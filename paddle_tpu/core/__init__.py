@@ -1,0 +1,17 @@
+"""Core runtime: IR descriptors, scope, op registry, block lowering, executor.
+
+This is the layer the reference implements in C++ under paddle/fluid/framework
+(ProgramDesc/Scope/Operator/Executor).  Here the "kernel dispatch" is replaced
+by whole-block lowering to XLA via JAX; see lowering.py.
+"""
+from .types import DataType, VarKind, np_dtype_to_proto, proto_to_np_dtype
+from .desc import Attr, OpDesc, VarDesc, BlockDesc, ProgramDesc
+from .scope import Scope
+from .registry import OpInfo, register_op, get_op_info, has_op, registered_ops
+
+__all__ = [
+    "DataType", "VarKind", "np_dtype_to_proto", "proto_to_np_dtype",
+    "Attr", "OpDesc", "VarDesc", "BlockDesc", "ProgramDesc",
+    "Scope", "OpInfo", "register_op", "get_op_info", "has_op",
+    "registered_ops",
+]

@@ -1,0 +1,268 @@
+"""Core Executor: runs a block of a ProgramDesc against a Scope.
+
+Parity: reference framework/executor.cc:127 (Executor::Run / Prepare /
+RunPreparedContext).  Two paths:
+
+- **Compiled path** (the normal one): the block is functionalized and lowered
+  to a single jitted XLA computation (see lowering.py), cached on
+  (program uid+version, block, feed specs, fetch list, mode).  Persistable
+  inputs that the block writes (optimizer in-place updates) are donated so
+  XLA reuses their buffers — the analog of the reference's buddy-allocator
+  reuse + in-place optimizer ops.
+- **Interpreted path**: if host ops (save/load/print/readers/RPC) appear
+  between device ops, ops run one-by-one eagerly — the "graceful fallback"
+  for ops XLA cannot express.  Host ops at the head/tail of a block (feed /
+  read / fetch) are peeled off and the middle still compiles.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import lowering
+from .lowering import LoweringContext, run_ops, run_op
+from .registry import get_op_info
+from .scope import Scope
+from .types import proto_to_np_dtype, VarKind
+
+# Flag parity: FLAGS_check_nan_inf (reference framework/operator.cc:590).
+check_nan_inf = False
+
+
+class _CacheEntry:
+    __slots__ = ("fn", "input_names", "persist_outs", "fetch_names")
+
+    def __init__(self, fn, input_names, persist_outs, fetch_names):
+        self.fn = fn
+        self.input_names = input_names
+        self.persist_outs = persist_outs
+        self.fetch_names = fetch_names
+
+
+class ExecutorCore:
+    def __init__(self, place):
+        self.place = place
+        self._cache = {}
+
+    # ------------------------------------------------------------------
+    def run(self, program, scope, block_id=0, feed=None, fetch_list=None,
+            mode="train", return_numpy=True):
+        feed = feed or {}
+        fetch_list = list(fetch_list or [])
+        block = program.blocks[block_id]
+
+        prelude, core_ops, postlude, mixed = _segment(block)
+        for op in prelude:
+            _run_host_op(self, op, scope, feed)
+
+        if mixed:
+            fetches = self._run_interpreted(program, block, scope, feed,
+                                            fetch_list, mode)
+        else:
+            fetches = self._run_compiled(program, block_id, core_ops, scope,
+                                         feed, fetch_list, mode)
+
+        for op in postlude:
+            _run_host_op(self, op, scope, feed)
+
+        if return_numpy:
+            fetches = [np.asarray(v) if v is not None and not isinstance(
+                v, (list, tuple)) else v for v in fetches]
+        return fetches
+
+    # ------------------------------------------------------------------
+    def _rng_key(self, program, scope):
+        seed = getattr(program, "random_seed", 0) or 0
+        counter = getattr(scope, "_rng_counter", 0)
+        scope._rng_counter = counter + 1
+        key = jax.random.PRNGKey(seed)
+        return jax.random.fold_in(key, counter)
+
+    def _run_compiled(self, program, block_id, core_ops, scope, feed,
+                      fetch_list, mode):
+        block = program.blocks[block_id]
+        feed_spec = tuple(sorted(
+            (name, tuple(np.shape(v)), str(np.asarray(v).dtype))
+            for name, v in feed.items()))
+        key = (program.uid, program.version, block_id, feed_spec,
+               tuple(fetch_list), mode)
+        entry = self._cache.get(key)
+        if entry is None:
+            entry = self._build(program, block_id, core_ops, scope, feed,
+                                fetch_list, mode)
+            self._cache[key] = entry
+
+        dev = self.place.jax_device()
+        args = []
+        for name in entry.input_names:
+            if name in feed:
+                val = feed[name]
+                vd = block.find_var_recursive(name)
+                if vd is not None and not hasattr(val, "dtype"):
+                    val = np.asarray(val, dtype=proto_to_np_dtype(vd.dtype))
+                args.append(jax.device_put(val, dev))
+            else:
+                args.append(scope.find_var(name))
+        rng = self._rng_key(program, scope)
+
+        fetches, persists = entry.fn(tuple(args), rng)
+        for name, val in zip(entry.persist_outs, persists):
+            scope.find_scope_of(name).set(name, val)
+        if check_nan_inf:
+            for name, val in zip(fetch_list, fetches):
+                if val is not None and jnp.issubdtype(
+                        jnp.result_type(val), jnp.floating):
+                    if not bool(jnp.isfinite(val).all()):
+                        raise FloatingPointError(
+                            "nan/inf in fetched var %r" % name)
+        return list(fetches)
+
+    def _build(self, program, block_id, core_ops, scope, feed, fetch_list,
+               mode):
+        block = program.blocks[block_id]
+        written = set()
+        external = []  # ordered reads satisfied by feed or scope
+        seen_ext = set()
+        for op in core_ops:
+            for name in op.input_arg_names():
+                if (name and name not in written and name not in seen_ext):
+                    seen_ext.add(name)
+                    external.append(name)
+            for name in op.output_arg_names():
+                if name:
+                    written.add(name)
+        # fetching an un-written var (e.g. a parameter) reads it too
+        for name in fetch_list:
+            if name and name not in written and name not in seen_ext:
+                seen_ext.add(name)
+                external.append(name)
+
+        input_names = []
+        for name in external:
+            if name in feed or scope.has_var(name):
+                input_names.append(name)
+            else:
+                raise RuntimeError(
+                    "variable %r is read by block %d but is neither fed nor "
+                    "initialized in the scope (run the startup program first)"
+                    % (name, block_id))
+
+        persist_outs = []
+        for name in written:
+            vd = block.find_var_recursive(name)
+            if vd is not None and vd.persistable:
+                persist_outs.append(name)
+        persist_outs.sort()
+
+        ops = list(core_ops)
+
+        def fn(inputs, rng):
+            env = dict(zip(input_names, inputs))
+            ctx = LoweringContext(program, block_id, env, rng, mode)
+            ctx.block = block
+            for op in ops:
+                run_op(ctx, op)
+            fetches = tuple(env.get(n) for n in fetch_list)
+            persists = tuple(env[n] for n in persist_outs)
+            return fetches, persists
+
+        # Donate persistable inputs that the block overwrites: XLA reuses
+        # the parameter buffers across steps (in-place optimizer update).
+        donate = tuple(
+            i for i, n in enumerate(input_names)
+            if n in persist_outs and not _in_feed_only(n, feed, scope))
+
+        def fn_flat(*flat_args):
+            return fn(tuple(flat_args[:-1]), flat_args[-1])
+
+        jflat = jax.jit(fn_flat, donate_argnums=donate)
+
+        def jfn(inputs, rng):
+            return jflat(*inputs, rng)
+
+        return _CacheEntry(jfn, input_names, persist_outs, tuple(fetch_list))
+
+    def _run_interpreted(self, program, block, scope, feed, fetch_list, mode):
+        dev = self.place.jax_device()
+        env = _ScopeEnv(scope, dev)
+        for name, val in feed.items():
+            vd = block.find_var_recursive(name)
+            dtype = (proto_to_np_dtype(vd.dtype) if vd is not None else None)
+            env[name] = jax.device_put(
+                np.asarray(val, dtype=dtype) if dtype else np.asarray(val),
+                dev)
+        ctx = LoweringContext(program, block.idx, env,
+                              self._rng_key(program, scope), mode)
+        for op in block.ops:
+            info = get_op_info(op.type)
+            if info.host_op:
+                _run_host_op(self, op, scope, feed, env)
+            else:
+                run_op(ctx, op)
+        # sync written persistables back
+        for name in env.written:
+            vd = block.find_var_recursive(name)
+            if vd is not None and vd.persistable:
+                s = scope.find_scope_of(name) or scope
+                s.set(name, env[name])
+        return [env.get(n) for n in fetch_list]
+
+
+class _ScopeEnv(dict):
+    """dict-like env that falls back to Scope lookups (interpreted path)."""
+
+    def __init__(self, scope, device):
+        super().__init__()
+        self.scope = scope
+        self.device = device
+        self.written = set()
+
+    def __contains__(self, name):
+        return super().__contains__(name) or self.scope.has_var(name)
+
+    def __missing__(self, name):
+        val = self.scope.find_var(name)  # KeyError if absent
+        super().__setitem__(name, val)
+        return val
+
+    def __setitem__(self, name, val):
+        self.written.add(name)
+        super().__setitem__(name, val)
+
+    def get(self, name, default=None):
+        try:
+            return self[name]
+        except KeyError:
+            return default
+
+
+def _in_feed_only(name, feed, scope):
+    return name in feed and not scope.has_var(name)
+
+
+def _segment(block):
+    """Split ops into host prelude / device core / host postlude.
+
+    Returns (prelude, core, postlude, mixed): ``mixed`` is True when host ops
+    are interleaved with device ops and the block must be interpreted.
+    """
+    ops = block.ops
+    is_host = [get_op_info(op.type).host_op for op in ops]
+    i = 0
+    while i < len(ops) and is_host[i]:
+        i += 1
+    j = len(ops)
+    while j > i and is_host[j - 1]:
+        j -= 1
+    mixed = any(is_host[i:j])
+    return ops[:i], ops[i:j], ops[j:], mixed
+
+
+def _run_host_op(executor, op, scope, feed, env=None):
+    info = get_op_info(op.type)
+    impl = getattr(info, "_host_impl", None) or getattr(info.lower,
+                                                        "host_impl", None)
+    if impl is None:
+        impl = info.lower
+    impl(executor, op, scope, feed, env)

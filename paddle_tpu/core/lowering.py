@@ -1,0 +1,308 @@
+"""Block -> JAX function lowering.
+
+This replaces the reference's per-op kernel dispatch loop
+(framework/executor.cc:332-345 + operator.cc:605 RunImpl): instead of running
+one CUDA kernel per op with a Scope of mutable tensors, an entire BlockDesc is
+traced into ONE pure JAX function (reads = arguments, writes = results) and
+compiled by XLA for the target backend.  XLA then does the fusion, layout
+assignment and scheduling that the reference implements by hand
+(operators/math/*, details/threaded_ssa_graph_executor.cc).
+
+The imperative Scope semantics are recovered by functionalization: variables
+read before written become function inputs; persistable variables that any op
+writes (e.g. sgd's in-place param update) become function outputs that the
+executor writes back to the Scope, with input buffers donated so XLA updates
+in place.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .registry import get_op_info
+from .types import proto_to_np_dtype, VarKind
+
+# Ops the trace skips entirely; the Executor handles them on the host.
+# (reference: feed_fetch_method.cc, save/load ops run as normal kernels —
+# here they are host-side by construction.)
+EMPTY_VAR = ""
+
+
+class Ins:
+    """Read-only view of an op's input slots during lowering.
+
+    ``ins[slot]`` -> the single value of a one-var slot;
+    ``ins.list(slot)`` -> list (entries may be None for empty var names);
+    ``ins.get(slot)`` -> single value or None.
+    """
+
+    __slots__ = ("_d",)
+
+    def __init__(self, d):
+        self._d = d
+
+    def __getitem__(self, slot):
+        v = self._d[slot]
+        if len(v) != 1 or v[0] is None:
+            raise ValueError("slot %r expected exactly one value, got %r" %
+                             (slot, v))
+        return v[0]
+
+    def get(self, slot, default=None):
+        v = self._d.get(slot)
+        if not v or v[0] is None:
+            return default
+        return v[0]
+
+    def list(self, slot):
+        return self._d.get(slot, [])
+
+    def has(self, slot):
+        v = self._d.get(slot)
+        return bool(v) and any(x is not None for x in v)
+
+    def slots(self):
+        return self._d.keys()
+
+
+class _Counter:
+    __slots__ = ("n",)
+
+    def __init__(self):
+        self.n = 0
+
+
+class LoweringContext:
+    """State threaded through the trace of one block (and its sub-blocks)."""
+
+    def __init__(self, program, block_idx, env, base_key, mode="train",
+                 counter=None):
+        self.program = program
+        self.block_idx = block_idx
+        self.block = program.blocks[block_idx]
+        self.env = env                  # name -> traced value
+        self.base_key = base_key        # jax PRNG key (traced)
+        self.mode = mode                # 'train' | 'test'
+        self._counter = counter or _Counter()
+
+    def next_key(self):
+        """Deterministic per-op PRNG key (replaces per-op curand states)."""
+        self._counter.n += 1
+        return jax.random.fold_in(self.base_key, self._counter.n)
+
+    def var_desc(self, name):
+        blk = self.block
+        while blk is not None:
+            if name in blk.vars:
+                return blk.vars[name]
+            blk = (self.program.blocks[blk.parent_idx]
+                   if blk.parent_idx >= 0 else None)
+        return None
+
+    def var_np_dtype(self, name):
+        vd = self.var_desc(name)
+        return np.float32 if vd is None else proto_to_np_dtype(vd.dtype)
+
+    def sub_context(self, block_idx, env):
+        """Context for tracing a sub-block (control flow bodies)."""
+        return LoweringContext(self.program, block_idx, env, self.base_key,
+                               self.mode, self._counter)
+
+
+def run_ops(ctx):
+    """Trace every op of ctx.block in order against ctx.env."""
+    for op in ctx.block.ops:
+        run_op(ctx, op)
+
+
+def run_op(ctx, op):
+    info = get_op_info(op.type)
+    if info.host_op:
+        return
+    ins = _gather_inputs(ctx.env, op)
+    attrs = {k: a.value for k, a in op.attrs.items()}
+    outs = info.lower(ctx, ins, attrs, op)
+    _scatter_outputs(ctx.env, op, outs)
+
+
+def _gather_inputs(env, op):
+    d = {}
+    for slot, names in op.inputs.items():
+        vals = []
+        for n in names:
+            if n == EMPTY_VAR:
+                vals.append(None)
+            elif n in env:
+                vals.append(env[n])
+            else:
+                raise KeyError(
+                    "op %s input %s/%s not found in environment" %
+                    (op.type, slot, n))
+        d[slot] = vals
+    return Ins(d)
+
+
+def _scatter_outputs(env, op, outs):
+    outs = outs or {}
+    for slot, names in op.outputs.items():
+        if slot not in outs:
+            if names and any(n != EMPTY_VAR for n in names):
+                raise ValueError("op %s produced no value for output slot %s"
+                                 % (op.type, slot))
+            continue
+        vals = outs[slot]
+        if not isinstance(vals, (list, tuple)):
+            vals = [vals]
+        if len(vals) != len(names):
+            raise ValueError(
+                "op %s output slot %s: %d values for %d names" %
+                (op.type, slot, len(vals), len(names)))
+        for n, v in zip(names, vals):
+            if n == EMPTY_VAR or v is None:
+                continue
+            env[n] = v
+
+
+# ---------------------------------------------------------------------------
+# Generic gradient lowering: jax.vjp of the forward lowering.
+# ---------------------------------------------------------------------------
+
+def generic_grad_lower(ctx, ins, attrs, op):
+    """Lower ``<fwd>_grad`` by differentiating the forward lowering.
+
+    Replaces hand-written grad kernels (reference operators/*_op.cc grad
+    kernels): inside one compiled block XLA fuses the vjp just as well as a
+    bespoke kernel, and correctness is guaranteed by construction.
+    """
+    fwd_type = op.type[: -len("_grad")]
+    info = get_op_info(fwd_type)
+
+    out_grad_slots = [s for s in ins.slots() if s.endswith("@GRAD")]
+    fwd_output_slots = [s[: -len("@GRAD")] for s in out_grad_slots]
+    fwd_input_slots = [s for s in ins.slots()
+                       if not s.endswith("@GRAD") and s not in fwd_output_slots]
+
+    # Differentiable leaf positions, read off the grad op's own outputs:
+    # slot "X@GRAD" name list parallels the forward slot "X" name list, with
+    # "" holes for non-differentiable / pruned entries.
+    wrt = []  # [(fwd_slot, index)]
+    for gslot, names in op.outputs.items():
+        base = gslot[: -len("@GRAD")]
+        for i, n in enumerate(names):
+            if n != EMPTY_VAR:
+                wrt.append((base, i))
+    if not wrt:
+        return {}
+
+    const_ins = {s: list(ins.list(s)) for s in fwd_input_slots}
+    primals = {}
+    for slot, i in wrt:
+        primals[(slot, i)] = const_ins[slot][i]
+
+    # Forward lowering must be deterministic under re-trace; stateful ops
+    # (dropout &c.) register custom grad lowerings instead.
+    sub_ctx = ctx  # shares the key counter; deterministic ops ignore it
+
+    def fwd(p):
+        merged = {s: list(v) for s, v in const_ins.items()}
+        for (slot, i), val in p.items():
+            merged[slot][i] = val
+        outs = info.lower(sub_ctx, Ins(merged), dict(attrs), None)
+        flat = {}
+        for s in fwd_output_slots:
+            v = outs.get(s)
+            if not isinstance(v, (list, tuple)):
+                v = [v]
+            flat[s] = [x if _is_float(x) else None for x in v]
+        return flat
+
+    out_vals, vjp_fn = jax.vjp(fwd, primals)
+
+    cots = {}
+    for s in fwd_output_slots:
+        gvals = ins.list(s + "@GRAD")
+        cot_list = []
+        for i, ov in enumerate(out_vals[s]):
+            if ov is None:
+                cot_list.append(None)
+                continue
+            g = gvals[i] if i < len(gvals) else None
+            cot_list.append(g if g is not None else jnp.zeros_like(ov))
+        cots[s] = cot_list
+    grads = vjp_fn(cots)[0]
+
+    result = {}
+    for gslot, names in op.outputs.items():
+        base = gslot[: -len("@GRAD")]
+        vals = []
+        for i, n in enumerate(names):
+            vals.append(grads.get((base, i)) if n != EMPTY_VAR else None)
+        result[gslot] = vals
+    return result
+
+
+def _is_float(x):
+    return x is not None and jnp.issubdtype(jnp.result_type(x), jnp.floating)
+
+
+# ---------------------------------------------------------------------------
+# Build-time shape inference by abstract evaluation.
+# ---------------------------------------------------------------------------
+
+_FAKE_BATCH = 97  # sentinel for dynamic (-1) dims during eval_shape
+
+
+def infer_op_outputs(program, block, op):
+    """Infer output (shape, dtype) per output var via jax.eval_shape.
+
+    Replaces reference per-op InferShape (operator.cc:606): abstract
+    evaluation of the lowering needs no hand-written shape functions.
+    Dynamic dims (-1) are substituted with a sentinel and mapped back.
+    """
+    info = get_op_info(op.type)
+    specs = {}
+    for slot, names in op.inputs.items():
+        lst = []
+        for n in names:
+            if n == EMPTY_VAR:
+                lst.append(None)
+                continue
+            vd = _find_var(program, block, n)
+            if vd is None:
+                raise KeyError("var %s not found for shape inference" % n)
+            shape = tuple(_FAKE_BATCH if d == -1 else d for d in vd.shape)
+            lst.append(jax.ShapeDtypeStruct(shape, proto_to_np_dtype(vd.dtype)))
+        specs[slot] = lst
+    attrs = {k: a.value for k, a in op.attrs.items()}
+
+    def f(s):
+        env = {}
+        ctx = LoweringContext(program, block.idx, env,
+                              jax.random.PRNGKey(0), "train")
+        outs = info.lower(ctx, Ins(s), attrs, op)
+        norm = {}
+        for slot, v in (outs or {}).items():
+            norm[slot] = list(v) if isinstance(v, (list, tuple)) else [v]
+        return norm
+
+    shaped = jax.eval_shape(f, specs)
+    result = {}
+    for slot, names in op.outputs.items():
+        if slot not in shaped:
+            continue
+        for n, sd in zip(names, shaped[slot]):
+            if n == EMPTY_VAR or sd is None:
+                continue
+            shape = tuple(-1 if d == _FAKE_BATCH else d for d in sd.shape)
+            result[n] = (shape, sd.dtype)
+    return result
+
+
+def _find_var(program, block, name):
+    blk = block
+    while blk is not None:
+        if name in blk.vars:
+            return blk.vars[name]
+        blk = program.blocks[blk.parent_idx] if blk.parent_idx >= 0 else None
+    return None

@@ -1,0 +1,60 @@
+"""Device places.
+
+Parity: reference platform/place.h:75 (CPUPlace:25, CUDAPlace:35).  The GPU
+place is replaced by TPUPlace; `CUDAPlace` is kept as a migration alias so
+reference user code runs unchanged.  A Place resolves to a jax.Device.
+"""
+from __future__ import annotations
+
+import jax
+
+
+class Place:
+    device_type = None
+
+    def __init__(self, device_id=0):
+        self.device_id = device_id
+
+    def __eq__(self, other):
+        return (type(self) is type(other)
+                and self.device_id == other.device_id)
+
+    def __hash__(self):
+        return hash((type(self).__name__, self.device_id))
+
+    def __repr__(self):
+        return "%s(%d)" % (type(self).__name__, self.device_id)
+
+    def jax_device(self):
+        devs = _devices_for(self.device_type)
+        if not devs:
+            raise RuntimeError("no %s devices available" % self.device_type)
+        return devs[self.device_id % len(devs)]
+
+
+def _devices_for(kind):
+    if kind == "cpu":
+        try:
+            return jax.devices("cpu")
+        except RuntimeError:
+            return []
+    # "accelerator": whatever the default backend exposes, minus pure-host
+    devs = jax.devices()
+    accel = [d for d in devs if d.platform != "cpu"]
+    return accel or devs  # fall back to CPU so tests run anywhere
+
+
+class CPUPlace(Place):
+    device_type = "cpu"
+
+
+class TPUPlace(Place):
+    device_type = "accelerator"
+
+
+# Migration alias for reference user code (platform/place.h:35).
+CUDAPlace = TPUPlace
+
+
+def is_accelerator_available():
+    return any(d.platform != "cpu" for d in jax.devices())

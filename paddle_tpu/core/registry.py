@@ -1,0 +1,84 @@
+"""Operator registry.
+
+Parity: reference framework/op_registry.h (REGISTER_OPERATOR + OpInfoMap) and
+grad_op_desc_maker.h.  An op here is:
+
+- ``lower(ctx, ins, attrs) -> outs``: a JAX tracing function.  ``ins``/``outs``
+  map slot name -> list of jax values (or a single value for convenience —
+  normalized by the engine).  This replaces the reference's per-device OpKernel
+  table: there is exactly one lowering, and XLA compiles it for the target
+  backend (TPU/CPU).
+- ``grad_maker(op, block, no_grad_set) -> (grad_op_descs, grad_to_var)``:
+  build-time autodiff hook, as in reference GradOpDescMakerBase.  The default
+  maker emits ``<type>_grad`` consuming forward ins/outs + output grads; the
+  default grad *lowering* evaluates jax.vjp of the forward lowering, so an op
+  gets a correct gradient without hand-writing one (XLA fuses it anyway).
+- ``infer_shape``: optional; the engine falls back to jax.eval_shape over the
+  lowering (abstract evaluation — no FLOPs).
+"""
+from __future__ import annotations
+
+
+class OpInfo:
+    __slots__ = ("type", "lower", "grad_maker", "grad_lower", "infer_shape",
+                 "host_op", "stateful", "wrt", "no_vjp_outputs")
+
+    def __init__(self, type_, lower=None, grad_maker="default",
+                 grad_lower=None, infer_shape=None, host_op=False,
+                 stateful=False, wrt=None, no_vjp_outputs=()):
+        self.type = type_
+        self.lower = lower
+        # "default" -> generic maker; None -> non-differentiable; callable -> custom
+        self.grad_maker = grad_maker
+        self.grad_lower = grad_lower
+        self.infer_shape = infer_shape
+        self.host_op = host_op          # executed on host by the Executor
+        self.stateful = stateful        # uses PRNG (dropout/uniform_random/...)
+        # slots to differentiate w.r.t.; None = all floating-point inputs
+        self.wrt = wrt
+        # output slots excluded from vjp (integer/aux outputs)
+        self.no_vjp_outputs = tuple(no_vjp_outputs)
+
+
+_registry = {}
+
+
+def register_op(type_, **kwargs):
+    """Register an op.  Usable directly or as a decorator on the lowering."""
+
+    def _do(lower):
+        if type_ in _registry:
+            raise ValueError("op %r already registered" % type_)
+        _registry[type_] = OpInfo(type_, lower=lower, **kwargs)
+        return lower
+
+    if "lower" in kwargs:
+        lower = kwargs.pop("lower")
+        return _do(lower)
+    return _do
+
+
+def get_op_info(type_):
+    info = _registry.get(type_)
+    if info is None and type_.endswith("_grad") and \
+            type_[: -len("_grad")] in _registry:
+        # Synthesize the grad op from the forward lowering's vjp
+        # (lowering.generic_grad_lower); registered lazily so explicit
+        # custom grad lowerings (e.g. dropout_grad) take precedence.
+        from . import lowering  # local import: registry <-> lowering cycle
+
+        info = OpInfo(type_, lower=lowering.generic_grad_lower,
+                      grad_maker=None)
+        _registry[type_] = info
+    if info is None:
+        raise KeyError("operator %r is not registered (registered: %d ops)" %
+                       (type_, len(_registry)))
+    return info
+
+
+def has_op(type_):
+    return type_ in _registry
+
+
+def registered_ops():
+    return sorted(_registry.keys())

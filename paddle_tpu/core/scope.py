@@ -1,0 +1,88 @@
+"""Scope: name -> value tree with parent lookup.
+
+Parity: reference framework/scope.h:39 / variable.h:26.  Values are
+type-erased Python objects; device tensors are jax.Arrays (committed to a
+device), host-side containers (LoDTensor, readers, step scopes) are plain
+objects.  Unlike the reference there is no separate Variable wrapper — the
+scope maps names directly to values plus a small metadata dict.
+"""
+from __future__ import annotations
+
+
+class Scope:
+    def __init__(self, parent=None):
+        self._parent = parent
+        self._vars = {}
+        self._kids = []
+
+    # --- tree ---
+    @property
+    def parent(self):
+        return self._parent
+
+    def new_scope(self):
+        kid = Scope(self)
+        self._kids.append(kid)
+        return kid
+
+    def drop_kids(self):
+        self._kids = []
+
+    # --- vars ---
+    def var(self, name):
+        """Find-or-create in THIS scope (reference Scope::Var)."""
+        if name not in self._vars:
+            self._vars[name] = None
+        return self._vars.get(name)
+
+    def set(self, name, value):
+        self._vars[name] = value
+
+    def find_var(self, name):
+        """Recursive lookup (reference Scope::FindVar). Returns value or
+        raises KeyError if the name exists nowhere."""
+        s = self
+        while s is not None:
+            if name in s._vars:
+                return s._vars[name]
+            s = s._parent
+        raise KeyError(name)
+
+    def has_var(self, name):
+        s = self
+        while s is not None:
+            if name in s._vars:
+                return True
+            s = s._parent
+        return False
+
+    def find_scope_of(self, name):
+        s = self
+        while s is not None:
+            if name in s._vars:
+                return s
+            s = s._parent
+        return None
+
+    def erase(self, names):
+        for n in names:
+            self._vars.pop(n, None)
+
+    def local_var_names(self):
+        return list(self._vars.keys())
+
+    def __contains__(self, name):
+        return self.has_var(name)
+
+
+_global_scope = Scope()
+
+
+def global_scope():
+    return _global_scope
+
+
+def reset_global_scope():
+    global _global_scope
+    _global_scope = Scope()
+    return _global_scope

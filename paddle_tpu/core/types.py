@@ -1,0 +1,102 @@
+"""Data type and variable-kind enums + numpy/jax mappings.
+
+Parity: reference framework/framework.proto VarType (dtype enum) and
+framework/data_type.h.  bfloat16 is first-class (TPU native compute type).
+"""
+import numpy as np
+
+from paddle_tpu.proto import framework_pb2 as pb
+
+try:
+    import ml_dtypes
+
+    _BF16 = np.dtype(ml_dtypes.bfloat16)
+except Exception:  # pragma: no cover
+    import jax.numpy as _jnp
+
+    _BF16 = np.dtype(_jnp.bfloat16)
+
+
+class DataType:
+    """Thin namespace over the proto enum (values are ints)."""
+
+    UNSET = pb.DT_UNSET
+    FP32 = pb.DT_FLOAT32
+    FP64 = pb.DT_FLOAT64
+    INT32 = pb.DT_INT32
+    INT64 = pb.DT_INT64
+    BOOL = pb.DT_BOOL
+    BF16 = pb.DT_BFLOAT16
+    FP16 = pb.DT_FLOAT16
+    UINT8 = pb.DT_UINT8
+    INT8 = pb.DT_INT8
+    INT16 = pb.DT_INT16
+    UINT32 = pb.DT_UINT32
+    UINT64 = pb.DT_UINT64
+
+
+class VarKind:
+    DENSE = pb.VK_DENSE
+    LOD_TENSOR = pb.VK_LOD_TENSOR
+    SELECTED_ROWS = pb.VK_SELECTED_ROWS
+    READER = pb.VK_READER
+    STEP_SCOPES = pb.VK_STEP_SCOPES
+    LOD_TENSOR_ARRAY = pb.VK_LOD_TENSOR_ARRAY
+    FETCH_LIST = pb.VK_FETCH_LIST
+    FEED_MINIBATCH = pb.VK_FEED_MINIBATCH
+    RAW = pb.VK_RAW
+    LOD_RANK_TABLE = pb.VK_LOD_RANK_TABLE
+
+
+_NP_TO_PROTO = {
+    np.dtype(np.float32): DataType.FP32,
+    np.dtype(np.float64): DataType.FP64,
+    np.dtype(np.int32): DataType.INT32,
+    np.dtype(np.int64): DataType.INT64,
+    np.dtype(np.bool_): DataType.BOOL,
+    _BF16: DataType.BF16,
+    np.dtype(np.float16): DataType.FP16,
+    np.dtype(np.uint8): DataType.UINT8,
+    np.dtype(np.int8): DataType.INT8,
+    np.dtype(np.int16): DataType.INT16,
+    np.dtype(np.uint32): DataType.UINT32,
+    np.dtype(np.uint64): DataType.UINT64,
+}
+_PROTO_TO_NP = {v: k for k, v in _NP_TO_PROTO.items()}
+
+_STR_TO_PROTO = {
+    "float32": DataType.FP32,
+    "float64": DataType.FP64,
+    "int32": DataType.INT32,
+    "int64": DataType.INT64,
+    "bool": DataType.BOOL,
+    "bfloat16": DataType.BF16,
+    "float16": DataType.FP16,
+    "uint8": DataType.UINT8,
+    "int8": DataType.INT8,
+    "int16": DataType.INT16,
+    "uint32": DataType.UINT32,
+    "uint64": DataType.UINT64,
+}
+
+
+def np_dtype_to_proto(dtype):
+    """numpy dtype / dtype-string / proto int -> proto DataType int."""
+    if isinstance(dtype, int):
+        return dtype
+    if isinstance(dtype, str):
+        return _STR_TO_PROTO[dtype]
+    return _NP_TO_PROTO[np.dtype(dtype)]
+
+
+def proto_to_np_dtype(proto_dtype):
+    return _PROTO_TO_NP[proto_dtype]
+
+
+def dtype_is_floating(proto_dtype):
+    return proto_dtype in (DataType.FP32, DataType.FP64, DataType.BF16,
+                           DataType.FP16)
+
+
+def dtype_name(proto_dtype):
+    return str(proto_to_np_dtype(proto_dtype))

@@ -1,0 +1,16 @@
+"""Operator library: importing this package registers every op.
+
+Parity: reference paddle/fluid/operators/ (~160 op types, 228 .cc / 129 .cu
+files).  Here each op is a JAX lowering registered into core.registry; grad
+ops default to the vjp of the forward lowering (core/lowering.py).
+"""
+from paddle_tpu.ops import (  # noqa: F401
+    math,
+    nn,
+    loss,
+    tensor,
+    random,
+    optimizer_ops,
+    io_ops,
+    metric,
+)

@@ -1,0 +1,106 @@
+"""Host-side IO ops: feed / fetch / save / load / print.
+
+Parity: reference operators/feed_op.cc, fetch_op.cc, save_op.cc, load_op.cc,
+save_combine_op.cc, load_combine_op.cc, print_op.cc.  These run on the host
+(the executor peels them off the compiled block — see executor_impl._segment).
+"""
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+from paddle_tpu.core.registry import register_op
+from paddle_tpu.utils import serialization
+
+
+def _host(name):
+    def deco(impl):
+        register_op(name, lower=impl, host_op=True, grad_maker=None)
+        return impl
+
+    return deco
+
+
+@_host("feed")
+def _feed(executor, op, scope, feed, env=None):
+    out = op.output("Out")[0]
+    val = feed.get(out)
+    if val is not None:
+        target = env if env is not None else scope
+        if env is not None:
+            env[out] = val
+        else:
+            scope.set(out, np.asarray(val))
+
+
+@_host("fetch")
+def _fetch(executor, op, scope, feed, env=None):
+    # fetch handled by the executor's fetch_list; op kept for program parity
+    pass
+
+
+@_host("save")
+def _save(executor, op, scope, feed, env=None):
+    path = op.attr("file_path")
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    name = op.input("X")[0]
+    val = env[name] if env is not None else scope.find_var(name)
+    serialization.save_tensor(path, np.asarray(val))
+
+
+@_host("load")
+def _load(executor, op, scope, feed, env=None):
+    path = op.attr("file_path")
+    arr = serialization.load_tensor(path)
+    name = op.output("Out")[0]
+    if env is not None:
+        env[name] = arr
+    s = scope.find_scope_of(name) or scope
+    s.set(name, arr)
+
+
+@_host("save_combine")
+def _save_combine(executor, op, scope, feed, env=None):
+    path = op.attr("file_path")
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    items = []
+    for name in op.input("X"):
+        val = env[name] if env is not None else scope.find_var(name)
+        items.append((name, np.asarray(val)))
+    serialization.save_combined(path, items)
+
+
+@_host("load_combine")
+def _load_combine(executor, op, scope, feed, env=None):
+    path = op.attr("file_path")
+    loaded = dict(serialization.load_combined(path))
+    for name in op.output("Out"):
+        arr = loaded[name]
+        if env is not None:
+            env[name] = arr
+        s = scope.find_scope_of(name) or scope
+        s.set(name, arr)
+
+
+@_host("print")
+def _print(executor, op, scope, feed, env=None):
+    name = op.input("In")[0]
+    val = env[name] if env is not None else scope.find_var(name)
+    msg = op.attr("message", "")
+    arr = np.asarray(val)
+    parts = [msg or name]
+    if op.attr("print_tensor_shape", True):
+        parts.append("shape=%s" % (arr.shape,))
+    if op.attr("print_tensor_type", True):
+        parts.append("dtype=%s" % arr.dtype)
+    if op.attr("summarize", -1) != 0:
+        parts.append("data=%s" % np.array2string(arr, threshold=20))
+    print("\t".join(parts))
+    if env is not None and op.output("Out"):
+        env[op.output("Out")[0]] = val
+
+
+@_host("delete_var")
+def _delete_var(executor, op, scope, feed, env=None):
+    scope.erase(op.input("X"))

@@ -1,0 +1,160 @@
+"""Loss ops.
+
+Parity: reference operators/cross_entropy_op.cc,
+softmax_with_cross_entropy_op.cc, sigmoid_cross_entropy_with_logits_op.cc,
+hinge_loss_op.cc, huber_loss_op.cc, log_loss_op.cc, rank_loss_op.cc,
+margin_rank_loss_op.cc, smooth_l1_loss_op.cc, modified_huber_loss_op.cc,
+bilinear_tensor_product_op.cc, nce_op.cc.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.registry import register_op
+
+_TOL = 1e-20  # reference math/cross_entropy.h TolerableValue
+
+
+@register_op("cross_entropy")
+def _cross_entropy(ctx, ins, attrs, op):
+    x = ins["X"]          # [N, D] probabilities
+    label = ins["Label"]
+    if attrs.get("soft_label", False):
+        loss = -jnp.sum(label * jnp.log(jnp.maximum(x, _TOL)), axis=-1,
+                        keepdims=True)
+    else:
+        idx = label.reshape(-1).astype(jnp.int32)
+        picked = jnp.take_along_axis(x, idx[:, None], axis=-1)
+        loss = -jnp.log(jnp.maximum(picked, _TOL))
+    return {"Y": loss}
+
+
+@register_op("softmax_with_cross_entropy")
+def _softmax_with_ce(ctx, ins, attrs, op):
+    logits = ins["Logits"]
+    label = ins["Label"]
+    lse = jax.scipy.special.logsumexp(logits, axis=-1, keepdims=True)
+    log_softmax = logits - lse
+    softmax = jnp.exp(log_softmax)
+    if attrs.get("soft_label", False):
+        loss = -jnp.sum(label * log_softmax, axis=-1, keepdims=True)
+    else:
+        idx = label.reshape(-1).astype(jnp.int32)
+        picked = jnp.take_along_axis(log_softmax, idx[:, None], axis=-1)
+        loss = -picked
+    return {"Softmax": softmax, "Loss": loss}
+
+
+@register_op("sigmoid_cross_entropy_with_logits")
+def _sigmoid_ce(ctx, ins, attrs, op):
+    x, label = ins["X"], ins["Label"]
+    # log(1+exp(x)) - x*label, numerically stable
+    loss = jnp.maximum(x, 0) - x * label + jnp.log1p(jnp.exp(-jnp.abs(x)))
+    return {"Out": loss}
+
+
+@register_op("hinge_loss")
+def _hinge_loss(ctx, ins, attrs, op):
+    logits, labels = ins["Logits"], ins["Labels"]
+    signs = 2.0 * labels - 1.0
+    return {"Loss": jnp.maximum(0.0, 1.0 - signs * logits)}
+
+
+@register_op("huber_loss")
+def _huber_loss(ctx, ins, attrs, op):
+    x, y = ins["X"], ins["Y"]
+    delta = attrs.get("delta", 1.0)
+    r = y - x
+    ar = jnp.abs(r)
+    loss = jnp.where(ar <= delta, 0.5 * r * r,
+                     delta * (ar - 0.5 * delta))
+    return {"Out": loss, "Residual": r}
+
+
+@register_op("log_loss")
+def _log_loss(ctx, ins, attrs, op):
+    p, label = ins["Predicted"], ins["Labels"]
+    eps = attrs.get("epsilon", 1e-4)
+    loss = -label * jnp.log(p + eps) - (1 - label) * jnp.log(1 - p + eps)
+    return {"Loss": loss}
+
+
+@register_op("rank_loss")
+def _rank_loss(ctx, ins, attrs, op):
+    label, left, right = ins["Label"], ins["Left"], ins["Right"]
+    d = left - right
+    return {"Out": jnp.maximum(d, 0) - d * label + jnp.log1p(
+        jnp.exp(-jnp.abs(d)))}
+
+
+@register_op("margin_rank_loss")
+def _margin_rank_loss(ctx, ins, attrs, op):
+    label, x1, x2 = ins["Label"], ins["X1"], ins["X2"]
+    margin = attrs.get("margin", 0.0)
+    out = jnp.maximum(0.0, -label * (x1 - x2) + margin)
+    return {"Out": out, "Activated": (out > 0).astype(x1.dtype)}
+
+
+@register_op("smooth_l1_loss")
+def _smooth_l1(ctx, ins, attrs, op):
+    x, y = ins["X"], ins["Y"]
+    sigma = attrs.get("sigma", 1.0)
+    s2 = sigma * sigma
+    diff = x - y
+    if ins.has("InsideWeight"):
+        diff = diff * ins["InsideWeight"]
+    ad = jnp.abs(diff)
+    elem = jnp.where(ad < 1.0 / s2, 0.5 * s2 * diff * diff,
+                     ad - 0.5 / s2)
+    if ins.has("OutsideWeight"):
+        elem = elem * ins["OutsideWeight"]
+    return {"Diff": diff, "Out": jnp.sum(
+        elem.reshape(elem.shape[0], -1), axis=1, keepdims=True)}
+
+
+@register_op("modified_huber_loss")
+def _modified_huber(ctx, ins, attrs, op):
+    x, y = ins["X"], ins["Y"]
+    s = 2.0 * y - 1.0
+    z = x * s
+    loss = jnp.where(z >= 1.0, jnp.zeros_like(z),
+                     jnp.where(z >= -1.0, jnp.square(1.0 - z), -4.0 * z))
+    return {"IntermediateVal": z, "Out": loss}
+
+
+@register_op("bilinear_tensor_product")
+def _bilinear_tp(ctx, ins, attrs, op):
+    x, y, w = ins["X"], ins["Y"], ins["Weight"]  # [N,M],[N,P],[S,M,P]
+    out = jnp.einsum("nm,smp,np->ns", x, w, y)
+    if ins.has("Bias"):
+        out = out + ins["Bias"]
+    return {"Out": out}
+
+
+@register_op("nce", stateful=True)
+def _nce(ctx, ins, attrs, op):
+    """Noise-contrastive estimation (reference nce_op.cc), uniform sampler."""
+    x = ins["Input"]              # [N, D]
+    label = ins["Label"]          # [N, T]
+    w = ins["Weight"]             # [V, D]
+    num_neg = attrs.get("num_neg_samples", 10)
+    total = attrs.get("num_total_classes")
+    n = x.shape[0]
+    t = label.shape[1] if label.ndim > 1 else 1
+    label2 = label.reshape(n, t)
+    key = ctx.next_key()
+    neg = jax.random.randint(key, (n, num_neg), 0, total)
+    samples = jnp.concatenate([label2, neg], axis=1)      # [N, T+S]
+    ws = w[samples]                                       # [N, T+S, D]
+    logits = jnp.einsum("nd,nkd->nk", x, ws)
+    if ins.has("Bias"):
+        logits = logits + ins["Bias"][samples]
+    p_noise = 1.0 / total
+    # logits adjusted by noise distribution: sigmoid CE against true/noise
+    lbl = jnp.concatenate([jnp.ones((n, t)), jnp.zeros((n, num_neg))], axis=1)
+    adj = logits - jnp.log(num_neg * p_noise)
+    per = jnp.maximum(adj, 0) - adj * lbl + jnp.log1p(jnp.exp(-jnp.abs(adj)))
+    cost = jnp.sum(per, axis=1, keepdims=True)
+    return {"Cost": cost, "SampleLogits": logits,
+            "SampleLabels": samples}

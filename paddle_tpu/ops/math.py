@@ -1,0 +1,333 @@
+"""Math / elementwise / activation / reduce ops.
+
+Parity: reference operators/elementwise_*_op.cc, activation_op.cc, mul_op.cc,
+matmul_op.cc, scale_op.cc, sum_op.cc, mean_op.cc, reduce_op.cc, clip_op.cc,
+compare_op.cc, logical_op.cc, cast_op.cc, cumsum_op.cc, sign_op.cc,
+cos_sim_op.cc.  All lower to jnp/lax; gradients come from the generic vjp of
+the lowering (XLA fuses them), so no hand-written grad kernels are needed.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.core.registry import register_op
+from paddle_tpu.core.types import proto_to_np_dtype
+
+
+# ---------------------------------------------------------------------------
+# Elementwise binary ops with the reference's axis-broadcast rule
+# (elementwise_op_function.h): y's dims align to x's starting at `axis`.
+# ---------------------------------------------------------------------------
+
+def broadcast_y_to_x(x, y, axis):
+    if x.shape == y.shape or y.ndim == 0:
+        return y
+    if axis < 0:
+        axis = x.ndim - y.ndim
+    new_shape = [1] * axis + list(y.shape) + [1] * (x.ndim - axis - y.ndim)
+    return y.reshape(new_shape)
+
+
+def _ew(name, fn):
+    def lower(ctx, ins, attrs, op):
+        x = ins["X"]
+        y = broadcast_y_to_x(x, ins["Y"], attrs.get("axis", -1))
+        return {"Out": fn(x, y)}
+
+    register_op(name, lower=lower)
+
+
+_ew("elementwise_add", jnp.add)
+_ew("elementwise_sub", jnp.subtract)
+_ew("elementwise_mul", jnp.multiply)
+_ew("elementwise_div", jnp.divide)
+_ew("elementwise_max", jnp.maximum)
+_ew("elementwise_min", jnp.minimum)
+_ew("elementwise_pow", jnp.power)
+_ew("elementwise_mod", jnp.mod)
+_ew("elementwise_floordiv", jnp.floor_divide)
+
+
+# ---------------------------------------------------------------------------
+# Activations (reference activation_op.cc registers ~20 of these).
+# ---------------------------------------------------------------------------
+
+def _act(name, fn, **reg_kwargs):
+    def lower(ctx, ins, attrs, op):
+        return {"Out": fn(ins["X"], attrs)}
+
+    register_op(name, lower=lower, **reg_kwargs)
+
+
+_act("relu", lambda x, a: jax.nn.relu(x))
+_act("sigmoid", lambda x, a: jax.nn.sigmoid(x))
+_act("logsigmoid", lambda x, a: jax.nn.log_sigmoid(x))
+_act("tanh", lambda x, a: jnp.tanh(x))
+_act("tanh_shrink", lambda x, a: x - jnp.tanh(x))
+_act("sqrt", lambda x, a: jnp.sqrt(x))
+_act("abs", lambda x, a: jnp.abs(x))
+_act("ceil", lambda x, a: jnp.ceil(x), grad_maker=None)
+_act("floor", lambda x, a: jnp.floor(x), grad_maker=None)
+_act("round", lambda x, a: jnp.round(x), grad_maker=None)
+_act("cos", lambda x, a: jnp.cos(x))
+_act("sin", lambda x, a: jnp.sin(x))
+_act("exp", lambda x, a: jnp.exp(x))
+_act("log", lambda x, a: jnp.log(x))
+_act("square", lambda x, a: jnp.square(x))
+_act("reciprocal", lambda x, a: 1.0 / x)
+_act("softplus", lambda x, a: jax.nn.softplus(x))
+_act("softsign", lambda x, a: x / (1 + jnp.abs(x)))
+_act("relu6", lambda x, a: jnp.clip(x, 0, a.get("threshold", 6.0)))
+_act("pow", lambda x, a: jnp.power(x, a.get("factor", 1.0)))
+_act("stanh", lambda x, a: a.get("scale_b", 1.7159) * jnp.tanh(
+    a.get("scale_a", 2.0 / 3.0) * x))
+_act("hard_sigmoid", lambda x, a: jnp.clip(
+    a.get("slope", 0.2) * x + a.get("offset", 0.5), 0.0, 1.0))
+_act("elu", lambda x, a: jnp.where(
+    x > 0, x, a.get("alpha", 1.0) * (jnp.exp(jnp.minimum(x, 0.0)) - 1)))
+_act("leaky_relu", lambda x, a: jnp.where(x > 0, x, a.get("alpha", 0.02) * x))
+_act("brelu", lambda x, a: jnp.clip(x, a.get("t_min", 0.0),
+                                    a.get("t_max", 24.0)))
+_act("soft_relu", lambda x, a: jnp.log(
+    1 + jnp.exp(jnp.clip(x, -a.get("threshold", 40.0),
+                         a.get("threshold", 40.0)))))
+_act("thresholded_relu", lambda x, a: jnp.where(
+    x > a.get("threshold", 1.0), x, jnp.zeros_like(x)))
+_act("hard_shrink", lambda x, a: jnp.where(
+    jnp.abs(x) > a.get("threshold", 0.5), x, jnp.zeros_like(x)))
+_act("softshrink", lambda x, a: jnp.sign(x) * jnp.maximum(
+    jnp.abs(x) - a.get("lambda", 0.5), 0.0))
+_act("swish", lambda x, a: x * jax.nn.sigmoid(a.get("beta", 1.0) * x))
+_act("sign", lambda x, a: jnp.sign(x), grad_maker=None)
+
+
+@register_op("prelu")
+def _prelu(ctx, ins, attrs, op):
+    x, alpha = ins["X"], ins["Alpha"]
+    mode = attrs.get("mode", "all")
+    if mode == "channel":
+        alpha = alpha.reshape((1, -1) + (1,) * (x.ndim - 2))
+    elif mode == "element":
+        alpha = alpha.reshape((1,) + x.shape[1:])
+    return {"Out": jnp.where(x > 0, x, alpha * x)}
+
+
+# ---------------------------------------------------------------------------
+# Linear algebra
+# ---------------------------------------------------------------------------
+
+@register_op("mul")
+def _mul(ctx, ins, attrs, op):
+    """reference mul_op.cc: flatten X to 2-D by x_num_col_dims, Y by
+    y_num_col_dims, matmul, restore leading dims.  This is THE fc matmul —
+    it must land on the MXU, hence a plain jnp.dot."""
+    x, y = ins["X"], ins["Y"]
+    xn = attrs.get("x_num_col_dims", 1)
+    yn = attrs.get("y_num_col_dims", 1)
+    xs, ys = x.shape, y.shape
+    x2 = x.reshape((int(np.prod(xs[:xn])), -1))
+    y2 = y.reshape((int(np.prod(ys[:yn])), -1))
+    out = jnp.dot(x2, y2, preferred_element_type=jnp.result_type(x2, y2))
+    return {"Out": out.reshape(xs[:xn] + ys[yn:])}
+
+
+@register_op("matmul")
+def _matmul(ctx, ins, attrs, op):
+    x, y = ins["X"], ins["Y"]
+    if attrs.get("transpose_X", False):
+        x = jnp.swapaxes(x, -1, -2) if x.ndim > 1 else x
+    if attrs.get("transpose_Y", False):
+        y = jnp.swapaxes(y, -1, -2) if y.ndim > 1 else y
+    out = jnp.matmul(x, y)
+    alpha = attrs.get("alpha", 1.0)
+    if alpha != 1.0:
+        out = out * alpha
+    return {"Out": out}
+
+
+@register_op("scale")
+def _scale(ctx, ins, attrs, op):
+    x = ins["X"]
+    scale = attrs.get("scale", 1.0)
+    bias = attrs.get("bias", 0.0)
+    if attrs.get("bias_after_scale", True):
+        return {"Out": x * scale + bias}
+    return {"Out": (x + bias) * scale}
+
+
+@register_op("sum")
+def _sum(ctx, ins, attrs, op):
+    xs = [x for x in ins.list("X") if x is not None]
+    out = xs[0]
+    for x in xs[1:]:
+        out = out + x
+    return {"Out": out}
+
+
+@register_op("mean")
+def _mean(ctx, ins, attrs, op):
+    return {"Out": jnp.mean(ins["X"]).reshape((1,))}
+
+
+@register_op("minus")
+def _minus(ctx, ins, attrs, op):
+    return {"Out": ins["X"] - ins["Y"]}
+
+
+@register_op("cos_sim")
+def _cos_sim(ctx, ins, attrs, op):
+    x, y = ins["X"], ins["Y"]
+    xn = jnp.sqrt(jnp.sum(x * x, axis=1, keepdims=True))
+    yn = jnp.sqrt(jnp.sum(y * y, axis=1, keepdims=True))
+    z = jnp.sum(x * y, axis=1, keepdims=True) / (xn * yn)
+    return {"Out": z, "XNorm": xn, "YNorm": yn}
+
+
+@register_op("clip")
+def _clip(ctx, ins, attrs, op):
+    return {"Out": jnp.clip(ins["X"], attrs.get("min"), attrs.get("max"))}
+
+
+@register_op("clip_by_norm")
+def _clip_by_norm(ctx, ins, attrs, op):
+    x = ins["X"]
+    max_norm = attrs.get("max_norm")
+    norm = jnp.sqrt(jnp.sum(x * x))
+    scale = jnp.where(norm > max_norm, max_norm / jnp.maximum(norm, 1e-12),
+                      jnp.ones_like(norm))
+    return {"Out": x * scale}
+
+
+@register_op("squared_l2_norm")
+def _squared_l2_norm(ctx, ins, attrs, op):
+    return {"Out": jnp.sum(jnp.square(ins["X"])).reshape((1,))}
+
+
+@register_op("squared_l2_distance")
+def _squared_l2_distance(ctx, ins, attrs, op):
+    x, y = ins["X"], ins["Y"]
+    diff = x - y
+    return {"sub_result": diff,
+            "Out": jnp.sum(jnp.square(diff), axis=1, keepdims=True)}
+
+
+@register_op("l1_norm")
+def _l1_norm(ctx, ins, attrs, op):
+    return {"Out": jnp.sum(jnp.abs(ins["X"])).reshape((1,))}
+
+
+@register_op("cumsum")
+def _cumsum(ctx, ins, attrs, op):
+    x = ins["X"]
+    axis = attrs.get("axis", -1)
+    rev = attrs.get("reverse", False)
+    if rev:
+        x = jnp.flip(x, axis)
+    out = jnp.cumsum(x, axis=axis)
+    if attrs.get("exclusive", False):
+        out = out - (jnp.flip(ins["X"], axis) if rev else ins["X"])
+    if rev:
+        out = jnp.flip(out, axis)
+    return {"Out": out}
+
+
+@register_op("norm")
+def _norm(ctx, ins, attrs, op):
+    x = ins["X"]
+    axis = attrs.get("axis", 1)
+    eps = attrs.get("epsilon", 1e-10)
+    norm = jnp.sqrt(jnp.sum(x * x, axis=axis, keepdims=True) + eps)
+    return {"Out": x / norm, "Norm": norm}
+
+
+# ---------------------------------------------------------------------------
+# Reduce family (reference reduce_op.cc)
+# ---------------------------------------------------------------------------
+
+def _reduce(name, fn):
+    def lower(ctx, ins, attrs, op):
+        x = ins["X"]
+        dims = attrs.get("dim", [0])
+        if isinstance(dims, int):
+            dims = [dims]
+        keep = attrs.get("keep_dim", False)
+        if attrs.get("reduce_all", False):
+            out = fn(x, axis=None, keepdims=keep)
+            if not keep:
+                out = out.reshape((1,))
+        else:
+            axes = tuple(d if d >= 0 else d + x.ndim for d in dims)
+            out = fn(x, axis=axes, keepdims=keep)
+        return {"Out": out}
+
+    register_op(name, lower=lower)
+
+
+_reduce("reduce_sum", jnp.sum)
+_reduce("reduce_mean", jnp.mean)
+_reduce("reduce_max", jnp.max)
+_reduce("reduce_min", jnp.min)
+_reduce("reduce_prod", jnp.prod)
+
+
+# ---------------------------------------------------------------------------
+# Comparison / logical (bool outputs, non-differentiable)
+# ---------------------------------------------------------------------------
+
+def _cmp(name, fn):
+    def lower(ctx, ins, attrs, op):
+        x = ins["X"]
+        y = broadcast_y_to_x(x, ins["Y"], attrs.get("axis", -1))
+        return {"Out": fn(x, y)}
+
+    register_op(name, lower=lower, grad_maker=None)
+
+
+_cmp("less_than", jnp.less)
+_cmp("less_equal", jnp.less_equal)
+_cmp("greater_than", jnp.greater)
+_cmp("greater_equal", jnp.greater_equal)
+_cmp("equal", jnp.equal)
+_cmp("not_equal", jnp.not_equal)
+
+
+def _logical(name, fn, unary=False):
+    def lower(ctx, ins, attrs, op):
+        if unary:
+            return {"Out": fn(ins["X"])}
+        return {"Out": fn(ins["X"], ins["Y"])}
+
+    register_op(name, lower=lower, grad_maker=None)
+
+
+_logical("logical_and", jnp.logical_and)
+_logical("logical_or", jnp.logical_or)
+_logical("logical_xor", jnp.logical_xor)
+_logical("logical_not", jnp.logical_not, unary=True)
+
+
+@register_op("cast", grad_maker="default")
+def _cast(ctx, ins, attrs, op):
+    out_dtype = proto_to_np_dtype(attrs["out_dtype"])
+    return {"Out": ins["X"].astype(out_dtype)}
+
+
+@register_op("isfinite", grad_maker=None)
+def _isfinite(ctx, ins, attrs, op):
+    return {"Out": jnp.isfinite(ins["X"]).all().reshape((1,))}
+
+
+@register_op("increment")
+def _increment(ctx, ins, attrs, op):
+    x = ins["X"]
+    return {"Out": x + jnp.asarray(attrs.get("step", 1.0), dtype=x.dtype)}
+
+
+@register_op("maxout")
+def _maxout(ctx, ins, attrs, op):
+    x = ins["X"]  # NCHW
+    groups = attrs["groups"]
+    n, c, h, w = x.shape
+    return {"Out": x.reshape(n, c // groups, groups, h, w).max(axis=2)}

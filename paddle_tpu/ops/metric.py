@@ -1,0 +1,82 @@
+"""Metric ops.
+
+Parity: reference operators/accuracy_op.cc, auc_op.cc, precision_recall_op.cc,
+edit_distance_op.cc (dense form), chunk_eval is host-side in metrics.py.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from paddle_tpu.core.registry import register_op
+
+
+@register_op("accuracy", grad_maker=None)
+def _accuracy(ctx, ins, attrs, op):
+    """Top-k accuracy: Indices [N,k] from top_k, Label [N,1]."""
+    indices = ins["Indices"]
+    label = ins["Label"].reshape(-1, 1)
+    correct = jnp.any(indices == label, axis=1)
+    num_correct = jnp.sum(correct.astype(jnp.int32))
+    total = indices.shape[0]
+    acc = num_correct.astype(jnp.float32) / float(total)
+    return {"Accuracy": acc.reshape((1,)),
+            "Correct": num_correct.reshape((1,)),
+            "Total": jnp.asarray([total], dtype=jnp.int32)}
+
+
+@register_op("auc", grad_maker=None)
+def _auc(ctx, ins, attrs, op):
+    """Streaming AUC with histogram buckets (reference auc_op.cc).
+    Inputs: Predict [N,2] (prob of class 1 in col 1), Label [N,1],
+    stat vars TP/FP/TN/FN [num_thresholds]."""
+    predict = ins["Predict"]
+    label = ins["Label"].reshape(-1)
+    num_t = attrs.get("num_thresholds", 200)
+    pos_prob = predict[:, -1]
+    thresholds = (jnp.arange(num_t, dtype=jnp.float32) + 1.0) / (num_t + 1.0)
+    pred_pos = pos_prob[None, :] > thresholds[:, None]      # [T, N]
+    is_pos = (label > 0)[None, :]
+    tp = ins["TP"] + jnp.sum(pred_pos & is_pos, axis=1)
+    fp = ins["FP"] + jnp.sum(pred_pos & ~is_pos, axis=1)
+    tn = ins["TN"] + jnp.sum(~pred_pos & ~is_pos, axis=1)
+    fn = ins["FN"] + jnp.sum(~pred_pos & is_pos, axis=1)
+    tpr = tp.astype(jnp.float32) / jnp.maximum(
+        (tp + fn).astype(jnp.float32), 1e-6)
+    fpr = fp.astype(jnp.float32) / jnp.maximum(
+        (fp + tn).astype(jnp.float32), 1e-6)
+    # trapezoid over decreasing fpr
+    auc = jnp.sum((fpr[:-1] - fpr[1:]) * (tpr[:-1] + tpr[1:]) / 2.0)
+    return {"AUC": auc.reshape((1,)), "TPOut": tp, "FPOut": fp,
+            "TNOut": tn, "FNOut": fn}
+
+
+@register_op("precision_recall", grad_maker=None)
+def _precision_recall(ctx, ins, attrs, op):
+    """Multi-class precision/recall (reference precision_recall_op.cc)."""
+    max_probs = ins["MaxProbs"].reshape(-1)
+    indices = ins["Indices"].reshape(-1).astype(jnp.int32)
+    labels = ins["Labels"].reshape(-1).astype(jnp.int32)
+    cls = attrs.get("class_number")
+    weights = (ins["Weights"].reshape(-1) if ins.has("Weights")
+               else jnp.ones_like(max_probs))
+    tp = jnp.zeros((cls,), jnp.float32).at[labels].add(
+        jnp.where(indices == labels, weights, 0.0))
+    pred_cnt = jnp.zeros((cls,), jnp.float32).at[indices].add(weights)
+    true_cnt = jnp.zeros((cls,), jnp.float32).at[labels].add(weights)
+    states = jnp.stack([tp, pred_cnt - tp, true_cnt - tp,
+                        jnp.zeros_like(tp)], axis=1)
+    if ins.has("StatesInfo"):
+        states = states + ins["StatesInfo"]
+    tp_a, fp_a, fn_a = states[:, 0], states[:, 1], states[:, 2]
+    prec = tp_a / jnp.maximum(tp_a + fp_a, 1e-6)
+    rec = tp_a / jnp.maximum(tp_a + fn_a, 1e-6)
+    f1 = 2 * prec * rec / jnp.maximum(prec + rec, 1e-6)
+    macro = jnp.stack([jnp.mean(prec), jnp.mean(rec), jnp.mean(f1)])
+    tp_s, fp_s, fn_s = tp_a.sum(), fp_a.sum(), fn_a.sum()
+    mprec = tp_s / jnp.maximum(tp_s + fp_s, 1e-6)
+    mrec = tp_s / jnp.maximum(tp_s + fn_s, 1e-6)
+    micro = jnp.stack([mprec, mrec,
+                       2 * mprec * mrec / jnp.maximum(mprec + mrec, 1e-6)])
+    return {"BatchMetrics": jnp.concatenate([macro, micro]).reshape(1, 6),
+            "AccumMetrics": jnp.concatenate([macro, micro]).reshape(1, 6),
+            "AccumStatesInfo": states}

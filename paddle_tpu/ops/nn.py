@@ -1,0 +1,284 @@
+"""NN core ops: conv, pool, norm, softmax, dropout.
+
+Parity: reference operators/conv_op.cc, conv_transpose_op.cc, pool_op.cc,
+batch_norm_op.cc, layer_norm_op.cc, softmax_op.cc, dropout_op.cc, lrn_op.cc.
+The reference dispatches to cuDNN; here each op is one lax expression that
+XLA maps onto the MXU (convs as conv_general_dilated) — layouts are left to
+XLA's TPU layout assignment.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.core.registry import register_op
+
+
+def _pair(v):
+    if isinstance(v, (list, tuple)):
+        return list(v)
+    return [v, v]
+
+
+def _conv_lower(ctx, ins, attrs, op):
+    x = ins["Input"]        # NCHW
+    w = ins["Filter"]       # OIHW (I = C/groups)
+    strides = _pair(attrs.get("strides", [1, 1]))
+    paddings = _pair(attrs.get("paddings", [0, 0]))
+    dilations = _pair(attrs.get("dilations", [1, 1]))
+    groups = attrs.get("groups", 1)
+    out = jax.lax.conv_general_dilated(
+        x, w,
+        window_strides=strides,
+        padding=[(paddings[0], paddings[0]), (paddings[1], paddings[1])],
+        rhs_dilation=dilations,
+        dimension_numbers=("NCHW", "OIHW", "NCHW"),
+        feature_group_count=groups,
+        preferred_element_type=jnp.result_type(x, w))
+    return {"Output": out}
+
+
+register_op("conv2d", lower=_conv_lower)
+# depthwise conv is just grouped conv; XLA lowers it natively on TPU
+register_op("depthwise_conv2d", lower=_conv_lower)
+
+
+@register_op("conv3d")
+def _conv3d(ctx, ins, attrs, op):
+    x, w = ins["Input"], ins["Filter"]
+    strides = list(attrs.get("strides", [1, 1, 1]))
+    paddings = list(attrs.get("paddings", [0, 0, 0]))
+    dilations = list(attrs.get("dilations", [1, 1, 1]))
+    out = jax.lax.conv_general_dilated(
+        x, w, window_strides=strides,
+        padding=[(p, p) for p in paddings],
+        rhs_dilation=dilations,
+        dimension_numbers=("NCDHW", "OIDHW", "NCDHW"),
+        feature_group_count=attrs.get("groups", 1))
+    return {"Output": out}
+
+
+@register_op("conv2d_transpose")
+def _conv2d_transpose(ctx, ins, attrs, op):
+    """Filter layout (C_in, C_out, kH, kW) as in reference
+    conv_transpose_op.cc; lowered as the transpose (lhs-dilated) conv."""
+    x, w = ins["Input"], ins["Filter"]
+    strides = _pair(attrs.get("strides", [1, 1]))
+    paddings = _pair(attrs.get("paddings", [0, 0]))
+    dilations = _pair(attrs.get("dilations", [1, 1]))
+    kh = (w.shape[2] - 1) * dilations[0] + 1
+    kw = (w.shape[3] - 1) * dilations[1] + 1
+    # transpose conv = conv with lhs_dilation=strides and flipped kernel
+    w_flip = jnp.flip(w, axis=(2, 3))            # IOHW -> flipped
+    w_t = jnp.swapaxes(w_flip, 0, 1)             # -> OIHW w/ O=C_out
+    out = jax.lax.conv_general_dilated(
+        x, w_t,
+        window_strides=(1, 1),
+        padding=[(kh - 1 - paddings[0], kh - 1 - paddings[0]),
+                 (kw - 1 - paddings[1], kw - 1 - paddings[1])],
+        lhs_dilation=strides,
+        rhs_dilation=dilations,
+        dimension_numbers=("NCHW", "OIHW", "NCHW"))
+    return {"Output": out}
+
+
+@register_op("pool2d")
+def _pool2d(ctx, ins, attrs, op):
+    x = ins["X"]  # NCHW
+    ptype = attrs.get("pooling_type", "max")
+    ksize = _pair(attrs.get("ksize", [2, 2]))
+    strides = _pair(attrs.get("strides", [1, 1]))
+    paddings = _pair(attrs.get("paddings", [0, 0]))
+    if attrs.get("global_pooling", False):
+        ksize = [x.shape[2], x.shape[3]]
+        paddings = [0, 0]
+        strides = [1, 1]
+    if attrs.get("adaptive", False):
+        # adaptive pooling to ksize output bins
+        oh, ow = ksize
+        n, c, h, w_ = x.shape
+        x4 = x.reshape(n, c, oh, h // oh, ow, w_ // ow)
+        red = jnp.max if ptype == "max" else jnp.mean
+        return {"Out": red(x4, axis=(3, 5))}
+    window = (1, 1, ksize[0], ksize[1])
+    strides4 = (1, 1, strides[0], strides[1])
+    pads4 = ((0, 0), (0, 0), (paddings[0], paddings[0]),
+             (paddings[1], paddings[1]))
+    if ptype == "max":
+        init = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else \
+            jnp.iinfo(x.dtype).min
+        out = jax.lax.reduce_window(x, jnp.asarray(init, x.dtype),
+                                    jax.lax.max, window, strides4, pads4)
+    else:
+        ssum = jax.lax.reduce_window(x, jnp.asarray(0.0, x.dtype),
+                                     jax.lax.add, window, strides4, pads4)
+        if attrs.get("exclusive", True) and (paddings[0] or paddings[1]):
+            ones = jnp.ones_like(x)
+            cnt = jax.lax.reduce_window(ones, jnp.asarray(0.0, x.dtype),
+                                        jax.lax.add, window, strides4, pads4)
+            out = ssum / cnt
+        else:
+            out = ssum / (ksize[0] * ksize[1])
+    return {"Out": out}
+
+
+@register_op("batch_norm")
+def _batch_norm(ctx, ins, attrs, op):
+    """reference batch_norm_op.cc: in train mode returns batch stats and
+    updates the running stats in place (MeanOut/VarianceOut alias
+    Mean/Variance); in test mode normalizes with running stats."""
+    x = ins["X"]
+    scale, bias = ins["Scale"], ins["Bias"]
+    mean_in, var_in = ins["Mean"], ins["Variance"]
+    eps = attrs.get("epsilon", 1e-5)
+    momentum = attrs.get("momentum", 0.9)
+    is_test = attrs.get("is_test", False) or ctx.mode == "test"
+    layout = attrs.get("data_layout", "NCHW")
+    c_axis = 1 if layout == "NCHW" else x.ndim - 1
+    red_axes = tuple(i for i in range(x.ndim) if i != c_axis)
+    bshape = [1] * x.ndim
+    bshape[c_axis] = x.shape[c_axis]
+
+    if is_test:
+        mean, var = mean_in, var_in
+        mean_out, var_out = mean_in, var_in
+        saved_mean = mean
+        saved_var = var
+    else:
+        # compute batch statistics in f32 for stability under bf16 inputs
+        xf = x.astype(jnp.float32)
+        mean = jnp.mean(xf, axis=red_axes)
+        var = jnp.mean(jnp.square(xf), axis=red_axes) - jnp.square(mean)
+        mean = mean.astype(mean_in.dtype)
+        var = var.astype(var_in.dtype)
+        mean_out = mean_in * momentum + mean * (1 - momentum)
+        var_out = var_in * momentum + var * (1 - momentum)
+        saved_mean = mean
+        saved_var = var
+
+    inv_std = jax.lax.rsqrt(var.astype(x.dtype).reshape(bshape) + eps)
+    y = (x - mean.astype(x.dtype).reshape(bshape)) * inv_std
+    y = y * scale.reshape(bshape) + bias.reshape(bshape)
+    return {"Y": y, "MeanOut": mean_out, "VarianceOut": var_out,
+            "SavedMean": saved_mean, "SavedVariance": saved_var}
+
+
+@register_op("layer_norm")
+def _layer_norm(ctx, ins, attrs, op):
+    x = ins["X"]
+    begin = attrs.get("begin_norm_axis", 1)
+    eps = attrs.get("epsilon", 1e-5)
+    axes = tuple(range(begin, x.ndim))
+    mean = jnp.mean(x, axis=axes, keepdims=True)
+    var = jnp.mean(jnp.square(x - mean), axis=axes, keepdims=True)
+    y = (x - mean) * jax.lax.rsqrt(var + eps)
+    nfeat = int(np.prod(x.shape[begin:]))
+    fshape = (1,) * begin + tuple(x.shape[begin:])
+    scale = ins.get("Scale")
+    bias = ins.get("Bias")
+    if scale is not None:
+        y = y * scale.reshape(fshape)
+    if bias is not None:
+        y = y + bias.reshape(fshape)
+    lead = x.shape[:begin]
+    return {"Y": y, "Mean": mean.reshape(lead), "Variance": var.reshape(lead)}
+
+
+@register_op("softmax")
+def _softmax(ctx, ins, attrs, op):
+    return {"Out": jax.nn.softmax(ins["X"], axis=-1)}
+
+
+@register_op("log_softmax")
+def _log_softmax(ctx, ins, attrs, op):
+    return {"Out": jax.nn.log_softmax(ins["X"], axis=attrs.get("axis", -1))}
+
+
+def _dropout_lower(ctx, ins, attrs, op):
+    """reference dropout_op.cc ("downgrade_in_infer"): train: out = x * mask,
+    mask ~ Bernoulli(1-p); infer: out = x * (1-p)."""
+    x = ins["X"]
+    p = attrs.get("dropout_prob", 0.5)
+    is_test = attrs.get("is_test", False) or ctx.mode == "test"
+    impl = attrs.get("dropout_implementation", "downgrade_in_infer")
+    if is_test:
+        if impl == "upscale_in_train":
+            return {"Out": x, "Mask": jnp.ones_like(x)}
+        return {"Out": x * (1.0 - p), "Mask": jnp.ones_like(x)}
+    seed = attrs.get("seed", 0)
+    key = jax.random.PRNGKey(seed) if seed else ctx.next_key()
+    keep = jax.random.bernoulli(key, 1.0 - p, x.shape)
+    mask = keep.astype(x.dtype)
+    if impl == "upscale_in_train":
+        mask = mask / (1.0 - p)
+    return {"Out": x * mask, "Mask": mask}
+
+
+def _dropout_grad_maker(op, block, no_grad_set):
+    from paddle_tpu.core.desc import OpDesc
+    xg = op.input("X")[0] + "@GRAD"
+    g = OpDesc("dropout_grad",
+               inputs={"Mask": op.output("Mask"),
+                       "Out@GRAD": [op.output("Out")[0] + "@GRAD"]},
+               outputs={"X@GRAD": [xg]},
+               attrs={k: a.value for k, a in op.attrs.items()})
+    return [g], {xg: op.input("X")[0]}
+
+
+register_op("dropout", lower=_dropout_lower, stateful=True,
+            grad_maker=_dropout_grad_maker)
+
+
+@register_op("dropout_grad", grad_maker=None)
+def _dropout_grad(ctx, ins, attrs, op):
+    return {"X@GRAD": ins["Out@GRAD"] * ins["Mask"]}
+
+
+@register_op("lrn")
+def _lrn(ctx, ins, attrs, op):
+    """Local response norm across channels (reference lrn_op.cc)."""
+    x = ins["X"]  # NCHW
+    n = attrs.get("n", 5)
+    k = attrs.get("k", 2.0)
+    alpha = attrs.get("alpha", 1e-4)
+    beta = attrs.get("beta", 0.75)
+    sq = jnp.square(x)
+    half = n // 2
+    pad = jnp.pad(sq, ((0, 0), (half, half), (0, 0), (0, 0)))
+    acc = sum(pad[:, i:i + x.shape[1]] for i in range(n))
+    mid = k + alpha * acc
+    return {"Out": x / jnp.power(mid, beta), "MidOut": mid}
+
+
+@register_op("row_conv")
+def _row_conv(ctx, ins, attrs, op):
+    """Lookahead row convolution (reference row_conv_op.cc), dense batch
+    form: x [N, T, D], filter [future_ctx, D]."""
+    x, f = ins["X"], ins["Filter"]
+    ctx_len = f.shape[0]
+    pads = [(0, 0), (0, ctx_len - 1), (0, 0)]
+    xp = jnp.pad(x, pads)
+    out = sum(xp[:, i:i + x.shape[1]] * f[i] for i in range(ctx_len))
+    return {"Out": out}
+
+
+@register_op("spp")
+def _spp(ctx, ins, attrs, op):
+    """Spatial pyramid pooling (reference spp_op.cc)."""
+    x = ins["X"]
+    levels = attrs.get("pyramid_height", 1)
+    ptype = attrs.get("pooling_type", "max")
+    n, c, h, w = x.shape
+    outs = []
+    for lvl in range(levels):
+        bins = 2 ** lvl
+        kh, kw = -(-h // bins), -(-w // bins)
+        ph, pw = kh * bins - h, kw * bins - w
+        fill = -jnp.inf if ptype == "max" else 0.0
+        xp = jnp.pad(x, ((0, 0), (0, 0), (0, ph), (0, pw)),
+                     constant_values=fill)
+        x6 = xp.reshape(n, c, bins, kh, bins, kw)
+        red = jnp.max if ptype == "max" else jnp.mean
+        outs.append(red(x6, axis=(3, 5)).reshape(n, -1))
+    return {"Out": jnp.concatenate(outs, axis=1)}

@@ -1,0 +1,194 @@
+"""Optimizer ops — each updates parameters "in place" (functionally: the op
+writes the same var name, and the executor donates/wires the buffer back).
+
+Parity: reference operators/{sgd,momentum,adam,adamax,adagrad,adadelta,
+decayed_adagrad,rmsprop,ftrl,proximal_gd,proximal_adagrad}_op.cc.  All are
+pure elementwise updates that XLA fuses into the step program — the
+reference's separate optimizer kernel launches disappear entirely.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from paddle_tpu.core.registry import register_op
+
+
+def _lr(ins):
+    return ins["LearningRate"].reshape(())
+
+
+@register_op("sgd", grad_maker=None)
+def _sgd(ctx, ins, attrs, op):
+    return {"ParamOut": ins["Param"] - _lr(ins) * ins["Grad"]}
+
+
+@register_op("momentum", grad_maker=None)
+def _momentum(ctx, ins, attrs, op):
+    p, g, v = ins["Param"], ins["Grad"], ins["Velocity"]
+    mu = attrs.get("mu")
+    lr = _lr(ins)
+    v_out = mu * v + g
+    if attrs.get("use_nesterov", False):
+        p_out = p - (g + mu * v_out) * lr
+    else:
+        p_out = p - lr * v_out
+    return {"ParamOut": p_out, "VelocityOut": v_out}
+
+
+@register_op("adam", grad_maker=None)
+def _adam(ctx, ins, attrs, op):
+    p, g = ins["Param"], ins["Grad"]
+    m1, m2 = ins["Moment1"], ins["Moment2"]
+    b1p, b2p = ins["Beta1Pow"].reshape(()), ins["Beta2Pow"].reshape(())
+    b1 = attrs.get("beta1", 0.9)
+    b2 = attrs.get("beta2", 0.999)
+    eps = attrs.get("epsilon", 1e-8)
+    lr = _lr(ins) * jnp.sqrt(1 - b2p) / (1 - b1p)
+    m1_out = b1 * m1 + (1 - b1) * g
+    m2_out = b2 * m2 + (1 - b2) * jnp.square(g)
+    p_out = p - lr * m1_out / (jnp.sqrt(m2_out) + eps)
+    return {"ParamOut": p_out, "Moment1Out": m1_out, "Moment2Out": m2_out,
+            "Beta1PowOut": ins["Beta1Pow"] * b1,
+            "Beta2PowOut": ins["Beta2Pow"] * b2}
+
+
+@register_op("adamax", grad_maker=None)
+def _adamax(ctx, ins, attrs, op):
+    p, g = ins["Param"], ins["Grad"]
+    m, inf = ins["Moment"], ins["InfNorm"]
+    b1p = ins["Beta1Pow"].reshape(())
+    b1 = attrs.get("beta1", 0.9)
+    b2 = attrs.get("beta2", 0.999)
+    eps = attrs.get("epsilon", 1e-8)
+    m_out = b1 * m + (1 - b1) * g
+    inf_out = jnp.maximum(b2 * inf, jnp.abs(g))
+    lr = _lr(ins) / (1 - b1p)
+    p_out = p - lr * m_out / (inf_out + eps)
+    return {"ParamOut": p_out, "MomentOut": m_out, "InfNormOut": inf_out,
+            "Beta1PowOut": ins["Beta1Pow"] * b1}
+
+
+@register_op("adagrad", grad_maker=None)
+def _adagrad(ctx, ins, attrs, op):
+    p, g, m = ins["Param"], ins["Grad"], ins["Moment"]
+    eps = attrs.get("epsilon", 1e-6)
+    m_out = m + jnp.square(g)
+    p_out = p - _lr(ins) * g / (jnp.sqrt(m_out) + eps)
+    return {"ParamOut": p_out, "MomentOut": m_out}
+
+
+@register_op("decayed_adagrad", grad_maker=None)
+def _decayed_adagrad(ctx, ins, attrs, op):
+    p, g, m = ins["Param"], ins["Grad"], ins["Moment"]
+    decay = attrs.get("decay", 0.95)
+    eps = attrs.get("epsilon", 1e-6)
+    m_out = decay * m + (1 - decay) * jnp.square(g)
+    p_out = p - _lr(ins) * g / (jnp.sqrt(m_out) + eps)
+    return {"ParamOut": p_out, "MomentOut": m_out}
+
+
+@register_op("adadelta", grad_maker=None)
+def _adadelta(ctx, ins, attrs, op):
+    p, g = ins["Param"], ins["Grad"]
+    avg_sq_g, avg_sq_u = ins["AvgSquaredGrad"], ins["AvgSquaredUpdate"]
+    rho = attrs.get("rho", 0.95)
+    eps = attrs.get("epsilon", 1e-6)
+    g2 = rho * avg_sq_g + (1 - rho) * jnp.square(g)
+    upd = -jnp.sqrt((avg_sq_u + eps) / (g2 + eps)) * g
+    u2 = rho * avg_sq_u + (1 - rho) * jnp.square(upd)
+    return {"ParamOut": p + upd, "AvgSquaredGradOut": g2,
+            "AvgSquaredUpdateOut": u2}
+
+
+@register_op("rmsprop", grad_maker=None)
+def _rmsprop(ctx, ins, attrs, op):
+    p, g = ins["Param"], ins["Grad"]
+    ms, mom = ins["MeanSquare"], ins["Moment"]
+    rho = attrs.get("decay", 0.9)
+    eps = attrs.get("epsilon", 1e-10)
+    momentum = attrs.get("momentum", 0.0)
+    ms_out = rho * ms + (1 - rho) * jnp.square(g)
+    mom_out = momentum * mom + _lr(ins) * g / jnp.sqrt(ms_out + eps)
+    return {"ParamOut": p - mom_out, "MeanSquareOut": ms_out,
+            "MomentOut": mom_out}
+
+
+@register_op("ftrl", grad_maker=None)
+def _ftrl(ctx, ins, attrs, op):
+    p, g = ins["Param"], ins["Grad"]
+    sq, lin = ins["SquaredAccumulator"], ins["LinearAccumulator"]
+    l1 = attrs.get("l1", 0.0)
+    l2 = attrs.get("l2", 0.0)
+    lr_power = attrs.get("lr_power", -0.5)
+    lr = _lr(ins)
+    new_sq = sq + jnp.square(g)
+    if lr_power == -0.5:
+        sigma = (jnp.sqrt(new_sq) - jnp.sqrt(sq)) / lr
+    else:
+        sigma = (jnp.power(new_sq, -lr_power) -
+                 jnp.power(sq, -lr_power)) / lr
+    lin_out = lin + g - sigma * p
+    if lr_power == -0.5:
+        denom = jnp.sqrt(new_sq) / lr + 2 * l2
+    else:
+        denom = jnp.power(new_sq, -lr_power) / lr + 2 * l2
+    pre = jnp.clip(lin_out, -l1, l1) - lin_out
+    p_out = pre / denom
+    return {"ParamOut": p_out, "SquaredAccumOut": new_sq,
+            "LinearAccumOut": lin_out}
+
+
+@register_op("proximal_gd", grad_maker=None)
+def _proximal_gd(ctx, ins, attrs, op):
+    p, g = ins["Param"], ins["Grad"]
+    l1 = attrs.get("l1", 0.0)
+    l2 = attrs.get("l2", 0.0)
+    lr = _lr(ins)
+    prox = p - lr * g
+    if l1 > 0:
+        prox = jnp.sign(prox) * jnp.maximum(jnp.abs(prox) - lr * l1, 0.0)
+    return {"ParamOut": prox / (1.0 + lr * l2)}
+
+
+@register_op("proximal_adagrad", grad_maker=None)
+def _proximal_adagrad(ctx, ins, attrs, op):
+    p, g, m = ins["Param"], ins["Grad"], ins["Moment"]
+    l1 = attrs.get("l1", 0.0)
+    l2 = attrs.get("l2", 0.0)
+    m_out = m + jnp.square(g)
+    lr = _lr(ins) / jnp.sqrt(m_out)
+    prox = p - lr * g
+    if l1 > 0:
+        prox = jnp.sign(prox) * jnp.maximum(jnp.abs(prox) - lr * l1, 0.0)
+    return {"ParamOut": prox / (1.0 + lr * l2), "MomentOut": m_out}
+
+
+@register_op("average_accumulates", grad_maker=None)
+def _average_accumulates(ctx, ins, attrs, op):
+    """Accumulators for ModelAverage (reference average_accumulates_op.cc)."""
+    param = ins["Param"]
+    sum1, sum2, sum3 = ins["in_sum_1"], ins["in_sum_2"], ins["in_sum_3"]
+    num_acc = ins["in_num_accumulates"].reshape(())
+    old_num = ins["in_old_num_accumulates"].reshape(())
+    num_upd = ins["in_num_updates"].reshape(())
+    avg_window = attrs.get("average_window", 0.0)
+    max_avg = attrs.get("max_average_window", 10000)
+    min_avg = attrs.get("min_average_window", 10000)
+    num_acc = num_acc + 1
+    num_upd = num_upd + 1
+    sum1 = sum1 + param
+    window = jnp.maximum(jnp.minimum(num_upd.astype(jnp.float32) * avg_window,
+                                     float(max_avg)), float(min_avg))
+    roll = num_acc.astype(jnp.float32) >= window
+    sum2 = jnp.where(roll, sum2 + sum1, sum2)
+    sum1 = jnp.where(roll, jnp.zeros_like(sum1), sum1)
+    old_num = jnp.where(roll, old_num + num_acc, old_num)
+    num_acc = jnp.where(roll, jnp.zeros_like(num_acc), num_acc)
+    big = old_num.astype(jnp.float32) >= 2.0 * window
+    sum3 = jnp.where(big, sum2, sum3)
+    sum2 = jnp.where(big, jnp.zeros_like(sum2), sum2)
+    old_num = jnp.where(big, num_acc, old_num)
+    return {"out_sum_1": sum1, "out_sum_2": sum2, "out_sum_3": sum3,
+            "out_num_accumulates": num_acc.reshape((1,)),
+            "out_old_num_accumulates": old_num.reshape((1,)),
+            "out_num_updates": num_upd.reshape((1,))}

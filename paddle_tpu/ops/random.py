@@ -1,0 +1,68 @@
+"""Random ops, driven by the threaded PRNG key (see LoweringContext.next_key).
+
+Parity: reference operators/uniform_random_op.cc, gaussian_random_op.cc,
+uniform_random_batch_size_like_op.cc, gaussian_random_batch_size_like_op.cc,
+sampling_id_op.cc — curand states replaced by counter-based jax PRNG, which
+is reproducible across backends and under SPMD.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.registry import register_op
+from paddle_tpu.core.types import proto_to_np_dtype, DataType
+
+
+def _key(ctx, attrs):
+    seed = attrs.get("seed", 0)
+    return jax.random.PRNGKey(seed) if seed else ctx.next_key()
+
+
+@register_op("uniform_random", stateful=True, grad_maker=None)
+def _uniform_random(ctx, ins, attrs, op):
+    dtype = proto_to_np_dtype(attrs.get("dtype", DataType.FP32))
+    out = jax.random.uniform(
+        _key(ctx, attrs), tuple(attrs.get("shape")), dtype=jnp.float32,
+        minval=attrs.get("min", -1.0), maxval=attrs.get("max", 1.0))
+    return {"Out": out.astype(dtype)}
+
+
+@register_op("uniform_random_batch_size_like", stateful=True, grad_maker=None)
+def _uniform_random_bsl(ctx, ins, attrs, op):
+    dtype = proto_to_np_dtype(attrs.get("dtype", DataType.FP32))
+    shape = list(attrs.get("shape"))
+    shape[attrs.get("output_dim_idx", 0)] = \
+        ins["Input"].shape[attrs.get("input_dim_idx", 0)]
+    out = jax.random.uniform(_key(ctx, attrs), tuple(shape),
+                             minval=attrs.get("min", -1.0),
+                             maxval=attrs.get("max", 1.0))
+    return {"Out": out.astype(dtype)}
+
+
+@register_op("gaussian_random", stateful=True, grad_maker=None)
+def _gaussian_random(ctx, ins, attrs, op):
+    dtype = proto_to_np_dtype(attrs.get("dtype", DataType.FP32))
+    out = jax.random.normal(_key(ctx, attrs), tuple(attrs.get("shape")))
+    out = out * attrs.get("std", 1.0) + attrs.get("mean", 0.0)
+    return {"Out": out.astype(dtype)}
+
+
+@register_op("gaussian_random_batch_size_like", stateful=True,
+             grad_maker=None)
+def _gaussian_random_bsl(ctx, ins, attrs, op):
+    dtype = proto_to_np_dtype(attrs.get("dtype", DataType.FP32))
+    shape = list(attrs.get("shape"))
+    shape[attrs.get("output_dim_idx", 0)] = \
+        ins["Input"].shape[attrs.get("input_dim_idx", 0)]
+    out = jax.random.normal(_key(ctx, attrs), tuple(shape))
+    out = out * attrs.get("std", 1.0) + attrs.get("mean", 0.0)
+    return {"Out": out.astype(dtype)}
+
+
+@register_op("sampling_id", stateful=True, grad_maker=None)
+def _sampling_id(ctx, ins, attrs, op):
+    x = ins["X"]  # [N, D] probabilities
+    idx = jax.random.categorical(_key(ctx, attrs), jnp.log(
+        jnp.maximum(x, 1e-20)), axis=-1)
+    return {"Out": idx.astype(jnp.int64)}

@@ -1,0 +1,342 @@
+"""Tensor manipulation ops.
+
+Parity: reference operators/{concat,split,reshape,transpose,expand,gather,
+scatter,pad,crop,slice,reverse,shape,top_k,arg_max,arg_min,one_hot,assign,
+assign_value,fill_constant,fill_constant_batch_size_like,fill_zeros_like,
+lookup_table,multiplex,bilinear_interp,label_smooth,squeeze,unsqueeze,
+multiplex,mean_iou}_op.cc.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.core.registry import register_op
+from paddle_tpu.core.types import proto_to_np_dtype, DataType
+
+
+@register_op("concat")
+def _concat(ctx, ins, attrs, op):
+    xs = [x for x in ins.list("X") if x is not None]
+    return {"Out": jnp.concatenate(xs, axis=attrs.get("axis", 0))}
+
+
+@register_op("split")
+def _split(ctx, ins, attrs, op):
+    x = ins["X"]
+    axis = attrs.get("axis", 0)
+    sections = attrs.get("sections", [])
+    num = attrs.get("num", 0)
+    if sections:
+        idx = np.cumsum(sections[:-1]).tolist()
+        outs = jnp.split(x, idx, axis=axis)
+    else:
+        outs = jnp.split(x, num, axis=axis)
+    return {"Out": list(outs)}
+
+
+@register_op("reshape")
+def _reshape(ctx, ins, attrs, op):
+    x = ins["X"]
+    shape = list(attrs.get("shape"))
+    # 0 = keep input dim (reference reshape semantics), -1 = infer
+    shape = [x.shape[i] if d == 0 else d for i, d in enumerate(shape)]
+    return {"Out": x.reshape(shape)}
+
+
+@register_op("reshape2")
+def _reshape2(ctx, ins, attrs, op):
+    x = ins["X"]
+    shape = list(attrs.get("shape"))
+    shape = [x.shape[i] if d == 0 else d for i, d in enumerate(shape)]
+    return {"Out": x.reshape(shape),
+            "XShape": jnp.zeros((0,) + x.shape, dtype=x.dtype)}
+
+
+@register_op("transpose")
+def _transpose(ctx, ins, attrs, op):
+    return {"Out": jnp.transpose(ins["X"], attrs.get("axis"))}
+
+
+@register_op("transpose2")
+def _transpose2(ctx, ins, attrs, op):
+    x = ins["X"]
+    return {"Out": jnp.transpose(x, attrs.get("axis")),
+            "XShape": jnp.zeros((0,) + x.shape, dtype=x.dtype)}
+
+
+@register_op("squeeze")
+def _squeeze(ctx, ins, attrs, op):
+    x = ins["X"]
+    axes = attrs.get("axes", [])
+    if axes:
+        shape = [d for i, d in enumerate(x.shape)
+                 if not (i in axes or i - x.ndim in axes) or d != 1]
+        return {"Out": x.reshape(shape)}
+    return {"Out": jnp.squeeze(x)}
+
+
+@register_op("unsqueeze")
+def _unsqueeze(ctx, ins, attrs, op):
+    x = ins["X"]
+    for ax in sorted(attrs.get("axes", [])):
+        x = jnp.expand_dims(x, ax)
+    return {"Out": x}
+
+
+@register_op("expand")
+def _expand(ctx, ins, attrs, op):
+    return {"Out": jnp.tile(ins["X"], attrs.get("expand_times"))}
+
+
+@register_op("gather")
+def _gather(ctx, ins, attrs, op):
+    idx = ins["Index"].reshape(-1).astype(jnp.int32)
+    return {"Out": jnp.take(ins["X"], idx, axis=0)}
+
+
+@register_op("scatter")
+def _scatter(ctx, ins, attrs, op):
+    x, ids, upd = ins["X"], ins["Ids"], ins["Updates"]
+    ids = ids.reshape(-1).astype(jnp.int32)
+    return {"Out": x.at[ids].set(upd)}
+
+
+@register_op("pad")
+def _pad(ctx, ins, attrs, op):
+    x = ins["X"]
+    p = attrs.get("paddings")
+    pads = [(p[2 * i], p[2 * i + 1]) for i in range(x.ndim)]
+    return {"Out": jnp.pad(x, pads,
+                           constant_values=attrs.get("pad_value", 0.0))}
+
+
+@register_op("crop")
+def _crop(ctx, ins, attrs, op):
+    x = ins["X"]
+    offsets = attrs.get("offsets")
+    shape = attrs.get("shape")
+    if ins.has("Y"):
+        shape = ins["Y"].shape
+    slices = tuple(slice(o, o + s) for o, s in zip(offsets, shape))
+    return {"Out": x[slices]}
+
+
+@register_op("slice")
+def _slice(ctx, ins, attrs, op):
+    x = ins["Input"]
+    axes = attrs.get("axes")
+    starts = attrs.get("starts")
+    ends = attrs.get("ends")
+    slices = [slice(None)] * x.ndim
+    for ax, st, en in zip(axes, starts, ends):
+        slices[ax] = slice(st, en)
+    return {"Out": x[tuple(slices)]}
+
+
+@register_op("reverse")
+def _reverse(ctx, ins, attrs, op):
+    return {"Out": jnp.flip(ins["X"], attrs.get("axis"))}
+
+
+@register_op("shape", grad_maker=None)
+def _shape(ctx, ins, attrs, op):
+    return {"Out": jnp.asarray(ins["Input"].shape, dtype=jnp.int64)}
+
+
+@register_op("top_k", grad_maker=None)
+def _top_k(ctx, ins, attrs, op):
+    vals, idx = jax.lax.top_k(ins["X"], attrs.get("k", 1))
+    return {"Out": vals, "Indices": idx.astype(jnp.int64)}
+
+
+@register_op("arg_max", grad_maker=None)
+def _arg_max(ctx, ins, attrs, op):
+    return {"Out": jnp.argmax(ins["X"], axis=attrs.get("axis", -1))
+            .astype(jnp.int64)}
+
+
+@register_op("arg_min", grad_maker=None)
+def _arg_min(ctx, ins, attrs, op):
+    return {"Out": jnp.argmin(ins["X"], axis=attrs.get("axis", -1))
+            .astype(jnp.int64)}
+
+
+@register_op("argsort", grad_maker=None)
+def _argsort(ctx, ins, attrs, op):
+    x = ins["X"]
+    axis = attrs.get("axis", -1)
+    idx = jnp.argsort(x, axis=axis)
+    return {"Out": jnp.sort(x, axis=axis), "Indices": idx.astype(jnp.int64)}
+
+
+@register_op("one_hot", grad_maker=None)
+def _one_hot(ctx, ins, attrs, op):
+    x = ins["X"]
+    depth = attrs.get("depth")
+    flat = x.reshape(x.shape[:-1] if x.shape[-1] == 1 else x.shape)
+    return {"Out": jax.nn.one_hot(flat.astype(jnp.int32), depth,
+                                  dtype=jnp.float32)}
+
+
+@register_op("assign")
+def _assign(ctx, ins, attrs, op):
+    return {"Out": ins["X"]}
+
+
+@register_op("assign_value", grad_maker=None)
+def _assign_value(ctx, ins, attrs, op):
+    dtype = proto_to_np_dtype(attrs.get("dtype", DataType.FP32))
+    shape = attrs.get("shape")
+    if attrs.get("fp32_values"):
+        vals = np.asarray(attrs["fp32_values"], dtype=np.float32)
+    else:
+        vals = np.asarray(attrs.get("int32_values", []), dtype=np.int32)
+    return {"Out": jnp.asarray(vals.reshape(shape), dtype=dtype)}
+
+
+@register_op("fill_constant", grad_maker=None)
+def _fill_constant(ctx, ins, attrs, op):
+    dtype = proto_to_np_dtype(attrs.get("dtype", DataType.FP32))
+    return {"Out": jnp.full(tuple(attrs.get("shape", [1])),
+                            attrs.get("value", 0.0), dtype=dtype)}
+
+
+@register_op("fill_constant_batch_size_like", grad_maker=None)
+def _fill_cbsl(ctx, ins, attrs, op):
+    dtype = proto_to_np_dtype(attrs.get("dtype", DataType.FP32))
+    shape = list(attrs.get("shape"))
+    in_idx = attrs.get("input_dim_idx", 0)
+    out_idx = attrs.get("output_dim_idx", 0)
+    shape[out_idx] = ins["Input"].shape[in_idx]
+    return {"Out": jnp.full(tuple(shape), attrs.get("value", 0.0),
+                            dtype=dtype)}
+
+
+@register_op("fill_zeros_like", grad_maker=None)
+def _fill_zeros_like(ctx, ins, attrs, op):
+    return {"Out": jnp.zeros_like(ins["X"])}
+
+
+@register_op("fill", grad_maker=None)
+def _fill(ctx, ins, attrs, op):
+    dtype = proto_to_np_dtype(attrs.get("dtype", DataType.FP32))
+    vals = np.asarray(attrs.get("value"), dtype=np.float32)
+    return {"Out": jnp.asarray(vals.reshape(attrs.get("shape")),
+                               dtype=dtype)}
+
+
+@register_op("lookup_table")
+def _lookup_table(ctx, ins, attrs, op):
+    """Embedding lookup (reference lookup_table_op.cc).  Ids [..., 1] int64.
+    The gather's vjp is a scatter-add, which XLA lowers efficiently; the
+    is_sparse SelectedRows path is handled by the pserver transpiler."""
+    w, ids = ins["W"], ins["Ids"]
+    padding_idx = attrs.get("padding_idx", -1)
+    idx = ids.reshape(ids.shape[:-1]) if ids.shape[-1] == 1 else ids
+    idx = idx.astype(jnp.int32)
+    out = jnp.take(w, idx, axis=0)
+    if padding_idx != -1:
+        mask = (idx == padding_idx)[..., None]
+        out = jnp.where(mask, jnp.zeros_like(out), out)
+    return {"Out": out}
+
+
+@register_op("multiplex")
+def _multiplex(ctx, ins, attrs, op):
+    ids = ins["Ids"].reshape(-1).astype(jnp.int32)
+    xs = jnp.stack([x for x in ins.list("X")], axis=0)  # [K, N, D]
+    rows = jnp.arange(ids.shape[0])
+    return {"Out": xs[ids, rows]}
+
+
+@register_op("bilinear_interp")
+def _bilinear_interp(ctx, ins, attrs, op):
+    x = ins["X"]  # NCHW
+    oh = attrs.get("out_h")
+    ow = attrs.get("out_w")
+    if ins.has("OutSize"):
+        pass  # dynamic size unsupported under XLA static shapes; attr wins
+    n, c, h, w = x.shape
+    ratio_h = (h - 1.0) / (oh - 1.0) if oh > 1 else 0.0
+    ratio_w = (w - 1.0) / (ow - 1.0) if ow > 1 else 0.0
+    hi = jnp.arange(oh) * ratio_h
+    wi = jnp.arange(ow) * ratio_w
+    h0 = jnp.floor(hi).astype(jnp.int32)
+    w0 = jnp.floor(wi).astype(jnp.int32)
+    h1 = jnp.minimum(h0 + 1, h - 1)
+    w1 = jnp.minimum(w0 + 1, w - 1)
+    lh = (hi - h0)[None, None, :, None]
+    lw = (wi - w0)[None, None, None, :]
+    v00 = x[:, :, h0][:, :, :, w0]
+    v01 = x[:, :, h0][:, :, :, w1]
+    v10 = x[:, :, h1][:, :, :, w0]
+    v11 = x[:, :, h1][:, :, :, w1]
+    out = (v00 * (1 - lh) * (1 - lw) + v01 * (1 - lh) * lw
+           + v10 * lh * (1 - lw) + v11 * lh * lw)
+    return {"Out": out}
+
+
+@register_op("label_smooth")
+def _label_smooth(ctx, ins, attrs, op):
+    x = ins["X"]
+    eps = attrs.get("epsilon", 0.0)
+    if ins.has("PriorDist"):
+        return {"Out": (1 - eps) * x + eps * ins["PriorDist"]}
+    return {"Out": (1 - eps) * x + eps / x.shape[-1]}
+
+
+@register_op("mean_iou", grad_maker=None)
+def _mean_iou(ctx, ins, attrs, op):
+    pred = ins["Predictions"].reshape(-1).astype(jnp.int32)
+    label = ins["Labels"].reshape(-1).astype(jnp.int32)
+    num = attrs.get("num_classes")
+    cm = jnp.zeros((num, num), jnp.int64).at[label, pred].add(1)
+    inter = jnp.diagonal(cm).astype(jnp.float32)
+    union = (cm.sum(0) + cm.sum(1)).astype(jnp.float32) - inter
+    valid = union > 0
+    iou = jnp.where(valid, inter / jnp.maximum(union, 1.0), 0.0)
+    miou = iou.sum() / jnp.maximum(valid.sum().astype(jnp.float32), 1.0)
+    return {"OutMeanIou": miou.reshape(()),
+            "OutWrong": (cm.sum(1).astype(jnp.int32) -
+                         jnp.diagonal(cm).astype(jnp.int32)),
+            "OutCorrect": jnp.diagonal(cm).astype(jnp.int32)}
+
+
+@register_op("im2sequence")
+def _im2sequence(ctx, ins, attrs, op):
+    """Extract patches (reference im2sequence_op.cc), dense form."""
+    x = ins["X"]  # NCHW
+    kh, kw = attrs.get("kernels")
+    sh, sw = attrs.get("strides", [1, 1])
+    p = attrs.get("paddings", [0, 0, 0, 0])
+    xp = jnp.pad(x, ((0, 0), (0, 0), (p[0], p[2]), (p[1], p[3])))
+    n, c, h, w = xp.shape
+    oh = (h - kh) // sh + 1
+    ow = (w - kw) // sw + 1
+    patches = jax.lax.conv_general_dilated_patches(
+        xp, (kh, kw), (sh, sw), "VALID",
+        dimension_numbers=("NCHW", "OIHW", "NCHW"))
+    # [N, C*kh*kw, OH, OW] -> [N*OH*OW, C*kh*kw]
+    out = patches.transpose(0, 2, 3, 1).reshape(n * oh * ow, c * kh * kw)
+    return {"Out": out}
+
+
+@register_op("random_crop", stateful=True, grad_maker=None)
+def _random_crop(ctx, ins, attrs, op):
+    x = ins["X"]
+    shape = attrs.get("shape")
+    key = ctx.next_key()
+    ndim_crop = len(shape)
+    lead = x.ndim - ndim_crop
+    starts = []
+    for i, s in enumerate(shape):
+        limit = x.shape[lead + i] - s
+        k = jax.random.fold_in(key, i)
+        starts.append(jax.random.randint(k, (), 0, max(limit, 0) + 1))
+    idx = [slice(None)] * lead
+    out = jax.lax.dynamic_slice(
+        x, [jnp.zeros((), jnp.int32)] * lead + starts,
+        list(x.shape[:lead]) + list(shape))
+    return {"Out": out, "SeedOut": ins.get("Seed")}

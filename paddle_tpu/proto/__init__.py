@@ -1,0 +1,3 @@
+from . import framework_pb2
+
+__all__ = ["framework_pb2"]

@@ -1,0 +1,86 @@
+"""Tensor file format used by save/load ops and checkpoints.
+
+Parity: reference framework/tensor_util.cc TensorToStream (version header +
+dtype + dims + raw data).  Format (little-endian):
+
+  magic  b"PTPU"
+  u32    version (=1)
+  u32    proto dtype
+  u32    ndim
+  i64[n] dims
+  bytes  raw row-major data
+"""
+from __future__ import annotations
+
+import struct
+
+import numpy as np
+
+from paddle_tpu.core.types import np_dtype_to_proto, proto_to_np_dtype
+
+_MAGIC = b"PTPU"
+_VERSION = 1
+
+
+def tensor_to_bytes(arr):
+    arr = np.ascontiguousarray(np.asarray(arr))
+    header = struct.pack("<4sII", _MAGIC, _VERSION,
+                         np_dtype_to_proto(arr.dtype))
+    dims = struct.pack("<I", arr.ndim) + struct.pack(
+        "<%dq" % arr.ndim, *arr.shape)
+    return header + dims + arr.tobytes()
+
+
+def tensor_from_bytes(buf, offset=0):
+    magic, version, dtype = struct.unpack_from("<4sII", buf, offset)
+    if magic != _MAGIC:
+        raise ValueError("bad tensor magic %r" % magic)
+    if version != _VERSION:
+        raise ValueError("unsupported tensor version %d" % version)
+    offset += 12
+    (ndim,) = struct.unpack_from("<I", buf, offset)
+    offset += 4
+    dims = struct.unpack_from("<%dq" % ndim, buf, offset)
+    offset += 8 * ndim
+    np_dtype = proto_to_np_dtype(dtype)
+    count = int(np.prod(dims)) if ndim else 1
+    arr = np.frombuffer(buf, dtype=np_dtype, count=count,
+                        offset=offset).reshape(dims)
+    offset += arr.nbytes
+    return arr.copy(), offset
+
+
+def save_tensor(path, arr):
+    with open(path, "wb") as f:
+        f.write(tensor_to_bytes(arr))
+
+
+def load_tensor(path):
+    with open(path, "rb") as f:
+        arr, _ = tensor_from_bytes(f.read())
+    return arr
+
+
+def save_combined(path, names_arrays):
+    with open(path, "wb") as f:
+        f.write(struct.pack("<I", len(names_arrays)))
+        for name, arr in names_arrays:
+            nb = name.encode("utf-8")
+            f.write(struct.pack("<I", len(nb)) + nb)
+            f.write(tensor_to_bytes(arr))
+
+
+def load_combined(path):
+    with open(path, "rb") as f:
+        buf = f.read()
+    (n,) = struct.unpack_from("<I", buf, 0)
+    offset = 4
+    result = []
+    for _ in range(n):
+        (ln,) = struct.unpack_from("<I", buf, offset)
+        offset += 4
+        name = buf[offset:offset + ln].decode("utf-8")
+        offset += ln
+        arr, offset = tensor_from_bytes(buf, offset)
+        result.append((name, arr))
+    return result
