@@ -1,0 +1,15 @@
+"""paddle_tpu: a TPU-native deep-learning framework with the capabilities of
+PaddlePaddle Fluid (reference: kexinzhao/Paddle), built on JAX/XLA.
+
+Layout:
+  core/      IR descriptors, scope, op registry, block->XLA lowering, executor
+  ops/       operator library (JAX lowerings, vjp-derived grads)
+  fluid/     user API mirroring python/paddle/fluid
+  parallel/  SPMD mesh utilities, distributed transpiler
+  models/    benchmark/fluid model configs
+  utils/     readers, datasets, serialization
+  native/    C++ runtime components (recordio, ...)
+"""
+__version__ = "0.1.0"
+
+from . import fluid  # noqa: F401

@@ -31,18 +31,29 @@ check_nan_inf = False
 
 
 class _CacheEntry:
-    __slots__ = ("fn", "input_names", "persist_outs", "fetch_names")
+    __slots__ = ("fn", "input_names", "persist_outs", "fetch_names",
+                 "input_shardings")
 
-    def __init__(self, fn, input_names, persist_outs, fetch_names):
+    def __init__(self, fn, input_names, persist_outs, fetch_names,
+                 input_shardings=None):
         self.fn = fn
         self.input_names = input_names
         self.persist_outs = persist_outs
         self.fetch_names = fetch_names
+        self.input_shardings = input_shardings
 
 
 class ExecutorCore:
-    def __init__(self, place):
+    """place: target device.  mesh: optional jax.sharding.Mesh — when set,
+    the block is compiled as ONE SPMD program: feed (batch-dim) inputs are
+    sharded over `dp_axis`, parameters replicated, and XLA's SPMD partitioner
+    inserts the gradient all-reduces over ICI that the reference implemented
+    as NCCL AllReduceOpHandles (details/multi_devices_graph_builder.cc:232)."""
+
+    def __init__(self, place, mesh=None, dp_axis="dp"):
         self.place = place
+        self.mesh = mesh
+        self.dp_axis = dp_axis
         self._cache = {}
 
     # ------------------------------------------------------------------
@@ -95,20 +106,25 @@ class ExecutorCore:
 
         dev = self.place.jax_device()
         args = []
-        for name in entry.input_names:
+        for i, name in enumerate(entry.input_names):
+            target = (entry.input_shardings[i]
+                      if entry.input_shardings is not None else dev)
             if name in feed:
                 val = feed[name]
                 vd = block.find_var_recursive(name)
                 if vd is not None and not hasattr(val, "dtype"):
                     val = np.asarray(val, dtype=proto_to_np_dtype(vd.dtype))
-                args.append(jax.device_put(val, dev))
+                args.append(jax.device_put(val, target))
             else:
-                args.append(scope.find_var(name))
+                val = scope.find_var(name)
+                if entry.input_shardings is not None:
+                    val = jax.device_put(val, target)
+                args.append(val)
         rng = self._rng_key(program, scope)
 
         fetches, persists = entry.fn(tuple(args), rng)
         for name, val in zip(entry.persist_outs, persists):
-            scope.find_scope_of(name).set(name, val)
+            (scope.find_scope_of(name) or scope).set(name, val)
         if check_nan_inf:
             for name, val in zip(fetch_list, fetches):
                 if val is not None and jnp.issubdtype(
@@ -176,12 +192,32 @@ class ExecutorCore:
         def fn_flat(*flat_args):
             return fn(tuple(flat_args[:-1]), flat_args[-1])
 
-        jflat = jax.jit(fn_flat, donate_argnums=donate)
+        jit_kwargs = {"donate_argnums": donate}
+        input_shardings = None
+        if self.mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+            repl = NamedSharding(self.mesh, P())
+            input_shardings = []
+            for name in input_names:
+                vd = block.find_var_recursive(name)
+                batch_sharded = (name in feed and vd is not None
+                                 and len(vd.shape) >= 1
+                                 and vd.shape[0] == -1)
+                if batch_sharded:
+                    spec = P(self.dp_axis,
+                             *([None] * (len(vd.shape) - 1)))
+                    input_shardings.append(NamedSharding(self.mesh, spec))
+                else:
+                    input_shardings.append(repl)
+            jit_kwargs["in_shardings"] = tuple(input_shardings) + (repl,)
+            jit_kwargs["out_shardings"] = repl
+        jflat = jax.jit(fn_flat, **jit_kwargs)
 
         def jfn(inputs, rng):
             return jflat(*inputs, rng)
 
-        return _CacheEntry(jfn, input_names, persist_outs, tuple(fetch_list))
+        return _CacheEntry(jfn, input_names, persist_outs, tuple(fetch_list),
+                           input_shardings)
 
     def _run_interpreted(self, program, block, scope, feed, fetch_list, mode):
         dev = self.place.jax_device()

@@ -1,0 +1,70 @@
+"""LoDTensor: dense data + Level-of-Detail ragged-sequence offsets.
+
+Parity: reference framework/lod_tensor.h:58-110.  The LoD (offset table per
+nesting level) stays on the host; the dense concatenated data is the device
+tensor.  Sequence ops receive the data plus host-side lengths and lower to
+bucketed/masked static-shape XLA code (SURVEY §5.7).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+
+class LoDTensor:
+    __slots__ = ("data", "lod")
+
+    def __init__(self, data, lod=None):
+        self.data = data
+        # lod: list of offset lists, e.g. [[0, 2, 5]] = two seqs len 2 and 3
+        self.lod = [list(l) for l in (lod or [])]
+
+    @property
+    def shape(self):
+        return tuple(np.shape(self.data))
+
+    @property
+    def dtype(self):
+        return np.asarray(self.data).dtype
+
+    def lod_level(self):
+        return len(self.lod)
+
+    def sequence_lengths(self, level=-1):
+        offs = self.lod[level]
+        return [offs[i + 1] - offs[i] for i in range(len(offs) - 1)]
+
+    def num_sequences(self, level=0):
+        return len(self.lod[level]) - 1
+
+    def __array__(self, dtype=None):
+        arr = np.asarray(self.data)
+        return arr.astype(dtype) if dtype is not None else arr
+
+    def __repr__(self):
+        return "LoDTensor(shape=%s, lod=%s)" % (self.shape, self.lod)
+
+    def to_padded(self, pad_value=0.0, max_len=None):
+        """[sum_T, D...] + lod -> ([N, max_len, D...], [N] lengths).
+        The ragged->dense bucketing bridge to XLA static shapes."""
+        data = np.asarray(self.data)
+        lens = self.sequence_lengths(0)
+        n = len(lens)
+        t = max_len or (max(lens) if lens else 0)
+        out = np.full((n, t) + data.shape[1:], pad_value, dtype=data.dtype)
+        offs = self.lod[0]
+        for i in range(n):
+            seq = data[offs[i]:offs[i + 1]]
+            out[i, : len(seq)] = seq[:t]
+        return out, np.asarray(lens, dtype=np.int64)
+
+    @staticmethod
+    def from_padded(padded, lengths):
+        padded = np.asarray(padded)
+        lengths = [int(l) for l in np.asarray(lengths).reshape(-1)]
+        parts = [padded[i, :l] for i, l in enumerate(lengths)]
+        data = (np.concatenate(parts, axis=0) if parts
+                else padded.reshape((0,) + padded.shape[2:]))
+        offs = [0]
+        for l in lengths:
+            offs.append(offs[-1] + l)
+        return LoDTensor(data, [offs])

@@ -1,0 +1,68 @@
+"""fluid-compatible user API for the TPU-native framework.
+
+A user of the reference (python/paddle/fluid) should find the same surface:
+
+    import paddle_tpu.fluid as fluid
+    x = fluid.layers.data(name="x", shape=[13])
+    y = fluid.layers.fc(x, size=1)
+    ...
+    exe = fluid.Executor(fluid.TPUPlace(0))
+"""
+import paddle_tpu.ops  # register the operator library
+
+from . import framework
+from .framework import (Program, Block, Operator, Variable, Parameter,
+                        default_main_program, default_startup_program,
+                        program_guard, switch_main_program,
+                        switch_startup_program)
+from . import layers
+from . import initializer
+from .param_attr import ParamAttr
+from . import param_attr
+from .layer_helper import LayerHelper
+from . import backward
+from .backward import append_backward, calc_gradient
+from . import optimizer
+from . import regularizer
+from . import clip
+from . import unique_name
+from . import nets
+from . import metrics
+from . import profiler
+from .executor import Executor, global_scope, scope_guard, fetch_var
+from . import io
+from .io import (save_vars, save_params, save_persistables, load_vars,
+                 load_params, load_persistables, save_inference_model,
+                 load_inference_model, save_checkpoint, load_checkpoint,
+                 clean_checkpoint, get_latest_checkpoint_serial)
+from .data_feeder import DataFeeder
+from .parallel_executor import (ParallelExecutor, ExecutionStrategy,
+                                BuildStrategy)
+
+from paddle_tpu.core.place import CPUPlace, TPUPlace, CUDAPlace
+from paddle_tpu.core.scope import Scope
+from paddle_tpu.core import executor_impl as core
+
+Tensor = None  # tensors are numpy/jax arrays; kept for import parity
+
+
+def is_compiled_with_cuda():
+    return False
+
+
+def is_compiled_with_tpu():
+    return True
+
+
+__all__ = [
+    "Program", "Block", "Operator", "Variable", "Parameter",
+    "default_main_program", "default_startup_program", "program_guard",
+    "switch_main_program", "switch_startup_program",
+    "layers", "initializer", "ParamAttr", "LayerHelper",
+    "append_backward", "calc_gradient", "optimizer", "regularizer", "clip",
+    "unique_name", "nets", "metrics", "profiler",
+    "Executor", "global_scope", "scope_guard", "fetch_var",
+    "io", "save_inference_model", "load_inference_model", "DataFeeder",
+    "ParallelExecutor", "ExecutionStrategy", "BuildStrategy",
+    "CPUPlace", "TPUPlace", "CUDAPlace", "Scope",
+]

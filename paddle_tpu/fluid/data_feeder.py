@@ -1,0 +1,94 @@
+"""DataFeeder: minibatch (list of tuples) -> feed dict of numpy arrays.
+
+Parity: reference python/paddle/fluid/data_feeder.py.  LoD (ragged) slots
+produce a LoDTensor (dense padded data + offsets) — see core/lod.py.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .framework import Variable, default_main_program
+
+__all__ = ["DataFeeder"]
+
+
+class DataToLoDTensorConverter:
+    def __init__(self, shape, dtype, lod_level):
+        self.shape = shape
+        self.dtype = dtype
+        self.lod_level = lod_level
+        self.data = []
+        self.lod = [[0] for _ in range(lod_level)]
+
+    def feed(self, data):
+        self._feed_impl(data, self.lod, self.lod_level)
+
+    def _feed_impl(self, data, lod, lod_level):
+        if lod_level == 0:
+            self.data.append(data)
+        else:
+            lod[0].append(lod[0][-1] + len(data))
+            for item in data:
+                self._feed_impl(item, lod[1:], lod_level - 1)
+
+    def done(self):
+        if self.lod_level == 0:
+            arr = np.array(self.data, dtype=self.dtype)
+            if self.shape:
+                want = [d for d in self.shape]
+                if arr.shape[1:] != tuple(d for d in want if d > 0):
+                    try:
+                        arr = arr.reshape([-1] + [d for d in want if d > 0])
+                    except ValueError:
+                        pass
+            return arr
+        from paddle_tpu.core.lod import LoDTensor
+        flat = np.concatenate(
+            [np.asarray(x, dtype=self.dtype).reshape(-1, *self.shape)
+             if self.shape else np.asarray(x, dtype=self.dtype)
+             for x in _flatten_seqs(self.data)], axis=0) \
+            if self.data else np.zeros([0] + list(self.shape),
+                                       dtype=self.dtype)
+        return LoDTensor(flat, self.lod)
+
+
+def _flatten_seqs(data):
+    return data
+
+
+class DataFeeder:
+    def __init__(self, feed_list, place=None, program=None):
+        self.feed_dtypes = []
+        self.feed_names = []
+        self.feed_shapes = []
+        self.feed_lod_level = []
+        if program is None:
+            program = default_main_program()
+        for each_var in feed_list:
+            if isinstance(each_var, str):
+                each_var = program.global_block().var(each_var)
+            if not isinstance(each_var, Variable):
+                raise TypeError("feed_list entries must be Variables")
+            self.feed_names.append(each_var.name)
+            self.feed_lod_level.append(each_var.lod_level)
+            shape = [d for d in each_var.shape if d >= 0]
+            # drop leading batch dim
+            if each_var.shape and each_var.shape[0] == -1:
+                shape = list(each_var.shape[1:])
+            self.feed_shapes.append(shape)
+            self.feed_dtypes.append(each_var.dtype)
+        self.place = place
+
+    def feed(self, iterable):
+        converters = [
+            DataToLoDTensorConverter(shape=s, dtype=d, lod_level=l)
+            for s, d, l in zip(self.feed_shapes, self.feed_dtypes,
+                               self.feed_lod_level)]
+        for each_sample in iterable:
+            assert len(each_sample) == len(converters), \
+                "sample arity %d != feed arity %d" % (len(each_sample),
+                                                      len(converters))
+            for each_converter, each_slot in zip(converters, each_sample):
+                each_converter.feed(each_slot)
+        return {name: conv.done()
+                for name, conv in zip(self.feed_names, converters)}

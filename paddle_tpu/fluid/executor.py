@@ -1,0 +1,69 @@
+"""User-facing Executor (parity: python/paddle/fluid/executor.py:274).
+
+Feed dict maps names -> numpy arrays (or LoDTensor); fetch_list holds
+Variables or names.  The heavy lifting (functionalization + XLA compile
+cache) is in core/executor_impl.py.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from paddle_tpu.core.executor_impl import ExecutorCore
+from paddle_tpu.core.scope import Scope, global_scope
+from paddle_tpu.core.place import CPUPlace, TPUPlace
+
+from .framework import Variable, default_main_program
+
+__all__ = ["Executor", "global_scope", "scope_guard", "fetch_var"]
+
+import contextlib
+
+_scope_stack = [global_scope()]
+
+
+def _current_scope():
+    return _scope_stack[-1]
+
+
+@contextlib.contextmanager
+def scope_guard(scope):
+    _scope_stack.append(scope)
+    try:
+        yield
+    finally:
+        _scope_stack.pop()
+
+
+def fetch_var(name, scope=None, return_numpy=True):
+    scope = scope or _current_scope()
+    val = scope.find_var(name)
+    return np.asarray(val) if return_numpy else val
+
+
+class Executor:
+    def __init__(self, place=None):
+        self.place = place if place is not None else CPUPlace()
+        self._core = ExecutorCore(self.place)
+
+    def run(self, program=None, feed=None, fetch_list=None,
+            feed_var_name="feed", fetch_var_name="fetch", scope=None,
+            return_numpy=True, use_program_cache=True):
+        if program is None:
+            program = default_main_program()
+        if scope is None:
+            scope = _current_scope()
+        feed = dict(feed or {})
+        names = []
+        for f in (fetch_list or []):
+            names.append(f.name if isinstance(f, Variable) else f)
+        feed_np = {}
+        for k, v in feed.items():
+            if isinstance(v, Variable):
+                raise TypeError("feed values must be arrays, got Variable")
+            feed_np[k] = v
+        mode = "test" if getattr(program, "_is_test", False) else "train"
+        return self._core.run(program.desc, scope, 0, feed_np, names,
+                              mode=mode, return_numpy=return_numpy)
+
+    def close(self):
+        pass

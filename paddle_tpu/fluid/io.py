@@ -1,0 +1,266 @@
+"""Persistence: save/load vars, inference models, training checkpoints.
+
+Parity: reference python/paddle/fluid/io.py (save_vars/save_params/
+save_persistables via save ops run in a temp program, save_inference_model:301
+(prune to feed/fetch subgraph), checkpoints:466 with serial dirs + _SUCCESS
+marker, keep-last-3 _scroll_delete:682).
+"""
+from __future__ import annotations
+
+import os
+import shutil
+import time
+
+import numpy as np
+
+from .framework import (Program, Parameter, Variable, default_main_program,
+                        default_startup_program, program_guard)
+from .executor import Executor, global_scope
+
+__all__ = [
+    "save_vars", "save_params", "save_persistables", "load_vars",
+    "load_params", "load_persistables", "save_inference_model",
+    "load_inference_model", "get_inference_program", "save_checkpoint",
+    "load_checkpoint", "clean_checkpoint", "get_latest_checkpoint_serial",
+]
+
+
+def is_persistable(var):
+    return var.persistable
+
+
+def is_parameter(var):
+    return isinstance(var, Parameter)
+
+
+def _save_load_vars(executor, dirname, main_program, predicate, op_type,
+                    filename=None):
+    if main_program is None:
+        main_program = default_main_program()
+    vars_ = [v for v in main_program.list_vars() if predicate(v)]
+    seen = set()
+    uniq = []
+    for v in vars_:
+        if v.name not in seen:
+            seen.add(v.name)
+            uniq.append(v)
+    prog = Program()
+    with program_guard(prog):
+        block = prog.global_block()
+        if filename is None:
+            for v in uniq:
+                block.create_var(name=v.name, shape=v.shape, dtype=v.dtype,
+                                 persistable=True)
+                io_slot = ({"X": [v.name]} if op_type == "save"
+                           else {})
+                out_slot = ({} if op_type == "save"
+                            else {"Out": [v.name]})
+                block.append_op(
+                    type=op_type, inputs=io_slot, outputs=out_slot,
+                    attrs={"file_path": os.path.join(dirname, v.name)},
+                    infer_shape=False)
+        else:
+            names = [v.name for v in uniq]
+            for v in uniq:
+                block.create_var(name=v.name, shape=v.shape, dtype=v.dtype,
+                                 persistable=True)
+            if op_type == "save":
+                block.append_op(type="save_combine",
+                                inputs={"X": names}, outputs={},
+                                attrs={"file_path":
+                                       os.path.join(dirname, filename)},
+                                infer_shape=False)
+            else:
+                block.append_op(type="load_combine", inputs={},
+                                outputs={"Out": names},
+                                attrs={"file_path":
+                                       os.path.join(dirname, filename)},
+                                infer_shape=False)
+    os.makedirs(dirname, exist_ok=True)
+    executor.run(prog)
+
+
+def save_vars(executor, dirname, main_program=None, vars=None,
+              predicate=None, filename=None):
+    if vars is not None:
+        names = {v.name if isinstance(v, Variable) else v for v in vars}
+        predicate = lambda v: v.name in names  # noqa: E731
+    _save_load_vars(executor, dirname, main_program, predicate, "save",
+                    filename)
+
+
+def save_params(executor, dirname, main_program=None, filename=None):
+    _save_load_vars(executor, dirname, main_program, is_parameter, "save",
+                    filename)
+
+
+def save_persistables(executor, dirname, main_program=None, filename=None):
+    _save_load_vars(executor, dirname, main_program, is_persistable, "save",
+                    filename)
+
+
+def load_vars(executor, dirname, main_program=None, vars=None,
+              predicate=None, filename=None):
+    if vars is not None:
+        names = {v.name if isinstance(v, Variable) else v for v in vars}
+        predicate = lambda v: v.name in names  # noqa: E731
+    _save_load_vars(executor, dirname, main_program, predicate, "load",
+                    filename)
+
+
+def load_params(executor, dirname, main_program=None, filename=None):
+    _save_load_vars(executor, dirname, main_program, is_parameter, "load",
+                    filename)
+
+
+def load_persistables(executor, dirname, main_program=None, filename=None):
+    _save_load_vars(executor, dirname, main_program, is_persistable, "load",
+                    filename)
+
+
+# ---------------------------------------------------------------------------
+# Inference model export (reference io.py:301,378)
+# ---------------------------------------------------------------------------
+
+def get_inference_program(target_vars, main_program=None):
+    if main_program is None:
+        main_program = default_main_program()
+    if not isinstance(target_vars, list):
+        target_vars = [target_vars]
+    return main_program.clone(for_test=True).prune(target_vars)
+
+
+def save_inference_model(dirname, feeded_var_names, target_vars, executor,
+                         main_program=None, model_filename=None,
+                         params_filename=None):
+    if main_program is None:
+        main_program = default_main_program()
+    if not isinstance(target_vars, list):
+        target_vars = [target_vars]
+    os.makedirs(dirname, exist_ok=True)
+    inference_program = main_program.clone(for_test=True).prune(target_vars)
+    # record feed/fetch names in the serialized program via attr-bearing ops
+    blk = inference_program.desc.blocks[0]
+    from paddle_tpu.core import desc as core_desc
+    for i, name in enumerate(feeded_var_names):
+        blk.ops.insert(i, core_desc.OpDesc(
+            "feed", {}, {"Out": [name]}, {"col": i}))
+    for i, var in enumerate(target_vars):
+        blk.ops.append(core_desc.OpDesc(
+            "fetch", {"X": [var.name]}, {}, {"col": i}))
+    inference_program.desc.bump_version()
+    model_filename = model_filename or "__model__"
+    with open(os.path.join(dirname, model_filename), "wb") as f:
+        f.write(inference_program.serialize_to_string())
+    save_persistables(executor, dirname, main_program, params_filename)
+    return inference_program
+
+
+def load_inference_model(dirname, executor, model_filename=None,
+                         params_filename=None):
+    model_filename = model_filename or "__model__"
+    with open(os.path.join(dirname, model_filename), "rb") as f:
+        program = Program.parse_from_string(f.read())
+    blk = program.desc.blocks[0]
+    feed_names = [op.output("Out")[0] for op in blk.ops
+                  if op.type == "feed"]
+    fetch_names = [op.input("X")[0] for op in blk.ops if op.type == "fetch"]
+    # mark persistables then load
+    load_persistables(executor, dirname, program, params_filename)
+    fetch_vars = [program.global_block().vars[n] for n in fetch_names
+                  if n in program.global_block().vars]
+    program._is_test = True
+    return program, feed_names, fetch_vars
+
+
+# ---------------------------------------------------------------------------
+# Training checkpoints (reference io.py:466-746)
+# ---------------------------------------------------------------------------
+
+SUCCESS_MARK_FILENAME = "_SUCCESS"
+CHECKPOINT_PREFIX = "checkpoint"
+MODEL_DIR = "__model__"
+TRAINER_PREFIX = "trainer"
+
+
+def _checkpoint_dir(root, serial):
+    return os.path.join(root, "%s_%d" % (CHECKPOINT_PREFIX, serial))
+
+
+def get_latest_checkpoint_serial(checkpoint_dir):
+    if not checkpoint_dir or not os.path.isdir(checkpoint_dir):
+        return -1
+    best = -1
+    for d in os.listdir(checkpoint_dir):
+        if not d.startswith(CHECKPOINT_PREFIX + "_"):
+            continue
+        try:
+            serial = int(d.split("_")[-1])
+        except ValueError:
+            continue
+        if os.path.exists(os.path.join(checkpoint_dir, d, MODEL_DIR,
+                                       SUCCESS_MARK_FILENAME)):
+            best = max(best, serial)
+    return best
+
+
+def _scroll_delete(checkpoint_dir, max_num_checkpoints=3):
+    serials = []
+    for d in os.listdir(checkpoint_dir):
+        if d.startswith(CHECKPOINT_PREFIX + "_"):
+            try:
+                serials.append(int(d.split("_")[-1]))
+            except ValueError:
+                pass
+    serials.sort(reverse=True)
+    for serial in serials[max_num_checkpoints:]:
+        shutil.rmtree(_checkpoint_dir(checkpoint_dir, serial),
+                      ignore_errors=True)
+
+
+def save_checkpoint(executor, checkpoint_dir, trainer_id=0,
+                    trainer_args=None, main_program=None,
+                    max_num_checkpoints=3):
+    if checkpoint_dir is None:
+        raise ValueError("checkpoint_dir is required")
+    os.makedirs(checkpoint_dir, exist_ok=True)
+    serial = get_latest_checkpoint_serial(checkpoint_dir) + 1
+    cur_dir = _checkpoint_dir(checkpoint_dir, serial)
+    model_dir = os.path.join(cur_dir, MODEL_DIR)
+    os.makedirs(model_dir, exist_ok=True)
+    if trainer_args:
+        import json
+        with open(os.path.join(cur_dir, "%s_%d" % (TRAINER_PREFIX,
+                                                   trainer_id)), "w") as f:
+            json.dump(trainer_args, f)
+    save_persistables(executor, model_dir, main_program)
+    with open(os.path.join(model_dir, SUCCESS_MARK_FILENAME), "w") as f:
+        f.write(str(time.time()))
+    _scroll_delete(checkpoint_dir, max_num_checkpoints)
+    return serial
+
+
+def load_checkpoint(executor, checkpoint_dir, serial=None,
+                    main_program=None):
+    if serial is None:
+        serial = get_latest_checkpoint_serial(checkpoint_dir)
+    if serial < 0:
+        raise ValueError("no checkpoint found in %r" % checkpoint_dir)
+    model_dir = os.path.join(_checkpoint_dir(checkpoint_dir, serial),
+                             MODEL_DIR)
+    load_persistables(executor, model_dir, main_program)
+    return serial
+
+
+def load_trainer_args(checkpoint_dir, serial, trainer_id):
+    import json
+    path = os.path.join(_checkpoint_dir(checkpoint_dir, serial),
+                        "%s_%d" % (TRAINER_PREFIX, trainer_id))
+    with open(path) as f:
+        return json.load(f)
+
+
+def clean_checkpoint(checkpoint_dir, delete_dir=False):
+    _scroll_delete(checkpoint_dir, max_num_checkpoints=0)
+    if delete_dir and not os.listdir(checkpoint_dir):
+        os.rmdir(checkpoint_dir)

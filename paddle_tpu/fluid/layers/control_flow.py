@@ -1,0 +1,9 @@
+"""Control-flow layers — placeholder set for round-1 (While/StaticRNN/
+DynamicRNN land with the LoD + lax.while_loop lowering work).
+
+Parity target: reference python/paddle/fluid/layers/control_flow.py
+(StaticRNN:383, While:608, DynamicRNN:1313, ConditionalBlock:1065).
+"""
+from __future__ import annotations
+
+__all__ = []

@@ -1,0 +1,4 @@
+"""Detection layers — placeholder (reference layers/detection.py)."""
+from __future__ import annotations
+
+__all__ = []

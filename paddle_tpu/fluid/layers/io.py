@@ -1,0 +1,23 @@
+"""Data-input layers.
+
+Parity: reference python/paddle/fluid/layers/io.py (`data`, readers,
+ListenAndServ/Send are added by the distributed transpiler work).
+"""
+from __future__ import annotations
+
+from ..framework import default_main_program, default_startup_program
+from ..layer_helper import LayerHelper
+from paddle_tpu.core.types import VarKind
+
+__all__ = ["data"]
+
+
+def data(name, shape, append_batch_size=True, dtype="float32", lod_level=0,
+         type=None, stop_gradient=True):
+    helper = LayerHelper("data", name=name)
+    shape = list(shape)
+    if append_batch_size:
+        shape = [-1] + shape
+    return helper.create_global_variable(
+        name=name, shape=shape, dtype=dtype, lod_level=lod_level,
+        stop_gradient=stop_gradient)

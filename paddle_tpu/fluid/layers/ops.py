@@ -1,0 +1,42 @@
+"""Auto-generated-style unary layers.
+
+Parity: reference python/paddle/fluid/layers/ops.py, which generates layer
+functions from registered OpProtos via layer_function_generator.py.  Here we
+generate a simple X->Out layer per registered activation op.
+"""
+from __future__ import annotations
+
+from ..layer_helper import LayerHelper
+
+_UNARY_OPS = [
+    "sigmoid", "logsigmoid", "exp", "tanh", "tanh_shrink", "softshrink",
+    "sqrt", "abs", "ceil", "floor", "cos", "sin", "round", "reciprocal",
+    "square", "softplus", "softsign", "brelu", "leaky_relu", "soft_relu",
+    "elu", "relu6", "pow", "stanh", "hard_sigmoid", "swish",
+    "thresholded_relu", "hard_shrink", "cumsum", "sign",
+]
+
+__all__ = list(_UNARY_OPS) + ["uniform_random_like"]
+
+
+def _make_unary(op_type):
+    def layer(x, name=None, **attrs):
+        helper = LayerHelper(op_type, name=name)
+        out = helper.create_tmp_variable(dtype=x.dtype)
+        helper.append_op(type=op_type, inputs={"X": [x]},
+                        outputs={"Out": [out]}, attrs=attrs)
+        return out
+
+    layer.__name__ = op_type
+    layer.__doc__ = "%s activation (generated op-builder)" % op_type
+    return layer
+
+
+for _op in _UNARY_OPS:
+    globals()[_op] = _make_unary(_op)
+
+
+def uniform_random_like(x, min=-1.0, max=1.0, seed=0):
+    from .nn import uniform_random_batch_size_like
+    return uniform_random_batch_size_like(x, shape=list(x.shape),
+                                          min=min, max=max, seed=seed)

@@ -1,0 +1,7 @@
+"""Sequence (LoD) layers — placeholder for the LoD work.
+
+Parity target: reference sequence_* ops (operators/sequence_*_op.cc).
+"""
+from __future__ import annotations
+
+__all__ = []

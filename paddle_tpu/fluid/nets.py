@@ -1,0 +1,94 @@
+"""Composite networks (parity: python/paddle/fluid/nets.py)."""
+from __future__ import annotations
+
+from . import layers
+
+__all__ = ["simple_img_conv_pool", "img_conv_group", "glu",
+           "scaled_dot_product_attention"]
+
+
+def simple_img_conv_pool(input, num_filters, filter_size, pool_size,
+                         pool_stride, act, param_attr=None,
+                         pool_type="max", use_cudnn=True, use_mkldnn=False):
+    conv_out = layers.conv2d(input=input, num_filters=num_filters,
+                             filter_size=filter_size, param_attr=param_attr,
+                             act=act)
+    return layers.pool2d(input=conv_out, pool_size=pool_size,
+                         pool_type=pool_type, pool_stride=pool_stride)
+
+
+def img_conv_group(input, conv_num_filter, pool_size, conv_padding=1,
+                   conv_filter_size=3, conv_act=None, param_attr=None,
+                   conv_with_batchnorm=False, conv_batchnorm_drop_rate=0.0,
+                   pool_stride=1, pool_type="max", use_cudnn=True,
+                   use_mkldnn=False):
+    tmp = input
+    assert isinstance(conv_num_filter, (list, tuple))
+
+    def _extend(v):
+        return v if hasattr(v, "__len__") else [v] * len(conv_num_filter)
+
+    conv_padding = _extend(conv_padding)
+    conv_filter_size = _extend(conv_filter_size)
+    param_attr = _extend(param_attr)
+    conv_with_batchnorm = _extend(conv_with_batchnorm)
+    conv_batchnorm_drop_rate = _extend(conv_batchnorm_drop_rate)
+
+    for i in range(len(conv_num_filter)):
+        local_conv_act = conv_act
+        if conv_with_batchnorm[i]:
+            local_conv_act = None
+        tmp = layers.conv2d(input=tmp, num_filters=conv_num_filter[i],
+                            filter_size=conv_filter_size[i],
+                            padding=conv_padding[i],
+                            param_attr=param_attr[i], act=local_conv_act)
+        if conv_with_batchnorm[i]:
+            tmp = layers.batch_norm(input=tmp, act=conv_act)
+            drop_rate = conv_batchnorm_drop_rate[i]
+            if abs(drop_rate) > 1e-5:
+                tmp = layers.dropout(x=tmp, dropout_prob=drop_rate)
+    return layers.pool2d(input=tmp, pool_size=pool_size,
+                         pool_type=pool_type, pool_stride=pool_stride)
+
+
+def glu(input, dim=-1):
+    a, b = layers.split(input, num_or_sections=2, dim=dim)
+    return layers.elementwise_mul(x=a, y=layers.sigmoid(b))
+
+
+def scaled_dot_product_attention(queries, keys, values, num_heads=1,
+                                 dropout_rate=0.0):
+    """Multi-head scaled dot-product attention (reference nets.py) over
+    [B, T, D] tensors — one fused XLA region; the MXU sees two batched
+    matmuls per head group."""
+    if queries.shape[-1] != keys.shape[-1]:
+        raise ValueError("queries and keys feature dims differ")
+    if keys.shape[-2] != values.shape[-2] if len(
+            keys.shape) > 2 else False:
+        raise ValueError("keys and values length mismatch")
+
+    def _split_heads(x, n):
+        if n == 1:
+            return x
+        b, t, d = x.shape
+        x = layers.reshape(x, shape=[-1 if b < 0 else b, t, n, d // n])
+        return layers.transpose(x, perm=[0, 2, 1, 3])
+
+    def _combine_heads(x):
+        if num_heads == 1:
+            return x
+        x = layers.transpose(x, perm=[0, 2, 1, 3])
+        b, t, n, d = x.shape
+        return layers.reshape(x, shape=[-1 if b < 0 else b, t, n * d])
+
+    q = _split_heads(queries, num_heads)
+    k = _split_heads(keys, num_heads)
+    v = _split_heads(values, num_heads)
+    d_k = float(q.shape[-1])
+    scaled_q = layers.scale(q, scale=d_k ** -0.5)
+    product = layers.matmul(scaled_q, k, transpose_y=True)
+    weights = layers.softmax(product)
+    if dropout_rate:
+        weights = layers.dropout(weights, dropout_prob=dropout_rate)
+    ctx_multiheads = layers.matmul(weights, v)
+    return _combine_heads(ctx_multiheads)

@@ -1,0 +1,128 @@
+"""Profiler: host events + device traces.
+
+Parity: reference python/paddle/fluid/profiler.py:135 (profiler context
+manager), platform/profiler.cc (RecordEvent host events + table dump),
+tools/timeline.py (chrome://tracing export).  Device-side CUPTI capture is
+replaced by jax.profiler (XPlane/Xprof), started alongside host events.
+"""
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import threading
+import time
+
+__all__ = ["RecordEvent", "profiler", "start_profiler", "stop_profiler",
+           "reset_profiler", "cuda_profiler", "export_chrome_tracing"]
+
+_state = {
+    "enabled": False,
+    "events": [],   # (name, start_ns, end_ns, thread_id)
+    "jax_trace_dir": None,
+}
+_lock = threading.Lock()
+
+
+class RecordEvent:
+    """RAII host-event annotation (reference platform/profiler.h:72)."""
+
+    def __init__(self, name):
+        self.name = name
+        self.start = None
+
+    def __enter__(self):
+        if _state["enabled"]:
+            self.start = time.perf_counter_ns()
+        return self
+
+    def __exit__(self, *exc):
+        if _state["enabled"] and self.start is not None:
+            with _lock:
+                _state["events"].append(
+                    (self.name, self.start, time.perf_counter_ns(),
+                     threading.get_ident()))
+        return False
+
+
+def reset_profiler():
+    with _lock:
+        _state["events"] = []
+
+
+def start_profiler(state="All", trace_dir=None):
+    if _state["enabled"]:
+        return
+    _state["enabled"] = True
+    reset_profiler()
+    if trace_dir and state in ("GPU", "All", "TPU"):
+        try:
+            import jax
+            jax.profiler.start_trace(trace_dir)
+            _state["jax_trace_dir"] = trace_dir
+        except Exception:
+            _state["jax_trace_dir"] = None
+
+
+def stop_profiler(sorted_key=None, profile_path="/tmp/profile"):
+    if not _state["enabled"]:
+        return
+    _state["enabled"] = False
+    if _state["jax_trace_dir"]:
+        try:
+            import jax
+            jax.profiler.stop_trace()
+        except Exception:
+            pass
+        _state["jax_trace_dir"] = None
+    events = list(_state["events"])
+    # aggregate per name (reference prints a table sorted by sorted_key)
+    agg = {}
+    for name, s, e, _tid in events:
+        total, cnt, mx, mn = agg.get(name, (0.0, 0, 0.0, float("inf")))
+        dur = (e - s) / 1e6
+        agg[name] = (total + dur, cnt + 1, max(mx, dur), min(mn, dur))
+    rows = [(name, cnt, total, total / cnt, mn, mx)
+            for name, (total, cnt, mx, mn) in agg.items()]
+    key_idx = {"calls": 1, "total": 2, "ave": 3, "min": 4, "max": 5}.get(
+        sorted_key, 2)
+    rows.sort(key=lambda r: r[key_idx], reverse=True)
+    if rows:
+        print("%-40s %8s %12s %12s %12s %12s" %
+              ("Event", "Calls", "Total(ms)", "Avg(ms)", "Min(ms)",
+               "Max(ms)"))
+        for r in rows:
+            print("%-40s %8d %12.3f %12.3f %12.3f %12.3f" % r)
+    if profile_path:
+        export_chrome_tracing(profile_path, events)
+
+
+def export_chrome_tracing(path, events=None):
+    """Dump events as a chrome://tracing JSON (reference tools/timeline.py)."""
+    events = events if events is not None else _state["events"]
+    trace = {"traceEvents": [
+        {"name": name, "ph": "X", "pid": 0, "tid": tid,
+         "ts": s / 1e3, "dur": (e - s) / 1e3, "cat": "host"}
+        for name, s, e, tid in events]}
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path, "w") as f:
+        json.dump(trace, f)
+
+
+@contextlib.contextmanager
+def profiler(state="CPU", sorted_key=None, profile_path="/tmp/profile",
+             trace_dir=None):
+    start_profiler(state, trace_dir)
+    try:
+        yield
+    finally:
+        stop_profiler(sorted_key, profile_path)
+
+
+@contextlib.contextmanager
+def cuda_profiler(*args, **kwargs):
+    """Kept for API parity (reference profiler.py:36 wraps nvprof); on TPU
+    use profiler(state='TPU', trace_dir=...) which starts an Xprof trace."""
+    yield

@@ -105,18 +105,20 @@ def _pool2d(ctx, ins, attrs, op):
     strides4 = (1, 1, strides[0], strides[1])
     pads4 = ((0, 0), (0, 0), (paddings[0], paddings[0]),
              (paddings[1], paddings[1]))
+    # NOTE: init values must be Python scalars so JAX recognizes the
+    # max/add monoids and lowers to the differentiable reduce-window prims.
     if ptype == "max":
-        init = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else \
-            jnp.iinfo(x.dtype).min
-        out = jax.lax.reduce_window(x, jnp.asarray(init, x.dtype),
-                                    jax.lax.max, window, strides4, pads4)
+        init = (-float("inf") if jnp.issubdtype(x.dtype, jnp.floating)
+                else int(jnp.iinfo(x.dtype).min))
+        out = jax.lax.reduce_window(x, init, jax.lax.max, window, strides4,
+                                    pads4)
     else:
-        ssum = jax.lax.reduce_window(x, jnp.asarray(0.0, x.dtype),
-                                     jax.lax.add, window, strides4, pads4)
+        ssum = jax.lax.reduce_window(x, 0.0, jax.lax.add, window, strides4,
+                                     pads4)
         if attrs.get("exclusive", True) and (paddings[0] or paddings[1]):
             ones = jnp.ones_like(x)
-            cnt = jax.lax.reduce_window(ones, jnp.asarray(0.0, x.dtype),
-                                        jax.lax.add, window, strides4, pads4)
+            cnt = jax.lax.reduce_window(ones, 0.0, jax.lax.add, window,
+                                        strides4, pads4)
             out = ssum / cnt
         else:
             out = ssum / (ksize[0] * ksize[1])
