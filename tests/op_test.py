@@ -1,0 +1,153 @@
+"""OpTest harness.
+
+Parity: reference python/paddle/fluid/tests/unittests/op_test.py:113 — a test
+declares op_type, numpy inputs/attrs and expected outputs; the harness builds
+a one-op program, checks outputs, and checks the emitted grad ops against
+numeric finite differences of the forward program (get_numeric_gradient:40).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.core.scope import Scope
+
+
+class OpTest:
+    """Subclass sets: op_type, inputs {slot: array or [(name, array), ...]},
+    attrs, outputs {slot: expected or [(name, expected), ...]}."""
+
+    op_type = None
+    inputs = {}
+    attrs = {}
+    outputs = {}
+
+    # --- program construction ---
+    def _build(self, extra_fetch=()):
+        main = fluid.Program()
+        startup = fluid.Program()
+        feed = {}
+        fetches = []
+        with fluid.program_guard(main, startup), fluid.unique_name.guard():
+            block = main.global_block()
+            in_map = {}
+            for slot, val in self.inputs.items():
+                entries = val if isinstance(val, list) else [(slot, val)]
+                names = []
+                for name, arr in entries:
+                    arr = np.asarray(arr)
+                    block.create_var(name=name, shape=arr.shape,
+                                     dtype=arr.dtype, stop_gradient=False)
+                    feed[name] = arr
+                    names.append(name)
+                in_map[slot] = names
+            out_map = {}
+            self._expected = {}
+            for slot, val in self.outputs.items():
+                entries = val if isinstance(val, list) else [(slot, val)]
+                names = []
+                for name, arr in entries:
+                    arr = np.asarray(arr)
+                    block.create_var(name=name, shape=arr.shape,
+                                     dtype=arr.dtype)
+                    names.append(name)
+                    self._expected[name] = arr
+                out_map[slot] = names
+            block.append_op(type=self.op_type, inputs=in_map,
+                            outputs=out_map, attrs=dict(self.attrs),
+                            infer_shape=False)
+        return main, startup, feed
+
+    def check_output(self, atol=1e-5, rtol=1e-5):
+        main, startup, feed = self._build()
+        exe = fluid.Executor(fluid.CPUPlace())
+        scope = Scope()
+        with fluid.scope_guard(scope):
+            fetch_names = list(self._expected.keys())
+            outs = exe.run(main, feed=feed, fetch_list=fetch_names)
+        for name, got in zip(self._expected.keys(), outs):
+            want = self._expected[name]
+            np.testing.assert_allclose(
+                np.asarray(got, dtype=np.float64),
+                np.asarray(want, dtype=np.float64),
+                atol=atol, rtol=rtol,
+                err_msg="op %s output %s mismatch" % (self.op_type, name))
+
+    # --- gradient check ---
+    def check_grad(self, inputs_to_check, output_names=None,
+                   max_relative_error=0.005, delta=1e-3):
+        """Analytic grads (append_backward over the one-op program) vs
+        numeric finite differences of a scalar head: sum(out * W) with fixed
+        random W per output."""
+        output_names = output_names or [
+            n for n in self._first_float_outputs()]
+        main, startup, feed = self._build()
+        rng = np.random.RandomState(7)
+        weights = {}
+        with fluid.program_guard(main, startup):
+            block = main.global_block()
+            parts = []
+            for oname in output_names:
+                ovar = block.var(oname)
+                w = rng.uniform(0.5, 1.5,
+                                [int(d) for d in ovar.shape]).astype(
+                                    np.float32)
+                weights[oname] = w
+                wvar = fluid.layers.assign(w)
+                wvar.stop_gradient = True
+                prod = fluid.layers.elementwise_mul(ovar, wvar)
+                parts.append(fluid.layers.reduce_sum(prod))
+            head = parts[0] if len(parts) == 1 else fluid.layers.sums(parts)
+            loss = fluid.layers.reduce_sum(head)
+            grads = fluid.backward.calc_gradient(
+                loss, [block.var(n) for n in inputs_to_check])
+        exe = fluid.Executor(fluid.CPUPlace())
+
+        def run_fetch(names, feed_over=None):
+            f = dict(feed)
+            if feed_over:
+                f.update(feed_over)
+            scope = Scope()
+            with fluid.scope_guard(scope):
+                return exe.run(main, feed=f, fetch_list=names)
+
+        grad_names = [g.name for g in grads]
+        analytic = run_fetch(grad_names)
+
+        for iname, a_grad in zip(inputs_to_check, analytic):
+            x = np.asarray(feed[iname], dtype=np.float64)
+            num = np.zeros_like(x)
+            flat = x.reshape(-1)
+            for i in range(flat.size):
+                for sgn, store in ((1, "p"), (-1, "m")):
+                    pert = flat.copy()
+                    pert[i] += sgn * delta
+                    out = run_fetch([loss.name],
+                                    {iname: pert.reshape(x.shape).astype(
+                                        feed[iname].dtype)})
+                    if sgn == 1:
+                        fp = float(np.asarray(out[0]).reshape(-1)[0])
+                    else:
+                        fm = float(np.asarray(out[0]).reshape(-1)[0])
+                num.reshape(-1)[i] = (fp - fm) / (2 * delta)
+            a = np.asarray(a_grad, dtype=np.float64)
+            # normalize by the LARGEST gradient magnitude: fp32 forward +
+            # finite differences put an absolute noise floor on every
+            # element, so per-element relative error is meaningless for
+            # near-zero entries (reference op_test.py __assert_is_close
+            # uses the same idea)
+            scale_ = max(np.abs(a).max(), np.abs(num).max(), 1e-3)
+            rel = np.abs(a - num) / scale_
+            assert rel.max() <= max_relative_error, (
+                "op %s grad wrt %s: max rel err %.5f (analytic %s vs "
+                "numeric %s)" % (self.op_type, iname, rel.max(),
+                                 a.reshape(-1)[:5], num.reshape(-1)[:5]))
+
+    def _first_float_outputs(self):
+        names = []
+        for slot, val in self.outputs.items():
+            entries = val if isinstance(val, list) else [(slot, val)]
+            for name, arr in entries:
+                if np.issubdtype(np.asarray(arr).dtype, np.floating):
+                    names.append(name)
+        return names

@@ -1,0 +1,81 @@
+"""Activation op tests (cf. reference test_activation_op.py)."""
+import numpy as np
+import pytest
+
+from op_test import OpTest
+
+rng = np.random.RandomState(0)
+
+
+def _sigmoid(x):
+    return 1 / (1 + np.exp(-x))
+
+
+CASES = {
+    "relu": lambda x: np.maximum(x, 0),
+    "sigmoid": _sigmoid,
+    "tanh": np.tanh,
+    "exp": np.exp,
+    "log": lambda x: np.log(x),
+    "sqrt": lambda x: np.sqrt(x),
+    "square": np.square,
+    "abs": np.abs,
+    "reciprocal": lambda x: 1.0 / x,
+    "softplus": lambda x: np.log1p(np.exp(x)),
+    "softsign": lambda x: x / (1 + np.abs(x)),
+    "logsigmoid": lambda x: np.log(_sigmoid(x)),
+    "tanh_shrink": lambda x: x - np.tanh(x),
+    "sin": np.sin,
+    "cos": np.cos,
+}
+
+POSITIVE_ONLY = {"log", "sqrt", "reciprocal"}
+
+
+@pytest.mark.parametrize("op_type", sorted(CASES))
+def test_activation(op_type):
+    if op_type in POSITIVE_ONLY:
+        x = rng.uniform(0.5, 2.0, (3, 5)).astype(np.float32)
+    else:
+        x = rng.uniform(-1.5, 1.5, (3, 5)).astype(np.float32)
+        x[np.abs(x) < 0.05] = 0.5  # keep away from kinks for numeric grad
+
+    class T(OpTest):
+        pass
+
+    T.op_type = op_type
+    T.inputs = {"X": x}
+    T.outputs = {"Out": CASES[op_type](x.astype(np.float64)).astype(
+        np.float32)}
+    t = T()
+    t.check_output(atol=1e-5)
+    t.check_grad(["X"], max_relative_error=0.01)
+
+
+def test_leaky_relu():
+    x = rng.uniform(-2, 2, (3, 4)).astype(np.float32)
+    x[np.abs(x) < 0.1] = 0.5
+
+    class T(OpTest):
+        op_type = "leaky_relu"
+        inputs = {"X": x}
+        attrs = {"alpha": 0.1}
+        outputs = {"Out": np.where(x > 0, x, 0.1 * x)}
+
+    T().check_output()
+    T().check_grad(["X"])
+
+
+def test_elu():
+    x = rng.uniform(-2, 2, (3, 4)).astype(np.float32)
+    x[np.abs(x) < 0.1] = 0.5
+
+    class T(OpTest):
+        op_type = "elu"
+        inputs = {"X": x}
+        attrs = {"alpha": 1.0}
+        outputs = {"Out": np.where(x > 0, x, np.exp(np.minimum(x, 0)) - 1)
+                   .astype(np.float32)}
+
+    T().check_output()
+    T().check_grad(["X"], max_relative_error=0.01)

@@ -1,0 +1,114 @@
+"""append_backward graph tests (cf. reference unittests asserting on op
+lists — the cheap deterministic layer, SURVEY §4.3)."""
+import numpy as np
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid.framework import OpRole
+
+
+def test_grad_op_emission(prog_scope):
+    main, startup, scope = prog_scope
+    x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+    y = fluid.layers.fc(x, size=3)
+    loss = fluid.layers.mean(y)
+    p_g = fluid.append_backward(loss)
+    types = [op.type for op in main.global_block().ops]
+    assert "mean_grad" in types
+    assert "mul_grad" in types
+    assert "elementwise_add_grad" in types
+    # backward ops marked with the Backward role
+    roles = [op.desc.role for op in main.global_block().ops
+             if op.type.endswith("_grad")]
+    assert all(r & OpRole.Backward for r in roles)
+    # one (param, grad) pair per trainable param (w + b)
+    assert len(p_g) == 2
+    for p, g in p_g:
+        assert g.name == p.name + "@GRAD"
+        assert tuple(g.shape) == tuple(p.shape)
+
+
+def test_duplicate_grad_summed(prog_scope, exe):
+    """x used twice -> contributions summed (reference
+    _addup_repetitive_outputs_)."""
+    main, startup, scope = prog_scope
+    x = fluid.layers.data(name="x", shape=[3], dtype="float32")
+    x.stop_gradient = False
+    y = fluid.layers.elementwise_mul(x, x)  # dy/dx = 2x
+    loss = fluid.layers.reduce_sum(y)
+    grads = fluid.calc_gradient(loss, [x])
+    types = [op.type for op in main.global_block().ops]
+    assert "sum" in types, "duplicate grad contributions must be summed"
+    xs = np.array([[1.0, 2.0, 3.0]], np.float32)
+    g, = exe.run(main, feed={"x": xs}, fetch_list=[grads[0]])
+    np.testing.assert_allclose(g, 2 * xs, rtol=1e-6)
+
+
+def test_stop_gradient_pruning(prog_scope):
+    main, startup, scope = prog_scope
+    x = fluid.layers.data(name="x", shape=[4], dtype="float32")  # stop_grad
+    w_frozen = fluid.layers.create_parameter([4, 2], "float32",
+                                             name="frozen")
+    w_frozen.trainable = False
+    w_frozen.stop_gradient = True
+    h = fluid.layers.mul(x, w_frozen)
+    loss = fluid.layers.mean(h)
+    p_g = fluid.append_backward(loss)
+    assert p_g == []
+    grad_names = [n for n in main.global_block().vars if "@GRAD" in n]
+    assert "frozen@GRAD" not in grad_names
+
+
+def test_unused_branch_skipped(prog_scope):
+    main, startup, scope = prog_scope
+    x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+    a = fluid.layers.fc(x, size=2)
+    b = fluid.layers.fc(x, size=2)  # not on loss path
+    loss = fluid.layers.mean(a)
+    fluid.append_backward(loss)
+    ops = [op.type for op in main.global_block().ops]
+    # exactly one mul_grad (for a's fc), not two
+    assert ops.count("mul_grad") == 1
+
+
+def test_grad_matches_jax_grad(prog_scope, exe):
+    """Whole-graph analytic grads vs jax.grad over an equivalent jnp
+    function: the strongest oracle available."""
+    import jax
+    import jax.numpy as jnp
+    main, startup, scope = prog_scope
+    np.random.seed(4)
+    xs = np.random.randn(5, 4).astype(np.float32)
+    w0 = np.random.randn(4, 8).astype(np.float32)
+    b0 = np.zeros(8, np.float32)
+    w1 = np.random.randn(8, 1).astype(np.float32)
+
+    x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+    h = fluid.layers.fc(x, size=8, act="tanh",
+                        param_attr=fluid.ParamAttr(name="w0"),
+                        bias_attr=fluid.ParamAttr(name="b0"))
+    y = fluid.layers.fc(h, size=1, act=None,
+                        param_attr=fluid.ParamAttr(name="w1"),
+                        bias_attr=False)
+    loss = fluid.layers.mean(y)
+    p_g = fluid.append_backward(loss)
+    exe.run(startup)
+    scope.set("w0", w0)
+    scope.set("b0", b0)
+    scope.set("w1", w1)
+    grad_map = {p.name: g.name for p, g in p_g}
+    got = exe.run(main, feed={"x": xs},
+                  fetch_list=[grad_map["w0"], grad_map["b0"],
+                              grad_map["w1"]])
+
+    # the environment's default matmul precision is reduced (TPU-style);
+    # force full f32 in the oracle to match the framework's mul lowering,
+    # which sets preferred_element_type=f32
+    @jax.default_matmul_precision("highest")
+    def f(params):
+        h_ = jnp.tanh(xs @ params["w0"] + params["b0"])
+        return jnp.mean(h_ @ params["w1"])
+
+    want = jax.grad(f)({"w0": w0, "b0": b0, "w1": w1})
+    np.testing.assert_allclose(got[0], want["w0"], atol=1e-4, rtol=1e-4)
+    np.testing.assert_allclose(got[1], want["b0"], atol=1e-4, rtol=1e-4)
+    np.testing.assert_allclose(got[2], want["w1"], atol=1e-4, rtol=1e-4)

@@ -1,0 +1,108 @@
+"""Book-style end-to-end model tests (cf. reference tests/book/):
+fit_a_line, recognize_digits (mlp + conv), word2vec-style embeddings —
+each trained a few iterations with loss-decrease assertions."""
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+
+
+def test_fit_a_line(prog_scope, exe):
+    main, startup, scope = prog_scope
+    np.random.seed(0)
+    x = fluid.layers.data(name="x", shape=[13], dtype="float32")
+    y = fluid.layers.data(name="y", shape=[1], dtype="float32")
+    y_predict = fluid.layers.fc(input=x, size=1, act=None)
+    cost = fluid.layers.square_error_cost(input=y_predict, label=y)
+    avg_cost = fluid.layers.mean(cost)
+    fluid.optimizer.SGD(learning_rate=0.01).minimize(avg_cost)
+    exe.run(startup)
+    true_w = np.random.randn(13, 1).astype(np.float32)
+    losses = []
+    for _ in range(80):
+        xs = np.random.randn(32, 13).astype(np.float32)
+        ys = xs @ true_w
+        loss, = exe.run(main, feed={"x": xs, "y": ys},
+                        fetch_list=[avg_cost])
+        losses.append(float(loss[0]))
+    assert losses[-1] < losses[0] * 0.2, (losses[0], losses[-1])
+
+
+def test_recognize_digits_mlp(prog_scope, exe):
+    main, startup, scope = prog_scope
+    np.random.seed(1)
+    img = fluid.layers.data(name="img", shape=[784], dtype="float32")
+    label = fluid.layers.data(name="label", shape=[1], dtype="int64")
+    hidden = fluid.layers.fc(img, size=64, act="relu")
+    prediction = fluid.layers.fc(hidden, size=10, act="softmax")
+    cost = fluid.layers.cross_entropy(input=prediction, label=label)
+    avg_cost = fluid.layers.mean(cost)
+    acc = fluid.layers.accuracy(input=prediction, label=label)
+    fluid.optimizer.Adam(learning_rate=0.01).minimize(avg_cost)
+    exe.run(startup)
+    losses = []
+    for i in range(80):
+        ys = np.random.randint(0, 10, (32, 1)).astype(np.int64)
+        xs = np.zeros((32, 784), np.float32)
+        xs[np.arange(32), ys[:, 0] * 78] = 1.0  # separable signal
+        loss, a = exe.run(main, feed={"img": xs, "label": ys},
+                          fetch_list=[avg_cost, acc])
+        losses.append(float(loss[0]))
+    assert losses[-1] < losses[0] * 0.5
+    assert float(a[0]) > 0.9
+
+
+def test_recognize_digits_conv(prog_scope, exe):
+    main, startup, scope = prog_scope
+    np.random.seed(2)
+    img = fluid.layers.data(name="img", shape=[1, 28, 28], dtype="float32")
+    label = fluid.layers.data(name="label", shape=[1], dtype="int64")
+    conv = fluid.nets.simple_img_conv_pool(img, 8, 5, 2, 2, act="relu")
+    prediction = fluid.layers.fc(conv, size=10, act="softmax")
+    avg_cost = fluid.layers.mean(
+        fluid.layers.cross_entropy(input=prediction, label=label))
+    fluid.optimizer.Adam(learning_rate=0.01).minimize(avg_cost)
+    exe.run(startup)
+    losses = []
+    for i in range(25):
+        ys = np.random.randint(0, 10, (16, 1)).astype(np.int64)
+        xs = np.zeros((16, 1, 28, 28), np.float32)
+        for j, c in enumerate(ys[:, 0]):
+            xs[j, 0, c * 2: c * 2 + 2, :] = 1.0
+        loss, = exe.run(main, feed={"img": xs, "label": ys},
+                        fetch_list=[avg_cost])
+        losses.append(float(loss[0]))
+    assert losses[-1] < losses[0] * 0.5, (losses[0], losses[-1])
+
+
+def test_word2vec_embeddings(prog_scope, exe):
+    """N-gram LM with shared embedding tables (reference book/word2vec)."""
+    main, startup, scope = prog_scope
+    np.random.seed(3)
+    dict_size, emb_size = 50, 16
+    words = []
+    embs = []
+    for i in range(3):
+        w = fluid.layers.data(name="w%d" % i, shape=[1], dtype="int64")
+        words.append(w)
+        embs.append(fluid.layers.embedding(
+            w, size=[dict_size, emb_size],
+            param_attr=fluid.ParamAttr(name="shared_emb")))
+    concat = fluid.layers.concat(embs, axis=1)
+    hidden = fluid.layers.fc(concat, size=32, act="relu")
+    predict = fluid.layers.fc(hidden, size=dict_size, act="softmax")
+    next_w = fluid.layers.data(name="next_w", shape=[1], dtype="int64")
+    avg_cost = fluid.layers.mean(
+        fluid.layers.cross_entropy(input=predict, label=next_w))
+    fluid.optimizer.Adam(learning_rate=0.01).minimize(avg_cost)
+    exe.run(startup)
+    losses = []
+    for _ in range(30):
+        seq = np.random.randint(0, dict_size - 4, (24, 1)).astype(np.int64)
+        feed = {"w0": seq, "w1": seq + 1, "w2": seq + 2,
+                "next_w": seq + 3}  # deterministic successor pattern
+        loss, = exe.run(main, feed=feed, fetch_list=[avg_cost])
+        losses.append(float(loss[0]))
+    assert losses[-1] < losses[0] * 0.7, (losses[0], losses[-1])
+    # the shared table must have received summed grads from 3 lookups
+    assert any("shared_emb" == n for n in scope.local_var_names())

@@ -1,0 +1,104 @@
+"""conv2d / pool2d tests (cf. reference test_conv2d_op.py, test_pool2d_op.py)."""
+import numpy as np
+
+from op_test import OpTest
+
+rng = np.random.RandomState(5)
+
+
+def _conv2d_ref(x, w, stride, pad):
+    n, c, h, wd = x.shape
+    oc, ic, kh, kw = w.shape
+    xp = np.pad(x, ((0, 0), (0, 0), (pad, pad), (pad, pad)))
+    oh = (h + 2 * pad - kh) // stride + 1
+    ow = (wd + 2 * pad - kw) // stride + 1
+    out = np.zeros((n, oc, oh, ow), dtype=np.float64)
+    for i in range(oh):
+        for j in range(ow):
+            patch = xp[:, :, i * stride:i * stride + kh,
+                       j * stride:j * stride + kw]
+            out[:, :, i, j] = np.tensordot(patch, w, axes=([1, 2, 3],
+                                                           [1, 2, 3]))
+    return out
+
+
+def test_conv2d_basic():
+    x = rng.randn(2, 3, 7, 7).astype(np.float32)
+    w = rng.randn(4, 3, 3, 3).astype(np.float32) * 0.5
+
+    class T(OpTest):
+        op_type = "conv2d"
+        inputs = {"Input": x, "Filter": w}
+        attrs = {"strides": [1, 1], "paddings": [1, 1],
+                 "dilations": [1, 1], "groups": 1}
+        outputs = {"Output": _conv2d_ref(x, w, 1, 1).astype(np.float32)}
+
+    T().check_output(atol=1e-4)
+
+
+def test_conv2d_stride_grad():
+    x = rng.randn(1, 2, 5, 5).astype(np.float32)
+    w = rng.randn(3, 2, 3, 3).astype(np.float32) * 0.5
+
+    class T(OpTest):
+        op_type = "conv2d"
+        inputs = {"Input": x, "Filter": w}
+        attrs = {"strides": [2, 2], "paddings": [0, 0],
+                 "dilations": [1, 1], "groups": 1}
+        outputs = {"Output": _conv2d_ref(x, w, 2, 0).astype(np.float32)}
+
+    T().check_output(atol=1e-4)
+    T().check_grad(["Input", "Filter"], max_relative_error=0.02)
+
+
+def test_pool2d_max():
+    x = rng.randn(2, 3, 6, 6).astype(np.float32)
+    ref = x.reshape(2, 3, 3, 2, 3, 2).max(axis=(3, 5))
+
+    class T(OpTest):
+        op_type = "pool2d"
+        inputs = {"X": x}
+        attrs = {"pooling_type": "max", "ksize": [2, 2],
+                 "strides": [2, 2], "paddings": [0, 0]}
+        outputs = {"Out": ref}
+
+    T().check_output()
+    T().check_grad(["X"], max_relative_error=0.02)
+
+
+def test_pool2d_avg():
+    x = rng.randn(2, 3, 6, 6).astype(np.float32)
+    ref = x.reshape(2, 3, 3, 2, 3, 2).mean(axis=(3, 5))
+
+    class T(OpTest):
+        op_type = "pool2d"
+        inputs = {"X": x}
+        attrs = {"pooling_type": "avg", "ksize": [2, 2],
+                 "strides": [2, 2], "paddings": [0, 0]}
+        outputs = {"Out": ref}
+
+    T().check_output()
+    T().check_grad(["X"])
+
+
+def test_pool2d_global():
+    x = rng.randn(2, 3, 4, 4).astype(np.float32)
+
+    class T(OpTest):
+        op_type = "pool2d"
+        inputs = {"X": x}
+        attrs = {"pooling_type": "avg", "ksize": [1, 1],
+                 "strides": [1, 1], "paddings": [0, 0],
+                 "global_pooling": True}
+        outputs = {"Out": x.mean(axis=(2, 3), keepdims=True)}
+
+    T().check_output()
+
+
+def test_conv2d_transpose_shape():
+    import paddle_tpu.fluid as fluid
+    with fluid.program_guard(fluid.Program(), fluid.Program()):
+        x = fluid.layers.data(name="x", shape=[3, 8, 8], dtype="float32")
+        y = fluid.layers.conv2d_transpose(x, num_filters=6, filter_size=4,
+                                          stride=2, padding=1)
+        assert tuple(y.shape[1:]) == (6, 16, 16), y.shape

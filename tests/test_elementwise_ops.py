@@ -1,0 +1,72 @@
+"""Elementwise / broadcast op tests (cf. reference
+test_elementwise_add_op.py etc.)."""
+import numpy as np
+import pytest
+
+from op_test import OpTest
+
+rng = np.random.RandomState(42)
+
+
+def _mk(op_type, fn, x, y, axis=-1):
+    class T(OpTest):
+        pass
+
+    T.op_type = op_type
+    T.inputs = {"X": x, "Y": y}
+    T.attrs = {"axis": axis}
+    # compute expected with numpy broadcast on aligned axes
+    yb = y
+    if y.shape != x.shape:
+        ax = axis if axis >= 0 else x.ndim - y.ndim
+        new_shape = [1] * ax + list(y.shape) + \
+            [1] * (x.ndim - ax - y.ndim)
+        yb = y.reshape(new_shape)
+    T.outputs = {"Out": fn(x.astype(np.float64),
+                           yb.astype(np.float64)).astype(x.dtype)}
+    return T()
+
+
+CASES = [
+    ("elementwise_add", np.add),
+    ("elementwise_sub", np.subtract),
+    ("elementwise_mul", np.multiply),
+    ("elementwise_div", np.divide),
+    ("elementwise_max", np.maximum),
+    ("elementwise_min", np.minimum),
+]
+
+
+@pytest.mark.parametrize("op_type,fn", CASES)
+def test_same_shape(op_type, fn):
+    x = rng.uniform(0.5, 2, (3, 4)).astype(np.float32)
+    y = rng.uniform(0.5, 2, (3, 4)).astype(np.float32)
+    t = _mk(op_type, fn, x, y)
+    t.check_output()
+    t.check_grad(["X", "Y"])
+
+
+@pytest.mark.parametrize("op_type,fn", [("elementwise_add", np.add),
+                                        ("elementwise_mul", np.multiply)])
+def test_broadcast_axis(op_type, fn):
+    x = rng.uniform(0.5, 2, (2, 3, 4)).astype(np.float32)
+    y = rng.uniform(0.5, 2, (3,)).astype(np.float32)
+    t = _mk(op_type, fn, x, y, axis=1)
+    t.check_output()
+    t.check_grad(["X", "Y"])
+
+
+def test_broadcast_trailing():
+    x = rng.uniform(0.5, 2, (2, 3)).astype(np.float32)
+    y = rng.uniform(0.5, 2, (3,)).astype(np.float32)
+    t = _mk("elementwise_add", np.add, x, y, axis=-1)
+    t.check_output()
+    t.check_grad(["X", "Y"])
+
+
+def test_pow():
+    x = rng.uniform(0.5, 2, (3, 4)).astype(np.float32)
+    y = np.full((3, 4), 2.0, np.float32)
+    t = _mk("elementwise_pow", np.power, x, y)
+    t.check_output()
+    t.check_grad(["X"])

@@ -1,0 +1,99 @@
+"""save/load persistables, inference model export, checkpoints
+(cf. reference io.py tests + book test save/load paths)."""
+import os
+
+import numpy as np
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.core.scope import Scope
+
+
+def _build(main, startup):
+    with fluid.program_guard(main, startup), fluid.unique_name.guard():
+        x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+        y = fluid.layers.fc(x, size=3, act="softmax",
+                            param_attr=fluid.ParamAttr(name="fc_w"),
+                            bias_attr=fluid.ParamAttr(name="fc_b"))
+        loss = fluid.layers.mean(y)
+        fluid.optimizer.SGD(0.1).minimize(loss)
+    return x, y, loss
+
+
+def test_save_load_persistables(tmp_path):
+    main, startup = fluid.Program(), fluid.Program()
+    _build(main, startup)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        w_before = np.asarray(scope.find_var("fc_w")).copy()
+        fluid.io.save_persistables(exe, str(tmp_path / "model"), main)
+        # clobber and reload
+        scope.set("fc_w", np.zeros_like(w_before))
+        fluid.io.load_persistables(exe, str(tmp_path / "model"), main)
+        np.testing.assert_allclose(np.asarray(scope.find_var("fc_w")),
+                                   w_before)
+
+
+def test_save_load_combined(tmp_path):
+    main, startup = fluid.Program(), fluid.Program()
+    _build(main, startup)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        w_before = np.asarray(scope.find_var("fc_w")).copy()
+        fluid.io.save_persistables(exe, str(tmp_path / "model"), main,
+                                   filename="all_params")
+        assert os.path.exists(tmp_path / "model" / "all_params")
+        scope.set("fc_w", np.zeros_like(w_before))
+        fluid.io.load_persistables(exe, str(tmp_path / "model"), main,
+                                   filename="all_params")
+        np.testing.assert_allclose(np.asarray(scope.find_var("fc_w")),
+                                   w_before)
+
+
+def test_inference_model_roundtrip(tmp_path):
+    main, startup = fluid.Program(), fluid.Program()
+    x, y, loss = _build(main, startup)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        xs = np.random.RandomState(0).randn(2, 4).astype(np.float32)
+        want, = exe.run(main.clone(for_test=True), feed={"x": xs},
+                        fetch_list=[y])
+        fluid.io.save_inference_model(str(tmp_path / "infer"), ["x"], [y],
+                                      exe, main)
+    # fresh scope = fresh process simulation
+    scope2 = Scope()
+    with fluid.scope_guard(scope2):
+        prog, feed_names, fetch_vars = fluid.io.load_inference_model(
+            str(tmp_path / "infer"), exe)
+        assert feed_names == ["x"]
+        got, = exe.run(prog, feed={"x": xs}, fetch_list=fetch_vars)
+        np.testing.assert_allclose(got, want, rtol=1e-5)
+
+
+def test_checkpoint_serial_dirs(tmp_path):
+    main, startup = fluid.Program(), fluid.Program()
+    _build(main, startup)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = Scope()
+    ckpt = str(tmp_path / "ckpt")
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        for i in range(5):
+            serial = fluid.io.save_checkpoint(
+                exe, ckpt, trainer_args={"step": i}, main_program=main)
+        assert serial == 4
+        # keep-last-3 scroll delete (reference io.py:682)
+        dirs = sorted(os.listdir(ckpt))
+        assert dirs == ["checkpoint_2", "checkpoint_3", "checkpoint_4"]
+        assert fluid.io.get_latest_checkpoint_serial(ckpt) == 4
+        w = np.asarray(scope.find_var("fc_w")).copy()
+        scope.set("fc_w", np.zeros_like(w))
+        fluid.io.load_checkpoint(exe, ckpt, main_program=main)
+        np.testing.assert_allclose(np.asarray(scope.find_var("fc_w")), w)
+        args = fluid.io.load_trainer_args(ckpt, 4, 0)
+        assert args["step"] == 4

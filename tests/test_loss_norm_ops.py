@@ -1,0 +1,168 @@
+"""Loss + normalization op tests (cf. reference test_cross_entropy_op.py,
+test_softmax_with_cross_entropy_op.py, test_batch_norm_op.py,
+test_layer_norm_op.py)."""
+import numpy as np
+
+from op_test import OpTest
+
+rng = np.random.RandomState(11)
+
+
+def _softmax(x):
+    e = np.exp(x - x.max(-1, keepdims=True))
+    return e / e.sum(-1, keepdims=True)
+
+
+def test_cross_entropy():
+    probs = _softmax(rng.randn(5, 7).astype(np.float32))
+    label = rng.randint(0, 7, (5, 1)).astype(np.int64)
+    expected = -np.log(probs[np.arange(5), label[:, 0]])[:, None]
+
+    class T(OpTest):
+        op_type = "cross_entropy"
+        inputs = {"X": probs, "Label": label}
+        outputs = {"Y": expected.astype(np.float32)}
+
+    T().check_output()
+
+
+def test_cross_entropy_soft():
+    probs = _softmax(rng.randn(4, 6).astype(np.float32))
+    label = _softmax(rng.randn(4, 6).astype(np.float32))
+    expected = -(label * np.log(probs)).sum(-1, keepdims=True)
+
+    class T(OpTest):
+        op_type = "cross_entropy"
+        inputs = {"X": probs, "Label": label}
+        attrs = {"soft_label": True}
+        outputs = {"Y": expected.astype(np.float32)}
+
+    T().check_output()
+
+
+def test_softmax_with_cross_entropy():
+    logits = rng.randn(5, 7).astype(np.float32)
+    label = rng.randint(0, 7, (5, 1)).astype(np.int64)
+    sm = _softmax(logits)
+    loss = -np.log(sm[np.arange(5), label[:, 0]])[:, None]
+
+    class T(OpTest):
+        op_type = "softmax_with_cross_entropy"
+        inputs = {"Logits": logits, "Label": label}
+        outputs = {"Softmax": sm, "Loss": loss.astype(np.float32)}
+
+    T().check_output(atol=1e-5)
+    T().check_grad(["Logits"], output_names=["Loss"],
+                   max_relative_error=0.01)
+
+
+def test_softmax():
+    x = rng.randn(4, 9).astype(np.float32)
+
+    class T(OpTest):
+        op_type = "softmax"
+        inputs = {"X": x}
+        outputs = {"Out": _softmax(x)}
+
+    T().check_output()
+    T().check_grad(["X"], max_relative_error=0.01)
+
+
+def test_batch_norm_train():
+    x = rng.randn(4, 3, 5, 5).astype(np.float32)
+    scale = rng.uniform(0.5, 1.5, 3).astype(np.float32)
+    bias = rng.randn(3).astype(np.float32)
+    mean_in = np.zeros(3, np.float32)
+    var_in = np.ones(3, np.float32)
+    eps, momentum = 1e-5, 0.9
+    mu = x.mean(axis=(0, 2, 3))
+    var = x.var(axis=(0, 2, 3))
+    y = (x - mu[None, :, None, None]) / np.sqrt(
+        var[None, :, None, None] + eps)
+    y = y * scale[None, :, None, None] + bias[None, :, None, None]
+
+    class T(OpTest):
+        op_type = "batch_norm"
+        inputs = {"X": x, "Scale": scale, "Bias": bias,
+                  "Mean": mean_in, "Variance": var_in}
+        attrs = {"epsilon": eps, "momentum": momentum, "is_test": False,
+                 "data_layout": "NCHW"}
+        outputs = {"Y": y.astype(np.float32),
+                   "MeanOut": (mean_in * momentum + mu * (1 - momentum)),
+                   "VarianceOut": (var_in * momentum + var * (1 - momentum)),
+                   "SavedMean": mu, "SavedVariance": var}
+
+    T().check_output(atol=2e-4, rtol=2e-4)
+
+
+def test_batch_norm_test_mode():
+    x = rng.randn(4, 3, 2, 2).astype(np.float32)
+    scale = np.ones(3, np.float32)
+    bias = np.zeros(3, np.float32)
+    mean_in = rng.randn(3).astype(np.float32)
+    var_in = np.abs(rng.randn(3).astype(np.float32)) + 0.5
+    eps = 1e-5
+    y = (x - mean_in[None, :, None, None]) / np.sqrt(
+        var_in[None, :, None, None] + eps)
+
+    class T(OpTest):
+        op_type = "batch_norm"
+        inputs = {"X": x, "Scale": scale, "Bias": bias,
+                  "Mean": mean_in, "Variance": var_in}
+        attrs = {"epsilon": eps, "is_test": True, "data_layout": "NCHW"}
+        outputs = {"Y": y.astype(np.float32)}
+
+    T().check_output(atol=1e-4)
+
+
+def test_layer_norm():
+    x = rng.randn(3, 10).astype(np.float32)
+    scale = rng.uniform(0.5, 1.5, 10).astype(np.float32)
+    bias = rng.randn(10).astype(np.float32)
+    eps = 1e-5
+    mu = x.mean(-1, keepdims=True)
+    var = x.var(-1, keepdims=True)
+    y = (x - mu) / np.sqrt(var + eps) * scale + bias
+
+    class T(OpTest):
+        op_type = "layer_norm"
+        inputs = {"X": x, "Scale": scale, "Bias": bias}
+        attrs = {"epsilon": eps, "begin_norm_axis": 1}
+        outputs = {"Y": y.astype(np.float32),
+                   "Mean": mu.reshape(3), "Variance": var.reshape(3)}
+
+    T().check_output(atol=1e-4)
+    T().check_grad(["X", "Scale", "Bias"], output_names=["Y"],
+                   max_relative_error=0.02)
+
+
+def test_sigmoid_cross_entropy_with_logits():
+    x = rng.randn(4, 5).astype(np.float32)
+    label = rng.uniform(0, 1, (4, 5)).astype(np.float32)
+    sig = 1 / (1 + np.exp(-x))
+    expected = -label * np.log(sig) - (1 - label) * np.log(1 - sig)
+
+    class T(OpTest):
+        op_type = "sigmoid_cross_entropy_with_logits"
+        inputs = {"X": x, "Label": label}
+        outputs = {"Out": expected.astype(np.float32)}
+
+    T().check_output(atol=1e-5)
+    T().check_grad(["X"], max_relative_error=0.01)
+
+
+def test_huber_loss():
+    x = rng.randn(6, 1).astype(np.float32)
+    y = rng.randn(6, 1).astype(np.float32)
+    d = 1.0
+    r = y - x
+    expected = np.where(np.abs(r) <= d, 0.5 * r * r,
+                        d * (np.abs(r) - 0.5 * d))
+
+    class T(OpTest):
+        op_type = "huber_loss"
+        inputs = {"X": x, "Y": y}
+        attrs = {"delta": d}
+        outputs = {"Out": expected.astype(np.float32), "Residual": r}
+
+    T().check_output()

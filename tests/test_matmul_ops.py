@@ -1,0 +1,61 @@
+"""mul / matmul tests (cf. reference test_mul_op.py, test_matmul_op.py)."""
+import numpy as np
+
+from op_test import OpTest
+
+rng = np.random.RandomState(3)
+
+
+def test_mul_2d():
+    x = rng.randn(4, 5).astype(np.float32)
+    y = rng.randn(5, 3).astype(np.float32)
+
+    class T(OpTest):
+        op_type = "mul"
+        inputs = {"X": x, "Y": y}
+        attrs = {"x_num_col_dims": 1, "y_num_col_dims": 1}
+        outputs = {"Out": x @ y}
+
+    T().check_output()
+    T().check_grad(["X", "Y"])
+
+
+def test_mul_flatten():
+    x = rng.randn(2, 3, 4).astype(np.float32)
+    y = rng.randn(12, 5).astype(np.float32)
+
+    class T(OpTest):
+        op_type = "mul"
+        inputs = {"X": x, "Y": y}
+        attrs = {"x_num_col_dims": 1, "y_num_col_dims": 1}
+        outputs = {"Out": x.reshape(2, 12) @ y}
+
+    T().check_output()
+    T().check_grad(["X", "Y"])
+
+
+def test_matmul_transpose():
+    x = rng.randn(3, 4).astype(np.float32)
+    y = rng.randn(5, 4).astype(np.float32)
+
+    class T(OpTest):
+        op_type = "matmul"
+        inputs = {"X": x, "Y": y}
+        attrs = {"transpose_X": False, "transpose_Y": True}
+        outputs = {"Out": x @ y.T}
+
+    T().check_output()
+    T().check_grad(["X", "Y"])
+
+
+def test_matmul_batched():
+    x = rng.randn(2, 3, 4).astype(np.float32)
+    y = rng.randn(2, 4, 5).astype(np.float32)
+
+    class T(OpTest):
+        op_type = "matmul"
+        inputs = {"X": x, "Y": y}
+        outputs = {"Out": np.matmul(x, y)}
+
+    T().check_output()
+    T().check_grad(["X", "Y"])

@@ -1,0 +1,126 @@
+"""Optimizer op tests vs numpy reference updates (cf. reference
+test_sgd_op.py, test_adam_op.py, test_momentum_op.py, ...)."""
+import numpy as np
+
+from op_test import OpTest
+
+rng = np.random.RandomState(21)
+
+
+def test_sgd():
+    p = rng.randn(4, 3).astype(np.float32)
+    g = rng.randn(4, 3).astype(np.float32)
+    lr = np.array([0.1], np.float32)
+
+    class T(OpTest):
+        op_type = "sgd"
+        inputs = {"Param": p, "Grad": g, "LearningRate": lr}
+        outputs = {"ParamOut": p - 0.1 * g}
+
+    T().check_output()
+
+
+def test_momentum():
+    p = rng.randn(4).astype(np.float32)
+    g = rng.randn(4).astype(np.float32)
+    v = rng.randn(4).astype(np.float32)
+    lr = np.array([0.01], np.float32)
+    mu = 0.9
+    v_out = mu * v + g
+    p_out = p - 0.01 * v_out
+
+    class T(OpTest):
+        op_type = "momentum"
+        inputs = {"Param": p, "Grad": g, "Velocity": v, "LearningRate": lr}
+        attrs = {"mu": mu, "use_nesterov": False}
+        outputs = {"ParamOut": p_out, "VelocityOut": v_out}
+
+    T().check_output()
+
+
+def test_adam():
+    p = rng.randn(6).astype(np.float32)
+    g = rng.randn(6).astype(np.float32)
+    m1 = rng.rand(6).astype(np.float32)
+    m2 = rng.rand(6).astype(np.float32)
+    b1, b2, eps = 0.9, 0.999, 1e-8
+    b1p = np.array([b1 ** 3], np.float32)
+    b2p = np.array([b2 ** 3], np.float32)
+    lr = np.array([0.001], np.float32)
+    m1o = b1 * m1 + (1 - b1) * g
+    m2o = b2 * m2 + (1 - b2) * g * g
+    lr_t = 0.001 * np.sqrt(1 - b2p[0]) / (1 - b1p[0])
+    po = p - lr_t * m1o / (np.sqrt(m2o) + eps)
+
+    class T(OpTest):
+        op_type = "adam"
+        inputs = {"Param": p, "Grad": g, "Moment1": m1, "Moment2": m2,
+                  "Beta1Pow": b1p, "Beta2Pow": b2p, "LearningRate": lr}
+        attrs = {"beta1": b1, "beta2": b2, "epsilon": eps}
+        outputs = {"ParamOut": po, "Moment1Out": m1o, "Moment2Out": m2o,
+                   "Beta1PowOut": b1p * b1, "Beta2PowOut": b2p * b2}
+
+    T().check_output(atol=1e-5)
+
+
+def test_adagrad():
+    p = rng.randn(5).astype(np.float32)
+    g = rng.randn(5).astype(np.float32)
+    m = np.abs(rng.randn(5)).astype(np.float32)
+    lr = np.array([0.01], np.float32)
+    eps = 1e-6
+    mo = m + g * g
+    po = p - 0.01 * g / (np.sqrt(mo) + eps)
+
+    class T(OpTest):
+        op_type = "adagrad"
+        inputs = {"Param": p, "Grad": g, "Moment": m, "LearningRate": lr}
+        attrs = {"epsilon": eps}
+        outputs = {"ParamOut": po, "MomentOut": mo}
+
+    T().check_output()
+
+
+def test_rmsprop():
+    p = rng.randn(5).astype(np.float32)
+    g = rng.randn(5).astype(np.float32)
+    ms = np.abs(rng.randn(5)).astype(np.float32)
+    mom = rng.randn(5).astype(np.float32)
+    lr = np.array([0.01], np.float32)
+    rho, eps, momentum = 0.9, 1e-10, 0.5
+    ms_o = rho * ms + (1 - rho) * g * g
+    mom_o = momentum * mom + 0.01 * g / np.sqrt(ms_o + eps)
+    p_o = p - mom_o
+
+    class T(OpTest):
+        op_type = "rmsprop"
+        inputs = {"Param": p, "Grad": g, "MeanSquare": ms, "Moment": mom,
+                  "LearningRate": lr}
+        attrs = {"decay": rho, "epsilon": eps, "momentum": momentum}
+        outputs = {"ParamOut": p_o, "MeanSquareOut": ms_o,
+                   "MomentOut": mom_o}
+
+    T().check_output(atol=1e-5)
+
+
+def test_optimizer_accumulators_e2e(prog_scope, exe):
+    """Adam end-to-end: accumulators must update across runs (the executor's
+    persistable write-back, reference test_optimizer.py)."""
+    import paddle_tpu.fluid as fluid
+    main, startup, scope = prog_scope
+    x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+    y = fluid.layers.fc(x, size=1)
+    loss = fluid.layers.mean(y)
+    opt = fluid.optimizer.Adam(learning_rate=0.01)
+    opt.minimize(loss)
+    exe.run(startup)
+    feed = {"x": np.ones((2, 4), np.float32)}
+    exe.run(main, feed=feed, fetch_list=[loss])
+    accs = [v for v in scope.local_var_names() if "beta1_pow" in v]
+    assert accs, "beta1 pow accumulator missing"
+    val1 = float(np.asarray(scope.find_var(accs[0]))[0])
+    exe.run(main, feed=feed, fetch_list=[loss])
+    val2 = float(np.asarray(scope.find_var(accs[0]))[0])
+    # init fill = beta1 (0.9); each step multiplies by beta1
+    assert abs(val1 - 0.81) < 1e-6
+    assert abs(val2 - 0.729) < 1e-6

@@ -1,0 +1,96 @@
+"""ParallelExecutor SPMD tests: loss parity with single-device Executor
+(cf. reference test_parallel_executor_mnist.py comparing PE vs Executor)."""
+import numpy as np
+
+import jax
+import paddle_tpu.fluid as fluid
+
+
+def _build_mnist_mlp():
+    img = fluid.layers.data(name="img", shape=[64], dtype="float32")
+    label = fluid.layers.data(name="label", shape=[1], dtype="int64")
+    hidden = fluid.layers.fc(img, size=32, act="relu")
+    prediction = fluid.layers.fc(hidden, size=10, act="softmax")
+    avg_cost = fluid.layers.mean(
+        fluid.layers.cross_entropy(input=prediction, label=label))
+    return avg_cost
+
+
+def test_pe_matches_single_device(prog_scope):
+    """Same init + same data => PE loss must equal Executor loss, because
+    SPMD data parallelism computes the identical global batch math."""
+    main, startup, scope = prog_scope
+    main.random_seed = 7
+    startup.random_seed = 7
+    avg_cost = _build_mnist_mlp()
+    fluid.optimizer.SGD(learning_rate=0.1).minimize(avg_cost)
+
+    np.random.seed(5)
+    data = []
+    for _ in range(6):
+        xs = np.random.randn(32, 64).astype(np.float32)
+        ys = np.random.randint(0, 10, (32, 1)).astype(np.int64)
+        data.append((xs, ys))
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    single_losses = []
+    for xs, ys in data:
+        loss, = exe.run(main, feed={"img": xs, "label": ys},
+                        fetch_list=[avg_cost])
+        single_losses.append(float(np.asarray(loss).reshape(-1)[0]))
+
+    # fresh scope, same seeds -> same init
+    from paddle_tpu.core.scope import Scope
+    scope2 = Scope()
+    with fluid.scope_guard(scope2):
+        exe2 = fluid.Executor(fluid.CPUPlace())
+        exe2.run(startup)
+        pe = fluid.ParallelExecutor(use_cuda=False,
+                                    loss_name=avg_cost.name,
+                                    main_program=main, scope=scope2)
+        assert pe.device_count == 8, "conftest must force 8 host devices"
+        pe_losses = []
+        for xs, ys in data:
+            loss, = pe.run(fetch_list=[avg_cost],
+                           feed={"img": xs, "label": ys})
+            pe_losses.append(float(np.asarray(loss).reshape(-1)[0]))
+
+    np.testing.assert_allclose(single_losses, pe_losses, rtol=2e-4,
+                               atol=1e-5)
+    assert single_losses[-1] < single_losses[0]
+
+
+def test_pe_batch_divisibility_error(prog_scope):
+    main, startup, scope = prog_scope
+    avg_cost = _build_mnist_mlp()
+    fluid.optimizer.SGD(learning_rate=0.1).minimize(avg_cost)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    pe = fluid.ParallelExecutor(use_cuda=False, loss_name=avg_cost.name,
+                                main_program=main)
+    xs = np.random.randn(30, 64).astype(np.float32)  # 30 % 8 != 0
+    ys = np.random.randint(0, 10, (30, 1)).astype(np.int64)
+    try:
+        pe.run(fetch_list=[avg_cost], feed={"img": xs, "label": ys})
+        raise AssertionError("expected divisibility error")
+    except ValueError as e:
+        assert "divisible" in str(e)
+
+
+def test_pe_per_device_feed_list(prog_scope):
+    """reference PE accepts a list of per-device feed dicts."""
+    main, startup, scope = prog_scope
+    avg_cost = _build_mnist_mlp()
+    fluid.optimizer.SGD(learning_rate=0.1).minimize(avg_cost)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    pe = fluid.ParallelExecutor(use_cuda=False, loss_name=avg_cost.name,
+                                main_program=main)
+    feeds = []
+    for _ in range(pe.device_count):
+        feeds.append({"img": np.random.randn(4, 64).astype(np.float32),
+                      "label": np.random.randint(0, 10, (4, 1))
+                      .astype(np.int64)})
+    loss, = pe.run(fetch_list=[avg_cost], feed=feeds)
+    assert np.isfinite(np.asarray(loss)).all()

@@ -1,0 +1,82 @@
+"""Program IR tests: serialization roundtrip, clone(for_test), prune
+(cf. reference test_program.py, test_protobuf_descs.py)."""
+import numpy as np
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.core.desc import ProgramDesc
+
+
+def _build_net(main, startup):
+    with fluid.program_guard(main, startup), fluid.unique_name.guard():
+        x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+        h = fluid.layers.fc(x, size=8, act="relu")
+        h = fluid.layers.dropout(h, dropout_prob=0.5)
+        y = fluid.layers.fc(h, size=2, act="softmax")
+        loss = fluid.layers.mean(fluid.layers.cross_entropy(
+            y, fluid.layers.data(name="label", shape=[1], dtype="int64")))
+        opt = fluid.optimizer.SGD(0.1)
+        opt.minimize(loss)
+    return x, y, loss
+
+
+def test_serialize_roundtrip():
+    main, startup = fluid.Program(), fluid.Program()
+    _build_net(main, startup)
+    blob = main.serialize_to_string()
+    restored = ProgramDesc.parse_from_string(blob)
+    assert [op.type for op in restored.blocks[0].ops] == \
+        [op.type for op in main.desc.blocks[0].ops]
+    for name, vd in main.desc.blocks[0].vars.items():
+        rd = restored.blocks[0].vars[name]
+        assert rd.shape == vd.shape and rd.dtype == vd.dtype \
+            and rd.persistable == vd.persistable
+
+
+def test_clone_for_test_strips_backward():
+    main, startup = fluid.Program(), fluid.Program()
+    _build_net(main, startup)
+    test_prog = main.clone(for_test=True)
+    types = [op.type for op in test_prog.desc.blocks[0].ops]
+    assert not any(t.endswith("_grad") for t in types)
+    assert "sgd" not in types
+    # dropout flips to test mode
+    d_ops = [op for op in test_prog.desc.blocks[0].ops
+             if op.type == "dropout"]
+    assert d_ops and d_ops[0].attr("is_test") is True
+    # original untouched
+    orig_types = [op.type for op in main.desc.blocks[0].ops]
+    assert any(t.endswith("_grad") for t in orig_types)
+
+
+def test_prune_keeps_only_needed():
+    main, startup = fluid.Program(), fluid.Program()
+    x, y, loss = _build_net(main, startup)
+    pruned = main.clone(for_test=True).prune([y])
+    types = [op.type for op in pruned.desc.blocks[0].ops]
+    assert "cross_entropy" not in types
+    assert "mul" in types
+
+
+def test_program_run_after_mutation_invalidates_cache(prog_scope, exe):
+    """Compile cache keys on (uid, version): editing the program after a run
+    must recompile, not reuse stale XLA."""
+    main, startup, scope = prog_scope
+    x = fluid.layers.data(name="x", shape=[2], dtype="float32")
+    y = fluid.layers.scale(x, scale=2.0)
+    exe.run(startup)
+    out1, = exe.run(main, feed={"x": np.ones((1, 2), np.float32)},
+                    fetch_list=[y])
+    z = fluid.layers.scale(y, scale=3.0)
+    out2, = exe.run(main, feed={"x": np.ones((1, 2), np.float32)},
+                    fetch_list=[z])
+    np.testing.assert_allclose(out1, 2 * np.ones((1, 2)))
+    np.testing.assert_allclose(out2, 6 * np.ones((1, 2)))
+
+
+def test_operator_introspection():
+    main, startup = fluid.Program(), fluid.Program()
+    _build_net(main, startup)
+    op = main.global_block().ops[0]
+    assert op.type == "mul"
+    assert op.input("X") and op.output("Out")
+    assert "x_num_col_dims" in op.attr_names

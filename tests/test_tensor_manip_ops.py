@@ -1,0 +1,233 @@
+"""Tensor-manipulation op tests (cf. reference test_concat_op.py,
+test_reshape_op.py, test_transpose_op.py, test_lookup_table_op.py, ...)."""
+import numpy as np
+
+from op_test import OpTest
+
+rng = np.random.RandomState(9)
+
+
+def test_concat():
+    a = rng.randn(2, 3).astype(np.float32)
+    b = rng.randn(2, 4).astype(np.float32)
+
+    class T(OpTest):
+        op_type = "concat"
+        inputs = {"X": [("a", a), ("b", b)]}
+        attrs = {"axis": 1}
+        outputs = {"Out": np.concatenate([a, b], axis=1)}
+
+    T().check_output()
+    T().check_grad(["a", "b"])
+
+
+def test_split():
+    x = rng.randn(4, 6).astype(np.float32)
+
+    class T(OpTest):
+        op_type = "split"
+        inputs = {"X": x}
+        attrs = {"axis": 1, "num": 0, "sections": [2, 4]}
+        outputs = {"Out": [("o0", x[:, :2]), ("o1", x[:, 2:])]}
+
+    T().check_output()
+
+
+def test_reshape():
+    x = rng.randn(2, 6).astype(np.float32)
+
+    class T(OpTest):
+        op_type = "reshape"
+        inputs = {"X": x}
+        attrs = {"shape": [4, 3]}
+        outputs = {"Out": x.reshape(4, 3)}
+
+    T().check_output()
+    T().check_grad(["X"])
+
+
+def test_reshape_infer():
+    x = rng.randn(2, 6).astype(np.float32)
+
+    class T(OpTest):
+        op_type = "reshape"
+        inputs = {"X": x}
+        attrs = {"shape": [-1, 4]}
+        outputs = {"Out": x.reshape(3, 4)}
+
+    T().check_output()
+
+
+def test_transpose():
+    x = rng.randn(2, 3, 4).astype(np.float32)
+
+    class T(OpTest):
+        op_type = "transpose"
+        inputs = {"X": x}
+        attrs = {"axis": [1, 0, 2]}
+        outputs = {"Out": x.transpose(1, 0, 2)}
+
+    T().check_output()
+    T().check_grad(["X"])
+
+
+def test_lookup_table():
+    w = rng.randn(10, 4).astype(np.float32)
+    ids = np.array([[1], [3], [1], [7]], dtype=np.int64)
+
+    class T(OpTest):
+        op_type = "lookup_table"
+        inputs = {"W": w, "Ids": ids}
+        outputs = {"Out": w[ids[:, 0]]}
+
+    T().check_output()
+    # grad of W is a scatter-add: ids 1 appears twice
+    T().check_grad(["W"])
+
+
+def test_lookup_table_padding_idx():
+    w = rng.randn(6, 3).astype(np.float32)
+    ids = np.array([[0], [2], [5]], dtype=np.int64)
+    expected = w[ids[:, 0]].copy()
+    expected[ids[:, 0] == 2] = 0
+
+    class T(OpTest):
+        op_type = "lookup_table"
+        inputs = {"W": w, "Ids": ids}
+        attrs = {"padding_idx": 2}
+        outputs = {"Out": expected}
+
+    T().check_output()
+
+
+def test_gather():
+    x = rng.randn(5, 3).astype(np.float32)
+    idx = np.array([0, 2, 4], dtype=np.int32)
+
+    class T(OpTest):
+        op_type = "gather"
+        inputs = {"X": x, "Index": idx}
+        outputs = {"Out": x[idx]}
+
+    T().check_output()
+    T().check_grad(["X"])
+
+
+def test_top_k():
+    x = rng.randn(3, 6).astype(np.float32)
+    k = 2
+    idx = np.argsort(-x, axis=1)[:, :k]
+    vals = np.take_along_axis(x, idx, axis=1)
+
+    class T(OpTest):
+        op_type = "top_k"
+        inputs = {"X": x}
+        attrs = {"k": k}
+        outputs = {"Out": vals, "Indices": idx.astype(np.int64)}
+
+    T().check_output()
+
+
+def test_one_hot():
+    x = np.array([[1], [0], [3]], dtype=np.int64)
+    expected = np.zeros((3, 4), np.float32)
+    expected[np.arange(3), x[:, 0]] = 1
+
+    class T(OpTest):
+        op_type = "one_hot"
+        inputs = {"X": x}
+        attrs = {"depth": 4}
+        outputs = {"Out": expected}
+
+    T().check_output()
+
+
+def test_cast():
+    from paddle_tpu.core.types import DataType
+    x = rng.randn(3, 4).astype(np.float32)
+
+    class T(OpTest):
+        op_type = "cast"
+        inputs = {"X": x}
+        attrs = {"in_dtype": DataType.FP32, "out_dtype": DataType.FP64}
+        outputs = {"Out": x.astype(np.float64)}
+
+    T().check_output()
+
+
+def test_expand():
+    x = rng.randn(2, 3).astype(np.float32)
+
+    class T(OpTest):
+        op_type = "expand"
+        inputs = {"X": x}
+        attrs = {"expand_times": [2, 2]}
+        outputs = {"Out": np.tile(x, (2, 2))}
+
+    T().check_output()
+    T().check_grad(["X"])
+
+
+def test_pad():
+    x = rng.randn(2, 3).astype(np.float32)
+
+    class T(OpTest):
+        op_type = "pad"
+        inputs = {"X": x}
+        attrs = {"paddings": [0, 1, 2, 0], "pad_value": 0.5}
+        outputs = {"Out": np.pad(x, ((0, 1), (2, 0)),
+                                 constant_values=0.5)}
+
+    T().check_output()
+
+
+def test_slice():
+    x = rng.randn(4, 5).astype(np.float32)
+
+    class T(OpTest):
+        op_type = "slice"
+        inputs = {"Input": x}
+        attrs = {"axes": [0, 1], "starts": [1, 0], "ends": [3, 4]}
+        outputs = {"Out": x[1:3, 0:4]}
+
+    T().check_output()
+
+
+def test_sum_multi():
+    a = rng.randn(3, 3).astype(np.float32)
+    b = rng.randn(3, 3).astype(np.float32)
+    c = rng.randn(3, 3).astype(np.float32)
+
+    class T(OpTest):
+        op_type = "sum"
+        inputs = {"X": [("sa", a), ("sb", b), ("sc", c)]}
+        outputs = {"Out": a + b + c}
+
+    T().check_output()
+    T().check_grad(["sa", "sb", "sc"])
+
+
+def test_reduce_ops():
+    x = rng.randn(3, 4, 5).astype(np.float32)
+    for op, fn in [("reduce_sum", np.sum), ("reduce_mean", np.mean),
+                   ("reduce_max", np.max)]:
+        class T(OpTest):
+            op_type = op
+            inputs = {"X": x}
+            attrs = {"dim": [1], "keep_dim": False, "reduce_all": False}
+            outputs = {"Out": fn(x, axis=1)}
+
+        T().check_output(atol=1e-5)
+
+
+def test_scale_op():
+    x = rng.randn(3, 4).astype(np.float32)
+
+    class T(OpTest):
+        op_type = "scale"
+        inputs = {"X": x}
+        attrs = {"scale": 2.5}
+        outputs = {"Out": 2.5 * x}
+
+    T().check_output()
+    T().check_grad(["X"])
