@@ -84,11 +84,17 @@ class ExecutorCore:
 
     # ------------------------------------------------------------------
     def _rng_key(self, program, scope):
-        seed = getattr(program, "random_seed", 0) or 0
+        seed, counter = self._rng_counter(program, scope)
+        return jax.random.fold_in(jax.random.PRNGKey(seed), counter)
+
+    def _rng_counter(self, program, scope):
+        """Step counter fed to the compiled fn; the PRNGKey derivation
+        happens inside the jitted computation so no eager dispatches are
+        paid per step."""
         counter = getattr(scope, "_rng_counter", 0)
         scope._rng_counter = counter + 1
-        key = jax.random.PRNGKey(seed)
-        return jax.random.fold_in(key, counter)
+        seed = getattr(program, "random_seed", 0) or 0
+        return np.uint32(seed), np.uint32(counter)
 
     def _run_compiled(self, program, block_id, core_ops, scope, feed,
                       fetch_list, mode):
@@ -116,13 +122,13 @@ class ExecutorCore:
                     val = np.asarray(val, dtype=proto_to_np_dtype(vd.dtype))
                 args.append(jax.device_put(val, target))
             else:
-                val = scope.find_var(name)
-                if entry.input_shardings is not None:
-                    val = jax.device_put(val, target)
-                args.append(val)
-        rng = self._rng_key(program, scope)
+                # Always commit to the target device: mixing committed and
+                # uncommitted arrays across steps would miss jit's C++ cache
+                # and recompile (device_put is a no-op when already there).
+                args.append(jax.device_put(scope.find_var(name), target))
+        seed, counter = self._rng_counter(program, scope)
 
-        fetches, persists = entry.fn(tuple(args), rng)
+        fetches, persists = entry.fn(tuple(args), seed, counter)
         for name, val in zip(entry.persist_outs, persists):
             (scope.find_scope_of(name) or scope).set(name, val)
         if check_nan_inf:
@@ -173,8 +179,9 @@ class ExecutorCore:
 
         ops = list(core_ops)
 
-        def fn(inputs, rng):
+        def fn(inputs, seed, counter):
             env = dict(zip(input_names, inputs))
+            rng = jax.random.fold_in(jax.random.PRNGKey(seed), counter)
             ctx = LoweringContext(program, block_id, env, rng, mode)
             ctx.block = block
             for op in ops:
@@ -190,7 +197,7 @@ class ExecutorCore:
             if n in persist_outs and not _in_feed_only(n, feed, scope))
 
         def fn_flat(*flat_args):
-            return fn(tuple(flat_args[:-1]), flat_args[-1])
+            return fn(tuple(flat_args[:-2]), flat_args[-2], flat_args[-1])
 
         jit_kwargs = {"donate_argnums": donate}
         input_shardings = None
@@ -209,12 +216,12 @@ class ExecutorCore:
                     input_shardings.append(NamedSharding(self.mesh, spec))
                 else:
                     input_shardings.append(repl)
-            jit_kwargs["in_shardings"] = tuple(input_shardings) + (repl,)
+            jit_kwargs["in_shardings"] = tuple(input_shardings) + (repl, repl)
             jit_kwargs["out_shardings"] = repl
         jflat = jax.jit(fn_flat, **jit_kwargs)
 
-        def jfn(inputs, rng):
-            return jflat(*inputs, rng)
+        def jfn(inputs, seed, counter):
+            return jflat(*inputs, seed, counter)
 
         return _CacheEntry(jfn, input_names, persist_outs, tuple(fetch_list),
                            input_shardings)

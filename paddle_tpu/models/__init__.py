@@ -1,0 +1,10 @@
+"""Model zoo: the fluid-benchmark model families.
+
+Parity: reference benchmark/fluid/models/{mnist,resnet,vgg,
+stacked_dynamic_lstm,machine_translation}.py — each module exposes the
+network builder(s) plus a ``get_model(...)`` returning
+(loss, feeds, extra_fetches) built into the current default program.
+"""
+from . import mnist, resnet, vgg  # noqa: F401
+
+__all__ = ["mnist", "resnet", "vgg"]
