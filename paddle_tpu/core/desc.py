@@ -309,6 +309,12 @@ class ProgramDesc:
         _prog_uid[0] += 1
         self.uid = _prog_uid[0]
         self.random_seed = 0
+        # name -> per-dim mesh-axis tuple (e.g. (None, "tp")), consumed by
+        # the executor when compiling under a Mesh.  The TPU-native
+        # replacement for the reference's per-device parameter placement in
+        # multi_devices_graph_builder.cc: instead of assigning whole tensors
+        # to devices, dims are assigned to mesh axes and GSPMD partitions.
+        self.var_shardings = {}
 
     def bump_version(self):
         self.version += 1

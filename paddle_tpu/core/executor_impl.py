@@ -99,8 +99,12 @@ class ExecutorCore:
     def _run_compiled(self, program, block_id, core_ops, scope, feed,
                       fetch_list, mode):
         block = program.blocks[block_id]
+        # NB: use .dtype when present — np.asarray on a jax.Array would be
+        # a blocking device-to-host copy in the hot path.
         feed_spec = tuple(sorted(
-            (name, tuple(np.shape(v)), str(np.asarray(v).dtype))
+            (name, tuple(np.shape(v)),
+             str(v.dtype) if hasattr(v, "dtype") else
+             str(np.asarray(v).dtype))
             for name, v in feed.items()))
         key = (program.uid, program.version, block_id, feed_spec,
                tuple(fetch_list), mode)
@@ -184,6 +188,7 @@ class ExecutorCore:
             rng = jax.random.fold_in(jax.random.PRNGKey(seed), counter)
             ctx = LoweringContext(program, block_id, env, rng, mode)
             ctx.block = block
+            ctx.mesh = self.mesh
             for op in ops:
                 run_op(ctx, op)
             fetches = tuple(env.get(n) for n in fetch_list)
@@ -204,20 +209,30 @@ class ExecutorCore:
         if self.mesh is not None:
             from jax.sharding import NamedSharding, PartitionSpec as P
             repl = NamedSharding(self.mesh, P())
-            input_shardings = []
-            for name in input_names:
+            annotated = getattr(program, "var_shardings", {})
+
+            axis_names = set(self.mesh.axis_names)
+
+            def shard_of(name):
+                if name in annotated:
+                    spec = tuple(a if a in axis_names else None
+                                 for a in annotated[name])
+                    return NamedSharding(self.mesh, P(*spec))
                 vd = block.find_var_recursive(name)
-                batch_sharded = (name in feed and vd is not None
-                                 and len(vd.shape) >= 1
-                                 and vd.shape[0] == -1)
-                if batch_sharded:
-                    spec = P(self.dp_axis,
-                             *([None] * (len(vd.shape) - 1)))
-                    input_shardings.append(NamedSharding(self.mesh, spec))
-                else:
-                    input_shardings.append(repl)
+                if (name in feed and vd is not None and len(vd.shape) >= 1
+                        and vd.shape[0] == -1 and self.dp_axis in axis_names):
+                    return NamedSharding(self.mesh, P(
+                        self.dp_axis, *([None] * (len(vd.shape) - 1))))
+                return repl
+
+            input_shardings = [shard_of(n) for n in input_names]
             jit_kwargs["in_shardings"] = tuple(input_shardings) + (repl, repl)
-            jit_kwargs["out_shardings"] = repl
+            # Fetches come back replicated (they are consumed on host);
+            # written persistables keep their annotated placement so e.g.
+            # tensor-parallel weights never gather.
+            jit_kwargs["out_shardings"] = (
+                tuple(repl for _ in fetch_list),
+                tuple(shard_of(n) for n in persist_outs))
         jflat = jax.jit(fn_flat, **jit_kwargs)
 
         def jfn(inputs, seed, counter):

@@ -84,6 +84,7 @@ class LoweringContext:
         self.env = env                  # name -> traced value
         self.base_key = base_key        # jax PRNG key (traced)
         self.mode = mode                # 'train' | 'test'
+        self.mesh = None                # set by the executor when SPMD
         self._counter = counter or _Counter()
 
     def next_key(self):
@@ -106,8 +107,10 @@ class LoweringContext:
 
     def sub_context(self, block_idx, env):
         """Context for tracing a sub-block (control flow bodies)."""
-        return LoweringContext(self.program, block_idx, env, self.base_key,
-                               self.mode, self._counter)
+        sub = LoweringContext(self.program, block_idx, env, self.base_key,
+                              self.mode, self._counter)
+        sub.mesh = self.mesh
+        return sub
 
 
 def run_ops(ctx):

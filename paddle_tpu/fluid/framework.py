@@ -114,6 +114,19 @@ class Variable:
     def stop_gradient(self, v):
         self.desc.stop_gradient = bool(v)
 
+    def set_sharding(self, spec):
+        """Assign tensor dims to mesh axes, e.g. ``(None, "tp")``.
+        Recorded on the ProgramDesc; the executor maps it to a GSPMD
+        NamedSharding when compiling under a Mesh."""
+        desc = self.block.program.desc
+        desc.var_shardings[self.name] = tuple(spec)
+        desc.bump_version()  # invalidate compiled-executable cache entries
+        return self
+
+    @property
+    def sharding(self):
+        return self.block.program.desc.var_shardings.get(self.name)
+
     def __repr__(self):
         return "<Variable %s shape=%s dtype=%s>" % (self.name, self.shape,
                                                     self.dtype)

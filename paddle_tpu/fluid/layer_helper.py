@@ -103,6 +103,8 @@ class LayerHelper:
             name=attr.name, shape=shape, dtype=dtype,
             trainable=attr.trainable)
         init(startup_param, self.startup_program.global_block())
+        if getattr(attr, "sharding", None) is not None:
+            param.set_sharding(attr.sharding)
         return param
 
     def create_tmp_variable(self, dtype, stop_gradient=False):

@@ -60,7 +60,8 @@ class ParallelExecutor:
     def __init__(self, use_cuda=False, loss_name=None, main_program=None,
                  share_vars_from=None, exec_strategy=None,
                  build_strategy=None, num_trainers=1, trainer_id=0,
-                 scope=None, use_tpu=None, num_devices=None):
+                 scope=None, use_tpu=None, num_devices=None,
+                 mesh_axes=None):
         if use_tpu is None:
             use_tpu = use_cuda  # migration: use_cuda=True means accelerator
         self._program = main_program or default_main_program()
@@ -78,7 +79,15 @@ class ParallelExecutor:
         if num_devices:
             devices = devices[:num_devices]
         self._devices = devices
-        self.mesh = Mesh(np.array(devices), ("dp",))
+        if mesh_axes:
+            # multi-axis mesh, e.g. {"dp": 2, "tp": 4}: parameters carry
+            # per-dim axis annotations (ParamAttr(sharding=...)), feeds
+            # shard over "dp"; GSPMD partitions the whole-step program.
+            from paddle_tpu.parallel.mesh import make_mesh
+            self.mesh = make_mesh(mesh_axes, devices=devices)
+            self._devices = devices = list(self.mesh.devices.flat)
+        else:
+            self.mesh = Mesh(np.array(devices), ("dp",))
         if share_vars_from is not None:
             self._scope = share_vars_from._scope
         self._core = ExecutorCore(place, mesh=self.mesh)
@@ -99,7 +108,7 @@ class ParallelExecutor:
         feed = feed or {}
         names = [f.name if isinstance(f, Variable) else f
                  for f in fetch_list]
-        n = len(self._devices)
+        n = dict(self.mesh.shape).get("dp", 1)  # batch splits over dp only
         for k, v in feed.items():
             bs = np.shape(v)[0] if np.ndim(v) else 0
             if bs % max(n, 1) != 0:

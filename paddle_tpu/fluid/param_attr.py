@@ -9,7 +9,7 @@ __all__ = ["ParamAttr"]
 class ParamAttr:
     def __init__(self, name=None, initializer=None, learning_rate=1.0,
                  regularizer=None, trainable=True, gradient_clip=None,
-                 do_model_average=None):
+                 do_model_average=None, sharding=None):
         self.name = name
         self.initializer = initializer
         self.learning_rate = learning_rate
@@ -17,6 +17,10 @@ class ParamAttr:
         self.trainable = trainable
         self.gradient_clip = gradient_clip
         self.do_model_average = do_model_average
+        # Per-dim mesh-axis placement, e.g. (None, "tp") shards the second
+        # dim over the tensor-parallel axis.  TPU-native addition (no
+        # reference analog: GPU placement was whole-tensor, per-device).
+        self.sharding = sharding
 
     @staticmethod
     def to_attr(arg):

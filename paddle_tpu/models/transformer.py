@@ -1,0 +1,130 @@
+"""Transformer language model — the attention-era flagship.
+
+Reference coverage: the machine_translation/seq2seq-attention configs
+(benchmark/fluid/models/machine_translation.py, tests book
+machine_translation) are RNN+attention; this model family is their
+TPU-first successor, built so every parallel axis of the mesh is
+exercised inside ONE fluid program:
+
+- dp  : batch sharding of feeds (ParallelExecutor).
+- tp  : column/row-parallel qkv/out/ffn weights via
+        ParamAttr(sharding=...); heads stay independent.
+- sp  : ring attention over the sequence axis (paddle_tpu.parallel.ring)
+        through the ``ring_attention`` op.
+- ep  : expert-parallel MoE FFN blocks through the ``moe_ffn`` op.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid.param_attr import ParamAttr
+
+__all__ = ["transformer_lm", "get_model"]
+
+
+def _attn_block(x, d_model, n_head, tp, sp, prefix):
+    ln = fluid.layers.layer_norm(x, begin_norm_axis=2)
+    head_dim = d_model // n_head
+    wattr = (lambda: ParamAttr(sharding=(None, "tp"))) if tp else \
+        (lambda: None)
+    qkv = []
+    for nm in ("q", "k", "v"):
+        h = fluid.layers.fc(ln, size=d_model, num_flatten_dims=2,
+                            param_attr=wattr(), bias_attr=False,
+                            name="%s_%s" % (prefix, nm))
+        h = fluid.layers.reshape(h, [0, 0, n_head, head_dim])
+        qkv.append(fluid.layers.transpose(h, [0, 2, 1, 3]))  # [B,H,S,Dh]
+    q, k, v = qkv
+
+    helper = fluid.layer_helper.LayerHelper(prefix + "_ring")
+    att = helper.create_tmp_variable(x.dtype)
+    helper.append_op(
+        type="ring_attention", inputs={"Q": [q], "K": [k], "V": [v]},
+        outputs={"Out": [att]},
+        attrs={"causal": True, "sp_axis": "sp" if sp else "",
+               "batch_axis": "dp", "head_axis": "tp" if tp else ""})
+    att = fluid.layers.transpose(att, [0, 2, 1, 3])
+    att = fluid.layers.reshape(att, [0, 0, d_model])
+    out = fluid.layers.fc(
+        att, size=d_model, num_flatten_dims=2,
+        param_attr=ParamAttr(sharding=("tp", None)) if tp else None,
+        name=prefix + "_o")
+    return fluid.layers.elementwise_add(x, out)
+
+
+def _ffn_block(x, d_model, d_ff, tp, prefix):
+    ln = fluid.layers.layer_norm(x, begin_norm_axis=2)
+    h = fluid.layers.fc(
+        ln, size=d_ff, num_flatten_dims=2, act="relu",
+        param_attr=ParamAttr(sharding=(None, "tp")) if tp else None,
+        name=prefix + "_fc1")
+    h = fluid.layers.fc(
+        h, size=d_model, num_flatten_dims=2,
+        param_attr=ParamAttr(sharding=("tp", None)) if tp else None,
+        name=prefix + "_fc2")
+    return fluid.layers.elementwise_add(x, h)
+
+
+def _moe_block(x, d_model, d_ff, n_experts, ep, prefix):
+    ln = fluid.layers.layer_norm(x, begin_norm_axis=2)
+    router = fluid.layers.create_parameter(
+        [d_model, n_experts], "float32", name=prefix + "_router")
+    eattr = (ParamAttr(sharding=("ep", None, None), name=prefix + "_w1")
+             if ep else ParamAttr(name=prefix + "_w1"))
+    e2attr = (ParamAttr(sharding=("ep", None, None), name=prefix + "_w2")
+              if ep else ParamAttr(name=prefix + "_w2"))
+    w1 = fluid.layers.create_parameter([n_experts, d_model, d_ff],
+                                       "float32", attr=eattr)
+    w2 = fluid.layers.create_parameter([n_experts, d_ff, d_model],
+                                       "float32", attr=e2attr)
+    helper = fluid.layer_helper.LayerHelper(prefix + "_moe")
+    out = helper.create_tmp_variable(x.dtype)
+    helper.append_op(
+        type="moe_ffn",
+        inputs={"X": [ln], "RouterW": [router], "W1": [w1], "W2": [w2]},
+        outputs={"Out": [out]},
+        attrs={"ep_axis": "ep" if ep else "", "dp_axis": "dp",
+               "capacity_factor": 2.0})
+    return fluid.layers.elementwise_add(x, out)
+
+
+def transformer_lm(src, vocab_size, max_len, d_model=256, n_head=8,
+                   n_layers=4, d_ff=1024, tp=False, sp=False,
+                   moe_experts=0, ep=False):
+    """src: [B, S] int64 token ids -> logits [B, S, vocab_size]."""
+    emb = fluid.layers.embedding(src, (vocab_size, d_model))
+    pos = fluid.layers.create_parameter([max_len, d_model], "float32",
+                                        name="pos_emb")
+    x = fluid.layers.elementwise_add(emb, pos, axis=1)
+    if sp:
+        from paddle_tpu.parallel.api import sharding_constraint
+        x = sharding_constraint(x, ("dp", "sp", None))
+    for i in range(n_layers):
+        x = _attn_block(x, d_model, n_head, tp, sp, "blk%d" % i)
+        if moe_experts and i % 2 == 1:
+            x = _moe_block(x, d_model, d_ff, moe_experts, ep,
+                           "blk%d" % i)
+        else:
+            x = _ffn_block(x, d_model, d_ff, tp, "blk%d" % i)
+    x = fluid.layers.layer_norm(x, begin_norm_axis=2)
+    logits = fluid.layers.fc(x, size=vocab_size, num_flatten_dims=2,
+                             name="lm_head")
+    return logits
+
+
+def get_model(vocab_size=1000, seq_len=64, batch_size=None, d_model=256,
+              n_head=8, n_layers=4, d_ff=1024, learning_rate=1e-3,
+              tp=False, sp=False, moe_experts=0, ep=False):
+    """(avg_cost, [src, label], []) — next-token LM loss."""
+    src = fluid.layers.data(name="src", shape=[seq_len], dtype="int64")
+    label = fluid.layers.data(name="label", shape=[seq_len, 1],
+                              dtype="int64")
+    logits = transformer_lm(src, vocab_size, seq_len, d_model, n_head,
+                            n_layers, d_ff, tp=tp, sp=sp,
+                            moe_experts=moe_experts, ep=ep)
+    loss = fluid.layers.softmax_with_cross_entropy(logits, label)
+    avg_cost = fluid.layers.mean(loss)
+    opt = fluid.optimizer.Adam(learning_rate=learning_rate)
+    opt.minimize(avg_cost)
+    return avg_cost, [src, label], []

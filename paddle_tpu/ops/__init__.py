@@ -13,4 +13,5 @@ from paddle_tpu.ops import (  # noqa: F401
     optimizer_ops,
     io_ops,
     metric,
+    parallel_ops,
 )

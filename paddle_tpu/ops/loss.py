@@ -24,8 +24,8 @@ def _cross_entropy(ctx, ins, attrs, op):
         loss = -jnp.sum(label * jnp.log(jnp.maximum(x, _TOL)), axis=-1,
                         keepdims=True)
     else:
-        idx = label.reshape(-1).astype(jnp.int32)
-        picked = jnp.take_along_axis(x, idx[:, None], axis=-1)
+        idx = _hard_label_idx(label, x.ndim)
+        picked = jnp.take_along_axis(x, idx, axis=-1)
         loss = -jnp.log(jnp.maximum(picked, _TOL))
     return {"Y": loss}
 
@@ -40,10 +40,19 @@ def _softmax_with_ce(ctx, ins, attrs, op):
     if attrs.get("soft_label", False):
         loss = -jnp.sum(label * log_softmax, axis=-1, keepdims=True)
     else:
-        idx = label.reshape(-1).astype(jnp.int32)
-        picked = jnp.take_along_axis(log_softmax, idx[:, None], axis=-1)
+        idx = _hard_label_idx(label, logits.ndim)
+        picked = jnp.take_along_axis(log_softmax, idx, axis=-1)
         loss = -picked
     return {"Softmax": softmax, "Loss": loss}
+
+
+def _hard_label_idx(label, logits_ndim):
+    """Label [..., 1] (or [...]) -> int index tensor with logits' rank,
+    so N-d logits (e.g. [B, S, V] LM heads) work."""
+    idx = label.astype(jnp.int32)
+    if idx.ndim < logits_ndim:
+        idx = idx[..., None]
+    return idx
 
 
 @register_op("sigmoid_cross_entropy_with_logits")

@@ -1,0 +1,87 @@
+"""SPMD annotation ops.
+
+TPU-native additions with no per-op reference analog: the reference placed
+whole tensors on devices and moved data with NCCL op handles
+(details/*_op_handle.cc); here placement is expressed as mesh-axis
+annotations inside the compiled program and GSPMD inserts the collectives.
+"""
+from __future__ import annotations
+
+import jax
+
+from paddle_tpu.core.registry import register_op
+
+
+@register_op("sharding_constraint")
+def _sharding_constraint_lower(ctx, ins, attrs, op=None):
+    x = ins["X"]
+    if ctx.mesh is None:
+        return {"Out": x}
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    spec = tuple(a if a and a in ctx.mesh.axis_names else None
+                 for a in attrs.get("spec", ()))
+    spec = spec[:x.ndim]
+    sharding = NamedSharding(ctx.mesh, P(*spec))
+    return {"Out": jax.lax.with_sharding_constraint(x, sharding)}
+
+
+def _axis_or_none(mesh, name):
+    return name if (name and mesh is not None
+                    and name in mesh.axis_names
+                    and dict(mesh.shape)[name] > 1) else None
+
+
+@register_op("ring_attention")
+def _ring_attention_lower(ctx, ins, attrs, op=None):
+    """Scaled-dot-product attention, sequence-parallel when compiled under
+    a mesh with the configured sp axis; dense otherwise.  Q/K/V: [B,H,S,D].
+    """
+    import jax.numpy as jnp
+
+    q, k, v = ins["Q"], ins["K"], ins["V"]
+    causal = bool(attrs.get("causal", True))
+    sp_axis = _axis_or_none(ctx.mesh, attrs.get("sp_axis", "sp"))
+    if sp_axis is not None:
+        from paddle_tpu.parallel.ring import ring_attention
+        out = ring_attention(
+            q, k, v, ctx.mesh, axis_name=sp_axis, causal=causal,
+            batch_axis=_axis_or_none(ctx.mesh, attrs.get("batch_axis", "dp")),
+            head_axis=_axis_or_none(ctx.mesh, attrs.get("head_axis", "tp")))
+        return {"Out": out}
+    scale = q.shape[-1] ** -0.5
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
+    if causal:
+        sq, sk = q.shape[2], k.shape[2]
+        mask = jnp.arange(sq)[:, None] >= jnp.arange(sk)[None, :]
+        s = jnp.where(mask[None, None], s, -jnp.inf)
+    return {"Out": jnp.einsum("bhqk,bhkd->bhqd",
+                              jax.nn.softmax(s, axis=-1), v)}
+
+
+@register_op("moe_ffn")
+def _moe_ffn_lower(ctx, ins, attrs, op=None):
+    """Top-1 mixture-of-experts FFN; expert-parallel over the ep axis when
+    compiled under a mesh, dense-dispatch otherwise.  X: [T, D] or
+    [B, S, D] (flattened internally)."""
+    import jax.numpy as jnp
+
+    x, wg = ins["X"], ins["RouterW"]
+    w1, w2 = ins["W1"], ins["W2"]
+    shape = x.shape
+    x2 = x.reshape(-1, shape[-1])
+    ep_axis = _axis_or_none(ctx.mesh, attrs.get("ep_axis", "ep"))
+    if ep_axis is not None:
+        from paddle_tpu.parallel.moe import moe_ffn
+        out = moe_ffn(x2, wg, w1, w2, ctx.mesh, axis_name=ep_axis,
+                      dp_axis=_axis_or_none(ctx.mesh,
+                                            attrs.get("dp_axis", "dp")),
+                      capacity_factor=float(
+                          attrs.get("capacity_factor", 2.0)))
+    else:
+        gates = jax.nn.softmax(x2 @ wg, axis=-1)
+        expert = jnp.argmax(gates, axis=-1)
+        gate = jnp.take_along_axis(gates, expert[:, None], axis=1)[:, 0]
+        h = jax.nn.relu(jnp.einsum("td,edf->tef", x2, w1))
+        y = jnp.einsum("tef,efd->ted", h, w2)
+        out = y[jnp.arange(x2.shape[0]), expert] * gate[:, None]
+    return {"Out": out.reshape(shape)}

@@ -1,0 +1,25 @@
+"""Parallelism package — the TPU-native counterpart of the reference's
+multi-device machinery (framework/details/ SSA graph + NCCL op handles,
+transpiler/distribute_transpiler.py).
+
+Three levels:
+
+- ``mesh``   : device-mesh construction helpers (axes: dp/tp/pp/sp/ep).
+- ``api``    : sharding annotations on fluid programs (parameters via
+               ParamAttr(sharding=...) / Variable.set_sharding, activations
+               via sharding_constraint op) — compiled by GSPMD, which
+               inserts the collectives the reference implemented as
+               AllReduce/Broadcast/Gather op handles.
+- ``ring`` / ``pipeline`` / ``moe``: explicit shard_map strategies for the
+  parts GSPMD cannot express alone — ring attention (sequence/context
+  parallelism), GPipe-style pipeline parallelism, expert parallelism.
+"""
+from .mesh import make_mesh, auto_mesh_axes  # noqa: F401
+from .api import shard_var, sharding_constraint  # noqa: F401
+from .ring import ring_attention  # noqa: F401
+from .pipeline import pipeline_apply  # noqa: F401
+from .moe import moe_ffn  # noqa: F401
+
+__all__ = ["make_mesh", "auto_mesh_axes", "shard_var",
+           "sharding_constraint", "ring_attention", "pipeline_apply",
+           "moe_ffn"]

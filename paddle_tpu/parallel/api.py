@@ -1,0 +1,23 @@
+"""Sharding annotations on fluid programs."""
+from __future__ import annotations
+
+from paddle_tpu.fluid.layer_helper import LayerHelper
+
+__all__ = ["shard_var", "sharding_constraint"]
+
+
+def shard_var(var, spec):
+    """Pin a variable's dims to mesh axes, e.g. shard_var(w, (None, "tp"))."""
+    return var.set_sharding(spec)
+
+
+def sharding_constraint(x, spec, name=None):
+    """In-graph activation sharding constraint (the GSPMD escape hatch;
+    becomes jax.lax.with_sharding_constraint under a Mesh, identity
+    otherwise)."""
+    helper = LayerHelper("sharding_constraint", **locals())
+    out = helper.create_tmp_variable(x.dtype)
+    helper.append_op(type="sharding_constraint", inputs={"X": [x]},
+                     outputs={"Out": [out]},
+                     attrs={"spec": [a if a else "" for a in spec]})
+    return out

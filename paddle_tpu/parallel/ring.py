@@ -1,0 +1,75 @@
+"""Ring attention: sequence/context parallelism over a mesh axis.
+
+Long-context story (SURVEY §5.7: the reference's is LoDTensor ragged
+batching — it predates sequence parallelism; this is the first-class
+TPU-native mechanism).  Q/K/V live sharded on the sequence dim over the
+``sp`` axis; each device computes attention of its Q shard against one K/V
+shard at a time with an online-softmax accumulator while K/V blocks rotate
+around the ring via ppermute over ICI — compute overlaps the collective
+and the full S×S score matrix never materializes.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+__all__ = ["ring_attention"]
+
+
+def _ring_attention_shard(q, k, v, axis_name, causal, scale):
+    """Per-shard body under shard_map.  q,k,v: [B, H, S_local, D]."""
+    p = lax.psum(1, axis_name)
+    my = lax.axis_index(axis_name)
+    sq = q.shape[2]
+    sk = k.shape[2]
+    qpos = my * sq + jnp.arange(sq)  # global positions of local queries
+
+    def step(carry, j):
+        k_blk, v_blk, m, num, den = carry
+        src = (my - j) % p  # which shard this K/V block came from
+        s = jnp.einsum("bhqd,bhkd->bhqk", q, k_blk) * scale
+        if causal:
+            kpos = src * sk + jnp.arange(sk)
+            mask = qpos[:, None] >= kpos[None, :]
+            s = jnp.where(mask[None, None], s, -jnp.inf)
+        blk_max = jnp.max(s, axis=-1, keepdims=True)
+        new_m = jnp.maximum(m, blk_max)
+        # new_m can stay -inf for fully-masked rows; keep exp() finite
+        safe_m = jnp.where(jnp.isneginf(new_m), 0.0, new_m)
+        corr = jnp.exp(jnp.where(jnp.isneginf(m), -jnp.inf, m) - safe_m)
+        e = jnp.exp(s - safe_m)
+        num = num * corr + jnp.einsum("bhqk,bhkd->bhqd", e, v_blk)
+        den = den * corr + jnp.sum(e, axis=-1, keepdims=True)
+        perm = [(i, (i + 1) % p) for i in range(p)]
+        k_nxt = lax.ppermute(k_blk, axis_name, perm)
+        v_nxt = lax.ppermute(v_blk, axis_name, perm)
+        return (k_nxt, v_nxt, new_m, num, den), None
+
+    # derive inits from q so their varying-axes match the step outputs
+    # regardless of which mesh axes q is sharded over
+    m0 = jnp.full_like(q[..., :1], -jnp.inf)
+    num0 = jnp.zeros_like(q)
+    den0 = jnp.zeros_like(q[..., :1])
+    (k, v, m, num, den), _ = lax.scan(step, (k, v, m0, num0, den0),
+                                      jnp.arange(p))
+    return num / jnp.maximum(den, 1e-20)
+
+
+def ring_attention(q, k, v, mesh, axis_name="sp", causal=True, scale=None,
+                   batch_axis=None, head_axis=None):
+    """q,k,v: [B, H, S, D] global; S sharded over ``axis_name`` (B over
+    ``batch_axis``, H over ``head_axis`` — tensor parallelism composes for
+    free since heads are independent).  Returns [B, H, S, D] with the same
+    sharding.  Differentiable (jax re-derives the reverse ring through the
+    scan)."""
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    spec = P(batch_axis, head_axis, axis_name, None)
+    fn = functools.partial(_ring_attention_shard, axis_name=axis_name,
+                           causal=causal, scale=scale)
+    return jax.shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
+                         out_specs=spec)(q, k, v)
