@@ -29,6 +29,26 @@ from .types import proto_to_np_dtype, VarKind
 # Flag parity: FLAGS_check_nan_inf (reference framework/operator.cc:590).
 check_nan_inf = False
 
+LEN_SUFFIX = "@LEN"
+# pad ragged batches' time dim up to a multiple of this so the number of
+# distinct compiled shapes stays bounded (bucketing)
+LOD_PAD_MULTIPLE = 8
+
+
+def _prepare_lod_feeds(feed):
+    """LoDTensor feeds -> padded dense array + '<name>@LEN' lengths."""
+    from .lod import LoDTensor
+
+    for name, v in list(feed.items()):
+        if isinstance(v, LoDTensor) and v.lod:
+            lens = v.sequence_lengths(0)
+            t = max(lens) if lens else 1
+            t = -(-max(t, 1) // LOD_PAD_MULTIPLE) * LOD_PAD_MULTIPLE
+            padded, lengths = v.to_padded(max_len=t)
+            feed[name] = padded
+            feed[name + LEN_SUFFIX] = lengths.astype(np.int32)
+    return feed
+
 
 class _CacheEntry:
     __slots__ = ("fn", "input_names", "persist_outs", "fetch_names",
@@ -59,7 +79,7 @@ class ExecutorCore:
     # ------------------------------------------------------------------
     def run(self, program, scope, block_id=0, feed=None, fetch_list=None,
             mode="train", return_numpy=True):
-        feed = feed or {}
+        feed = _prepare_lod_feeds(dict(feed or {}))
         fetch_list = list(fetch_list or [])
         block = program.blocks[block_id]
 
@@ -163,6 +183,13 @@ class ExecutorCore:
             if name and name not in written and name not in seen_ext:
                 seen_ext.add(name)
                 external.append(name)
+        # ragged feeds travel as (padded, lengths) pairs: pull in the
+        # device-side length vector of every LoD input (SURVEY §5.7 —
+        # ragged->dense bucketing bridge to XLA static shapes)
+        for name in list(external):
+            if name + LEN_SUFFIX in feed and name + LEN_SUFFIX not in seen_ext:
+                seen_ext.add(name + LEN_SUFFIX)
+                external.append(name + LEN_SUFFIX)
 
         input_names = []
         for name in external:

@@ -92,6 +92,15 @@ class LoweringContext:
         self._counter.n += 1
         return jax.random.fold_in(self.base_key, self._counter.n)
 
+    def seq_len_of(self, name):
+        """Device-side [N] lengths of a ragged (LoD) value, or None when
+        the value is dense.  Lengths enter as '<feed>@LEN' arrays and are
+        propagated across shape-preserving ops by run_op."""
+        return self.env.get(name + "@LEN")
+
+    def set_seq_len(self, name, lengths):
+        self.env[name + "@LEN"] = lengths
+
     def var_desc(self, name):
         blk = self.block
         while blk is not None:
@@ -127,6 +136,31 @@ def run_op(ctx, op):
     attrs = {k: a.value for k, a in op.attrs.items()}
     outs = info.lower(ctx, ins, attrs, op)
     _scatter_outputs(ctx.env, op, outs)
+    if not getattr(info, "seq_aware", False):
+        _propagate_seq_lens(ctx, op)
+
+
+def _propagate_seq_lens(ctx, op):
+    """Carry '<name>@LEN' across ops that keep the [N, T, ...] leading
+    layout (embedding/fc/activation/elementwise chains), the padded-batch
+    analog of the reference's ShareLoD in InferShape."""
+    lens = None
+    src = None
+    for n in op.input_arg_names():
+        if n and n + "@LEN" in ctx.env:
+            lens = ctx.env[n + "@LEN"]
+            src = ctx.env.get(n)
+            break
+    if lens is None or src is None or getattr(src, "ndim", 0) < 2:
+        return
+    lead = src.shape[:2]
+    for n in op.output_arg_names():
+        if not n or n + "@LEN" in ctx.env:
+            continue
+        val = ctx.env.get(n)
+        if getattr(val, "ndim", 0) >= 2 and tuple(val.shape[:2]) == \
+                tuple(lead):
+            ctx.env[n + "@LEN"] = lens
 
 
 def _gather_inputs(env, op):
@@ -207,11 +241,17 @@ def generic_grad_lower(ctx, ins, attrs, op):
     # (dropout &c.) register custom grad lowerings instead.
     sub_ctx = ctx  # shares the key counter; deterministic ops ignore it
 
+    # View exposing the forward op's input names (slots the grad op shares)
+    # so lowerings that consult names — e.g. sequence ops reading
+    # '<input>@LEN' — behave identically under differentiation.
+    fwd_op_view = _FwdOpView(
+        fwd_type, {s: list(op.inputs.get(s, [])) for s in fwd_input_slots})
+
     def fwd(p):
         merged = {s: list(v) for s, v in const_ins.items()}
         for (slot, i), val in p.items():
             merged[slot][i] = val
-        outs = info.lower(sub_ctx, Ins(merged), dict(attrs), None)
+        outs = info.lower(sub_ctx, Ins(merged), dict(attrs), fwd_op_view)
         flat = {}
         for s in fwd_output_slots:
             v = outs.get(s)
@@ -243,6 +283,23 @@ def generic_grad_lower(ctx, ins, attrs, op):
             vals.append(grads.get((base, i)) if n != EMPTY_VAR else None)
         result[gslot] = vals
     return result
+
+
+class _FwdOpView:
+    """Minimal OpDesc stand-in handed to forward lowerings during vjp."""
+
+    __slots__ = ("type", "inputs", "outputs")
+
+    def __init__(self, type_, inputs):
+        self.type = type_
+        self.inputs = inputs
+        self.outputs = {}
+
+    def input_arg_names(self):
+        return [n for ns in self.inputs.values() for n in ns]
+
+    def output_arg_names(self):
+        return []
 
 
 def _is_float(x):

@@ -21,11 +21,12 @@ from __future__ import annotations
 
 class OpInfo:
     __slots__ = ("type", "lower", "grad_maker", "grad_lower", "infer_shape",
-                 "host_op", "stateful", "wrt", "no_vjp_outputs")
+                 "host_op", "stateful", "wrt", "no_vjp_outputs", "seq_aware")
 
     def __init__(self, type_, lower=None, grad_maker="default",
                  grad_lower=None, infer_shape=None, host_op=False,
-                 stateful=False, wrt=None, no_vjp_outputs=()):
+                 stateful=False, wrt=None, no_vjp_outputs=(),
+                 seq_aware=False):
         self.type = type_
         self.lower = lower
         # "default" -> generic maker; None -> non-differentiable; callable -> custom
@@ -38,6 +39,8 @@ class OpInfo:
         self.wrt = wrt
         # output slots excluded from vjp (integer/aux outputs)
         self.no_vjp_outputs = tuple(no_vjp_outputs)
+        # op manages sequence lengths itself (no automatic @LEN propagation)
+        self.seq_aware = seq_aware
 
 
 _registry = {}

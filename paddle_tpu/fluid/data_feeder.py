@@ -71,10 +71,11 @@ class DataFeeder:
                 raise TypeError("feed_list entries must be Variables")
             self.feed_names.append(each_var.name)
             self.feed_lod_level.append(each_var.lod_level)
-            shape = [d for d in each_var.shape if d >= 0]
-            # drop leading batch dim
-            if each_var.shape and each_var.shape[0] == -1:
-                shape = list(each_var.shape[1:])
+            shape = list(each_var.shape)
+            if shape and shape[0] == -1:   # drop batch dim
+                shape = shape[1:]
+            if each_var.lod_level > 0 and shape and shape[0] == -1:
+                shape = shape[1:]          # drop padded time dim too
             self.feed_shapes.append(shape)
             self.feed_dtypes.append(each_var.dtype)
         self.place = place

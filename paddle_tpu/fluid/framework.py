@@ -342,10 +342,33 @@ class Block:
         self.ops.append(op)
         if infer_shape:
             self._infer_and_set_shapes(op_desc, outputs)
+        self._share_lod(inputs, outputs)
         # record producing op on output Variables
         for slot, vs in _iter_vars(outputs):
             vs.op = op
         return op
+
+    def _share_lod(self, inputs, outputs):
+        """Build-time LoD propagation (reference ShareLoD in per-op
+        InferShape): outputs keeping an input's leading [N, T] layout
+        inherit its lod_level; the executor propagates the runtime
+        lengths the same way (core/lowering._propagate_seq_lens)."""
+        src = None
+        for _, v in _iter_vars(inputs):
+            if isinstance(v, Variable) and v.lod_level > 0 \
+                    and len(v.shape) >= 2:
+                src = v
+                break
+        if src is None:
+            return
+        lead = src.shape[:2]
+        for _, v in _iter_vars(outputs):
+            if not isinstance(v, Variable) or v.lod_level > 0:
+                continue
+            shp = v.shape
+            if len(shp) >= 2 and all(a == b
+                                     for a, b in zip(shp[:2], lead)):
+                v.desc.lod_level = src.lod_level
 
     def _infer_and_set_shapes(self, op_desc, outputs):
         """Abstract-evaluate the lowering to set output VarDesc shapes

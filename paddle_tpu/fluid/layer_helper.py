@@ -90,7 +90,8 @@ class LayerHelper:
             return None
         attr = ParamAttr.to_attr(attr)
         if attr.name is None:
-            attr.name = unique_name.generate(".".join([self.name, "w"]))
+            attr.name = unique_name.generate(
+                ".".join([self.name, "b" if is_bias else "w"]))
         init = attr.initializer or default_initializer
         if init is None:
             init = (ConstantInitializer(0.0) if is_bias

@@ -17,7 +17,11 @@ def data(name, shape, append_batch_size=True, dtype="float32", lod_level=0,
     helper = LayerHelper("data", name=name)
     shape = list(shape)
     if append_batch_size:
-        shape = [-1] + shape
+        # Ragged (LoD) feeds arrive padded [N, T, ...]: a time dim is
+        # inserted after batch (the executor pairs the data with a
+        # '<name>@LEN' length vector — core/executor_impl.py).  The
+        # reference packs to [sum_T, ...] instead (lod_tensor.h:58).
+        shape = [-1] * (1 + (1 if lod_level > 0 else 0)) + shape
     return helper.create_global_variable(
         name=name, shape=shape, dtype=dtype, lod_level=lod_level,
         stop_gradient=stop_gradient)

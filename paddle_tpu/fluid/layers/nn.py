@@ -42,15 +42,20 @@ def fc(input, size, num_flatten_dims=1, param_attr=None, bias_attr=None,
     mul_results = []
     for input_var, param_attr_ in helper.iter_inputs_and_params():
         input_shape = input_var.shape
+        nfd = num_flatten_dims
+        if input_var.lod_level > 0 and nfd == 1:
+            # ragged input is padded [N, T, ...]: default fc is per-token,
+            # like the reference's fc on packed [sum_T, D] LoD tensors
+            nfd = max(1, len(input_shape) - 1)
         param_shape = [
-            int(np.prod(input_shape[num_flatten_dims:]))] + [size]
+            int(np.prod(input_shape[nfd:]))] + [size]
         w = helper.create_parameter(attr=param_attr_, shape=param_shape,
                                     dtype=dtype)
         tmp = helper.create_tmp_variable(dtype)
         helper.append_op(
             type="mul", inputs={"X": [input_var], "Y": [w]},
             outputs={"Out": [tmp]},
-            attrs={"x_num_col_dims": num_flatten_dims,
+            attrs={"x_num_col_dims": nfd,
                    "y_num_col_dims": 1})
         mul_results.append(tmp)
     if len(mul_results) == 1:
@@ -59,7 +64,7 @@ def fc(input, size, num_flatten_dims=1, param_attr=None, bias_attr=None,
         pre_bias = helper.create_tmp_variable(dtype)
         helper.append_op(type="sum", inputs={"X": mul_results},
                          outputs={"Out": [pre_bias]})
-    pre_act = helper.append_bias_op(pre_bias, dim_start=num_flatten_dims)
+    pre_act = helper.append_bias_op(pre_bias, dim_start=-1)
     return helper.append_activation(pre_act)
 
 

@@ -5,6 +5,8 @@ stacked_dynamic_lstm,machine_translation}.py — each module exposes the
 network builder(s) plus a ``get_model(...)`` returning
 (loss, feeds, extra_fetches) built into the current default program.
 """
-from . import mnist, resnet, vgg  # noqa: F401
+from . import (mnist, resnet, vgg, transformer,  # noqa: F401
+               stacked_dynamic_lstm, machine_translation)
 
-__all__ = ["mnist", "resnet", "vgg"]
+__all__ = ["mnist", "resnet", "vgg", "transformer",
+           "stacked_dynamic_lstm", "machine_translation"]

@@ -14,4 +14,5 @@ from paddle_tpu.ops import (  # noqa: F401
     io_ops,
     metric,
     parallel_ops,
+    sequence,
 )

@@ -1,0 +1,210 @@
+"""Control-flow operators.
+
+Parity: reference operators/{compare_op,logical_op,conditional_block_op,
+while_op,recurrent_op,is_empty_op,increment_op}.cc.  The reference runs
+sub-blocks imperatively against step scopes (STEP_SCOPES vars); here a
+sub-block is traced functionally and handed to the XLA structured
+control-flow primitive (lax.cond / lax.while_loop / lax.scan), so
+gradients fall out of jax.vjp instead of hand-built *_grad blocks —
+while_grad's stacked-memory machinery (SURVEY hard part #4) is subsumed
+by scan's native differentiability.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.core.registry import register_op
+
+
+def _cmp(name, fn):
+    def lower(ctx, ins, attrs, op=None):
+        return {"Out": fn(ins["X"], ins["Y"])}
+    lower.__name__ = "_" + name
+    register_op(name, lower=lower, grad_maker=None)
+
+
+_cmp("less_than", lambda x, y: x < y)
+_cmp("less_equal", lambda x, y: x <= y)
+_cmp("greater_than", lambda x, y: x > y)
+_cmp("greater_equal", lambda x, y: x >= y)
+_cmp("equal", lambda x, y: x == y)
+_cmp("not_equal", lambda x, y: x != y)
+
+
+def _logical(name, fn, binary=True):
+    def lower(ctx, ins, attrs, op=None):
+        if binary:
+            return {"Out": fn(ins["X"], ins["Y"])}
+        return {"Out": fn(ins["X"])}
+    lower.__name__ = "_" + name
+    register_op(name, lower=lower, grad_maker=None)
+
+
+_logical("logical_and", jnp.logical_and)
+_logical("logical_or", jnp.logical_or)
+_logical("logical_xor", jnp.logical_xor)
+_logical("logical_not", jnp.logical_not, binary=False)
+
+
+@register_op("increment", grad_maker=None)
+def _increment(ctx, ins, attrs, op=None):
+    return {"Out": ins["X"] + attrs.get("step", 1.0)}
+
+
+@register_op("is_empty", grad_maker=None)
+def _is_empty(ctx, ins, attrs, op=None):
+    x = ins["X"]
+    return {"Out": jnp.asarray([int(np.prod(x.shape)) == 0])}
+
+
+def _trace_block(ctx, block_idx, env):
+    from paddle_tpu.core.lowering import run_ops
+    sub = ctx.sub_context(block_idx, env)
+    run_ops(sub)
+    return env
+
+
+@register_op("conditional_block")
+def _conditional_block(ctx, ins, attrs, op=None):
+    """Scalar-condition sub-block -> lax.cond (reference
+    conditional_block_op.cc).  Inputs: Cond [1] bool, Input = every
+    outer var the block reads (so grads flow); Out = outer vars the
+    block writes.  When an Out var has no prior value, the false branch
+    yields zeros of the block-computed shape."""
+    cond = ins.list("Cond")[0]
+    sub_idx = int(attrs["sub_block"])
+    in_names = [n for n in (op.inputs.get("Input") or []) if n]
+    in_vals = [v for v in ins.list("Input")]
+    out_names = [n for n in (op.outputs.get("Out") or []) if n]
+    prior = [ctx.env.get(n) for n in out_names]
+
+    def true_fn(operands):
+        in_vals, prior = operands
+        env = dict(zip(in_names, in_vals))
+        _trace_block(ctx, sub_idx, env)
+        return tuple(
+            env[n] if n in env else
+            (p if p is not None else jnp.zeros(()))
+            for n, p in zip(out_names, prior))
+
+    def false_fn(operands):
+        in_vals, prior = operands
+        if any(p is None for p in prior):
+            shapes = jax.eval_shape(true_fn, operands)
+            return tuple(p if p is not None else jnp.zeros(s.shape, s.dtype)
+                         for p, s in zip(prior, shapes))
+        return tuple(prior)
+
+    cond_scalar = jnp.reshape(cond, ()).astype(bool)
+    outs = jax.lax.cond(cond_scalar, true_fn, false_fn,
+                        (tuple(in_vals), tuple(prior)))
+    return {"Out": list(outs)}
+
+
+@register_op("while")
+def _while(ctx, ins, attrs, op=None):
+    """while-loop (reference while_op.cc): Condition [1] bool; X = loop
+    vars (read+written by the block); sub-block recomputes Condition.
+    Lowered to lax.while_loop — NOT differentiable (XLA While has no
+    vjp); use StaticRNN/DynamicRNN (the scan-lowered ``recurrent`` op)
+    for trainable recurrence, as the reference's own RNN layers do."""
+    sub_idx = int(attrs["sub_block"])
+    cond_name = (op.inputs.get("Condition") or [None])[0]
+    x_names = [n for n in (op.inputs.get("X") or []) if n]
+    x_vals = list(ins.list("X"))
+    cond0 = ins.list("Condition")[0]
+
+    def cond_fn(carry):
+        c, _ = carry
+        return jnp.reshape(c, ()).astype(bool)
+
+    def body_fn(carry):
+        c, xs = carry
+        env = dict(zip(x_names, xs))
+        env[cond_name] = c
+        _trace_block(ctx, sub_idx, env)
+        return (env[cond_name], tuple(env[n] for n in x_names))
+
+    _, outs = jax.lax.while_loop(cond_fn, body_fn,
+                                 (cond0, tuple(x_vals)))
+    return {"Out": list(outs)}
+
+
+@register_op("recurrent", seq_aware=True)
+def _recurrent(ctx, ins, attrs, op=None):
+    """Step a sub-block over the time axis with lax.scan — the TPU-native
+    backend of StaticRNN/DynamicRNN (reference recurrent_op.cc:636 /
+    while-op DynamicRNN, layers/control_flow.py:383,1313).
+
+    Inputs
+      Inputs      sequence tensors [N, T, ...] (sliced to [N, ...]/step)
+      InitStates  initial memory values, one per state
+      Parameters  every outer var the block reads (weights) — declared
+                  explicitly so jax.vjp reaches them
+    Attrs
+      sub_block, step_input_names, state_in_names, state_out_names,
+      step_output_names, masked (freeze states & zero outputs past each
+      sequence's length, from the first input's @LEN vector)
+    Outputs
+      Outputs     stacked step outputs [N, T, ...]
+      FinalStates last state values [N, ...]
+    """
+    sub_idx = int(attrs["sub_block"])
+    step_in_names = list(attrs.get("step_input_names", []))
+    st_in_names = list(attrs.get("state_in_names", []))
+    st_out_names = list(attrs.get("state_out_names", []))
+    out_names = list(attrs.get("step_output_names", []))
+    masked = bool(attrs.get("masked", False))
+    param_names = [n for n in (op.inputs.get("Parameters") or []) if n]
+
+    xs = [v for v in ins.list("Inputs")]
+    inits = [v for v in ins.list("InitStates")]
+    params = [v for v in ins.list("Parameters")]
+
+    lens = None
+    if masked and op is not None:
+        src_names = op.inputs.get("Inputs") or []
+        if src_names and src_names[0]:
+            lens = ctx.seq_len_of(src_names[0])
+    n, t = xs[0].shape[0], xs[0].shape[1]
+    if lens is None:
+        mask_t = jnp.ones((t, n), xs[0].dtype if jnp.issubdtype(
+            jnp.result_type(xs[0]), jnp.floating) else jnp.float32)
+    else:
+        mask_t = (jnp.arange(t)[:, None] < lens[None, :]).astype(
+            jnp.float32)
+
+    xs_t = [jnp.moveaxis(x, 1, 0) for x in xs]       # time-major
+
+    def step(states, xm):
+        xts, mt = xm
+        env = dict(zip(param_names, params))
+        env.update(zip(step_in_names, xts))
+        env.update(zip(st_in_names, states))
+        _trace_block(ctx, sub_idx, env)
+        new_states = tuple(env[n] for n in st_out_names)
+        if masked:
+            kept = []
+            for s_new, s_old in zip(new_states, states):
+                m = mt.reshape((n,) + (1,) * (s_new.ndim - 1))
+                kept.append(m * s_new + (1 - m) * s_old)
+            new_states = tuple(kept)
+        outs = []
+        for nm in out_names:
+            o = env[nm]
+            if masked:
+                o = o * mt.reshape((n,) + (1,) * (o.ndim - 1))
+            outs.append(o)
+        return new_states, tuple(outs)
+
+    final_states, stacked = jax.lax.scan(step, tuple(inits),
+                                         (tuple(xs_t), mask_t))
+    outputs = [jnp.moveaxis(o, 0, 1) for o in stacked]
+    result = {"Outputs": outputs, "FinalStates": list(final_states)}
+    if lens is not None and op is not None:
+        for nm in (op.outputs.get("Outputs") or []):
+            if nm:
+                ctx.set_seq_len(nm, lens)
+    return result

@@ -27,7 +27,7 @@ def _cross_entropy(ctx, ins, attrs, op):
         idx = _hard_label_idx(label, x.ndim)
         picked = jnp.take_along_axis(x, idx, axis=-1)
         loss = -jnp.log(jnp.maximum(picked, _TOL))
-    return {"Y": loss}
+    return {"Y": _mask_padded(ctx, op, "X", loss)}
 
 
 @register_op("softmax_with_cross_entropy")
@@ -43,7 +43,22 @@ def _softmax_with_ce(ctx, ins, attrs, op):
         idx = _hard_label_idx(label, logits.ndim)
         picked = jnp.take_along_axis(log_softmax, idx, axis=-1)
         loss = -picked
-    return {"Softmax": softmax, "Loss": loss}
+    return {"Softmax": softmax,
+            "Loss": _mask_padded(ctx, op, "Logits", loss)}
+
+
+def _mask_padded(ctx, op, slot, loss):
+    """Zero the per-token loss at padded positions of a ragged input (the
+    packed reference never sees padding, cross_entropy_op.cc)."""
+    if op is None:
+        return loss
+    names = op.inputs.get(slot) or []
+    lens = ctx.seq_len_of(names[0]) if names and names[0] else None
+    if lens is None or loss.ndim < 2:
+        return loss
+    mask = (jnp.arange(loss.shape[1])[None, :] <
+            lens[:, None]).astype(loss.dtype)
+    return loss * mask.reshape(mask.shape + (1,) * (loss.ndim - 2))
 
 
 def _hard_label_idx(label, logits_ndim):

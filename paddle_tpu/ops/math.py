@@ -166,9 +166,25 @@ def _sum(ctx, ins, attrs, op):
     return {"Out": out}
 
 
-@register_op("mean")
+@register_op("mean", seq_aware=True)
 def _mean(ctx, ins, attrs, op):
-    return {"Out": jnp.mean(ins["X"]).reshape((1,))}
+    """Mean over all elements; over VALID elements for ragged inputs (the
+    reference averages over sum_T packed tokens — lod_tensor.h:58 — so a
+    padded batch must not count its padding)."""
+    x = ins["X"]
+    lens = None
+    if op is not None:
+        names = op.inputs.get("X") or []
+        if names and names[0]:
+            lens = ctx.seq_len_of(names[0])
+    if lens is not None and x.ndim >= 2:
+        mask = (jnp.arange(x.shape[1])[None, :] <
+                lens[:, None]).astype(x.dtype)
+        mask = mask.reshape(mask.shape + (1,) * (x.ndim - 2))
+        denom = jnp.sum(mask) * float(np.prod(x.shape[2:]) or 1.0)
+        return {"Out": (jnp.sum(x * mask) /
+                        jnp.maximum(denom, 1.0)).reshape((1,))}
+    return {"Out": jnp.mean(x).reshape((1,))}
 
 
 @register_op("minus")

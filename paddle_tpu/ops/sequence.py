@@ -1,0 +1,447 @@
+"""Sequence (LoD) operators on the padded-batch representation.
+
+Parity: reference operators/sequence_*_op.cc, lstm_op.cc, gru_op.cc.  The
+reference stores ragged batches packed ([sum_T, D] + offset table) and
+walks them with hand-written CPU/CUDA kernels; here a ragged batch is a
+padded dense [N, T, D] block plus a device-side length vector
+('<name>@LEN', see core/executor_impl._prepare_lod_feeds) so every op is
+a static-shape masked XLA computation — recurrences are lax.scan over the
+time axis (one compiled loop on the MXU instead of per-step kernel
+launches, SURVEY §5.7).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.core.registry import register_op
+
+_ACTS = {
+    "sigmoid": jax.nn.sigmoid,
+    "tanh": jnp.tanh,
+    "relu": jax.nn.relu,
+    "identity": lambda x: x,
+    "": lambda x: x,
+}
+
+
+def _act(name):
+    return _ACTS[name]
+
+
+def _lens_of(ctx, op, slot):
+    if op is None:
+        return None
+    names = op.inputs.get(slot) or []
+    if names and names[0]:
+        return ctx.seq_len_of(names[0])
+    return None
+
+
+def _mask(lens, n, t, dtype=jnp.float32):
+    """[N, T] 1/0 validity mask; all-ones when lens is None."""
+    if lens is None:
+        return jnp.ones((n, t), dtype)
+    return (jnp.arange(t)[None, :] < lens[:, None]).astype(dtype)
+
+
+def _reverse_time(x, lens):
+    """Reverse each sequence within its own length (padding stays put) —
+    reference is_reverse semantics for packed batches."""
+    if lens is None:
+        return jnp.flip(x, axis=1)
+    t = x.shape[1]
+    tt = jnp.arange(t)[None, :]
+    idx = jnp.where(tt < lens[:, None], lens[:, None] - 1 - tt, tt)
+    idx = idx.reshape(idx.shape + (1,) * (x.ndim - 2))
+    return jnp.take_along_axis(x, jnp.broadcast_to(idx, x.shape), axis=1)
+
+
+# ---------------------------------------------------------------------------
+# Recurrent ops
+# ---------------------------------------------------------------------------
+
+@register_op("lstm", no_vjp_outputs=("BatchGate", "BatchCellPreAct"))
+def _lstm(ctx, ins, attrs, op=None):
+    """LSTM over a padded batch (reference lstm_op.cc:180 equations).
+
+    Input [N,T,4H] (pre-projected x), Weight [H,4H] with gate columns
+    ordered [c~, i, f, o] (reference math/detail/lstm_kernel.h memory
+    layout), Bias [1,4H] or [1,7H] with peephole vectors checkI/checkF/
+    checkO appended (use_peepholes).  Outputs Hidden/Cell [N,T,H].
+    """
+    x = ins["Input"]
+    w = ins["Weight"]
+    b = ins.get("Bias")
+    h0 = ins.get("H0")
+    c0 = ins.get("C0")
+    lens = _lens_of(ctx, op, "Input")
+    n, t, h4 = x.shape
+    h = h4 // 4
+    rev = bool(attrs.get("is_reverse", False))
+    peep = bool(attrs.get("use_peepholes", True)) and b is not None \
+        and b.shape[-1] == 7 * h
+    gate_act = _act(attrs.get("gate_activation", "sigmoid"))
+    cell_act = _act(attrs.get("cell_activation", "tanh"))
+    cand_act = _act(attrs.get("candidate_activation", "tanh"))
+
+    if b is not None:
+        x = x + b[..., : 4 * h].reshape(1, 1, 4 * h)
+    if peep:
+        ck_i, ck_f, ck_o = jnp.split(b[0, 4 * h:], 3)
+    if rev:
+        x = _reverse_time(x, lens)
+
+    mask = _mask(lens, n, t, x.dtype)
+    h_prev = h0 if h0 is not None else jnp.zeros((n, h), x.dtype)
+    c_prev = c0 if c0 is not None else jnp.zeros((n, h), x.dtype)
+
+    def step(carry, xm):
+        h_prev, c_prev = carry
+        xt, mt = xm                       # [N,4H], [N]
+        g = xt + h_prev @ w
+        cand, gi, gf, go = jnp.split(g, 4, axis=-1)
+        if peep:
+            gi = gi + c_prev * ck_i
+            gf = gf + c_prev * ck_f
+        i = gate_act(gi)
+        f = gate_act(gf)
+        c = f * c_prev + i * cand_act(cand)
+        if peep:
+            go = go + c * ck_o
+        o = gate_act(go)
+        hh = o * cell_act(c)
+        mt = mt[:, None]
+        c = mt * c + (1 - mt) * c_prev
+        hh = mt * hh
+        h_keep = mt * hh + (1 - mt) * h_prev
+        return (h_keep, c), (hh, c)
+
+    (_, _), (hs, cs) = jax.lax.scan(
+        step, (h_prev, c_prev),
+        (jnp.swapaxes(x, 0, 1), jnp.swapaxes(mask, 0, 1)))
+    hidden = jnp.swapaxes(hs, 0, 1)
+    cell = jnp.swapaxes(cs, 0, 1)
+    if rev:
+        hidden = _reverse_time(hidden, lens)
+        cell = _reverse_time(cell, lens)
+    return {"Hidden": hidden, "Cell": cell}
+
+
+@register_op("gru")
+def _gru(ctx, ins, attrs, op=None):
+    """GRU over a padded batch (reference gru_op.cc:129-142):
+    u = act_gate(x_u + h W_u), r = act_gate(x_r + h W_r),
+    h~ = act(x_c + (r*h) W_c), h_t = (1-u)*h_{t-1} + u*h~.
+    Input [N,T,3D]; Weight [D,3D] = [W_u | W_r | W_c]; Bias [1,3D]."""
+    x = ins["Input"]
+    w = ins["Weight"]
+    b = ins.get("Bias")
+    h0 = ins.get("H0")
+    lens = _lens_of(ctx, op, "Input")
+    n, t, d3 = x.shape
+    d = d3 // 3
+    rev = bool(attrs.get("is_reverse", False))
+    gate_act = _act(attrs.get("gate_activation", "sigmoid"))
+    act = _act(attrs.get("activation", "tanh"))
+    wu, wr, wc = w[:, :d], w[:, d: 2 * d], w[:, 2 * d:]
+
+    if b is not None:
+        x = x + b.reshape(1, 1, d3)
+    if rev:
+        x = _reverse_time(x, lens)
+    mask = _mask(lens, n, t, x.dtype)
+    h_prev = h0 if h0 is not None else jnp.zeros((n, d), x.dtype)
+
+    def step(h_prev, xm):
+        xt, mt = xm
+        xu, xr, xc = jnp.split(xt, 3, axis=-1)
+        u = gate_act(xu + h_prev @ wu)
+        r = gate_act(xr + h_prev @ wr)
+        cand = act(xc + (r * h_prev) @ wc)
+        hh = (1 - u) * h_prev + u * cand
+        mt = mt[:, None]
+        h_keep = mt * hh + (1 - mt) * h_prev
+        return h_keep, mt * hh
+
+    _, hs = jax.lax.scan(
+        step, h_prev, (jnp.swapaxes(x, 0, 1), jnp.swapaxes(mask, 0, 1)))
+    hidden = jnp.swapaxes(hs, 0, 1)
+    if rev:
+        hidden = _reverse_time(hidden, lens)
+    return {"Hidden": hidden}
+
+
+@register_op("lstm_unit")
+def _lstm_unit(ctx, ins, attrs, op=None):
+    """Single-step LSTM cell (reference lstm_unit_op.cc): X [N,4H] pre-
+    activation gates (order [c~, i, f, o]), C_prev [N,H]."""
+    x, c_prev = ins["X"], ins["C_prev"]
+    forget_bias = float(attrs.get("forget_bias", 0.0))
+    h = c_prev.shape[-1]
+    cand, gi, gf, go = jnp.split(x, 4, axis=-1)
+    i = jax.nn.sigmoid(gi)
+    f = jax.nn.sigmoid(gf + forget_bias)
+    o = jax.nn.sigmoid(go)
+    c = f * c_prev + i * jnp.tanh(cand)
+    return {"C": c, "H": o * jnp.tanh(c)}
+
+
+@register_op("gru_unit")
+def _gru_unit(ctx, ins, attrs, op=None):
+    """Single-step GRU cell (reference gru_unit_op.cc)."""
+    x = ins["Input"]             # [N,3D]
+    h_prev = ins["HiddenPrev"]   # [N,D]
+    w = ins["Weight"]            # [D,3D]
+    b = ins.get("Bias")
+    d = h_prev.shape[-1]
+    if b is not None:
+        x = x + b.reshape(1, -1)
+    gate_act = _act({1: "sigmoid", 2: "tanh", 0: "identity", 3: "relu"}.get(
+        attrs.get("gate_activation", 1), "sigmoid")
+        if isinstance(attrs.get("gate_activation", 1), int)
+        else attrs.get("gate_activation", "sigmoid"))
+    act = _act({1: "sigmoid", 2: "tanh", 0: "identity", 3: "relu"}.get(
+        attrs.get("activation", 2), "tanh")
+        if isinstance(attrs.get("activation", 2), int)
+        else attrs.get("activation", "tanh"))
+    xu, xr, xc = jnp.split(x, 3, axis=-1)
+    u = gate_act(xu + h_prev @ w[:, :d])
+    r = gate_act(xr + h_prev @ w[:, d: 2 * d])
+    cand = act(xc + (r * h_prev) @ w[:, 2 * d:])
+    gate = jnp.concatenate([u, r, cand], axis=-1)
+    hidden = (1 - u) * h_prev + u * cand
+    return {"Gate": gate, "ResetHiddenPrev": r * h_prev, "Hidden": hidden}
+
+
+# ---------------------------------------------------------------------------
+# Sequence manipulation ops
+# ---------------------------------------------------------------------------
+
+@register_op("sequence_pool", seq_aware=True,
+             no_vjp_outputs=("MaxIndex",))
+def _sequence_pool(ctx, ins, attrs, op=None):
+    """Pool each sequence to one vector (reference sequence_pool_op.cc):
+    SUM/AVERAGE/SQRT/MAX/LAST/FIRST.  [N,T,D] -> [N,D]."""
+    x = ins["X"]
+    lens = _lens_of(ctx, op, "X")
+    ptype = attrs.get("pooltype", "AVERAGE").upper()
+    n, t = x.shape[:2]
+    mask = _mask(lens, n, t, x.dtype)
+    mshape = mask.shape + (1,) * (x.ndim - 2)
+    m = mask.reshape(mshape)
+    counts = (jnp.sum(mask, axis=1).reshape((n,) + (1,) * (x.ndim - 2))
+              if lens is not None else jnp.full((n,) + (1,) * (x.ndim - 2),
+                                                t, x.dtype))
+    outs = {}
+    if ptype == "SUM":
+        out = jnp.sum(x * m, axis=1)
+    elif ptype == "AVERAGE":
+        out = jnp.sum(x * m, axis=1) / jnp.maximum(counts, 1)
+    elif ptype == "SQRT":
+        out = jnp.sum(x * m, axis=1) / jnp.sqrt(jnp.maximum(counts, 1))
+    elif ptype == "MAX":
+        neg = jnp.finfo(x.dtype).min
+        masked = jnp.where(m > 0, x, neg)
+        out = jnp.max(masked, axis=1)
+        outs["MaxIndex"] = jnp.argmax(masked, axis=1).astype(jnp.int32)
+    elif ptype == "LAST":
+        idx = (jnp.maximum(lens - 1, 0) if lens is not None
+               else jnp.full((n,), t - 1))
+        out = jnp.take_along_axis(
+            x, idx.reshape((n, 1) + (1,) * (x.ndim - 2)).astype(jnp.int32),
+            axis=1)[:, 0]
+    elif ptype == "FIRST":
+        out = x[:, 0]
+    else:
+        raise ValueError("unknown pooltype %r" % ptype)
+    outs["Out"] = out
+    if "MaxIndex" not in outs:  # slot always declared by the layer
+        outs["MaxIndex"] = jnp.zeros((n,) + x.shape[2:], jnp.int32)
+    return outs
+
+
+@register_op("sequence_softmax", seq_aware=True)
+def _sequence_softmax(ctx, ins, attrs, op=None):
+    """Softmax within each sequence over the time axis, masked."""
+    x = ins["X"]
+    lens = _lens_of(ctx, op, "X")
+    n, t = x.shape[:2]
+    mask = _mask(lens, n, t, x.dtype).reshape(
+        (n, t) + (1,) * (x.ndim - 2))
+    neg = jnp.finfo(x.dtype).min
+    e = jnp.exp(x - jnp.max(jnp.where(mask > 0, x, neg), axis=1,
+                            keepdims=True))
+    e = e * mask
+    out = e / jnp.maximum(jnp.sum(e, axis=1, keepdims=True), 1e-20)
+    if op is not None and op.outputs.get("Out") and lens is not None:
+        ctx.set_seq_len(op.outputs["Out"][0], lens)
+    return {"Out": out}
+
+
+@register_op("sequence_expand", seq_aware=True)
+def _sequence_expand(ctx, ins, attrs, op=None):
+    """Broadcast per-sequence vectors over the time steps of a reference
+    ragged batch (reference sequence_expand_op.cc): X [N,D] + Y [N,T,..]
+    -> [N,T,D] masked by Y's lengths."""
+    x, y = ins["X"], ins["Y"]
+    lens = _lens_of(ctx, op, "Y")
+    t = y.shape[1]
+    out = jnp.broadcast_to(x[:, None], (x.shape[0], t) + x.shape[1:])
+    m = _mask(lens, x.shape[0], t, x.dtype).reshape(
+        (x.shape[0], t) + (1,) * (x.ndim - 1))
+    out = out * m
+    if op is not None and op.outputs.get("Out") and lens is not None:
+        ctx.set_seq_len(op.outputs["Out"][0], lens)
+    return {"Out": out}
+
+
+@register_op("sequence_conv", seq_aware=True)
+def _sequence_conv(ctx, ins, attrs, op=None):
+    """Context-window convolution over time (reference
+    sequence_conv_op.cc): X [N,T,D], Filter [ctx_len*D, F]."""
+    x = ins["X"]
+    filt = ins["Filter"]
+    lens = _lens_of(ctx, op, "X")
+    ctx_len = int(attrs.get("contextLength", 3))
+    ctx_start = int(attrs.get("contextStart", -(ctx_len // 2)))
+    n, t, d = x.shape
+    m = _mask(lens, n, t, x.dtype)[..., None]
+    xm = x * m
+    cols = []
+    for k in range(ctx_len):
+        shift = ctx_start + k
+        cols.append(jnp.roll(xm, -shift, axis=1) * _shift_valid(
+            n, t, shift, x.dtype))
+    col = jnp.concatenate(cols, axis=-1)          # [N,T,ctx*D]
+    out = col @ filt
+    if op is not None and op.outputs.get("Out") and lens is not None:
+        ctx.set_seq_len(op.outputs["Out"][0], lens)
+    return {"Out": out * m}
+
+
+def _shift_valid(n, t, shift, dtype):
+    """Validity of positions after shifting by `shift` (zero padding
+    outside [0, T))."""
+    tt = jnp.arange(t)[None, :, None]
+    src = tt + shift
+    return ((src >= 0) & (src < t)).astype(dtype)
+
+
+@register_op("sequence_erase", seq_aware=True)
+def _sequence_erase(ctx, ins, attrs, op=None):
+    """Remove listed tokens and compact each sequence left (reference
+    sequence_erase_op.cc).  X [N,T] (or [N,T,1]) int tokens."""
+    x = ins["X"]
+    lens = _lens_of(ctx, op, "X")
+    tokens = attrs.get("tokens", [])
+    squeeze = x.ndim == 3 and x.shape[-1] == 1
+    ids = x[..., 0] if squeeze else x
+    n, t = ids.shape
+    valid = _mask(lens, n, t, jnp.bool_)
+    keep = valid
+    for tok in tokens:
+        keep = keep & (ids != tok)
+    # stable left-compaction: sort by (dropped, position)
+    order = jnp.argsort(jnp.where(keep, 0, 1) * t + jnp.arange(t)[None, :],
+                        axis=1)
+    gathered = jnp.take_along_axis(ids, order, axis=1)
+    new_lens = jnp.sum(keep, axis=1).astype(jnp.int32)
+    pos_ok = jnp.arange(t)[None, :] < new_lens[:, None]
+    out = jnp.where(pos_ok, gathered, 0)
+    if squeeze:
+        out = out[..., None]
+    if op is not None and op.outputs.get("Out"):
+        ctx.set_seq_len(op.outputs["Out"][0], new_lens)
+    return {"Out": out}
+
+
+@register_op("seq_cross_attention", seq_aware=True)
+def _seq_cross_attention(ctx, ins, attrs, op=None):
+    """Dot-product cross attention with key-side length masking — the
+    batched static-shape form of the reference's per-step attention inside
+    DynamicRNN (book machine_translation: sequence_expand + sequence_
+    softmax over encoder states).  Q [N,Tq,D], K/V [N,Tk,D]."""
+    q, k, v = ins["Q"], ins["K"], ins["V"]
+    klens = _lens_of(ctx, op, "K")
+    scale = float(attrs.get("scale", 0.0)) or q.shape[-1] ** -0.5
+    s = jnp.einsum("nqd,nkd->nqk", q, k) * scale
+    if klens is not None:
+        mask = jnp.arange(k.shape[1])[None, None, :] < klens[:, None, None]
+        s = jnp.where(mask, s, jnp.finfo(s.dtype).min)
+    w = jax.nn.softmax(s, axis=-1)
+    out = jnp.einsum("nqk,nkd->nqd", w, v)
+    if op is not None and op.outputs.get("Out"):
+        qlens = _lens_of(ctx, op, "Q")
+        if qlens is not None:
+            ctx.set_seq_len(op.outputs["Out"][0], qlens)
+    return {"Out": out}
+
+
+@register_op("lod_reset", seq_aware=True)
+def _lod_reset(ctx, ins, attrs, op=None):
+    """Reassign sequence lengths from attr target_lod (offsets) or a
+    second input (reference lod_reset_op.cc)."""
+    x = ins["X"]
+    y = ins.get("Y")
+    if y is not None:
+        lens = y.astype(jnp.int32)
+    else:
+        offs = list(attrs.get("target_lod", []))
+        lens = jnp.asarray(np.diff(np.asarray(offs, np.int64)),
+                           jnp.int32) if offs else None
+    if op is not None and op.outputs.get("Out") and lens is not None:
+        ctx.set_seq_len(op.outputs["Out"][0], lens)
+    return {"Out": x}
+
+
+@register_op("edit_distance", grad_maker=None, seq_aware=True)
+def _edit_distance(ctx, ins, attrs, op=None):
+    """Levenshtein distance per (hypothesis, reference) pair via a
+    lax.scan DP (reference edit_distance_op.cc).  Hyps [N,T1], Refs
+    [N,T2] int tokens with @LEN lengths."""
+    hyp, ref = ins["Hyps"], ins["Refs"]
+    hlens = _lens_of(ctx, op, "Hyps")
+    rlens = _lens_of(ctx, op, "Refs")
+    norm = bool(attrs.get("normalized", False))
+    h = hyp[..., 0] if hyp.ndim == 3 else hyp
+    r = ref[..., 0] if ref.ndim == 3 else ref
+    n, t1 = h.shape
+    t2 = r.shape[1]
+    if hlens is None:
+        hlens = jnp.full((n,), t1, jnp.int32)
+    if rlens is None:
+        rlens = jnp.full((n,), t2, jnp.int32)
+
+    # DP rows over hypothesis tokens; mask positions beyond lengths.
+    row0 = jnp.broadcast_to(jnp.arange(t2 + 1, dtype=jnp.float32)[None],
+                            (n, t2 + 1))
+    row0 = jnp.minimum(row0, rlens[:, None].astype(jnp.float32))
+
+    def step(row, i):
+        # row: [N, T2+1] distances for prefix length i of hyp
+        sub = row[:, :-1] + (h[:, i][:, None] != r).astype(jnp.float32)
+        first = row[:, 0] + 1.0
+
+        def col(carry, j):
+            prev = carry
+            cand = jnp.minimum(jnp.minimum(row[:, j + 1] + 1.0, prev + 1.0),
+                               sub[:, j])
+            return cand, cand
+
+        _, cols = jax.lax.scan(col, first, jnp.arange(t2))
+        new = jnp.concatenate([first[:, None], jnp.swapaxes(cols, 0, 1)],
+                              axis=1)
+        # only advance rows that are within this hyp's length
+        active = (i < hlens)[:, None]
+        row = jnp.where(active, new, row)
+        return row, None
+
+    row, _ = jax.lax.scan(step, row0, jnp.arange(t1))
+    dist = jnp.take_along_axis(row, rlens[:, None].astype(jnp.int32),
+                               axis=1)
+    seq_num = jnp.asarray(n, jnp.int64)
+    if norm:
+        dist = dist / jnp.maximum(rlens[:, None].astype(jnp.float32), 1.0)
+    return {"Out": dist.astype(jnp.float32), "SequenceNum": seq_num}

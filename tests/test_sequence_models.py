@@ -1,0 +1,50 @@
+"""End-to-end ragged-sequence models (reference book tests:
+understand_sentiment stacked-lstm, machine_translation)."""
+import numpy as np
+
+import paddle_tpu.fluid as fluid
+
+
+def test_stacked_dynamic_lstm(prog_scope, exe):
+    from paddle_tpu.models.stacked_dynamic_lstm import get_model
+    main, startup, scope = prog_scope
+    loss, feeds, (acc,) = get_model(dict_dim=100, emb_dim=16,
+                                    hidden_dim=32, stacked_num=2,
+                                    learning_rate=5e-3)
+    exe.run(startup)
+    feeder = fluid.DataFeeder(feeds, program=main)
+    rng = np.random.RandomState(0)
+    ls = []
+    for _ in range(40):
+        batch = []
+        for _ in range(16):
+            y = rng.randint(0, 2)
+            L = rng.randint(3, 12)
+            toks = rng.randint(0, 50, L) + (50 if y else 0)
+            batch.append(([int(t) for t in toks], [y]))
+        l, = exe.run(main, feed=feeder.feed(batch), fetch_list=[loss])
+        ls.append(float(l[0]))
+    assert ls[-1] < 0.35, (ls[0], ls[-1])
+
+
+def test_machine_translation_copy_task(prog_scope, exe):
+    from paddle_tpu.models.machine_translation import get_model
+    main, startup, scope = prog_scope
+    loss, feeds, _ = get_model(src_dict_dim=60, trg_dict_dim=60,
+                               emb_dim=32, hidden_dim=32,
+                               learning_rate=5e-3)
+    exe.run(startup)
+    feeder = fluid.DataFeeder(feeds, program=main)
+    rng = np.random.RandomState(0)
+    ls = []
+    for _ in range(60):
+        batch = []
+        for _ in range(8):
+            L = rng.randint(3, 10)
+            src = rng.randint(2, 58, L).tolist()
+            trg = [1] + src[:-1]
+            batch.append((src, trg, src))
+        l, = exe.run(main, feed=feeder.feed(batch), fetch_list=[loss])
+        ls.append(float(l[0]))
+    # steady convergence on the copy task
+    assert ls[-1] < ls[0] - 0.25, (ls[0], ls[-1])

@@ -1,0 +1,235 @@
+"""Sequence (LoD) ops on the padded representation vs numpy references.
+
+Mirrors reference tests/unittests/test_lstm_op.py, test_gru_op.py,
+test_seq_pool.py, test_sequence_softmax_op.py, test_sequence_erase_op.py,
+test_edit_distance_op.py — adapted to padded batches + length vectors.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.core.lod import LoDTensor
+
+
+def _run_seq_op(prog_scope, exe, build, feeds, fetch):
+    main, startup, scope = prog_scope
+    outs = build()
+    exe.run(startup)
+    vals = exe.run(main, feed=feeds, fetch_list=fetch(outs))
+    return vals
+
+
+def _lod(data, lens, dtype=np.float32):
+    """Build a LoDTensor from a padded [N,T,...] array + lengths."""
+    parts = [data[i, :l] for i, l in enumerate(lens)]
+    flat = np.concatenate(parts, 0).astype(dtype)
+    offs = np.concatenate([[0], np.cumsum(lens)]).tolist()
+    return LoDTensor(flat, [offs])
+
+
+def test_sequence_pool_types(prog_scope, exe):
+    main, startup, scope = prog_scope
+    x = fluid.layers.data(name="x", shape=[4], lod_level=1,
+                          dtype="float32")
+    outs = {t: fluid.layers.sequence_pool(x, t)
+            for t in ["sum", "average", "sqrt", "max", "last", "first"]}
+    exe.run(startup)
+    rng = np.random.RandomState(0)
+    lens = [3, 5, 1]
+    data = rng.randn(3, 8, 4).astype(np.float32)
+    feed = {"x": _lod(data, lens)}
+    names = list(outs)
+    vals = exe.run(main, feed=feed, fetch_list=[outs[n] for n in names])
+    for name, got in zip(names, vals):
+        for i, l in enumerate(lens):
+            seq = data[i, :l].astype(np.float64)
+            want = {
+                "sum": seq.sum(0), "average": seq.mean(0),
+                "sqrt": seq.sum(0) / np.sqrt(l), "max": seq.max(0),
+                "last": seq[-1], "first": seq[0],
+            }[name]
+            np.testing.assert_allclose(got[i], want, rtol=2e-5,
+                                       atol=1e-5, err_msg=name)
+
+
+def test_dynamic_lstm_vs_numpy(prog_scope, exe):
+    main, startup, scope = prog_scope
+    h = 8
+    x = fluid.layers.data(name="x", shape=[4 * h], lod_level=1,
+                          dtype="float32")
+    hid, cell = fluid.layers.dynamic_lstm(x, size=4 * h,
+                                          use_peepholes=False)
+    exe.run(startup)
+    rng = np.random.RandomState(1)
+    lens = [5, 2, 7]
+    data = rng.randn(3, 8, 4 * h).astype(np.float32) * 0.5
+    feed = {"x": _lod(data, lens)}
+    got_h, = exe.run(main, feed=feed, fetch_list=[hid])
+
+    w = np.asarray(scope.find_var("lstm_0.w_0"))
+    b = np.asarray(scope.find_var("lstm_0.b_0"))
+
+    def sigmoid(v):
+        return 1 / (1 + np.exp(-v))
+
+    for i, l in enumerate(lens):
+        hp = np.zeros(h)
+        cp = np.zeros(h)
+        for t in range(l):
+            g = data[i, t] + b[0] + hp @ w
+            cand, gi, gf, go = np.split(g, 4)
+            ii, ff, oo = sigmoid(gi), sigmoid(gf), sigmoid(go)
+            cp = ff * cp + ii * np.tanh(cand)
+            hp = oo * np.tanh(cp)
+            np.testing.assert_allclose(got_h[i, t], hp, rtol=2e-4,
+                                       atol=2e-5)
+        # padded positions are zero
+        assert np.abs(got_h[i, l:]).max() == 0.0
+
+
+def test_dynamic_gru_vs_numpy(prog_scope, exe):
+    main, startup, scope = prog_scope
+    d = 6
+    x = fluid.layers.data(name="x", shape=[3 * d], lod_level=1,
+                          dtype="float32")
+    hid = fluid.layers.dynamic_gru(x, size=d)
+    exe.run(startup)
+    rng = np.random.RandomState(2)
+    lens = [4, 6]
+    data = rng.randn(2, 8, 3 * d).astype(np.float32) * 0.5
+    got_h, = exe.run(main, feed={"x": _lod(data, lens)}, fetch_list=[hid])
+
+    w = np.asarray(scope.find_var("gru_0.w_0"))
+    b = np.asarray(scope.find_var("gru_0.b_0"))
+
+    def sigmoid(v):
+        return 1 / (1 + np.exp(-v))
+
+    for i, l in enumerate(lens):
+        hp = np.zeros(d)
+        for t in range(l):
+            xt = data[i, t] + b[0]
+            xu, xr, xc = np.split(xt, 3)
+            u = sigmoid(xu + hp @ w[:, :d])
+            r = sigmoid(xr + hp @ w[:, d: 2 * d])
+            cand = np.tanh(xc + (r * hp) @ w[:, 2 * d:])
+            hp = (1 - u) * hp + u * cand
+            np.testing.assert_allclose(got_h[i, t], hp, rtol=2e-4,
+                                       atol=2e-5)
+
+
+def test_sequence_softmax_masks_padding(prog_scope, exe):
+    main, startup, scope = prog_scope
+    x = fluid.layers.data(name="x", shape=[1], lod_level=1,
+                          dtype="float32")
+    out = fluid.layers.sequence_softmax(x)
+    exe.run(startup)
+    lens = [3, 6]
+    data = np.random.RandomState(3).randn(2, 8, 1).astype(np.float32)
+    got, = exe.run(main, feed={"x": _lod(data, lens)}, fetch_list=[out])
+    for i, l in enumerate(lens):
+        e = np.exp(data[i, :l, 0] - data[i, :l, 0].max())
+        np.testing.assert_allclose(got[i, :l, 0], e / e.sum(), rtol=1e-5,
+                                   atol=1e-6)
+        assert np.abs(got[i, l:]).max() == 0.0
+
+
+def test_sequence_expand(prog_scope, exe):
+    main, startup, scope = prog_scope
+    x = fluid.layers.data(name="x", shape=[3], dtype="float32")
+    y = fluid.layers.data(name="y", shape=[2], lod_level=1,
+                          dtype="float32")
+    out = fluid.layers.sequence_expand(x, y)
+    exe.run(startup)
+    lens = [2, 4]
+    ydata = np.zeros((2, 8, 2), np.float32)
+    xdata = np.random.RandomState(4).randn(2, 3).astype(np.float32)
+    got, = exe.run(main, feed={"x": xdata, "y": _lod(ydata, lens)},
+                   fetch_list=[out])
+    for i, l in enumerate(lens):
+        for t in range(l):
+            np.testing.assert_allclose(got[i, t], xdata[i], rtol=1e-6)
+        assert np.abs(got[i, l:]).max() == 0.0
+
+
+def test_sequence_erase(prog_scope, exe):
+    main, startup, scope = prog_scope
+    x = fluid.layers.data(name="x", shape=[1], lod_level=1, dtype="int64")
+    out = fluid.layers.sequence_erase(x, tokens=[2, 5])
+    exe.run(startup)
+    lens = [6, 4]
+    data = np.array([[1, 2, 3, 2, 5, 4, 0, 0],
+                     [2, 2, 7, 5, 0, 0, 0, 0]])[..., None]
+    got, = exe.run(main, feed={"x": _lod(data, lens, np.int64)},
+                   fetch_list=[out])
+    np.testing.assert_array_equal(got[0, :3, 0], [1, 3, 4])
+    np.testing.assert_array_equal(got[1, :1, 0], [7])
+    assert np.abs(got[0, 3:]).max() == 0 and np.abs(got[1, 1:]).max() == 0
+
+
+def test_edit_distance(prog_scope, exe):
+    main, startup, scope = prog_scope
+    hyp = fluid.layers.data(name="hyp", shape=[1], lod_level=1,
+                            dtype="int64")
+    ref = fluid.layers.data(name="ref", shape=[1], lod_level=1,
+                            dtype="int64")
+    dist, seq_num = fluid.layers.edit_distance(hyp, ref,
+                                               normalized=False)
+    exe.run(startup)
+
+    def lev(a, b):
+        dp = np.arange(len(b) + 1, dtype=float)
+        for i, ca in enumerate(a):
+            prev = dp.copy()
+            dp[0] = i + 1
+            for j, cb in enumerate(b):
+                dp[j + 1] = min(prev[j + 1] + 1, dp[j] + 1,
+                                prev[j] + (ca != cb))
+        return dp[-1]
+
+    hyps = [[1, 2, 3], [4, 5, 6, 7, 8]]
+    refs = [[1, 3, 3, 4], [4, 5, 8]]
+    hl = [len(s) for s in hyps]
+    rl = [len(s) for s in refs]
+    hp = np.zeros((2, 8, 1), np.int64)
+    rp = np.zeros((2, 8, 1), np.int64)
+    for i, s in enumerate(hyps):
+        hp[i, :len(s), 0] = s
+    for i, s in enumerate(refs):
+        rp[i, :len(s), 0] = s
+    got, = exe.run(main, feed={"hyp": _lod(hp, hl, np.int64),
+                               "ref": _lod(rp, rl, np.int64)},
+                   fetch_list=[dist])
+    for i in range(2):
+        assert got[i, 0] == lev(hyps[i], refs[i]), (i, got[i, 0])
+
+
+def test_lstm_sentiment_e2e(prog_scope, exe):
+    """Variable-length classification converges (grad flows through the
+    masked scan) — the stacked_dynamic_lstm pattern."""
+    main, startup, scope = prog_scope
+    words = fluid.layers.data(name="words", shape=[1], lod_level=1,
+                              dtype="int64")
+    label = fluid.layers.data(name="label", shape=[1], dtype="int64")
+    emb = fluid.layers.embedding(words, size=[100, 16])
+    proj = fluid.layers.fc(emb, size=64, act=None)
+    hidden, _ = fluid.layers.dynamic_lstm(proj, size=64,
+                                          use_peepholes=False)
+    last = fluid.layers.sequence_pool(hidden, "max")
+    logit = fluid.layers.fc(last, size=2, act="softmax")
+    loss = fluid.layers.mean(fluid.layers.cross_entropy(logit, label))
+    fluid.optimizer.Adam(5e-3).minimize(loss)
+    exe.run(startup)
+    feeder = fluid.DataFeeder([words, label], program=main)
+    rng = np.random.RandomState(0)
+    ls = []
+    for _ in range(40):
+        batch = []
+        for _ in range(16):
+            y = rng.randint(0, 2)
+            L = rng.randint(3, 12)
+            toks = rng.randint(0, 50, L) + (50 if y else 0)
+            batch.append(([int(t) for t in toks], [y]))
+        l, = exe.run(main, feed=feeder.feed(batch), fetch_list=[loss])
+        ls.append(float(l[0]))
+    assert ls[-1] < 0.3, (ls[0], ls[-1])
