@@ -262,8 +262,17 @@ class ExecutorCore:
                 tuple(shard_of(n) for n in persist_outs))
         jflat = jax.jit(fn_flat, **jit_kwargs)
 
+        # Pin trace/compile/execute to the place's device: with zero inputs
+        # (every startup program) nothing else commits the computation, and
+        # jit would otherwise compile for the process-default backend — e.g.
+        # a CPUPlace startup run landing on the host's TPU.
+        pin = None if self.mesh is not None else self.place.jax_device()
+
         def jfn(inputs, seed, counter):
-            return jflat(*inputs, seed, counter)
+            if pin is None:
+                return jflat(*inputs, seed, counter)
+            with jax.default_device(pin):
+                return jflat(*inputs, seed, counter)
 
         return _CacheEntry(jfn, input_names, persist_outs, tuple(fetch_list),
                            input_shardings)
@@ -279,12 +288,13 @@ class ExecutorCore:
                 dev)
         ctx = LoweringContext(program, block.idx, env,
                               self._rng_key(program, scope), mode)
-        for op in block.ops:
-            info = get_op_info(op.type)
-            if info.host_op:
-                _run_host_op(self, op, scope, feed, env)
-            else:
-                run_op(ctx, op)
+        with jax.default_device(dev):
+            for op in block.ops:
+                info = get_op_info(op.type)
+                if info.host_op:
+                    _run_host_op(self, op, scope, feed, env)
+                else:
+                    run_op(ctx, op)
         # sync written persistables back
         for name in env.written:
             vd = block.find_var_recursive(name)

@@ -98,7 +98,8 @@ class LayerHelper:
                     else XavierInitializer())
         # parameter in the main program's global block
         param = self.main_program.global_block().create_parameter(
-            shape=shape, dtype=dtype, **attr._to_kwargs())
+            name=attr.name, shape=shape, dtype=dtype,
+            **attr._to_param_kwargs())
         # twin in the startup program, with the initializer op
         startup_param = self.startup_program.global_block().create_parameter(
             name=attr.name, shape=shape, dtype=dtype,

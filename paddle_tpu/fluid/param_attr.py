@@ -32,24 +32,41 @@ class ParamAttr:
             return arg
         if isinstance(arg, str):
             return ParamAttr(name=arg)
+        # bool before the numeric branch: isinstance(False, int) is True,
+        # and bias_attr=False means "no parameter at all"
+        if arg is False:
+            return False
+        if arg is True:
+            return ParamAttr()
         if isinstance(arg, (int, float)):
             return ParamAttr(learning_rate=float(arg))
         from .initializer import Initializer
         if isinstance(arg, Initializer):
             return ParamAttr(initializer=arg)
-        if arg is False:
-            return False
         raise TypeError("cannot convert %r to ParamAttr" % (arg,))
 
     def _to_kwargs(self, with_initializer=False):
+        """Constructor-compatible kwargs: ParamAttr(**attr._to_kwargs())
+        replicates the attr (used when one param_attr covers several inputs)."""
         kwargs = {
             "name": self.name,
+            "learning_rate": self.learning_rate,
+            "regularizer": self.regularizer,
+            "trainable": self.trainable,
+            "gradient_clip": self.gradient_clip,
+            "do_model_average": self.do_model_average,
+            "sharding": self.sharding,
+        }
+        if with_initializer:
+            kwargs["initializer"] = self.initializer
+        return kwargs
+
+    def _to_param_kwargs(self):
+        """kwargs for Block.create_parameter (Parameter ctor fields)."""
+        return {
             "optimize_attr": {"learning_rate": self.learning_rate},
             "regularizer": self.regularizer,
             "trainable": self.trainable,
             "gradient_clip_attr": self.gradient_clip,
             "do_model_average": self.do_model_average,
         }
-        if with_initializer:
-            kwargs["initializer"] = self.initializer
-        return kwargs

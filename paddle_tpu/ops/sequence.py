@@ -441,7 +441,11 @@ def _edit_distance(ctx, ins, attrs, op=None):
     row, _ = jax.lax.scan(step, row0, jnp.arange(t1))
     dist = jnp.take_along_axis(row, rlens[:, None].astype(jnp.int32),
                                axis=1)
-    seq_num = jnp.asarray(n, jnp.int64)
+    # static batch count, not a traced value (reference edit_distance_op.cc
+    # emits a shape-[1] int64 tensor); pick the widest int the active JAX
+    # mode keeps so compiled and interpreted paths agree on dtype
+    seq_num = np.asarray(
+        [n], np.int64 if jax.config.jax_enable_x64 else np.int32)
     if norm:
         dist = dist / jnp.maximum(rlens[:, None].astype(jnp.float32), 1.0)
     return {"Out": dist.astype(jnp.float32), "SequenceNum": seq_num}

@@ -22,7 +22,15 @@ def make_mesh(axes, devices=None):
     if devices is None:
         devices = jax.devices()
         if len(devices) < n:
-            devices = jax.devices("cpu")
+            cpus = jax.devices("cpu")
+            if len(cpus) >= n and devices and devices[0].platform != "cpu":
+                import warnings
+                warnings.warn(
+                    "mesh %r needs %d devices but the default platform (%s) "
+                    "has %d — falling back to %d host-CPU devices; the SPMD "
+                    "program will run on CPU" % (axes, n, devices[0].platform,
+                                                 len(devices), len(cpus)))
+            devices = cpus
     if len(devices) < n:
         raise ValueError("mesh %r needs %d devices, have %d"
                          % (axes, n, len(devices)))
