@@ -245,7 +245,10 @@ def generic_grad_lower(ctx, ins, attrs, op):
     # so lowerings that consult names — e.g. sequence ops reading
     # '<input>@LEN' — behave identically under differentiation.
     fwd_op_view = _FwdOpView(
-        fwd_type, {s: list(op.inputs.get(s, [])) for s in fwd_input_slots})
+        fwd_type, {s: list(op.inputs.get(s, [])) for s in fwd_input_slots},
+        # grad-op inputs named after fwd output slots ARE the fwd outputs;
+        # block-ops (conditional_block/recurrent) consult these names
+        {s: list(op.inputs.get(s, [])) for s in fwd_output_slots})
 
     def fwd(p):
         merged = {s: list(v) for s, v in const_ins.items()}
@@ -290,16 +293,16 @@ class _FwdOpView:
 
     __slots__ = ("type", "inputs", "outputs")
 
-    def __init__(self, type_, inputs):
+    def __init__(self, type_, inputs, outputs=None):
         self.type = type_
         self.inputs = inputs
-        self.outputs = {}
+        self.outputs = outputs or {}
 
     def input_arg_names(self):
         return [n for ns in self.inputs.values() for n in ns]
 
     def output_arg_names(self):
-        return []
+        return [n for ns in self.outputs.values() for n in ns]
 
 
 def _is_float(x):

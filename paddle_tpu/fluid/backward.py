@@ -101,6 +101,17 @@ def append_backward(loss, parameter_list=None, no_grad_set=None,
     block = loss.block
     bdesc = block.desc
 
+    # Appending backward twice (a second minimize / calc_gradient on the
+    # same program) would duplicate grad ops and silently corrupt
+    # gradients — fail loudly instead.
+    done = getattr(program, "_backward_applied_for", set())
+    if done:
+        raise RuntimeError(
+            "append_backward already ran on this program (for %s); clone "
+            "the program to build another backward pass" % sorted(done))
+    done.add(loss.name)
+    program._backward_applied_for = done
+
     no_grad = set(no_grad_set or [])
     for name, vd in bdesc.vars.items():
         if vd.stop_gradient:

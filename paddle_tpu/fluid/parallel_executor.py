@@ -64,6 +64,14 @@ class ParallelExecutor:
                  mesh_axes=None):
         if use_tpu is None:
             use_tpu = use_cuda  # migration: use_cuda=True means accelerator
+        if num_trainers != 1 or trainer_id != 0:
+            # Accepting-and-ignoring the multi-host API would be a trap
+            # (reference parallel_executor.cc:88 builds flat NCCL world
+            # ranks from these); raise until the multi-host path exists.
+            raise NotImplementedError(
+                "multi-host ParallelExecutor (num_trainers/trainer_id) is "
+                "not wired up yet; use the distribute transpiler for "
+                "multi-process training")
         self._program = main_program or default_main_program()
         self._scope = scope or _current_scope()
         self._build_strategy = build_strategy or BuildStrategy()

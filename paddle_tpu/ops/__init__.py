@@ -15,4 +15,5 @@ from paddle_tpu.ops import (  # noqa: F401
     metric,
     parallel_ops,
     sequence,
+    control_flow,
 )

@@ -1,13 +1,31 @@
 """Control-flow operators.
 
-Parity: reference operators/{compare_op,logical_op,conditional_block_op,
-while_op,recurrent_op,is_empty_op,increment_op}.cc.  The reference runs
-sub-blocks imperatively against step scopes (STEP_SCOPES vars); here a
-sub-block is traced functionally and handed to the XLA structured
-control-flow primitive (lax.cond / lax.while_loop / lax.scan), so
+Parity: reference operators/{conditional_block_op,while_op,recurrent_op,
+is_empty_op}.cc plus the array-op family (tensor_array_read_write.cc,
+lod_tensor_to_array_op.cc, array_to_lod_tensor_op.cc, lod_rank_table_op.cc,
+max_sequence_len_op.cc, lod_array_length_op.cc, shrink_rnn_memory_op.cc,
+split_lod_tensor_op.cc, merge_lod_tensor_op.cc).
+
+The reference runs sub-blocks imperatively against step scopes (STEP_SCOPES
+vars); here a sub-block is traced functionally and handed to the XLA
+structured control-flow primitive (lax.cond / lax.while_loop / lax.scan), so
 gradients fall out of jax.vjp instead of hand-built *_grad blocks —
-while_grad's stacked-memory machinery (SURVEY hard part #4) is subsumed
-by scan's native differentiability.
+while_grad's stacked-memory machinery (SURVEY hard part #4) is subsumed by
+scan's native differentiability.
+
+TPU-first translations of the LoD machinery:
+
+- ``LoDTensorArray`` -> :class:`TensorArray`, a fixed-capacity device buffer
+  registered as a JAX pytree so it can ride a ``lax.while_loop`` carry;
+  reads/writes are dynamic slices (the reference grows a vector of tensors).
+- ``split_lod_tensor``/``merge_lod_tensor`` (the IfElse engine) -> batched
+  select: both branches compute on the full batch and the merge is a
+  row-wise ``where``.  Identical results for per-row branch computations,
+  and the idiomatic XLA shape (lax.select computes both sides anyway).
+- ``lod_rank_table``/``shrink_rnn_memory`` -> in the padded [N, T, ...]
+  world sequences need no length-descending reorder and the active batch
+  never shrinks: masking inside the scan (the ``recurrent`` op) plays that
+  role, so these lower to length bookkeeping / identity.
 """
 from __future__ import annotations
 
@@ -18,46 +36,186 @@ import numpy as np
 from paddle_tpu.core.registry import register_op
 
 
-def _cmp(name, fn):
-    def lower(ctx, ins, attrs, op=None):
-        return {"Out": fn(ins["X"], ins["Y"])}
-    lower.__name__ = "_" + name
-    register_op(name, lower=lower, grad_maker=None)
-
-
-_cmp("less_than", lambda x, y: x < y)
-_cmp("less_equal", lambda x, y: x <= y)
-_cmp("greater_than", lambda x, y: x > y)
-_cmp("greater_equal", lambda x, y: x >= y)
-_cmp("equal", lambda x, y: x == y)
-_cmp("not_equal", lambda x, y: x != y)
-
-
-def _logical(name, fn, binary=True):
-    def lower(ctx, ins, attrs, op=None):
-        if binary:
-            return {"Out": fn(ins["X"], ins["Y"])}
-        return {"Out": fn(ins["X"])}
-    lower.__name__ = "_" + name
-    register_op(name, lower=lower, grad_maker=None)
-
-
-_logical("logical_and", jnp.logical_and)
-_logical("logical_or", jnp.logical_or)
-_logical("logical_xor", jnp.logical_xor)
-_logical("logical_not", jnp.logical_not, binary=False)
-
-
-@register_op("increment", grad_maker=None)
-def _increment(ctx, ins, attrs, op=None):
-    return {"Out": ins["X"] + attrs.get("step", 1.0)}
-
-
 @register_op("is_empty", grad_maker=None)
 def _is_empty(ctx, ins, attrs, op=None):
     x = ins["X"]
     return {"Out": jnp.asarray([int(np.prod(x.shape)) == 0])}
 
+
+# ---------------------------------------------------------------------------
+# TensorArray (reference LoDTensorArray, framework.proto LOD_TENSOR_ARRAY)
+# ---------------------------------------------------------------------------
+
+@jax.tree_util.register_pytree_node_class
+class TensorArray:
+    """Fixed-capacity stack of same-shape tensors on device.
+
+    ``buffer`` is ``[capacity, ...]``; ``size`` is the number of live
+    entries (traced int32 scalar).  Registered as a pytree so arrays can be
+    loop-carried through ``lax.while_loop`` / appear in jit results.
+    """
+
+    __slots__ = ("buffer", "size")
+
+    def __init__(self, buffer, size):
+        self.buffer = buffer
+        self.size = size
+
+    def tree_flatten(self):
+        return (self.buffer, self.size), None
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children)
+
+    @staticmethod
+    def empty(element_shape, dtype, capacity):
+        return TensorArray(
+            jnp.zeros((int(capacity),) + tuple(int(d) for d in element_shape),
+                      dtype), jnp.asarray(0, jnp.int32))
+
+
+def _as_index(i):
+    i = jnp.asarray(i)
+    return jnp.reshape(i, ()).astype(jnp.int32)
+
+
+@register_op("create_array", grad_maker=None)
+def _create_array(ctx, ins, attrs, op=None):
+    """Preallocated empty TensorArray.  ``element_shape``+``capacity`` attrs
+    size the buffer (XLA needs static shapes; the reference grows a
+    std::vector instead)."""
+    from paddle_tpu.core.types import proto_to_np_dtype
+
+    if "element_shape" not in attrs:
+        # defer sizing to the first (out-of-loop) write_to_array
+        return {"Out": TensorArray(None, jnp.asarray(0, jnp.int32))}
+    shape = tuple(attrs["element_shape"])
+    dtype = proto_to_np_dtype(attrs["dtype"]) if "dtype" in attrs \
+        else np.float32
+    cap = int(attrs.get("capacity", 64))
+    return {"Out": TensorArray.empty(shape, dtype, cap)}
+
+
+@register_op("write_to_array", seq_aware=True)
+def _write_to_array(ctx, ins, attrs, op=None):
+    """array[i] = x (reference tensor_array_read_write.cc WriteToArray).
+    A missing/empty input array is allocated from x's shape."""
+    x = ins["X"]
+    i = _as_index(ins["I"])
+    arr = ins.get("Array")
+    if arr is None or arr.buffer is None:
+        cap = int(attrs.get("capacity", 64))
+        arr = TensorArray.empty(x.shape, jnp.result_type(x), cap)
+    buf = jax.lax.dynamic_update_index_in_dim(
+        arr.buffer, x.astype(arr.buffer.dtype), i, 0)
+    size = jnp.maximum(arr.size, i + 1)
+    return {"Out": TensorArray(buf, size)}
+
+
+@register_op("read_from_array", seq_aware=True)
+def _read_from_array(ctx, ins, attrs, op=None):
+    arr = ins["X"]
+    i = _as_index(ins["I"])
+    return {"Out": jax.lax.dynamic_index_in_dim(arr.buffer, i, 0,
+                                                keepdims=False)}
+
+
+def _wide_int():
+    """Widest int the active JAX mode keeps (int64 silently truncates to
+    int32 under the default x32 mode)."""
+    return jnp.int64 if jax.config.jax_enable_x64 else jnp.int32
+
+
+@register_op("lod_array_length", grad_maker=None)
+def _lod_array_length(ctx, ins, attrs, op=None):
+    return {"Out": jnp.reshape(ins["X"].size, (1,)).astype(_wide_int())}
+
+
+@register_op("lod_rank_table", grad_maker=None, seq_aware=True)
+def _lod_rank_table(ctx, ins, attrs, op=None):
+    """Reference lod_rank_table_op.cc sorts sequences by descending length
+    so the while-RNN can shrink its active batch.  Padded batches stay in
+    order; the 'table' is just the [N] length vector (all-T when dense)."""
+    x = ins["X"]
+    name = (op.inputs.get("X") or [None])[0] if op is not None else None
+    lens = ctx.seq_len_of(name) if name else None
+    if lens is None:
+        n, t = x.shape[0], (x.shape[1] if x.ndim > 1 else 1)
+        lens = jnp.full((n,), t, jnp.int32)
+    return {"Out": lens.astype(jnp.int32)}
+
+
+@register_op("max_sequence_len", grad_maker=None)
+def _max_sequence_len(ctx, ins, attrs, op=None):
+    return {"Out": jnp.reshape(jnp.max(ins["RankTable"]), (1,)).astype(
+        _wide_int())}
+
+
+@register_op("lod_tensor_to_array", seq_aware=True)
+def _lod_tensor_to_array(ctx, ins, attrs, op=None):
+    """Padded [N, T, ...] -> TensorArray of T time slices [N, ...]
+    (reference packs ragged rows per timestep; masking replaces that)."""
+    x = ins["X"]
+    t = x.shape[1]
+    return {"Out": TensorArray(jnp.moveaxis(x, 1, 0),
+                               jnp.asarray(t, jnp.int32))}
+
+
+@register_op("array_to_lod_tensor", seq_aware=True)
+def _array_to_lod_tensor(ctx, ins, attrs, op=None):
+    arr = ins["X"]
+    out = jnp.moveaxis(arr.buffer, 0, 1)  # [N, T, ...]
+    if op is not None:
+        table_names = op.inputs.get("RankTable") or []
+        if table_names and table_names[0] and table_names[0] in ctx.env:
+            lens = ctx.env[table_names[0]]
+            out_names = op.outputs.get("Out") or []
+            for nm in out_names:
+                if nm:
+                    ctx.set_seq_len(nm, lens)
+    return {"Out": out}
+
+
+@register_op("shrink_rnn_memory", seq_aware=True)
+def _shrink_rnn_memory(ctx, ins, attrs, op=None):
+    """Identity: the padded scan keeps the full batch and freezes finished
+    rows by mask (ops/control_flow.py recurrent), so there is no shrinking
+    to do (reference shrink_rnn_memory_op.cc)."""
+    return {"Out": ins["X"]}
+
+
+@register_op("reorder_lod_tensor_by_rank", seq_aware=True)
+def _reorder_lod_tensor_by_rank(ctx, ins, attrs, op=None):
+    """Identity: padded batches are never length-sorted."""
+    return {"Out": ins["X"]}
+
+
+# ---------------------------------------------------------------------------
+# IfElse engine: batched row select (reference split/merge_lod_tensor_op.cc)
+# ---------------------------------------------------------------------------
+
+@register_op("split_lod_tensor")
+def _split_lod_tensor(ctx, ins, attrs, op=None):
+    """Both 'halves' alias the full batch; the branch-select happens in
+    merge_lod_tensor.  Row-wise branch computations produce identical
+    results to the reference's physical row split."""
+    x = ins["X"]
+    return {"OutTrue": x, "OutFalse": x}
+
+
+@register_op("merge_lod_tensor")
+def _merge_lod_tensor(ctx, ins, attrs, op=None):
+    mask = ins["Mask"]
+    in_true, in_false = ins["InTrue"], ins["InFalse"]
+    m = jnp.reshape(mask, (-1,)).astype(bool)
+    m = m.reshape((m.shape[0],) + (1,) * (in_true.ndim - 1))
+    return {"Out": jnp.where(m, in_true, in_false)}
+
+
+# ---------------------------------------------------------------------------
+# conditional_block / while / recurrent
+# ---------------------------------------------------------------------------
 
 def _trace_block(ctx, block_idx, env):
     from paddle_tpu.core.lowering import run_ops
@@ -103,17 +261,21 @@ def _conditional_block(ctx, ins, attrs, op=None):
     return {"Out": list(outs)}
 
 
-@register_op("while")
+@register_op("while", grad_maker=None, seq_aware=True)
 def _while(ctx, ins, attrs, op=None):
     """while-loop (reference while_op.cc): Condition [1] bool; X = loop
-    vars (read+written by the block); sub-block recomputes Condition.
-    Lowered to lax.while_loop — NOT differentiable (XLA While has no
-    vjp); use StaticRNN/DynamicRNN (the scan-lowered ``recurrent`` op)
-    for trainable recurrence, as the reference's own RNN layers do."""
+    vars (read AND written by the block, carried through the loop);
+    Params = outer vars the block only reads (closed over, not carried);
+    the sub-block recomputes Condition.  Lowered to lax.while_loop — NOT
+    differentiable (XLA While has no vjp); use StaticRNN/DynamicRNN (the
+    scan-lowered ``recurrent`` op) for trainable recurrence, as the
+    reference's own RNN layers do."""
     sub_idx = int(attrs["sub_block"])
     cond_name = (op.inputs.get("Condition") or [None])[0]
     x_names = [n for n in (op.inputs.get("X") or []) if n]
     x_vals = list(ins.list("X"))
+    p_names = [n for n in (op.inputs.get("Params") or []) if n]
+    p_vals = list(ins.list("Params"))
     cond0 = ins.list("Condition")[0]
 
     def cond_fn(carry):
@@ -122,14 +284,15 @@ def _while(ctx, ins, attrs, op=None):
 
     def body_fn(carry):
         c, xs = carry
-        env = dict(zip(x_names, xs))
+        env = dict(zip(p_names, p_vals))
+        env.update(zip(x_names, xs))
         env[cond_name] = c
         _trace_block(ctx, sub_idx, env)
         return (env[cond_name], tuple(env[n] for n in x_names))
 
-    _, outs = jax.lax.while_loop(cond_fn, body_fn,
-                                 (cond0, tuple(x_vals)))
-    return {"Out": list(outs)}
+    final_c, outs = jax.lax.while_loop(cond_fn, body_fn,
+                                       (cond0, tuple(x_vals)))
+    return {"Out": list(outs), "CondOut": final_c}
 
 
 @register_op("recurrent", seq_aware=True)
@@ -146,7 +309,8 @@ def _recurrent(ctx, ins, attrs, op=None):
     Attrs
       sub_block, step_input_names, state_in_names, state_out_names,
       step_output_names, masked (freeze states & zero outputs past each
-      sequence's length, from the first input's @LEN vector)
+      sequence's length, from the first input's @LEN vector), reverse
+      (iterate time back-to-front, for bidirectional RNNs)
     Outputs
       Outputs     stacked step outputs [N, T, ...]
       FinalStates last state values [N, ...]
@@ -157,6 +321,7 @@ def _recurrent(ctx, ins, attrs, op=None):
     st_out_names = list(attrs.get("state_out_names", []))
     out_names = list(attrs.get("step_output_names", []))
     masked = bool(attrs.get("masked", False))
+    reverse = bool(attrs.get("reverse", False))
     param_names = [n for n in (op.inputs.get("Parameters") or []) if n]
 
     xs = [v for v in ins.list("Inputs")]
@@ -200,7 +365,8 @@ def _recurrent(ctx, ins, attrs, op=None):
         return new_states, tuple(outs)
 
     final_states, stacked = jax.lax.scan(step, tuple(inits),
-                                         (tuple(xs_t), mask_t))
+                                         (tuple(xs_t), mask_t),
+                                         reverse=reverse)
     outputs = [jnp.moveaxis(o, 0, 1) for o in stacked]
     result = {"Outputs": outputs, "FinalStates": list(final_states)}
     if lens is not None and op is not None:
