@@ -82,6 +82,8 @@ class ExecutorCore:
         feed = _prepare_lod_feeds(dict(feed or {}))
         fetch_list = list(fetch_list or [])
         block = program.blocks[block_id]
+        # host ops with sub-block access (listen_and_serv) read this
+        self._current_program = program
 
         prelude, core_ops, postlude, mixed = _segment(block)
         for op in prelude:

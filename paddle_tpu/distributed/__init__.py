@@ -1,0 +1,8 @@
+"""Host-side distributed runtime: the parameter-server RPC path.
+
+Parity: reference operators/detail/ (grpc_client.h:168, grpc_server.cc,
+send_recv.proto SendRecvService) — the gRPC transport between trainers and
+parameter servers.  Device-side collectives (the "nccl2 mode" analog) are
+XLA/GSPMD collectives over the mesh instead (paddle_tpu/parallel/).
+"""
+from .rpc import RPCClient, VariableServer  # noqa: F401

@@ -1,0 +1,268 @@
+"""Trainer<->pserver RPC over gRPC generic handlers.
+
+Parity: reference operators/detail/send_recv.proto:19-28 (SendRecvService:
+SendVariable / GetVariable / PrefetchVariable), grpc_client.h:168,
+grpc_server.cc, and the sync/async serve loops of
+operators/listen_and_serv_op.cc:99,166.
+
+Implementation notes (TPU-host path):
+- gRPC *generic* method handlers with a numpy-native wire format — no
+  protoc codegen; tensors travel as raw ``np.lib.format`` bytes.
+- The sync protocol is barrier-counted like the reference: trainers send
+  every grad, then SendBarrier; once ``fanin`` barriers arrive the server
+  aggregates (mean over trainers), runs the per-param optimize blocks, and
+  bumps ``applied_round``; GetVariable(round) blocks until
+  ``applied_round >= round``.  SendComplete decrements fanin (reference
+  framework/executor.cc:50 SendComplete) and stops the server at zero.
+"""
+from __future__ import annotations
+
+import io
+import threading
+from concurrent import futures
+
+import numpy as np
+
+SERVICE = "paddle_tpu.PServer"
+
+
+def _enc_tensor(name, arr, extra=0):
+    buf = io.BytesIO()
+    nb = name.encode("utf-8")
+    buf.write(len(nb).to_bytes(4, "little"))
+    buf.write(nb)
+    buf.write(int(extra).to_bytes(8, "little", signed=True))
+    np.save(buf, np.asarray(arr), allow_pickle=False)
+    return buf.getvalue()
+
+
+def _dec_tensor(data):
+    buf = io.BytesIO(data)
+    n = int.from_bytes(buf.read(4), "little")
+    name = buf.read(n).decode("utf-8")
+    extra = int.from_bytes(buf.read(8), "little", signed=True)
+    arr = np.load(buf, allow_pickle=False)
+    return name, arr, extra
+
+
+def _enc_msg(name, extra=0):
+    nb = name.encode("utf-8")
+    return (len(nb).to_bytes(4, "little") + nb
+            + int(extra).to_bytes(8, "little", signed=True))
+
+
+def _dec_msg(data):
+    n = int.from_bytes(data[:4], "little")
+    name = data[4:4 + n].decode("utf-8")
+    extra = int.from_bytes(data[4 + n:12 + n], "little", signed=True)
+    return name, extra
+
+
+class VariableServer:
+    """Parameter-server side: owns the scope, applies optimize blocks.
+
+    ``grad_to_block``: grad(-block) var name -> pserver sub-block index.
+    ``apply_block``: callable(block_idx) running one optimize sub-block
+    against the server scope (wired to the executor by listen_and_serv).
+    """
+
+    def __init__(self, scope, grad_to_block, apply_block, fanin,
+                 sync_mode=True):
+        import grpc
+
+        self.scope = scope
+        self.grad_to_block = dict(grad_to_block)
+        self.apply_block = apply_block
+        self.fanin_total = int(fanin)
+        self.sync_mode = bool(sync_mode)
+
+        self._cv = threading.Condition()
+        self._pending = {g: [] for g in self.grad_to_block}
+        self._applied_round = 0
+        self._barriers = 0
+        self._alive = self.fanin_total
+        self._shutdown = threading.Event()
+
+        handlers = {
+            "SendVariable": self._h(self._send_variable),
+            "GetVariable": self._h(self._get_variable),
+            "SendBarrier": self._h(self._send_barrier),
+            "FetchBarrier": self._h(self._fetch_barrier),
+            "SendComplete": self._h(self._send_complete),
+        }
+        # enough workers that fanin-1 blocked GetVariable waiters can never
+        # starve the SendBarrier that would wake them
+        self._server = grpc.server(futures.ThreadPoolExecutor(
+            max_workers=max(16, 4 * self.fanin_total + 4)))
+        self._server.add_generic_rpc_handlers((
+            grpc.method_handlers_generic_handler(SERVICE, handlers),))
+
+    @staticmethod
+    def _h(fn):
+        import grpc
+
+        return grpc.unary_unary_rpc_method_handler(
+            lambda req, ctx: fn(req))
+
+    # -- lifecycle --
+    def start(self, endpoint):
+        """Bind + start; returns the bound port."""
+        port = self._server.add_insecure_port(endpoint)
+        self._server.start()
+        return port
+
+    def wait(self):
+        """Block until every trainer sent SendComplete."""
+        self._shutdown.wait()
+        self._server.stop(grace=1).wait()
+
+    # -- handlers --
+    def _send_variable(self, req):
+        name, arr, _round = _dec_tensor(req)
+        with self._cv:
+            if name not in self._pending:
+                # direct write (e.g. init push or non-optimized var)
+                self.scope.set(name, arr)
+                return b""
+            self._pending[name].append(arr)
+            if not self.sync_mode:
+                self._apply_one(name)
+                self._cv.notify_all()
+        return b""
+
+    def _send_barrier(self, req):
+        with self._cv:
+            self._barriers += 1
+            if self._barriers >= self._alive:
+                self._apply_round()
+        return b""
+
+    def _get_variable(self, req):
+        name, round_ = _dec_msg(req)
+        with self._cv:
+            if self.sync_mode:
+                self._cv.wait_for(
+                    lambda: self._applied_round >= round_
+                    or self._shutdown.is_set())
+            # materialize to host INSIDE the lock: a concurrent async-mode
+            # apply donates the param's device buffer, invalidating it
+            val = np.asarray(self.scope.find_var(name))
+        return _enc_tensor(name, val)
+
+    def _fetch_barrier(self, req):
+        return b""
+
+    def _send_complete(self, req):
+        with self._cv:
+            self._alive -= 1
+            if self._alive <= 0:
+                self._shutdown.set()
+            elif self._barriers >= self._alive > 0:
+                # stragglers of a half-round: apply what arrived
+                self._apply_round()
+            self._cv.notify_all()
+        return b""
+
+    # -- application (lock held) --
+    def _apply_one(self, gname):
+        vals = self._pending[gname]
+        if not vals:
+            return
+        agg = vals[0] if len(vals) == 1 else (
+            np.sum(vals, axis=0) / len(vals))
+        self.scope.set(gname, np.asarray(agg))
+        self._pending[gname] = []
+        self.apply_block(self.grad_to_block[gname])
+
+    def _apply_round(self):
+        for g in self._pending:
+            self._apply_one(g)
+        self._applied_round += 1
+        self._barriers = 0
+        self._cv.notify_all()
+
+
+class RPCClient:
+    """Trainer side (reference grpc_client.h:168).  Process-wide singleton:
+    send/recv ops share channels and the sync round counter."""
+
+    _instance = None
+
+    def __init__(self):
+        self._channels = {}
+        self._lock = threading.Lock()
+        self.step = 0
+
+    @classmethod
+    def instance(cls):
+        if cls._instance is None:
+            cls._instance = RPCClient()
+        return cls._instance
+
+    @classmethod
+    def reset(cls):
+        cls._instance = None
+
+    def _call(self, ep, method, payload):
+        import grpc
+
+        with self._lock:
+            ch = self._channels.get(ep)
+            if ch is None:
+                ch = grpc.insecure_channel(ep)
+                self._channels[ep] = ch
+        fn = ch.unary_unary("/%s/%s" % (SERVICE, method))
+        return fn(payload, wait_for_ready=True)
+
+    def _stub(self, ep, method):
+        import grpc
+
+        with self._lock:
+            ch = self._channels.get(ep)
+            if ch is None:
+                ch = grpc.insecure_channel(ep)
+                self._channels[ep] = ch
+        return ch.unary_unary("/%s/%s" % (SERVICE, method))
+
+    def send_var(self, ep, name, arr):
+        self._call(ep, "SendVariable", _enc_tensor(name, arr, self.step))
+
+    def send_vars(self, triples):
+        """Overlapped sends: [(ep, name, arr)] in flight together
+        (reference grpc_client AsyncSendVar + Wait)."""
+        futs = [self._stub(ep, "SendVariable").future(
+            _enc_tensor(name, arr, self.step), wait_for_ready=True)
+            for ep, name, arr in triples]
+        for f in futs:
+            f.result()
+
+    def get_var(self, ep, name, round_=None):
+        round_ = self.step if round_ is None else round_
+        _, arr, _ = _dec_tensor(
+            self._call(ep, "GetVariable", _enc_msg(name, round_)))
+        return arr
+
+    def get_vars(self, pairs, round_=None):
+        """Overlapped gets: [(ep, name)] -> [arr], one joined wait
+        (reference AsyncGetVar + Wait)."""
+        round_ = self.step if round_ is None else round_
+        futs = [self._stub(ep, "GetVariable").future(
+            _enc_msg(name, round_), wait_for_ready=True)
+            for ep, name in pairs]
+        return [_dec_tensor(f.result())[1] for f in futs]
+
+    def send_barrier(self, eps):
+        for ep in eps:
+            self._call(ep, "SendBarrier", b"")
+        self.step += 1
+
+    def fetch_barrier(self, eps):
+        for ep in eps:
+            self._call(ep, "FetchBarrier", b"")
+
+    def send_complete(self, eps):
+        for ep in eps:
+            try:
+                self._call(ep, "SendComplete", b"")
+            except Exception:
+                pass  # server may already be down

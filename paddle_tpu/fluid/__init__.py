@@ -36,6 +36,8 @@ from .io import (save_vars, save_params, save_persistables, load_vars,
                  load_inference_model, save_checkpoint, load_checkpoint,
                  clean_checkpoint, get_latest_checkpoint_serial)
 from .data_feeder import DataFeeder
+from . import transpiler
+from .transpiler import DistributeTranspiler
 from .parallel_executor import (ParallelExecutor, ExecutionStrategy,
                                 BuildStrategy)
 

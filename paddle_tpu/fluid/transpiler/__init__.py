@@ -1,0 +1,6 @@
+"""Program-to-program transpilers (parity: python/paddle/fluid/transpiler/)."""
+from .distribute_transpiler import DistributeTranspiler, slice_variable  # noqa: F401
+from .ps_dispatcher import RoundRobin, HashName, PSDispatcher  # noqa: F401
+
+__all__ = ["DistributeTranspiler", "slice_variable", "RoundRobin",
+           "HashName", "PSDispatcher"]

@@ -1,0 +1,370 @@
+"""DistributeTranspiler: one program -> trainer + pserver programs.
+
+Parity: reference python/paddle/fluid/transpiler/distribute_transpiler.py
+(slice_variable:74, transpile:244, get_pserver_program:399,
+get_startup_program:554) over operators/listen_and_serv_op.cc:99,166.
+
+Differences from the reference, chosen for the TPU host path:
+- send/recv collapse the reference's split_byref->send / recv->concat op
+  chains: one host ``send`` op splits a grad and ships its slices, one
+  host ``recv`` op fetches + concatenates a param.  The device step stays
+  a single compiled XLA program; RPC traffic is host-side numpy
+  (ops/distributed_ops.py).
+- Gradient aggregation (sum/N over trainers) happens in the pserver's
+  serve loop rather than as grad-merge ops in the pserver program
+  (reference :999); the per-param optimize sub-blocks are identical.
+"""
+from __future__ import annotations
+
+import math
+
+import numpy as np
+
+from paddle_tpu.core import desc as core_desc
+from paddle_tpu.core.types import proto_to_np_dtype
+
+from ..framework import (Program, OpRole, Operator, default_main_program,
+                         default_startup_program)
+from .ps_dispatcher import RoundRobin
+
+__all__ = ["DistributeTranspiler", "slice_variable", "VarBlock"]
+
+MIN_BLOCK_SIZE = 8192
+
+
+class VarBlock:
+    """One slice of a variable along axis 0 (reference VarBlock
+    "varname:blockid:size")."""
+
+    __slots__ = ("varname", "block_id", "row_start", "rows", "shape")
+
+    def __init__(self, varname, block_id, row_start, rows, shape):
+        self.varname = varname
+        self.block_id = block_id
+        self.row_start = row_start
+        self.rows = rows
+        self.shape = list(shape)
+
+    @property
+    def name(self):
+        if self.block_id < 0:
+            return self.varname
+        return "%s.block%d" % (self.varname, self.block_id)
+
+    def __repr__(self):
+        return "%s:%d:%d" % (self.varname, self.block_id, self.rows)
+
+
+def slice_variable(var_shapes, slice_count, min_block_size=MIN_BLOCK_SIZE):
+    """Split each var into <= slice_count row-blocks of >= min_block_size
+    elements (reference slice_variable:74; split axis = 0).  var_shapes:
+    [(name, shape)].  Returns {name: [VarBlock]}; unsplit vars get a
+    single block with block_id=-1."""
+    out = {}
+    for name, shape in var_shapes:
+        shape = [int(d) for d in shape]
+        numel = int(np.prod(shape)) if shape else 1
+        rows = shape[0] if shape else 1
+        if numel <= min_block_size or rows < 2 or slice_count < 2:
+            out[name] = [VarBlock(name, -1, 0, rows, shape)]
+            continue
+        row_numel = max(1, numel // rows)
+        max_splits = max(1, numel // min_block_size)
+        n_blocks = min(slice_count, rows, max_splits)
+        per = int(math.ceil(rows / float(n_blocks)))
+        blocks = []
+        start = 0
+        bid = 0
+        while start < rows:
+            r = min(per, rows - start)
+            blocks.append(VarBlock(name, bid, start, r,
+                                   [r] + shape[1:]))
+            start += r
+            bid += 1
+        out[name] = blocks
+    return out
+
+
+def _attrs_of(op_desc):
+    return {k: a.value for k, a in op_desc.attrs.items()}
+
+
+class DistributeTranspiler:
+    """Usage (reference transpile:244)::
+
+        t = DistributeTranspiler()
+        t.transpile(trainer_id, program=main, pservers="ip:p1,ip:p2",
+                    trainers=2)
+        trainer_prog = t.get_trainer_program()
+        # on each pserver process:
+        ps_prog = t.get_pserver_program(ep)
+        ps_startup = t.get_startup_program(ep, ps_prog)
+    """
+
+    def transpile(self, trainer_id, program=None, pservers="127.0.0.1:6174",
+                  trainers=1, sync_mode=True, startup_program=None,
+                  min_block_size=MIN_BLOCK_SIZE):
+        self.trainer_id = trainer_id
+        self.trainer_num = trainers
+        self.sync_mode = sync_mode
+        self.origin_program = program or default_main_program()
+        self.startup_program = startup_program or default_startup_program()
+        self.pserver_endpoints = [e.strip() for e in pservers.split(",")
+                                  if e.strip()]
+
+        block = self.origin_program.global_block()
+
+        # -- 1. find + detach the optimize ops ------------------------------
+        self.optimize_ops = []
+        params_grads = []
+        kept_ops, kept_descs = [], []
+        for op in block.ops:
+            is_opt = (op.desc.role & OpRole.Optimize) and \
+                "Param" in op.desc.inputs and "Grad" in op.desc.inputs
+            if is_opt:
+                self.optimize_ops.append(op.desc)
+                params_grads.append((op.desc.inputs["Param"][0],
+                                     op.desc.inputs["Grad"][0]))
+            else:
+                kept_ops.append(op)
+                kept_descs.append(op.desc)
+        block.ops = kept_ops
+        block.desc.ops = kept_descs
+        self.params_grads = params_grads
+
+        # -- 2. slice params/grads into blocks ------------------------------
+        shapes = []
+        for p, g in params_grads:
+            vd = block.desc.find_var_recursive(p)
+            shapes.append((p, vd.shape))
+        self.param_blocks = slice_variable(
+            shapes, len(self.pserver_endpoints), min_block_size)
+
+        # round-robin blocks over endpoints (reference RoundRobin default)
+        dispatcher = RoundRobin(self.pserver_endpoints)
+        self.block_ep = {}   # block name -> endpoint
+        for p, g in params_grads:
+            eps = dispatcher.dispatch(self.param_blocks[p])
+            for blk, ep in zip(self.param_blocks[p], eps):
+                self.block_ep[blk.name] = ep
+
+        # grads must survive the compiled step so host send ops can read
+        # them from the scope
+        for p, g in params_grads:
+            gvd = block.desc.find_var_recursive(g)
+            if gvd is not None:
+                gvd.persistable = True
+
+        # -- 3. append trainer-side send/recv chain -------------------------
+        used_eps = sorted({ep for ep in self.block_ep.values()})
+        for p, g in params_grads:
+            blocks = self.param_blocks[p]
+            block.append_op(
+                type="send", inputs={"X": [g]}, outputs={},
+                attrs={"epmap": [self.block_ep[b.name] for b in blocks],
+                       "sections": [b.rows for b in blocks],
+                       "block_names": [self._grad_block_name(g, b)
+                                       for b in blocks]},
+                infer_shape=False)
+        if sync_mode:
+            block.append_op(type="send_barrier", inputs={}, outputs={},
+                            attrs={"endpoints": used_eps},
+                            infer_shape=False)
+        for p, g in params_grads:
+            blocks = self.param_blocks[p]
+            block.append_op(
+                type="recv", inputs={}, outputs={"Out": [p]},
+                attrs={"epmap": [self.block_ep[b.name] for b in blocks],
+                       "sections": [b.rows for b in blocks],
+                       "block_names": [b.name for b in blocks]},
+                infer_shape=False)
+        if sync_mode:
+            block.append_op(type="fetch_barrier", inputs={}, outputs={},
+                            attrs={"endpoints": used_eps},
+                            infer_shape=False)
+        self.origin_program.desc.bump_version()
+
+        # Trainer startup ends by pulling the authoritative initial params
+        # from the pservers (GetVariable at round 0 returns immediately):
+        # pserver init is the source of truth, so random initializers stay
+        # consistent across trainers even though each process draws its
+        # own local values first.
+        su_block = self.startup_program.global_block()
+        for p, g in params_grads:
+            blocks = self.param_blocks[p]
+            if not su_block.has_var(p):
+                vd = block.desc.find_var_recursive(p)
+                su_block.create_var(name=p, shape=list(vd.shape),
+                                    dtype=proto_to_np_dtype(vd.dtype),
+                                    persistable=True)
+            su_block.append_op(
+                type="recv", inputs={}, outputs={"Out": [p]},
+                attrs={"epmap": [self.block_ep[b.name] for b in blocks],
+                       "sections": [b.rows for b in blocks],
+                       "block_names": [b.name for b in blocks]},
+                infer_shape=False)
+        if sync_mode:
+            su_block.append_op(type="fetch_barrier", inputs={}, outputs={},
+                               attrs={"endpoints": used_eps},
+                               infer_shape=False)
+        self.startup_program.desc.bump_version()
+
+    @staticmethod
+    def _grad_block_name(gname, blk):
+        if blk.block_id < 0:
+            return gname
+        return "%s.block%d" % (gname, blk.block_id)
+
+    def get_trainer_program(self):
+        return self.origin_program
+
+    # ---------------------------------------------------------------------
+    def get_pserver_program(self, endpoint):
+        """Pserver program: per-param-block optimize sub-blocks + a
+        listen_and_serv op (reference get_pserver_program:399)."""
+        prog = Program()
+        gb = prog.global_block()
+        origin_block = self.origin_program.global_block()
+        grad_to_block_id = []
+        ep_var_origin = {}   # pserver var name -> (origin name, VarBlock|None)
+
+        for (p, g), opt_desc in zip(self.params_grads, self.optimize_ops):
+            for blk in self.param_blocks[p]:
+                if self.block_ep[blk.name] != endpoint:
+                    continue
+                name_map = self._retarget_map(
+                    opt_desc, p, g, blk, origin_block, ep_var_origin)
+                # declare vars in pserver global block
+                for oname, (pname, shape) in name_map.items():
+                    if not gb.has_var(pname):
+                        ovd = origin_block.desc.find_var_recursive(oname)
+                        gb.create_var(
+                            name=pname, shape=shape,
+                            dtype=("float32" if ovd is None else
+                                   proto_to_np_dtype(ovd.dtype)),
+                            persistable=True)
+                # one sub-block holding the retargeted optimize op
+                sub = prog.create_block(parent_idx=0)
+                prog.rollback()
+                inputs = {s: [name_map.get(n, (n, None))[0] for n in ns]
+                          for s, ns in opt_desc.inputs.items()}
+                outputs = {s: [name_map.get(n, (n, None))[0] for n in ns]
+                           for s, ns in opt_desc.outputs.items()}
+                sub_desc = core_desc.OpDesc(
+                    opt_desc.type, inputs, outputs, _attrs_of(opt_desc),
+                    role=OpRole.Optimize)
+                sub.desc.append_op(sub_desc)
+                sub.ops.append(Operator(sub, sub_desc))
+                gname = self._grad_block_name(g, blk)
+                grad_to_block_id.append("%s:%d" % (gname, sub.idx))
+
+        gb.append_op(
+            type="listen_and_serv", inputs={}, outputs={},
+            attrs={"endpoint": endpoint,
+                   "Fanin": self.trainer_num,
+                   "sync_mode": self.sync_mode,
+                   "grad_to_block_id": grad_to_block_id},
+            infer_shape=False)
+        prog._pserver_var_origin = ep_var_origin
+        return prog
+
+    def _retarget_map(self, opt_desc, p, g, blk, origin_block,
+                      ep_var_origin):
+        """origin var name -> (pserver var name, slice shape) for every
+        in/out of one optimize op applied to one param block."""
+        pvd = origin_block.desc.find_var_recursive(p)
+        pshape = list(pvd.shape)
+        sliced_shape = list(blk.shape)
+        name_map = {}
+
+        def add(oname, pname, shape, origin_blk):
+            name_map[oname] = (pname, shape)
+            ep_var_origin[pname] = (oname, origin_blk)
+
+        gname = self._grad_block_name(g, blk)
+        add(p, blk.name, sliced_shape, blk)
+        add(g, gname, sliced_shape, None)   # grads arrive via RPC
+        written = set()
+        for s, ns in opt_desc.outputs.items():
+            written.update(n for n in ns if n)
+        for s, ns in opt_desc.inputs.items():
+            for n in ns:
+                if not n or n in name_map:
+                    continue
+                vd = origin_block.desc.find_var_recursive(n)
+                shape = list(vd.shape) if vd is not None else [1]
+                if shape == pshape and blk.block_id >= 0:
+                    # param-shaped accumulator: slice like the param
+                    acc_blk = VarBlock(n, blk.block_id, blk.row_start,
+                                       blk.rows, sliced_shape)
+                    add(n, acc_blk.name, sliced_shape, acc_blk)
+                elif shape == pshape:
+                    add(n, n, shape, VarBlock(n, -1, 0, blk.rows, shape))
+                elif n in written and blk.block_id >= 0:
+                    # scalar state written per application (beta pows):
+                    # per-block copy so repeated application stays correct
+                    add(n, "%s.block%d" % (n, blk.block_id), shape, None)
+                else:
+                    # shared read-only hyperparam (learning rate)
+                    add(n, n, shape, None)
+        return name_map
+
+    # ---------------------------------------------------------------------
+    def get_startup_program(self, endpoint, pserver_program):
+        """Init program for one pserver: clones the origin startup op of
+        each base var, then slices out this server's block (reference
+        get_startup_program:554)."""
+        prog = Program()
+        gb = prog.global_block()
+        created_full = {}
+        origin_map = getattr(pserver_program, "_pserver_var_origin", {})
+        s_block = self.startup_program.global_block()
+
+        for psname, (oname, blk) in origin_map.items():
+            pvd = pserver_program.global_block().desc.find_var_recursive(
+                psname)
+            if pvd is None:
+                continue
+            init_desc = None
+            for op in s_block.ops:
+                if oname in op.desc.output_arg_names():
+                    init_desc = op.desc
+                    break
+            if init_desc is None:
+                continue  # e.g. grad blocks: arrive via RPC
+            dtype = proto_to_np_dtype(pvd.dtype)
+            if blk is None or blk.block_id < 0:
+                # whole-var init, same name
+                if not gb.has_var(psname):
+                    gb.create_var(name=psname, shape=list(pvd.shape),
+                                  dtype=dtype, persistable=True)
+                    gb.desc.append_op(core_desc.OpDesc(
+                        init_desc.type, dict(init_desc.inputs),
+                        {s: [psname if n == oname else n for n in ns]
+                         for s, ns in init_desc.outputs.items()},
+                        _attrs_of(init_desc)))
+                continue
+            # sliced: init the FULL var once (same initializer as the
+            # single-process run), then slice this server's rows
+            if oname not in created_full:
+                full_name = "%s.full@INIT" % oname
+                ovd = s_block.desc.find_var_recursive(oname)
+                gb.create_var(name=full_name, shape=list(ovd.shape),
+                              dtype=proto_to_np_dtype(ovd.dtype))
+                gb.desc.append_op(core_desc.OpDesc(
+                    init_desc.type, dict(init_desc.inputs),
+                    {s: [full_name if n == oname else n for n in ns]
+                     for s, ns in init_desc.outputs.items()},
+                    _attrs_of(init_desc)))
+                created_full[oname] = full_name
+            gb.create_var(name=psname, shape=list(blk.shape), dtype=dtype,
+                          persistable=True)
+            gb.desc.append_op(core_desc.OpDesc(
+                "slice", {"Input": [created_full[oname]]},
+                {"Out": [psname]},
+                {"axes": [0], "starts": [blk.row_start],
+                 "ends": [blk.row_start + blk.rows]}))
+        # rebuild the python-level op list from descs
+        gb.ops = [Operator(gb, d) for d in gb.desc.ops]
+        prog.desc.bump_version()
+        return prog

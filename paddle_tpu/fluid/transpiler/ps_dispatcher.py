@@ -1,0 +1,41 @@
+"""Parameter-block -> pserver dispatchers (parity:
+python/paddle/fluid/transpiler/ps_dispatcher.py)."""
+from __future__ import annotations
+
+__all__ = ["PSDispatcher", "RoundRobin", "HashName"]
+
+
+class PSDispatcher:
+    def __init__(self, pserver_endpoints):
+        self._eps = list(pserver_endpoints)
+        self._step = 0
+
+    @property
+    def eps(self):
+        return self._eps
+
+    def reset(self):
+        self._step = 0
+
+    def dispatch(self, varlist):
+        raise NotImplementedError
+
+
+class RoundRobin(PSDispatcher):
+    def dispatch(self, varlist):
+        out = []
+        for _ in varlist:
+            out.append(self._eps[self._step % len(self._eps)])
+            self._step += 1
+        return out
+
+
+class HashName(PSDispatcher):
+    def dispatch(self, varlist):
+        import zlib
+
+        # stable across processes (builtin hash() is randomized per
+        # process, which would desync independently-transpiling workers)
+        return [self._eps[zlib.crc32(
+            (v if isinstance(v, str) else v.name).encode("utf-8"))
+            % len(self._eps)] for v in varlist]

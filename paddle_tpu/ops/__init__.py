@@ -16,4 +16,5 @@ from paddle_tpu.ops import (  # noqa: F401
     parallel_ops,
     sequence,
     control_flow,
+    distributed_ops,
 )

@@ -1,0 +1,131 @@
+"""Distributed (parameter-server) host ops: send / recv / send_barrier /
+fetch_barrier / listen_and_serv.
+
+Parity: reference operators/{send,recv,send_barrier,fetch_barrier,
+listen_and_serv}_op.cc over the gRPC service (operators/detail/).  All run
+on the host at the tail/head of a block, so the device step stays ONE
+compiled XLA program; parameter traffic is numpy over gRPC
+(paddle_tpu/distributed/rpc.py).
+
+Wire layout used by the transpiler (fluid/transpiler/distribute_transpiler.py):
+- ``send``: X=[grad]; attrs ``epmap`` (endpoint per block), ``sections``
+  (rows per block, axis 0), ``block_names``.  The host splits the grad
+  and ships each slice to its pserver.
+- ``recv``: Out=[param]; same attrs — fetches every slice (blocking on the
+  sync round) and concatenates into the param var.
+- ``listen_and_serv``: attrs ``endpoint``, ``Fanin``, ``sync_mode``,
+  ``grad_to_block_id`` ("gradname:blockidx" strings); blocks serving until
+  every trainer sends SendComplete.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from paddle_tpu.core.registry import register_op
+
+
+def _host(name):
+    def deco(impl):
+        register_op(name, lower=impl, host_op=True, grad_maker=None)
+        return impl
+
+    return deco
+
+
+def _read(name, scope, env):
+    if env is not None and name in env:
+        return np.asarray(env[name])
+    return np.asarray(scope.find_var(name))
+
+
+def _write(name, val, scope, env):
+    if env is not None:
+        env[name] = val
+    s = scope.find_scope_of(name) or scope
+    s.set(name, val)
+
+
+def _sections_starts(sections):
+    starts = [0]
+    for s in sections:
+        starts.append(starts[-1] + s)
+    return starts
+
+
+@_host("send")
+def _send(executor, op, scope, feed, env=None):
+    from paddle_tpu.distributed.rpc import RPCClient
+
+    client = RPCClient.instance()
+    name = op.input("X")[0]
+    val = _read(name, scope, env)
+    eps = op.attr("epmap")
+    names = op.attr("block_names")
+    sections = op.attr("sections")
+    starts = _sections_starts(sections)
+    client.send_vars([
+        (ep, bname,
+         val[starts[i]:starts[i + 1]] if len(eps) > 1 else val)
+        for i, (ep, bname) in enumerate(zip(eps, names))])
+
+
+@_host("recv")
+def _recv(executor, op, scope, feed, env=None):
+    from paddle_tpu.distributed.rpc import RPCClient
+
+    client = RPCClient.instance()
+    out = op.output("Out")[0]
+    eps = op.attr("epmap")
+    names = op.attr("block_names")
+    parts = client.get_vars(list(zip(eps, names)))
+    val = parts[0] if len(parts) == 1 else np.concatenate(parts, axis=0)
+    _write(out, val, scope, env)
+
+
+@_host("send_barrier")
+def _send_barrier(executor, op, scope, feed, env=None):
+    from paddle_tpu.distributed.rpc import RPCClient
+
+    RPCClient.instance().send_barrier(op.attr("endpoints"))
+
+
+@_host("fetch_barrier")
+def _fetch_barrier(executor, op, scope, feed, env=None):
+    from paddle_tpu.distributed.rpc import RPCClient
+
+    RPCClient.instance().fetch_barrier(op.attr("endpoints"))
+
+
+@_host("listen_and_serv")
+def _listen_and_serv(executor, op, scope, feed, env=None):
+    """Serve until all trainers complete (reference
+    listen_and_serv_op.cc:99 RunSyncLoop / :166 RunAsyncLoop).  Optimize
+    sub-blocks run through a nested ExecutorCore against the server
+    scope."""
+    from paddle_tpu.core.executor_impl import ExecutorCore
+    from paddle_tpu.distributed.rpc import VariableServer
+
+    program = executor._current_program
+    endpoint = op.attr("endpoint")
+    fanin = int(op.attr("Fanin", 1))
+    sync_mode = bool(op.attr("sync_mode", True))
+    grad_to_block = {}
+    for item in op.attr("grad_to_block_id", []):
+        gname, bid = item.rsplit(":", 1)
+        grad_to_block[gname] = int(bid)
+
+    sub_exec = ExecutorCore(executor.place)
+
+    def apply_block(block_id):
+        sub_exec.run(program, scope, block_id=block_id)
+
+    server = VariableServer(scope, grad_to_block, apply_block, fanin,
+                            sync_mode)
+    port = server.start(endpoint)
+    port_file = op.attr("port_file", "")
+    if port_file:
+        # reference SavePort (listen_and_serv_op.cc:86): tests discover
+        # the chosen port through this file
+        with open(port_file, "w") as f:
+            f.write(str(port))
+    server.wait()

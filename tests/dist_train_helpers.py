@@ -1,0 +1,116 @@
+"""Spawn-able workers for the localhost pserver training test
+(reference test_dist_train.py forks pservers with multiprocessing and
+connects over localhost gRPC).  Top-level functions so the 'spawn' start
+method can pickle them."""
+import os
+import sys
+
+os.environ.setdefault("XLA_FLAGS",
+                      "--xla_force_host_platform_device_count=8")
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+# spawn children start with a fresh sys.path that lacks the repo root
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+import numpy as np
+
+N_FEAT = 48
+N_CLS = 10
+LR = 0.5
+
+
+def build_model():
+    import paddle_tpu.fluid as fluid
+
+    img = fluid.layers.data(name="img", shape=[N_FEAT], dtype="float32")
+    label = fluid.layers.data(name="label", shape=[1], dtype="int64")
+    # zero init everywhere -> every process starts from identical params,
+    # so sync-SGD losses must match the single-process run exactly
+    zinit = fluid.initializer.ConstantInitializer(0.0)
+    pred = fluid.layers.fc(
+        input=img, size=N_CLS, act="softmax",
+        param_attr=fluid.ParamAttr(name="fc_w", initializer=zinit),
+        bias_attr=fluid.ParamAttr(name="fc_b", initializer=zinit))
+    loss = fluid.layers.mean(
+        fluid.layers.cross_entropy(input=pred, label=label))
+    fluid.optimizer.SGD(learning_rate=LR).minimize(loss)
+    return loss
+
+
+def make_batch(step):
+    rng = np.random.RandomState(1234 + step)
+    x = rng.randn(64, N_FEAT).astype(np.float32)
+    proj = np.random.RandomState(7).randn(N_FEAT, N_CLS)
+    y = np.argmax(x @ proj, axis=1).astype(np.int64)[:, None]
+    return x, y
+
+
+def run_local_baseline(steps):
+    import paddle_tpu.fluid as fluid
+
+    main, startup = fluid.Program(), fluid.Program()
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        with fluid.program_guard(main, startup):
+            with fluid.unique_name.guard():
+                loss = build_model()
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        losses = []
+        for s in range(steps):
+            x, y = make_batch(s)
+            l, = exe.run(main, feed={"img": x, "label": y},
+                         fetch_list=[loss])
+            losses.append(float(np.ravel(l)[0]))
+    return losses
+
+
+def _transpile(trainer_id, pservers, trainers):
+    import paddle_tpu.fluid as fluid
+
+    main, startup = fluid.Program(), fluid.Program()
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        with fluid.program_guard(main, startup):
+            with fluid.unique_name.guard():
+                loss = build_model()
+    t = fluid.DistributeTranspiler()
+    t.transpile(trainer_id=trainer_id, program=main,
+                startup_program=startup, pservers=pservers,
+                trainers=trainers, min_block_size=64)
+    return t, main, startup, scope, loss
+
+
+def run_pserver(endpoint, pservers, trainers):
+    import paddle_tpu.fluid as fluid
+
+    t, main, startup, scope, loss = _transpile(0, pservers, trainers)
+    ps_prog = t.get_pserver_program(endpoint)
+    ps_startup = t.get_startup_program(endpoint, ps_prog)
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(scope):
+        exe.run(ps_startup)
+        exe.run(ps_prog)   # blocks until all trainers SendComplete
+
+
+def run_trainer(trainer_id, pservers, trainers, steps, queue):
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.distributed.rpc import RPCClient
+
+    t, main, startup, scope, loss = _transpile(trainer_id, pservers,
+                                               trainers)
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        losses = []
+        for s in range(steps):
+            # both trainers feed the SAME batch: the pserver's grad mean
+            # then equals the single-process grad, so losses must match
+            x, y = make_batch(s)
+            l, = exe.run(t.get_trainer_program(),
+                         feed={"img": x, "label": y}, fetch_list=[loss])
+            losses.append(float(np.ravel(l)[0]))
+    RPCClient.instance().send_complete(t.pserver_endpoints)
+    queue.put((trainer_id, losses))

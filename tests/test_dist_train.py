@@ -1,0 +1,78 @@
+"""End-to-end pserver training on localhost (reference test_dist_train.py):
+2 trainers x 2 pservers over gRPC, compared against the single-process
+run — zero-init + identical batches make sync-SGD losses match exactly
+(up to float accumulation order)."""
+import multiprocessing as mp
+import socket
+
+import numpy as np
+import pytest
+
+import dist_train_helpers as H
+
+
+def _baseline_to_queue(steps, queue):
+    queue.put(H.run_local_baseline(steps))
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def test_dist_train_matches_local():
+    import os
+
+    # spawn children as PURE-CPU jax processes: the axon TPU plugin
+    # registers at interpreter start (sitecustomize) gated on this env
+    # var, and its client init can block every jax call when the TPU
+    # tunnel is unavailable — pserver/trainer hosts never need it
+    os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+    os.environ["JAX_PLATFORMS"] = "cpu"
+
+    steps = 8
+    ctx = mp.get_context("spawn")
+    eps = ["127.0.0.1:%d" % _free_port() for _ in range(2)]
+    pservers = ",".join(eps)
+    n_trainers = 2
+
+    ps_procs = [ctx.Process(target=H.run_pserver,
+                            args=(ep, pservers, n_trainers))
+                for ep in eps]
+    for p in ps_procs:
+        p.start()
+
+    q = ctx.Queue()
+    tr_procs = [ctx.Process(target=H.run_trainer,
+                            args=(tid, pservers, n_trainers, steps, q))
+                for tid in range(n_trainers)]
+    for p in tr_procs:
+        p.start()
+
+    results = {}
+    for _ in range(n_trainers):
+        tid, losses = q.get(timeout=240)
+        results[tid] = losses
+    for p in tr_procs:
+        p.join(timeout=60)
+    for p in ps_procs:
+        p.join(timeout=60)
+        if p.is_alive():
+            p.terminate()
+            pytest.fail("pserver did not shut down after SendComplete")
+
+    # baseline in a spawned child too: the pytest parent may have the
+    # axon TPU plugin registered (interpreter start), and its client
+    # init can block every jax call when the tunnel is down
+    bq = ctx.Queue()
+    bp = ctx.Process(target=_baseline_to_queue, args=(steps, bq))
+    bp.start()
+    local = bq.get(timeout=240)
+    bp.join(timeout=60)
+    for tid in range(n_trainers):
+        np.testing.assert_allclose(results[tid], local, rtol=1e-4,
+                                   atol=1e-5)
+    assert local[-1] < local[0] * 0.8  # actually learning

@@ -1,0 +1,87 @@
+"""Pure graph tests of the DistributeTranspiler (reference
+test_dist_transpiler.py / test_simple_dist_transpiler.py: transpile, then
+assert on the resulting trainer/pserver op lists — no processes)."""
+import numpy as np
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid.transpiler import slice_variable
+
+
+def _build_net():
+    x = fluid.layers.data(name="x", shape=[1000], dtype="float32")
+    y = fluid.layers.data(name="y", shape=[1], dtype="float32")
+    y_predict = fluid.layers.fc(input=x, size=1000, act=None,
+                                param_attr=fluid.ParamAttr(name="fc_w"),
+                                bias_attr=fluid.ParamAttr(name="fc_b"))
+    loss = fluid.layers.mean(
+        fluid.layers.square_error_cost(input=y_predict, label=y))
+    sgd = fluid.optimizer.SGD(learning_rate=0.1)
+    sgd.minimize(loss)
+    return loss
+
+
+def test_slice_variable():
+    blocks = slice_variable([("w", [1000, 100]), ("tiny", [8])],
+                            slice_count=4, min_block_size=8192)
+    assert len(blocks["tiny"]) == 1 and blocks["tiny"][0].block_id == -1
+    ws = blocks["w"]
+    assert len(ws) == 4
+    assert sum(b.rows for b in ws) == 1000
+    assert ws[0].name == "w.block0" and ws[0].shape == [250, 100]
+    offs = [b.row_start for b in ws]
+    assert offs == [0, 250, 500, 750]
+
+
+def test_transpile_trainer_and_pserver_programs(prog_scope):
+    main, startup, scope = prog_scope
+    _build_net()
+    t = fluid.DistributeTranspiler()
+    t.transpile(trainer_id=0, program=main, startup_program=startup,
+                pservers="127.0.0.1:6174,127.0.0.1:6175", trainers=2)
+
+    trainer = t.get_trainer_program()
+    types = [op.type for op in trainer.global_block().ops]
+    # optimize ops moved out; send/recv chain appended
+    assert "sgd" not in types
+    assert types.count("send") == 2          # fc_w grad + fc_b grad
+    assert types.count("recv") == 2
+    assert types.index("send_barrier") < types.index("recv")
+    assert types[-1] == "fetch_barrier"
+
+    eps = t.pserver_endpoints
+    total_opt_blocks = 0
+    served = []
+    for ep in eps:
+        ps = t.get_pserver_program(ep)
+        ps_types = [op.type for op in ps.global_block().ops]
+        assert ps_types == ["listen_and_serv"]
+        n_sub = len(ps.blocks) - 1
+        total_opt_blocks += n_sub
+        for b in ps.blocks[1:]:
+            assert [op.type for op in b.ops] == ["sgd"]
+        served.append(n_sub)
+        # startup program initializes this server's param slices
+        su = t.get_startup_program(ep, ps)
+        su_types = [op.type for op in su.global_block().ops]
+        assert any(tp == "slice" for tp in su_types) or n_sub == 0
+    # fc_w [1000,1000] slices over both pservers; fc_b [1000] fits one
+    # block; every (param block) gets exactly one optimize sub-block
+    assert total_opt_blocks == sum(
+        len(t.param_blocks[p]) for p, _ in t.params_grads)
+    assert all(n > 0 for n in served)
+
+
+def test_transpile_unsliced_small_var(prog_scope):
+    main, startup, scope = prog_scope
+    x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+    y = fluid.layers.data(name="y", shape=[1], dtype="float32")
+    p = fluid.layers.fc(input=x, size=1, act=None)
+    loss = fluid.layers.mean(fluid.layers.square_error_cost(p, y))
+    fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    t = fluid.DistributeTranspiler()
+    t.transpile(trainer_id=0, program=main, startup_program=startup,
+                pservers="127.0.0.1:6176", trainers=1)
+    for blocks in t.param_blocks.values():
+        assert len(blocks) == 1 and blocks[0].block_id == -1
+    ps = t.get_pserver_program("127.0.0.1:6176")
+    assert len(ps.blocks) == 3  # two params -> two optimize sub-blocks
