@@ -1,0 +1,85 @@
+"""SelectedRows: sparse row-subset gradient representation.
+
+Parity: reference framework/selected_rows.h:30 — a (rows, value) pair where
+``rows`` indexes into a logical [height, ...] tensor.  Produced by
+``lookup_table_grad`` when ``is_sparse=True``; consumed by the sparse paths
+of the optimizer ops (row-subset updates) and by the pserver send path
+(only touched rows travel).
+
+TPU-native notes: registered as a JAX pytree so SelectedRows flow through
+jit/scan/vjp like any tensor pair; ``rows`` keeps a STATIC length (number
+of looked-up ids, duplicates included) because XLA needs static shapes —
+duplicate rows are merged either implicitly (scatter-add) or explicitly
+(:func:`merge_rows`, sort + segment-sum) instead of by host-side dedup.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.tree_util.register_pytree_node_class
+class SelectedRows:
+    __slots__ = ("rows", "values", "height")
+
+    def __init__(self, rows, values, height):
+        self.rows = rows          # [K] int32 row indices (dups allowed)
+        self.values = values      # [K, ...] per-row values
+        self.height = height      # static int: dim 0 of the dense tensor
+
+    def tree_flatten(self):
+        return (self.rows, self.values), self.height
+
+    @classmethod
+    def tree_unflatten(cls, height, children):
+        return cls(children[0], children[1], height)
+
+    @property
+    def dense_shape(self):
+        return (self.height,) + tuple(self.values.shape[1:])
+
+    def to_dense(self):
+        """Scatter-add into the dense [height, ...] tensor (reference
+        SelectedRows -> Tensor conversion; dup rows accumulate)."""
+        dense = jnp.zeros(self.dense_shape, self.values.dtype)
+        return dense.at[self.rows].add(self.values)
+
+    def scale(self, factor):
+        return SelectedRows(self.rows, self.values * factor, self.height)
+
+    def __repr__(self):
+        return "SelectedRows(rows=%s, values=%s, height=%d)" % (
+            getattr(self.rows, "shape", None),
+            getattr(self.values, "shape", None), self.height)
+
+
+def concat_rows(srs):
+    """Sum of several SelectedRows over the same dense shape: concatenated
+    rows/values (scatter-add semantics make concatenation a sum)."""
+    assert len({s.height for s in srs}) == 1
+    return SelectedRows(
+        jnp.concatenate([s.rows for s in srs], axis=0),
+        jnp.concatenate([s.values for s in srs], axis=0),
+        srs[0].height)
+
+
+def merge_rows(sr):
+    """Merge duplicate rows by summation, keeping the static length K
+    (reference math::scatter::MergeAdd).  Returns a SelectedRows whose
+    inactive slots point at row == height — out-of-bounds scatter updates
+    are DROPPED by XLA, so row-subset consumers can scatter the merged
+    result directly."""
+    k = sr.rows.shape[0]
+    order = jnp.argsort(sr.rows)
+    r = sr.rows[order]
+    v = sr.values[order]
+    is_start = jnp.concatenate(
+        [jnp.ones((1,), bool), r[1:] != r[:-1]]) if k > 1 else \
+        jnp.ones((k,), bool)
+    seg = jnp.cumsum(is_start.astype(jnp.int32)) - 1      # [K] segment ids
+    merged_vals = jax.ops.segment_sum(v, seg, num_segments=k)
+    # representative row per segment; inactive segments -> height (dropped)
+    rep = jax.ops.segment_min(r, seg, num_segments=k)
+    n_seg = seg[-1] + 1
+    rows_m = jnp.where(jnp.arange(k) < n_seg, rep, sr.height)
+    return SelectedRows(rows_m.astype(jnp.int32), merged_vals, sr.height)

@@ -27,20 +27,40 @@ SERVICE = "paddle_tpu.PServer"
 
 
 def _enc_tensor(name, arr, extra=0):
+    """Wire format: name | extra | kind (0 dense, 1 SelectedRows) | arrays.
+    SelectedRows travel as (rows, values, height) — reference
+    VariableMessage's SELECTED_ROWS type (send_recv.proto:48)."""
+    from paddle_tpu.core.selected_rows import SelectedRows
+
     buf = io.BytesIO()
     nb = name.encode("utf-8")
     buf.write(len(nb).to_bytes(4, "little"))
     buf.write(nb)
     buf.write(int(extra).to_bytes(8, "little", signed=True))
-    np.save(buf, np.asarray(arr), allow_pickle=False)
+    if isinstance(arr, SelectedRows):
+        buf.write(b"\x01")
+        buf.write(int(arr.height).to_bytes(8, "little"))
+        np.save(buf, np.asarray(arr.rows), allow_pickle=False)
+        np.save(buf, np.asarray(arr.values), allow_pickle=False)
+    else:
+        buf.write(b"\x00")
+        np.save(buf, np.asarray(arr), allow_pickle=False)
     return buf.getvalue()
 
 
 def _dec_tensor(data):
+    from paddle_tpu.core.selected_rows import SelectedRows
+
     buf = io.BytesIO(data)
     n = int.from_bytes(buf.read(4), "little")
     name = buf.read(n).decode("utf-8")
     extra = int.from_bytes(buf.read(8), "little", signed=True)
+    kind = buf.read(1)
+    if kind == b"\x01":
+        height = int.from_bytes(buf.read(8), "little")
+        rows = np.load(buf, allow_pickle=False)
+        values = np.load(buf, allow_pickle=False)
+        return name, SelectedRows(rows, values, height), extra
     arr = np.load(buf, allow_pickle=False)
     return name, arr, extra
 
@@ -165,12 +185,24 @@ class VariableServer:
 
     # -- application (lock held) --
     def _apply_one(self, gname):
+        from paddle_tpu.core.selected_rows import SelectedRows
+
         vals = self._pending[gname]
         if not vals:
             return
-        agg = vals[0] if len(vals) == 1 else (
-            np.sum(vals, axis=0) / len(vals))
-        self.scope.set(gname, np.asarray(agg))
+        if any(isinstance(v, SelectedRows) for v in vals):
+            # mean of sparse grads = concatenated rows, values / N
+            # (scatter-add makes concatenation a sum)
+            agg = SelectedRows(
+                np.concatenate([np.asarray(v.rows) for v in vals]),
+                np.concatenate([np.asarray(v.values) for v in vals])
+                / len(vals),
+                vals[0].height)
+        elif len(vals) == 1:
+            agg = np.asarray(vals[0])
+        else:
+            agg = np.sum(vals, axis=0) / len(vals)
+        self.scope.set(gname, agg)
         self._pending[gname] = []
         self.apply_block(self.grad_to_block[gname])
 

@@ -33,9 +33,14 @@ def _host(name):
 
 
 def _read(name, scope, env):
-    if env is not None and name in env:
-        return np.asarray(env[name])
-    return np.asarray(scope.find_var(name))
+    from paddle_tpu.core.selected_rows import SelectedRows
+
+    val = (env[name] if env is not None and name in env
+           else scope.find_var(name))
+    if isinstance(val, SelectedRows):
+        return SelectedRows(np.asarray(val.rows), np.asarray(val.values),
+                            val.height)
+    return np.asarray(val)
 
 
 def _write(name, val, scope, env):
@@ -63,10 +68,23 @@ def _send(executor, op, scope, feed, env=None):
     names = op.attr("block_names")
     sections = op.attr("sections")
     starts = _sections_starts(sections)
-    client.send_vars([
-        (ep, bname,
-         val[starts[i]:starts[i + 1]] if len(eps) > 1 else val)
-        for i, (ep, bname) in enumerate(zip(eps, names))])
+    from paddle_tpu.core.selected_rows import SelectedRows
+
+    triples = []
+    for i, (ep, bname) in enumerate(zip(eps, names)):
+        if isinstance(val, SelectedRows):
+            if len(eps) == 1:
+                part = val
+            else:
+                # split_ids by row range, re-based to the block's origin
+                # (reference split_selected_rows_op.cc)
+                m = (val.rows >= starts[i]) & (val.rows < starts[i + 1])
+                part = SelectedRows(val.rows[m] - starts[i],
+                                    val.values[m], sections[i])
+        else:
+            part = val[starts[i]:starts[i + 1]] if len(eps) > 1 else val
+        triples.append((ep, bname, part))
+    client.send_vars(triples)
 
 
 @_host("recv")

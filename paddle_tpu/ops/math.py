@@ -149,9 +149,14 @@ def _matmul(ctx, ins, attrs, op):
 
 @register_op("scale")
 def _scale(ctx, ins, attrs, op):
+    from paddle_tpu.core.selected_rows import SelectedRows
+
     x = ins["X"]
     scale = attrs.get("scale", 1.0)
     bias = attrs.get("bias", 0.0)
+    if isinstance(x, SelectedRows):   # grad scaling of sparse grads
+        assert bias == 0.0, "scale(SelectedRows) supports bias=0 only"
+        return {"Out": x.scale(scale)}
     if attrs.get("bias_after_scale", True):
         return {"Out": x * scale + bias}
     return {"Out": (x + bias) * scale}
@@ -159,7 +164,17 @@ def _scale(ctx, ins, attrs, op):
 
 @register_op("sum")
 def _sum(ctx, ins, attrs, op):
+    from paddle_tpu.core.selected_rows import SelectedRows, concat_rows
+
     xs = [x for x in ins.list("X") if x is not None]
+    sparse = [isinstance(x, SelectedRows) for x in xs]
+    if all(sparse) and xs:
+        # sum of sparse grads = concatenated rows (scatter-add semantics),
+        # reference operators/sum_op SelectedRows kernel
+        return {"Out": concat_rows(xs)}
+    if any(sparse):
+        xs = [x.to_dense() if isinstance(x, SelectedRows) else x
+              for x in xs]
     out = xs[0]
     for x in xs[1:]:
         out = out + x

@@ -11,6 +11,7 @@ from __future__ import annotations
 import jax.numpy as jnp
 
 from paddle_tpu.core.registry import register_op
+from paddle_tpu.core.selected_rows import SelectedRows, merge_rows
 
 
 def _lr(ins):
@@ -19,6 +20,12 @@ def _lr(ins):
 
 @register_op("sgd", grad_maker=None)
 def _sgd(ctx, ins, attrs, op):
+    g = ins["Grad"]
+    if isinstance(g, SelectedRows):
+        # sparse path (reference sgd_op.h SelectedRows kernel): scatter-add
+        # touches only the looked-up rows; duplicates accumulate
+        return {"ParamOut":
+                ins["Param"].at[g.rows].add(-_lr(ins) * g.values)}
     return {"ParamOut": ins["Param"] - _lr(ins) * ins["Grad"]}
 
 
@@ -44,6 +51,21 @@ def _adam(ctx, ins, attrs, op):
     b2 = attrs.get("beta2", 0.999)
     eps = attrs.get("epsilon", 1e-8)
     lr = _lr(ins) * jnp.sqrt(1 - b2p) / (1 - b1p)
+    if isinstance(g, SelectedRows):
+        # sparse (lazy) path, reference adam_op.h SelectedRows kernel:
+        # duplicates merged first, then moments/param updated only at the
+        # touched rows (out-of-bounds rows of the merge are dropped)
+        sr = merge_rows(g)
+        rows = jnp.clip(sr.rows, 0, sr.height - 1)  # safe gather indices
+        m1_r, m2_r, p_r = m1[rows], m2[rows], p[rows]
+        m1_n = b1 * m1_r + (1 - b1) * sr.values
+        m2_n = b2 * m2_r + (1 - b2) * jnp.square(sr.values)
+        p_n = p_r - lr * m1_n / (jnp.sqrt(m2_n) + eps)
+        return {"ParamOut": p.at[sr.rows].set(p_n),
+                "Moment1Out": m1.at[sr.rows].set(m1_n),
+                "Moment2Out": m2.at[sr.rows].set(m2_n),
+                "Beta1PowOut": ins["Beta1Pow"] * b1,
+                "Beta2PowOut": ins["Beta2Pow"] * b2}
     m1_out = b1 * m1 + (1 - b1) * g
     m2_out = b2 * m2 + (1 - b2) * jnp.square(g)
     p_out = p - lr * m1_out / (jnp.sqrt(m2_out) + eps)
@@ -72,6 +94,13 @@ def _adamax(ctx, ins, attrs, op):
 def _adagrad(ctx, ins, attrs, op):
     p, g, m = ins["Param"], ins["Grad"], ins["Moment"]
     eps = attrs.get("epsilon", 1e-6)
+    if isinstance(g, SelectedRows):
+        sr = merge_rows(g)
+        rows = jnp.clip(sr.rows, 0, sr.height - 1)
+        m_n = m[rows] + jnp.square(sr.values)
+        p_n = p[rows] - _lr(ins) * sr.values / (jnp.sqrt(m_n) + eps)
+        return {"ParamOut": p.at[sr.rows].set(p_n),
+                "MomentOut": m.at[sr.rows].set(m_n)}
     m_out = m + jnp.square(g)
     p_out = p - _lr(ins) * g / (jnp.sqrt(m_out) + eps)
     return {"ParamOut": p_out, "MomentOut": m_out}

@@ -227,20 +227,48 @@ def _fill(ctx, ins, attrs, op):
                                dtype=dtype)}
 
 
+def _lookup_idx(ids):
+    idx = ids.reshape(ids.shape[:-1]) if ids.shape[-1] == 1 else ids
+    return idx.astype(jnp.int32)
+
+
 @register_op("lookup_table")
 def _lookup_table(ctx, ins, attrs, op):
     """Embedding lookup (reference lookup_table_op.cc).  Ids [..., 1] int64.
-    The gather's vjp is a scatter-add, which XLA lowers efficiently; the
-    is_sparse SelectedRows path is handled by the pserver transpiler."""
+    The gather's vjp is a scatter-add, which XLA lowers efficiently; with
+    is_sparse=True the explicit grad lowering below emits a SelectedRows
+    instead of materializing the [V, D] dense grad."""
     w, ids = ins["W"], ins["Ids"]
     padding_idx = attrs.get("padding_idx", -1)
-    idx = ids.reshape(ids.shape[:-1]) if ids.shape[-1] == 1 else ids
-    idx = idx.astype(jnp.int32)
+    idx = _lookup_idx(ids)
     out = jnp.take(w, idx, axis=0)
     if padding_idx != -1:
         mask = (idx == padding_idx)[..., None]
         out = jnp.where(mask, jnp.zeros_like(out), out)
     return {"Out": out}
+
+
+@register_op("lookup_table_grad", grad_maker=None)
+def _lookup_table_grad(ctx, ins, attrs, op):
+    """W@GRAD of the lookup: SelectedRows (rows = the looked-up ids,
+    values = the out-grad rows) when is_sparse, else dense scatter-add
+    (reference lookup_table_op.cc grad kernels + selected_rows_functor)."""
+    from paddle_tpu.core.selected_rows import SelectedRows
+
+    w, ids, g = ins["W"], ins["Ids"], ins["Out@GRAD"]
+    padding_idx = attrs.get("padding_idx", -1)
+    idx = _lookup_idx(ids)
+    d = w.shape[1]
+    rows = idx.reshape(-1)
+    vals = g.reshape(-1, d).astype(w.dtype)
+    if padding_idx != -1:
+        # vjp of the padding mask: those rows contribute nothing
+        vals = jnp.where((rows == padding_idx)[:, None],
+                         jnp.zeros_like(vals), vals)
+    if attrs.get("is_sparse", False):
+        return {"W@GRAD": SelectedRows(rows, vals, int(w.shape[0]))}
+    dense = jnp.zeros_like(w).at[rows].add(vals)
+    return {"W@GRAD": dense}
 
 
 @register_op("multiplex")

@@ -21,14 +21,29 @@ N_CLS = 10
 LR = 0.5
 
 
-def build_model():
+def build_model(kind="softmax"):
     import paddle_tpu.fluid as fluid
 
-    img = fluid.layers.data(name="img", shape=[N_FEAT], dtype="float32")
-    label = fluid.layers.data(name="label", shape=[1], dtype="int64")
     # zero init everywhere -> every process starts from identical params,
     # so sync-SGD losses must match the single-process run exactly
     zinit = fluid.initializer.ConstantInitializer(0.0)
+    if kind in ("emb_sparse", "emb_dense"):
+        ids = fluid.layers.data(name="ids", shape=[4], dtype="int64")
+        y = fluid.layers.data(name="y", shape=[1], dtype="float32")
+        emb = fluid.layers.embedding(
+            ids, size=[50, 8], is_sparse=(kind == "emb_sparse"),
+            param_attr=fluid.ParamAttr(name="emb_w", initializer=zinit))
+        pooled = fluid.layers.reduce_mean(emb, dim=1)   # [N, 8]
+        pred = fluid.layers.fc(
+            input=pooled, size=1,
+            param_attr=fluid.ParamAttr(name="fc_w", initializer=zinit),
+            bias_attr=fluid.ParamAttr(name="fc_b", initializer=zinit))
+        loss = fluid.layers.mean(
+            fluid.layers.square_error_cost(input=pred, label=y))
+        fluid.optimizer.SGD(learning_rate=LR).minimize(loss)
+        return loss
+    img = fluid.layers.data(name="img", shape=[N_FEAT], dtype="float32")
+    label = fluid.layers.data(name="label", shape=[1], dtype="int64")
     pred = fluid.layers.fc(
         input=img, size=N_CLS, act="softmax",
         param_attr=fluid.ParamAttr(name="fc_w", initializer=zinit),
@@ -39,15 +54,19 @@ def build_model():
     return loss
 
 
-def make_batch(step):
+def make_batch(step, kind="softmax"):
     rng = np.random.RandomState(1234 + step)
+    if kind in ("emb_sparse", "emb_dense"):
+        ids = rng.randint(0, 50, (32, 4)).astype(np.int64)
+        y = (np.sin(ids).sum(1, keepdims=True) * 0.1).astype(np.float32)
+        return {"ids": ids, "y": y}
     x = rng.randn(64, N_FEAT).astype(np.float32)
     proj = np.random.RandomState(7).randn(N_FEAT, N_CLS)
     y = np.argmax(x @ proj, axis=1).astype(np.int64)[:, None]
-    return x, y
+    return {"img": x, "label": y}
 
 
-def run_local_baseline(steps):
+def run_local_baseline(steps, kind="softmax"):
     import paddle_tpu.fluid as fluid
 
     main, startup = fluid.Program(), fluid.Program()
@@ -55,19 +74,18 @@ def run_local_baseline(steps):
     with fluid.scope_guard(scope):
         with fluid.program_guard(main, startup):
             with fluid.unique_name.guard():
-                loss = build_model()
+                loss = build_model(kind)
         exe = fluid.Executor(fluid.CPUPlace())
         exe.run(startup)
         losses = []
         for s in range(steps):
-            x, y = make_batch(s)
-            l, = exe.run(main, feed={"img": x, "label": y},
+            l, = exe.run(main, feed=make_batch(s, kind),
                          fetch_list=[loss])
             losses.append(float(np.ravel(l)[0]))
     return losses
 
 
-def _transpile(trainer_id, pservers, trainers):
+def _transpile(trainer_id, pservers, trainers, kind="softmax"):
     import paddle_tpu.fluid as fluid
 
     main, startup = fluid.Program(), fluid.Program()
@@ -75,7 +93,7 @@ def _transpile(trainer_id, pservers, trainers):
     with fluid.scope_guard(scope):
         with fluid.program_guard(main, startup):
             with fluid.unique_name.guard():
-                loss = build_model()
+                loss = build_model(kind)
     t = fluid.DistributeTranspiler()
     t.transpile(trainer_id=trainer_id, program=main,
                 startup_program=startup, pservers=pservers,
@@ -83,10 +101,11 @@ def _transpile(trainer_id, pservers, trainers):
     return t, main, startup, scope, loss
 
 
-def run_pserver(endpoint, pservers, trainers):
+def run_pserver(endpoint, pservers, trainers, kind="softmax"):
     import paddle_tpu.fluid as fluid
 
-    t, main, startup, scope, loss = _transpile(0, pservers, trainers)
+    t, main, startup, scope, loss = _transpile(0, pservers, trainers,
+                                               kind)
     ps_prog = t.get_pserver_program(endpoint)
     ps_startup = t.get_startup_program(endpoint, ps_prog)
     exe = fluid.Executor(fluid.CPUPlace())
@@ -95,12 +114,13 @@ def run_pserver(endpoint, pservers, trainers):
         exe.run(ps_prog)   # blocks until all trainers SendComplete
 
 
-def run_trainer(trainer_id, pservers, trainers, steps, queue):
+def run_trainer(trainer_id, pservers, trainers, steps, queue,
+                kind="softmax"):
     import paddle_tpu.fluid as fluid
     from paddle_tpu.distributed.rpc import RPCClient
 
     t, main, startup, scope, loss = _transpile(trainer_id, pservers,
-                                               trainers)
+                                               trainers, kind)
     exe = fluid.Executor(fluid.CPUPlace())
     with fluid.scope_guard(scope):
         exe.run(startup)
@@ -108,9 +128,8 @@ def run_trainer(trainer_id, pservers, trainers, steps, queue):
         for s in range(steps):
             # both trainers feed the SAME batch: the pserver's grad mean
             # then equals the single-process grad, so losses must match
-            x, y = make_batch(s)
             l, = exe.run(t.get_trainer_program(),
-                         feed={"img": x, "label": y}, fetch_list=[loss])
+                         feed=make_batch(s, kind), fetch_list=[loss])
             losses.append(float(np.ravel(l)[0]))
     RPCClient.instance().send_complete(t.pserver_endpoints)
     queue.put((trainer_id, losses))

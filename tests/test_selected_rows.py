@@ -1,0 +1,123 @@
+"""SelectedRows sparse gradients (reference framework/selected_rows.h:30,
+lookup_table_op.cc sparse grad kernel, sgd/adam SelectedRows kernels,
+sum_op SelectedRows kernel, split_selected_rows_op.cc)."""
+import numpy as np
+
+import paddle_tpu.fluid as fluid
+
+
+def test_merge_rows_unit():
+    import jax.numpy as jnp
+    from paddle_tpu.core.selected_rows import SelectedRows, merge_rows
+
+    sr = SelectedRows(jnp.asarray([3, 1, 3, 0], jnp.int32),
+                      jnp.asarray([[1.], [2.], [10.], [4.]]), height=5)
+    m = merge_rows(sr)
+    dense = np.zeros((5, 1), np.float32)
+    for r, v in zip([3, 1, 3, 0], [1., 2., 10., 4.]):
+        dense[r, 0] += v
+    np.testing.assert_allclose(np.asarray(m.to_dense()), dense)
+    # inactive slots point out of bounds so scatters drop them
+    rows = np.asarray(m.rows)
+    assert (rows == 5).sum() == 1  # 4 entries, 3 unique
+
+
+def _train_embedding(is_sparse, optimizer, steps=12, seed=0):
+    main, startup = fluid.Program(), fluid.Program()
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        with fluid.program_guard(main, startup):
+            with fluid.unique_name.guard():
+                ids = fluid.layers.data(name="ids", shape=[6],
+                                        dtype="int64")
+                y = fluid.layers.data(name="y", shape=[1],
+                                      dtype="float32")
+                emb = fluid.layers.embedding(
+                    ids, size=[40, 8], is_sparse=is_sparse,
+                    param_attr=fluid.ParamAttr(
+                        name="emb_w",
+                        initializer=fluid.initializer.
+                        ConstantInitializer(0.05)))
+                pooled = fluid.layers.reduce_mean(emb, dim=1)
+                pred = fluid.layers.fc(
+                    input=pooled, size=1,
+                    param_attr=fluid.ParamAttr(
+                        name="w2", initializer=fluid.initializer.
+                        ConstantInitializer(0.1)))
+                loss = fluid.layers.mean(
+                    fluid.layers.square_error_cost(pred, y))
+                optimizer().minimize(loss)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        rng = np.random.RandomState(seed)
+        losses = []
+        for _ in range(steps):
+            idv = rng.randint(0, 40, (16, 6)).astype(np.int64)
+            yv = (np.cos(idv).sum(1, keepdims=True) * 0.2).astype(
+                np.float32)
+            l, = exe.run(main, feed={"ids": idv, "y": yv},
+                         fetch_list=[loss])
+            losses.append(float(np.ravel(l)[0]))
+        w = np.asarray(scope.find_var("emb_w"))
+    return losses, w
+
+
+def test_sparse_matches_dense_sgd():
+    """Scatter-add sparse SGD == dense SGD exactly."""
+    dense_l, dense_w = _train_embedding(
+        False, lambda: fluid.optimizer.SGD(learning_rate=0.1))
+    sparse_l, sparse_w = _train_embedding(
+        True, lambda: fluid.optimizer.SGD(learning_rate=0.1))
+    np.testing.assert_allclose(sparse_l, dense_l, rtol=1e-5)
+    np.testing.assert_allclose(sparse_w, dense_w, rtol=1e-5)
+    assert dense_l[-1] < dense_l[0] * 0.7
+
+
+def test_sparse_adam_trains():
+    """Lazy adam (row-subset moments) converges; not bitwise-equal to
+    dense adam by design (untouched rows don't decay)."""
+    losses, _ = _train_embedding(
+        True, lambda: fluid.optimizer.Adam(learning_rate=0.01), steps=25)
+    assert losses[-1] < losses[0] * 0.5, (losses[0], losses[-1])
+
+
+def test_sum_of_selected_rows():
+    """Two sparse grads into one table (shared embedding) sum correctly."""
+    main, startup = fluid.Program(), fluid.Program()
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        with fluid.program_guard(main, startup):
+            with fluid.unique_name.guard():
+                a = fluid.layers.data(name="a", shape=[3], dtype="int64")
+                b = fluid.layers.data(name="b", shape=[3], dtype="int64")
+                y = fluid.layers.data(name="y", shape=[1],
+                                      dtype="float32")
+                attr = fluid.ParamAttr(
+                    name="shared_w",
+                    initializer=fluid.initializer.ConstantInitializer(
+                        0.02))
+                ea = fluid.layers.embedding(a, size=[30, 4],
+                                            is_sparse=True,
+                                            param_attr=attr)
+                eb = fluid.layers.embedding(b, size=[30, 4],
+                                            is_sparse=True,
+                                            param_attr=attr)
+                merged = fluid.layers.elementwise_add(
+                    x=fluid.layers.reduce_mean(ea, dim=1),
+                    y=fluid.layers.reduce_mean(eb, dim=1))
+                pred = fluid.layers.fc(input=merged, size=1)
+                loss = fluid.layers.mean(
+                    fluid.layers.square_error_cost(pred, y))
+                fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        rng = np.random.RandomState(3)
+        ls = []
+        for _ in range(15):
+            av = rng.randint(0, 30, (8, 3)).astype(np.int64)
+            bv = rng.randint(0, 30, (8, 3)).astype(np.int64)
+            yv = rng.randn(8, 1).astype(np.float32) * 0.1
+            l, = exe.run(main, feed={"a": av, "b": bv, "y": yv},
+                         fetch_list=[loss])
+            ls.append(float(np.ravel(l)[0]))
+        assert ls[-1] < ls[0], ls
