@@ -70,6 +70,8 @@ def merge_rows(sr):
     are DROPPED by XLA, so row-subset consumers can scatter the merged
     result directly."""
     k = sr.rows.shape[0]
+    if k == 0:  # nothing to merge (e.g. a pserver block no id hit)
+        return sr
     order = jnp.argsort(sr.rows)
     r = sr.rows[order]
     v = sr.values[order]

@@ -77,10 +77,17 @@ def _send(executor, op, scope, feed, env=None):
                 part = val
             else:
                 # split_ids by row range, re-based to the block's origin
-                # (reference split_selected_rows_op.cc)
+                # (reference split_selected_rows_op.cc).  K stays STATIC:
+                # out-of-range slots point at the part's height (scatters
+                # drop them) so the pserver's jitted optimize block sees
+                # one shape per table and never recompiles per step.
                 m = (val.rows >= starts[i]) & (val.rows < starts[i + 1])
-                part = SelectedRows(val.rows[m] - starts[i],
-                                    val.values[m], sections[i])
+                rows = np.where(m, val.rows - starts[i],
+                                sections[i]).astype(np.int32)
+                vals = np.where(
+                    m.reshape((-1,) + (1,) * (val.values.ndim - 1)),
+                    val.values, 0)
+                part = SelectedRows(rows, vals, sections[i])
         else:
             part = val[starts[i]:starts[i + 1]] if len(eps) > 1 else val
         triples.append((ep, bname, part))

@@ -18,6 +18,13 @@ def _lr(ins):
     return ins["LearningRate"].reshape(())
 
 
+def _dense_grad(g):
+    """Optimizers without a row-subset kernel densify SelectedRows grads
+    (the reference errors out for ops lacking a SelectedRows kernel; we
+    fall back to the mathematically-identical dense update instead)."""
+    return g.to_dense() if isinstance(g, SelectedRows) else g
+
+
 @register_op("sgd", grad_maker=None)
 def _sgd(ctx, ins, attrs, op):
     g = ins["Grad"]
@@ -31,7 +38,7 @@ def _sgd(ctx, ins, attrs, op):
 
 @register_op("momentum", grad_maker=None)
 def _momentum(ctx, ins, attrs, op):
-    p, g, v = ins["Param"], ins["Grad"], ins["Velocity"]
+    p, g, v = ins["Param"], _dense_grad(ins["Grad"]), ins["Velocity"]
     mu = attrs.get("mu")
     lr = _lr(ins)
     v_out = mu * v + g
@@ -76,7 +83,7 @@ def _adam(ctx, ins, attrs, op):
 
 @register_op("adamax", grad_maker=None)
 def _adamax(ctx, ins, attrs, op):
-    p, g = ins["Param"], ins["Grad"]
+    p, g = ins["Param"], _dense_grad(ins["Grad"])
     m, inf = ins["Moment"], ins["InfNorm"]
     b1p = ins["Beta1Pow"].reshape(())
     b1 = attrs.get("beta1", 0.9)
@@ -108,7 +115,7 @@ def _adagrad(ctx, ins, attrs, op):
 
 @register_op("decayed_adagrad", grad_maker=None)
 def _decayed_adagrad(ctx, ins, attrs, op):
-    p, g, m = ins["Param"], ins["Grad"], ins["Moment"]
+    p, g, m = ins["Param"], _dense_grad(ins["Grad"]), ins["Moment"]
     decay = attrs.get("decay", 0.95)
     eps = attrs.get("epsilon", 1e-6)
     m_out = decay * m + (1 - decay) * jnp.square(g)
@@ -118,7 +125,7 @@ def _decayed_adagrad(ctx, ins, attrs, op):
 
 @register_op("adadelta", grad_maker=None)
 def _adadelta(ctx, ins, attrs, op):
-    p, g = ins["Param"], ins["Grad"]
+    p, g = ins["Param"], _dense_grad(ins["Grad"])
     avg_sq_g, avg_sq_u = ins["AvgSquaredGrad"], ins["AvgSquaredUpdate"]
     rho = attrs.get("rho", 0.95)
     eps = attrs.get("epsilon", 1e-6)
@@ -131,7 +138,7 @@ def _adadelta(ctx, ins, attrs, op):
 
 @register_op("rmsprop", grad_maker=None)
 def _rmsprop(ctx, ins, attrs, op):
-    p, g = ins["Param"], ins["Grad"]
+    p, g = ins["Param"], _dense_grad(ins["Grad"])
     ms, mom = ins["MeanSquare"], ins["Moment"]
     rho = attrs.get("decay", 0.9)
     eps = attrs.get("epsilon", 1e-10)
@@ -144,7 +151,7 @@ def _rmsprop(ctx, ins, attrs, op):
 
 @register_op("ftrl", grad_maker=None)
 def _ftrl(ctx, ins, attrs, op):
-    p, g = ins["Param"], ins["Grad"]
+    p, g = ins["Param"], _dense_grad(ins["Grad"])
     sq, lin = ins["SquaredAccumulator"], ins["LinearAccumulator"]
     l1 = attrs.get("l1", 0.0)
     l2 = attrs.get("l2", 0.0)
@@ -169,7 +176,7 @@ def _ftrl(ctx, ins, attrs, op):
 
 @register_op("proximal_gd", grad_maker=None)
 def _proximal_gd(ctx, ins, attrs, op):
-    p, g = ins["Param"], ins["Grad"]
+    p, g = ins["Param"], _dense_grad(ins["Grad"])
     l1 = attrs.get("l1", 0.0)
     l2 = attrs.get("l2", 0.0)
     lr = _lr(ins)
@@ -181,7 +188,7 @@ def _proximal_gd(ctx, ins, attrs, op):
 
 @register_op("proximal_adagrad", grad_maker=None)
 def _proximal_adagrad(ctx, ins, attrs, op):
-    p, g, m = ins["Param"], ins["Grad"], ins["Moment"]
+    p, g, m = ins["Param"], _dense_grad(ins["Grad"]), ins["Moment"]
     l1 = attrs.get("l1", 0.0)
     l2 = attrs.get("l2", 0.0)
     m_out = m + jnp.square(g)

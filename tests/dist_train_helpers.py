@@ -30,13 +30,20 @@ def build_model(kind="softmax"):
     if kind in ("emb_sparse", "emb_dense"):
         ids = fluid.layers.data(name="ids", shape=[4], dtype="int64")
         y = fluid.layers.data(name="y", shape=[1], dtype="float32")
+        # NON-zero constant inits (still identical across processes):
+        # with emb_w=fc_w=0 both grads vanish identically and the test
+        # could not distinguish a broken sparse path from a working one
         emb = fluid.layers.embedding(
             ids, size=[50, 8], is_sparse=(kind == "emb_sparse"),
-            param_attr=fluid.ParamAttr(name="emb_w", initializer=zinit))
+            param_attr=fluid.ParamAttr(
+                name="emb_w",
+                initializer=fluid.initializer.ConstantInitializer(0.02)))
         pooled = fluid.layers.reduce_mean(emb, dim=1)   # [N, 8]
         pred = fluid.layers.fc(
             input=pooled, size=1,
-            param_attr=fluid.ParamAttr(name="fc_w", initializer=zinit),
+            param_attr=fluid.ParamAttr(
+                name="fc_w",
+                initializer=fluid.initializer.ConstantInitializer(0.1)),
             bias_attr=fluid.ParamAttr(name="fc_b", initializer=zinit))
         loss = fluid.layers.mean(
             fluid.layers.square_error_cost(input=pred, label=y))
@@ -57,6 +64,9 @@ def build_model(kind="softmax"):
 def make_batch(step, kind="softmax"):
     rng = np.random.RandomState(1234 + step)
     if kind in ("emb_sparse", "emb_dense"):
+        # one FIXED batch (step-independent): squared loss on a linear
+        # model then descends monotonically, a clean learning signal
+        rng = np.random.RandomState(1234)
         ids = rng.randint(0, 50, (32, 4)).astype(np.int64)
         y = (np.sin(ids).sum(1, keepdims=True) * 0.1).astype(np.float32)
         return {"ids": ids, "y": y}

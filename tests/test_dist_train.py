@@ -1,7 +1,12 @@
 """End-to-end pserver training on localhost (reference test_dist_train.py):
 2 trainers x 2 pservers over gRPC, compared against the single-process
-run — zero-init + identical batches make sync-SGD losses match exactly
-(up to float accumulation order)."""
+run — constant inits + identical batches make sync-SGD losses match
+exactly (up to float accumulation order).
+
+The emb_sparse variant drives the full distributed SelectedRows path:
+lookup_table_grad -> send row-range split -> gRPC sparse wire format
+(kind=1) -> pserver sparse mean aggregation -> sparse sgd apply.
+"""
 import multiprocessing as mp
 import socket
 
@@ -11,8 +16,8 @@ import pytest
 import dist_train_helpers as H
 
 
-def _baseline_to_queue(steps, queue):
-    queue.put(H.run_local_baseline(steps))
+def _baseline_to_queue(steps, kind, queue):
+    queue.put(H.run_local_baseline(steps, kind))
 
 
 def _free_port():
@@ -23,7 +28,7 @@ def _free_port():
     return port
 
 
-def test_dist_train_matches_local():
+def _run_dist(kind, steps=8):
     import os
 
     # spawn children as PURE-CPU jax processes: the axon TPU plugin
@@ -33,21 +38,21 @@ def test_dist_train_matches_local():
     os.environ.pop("PALLAS_AXON_POOL_IPS", None)
     os.environ["JAX_PLATFORMS"] = "cpu"
 
-    steps = 8
     ctx = mp.get_context("spawn")
     eps = ["127.0.0.1:%d" % _free_port() for _ in range(2)]
     pservers = ",".join(eps)
     n_trainers = 2
 
     ps_procs = [ctx.Process(target=H.run_pserver,
-                            args=(ep, pservers, n_trainers))
+                            args=(ep, pservers, n_trainers, kind))
                 for ep in eps]
     for p in ps_procs:
         p.start()
 
     q = ctx.Queue()
     tr_procs = [ctx.Process(target=H.run_trainer,
-                            args=(tid, pservers, n_trainers, steps, q))
+                            args=(tid, pservers, n_trainers, steps, q,
+                                  kind))
                 for tid in range(n_trainers)]
     for p in tr_procs:
         p.start()
@@ -68,11 +73,23 @@ def test_dist_train_matches_local():
     # axon TPU plugin registered (interpreter start), and its client
     # init can block every jax call when the tunnel is down
     bq = ctx.Queue()
-    bp = ctx.Process(target=_baseline_to_queue, args=(steps, bq))
+    bp = ctx.Process(target=_baseline_to_queue, args=(steps, kind, bq))
     bp.start()
     local = bq.get(timeout=240)
     bp.join(timeout=60)
     for tid in range(n_trainers):
         np.testing.assert_allclose(results[tid], local, rtol=1e-4,
                                    atol=1e-5)
+    return local
+
+
+def test_dist_train_matches_local():
+    local = _run_dist("softmax")
     assert local[-1] < local[0] * 0.8  # actually learning
+
+
+def test_dist_train_sparse_embedding():
+    """Distributed SelectedRows: sparse grads travel the wire split by
+    row range and the pserver applies them; must match the local run."""
+    local = _run_dist("emb_sparse")
+    assert local[-1] < local[0]  # embedding actually moved

@@ -22,6 +22,80 @@ def test_merge_rows_unit():
     assert (rows == 5).sum() == 1  # 4 entries, 3 unique
 
 
+def test_merge_rows_empty():
+    import jax.numpy as jnp
+    from paddle_tpu.core.selected_rows import SelectedRows, merge_rows
+
+    sr = SelectedRows(jnp.zeros((0,), jnp.int32),
+                      jnp.zeros((0, 3), jnp.float32), height=7)
+    m = merge_rows(sr)
+    assert m.rows.shape == (0,)
+    np.testing.assert_allclose(np.asarray(m.to_dense()),
+                               np.zeros((7, 3), np.float32))
+
+
+def test_sparse_grad_with_momentum_densifies():
+    """Optimizers without a row-subset kernel fall back to the dense
+    update; sparse training must match dense training exactly."""
+    opt = lambda: fluid.optimizer.Momentum(learning_rate=0.05,
+                                           momentum=0.9)
+    dense_l, dense_w = _train_embedding(False, opt, steps=8)
+    sparse_l, sparse_w = _train_embedding(True, opt, steps=8)
+    np.testing.assert_allclose(sparse_l, dense_l, rtol=1e-5)
+    np.testing.assert_allclose(sparse_w, dense_w, rtol=1e-5)
+
+
+def test_split_selected_rows_static_shape():
+    """send's row-range split keeps K static and drops out-of-range rows
+    via height-pointing slots (no per-step recompiles on the pserver)."""
+    from paddle_tpu.core.selected_rows import SelectedRows
+
+    class FakeClient:
+        sent = []
+
+        @classmethod
+        def instance(cls):
+            return cls()
+
+        def send_vars(self, triples):
+            FakeClient.sent = triples
+
+    import paddle_tpu.distributed.rpc as rpc
+    from paddle_tpu.core.registry import get_op_info
+    from paddle_tpu.core.scope import Scope
+
+    orig = rpc.RPCClient
+    rpc.RPCClient = FakeClient
+    try:
+        scope = Scope()
+        sr = SelectedRows(np.asarray([1, 5, 9, 1], np.int32),
+                          np.arange(8, dtype=np.float32).reshape(4, 2),
+                          height=12)
+        scope.set("g", sr)
+
+        class FakeOp:
+            def input(self, _):
+                return ["g"]
+
+            def attr(self, name, default=None):
+                return {"epmap": ["a:1", "b:1"],
+                        "block_names": ["g.b0", "g.b1"],
+                        "sections": [6, 6]}.get(name, default)
+
+        get_op_info("send").lower(None, FakeOp(), scope, {}, env=None)
+        (ep0, _, p0), (ep1, _, p1) = FakeClient.sent
+        assert p0.rows.shape == (4,) and p1.rows.shape == (4,)
+        # block 0 holds rows [0,6): ids 1,5,1 kept; 9 -> height(6)=dropped
+        np.testing.assert_array_equal(p0.rows, [1, 5, 6, 1])
+        np.testing.assert_allclose(
+            np.asarray(p0.to_dense())[1], [0 + 6, 1 + 7])
+        # block 1 holds rows [6,12): id 9 -> 3; others dropped
+        np.testing.assert_array_equal(p1.rows, [6, 6, 3, 6])
+        np.testing.assert_allclose(np.asarray(p1.to_dense())[3], [4, 5])
+    finally:
+        rpc.RPCClient = orig
+
+
 def _train_embedding(is_sparse, optimizer, steps=12, seed=0):
     main, startup = fluid.Program(), fluid.Program()
     scope = fluid.Scope()
@@ -65,12 +139,13 @@ def _train_embedding(is_sparse, optimizer, steps=12, seed=0):
 def test_sparse_matches_dense_sgd():
     """Scatter-add sparse SGD == dense SGD exactly."""
     dense_l, dense_w = _train_embedding(
-        False, lambda: fluid.optimizer.SGD(learning_rate=0.1))
+        False, lambda: fluid.optimizer.SGD(learning_rate=0.1), steps=40)
     sparse_l, sparse_w = _train_embedding(
-        True, lambda: fluid.optimizer.SGD(learning_rate=0.1))
+        True, lambda: fluid.optimizer.SGD(learning_rate=0.1), steps=40)
     np.testing.assert_allclose(sparse_l, dense_l, rtol=1e-5)
     np.testing.assert_allclose(sparse_w, dense_w, rtol=1e-5)
-    assert dense_l[-1] < dense_l[0] * 0.7
+    # fresh random batches each step => noisy loss; compare windowed means
+    assert np.mean(dense_l[-4:]) < np.mean(dense_l[:4])
 
 
 def test_sparse_adam_trains():
@@ -81,8 +156,7 @@ def test_sparse_adam_trains():
     assert losses[-1] < losses[0] * 0.5, (losses[0], losses[-1])
 
 
-def test_sum_of_selected_rows():
-    """Two sparse grads into one table (shared embedding) sum correctly."""
+def _train_shared_embedding(is_sparse, steps=15):
     main, startup = fluid.Program(), fluid.Program()
     scope = fluid.Scope()
     with fluid.scope_guard(scope):
@@ -97,15 +171,19 @@ def test_sum_of_selected_rows():
                     initializer=fluid.initializer.ConstantInitializer(
                         0.02))
                 ea = fluid.layers.embedding(a, size=[30, 4],
-                                            is_sparse=True,
+                                            is_sparse=is_sparse,
                                             param_attr=attr)
                 eb = fluid.layers.embedding(b, size=[30, 4],
-                                            is_sparse=True,
+                                            is_sparse=is_sparse,
                                             param_attr=attr)
                 merged = fluid.layers.elementwise_add(
                     x=fluid.layers.reduce_mean(ea, dim=1),
                     y=fluid.layers.reduce_mean(eb, dim=1))
-                pred = fluid.layers.fc(input=merged, size=1)
+                pred = fluid.layers.fc(
+                    input=merged, size=1,
+                    param_attr=fluid.ParamAttr(
+                        name="w_out", initializer=fluid.initializer.
+                        ConstantInitializer(0.1)))
                 loss = fluid.layers.mean(
                     fluid.layers.square_error_cost(pred, y))
                 fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
@@ -113,11 +191,81 @@ def test_sum_of_selected_rows():
         exe.run(startup)
         rng = np.random.RandomState(3)
         ls = []
-        for _ in range(15):
+        for _ in range(steps):
             av = rng.randint(0, 30, (8, 3)).astype(np.int64)
             bv = rng.randint(0, 30, (8, 3)).astype(np.int64)
             yv = rng.randn(8, 1).astype(np.float32) * 0.1
             l, = exe.run(main, feed={"a": av, "b": bv, "y": yv},
                          fetch_list=[loss])
             ls.append(float(np.ravel(l)[0]))
-        assert ls[-1] < ls[0], ls
+        w = np.asarray(scope.find_var("shared_w"))
+    return ls, w
+
+
+def test_sum_of_selected_rows():
+    """Two sparse grads into one table (shared embedding) sum correctly:
+    the sparse path must match the dense path exactly."""
+    dense_l, dense_w = _train_shared_embedding(False)
+    sparse_l, sparse_w = _train_shared_embedding(True)
+    np.testing.assert_allclose(sparse_l, dense_l, rtol=1e-5)
+    np.testing.assert_allclose(sparse_w, dense_w, rtol=1e-5)
+    # weights actually moved (grads flowed through both branches)
+    assert not np.allclose(sparse_w, 0.02)
+
+
+def test_shared_table_grads_sum_one_step():
+    """Analytical pin: both embedding branches see ids {0,1,2}, so after
+    one SGD step each touched row must move by lr * 2*pred*(2/3)*w_out —
+    the factor 2 only appears if the two branches' grads are summed."""
+    import paddle_tpu.fluid as fluid
+
+    for is_sparse in (False, True):
+        main, startup = fluid.Program(), fluid.Program()
+        scope = fluid.Scope()
+        with fluid.scope_guard(scope):
+            with fluid.program_guard(main, startup):
+                with fluid.unique_name.guard():
+                    a = fluid.layers.data(name="a", shape=[3],
+                                          dtype="int64")
+                    b = fluid.layers.data(name="b", shape=[3],
+                                          dtype="int64")
+                    y = fluid.layers.data(name="y", shape=[1],
+                                          dtype="float32")
+                    attr = fluid.ParamAttr(
+                        name="shared_w",
+                        initializer=fluid.initializer.
+                        ConstantInitializer(0.02))
+                    ea = fluid.layers.embedding(
+                        a, size=[30, 4], is_sparse=is_sparse,
+                        param_attr=attr)
+                    eb = fluid.layers.embedding(
+                        b, size=[30, 4], is_sparse=is_sparse,
+                        param_attr=attr)
+                    merged = fluid.layers.elementwise_add(
+                        x=fluid.layers.reduce_mean(ea, dim=1),
+                        y=fluid.layers.reduce_mean(eb, dim=1))
+                    pred = fluid.layers.fc(
+                        input=merged, size=1, bias_attr=False,
+                        param_attr=fluid.ParamAttr(
+                            name="w_out",
+                            initializer=fluid.initializer.
+                            ConstantInitializer(0.1)))
+                    loss = fluid.layers.mean(
+                        fluid.layers.square_error_cost(pred, y))
+                    fluid.optimizer.SGD(learning_rate=0.1).minimize(
+                        loss)
+            exe = fluid.Executor(fluid.CPUPlace())
+            exe.run(startup)
+            ids = np.asarray([[0, 1, 2]], np.int64)
+            exe.run(main, feed={"a": ids, "b": ids,
+                                "y": np.zeros((1, 1), np.float32)},
+                    fetch_list=[loss])
+            w = np.asarray(scope.find_var("shared_w"))
+        # pred = sum over 4 dims of (0.02+0.02)*0.1 = 0.016
+        # dloss/d row[r,j] = 2*pred * (1/3)*w_out[j] per branch, x2 summed
+        pred_v = 0.016
+        grad = 2 * pred_v * (2.0 / 3.0) * 0.1
+        expect_touched = 0.02 - 0.1 * grad
+        np.testing.assert_allclose(w[:3], expect_touched, rtol=1e-5,
+                                   err_msg=f"is_sparse={is_sparse}")
+        np.testing.assert_allclose(w[3:], 0.02, rtol=1e-6)
