@@ -7,12 +7,22 @@ fluid-style ResNet-50 (benchmark/fluid/models/resnet.py) built with
 paddle_tpu and compiled by XLA onto whatever accelerator is attached
 (one TPU chip under the driver; CPU otherwise).
 
+Accelerator runs default to bf16 mixed precision (Float16Transpiler —
+the TPU analog of reference paddle/contrib/float16/float16_transpiler.py)
+at batch 256; BENCH_AMP=0 / BENCH_BATCH override.
+
 Prints ONE json line:
-  {"metric": ..., "value": N, "unit": "images/sec", "vs_baseline": N}
+  {"metric": ..., "value": N, "unit": "images/sec", "vs_baseline": N,
+   "tflops": N, "mfu": N, "amp": bool}
 
 vs_baseline: the only in-repo published ResNet-50 training number is the
 MKL-DNN CPU baseline, 81.69 images/sec at bs=64
 (reference benchmark/IntelOptimizedPaddle.md:39-45); value/81.69.
+
+tflops/mfu: delivered training FLOP/s from the standard analytic count
+(~4.1 GFLOPs/image forward at 224x224, x3 for fwd+bwd ~= 12.3e9), against
+BENCH_PEAK_TFLOPS (default 197, TPU v5e bf16 peak).  Only reported for
+224x224 datasets where the analytic count applies.
 """
 import json
 import os
@@ -20,6 +30,9 @@ import sys
 import time
 
 import numpy as np
+
+TRAIN_FLOPS_PER_IMG_224 = 12.3e9
+DEFAULT_PEAK_TFLOPS = 197.0  # v5e bf16
 
 
 def main():
@@ -32,13 +45,14 @@ def main():
         pass
     # Keep CPU smoke-runs fast; real run uses ImageNet shapes.
     if on_accel:
-        batch_size = int(os.environ.get("BENCH_BATCH", "64"))
+        batch_size = int(os.environ.get("BENCH_BATCH", "256"))
         data_set = os.environ.get("BENCH_DATASET", "flowers")
-        iters = int(os.environ.get("BENCH_ITERS", "20"))
+        iters = int(os.environ.get("BENCH_ITERS", "60"))
     else:
         batch_size = int(os.environ.get("BENCH_BATCH", "16"))
         data_set = os.environ.get("BENCH_DATASET", "cifar10")
         iters = int(os.environ.get("BENCH_ITERS", "5"))
+    amp = os.environ.get("BENCH_AMP", "1" if on_accel else "0") == "1"
 
     import paddle_tpu.fluid as fluid
     from paddle_tpu.models import resnet
@@ -47,6 +61,8 @@ def main():
     with fluid.program_guard(main_prog, startup):
         avg_cost, (data, label), (acc,) = resnet.get_model(
             data_set=data_set, depth=50 if model_name == "resnet50" else 32)
+    if amp:
+        fluid.transpiler.Float16Transpiler().transpile(main_prog)
 
     place = fluid.TPUPlace() if on_accel else fluid.CPUPlace()
     exe = fluid.Executor(place)
@@ -83,12 +99,23 @@ def main():
 
     images_per_sec = batch_size * iters / elapsed
     baseline = 81.69  # MKL-DNN CPU ResNet-50 bs64 (IntelOptimizedPaddle.md:41)
-    print(json.dumps({
-        "metric": "resnet50_%s_train_bs%d" % (data_set, batch_size),
+    out = {
+        "metric": "resnet50_%s_train_bs%d%s" % (
+            data_set, batch_size, "_bf16" if amp else ""),
         "value": round(images_per_sec, 2),
         "unit": "images/sec",
         "vs_baseline": round(images_per_sec / baseline, 3),
-    }))
+        "amp": amp,
+    }
+    # 224x224 ResNet-50 only: that's what the analytic FLOP count is for
+    if data_set in ("flowers", "imagenet") and model_name == "resnet50":
+        tflops = images_per_sec * TRAIN_FLOPS_PER_IMG_224 / 1e12
+        out["tflops"] = round(tflops, 1)
+        if amp:  # MFU only vs the bf16 peak the run actually targets
+            peak = float(os.environ.get("BENCH_PEAK_TFLOPS",
+                                        DEFAULT_PEAK_TFLOPS))
+            out["mfu"] = round(tflops / peak, 3)
+    print(json.dumps(out))
 
 
 if __name__ == "__main__":

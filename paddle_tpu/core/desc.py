@@ -315,6 +315,11 @@ class ProgramDesc:
         # multi_devices_graph_builder.cc: instead of assigning whole tensors
         # to devices, dims are assigned to mesh axes and GSPMD partitions.
         self.var_shardings = {}
+        # bf16 mixed-precision flag (set by fluid Float16Transpiler): the
+        # lowering autocasts white-list ops to bfloat16 while params/desc
+        # dtypes stay float32 (master weights).  Participates in the
+        # executor's compile-cache key.
+        self.amp_bf16 = False
 
     def bump_version(self):
         self.version += 1
@@ -332,9 +337,13 @@ class ProgramDesc:
         return len(self.blocks)
 
     def to_proto(self):
-        p = pb.ProgramDesc(version=self.version)
+        p = pb.ProgramDesc(version=self.version, amp_bf16=self.amp_bf16)
         for blk in self.blocks:
             p.blocks.append(blk.to_proto())
+        for name in sorted(self.var_shardings):
+            spec = self.var_shardings[name]
+            p.var_shardings.add(
+                var=name, axes=["" if a is None else a for a in spec])
         return p
 
     def serialize_to_string(self):
@@ -357,4 +366,8 @@ class ProgramDesc:
         if not prog.blocks:
             prog.blocks = [BlockDesc(prog, 0, -1)]
         prog.version = p.version
+        prog.amp_bf16 = p.amp_bf16
+        prog.var_shardings = {
+            vs.var: tuple(a if a else None for a in vs.axes)
+            for vs in p.var_shardings}
         return prog

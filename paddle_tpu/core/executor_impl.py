@@ -129,7 +129,8 @@ class ExecutorCore:
              str(np.asarray(v).dtype))
             for name, v in feed.items()))
         key = (program.uid, program.version, block_id, feed_spec,
-               tuple(fetch_list), mode)
+               tuple(fetch_list), mode,
+               bool(getattr(program, "amp_bf16", False)))
         entry = self._cache.get(key)
         if entry is None:
             entry = self._build(program, block_id, core_ops, scope, feed,

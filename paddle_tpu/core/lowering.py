@@ -28,6 +28,68 @@ from .types import proto_to_np_dtype, VarKind
 # here they are host-side by construction.)
 EMPTY_VAR = ""
 
+# --------------------------------------------------------------------------
+# bf16 mixed precision (the TPU-native analog of the reference's
+# paddle/contrib/float16/float16_transpiler.py): instead of rewriting the
+# desc with cast ops and fp16 weight copies, the lowering autocasts
+# MXU-bound ops to bfloat16 at trace time and XLA fuses the casts into the
+# matmul/conv kernels.  Params and the desc stay float32 (master weights);
+# the vjp of the cast gives fp32 parameter gradients automatically, and
+# bf16's fp32-sized exponent means no loss scaling is needed.
+# --------------------------------------------------------------------------
+
+# MXU-bound ops: compute in bf16 (inputs cast fp32 -> bf16).
+# elementwise_add is here for bias/residual adds: without it the fp32
+# bias promotes every post-matmul activation back to fp32 and the
+# network's activation traffic loses the bf16 bandwidth win.
+AMP_WHITE = frozenset({
+    "mul", "matmul", "conv2d", "conv3d", "conv2d_transpose",
+    "depthwise_conv2d", "sequence_conv", "elementwise_add",
+})
+# Numerically sensitive ops: always compute in fp32 (inputs cast back).
+# layer_norm is NOT here: its lowering computes statistics in f32
+# internally while keeping the normalized output in the input dtype, so
+# transformer activation chains stay bf16.  batch_norm (which does the
+# same internally) IS here — measured on ResNet-50/v5e, the fp32 BN
+# segments fuse better and train ~10% faster than bf16-out BN.
+AMP_BLACK = frozenset({
+    "softmax", "softmax_with_cross_entropy", "cross_entropy", "mean",
+    "reduce_mean", "reduce_sum", "sum", "batch_norm",
+    "exp", "log", "square_error_cost", "l2_normalize", "norm",
+    "sigmoid_cross_entropy_with_logits",
+})
+
+
+_OPTIMIZE_ROLE = 0x0002  # framework.OpRole.Optimize
+
+
+def _amp_cast_ins(op_type, ins, role=0):
+    """Autocast an op's inputs per the white/black lists; everything else
+    runs in whatever dtype flows in (XLA fuses the casts)."""
+    if role & _OPTIMIZE_ROLE:
+        # parameter updates / lr arithmetic stay fp32 (master weights)
+        return ins
+    if op_type in AMP_WHITE:
+        if op_type == "elementwise_add":
+            # only activation-shaped adds (bias/residual): scalar or [1]
+            # adds are lr-schedule / counter arithmetic and keep fp32
+            x = ins.get("X")
+            if x is None or getattr(x, "ndim", 0) < 2:
+                return ins
+
+        def conv(x):
+            if x is not None and getattr(x, "dtype", None) == jnp.float32:
+                return x.astype(jnp.bfloat16)
+            return x
+    elif op_type in AMP_BLACK:
+        def conv(x):
+            if x is not None and getattr(x, "dtype", None) == jnp.bfloat16:
+                return x.astype(jnp.float32)
+            return x
+    else:
+        return ins
+    return Ins({s: [conv(v) for v in vs] for s, vs in ins._d.items()})
+
 
 class Ins:
     """Read-only view of an op's input slots during lowering.
@@ -85,6 +147,7 @@ class LoweringContext:
         self.base_key = base_key        # jax PRNG key (traced)
         self.mode = mode                # 'train' | 'test'
         self.mesh = None                # set by the executor when SPMD
+        self.amp = bool(getattr(program, "amp_bf16", False))
         self._counter = counter or _Counter()
 
     def next_key(self):
@@ -134,6 +197,8 @@ def run_op(ctx, op):
         return
     ins = _gather_inputs(ctx.env, op)
     attrs = {k: a.value for k, a in op.attrs.items()}
+    if ctx.amp:
+        ins = _amp_cast_ins(op.type, ins, getattr(op, "role", 0))
     outs = info.lower(ctx, ins, attrs, op)
     _scatter_outputs(ctx.env, op, outs)
     if not getattr(info, "seq_aware", False):
@@ -254,7 +319,13 @@ def generic_grad_lower(ctx, ins, attrs, op):
         merged = {s: list(v) for s, v in const_ins.items()}
         for (slot, i), val in p.items():
             merged[slot][i] = val
-        outs = info.lower(sub_ctx, Ins(merged), dict(attrs), fwd_op_view)
+        merged_ins = Ins(merged)
+        if ctx.amp:
+            # same autocast as the forward trace: backward matmuls/convs
+            # also run bf16, and vjp-of-cast returns fp32 param grads
+            merged_ins = _amp_cast_ins(fwd_type, merged_ins,
+                                       getattr(op, "role", 0))
+        outs = info.lower(sub_ctx, merged_ins, dict(attrs), fwd_op_view)
         flat = {}
         for s in fwd_output_slots:
             v = outs.get(s)
@@ -274,7 +345,15 @@ def generic_grad_lower(ctx, ins, attrs, op):
                 cot_list.append(None)
                 continue
             g = gvals[i] if i < len(gvals) else None
-            cot_list.append(g if g is not None else jnp.zeros_like(ov))
+            if g is None:
+                g = jnp.zeros_like(ov)
+            elif ctx.amp and g.dtype != ov.dtype:
+                # mixed precision: a cotangent arriving from an op of a
+                # different compute dtype (e.g. fp32 from a black-listed
+                # consumer into a bf16 forward) — cast; XLA fuses it.
+                # Outside AMP a mismatch is a real bug: let jax.vjp raise.
+                g = g.astype(ov.dtype)
+            cot_list.append(g)
         cots[s] = cot_list
     grads = vjp_fn(cots)[0]
 

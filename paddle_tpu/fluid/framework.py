@@ -520,7 +520,7 @@ class Program:
         p = Program()
         p.desc = core_desc.ProgramDesc.parse_from_string(
             self.desc.serialize_to_string())
-        p.desc.random_seed = self.desc.random_seed
+        p.desc.random_seed = self.desc.random_seed  # not in the proto
         if for_test:
             for blk in p.desc.blocks:
                 kept = []

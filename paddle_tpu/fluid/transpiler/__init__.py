@@ -1,6 +1,7 @@
 """Program-to-program transpilers (parity: python/paddle/fluid/transpiler/)."""
 from .distribute_transpiler import DistributeTranspiler, slice_variable  # noqa: F401
+from .float16_transpiler import Float16Transpiler  # noqa: F401
 from .ps_dispatcher import RoundRobin, HashName, PSDispatcher  # noqa: F401
 
-__all__ = ["DistributeTranspiler", "slice_variable", "RoundRobin",
-           "HashName", "PSDispatcher"]
+__all__ = ["DistributeTranspiler", "slice_variable", "Float16Transpiler",
+           "RoundRobin", "HashName", "PSDispatcher"]

@@ -1,0 +1,43 @@
+"""bf16 mixed-precision transpiler.
+
+Parity: reference paddle/contrib/float16/float16_transpiler.py — that
+transpiler rewrites an inference desc with cast ops and fp16 weight
+copies around the cudnn kernels.  On TPU the idiomatic design is
+different and strictly stronger:
+
+- bfloat16 (the MXU compute type) replaces float16; its fp32-sized
+  exponent removes the need for loss scaling, so TRAINING works too.
+- no desc rewriting: the transpiler sets one program flag, and the
+  block lowering (core/lowering.py AMP_WHITE/AMP_BLACK + _amp_cast_ins)
+  autocasts MXU-bound ops to bf16 at trace time.  XLA fuses the casts
+  into the conv/matmul kernels, which is exactly what the reference's
+  hand-inserted cast ops try to approximate.
+- parameters stay float32 in the scope (master weights); the vjp of the
+  cast yields fp32 parameter gradients, and optimizer ops run fp32.
+"""
+from __future__ import annotations
+
+__all__ = ["Float16Transpiler"]
+
+
+class Float16Transpiler:
+    """Enable bf16 mixed precision on a program (training or inference).
+
+    Usage (either before or after ``optimizer.minimize`` — the autocast
+    is applied at lowering time to forward and backward ops alike)::
+
+        t = fluid.transpiler.Float16Transpiler()
+        t.transpile(main_program)
+    """
+
+    def transpile(self, program, place=None, scope=None):
+        # place/scope accepted for reference API compatibility
+        # (float16_transpiler.py:60 transpile(program, place, scope));
+        # no weight copies are made here, so both are unused.
+        program.desc.amp_bf16 = True
+        program.desc.bump_version()
+
+    def revert(self, program):
+        """Back to full fp32 (no weight copies exist to undo)."""
+        program.desc.amp_bf16 = False
+        program.desc.bump_version()

@@ -224,6 +224,17 @@ def _trace_block(ctx, block_idx, env):
     return env
 
 
+def _match_dtype(val, ref, amp):
+    """Pin a loop-carried / branch-merged value to its reference dtype:
+    under AMP the body may compute in bf16 while the init is fp32, and
+    lax.scan/while/cond require carry dtypes to be invariant.  Outside
+    AMP a mismatch is a real bug — let lax raise its invariance error."""
+    if (amp and ref is not None and hasattr(val, "dtype")
+            and hasattr(ref, "dtype") and val.dtype != ref.dtype):
+        return val.astype(ref.dtype)
+    return val
+
+
 @register_op("conditional_block")
 def _conditional_block(ctx, ins, attrs, op=None):
     """Scalar-condition sub-block -> lax.cond (reference
@@ -243,7 +254,7 @@ def _conditional_block(ctx, ins, attrs, op=None):
         env = dict(zip(in_names, in_vals))
         _trace_block(ctx, sub_idx, env)
         return tuple(
-            env[n] if n in env else
+            _match_dtype(env[n], p, ctx.amp) if n in env else
             (p if p is not None else jnp.zeros(()))
             for n, p in zip(out_names, prior))
 
@@ -288,7 +299,9 @@ def _while(ctx, ins, attrs, op=None):
         env.update(zip(x_names, xs))
         env[cond_name] = c
         _trace_block(ctx, sub_idx, env)
-        return (env[cond_name], tuple(env[n] for n in x_names))
+        return (env[cond_name],
+                tuple(_match_dtype(env[n], x, ctx.amp)
+                      for n, x in zip(x_names, xs)))
 
     final_c, outs = jax.lax.while_loop(cond_fn, body_fn,
                                        (cond0, tuple(x_vals)))
@@ -349,12 +362,15 @@ def _recurrent(ctx, ins, attrs, op=None):
         env.update(zip(step_in_names, xts))
         env.update(zip(st_in_names, states))
         _trace_block(ctx, sub_idx, env)
-        new_states = tuple(env[n] for n in st_out_names)
+        new_states = tuple(
+            _match_dtype(env[nm], s, ctx.amp)
+            for nm, s in zip(st_out_names, states))
         if masked:
             kept = []
             for s_new, s_old in zip(new_states, states):
                 m = mt.reshape((n,) + (1,) * (s_new.ndim - 1))
-                kept.append(m * s_new + (1 - m) * s_old)
+                kept.append(_match_dtype(m * s_new + (1 - m) * s_old,
+                                         s_old, ctx.amp))
             new_states = tuple(kept)
         outs = []
         for nm in out_names:

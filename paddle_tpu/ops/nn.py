@@ -172,17 +172,24 @@ def _layer_norm(ctx, ins, attrs, op):
     begin = attrs.get("begin_norm_axis", 1)
     eps = attrs.get("epsilon", 1e-5)
     axes = tuple(range(begin, x.ndim))
-    mean = jnp.mean(x, axis=axes, keepdims=True)
-    var = jnp.mean(jnp.square(x - mean), axis=axes, keepdims=True)
+    # statistics in f32 for stability under bf16 inputs (normalized
+    # output stays in x.dtype so bf16 activation chains aren't promoted)
+    xf = x.astype(jnp.float32)
+    mean = jnp.mean(xf, axis=axes, keepdims=True)
+    var = jnp.mean(jnp.square(xf - mean), axis=axes, keepdims=True)
+    mean = mean.astype(x.dtype)
+    var = var.astype(x.dtype)
     y = (x - mean) * jax.lax.rsqrt(var + eps)
     nfeat = int(np.prod(x.shape[begin:]))
     fshape = (1,) * begin + tuple(x.shape[begin:])
     scale = ins.get("Scale")
     bias = ins.get("Bias")
+    # affine in x.dtype: an fp32 scale would promote every post-LN
+    # activation back to f32 and lose the bf16 bandwidth win under AMP
     if scale is not None:
-        y = y * scale.reshape(fshape)
+        y = y * scale.astype(x.dtype).reshape(fshape)
     if bias is not None:
-        y = y + bias.reshape(fshape)
+        y = y + bias.astype(x.dtype).reshape(fshape)
     lead = x.shape[:begin]
     return {"Y": y, "Mean": mean.reshape(lead), "Variance": var.reshape(lead)}
 

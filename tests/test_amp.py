@@ -1,0 +1,154 @@
+"""bf16 mixed precision (Float16Transpiler; TPU analog of reference
+paddle/contrib/float16/float16_transpiler.py — see that module's
+docstring for the design mapping)."""
+import numpy as np
+
+import paddle_tpu.fluid as fluid
+
+
+def _build_convnet():
+    img = fluid.layers.data(name="img", shape=[1, 16, 16], dtype="float32")
+    label = fluid.layers.data(name="label", shape=[1], dtype="int64")
+    conv = fluid.layers.conv2d(input=img, num_filters=8, filter_size=3,
+                               padding=1, act="relu")
+    pool = fluid.layers.pool2d(input=conv, pool_size=2, pool_type="max",
+                               pool_stride=2)
+    fc = fluid.layers.fc(input=pool, size=10, act="softmax")
+    loss = fluid.layers.mean(
+        fluid.layers.cross_entropy(input=fc, label=label))
+    return img, label, conv, loss
+
+
+def _train(amp, steps=8):
+    main, startup = fluid.Program(), fluid.Program()
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        with fluid.program_guard(main, startup):
+            with fluid.unique_name.guard():
+                img, label, conv, loss = _build_convnet()
+                fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+        if amp:
+            fluid.transpiler.Float16Transpiler().transpile(main)
+        main.random_seed = 5
+        startup.random_seed = 5
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        rng = np.random.RandomState(0)
+        x = rng.rand(16, 1, 16, 16).astype(np.float32)
+        y = rng.randint(0, 10, (16, 1)).astype(np.int64)
+        losses, conv_v = [], None
+        for _ in range(steps):
+            l, c = exe.run(main, feed={"img": x, "label": y},
+                           fetch_list=[loss, conv], return_numpy=False)
+            losses.append(float(np.ravel(np.asarray(l))[0]))
+            conv_v = c
+        params = {p.name: np.asarray(scope.find_var(p.name))
+                  for p in main.all_parameters()}
+    return losses, conv_v, params
+
+
+def test_amp_loss_parity_and_dtypes():
+    import jax.numpy as jnp
+
+    fp_l, fp_conv, fp_params = _train(False)
+    amp_l, amp_conv, amp_params = _train(True)
+
+    # losses track fp32 closely (bf16 has ~3 decimal digits)
+    np.testing.assert_allclose(amp_l, fp_l, rtol=0.1, atol=0.02)
+    assert amp_l[-1] < amp_l[0]  # still learning
+
+    # compute really happened in bf16: the fetched conv activation is
+    # bfloat16 under AMP, float32 without
+    assert fp_conv.dtype == jnp.float32
+    assert amp_conv.dtype == jnp.bfloat16
+
+    # master weights stay fp32 in the scope
+    for name, w in amp_params.items():
+        assert w.dtype == np.float32, name
+    # and actually differ from the fp32 run (bf16 rounding), proving the
+    # updates flowed through the bf16 path
+    assert set(amp_params) == set(fp_params)
+    assert any(not np.array_equal(amp_params[n], fp_params[n])
+               for n in fp_params)
+
+
+def test_amp_with_dynamic_rnn():
+    """AMP through lax.scan control flow: fp32 carries + bf16 body ops
+    must not break carry dtype invariance."""
+    def build_and_train(amp):
+        main, startup = fluid.Program(), fluid.Program()
+        scope = fluid.Scope()
+        with fluid.scope_guard(scope):
+            with fluid.program_guard(main, startup):
+                with fluid.unique_name.guard():
+                    x = fluid.layers.data(name="x", shape=[6, 4],
+                                          dtype="float32")
+                    y = fluid.layers.data(name="y", shape=[1],
+                                          dtype="float32")
+                    rnn = fluid.layers.StaticRNN()
+                    with rnn.step():
+                        xt = rnn.step_input(x)
+                        h = rnn.memory(shape=[8], batch_ref=x)
+                        nh = fluid.layers.fc(input=[xt, h], size=8,
+                                             act="tanh")
+                        rnn.update_memory(h, nh)
+                        rnn.step_output(nh)
+                    seq = rnn()
+                    pred = fluid.layers.fc(
+                        fluid.layers.reduce_mean(seq, dim=1), size=1)
+                    loss = fluid.layers.mean(
+                        fluid.layers.square_error_cost(pred, y))
+                    fluid.optimizer.SGD(learning_rate=0.05).minimize(
+                        loss)
+            if amp:
+                fluid.transpiler.Float16Transpiler().transpile(main)
+            main.random_seed = startup.random_seed = 11
+            exe = fluid.Executor(fluid.CPUPlace())
+            exe.run(startup)
+            rng = np.random.RandomState(2)
+            xv = rng.randn(8, 6, 4).astype(np.float32)
+            yv = xv.sum(axis=(1, 2), keepdims=False)[:, None] * 0.1
+            yv = yv.astype(np.float32)
+            ls = []
+            for _ in range(10):
+                l, = exe.run(main, feed={"x": xv, "y": yv},
+                             fetch_list=[loss])
+                ls.append(float(np.ravel(l)[0]))
+        return ls
+
+    fp_l = build_and_train(False)
+    amp_l = build_and_train(True)
+    assert all(np.isfinite(amp_l))
+    assert amp_l[-1] < amp_l[0]
+    np.testing.assert_allclose(amp_l, fp_l, rtol=0.15, atol=0.05)
+
+
+def test_amp_and_shardings_survive_serialize():
+    """save/load round-trips the AMP flag and sharding annotations (a
+    transpiled program must not silently revert to fp32/unsharded)."""
+    from paddle_tpu.core.desc import ProgramDesc
+
+    main = fluid.Program()
+    startup = fluid.Program()
+    with fluid.program_guard(main, startup):
+        with fluid.unique_name.guard():
+            _build_convnet()
+    fluid.transpiler.Float16Transpiler().transpile(main)
+    main.desc.var_shardings["fc_0.w_0"] = (None, "tp")
+    rt = ProgramDesc.parse_from_string(main.desc.serialize_to_string())
+    assert rt.amp_bf16
+    assert rt.var_shardings == {"fc_0.w_0": (None, "tp")}
+
+
+def test_amp_flag_survives_clone():
+    main = fluid.Program()
+    startup = fluid.Program()
+    with fluid.program_guard(main, startup):
+        with fluid.unique_name.guard():
+            _build_convnet()
+    fluid.transpiler.Float16Transpiler().transpile(main)
+    test_prog = main.clone(for_test=True)
+    assert test_prog.desc.amp_bf16
+    fluid.transpiler.Float16Transpiler().revert(main)
+    assert not main.desc.amp_bf16
+    assert test_prog.desc.amp_bf16  # clone is independent
