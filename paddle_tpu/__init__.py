@@ -7,9 +7,20 @@ Layout:
   fluid/     user API mirroring python/paddle/fluid
   parallel/  SPMD mesh utilities, distributed transpiler
   models/    benchmark/fluid model configs
-  utils/     readers, datasets, serialization
-  native/    C++ runtime components (recordio, ...)
+  reader/    reader creators/decorators + double-buffered DeviceLoader
+  dataset/   dataset adapters (real-format parsers, synthetic fallback)
+  recordio/  chunked record container (C++ core + Python codec)
+  utils/     serialization helpers
 """
 __version__ = "0.1.0"
 
 from . import fluid  # noqa: F401
+from . import reader  # noqa: F401
+from . import dataset  # noqa: F401
+from . import recordio  # noqa: F401
+
+
+def batch(reader_fn, batch_size, drop_last=True):
+    """paddle.batch parity (reference python/paddle/batch.py)."""
+    from .reader.device_loader import batch as _b
+    return _b(reader_fn, batch_size, drop_last)

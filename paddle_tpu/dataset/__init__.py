@@ -1,0 +1,13 @@
+"""Dataset adapters (parity: python/paddle/dataset/__init__.py).
+
+Each module exposes ``train()``/``test()`` reader creators.  With no
+network egress, modules parse the real files when cached under
+``common.DATA_HOME`` and otherwise fall back to deterministic synthetic
+data of the same shapes/dtypes (``<module>.is_synthetic()`` tells)."""
+from . import common  # noqa: F401
+from . import mnist  # noqa: F401
+from . import cifar  # noqa: F401
+from . import uci_housing  # noqa: F401
+from . import flowers  # noqa: F401
+
+__all__ = ["common", "mnist", "cifar", "uci_housing", "flowers"]

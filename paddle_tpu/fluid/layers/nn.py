@@ -29,7 +29,7 @@ __all__ = [
     "dot_product_attention", "warpctc", "bilinear_tensor_product",
     "sampling_id", "gaussian_random", "uniform_random",
     "gaussian_random_batch_size_like", "uniform_random_batch_size_like",
-    "random_crop", "mean_iou", "spp",
+    "random_crop", "mean_iou", "spp", "beam_search", "beam_search_decode",
 ]
 
 
@@ -956,3 +956,46 @@ def spp(input, pyramid_height, pool_type="max"):
                      attrs={"pyramid_height": pyramid_height,
                             "pooling_type": pool_type})
     return out
+
+
+def beam_search(pre_ids, pre_scores, ids, scores, beam_size, end_id,
+                level=0, name=None):
+    """One beam-growth step (reference nn.py:2025 / beam_search_op.cc).
+
+    Signature follows the op's evolved form with explicit ``pre_scores``
+    (the 0.14 layer smuggled them through the score LoD); ``scores`` are
+    the ACCUMULATED log-probs of each candidate in ``ids``.  Returns
+    (selected_ids, selected_scores, parent_idx) — ancestry is an explicit
+    gather index instead of the reference's output-LoD encoding."""
+    helper = LayerHelper("beam_search", **locals())
+    selected_ids = helper.create_tmp_variable(dtype=ids.dtype)
+    selected_scores = helper.create_tmp_variable(dtype="float32")
+    parent_idx = helper.create_tmp_variable(dtype="int32")
+    for v in (selected_ids, selected_scores, parent_idx):
+        v.stop_gradient = True
+    helper.append_op(
+        type="beam_search",
+        inputs={"pre_ids": [pre_ids], "pre_scores": [pre_scores],
+                "ids": [ids], "scores": [scores]},
+        outputs={"selected_ids": [selected_ids],
+                 "selected_scores": [selected_scores],
+                 "parent_idx": [parent_idx]},
+        attrs={"beam_size": beam_size, "end_id": end_id, "level": level})
+    return selected_ids, selected_scores, parent_idx
+
+
+def beam_search_decode(ids, scores, parents, beam_size, end_id, name=None):
+    """Backtrack a finished decode loop's arrays into whole sequences
+    (reference nn.py:1765 / beam_search_decode_op.cc).  ``ids``/``scores``
+    /``parents`` are the TensorArrays written per step; returns
+    (sentence_ids [N, beam, T] best-first, sentence_scores [N, beam])."""
+    helper = LayerHelper("beam_search_decode", **locals())
+    sentence_ids = helper.create_tmp_variable(dtype="int64")
+    sentence_scores = helper.create_tmp_variable(dtype="float32")
+    helper.append_op(
+        type="beam_search_decode",
+        inputs={"Ids": [ids], "Scores": [scores], "Parents": [parents]},
+        outputs={"SentenceIds": [sentence_ids],
+                 "SentenceScores": [sentence_scores]},
+        attrs={"beam_size": beam_size, "end_id": end_id})
+    return sentence_ids, sentence_scores

@@ -17,4 +17,5 @@ from paddle_tpu.ops import (  # noqa: F401
     sequence,
     control_flow,
     distributed_ops,
+    beam_search,
 )

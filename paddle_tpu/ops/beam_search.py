@@ -1,0 +1,126 @@
+"""Beam-search ops.
+
+Parity: reference operators/beam_search_op.cc (per-step candidate
+selection) and beam_search_decode_op.cc (end-of-loop backtracking), as
+driven by the book machine_translation decode program: per step the
+model computes topk candidate ids + ACCUMULATED log scores, and
+``beam_search`` keeps the best ``beam_size`` beams per source sentence.
+
+TPU-native redesign: the reference walks LoD levels per sentence on the
+CPU and encodes beam ancestry in the output LoD
+(beam_search_op.h:94 BeamSearch, SelectTopBeamSizeItems); here the step
+is one batched top-k over ``[N, B*K]`` on device (MXU-adjacent, no
+host sync inside the decode loop) and ancestry is an explicit
+``parent_idx`` output ([N*B] gather indices).  ``beam_search_decode``
+backtracks the stacked per-step outputs on the host once, after the
+loop — the only host work in the whole decode.
+"""
+from __future__ import annotations
+
+import jax.lax
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.core.registry import register_op
+
+NEG_INF = -1e9
+
+
+@register_op("beam_search", grad_maker=None)
+def _beam_search(ctx, ins, attrs, op=None):
+    """One step of beam growth.
+
+    Inputs (shapes; N sentences x B beams flattened on dim 0):
+      pre_ids     [N*B, 1] int  — previous step's chosen token per beam
+      pre_scores  [N*B, 1] f32  — accumulated log-prob per beam
+      ids         [N*B, K] int  — candidate token ids (topk of the step)
+      scores      [N*B, K] f32  — accumulated log-prob of each candidate
+    Attrs: beam_size, end_id.
+    Outputs:
+      selected_ids     [N*B, 1]   selected_scores [N*B, 1]
+      parent_idx       [N*B] int32 — which flat beam each winner grew from
+    A finished beam (pre_id == end_id) competes with its frozen score and
+    re-emits end_id (reference PruneEndBeams keeps it out of growth).
+    """
+    pre_ids = ins["pre_ids"].reshape(-1)
+    pre_scores = ins["pre_scores"].reshape(-1).astype(jnp.float32)
+    ids = ins["ids"]
+    scores = ins["scores"].astype(jnp.float32)
+    beam_size = int(attrs["beam_size"])
+    end_id = int(attrs["end_id"])
+
+    nb, k = scores.shape
+    n = nb // beam_size
+    finished = pre_ids == end_id  # [NB]
+
+    # finished beams offer exactly one candidate: (end_id, frozen score)
+    cand_scores = jnp.where(finished[:, None], NEG_INF, scores)
+    frozen = jnp.where(
+        (jnp.arange(k) == 0)[None, :] & finished[:, None],
+        pre_scores[:, None], NEG_INF)
+    cand_scores = jnp.maximum(cand_scores, frozen)
+    cand_ids = jnp.where(finished[:, None], end_id, ids)
+
+    flat_scores = cand_scores.reshape(n, beam_size * k)
+    flat_ids = cand_ids.reshape(n, beam_size * k)
+    top_scores, top_pos = jax.lax.top_k(flat_scores, beam_size)
+    sel_scores = top_scores.reshape(nb, 1)
+    sel_ids = jnp.take_along_axis(flat_ids, top_pos, axis=1).reshape(nb, 1)
+    beam_of = top_pos // k                            # [N, B] local beam
+    parent = (beam_of + jnp.arange(n)[:, None] * beam_size).reshape(nb)
+    return {"selected_ids": sel_ids.astype(pre_ids.dtype),
+            "selected_scores": sel_scores,
+            "parent_idx": parent.astype(jnp.int32)}
+
+
+@register_op("beam_search_decode", grad_maker=None)
+def _beam_search_decode(ctx, ins, attrs, op=None):
+    """Backtrack stacked per-step (ids, scores, parents) into full beams.
+
+    Inputs (TensorArrays written by the decode loop):
+      Ids      buffer [cap, N*B, 1] of selected_ids
+      Scores   buffer [cap, N*B, 1] of selected_scores
+      Parents  buffer [cap, N*B]    of parent_idx
+    Attrs: beam_size, end_id.
+    Outputs:
+      SentenceIds    [N, B, cap] int (end_id padded), best beam first
+      SentenceScores [N, B]      f32 accumulated log-prob
+
+    Reference beam_search_decode_op.cc walks the per-step LoDs on the CPU;
+    here ancestry is explicit so the backtrack is one reverse lax.scan on
+    device — the decode program stays a single XLA computation.
+    """
+    ids_arr = ins["Ids"]
+    sc_arr = ins["Scores"]
+    par_arr = ins["Parents"]
+    beam_size = int(attrs["beam_size"])
+    end_id = int(attrs["end_id"])
+
+    cap = ids_arr.buffer.shape[0]
+    nb = int(np.prod(ids_arr.buffer.shape[1:]))
+    n = nb // beam_size
+    buf_ids = ids_arr.buffer.reshape(cap, nb)
+    buf_sc = sc_arr.buffer.reshape(cap, nb).astype(jnp.float32)
+    buf_par = par_arr.buffer.reshape(cap, nb)
+    size = jnp.reshape(ids_arr.size, ()).astype(jnp.int32)
+
+    last = jnp.clip(size - 1, 0, cap - 1)
+    final_scores = jnp.take(buf_sc, last, axis=0)       # [NB]
+
+    def step(cur, t):
+        valid = t < size
+        out = jnp.where(valid, jnp.take(buf_ids, t, axis=0)[cur], end_id)
+        nxt = jnp.where(valid,
+                        jnp.take(buf_par, t, axis=0)[cur].astype(cur.dtype),
+                        cur)
+        return nxt, out
+
+    _, outs = jax.lax.scan(step, jnp.arange(nb), jnp.arange(cap),
+                           reverse=True)                # outs [cap, NB]
+    sent = jnp.moveaxis(outs, 0, 1).reshape(n, beam_size, cap)
+    scores = final_scores.reshape(n, beam_size)
+    order = jnp.argsort(-scores, axis=1)
+    sent = jnp.take_along_axis(sent, order[:, :, None], axis=1)
+    scores = jnp.take_along_axis(scores, order, axis=1)
+    return {"SentenceIds": sent.astype(buf_ids.dtype),
+            "SentenceScores": scores}

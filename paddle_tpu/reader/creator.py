@@ -1,0 +1,43 @@
+"""Reader creators (parity: python/paddle/reader/creator.py — np_array,
+text_file, recordio)."""
+from __future__ import annotations
+
+__all__ = ["np_array", "text_file", "recordio"]
+
+
+def np_array(x):
+    """Reader over the first axis of a numpy array."""
+
+    def reader():
+        for row in x:
+            yield row
+
+    return reader
+
+
+def text_file(path):
+    """Reader yielding stripped lines of a text file."""
+
+    def reader():
+        with open(path, "r") as f:
+            for line in f:
+                yield line.rstrip("\n")
+
+    return reader
+
+
+def recordio(paths, deserializer=None):
+    """Reader over recordio file(s) (reference creator.py:60 uses the
+    recordio scanner; ours is paddle_tpu.recordio).  ``deserializer``
+    maps raw record bytes to a sample (default: raw bytes)."""
+    from paddle_tpu import recordio as rio
+
+    if isinstance(paths, str):
+        paths = paths.split(",")
+
+    def reader():
+        for p in paths:
+            for rec in rio.read_records(p):
+                yield deserializer(rec) if deserializer else rec
+
+    return reader

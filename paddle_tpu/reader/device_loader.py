@@ -1,0 +1,109 @@
+"""Device-side data loading: batching + double-buffered host->device
+staging.
+
+Role parity: reference operators/reader/ (BatchReader,
+create_double_buffer_reader_op.cc, blocking_queue.h) — the C++ decorated
+-reader chain that overlaps input copy with compute.  TPU-native design:
+a background thread calls ``jax.device_put`` (async on TPU) on upcoming
+batches so transfers ride the interconnect while XLA executes the
+current step; the bounded queue is the blocking-queue analog.
+"""
+from __future__ import annotations
+
+import queue
+import threading
+
+import numpy as np
+
+__all__ = ["batch", "DeviceLoader"]
+
+
+def batch(reader, batch_size, drop_last=True):
+    """Group samples into lists of ``batch_size`` (reference
+    python/paddle/batch.py; drop_last=True is the reference default —
+    and the right one here, where a ragged tail batch would trigger an
+    XLA recompile).  Samples may be tuples (fields stay parallel)."""
+
+    def batched():
+        b = []
+        for s in reader():
+            b.append(s)
+            if len(b) >= batch_size:
+                yield b
+                b = []
+        if b and not drop_last:
+            yield b
+
+    return batched
+
+
+class DeviceLoader:
+    """Iterate device-resident feed dicts, ``capacity`` batches ahead.
+
+    feed_list: var names (or Variables) matching each sample field.
+    Samples are field tuples; batches (lists of samples) are stacked
+    per-field with np.stack before staging.
+    """
+
+    def __init__(self, reader, feed_list, place, capacity=2):
+        self.reader = reader
+        self.names = [getattr(v, "name", v) for v in feed_list]
+        self.place = place
+        self.capacity = max(1, int(capacity))
+
+    def _stack(self, samples):
+        fields = list(zip(*samples))
+        if len(fields) != len(self.names):
+            raise ValueError(
+                "sample has %d fields but feed_list names %d" %
+                (len(fields), len(self.names)))
+        return {n: np.stack([np.asarray(x) for x in f])
+                for n, f in zip(self.names, fields)}
+
+    def __iter__(self):
+        import jax
+
+        dev = self.place.jax_device()
+        end = object()
+        q = queue.Queue(maxsize=self.capacity)
+        stop = threading.Event()
+
+        def put(item):
+            # bounded put that gives up when the consumer went away, so
+            # an abandoned iterator doesn't pin a thread + `capacity`
+            # device-staged batches forever
+            while not stop.is_set():
+                try:
+                    q.put(item, timeout=0.1)
+                    return True
+                except queue.Full:
+                    continue
+            return False
+
+        def produce():
+            try:
+                for samples in self.reader():
+                    host = self._stack(samples)
+                    # async H2D: on TPU device_put returns immediately
+                    # and the copy overlaps the running step
+                    if not put({k: jax.device_put(v, dev)
+                                for k, v in host.items()}):
+                        return
+            finally:
+                put(end)
+
+        t = threading.Thread(target=produce, daemon=True)
+        t.start()
+        try:
+            while True:
+                item = q.get()
+                if item is end:
+                    return
+                yield item
+        finally:
+            stop.set()
+            while True:  # drop staged batches so buffers free promptly
+                try:
+                    q.get_nowait()
+                except queue.Empty:
+                    break

@@ -1,0 +1,131 @@
+"""Beam search (reference operators/beam_search_op.cc,
+beam_search_decode_op.cc, book/test_machine_translation.py decode
+program): per-step selection semantics, and a full While-loop decode
+program where beam=2 provably beats greedy on a garden-path LM."""
+import numpy as np
+
+import paddle_tpu.fluid as fluid
+
+layers = fluid.layers
+
+END = 0
+
+
+def test_beam_search_step_semantics(prog_scope, exe):
+    """One step, N=1 sentences x B=2 beams, K=3 candidates."""
+    main, startup, scope = prog_scope
+    pre_ids = layers.data(name="pre_ids", shape=[1], dtype="int64",
+                          append_batch_size=False)
+    pre_scores = layers.data(name="pre_scores", shape=[1],
+                             dtype="float32", append_batch_size=False)
+    ids = layers.data(name="ids", shape=[3], dtype="int64",
+                      append_batch_size=False)
+    scores = layers.data(name="scores", shape=[3], dtype="float32",
+                         append_batch_size=False)
+    sel_ids, sel_scores, parent = layers.beam_search(
+        pre_ids, pre_scores, ids, scores, beam_size=2, end_id=END)
+    exe.run(startup)
+    # beam 0 alive (pre_id=5), beam 1 finished (pre_id=END, score -0.1)
+    out = exe.run(main, feed={
+        "pre_ids": np.asarray([[5], [END]], np.int64),
+        "pre_scores": np.asarray([[-0.5], [-0.1]], np.float32),
+        "ids": np.asarray([[7, 8, END], [1, 2, 3]], np.int64),
+        "scores": np.asarray([[-0.6, -0.9, -2.0],
+                              [-9.0, -9.0, -9.0]], np.float32),
+    }, fetch_list=[sel_ids, sel_scores, parent])
+    got_ids, got_scores, got_parent = [np.asarray(o) for o in out]
+    # candidates: beam0 -> (7,-0.6) (8,-0.9) (END,-2.0); beam1 frozen
+    # -> (END,-0.1).  top-2 overall: (END,-0.1) from beam1, (7,-0.6).
+    assert got_ids.reshape(-1).tolist() == [END, 7]
+    np.testing.assert_allclose(got_scores.reshape(-1), [-0.1, -0.6],
+                               rtol=1e-6)
+    assert got_parent.tolist() == [1, 0]
+
+
+def _build_decode(beam_size, max_len=4, vocab=5):
+    """While-loop decode over a fixed transition table (the reference
+    machine_translation decode program shape, states = log-prob rows)."""
+    counter = layers.fill_constant(shape=[1], dtype="int64", value=0)
+    limit = layers.fill_constant(shape=[1], dtype="int64", value=max_len)
+    nb = beam_size  # N=1 sentence
+
+    init_ids = layers.fill_constant(shape=[nb, 1], dtype="int64", value=1)
+    # only beam 0 is live at t=0 so beams diverge from one start token
+    init_scores = layers.assign(
+        np.asarray([[0.0]] + [[-1e9]] * (nb - 1), np.float32))
+
+    ids_arr = layers.array_write(init_ids, i=counter, capacity=max_len + 1)
+    sc_arr = layers.array_write(init_scores, i=counter,
+                                capacity=max_len + 1)
+    par_arr = layers.array_write(
+        layers.assign(np.zeros((nb,), np.int32)), i=counter,
+        capacity=max_len + 1)
+
+    cond = layers.less_than(x=counter, y=limit)
+    w = layers.While(cond=cond)
+    with w.block():
+        pre_ids = layers.array_read(ids_arr, i=counter)
+        pre_scores = layers.array_read(sc_arr, i=counter)
+        # "model": log-prob of next token = table row of pre_id
+        logp = layers.embedding(
+            pre_ids, size=[vocab, vocab],
+            param_attr=fluid.ParamAttr(name="table"))
+        logp = layers.reshape(logp, [nb, vocab])
+        accu = layers.elementwise_add(x=logp, y=pre_scores)
+        cand_scores, cand_ids = layers.topk(accu, k=vocab - 1)
+        sel_ids, sel_scores, parent = layers.beam_search(
+            pre_ids, pre_scores, cand_ids, cand_scores,
+            beam_size=beam_size, end_id=END)
+        layers.increment(x=counter, value=1, in_place=True)
+        layers.array_write(sel_ids, i=counter, array=ids_arr)
+        layers.array_write(sel_scores, i=counter, array=sc_arr)
+        layers.array_write(parent, i=counter, array=par_arr)
+        layers.less_than(x=counter, y=limit, cond=cond)
+
+    return layers.beam_search_decode(ids_arr, sc_arr, par_arr,
+                                     beam_size, END)
+
+
+def _table():
+    """Garden-path transitions: greedy 1->2 then 2's best continuation
+    is weak; 1->3->END has higher total probability."""
+    t = np.full((5, 5), -1e9, np.float32)
+    t[1, 2] = np.log(0.6)
+    t[1, 3] = np.log(0.4)
+    t[2, 4] = np.log(0.55)
+    t[2, END] = np.log(0.45)
+    t[4, END] = 0.0              # log 1.0
+    t[3, END] = 0.0
+    t[END, END] = 0.0            # harmless: finished beams are frozen
+    return t
+
+
+def _run_decode(beam_size):
+    main, startup = fluid.Program(), fluid.Program()
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        with fluid.program_guard(main, startup):
+            with fluid.unique_name.guard():
+                sent_ids, sent_scores = _build_decode(beam_size)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        scope.set("table", _table())
+        ids, scores = exe.run(main,
+                              fetch_list=[sent_ids, sent_scores])
+    return np.asarray(ids), np.asarray(scores)
+
+
+def test_beam_beats_greedy_on_garden_path():
+    # sequences include the start token (step 0's array entry)
+    # greedy (beam 1): 1 -> 2 -> 4 -> END, logp = log(0.6*0.55)
+    g_ids, g_scores = _run_decode(1)
+    assert g_ids[0, 0].tolist()[:4] == [1, 2, 4, END]
+    np.testing.assert_allclose(g_scores[0, 0], np.log(0.6 * 0.55),
+                               rtol=1e-5)
+    # beam 2 recovers the delayed-reward path: 1 -> 3 -> END, logp=log 0.4
+    b_ids, b_scores = _run_decode(2)
+    assert b_ids[0, 0].tolist()[:3] == [1, 3, END]
+    np.testing.assert_allclose(b_scores[0, 0], np.log(0.4), rtol=1e-5)
+    assert b_scores[0, 0] > g_scores[0, 0]
+    # runner-up beam is exactly the greedy path
+    assert b_ids[0, 1].tolist()[:4] == [1, 2, 4, END]
