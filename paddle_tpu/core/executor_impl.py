@@ -16,6 +16,9 @@ RunPreparedContext).  Two paths:
 """
 from __future__ import annotations
 
+import sys
+import time
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -26,8 +29,7 @@ from .registry import get_op_info
 from .scope import Scope
 from .types import proto_to_np_dtype, VarKind
 
-# Flag parity: FLAGS_check_nan_inf (reference framework/operator.cc:590).
-check_nan_inf = False
+from .flags import FLAGS
 
 LEN_SUFFIX = "@LEN"
 # pad ragged batches' time dim up to a multiple of this so the number of
@@ -85,19 +87,43 @@ class ExecutorCore:
         # host ops with sub-block access (listen_and_serv) read this
         self._current_program = program
 
-        prelude, core_ops, postlude, mixed = _segment(block)
-        for op in prelude:
-            _run_host_op(self, op, scope, feed)
+        t0 = time.perf_counter() if FLAGS.benchmark else None
 
+        prelude, core_ops, postlude, mixed = _segment(block)
+        if FLAGS.check_nan_inf:
+            # debug mode: run op-by-op eagerly so EVERY op's outputs are
+            # validated and the first bad op is named (reference
+            # FLAGS_check_nan_inf, framework/operator.cc:590 — inside one
+            # fused XLA program that granularity doesn't exist)
+            mixed = True
         if mixed:
+            # the interpreted path executes EVERY op of the block itself
+            # (host ops included) — running prelude/postlude here too
+            # would execute them twice (e.g. double-send to a pserver)
             fetches = self._run_interpreted(program, block, scope, feed,
                                             fetch_list, mode)
         else:
-            fetches = self._run_compiled(program, block_id, core_ops, scope,
-                                         feed, fetch_list, mode)
+            for op in prelude:
+                _run_host_op(self, op, scope, feed)
+            # postlude host ops may read non-persistable temps the block
+            # computed (e.g. print of an activation): fetch those too and
+            # hand them over via env instead of polluting the scope
+            post_reads = sorted({
+                n for op in postlude for n in op.input_arg_names()
+                if n and n not in feed and not scope.has_var(n)})
+            outs = self._run_compiled(program, block_id, core_ops, scope,
+                                      feed, fetch_list + post_reads, mode)
+            fetches = outs[:len(fetch_list)]
+            post_env = dict(zip(post_reads, outs[len(fetch_list):]))
+            for op in postlude:
+                _run_host_op(self, op, scope, feed,
+                             post_env if post_reads else None)
 
-        for op in postlude:
-            _run_host_op(self, op, scope, feed)
+        if t0 is not None:
+            # reference FLAGS_benchmark (executor.cc): per-run wall time
+            print("[benchmark] block %d ran in %.3f ms" %
+                  (block_id, (time.perf_counter() - t0) * 1e3),
+                  file=sys.stderr)
 
         if return_numpy:
             fetches = [np.asarray(v) if v is not None and not isinstance(
@@ -158,13 +184,6 @@ class ExecutorCore:
         fetches, persists = entry.fn(tuple(args), seed, counter)
         for name, val in zip(entry.persist_outs, persists):
             (scope.find_scope_of(name) or scope).set(name, val)
-        if check_nan_inf:
-            for name, val in zip(fetch_list, fetches):
-                if val is not None and jnp.issubdtype(
-                        jnp.result_type(val), jnp.floating):
-                    if not bool(jnp.isfinite(val).all()):
-                        raise FloatingPointError(
-                            "nan/inf in fetched var %r" % name)
         return list(fetches)
 
     def _build(self, program, block_id, core_ops, scope, feed, fetch_list,
@@ -298,6 +317,8 @@ class ExecutorCore:
                     _run_host_op(self, op, scope, feed, env)
                 else:
                     run_op(ctx, op)
+                    if FLAGS.check_nan_inf:
+                        _check_op_outputs(op, env)
         # sync written persistables back
         for name in env.written:
             vd = block.find_var_recursive(name)
@@ -333,6 +354,23 @@ class _ScopeEnv(dict):
             return self[name]
         except KeyError:
             return default
+
+
+def _check_op_outputs(op, env):
+    """Validate every float output of one eagerly-run op; name the op and
+    var of the first nan/inf (reference operator.cc:590)."""
+    for name in op.output_arg_names():
+        if not name:
+            continue
+        val = env.get(name)
+        if val is None or not hasattr(val, "dtype"):
+            continue
+        if not jnp.issubdtype(jnp.result_type(val), jnp.floating):
+            continue
+        if not bool(jnp.isfinite(val).all()):
+            raise FloatingPointError(
+                "operator %r produced nan/inf in output %r" %
+                (op.type, name))
 
 
 def _in_feed_only(name, feed, scope):

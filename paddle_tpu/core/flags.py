@@ -1,0 +1,66 @@
+"""Runtime flag registry — the gflags analog.
+
+Parity: reference platform/enforce + gflags flags (FLAGS_check_nan_inf
+in framework/operator.cc:590, FLAGS_benchmark in executor.cc, plus the
+env forwarding done by python/paddle/fluid/__init__.py:__bootstrap__,
+which passes selected FLAGS_* env vars to InitGflags).  Here flags are
+plain Python with the same ``FLAGS_<name>`` environment override.
+"""
+from __future__ import annotations
+
+import os
+
+__all__ = ["FLAGS", "define_flag"]
+
+
+def _parse(raw, default):
+    if isinstance(default, bool):
+        return raw.lower() in ("1", "true", "yes", "on")
+    if isinstance(default, int):
+        return int(raw)
+    if isinstance(default, float):
+        return float(raw)
+    return raw
+
+
+class _Flags:
+    """Attribute-style access; unknown flags raise AttributeError."""
+
+    def __init__(self):
+        object.__setattr__(self, "_defs", {})
+
+    def define(self, name, default, help=""):
+        raw = os.environ.get("FLAGS_" + name)
+        value = _parse(raw, default) if raw is not None else default
+        self._defs[name] = {"value": value, "default": default,
+                            "help": help}
+
+    def __getattr__(self, name):
+        try:
+            return self._defs[name]["value"]
+        except KeyError:
+            raise AttributeError("undefined flag %r (define it with "
+                                 "flags.define_flag)" % name)
+
+    def __setattr__(self, name, value):
+        if name not in self._defs:
+            raise AttributeError("undefined flag %r" % name)
+        self._defs[name]["value"] = value
+
+    def flags(self):
+        return {k: v["value"] for k, v in self._defs.items()}
+
+
+FLAGS = _Flags()
+
+
+def define_flag(name, default, help=""):
+    FLAGS.define(name, default, help)
+
+
+# core runtime flags (reference analogs cited above)
+define_flag("check_nan_inf", False,
+            "run blocks op-by-op and raise on the first op producing "
+            "nan/inf (reference FLAGS_check_nan_inf)")
+define_flag("benchmark", False,
+            "print per-run wall time (reference FLAGS_benchmark)")

@@ -36,6 +36,13 @@ from .io import (save_vars, save_params, save_persistables, load_vars,
                  load_inference_model, save_checkpoint, load_checkpoint,
                  clean_checkpoint, get_latest_checkpoint_serial)
 from .data_feeder import DataFeeder
+from . import trainer
+from .trainer import (Trainer, BeginEpochEvent, EndEpochEvent,
+                      BeginStepEvent, EndStepEvent, CheckpointConfig)
+from . import inferencer
+from .inferencer import Inferencer
+from . import debugger
+from paddle_tpu.core.flags import FLAGS, define_flag
 from . import transpiler
 from .transpiler import DistributeTranspiler
 from .parallel_executor import (ParallelExecutor, ExecutionStrategy,

@@ -1,0 +1,311 @@
+"""High-level Trainer: event-driven train loop with checkpoint/resume.
+
+Parity: reference python/paddle/fluid/trainer.py:35-114 (events +
+CheckpointConfig), :120-196 (program construction, checkpoint load,
+dist transpile by env), :280-330 (train/test/save), :332-460 (executor
+loop, per-step events, save+scroll, epoch/step restore).
+"""
+from __future__ import annotations
+
+import os
+
+from paddle_tpu.core.place import CPUPlace, TPUPlace
+from paddle_tpu.core.scope import Scope
+
+from . import framework
+from . import io
+from . import optimizer as opt_module
+from .data_feeder import DataFeeder
+from .executor import Executor, scope_guard
+from .transpiler import DistributeTranspiler
+
+__all__ = ["Trainer", "BeginEpochEvent", "EndEpochEvent",
+           "BeginStepEvent", "EndStepEvent", "CheckpointConfig"]
+
+
+class BeginEpochEvent:
+    def __init__(self, epoch_id):
+        self.epoch = epoch_id
+
+
+class EndEpochEvent:
+    def __init__(self, epoch_id):
+        self.epoch = epoch_id
+
+
+class BeginStepEvent:
+    def __init__(self, epoch_id, step_id):
+        self.epoch = epoch_id
+        self.step = step_id
+        # handler may flip this off to skip fetching metrics this step
+        self.fetch_metrics = True
+
+
+class EndStepEvent:
+    def __init__(self, epoch_id, step_id, metrics):
+        self.epoch = epoch_id
+        self.step = step_id
+        self.metrics = metrics
+
+
+class CheckpointConfig:
+    def __init__(self, checkpoint_dir=None, max_num_checkpoints=3,
+                 epoch_interval=1, step_interval=10):
+        self.checkpoint_dir = checkpoint_dir or os.getcwd()
+        self.max_num_checkpoints = max_num_checkpoints
+        self.epoch_interval = max(1, epoch_interval)
+        self.step_interval = step_interval if step_interval >= 1 else 10
+        self.epoch_id = 0
+        self.step_id = 0
+        self.load_serial = None
+        self.is_pserver = False
+
+
+def check_and_get_place(place):
+    """Default to the TPU when one is attached (reference
+    trainer.py:check_and_get_place defaults to CUDAPlace(0))."""
+    if place is not None:
+        return place
+    try:
+        import jax
+        if any(d.platform != "cpu" for d in jax.devices()):
+            return TPUPlace()
+    except Exception:
+        pass
+    return CPUPlace()
+
+
+class Trainer:
+    """train_func() builds the forward graph and returns [loss, ...];
+    optimizer_func() returns the Optimizer.  The constructor builds
+    train/test/startup programs, runs startup, dist-transpiles when the
+    PADDLE_TRAINING_ROLE env contract is present, and restores the
+    newest checkpoint if checkpoint_config is given."""
+
+    def __init__(self, train_func, optimizer_func, param_path=None,
+                 place=None, parallel=False, checkpoint_config=None):
+        self.__stop = False
+        self.parallel = parallel
+        self.trainer_id = 0
+        self.checkpoint_cfg = checkpoint_config
+        if self.checkpoint_cfg:
+            assert isinstance(self.checkpoint_cfg, CheckpointConfig)
+            serial = io.get_latest_checkpoint_serial(
+                self.checkpoint_cfg.checkpoint_dir)
+            self.checkpoint_cfg.load_serial = \
+                serial if serial >= 0 else None
+
+        self.scope = Scope()
+        self.startup_program = framework.Program()
+        self.train_program = framework.Program()
+
+        from . import unique_name
+
+        with framework.program_guard(self.train_program,
+                                     self.startup_program):
+            # fresh name scope: var names are deterministic per Trainer,
+            # so an in-process re-construction resumes from checkpoints
+            # written by an earlier instance
+            with unique_name.guard():
+                outs = train_func()
+                self.train_func_outputs = outs if isinstance(outs, list) \
+                    else [outs]
+                self.test_program = \
+                    self.train_program.clone(for_test=True)
+                loss = self.train_func_outputs[0]
+                opt = optimizer_func()
+                if not isinstance(opt, opt_module.Optimizer):
+                    raise TypeError(
+                        "optimizer_func must return an Optimizer")
+                opt.minimize(loss)
+
+        self.place = check_and_get_place(place)
+        self._dist_transpile_if_necessary()
+
+        with self._prog_and_scope_guard():
+            exe = Executor(self.place)
+            exe.run(self.startup_program)
+
+        if self.checkpoint_cfg and self.checkpoint_cfg.load_serial \
+                is not None:
+            with self._prog_and_scope_guard():
+                io.load_checkpoint(exe, self.checkpoint_cfg.checkpoint_dir,
+                                   self.checkpoint_cfg.load_serial,
+                                   self.train_program)
+            if not self.checkpoint_cfg.is_pserver:
+                args = io.load_trainer_args(
+                    self.checkpoint_cfg.checkpoint_dir,
+                    self.checkpoint_cfg.load_serial, self.trainer_id)
+                self.checkpoint_cfg.epoch_id = int(args["epoch_id"])
+                self.checkpoint_cfg.step_id = int(args["step_id"])
+
+        if param_path and os.path.isdir(param_path):
+            with self._prog_and_scope_guard():
+                io.load_persistables(exe, param_path, self.train_program)
+
+    # ------------------------------------------------------------------
+    def _prog_and_scope_guard(self):
+        import contextlib
+
+        @contextlib.contextmanager
+        def guard():
+            with framework.program_guard(self.train_program,
+                                         self.startup_program):
+                with scope_guard(self.scope):
+                    yield
+
+        return guard()
+
+    def _dist_transpile_if_necessary(self):
+        """Env-variable dist contract (reference trainer.py:228-273):
+        PADDLE_TRAINING_ROLE in {PSERVER, TRAINER} switches this process
+        into its pserver/trainer program."""
+        if "PADDLE_TRAINING_ROLE" not in os.environ:
+            return
+        port = os.getenv("PADDLE_PSERVER_PORT", "6174")
+        pserver_ips = os.getenv("PADDLE_PSERVER_IPS", "")
+        eps = [ip + ":" + port for ip in pserver_ips.split(",") if ip]
+        pserver_endpoints = ",".join(eps)
+        trainers = int(os.getenv("PADDLE_TRAINERS", "1"))
+        current_endpoint = os.getenv("PADDLE_CURRENT_IP", "") + ":" + port
+        self.trainer_id = int(os.getenv("PADDLE_TRAINER_ID", "0"))
+        role = os.getenv("PADDLE_TRAINING_ROLE")
+        with self._prog_and_scope_guard():
+            t = DistributeTranspiler()
+            t.transpile(self.trainer_id, program=self.train_program,
+                        startup_program=self.startup_program,
+                        pservers=pserver_endpoints, trainers=trainers)
+            if role == "PSERVER":
+                if self.checkpoint_cfg:
+                    self.checkpoint_cfg.is_pserver = True
+                self.train_program = t.get_pserver_program(
+                    current_endpoint)
+                self.startup_program = t.get_startup_program(
+                    current_endpoint, self.train_program)
+            elif role == "TRAINER":
+                self.train_program = t.get_trainer_program()
+            else:
+                raise ValueError(
+                    "PADDLE_TRAINING_ROLE must be TRAINER or PSERVER")
+
+    # ------------------------------------------------------------------
+    def stop(self):
+        self.__stop = True
+
+    def train(self, num_epochs, event_handler, reader=None,
+              feed_order=None):
+        if os.getenv("PADDLE_TRAINING_ROLE", "") == "PSERVER":
+            with self._prog_and_scope_guard():
+                exe = Executor(self.place)
+                exe.run(self.train_program)  # serve until SendComplete
+                return
+        self._train_by_executor(num_epochs, event_handler, reader,
+                                feed_order)
+
+    def test(self, reader, feed_order=None):
+        """Mean metrics of train_func's outputs over the test reader."""
+        import numpy as np
+
+        feeder = self._feeder(feed_order, self.test_program)
+        exe = Executor(self.place)
+        totals = None
+        count = 0
+        with scope_guard(self.scope):
+            for minibatch in reader():
+                feed = feeder.feed(minibatch)
+                outs = exe.run(self.test_program, feed=feed,
+                               fetch_list=[v.name for v in
+                                           self.train_func_outputs])
+                vals = [float(np.ravel(np.asarray(o))[0]) for o in outs]
+                totals = vals if totals is None else \
+                    [a + b for a, b in zip(totals, vals)]
+                count += 1
+        return [t / max(count, 1) for t in (totals or [])]
+
+    def save_params(self, param_path):
+        with self._prog_and_scope_guard():
+            exe = Executor(self.place)
+            io.save_persistables(exe, param_path, self.train_program)
+
+    def save_inference_model(self, param_path, feeded_var_names,
+                             target_var_indexes):
+        with self._prog_and_scope_guard():
+            exe = Executor(self.place)
+            io.save_inference_model(
+                param_path, feeded_var_names,
+                [self.train_func_outputs[i]
+                 for i in target_var_indexes], exe,
+                main_program=self.train_program)
+
+    # ------------------------------------------------------------------
+    def _feeder(self, feed_order, program):
+        if feed_order is None:
+            raise ValueError(
+                "feed_order is required (list of data-layer names, "
+                "matching the reader's sample fields)")
+        with framework.program_guard(program):
+            return DataFeeder(feed_list=list(feed_order), place=self.place,
+                              program=program)
+
+    def _train_by_executor(self, num_epochs, event_handler, reader,
+                           feed_order):
+        import numpy as np
+
+        feeder = self._feeder(feed_order, self.train_program)
+        exe = Executor(self.place)
+        metrics = [v.name for v in self.train_func_outputs]
+        start_epoch = (self.checkpoint_cfg.epoch_id
+                       if self.checkpoint_cfg else 0)
+        with scope_guard(self.scope):
+            for epoch_id in range(start_epoch, num_epochs):
+                event_handler(BeginEpochEvent(epoch_id))
+                for step_id, minibatch in enumerate(reader()):
+                    if self.__stop:
+                        if self.checkpoint_cfg:
+                            self._clean_checkpoint()
+                        return
+                    # resuming mid-epoch: skip already-trained steps
+                    if (self.checkpoint_cfg and
+                            epoch_id == start_epoch and
+                            step_id < self.checkpoint_cfg.step_id):
+                        continue
+                    begin = BeginStepEvent(epoch_id, step_id)
+                    event_handler(begin)
+                    feed = feeder.feed(minibatch)
+                    if begin.fetch_metrics:
+                        outs = exe.run(self.train_program, feed=feed,
+                                       fetch_list=metrics)
+                        vals = [np.asarray(o) for o in outs]
+                    else:
+                        exe.run(self.train_program, feed=feed,
+                                fetch_list=[])
+                        vals = []
+                    if (self.checkpoint_cfg and
+                            step_id % self.checkpoint_cfg.step_interval
+                            == 0 and
+                            epoch_id % self.checkpoint_cfg.epoch_interval
+                            == 0):
+                        # cursor = NEXT step to run: the params already
+                        # include this step's update, so resuming must
+                        # not re-apply it (the reference saves step_id
+                        # and double-runs the checkpointed step)
+                        self._save_checkpoint(epoch_id, step_id + 1)
+                    event_handler(EndStepEvent(epoch_id, step_id, vals))
+                if self.checkpoint_cfg:
+                    # epoch rolls over: next resume starts at step 0
+                    self._save_checkpoint(epoch_id + 1, 0)
+                event_handler(EndEpochEvent(epoch_id))
+            if self.checkpoint_cfg:
+                self._clean_checkpoint()
+
+    def _save_checkpoint(self, epoch_id, step_id):
+        exe = Executor(self.place)
+        io.save_checkpoint(
+            exe, self.checkpoint_cfg.checkpoint_dir,
+            trainer_id=self.trainer_id,
+            trainer_args={"epoch_id": epoch_id, "step_id": step_id},
+            main_program=self.train_program,
+            max_num_checkpoints=self.checkpoint_cfg.max_num_checkpoints)
+
+    def _clean_checkpoint(self):
+        io.clean_checkpoint(self.checkpoint_cfg.checkpoint_dir)

@@ -45,7 +45,8 @@ def _save(executor, op, scope, feed, env=None):
     path = op.attr("file_path")
     os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
     name = op.input("X")[0]
-    val = env[name] if env is not None else scope.find_var(name)
+    val = (env[name] if env is not None and name in env
+           else scope.find_var(name))
     serialization.save_tensor(path, np.asarray(val))
 
 
@@ -66,7 +67,8 @@ def _save_combine(executor, op, scope, feed, env=None):
     os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
     items = []
     for name in op.input("X"):
-        val = env[name] if env is not None else scope.find_var(name)
+        val = (env[name] if env is not None and name in env
+           else scope.find_var(name))
         items.append((name, np.asarray(val)))
     serialization.save_combined(path, items)
 
@@ -86,7 +88,8 @@ def _load_combine(executor, op, scope, feed, env=None):
 @_host("print")
 def _print(executor, op, scope, feed, env=None):
     name = op.input("In")[0]
-    val = env[name] if env is not None else scope.find_var(name)
+    val = (env[name] if env is not None and name in env
+           else scope.find_var(name))
     msg = op.attr("message", "")
     arr = np.asarray(val)
     parts = [msg or name]

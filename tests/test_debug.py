@@ -1,0 +1,131 @@
+"""Debug subsystem: FLAGS registry (gflags analog), per-op nan/inf
+detection naming the culprit op (reference FLAGS_check_nan_inf,
+framework/operator.cc:590), and graphviz/pseudo-code program dumps
+(reference python/paddle/fluid/debuger.py)."""
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+
+
+def _nan_model():
+    x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+    h = fluid.layers.fc(x, size=4, act="relu",
+                        param_attr=fluid.ParamAttr(
+                            name="w", initializer=fluid.initializer.
+                            ConstantInitializer(0.1)))
+    # log(relu(h) - big) -> log of a negative number -> nan, at THIS op
+    shifted = fluid.layers.scale(h, scale=1.0, bias=-100.0)
+    bad = fluid.layers.log(shifted)
+    loss = fluid.layers.mean(bad)
+    return loss
+
+
+def test_check_nan_inf_names_the_op():
+    main, startup = fluid.Program(), fluid.Program()
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        with fluid.program_guard(main, startup):
+            with fluid.unique_name.guard():
+                loss = _nan_model()
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        xv = np.ones((2, 4), np.float32)
+        # without the flag: nan flows to the fetch silently
+        out, = exe.run(main, feed={"x": xv}, fetch_list=[loss])
+        assert np.isnan(np.asarray(out)).all()
+        fluid.FLAGS.check_nan_inf = True
+        try:
+            with pytest.raises(FloatingPointError) as ei:
+                exe.run(main, feed={"x": xv}, fetch_list=[loss])
+        finally:
+            fluid.FLAGS.check_nan_inf = False
+        # the first nan-producing op is 'log', not the downstream mean
+        assert "'log'" in str(ei.value)
+
+
+def test_host_ops_run_once_in_interpreted_mode(capsys, tmp_path):
+    """Interpreted path (forced by check_nan_inf) must not double-run
+    head/tail host ops — e.g. a double-send would desync a pserver."""
+    main, startup = fluid.Program(), fluid.Program()
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        with fluid.program_guard(main, startup):
+            with fluid.unique_name.guard():
+                x = fluid.layers.data(name="x", shape=[2],
+                                      dtype="float32")
+                y = fluid.layers.scale(x, scale=2.0)
+                fluid.layers.Print(y, message="tailprint")
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        for flag in (False, True):
+            fluid.FLAGS.check_nan_inf = flag
+            try:
+                capsys.readouterr()
+                exe.run(main, feed={"x": np.ones((1, 2), np.float32)},
+                        fetch_list=[y])
+            finally:
+                fluid.FLAGS.check_nan_inf = False
+            printed = capsys.readouterr().out
+            assert printed.count("tailprint") == 1, (flag, printed)
+
+
+def test_flags_benchmark_prints(capsys):
+    main, startup = fluid.Program(), fluid.Program()
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        with fluid.program_guard(main, startup):
+            with fluid.unique_name.guard():
+                x = fluid.layers.data(name="x", shape=[2],
+                                      dtype="float32")
+                y = fluid.layers.scale(x, scale=2.0)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        fluid.FLAGS.benchmark = True
+        try:
+            exe.run(main, feed={"x": np.ones((1, 2), np.float32)},
+                    fetch_list=[y])
+        finally:
+            fluid.FLAGS.benchmark = False
+        assert "[benchmark] block 0 ran in" in capsys.readouterr().err
+
+
+def test_flags_env_forwarding():
+    code = ("import paddle_tpu.fluid as fluid; "
+            "print(fluid.FLAGS.check_nan_inf, fluid.FLAGS.benchmark)")
+    env = dict(os.environ, FLAGS_check_nan_inf="true",
+               JAX_PLATFORMS="cpu")
+    out = subprocess.run([sys.executable, "-c", code], env=env,
+                         capture_output=True, text=True)
+    assert out.stdout.strip() == "True False", out.stderr
+
+
+def test_flags_unknown_raises():
+    with pytest.raises(AttributeError):
+        fluid.FLAGS.not_a_flag
+    with pytest.raises(AttributeError):
+        fluid.FLAGS.also_not_a_flag = 1
+    fluid.define_flag("custom_test_flag", 7)
+    assert fluid.FLAGS.custom_test_flag == 7
+
+
+def test_program_dumps():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        with fluid.unique_name.guard():
+            _nan_model()
+    text = fluid.debugger.pprint_program(main)
+    assert "mul(" in text and "block_0" in text
+    dot = fluid.debugger.draw_block_graphviz(main.global_block())
+    assert dot.startswith("digraph G {") and dot.rstrip().endswith("}")
+    assert '[label="mul"' in dot
+    assert "fillcolor=\"lightgrey\"" in dot  # parameter shading
+    # every edge endpoint is a declared node
+    import re
+    nodes = set(re.findall(r"^\s{2}(\w+) \[", dot, re.M))
+    for a, b in re.findall(r"^\s{2}(\w+) -> (\w+);", dot, re.M):
+        assert a in nodes and b in nodes
