@@ -107,17 +107,31 @@ class ExecutorCore:
                 _run_host_op(self, op, scope, feed)
             # postlude host ops may read non-persistable temps the block
             # computed (e.g. print of an activation): fetch those too and
-            # hand them over via env instead of polluting the scope
+            # hand them over via env instead of polluting the scope.
+            # Conversely, fetches PRODUCED by postlude host ops (e.g. a
+            # chunk_eval metric) come out of that env afterwards.
+            post_writes = {n for op in postlude
+                           for n in op.output_arg_names() if n}
+            core_fetch = [n for n in fetch_list if n not in post_writes]
+            post_in = [n for op in postlude for n in op.input_arg_names()
+                       if n]
+            # '@LEN' companions ride along so host ops (chunk_eval &c.)
+            # see real sequence lengths, not the padded T
+            post_in += [n + LEN_SUFFIX for n in list(post_in)]
             post_reads = sorted({
-                n for op in postlude for n in op.input_arg_names()
-                if n and n not in feed and not scope.has_var(n)})
+                n for n in post_in
+                if n not in feed and not scope.has_var(n)
+                and n not in post_writes})
             outs = self._run_compiled(program, block_id, core_ops, scope,
-                                      feed, fetch_list + post_reads, mode)
-            fetches = outs[:len(fetch_list)]
-            post_env = dict(zip(post_reads, outs[len(fetch_list):]))
+                                      feed, core_fetch + post_reads, mode)
+            by_name = dict(zip(core_fetch, outs[:len(core_fetch)]))
+            post_env = dict(zip(post_reads, outs[len(core_fetch):]))
             for op in postlude:
                 _run_host_op(self, op, scope, feed,
-                             post_env if post_reads else None)
+                             post_env if (post_reads or post_writes)
+                             else None)
+            fetches = [by_name[n] if n in by_name else post_env.get(n)
+                       for n in fetch_list]
 
         if t0 is not None:
             # reference FLAGS_benchmark (executor.cc): per-run wall time
@@ -200,9 +214,14 @@ class ExecutorCore:
             for name in op.output_arg_names():
                 if name:
                     written.add(name)
-        # fetching an un-written var (e.g. a parameter) reads it too
+        # fetching an un-written var (e.g. a parameter) reads it too.
+        # '@LEN' fetches are env-internal sequence lengths produced by the
+        # trace itself (or absent -> fetched as None), never external.
         for name in fetch_list:
-            if name and name not in written and name not in seen_ext:
+            if (name and name not in written and name not in seen_ext
+                    and not (name.endswith(LEN_SUFFIX)
+                             and not scope.has_var(name)
+                             and name not in feed)):
                 seen_ext.add(name)
                 external.append(name)
         # ragged feeds travel as (padded, lengths) pairs: pull in the

@@ -58,6 +58,17 @@ class LoDTensor:
         return out, np.asarray(lens, dtype=np.int64)
 
     @staticmethod
+    def from_sequences(seqs):
+        """Build from a list of [T_i, D...] arrays (level-1 LoD)."""
+        seqs = [np.asarray(s) for s in seqs]
+        offs = [0]
+        for s in seqs:
+            offs.append(offs[-1] + len(s))
+        data = (np.concatenate(seqs, axis=0) if seqs
+                else np.zeros((0,), np.float32))
+        return LoDTensor(data, [offs])
+
+    @staticmethod
     def from_padded(padded, lengths):
         padded = np.asarray(padded)
         lengths = [int(l) for l in np.asarray(lengths).reshape(-1)]

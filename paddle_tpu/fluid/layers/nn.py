@@ -30,6 +30,8 @@ __all__ = [
     "sampling_id", "gaussian_random", "uniform_random",
     "gaussian_random_batch_size_like", "uniform_random_batch_size_like",
     "random_crop", "mean_iou", "spp", "beam_search", "beam_search_decode",
+    "linear_chain_crf", "crf_decoding", "ctc_greedy_decoder",
+    "chunk_eval",
 ]
 
 
@@ -999,3 +1001,100 @@ def beam_search_decode(ids, scores, parents, beam_size, end_id, name=None):
                  "SentenceScores": [sentence_scores]},
         attrs={"beam_size": beam_size, "end_id": end_id})
     return sentence_ids, sentence_scores
+
+
+def linear_chain_crf(input, label, param_attr=None):
+    """Linear-chain CRF cost (reference nn.py linear_chain_crf:xxx /
+    linear_chain_crf_op.cc).  Creates the [K+2, K] transition parameter
+    (row 0 start, row 1 stop) and returns the per-sequence negative
+    log-likelihood [N, 1]."""
+    helper = LayerHelper("linear_chain_crf", **locals())
+    size = input.shape[-1]
+    transition = helper.create_parameter(
+        attr=helper.param_attr(), shape=[size + 2, size],
+        dtype=helper.input_dtype())
+    log_likelihood = helper.create_tmp_variable(
+        dtype=helper.input_dtype())
+    helper.append_op(
+        type="linear_chain_crf",
+        inputs={"Emission": [input], "Transition": [transition],
+                "Label": [label]},
+        outputs={"LogLikelihood": [log_likelihood]})
+    return log_likelihood
+
+
+def crf_decoding(input, param_attr, label=None):
+    """Viterbi decode with the CRF's transition parameter (reference
+    nn.py crf_decoding / crf_decoding_op.cc).  With ``label`` the output
+    is the per-token correctness mask."""
+    helper = LayerHelper("crf_decoding", **locals())
+    block = helper.main_program.global_block()
+    if param_attr.name in block.vars:
+        transition = block.var(param_attr.name)
+    else:
+        # standalone inference program: declare the parameter so
+        # load_persistables can fill it by name
+        size = input.shape[-1]
+        transition = helper.create_parameter(
+            attr=param_attr, shape=[size + 2, size],
+            dtype=helper.input_dtype())
+    viterbi_path = helper.create_tmp_variable(dtype="int64")
+    inputs = {"Emission": [input], "Transition": [transition]}
+    if label is not None:
+        inputs["Label"] = [label]
+    helper.append_op(type="crf_decoding", inputs=inputs,
+                     outputs={"ViterbiPath": [viterbi_path]})
+    viterbi_path.stop_gradient = True
+    return viterbi_path
+
+
+def warpctc(input, label, blank=0, norm_by_times=False):
+    """CTC loss (reference nn.py warpctc / warpctc_op.cc).  ``input`` is
+    the raw [N, T, V] logits; returns per-sequence loss [N, 1]."""
+    helper = LayerHelper("warpctc", **locals())
+    loss = helper.create_tmp_variable(dtype=input.dtype)
+    grad = helper.create_tmp_variable(dtype=input.dtype)
+    helper.append_op(
+        type="warpctc", inputs={"Logits": [input], "Label": [label]},
+        outputs={"Loss": [loss], "WarpCTCGrad": [grad]},
+        attrs={"blank": int(blank), "norm_by_times": norm_by_times})
+    return loss
+
+
+def ctc_greedy_decoder(input, blank, name=None):
+    """argmax + ctc_align: merge repeats then drop blanks (reference
+    nn.py ctc_greedy_decoder built on ctc_align_op.cc)."""
+    helper = LayerHelper("ctc_greedy_decoder", **locals())
+    _, ids = topk(input, k=1)
+    ids = reshape(ids, list(ids.shape[:-1]))
+    out = helper.create_tmp_variable(dtype="int64")
+    helper.append_op(type="ctc_align", inputs={"Input": [ids]},
+                     outputs={"Output": [out]},
+                     attrs={"blank": int(blank), "padding_value": 0})
+    out.stop_gradient = True
+    return out
+
+
+def chunk_eval(input, label, chunk_scheme, num_chunk_types,
+               excluded_chunk_types=None):
+    """Chunk-level precision/recall/F1 (reference nn.py chunk_eval /
+    chunk_eval_op.cc; schemes plain/IOB/IOE/IOBES)."""
+    helper = LayerHelper("chunk_eval", **locals())
+    precision = helper.create_tmp_variable(dtype="float32")
+    recall = helper.create_tmp_variable(dtype="float32")
+    f1_score = helper.create_tmp_variable(dtype="float32")
+    num_infer = helper.create_tmp_variable(dtype="int64")
+    num_label = helper.create_tmp_variable(dtype="int64")
+    num_correct = helper.create_tmp_variable(dtype="int64")
+    helper.append_op(
+        type="chunk_eval",
+        inputs={"Inference": [input], "Label": [label]},
+        outputs={"Precision": [precision], "Recall": [recall],
+                 "F1-Score": [f1_score], "NumInferChunks": [num_infer],
+                 "NumLabelChunks": [num_label],
+                 "NumCorrectChunks": [num_correct]},
+        attrs={"chunk_scheme": chunk_scheme,
+               "num_chunk_types": int(num_chunk_types),
+               "excluded_chunk_types": list(excluded_chunk_types or [])})
+    return (precision, recall, f1_score, num_infer, num_label,
+            num_correct)

@@ -12,7 +12,8 @@ __all__ = [
     "dynamic_lstm", "dynamic_gru", "sequence_pool", "sequence_softmax",
     "sequence_expand", "sequence_conv", "sequence_first_step",
     "sequence_last_step", "sequence_erase", "lod_reset", "edit_distance",
-    "lstm_unit", "gru_unit",
+    "lstm_unit", "gru_unit", "dynamic_lstmp", "sequence_concat",
+    "sequence_reshape", "sequence_slice",
 ]
 
 
@@ -205,3 +206,75 @@ def gru_unit(input, hidden, size, param_attr=None, bias_attr=None,
         attrs={"activation": activation,
                "gate_activation": gate_activation})
     return updated, reset_hidden, gate
+
+
+def dynamic_lstmp(input, size, proj_size, param_attr=None,
+                  bias_attr=None, use_peepholes=True, is_reverse=False,
+                  gate_activation="sigmoid", cell_activation="tanh",
+                  candidate_activation="tanh", proj_activation="tanh",
+                  dtype="float32", name=None):
+    """LSTM with recurrent projection (reference nn.py dynamic_lstmp /
+    lstmp_op.cc).  Returns (projection [N,T,P], cell [N,T,H])."""
+    helper = LayerHelper("lstmp", **locals())
+    hidden_size = size // 4
+    # two distinct parameters: replicate the (possibly shared) attr so
+    # create_parameter doesn't collide Weight and ProjWeight on one name
+    w_attr, proj_attr = helper.multiple_param_attr(2)
+    weight = helper.create_parameter(
+        attr=w_attr, shape=[proj_size, 4 * hidden_size], dtype=dtype)
+    proj_weight = helper.create_parameter(
+        attr=proj_attr, shape=[hidden_size, proj_size], dtype=dtype)
+    bias_size = [1, 7 * hidden_size if use_peepholes
+                 else 4 * hidden_size]
+    bias = helper.create_parameter(attr=helper.bias_attr(),
+                                   shape=bias_size, dtype=dtype,
+                                   is_bias=True)
+    proj = helper.create_tmp_variable(dtype)
+    cell = helper.create_tmp_variable(dtype)
+    helper.append_op(
+        type="lstmp",
+        inputs={"Input": [input], "Weight": [weight],
+                "ProjWeight": [proj_weight], "Bias": [bias]},
+        outputs={"Projection": [proj], "Cell": [cell]},
+        attrs={"use_peepholes": use_peepholes, "is_reverse": is_reverse,
+               "gate_activation": gate_activation,
+               "cell_activation": cell_activation,
+               "candidate_activation": candidate_activation,
+               "proj_activation": proj_activation})
+    return proj, cell
+
+
+def sequence_concat(input, name=None):
+    """Concatenate sequences row-wise along time (reference nn.py
+    sequence_concat / sequence_concat_op.cc)."""
+    helper = LayerHelper("sequence_concat", **locals())
+    xs = input if isinstance(input, (list, tuple)) else [input]
+    out = helper.create_tmp_variable(dtype=xs[0].dtype)
+    helper.append_op(type="sequence_concat", inputs={"X": list(xs)},
+                     outputs={"Out": [out]})
+    return out
+
+
+def sequence_reshape(input, new_dim):
+    """Change token width, rescaling sequence lengths (reference nn.py
+    sequence_reshape / sequence_reshape_op.cc)."""
+    helper = LayerHelper("sequence_reshape", **locals())
+    out = helper.create_tmp_variable(dtype=input.dtype)
+    helper.append_op(type="sequence_reshape", inputs={"X": [input]},
+                     outputs={"Out": [out]},
+                     attrs={"new_dim": int(new_dim)})
+    return out
+
+
+def sequence_slice(input, offset, length, name=None):
+    """Per-sequence [offset, offset+length) slice (reference nn.py
+    sequence_slice / sequence_slice_op.cc)."""
+    helper = LayerHelper("sequence_slice", **locals())
+    out = helper.create_tmp_variable(dtype=input.dtype)
+    helper.append_op(type="sequence_slice",
+                     inputs={"X": [input], "Offset": [offset],
+                             "Length": [length]},
+                     outputs={"Out": [out]})
+    for v in (offset, length):
+        v.stop_gradient = True
+    return out

@@ -18,4 +18,5 @@ from paddle_tpu.ops import (  # noqa: F401
     control_flow,
     distributed_ops,
     beam_search,
+    crf_ctc,
 )

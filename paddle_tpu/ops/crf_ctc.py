@@ -1,0 +1,380 @@
+"""Structured-prediction ops: linear-chain CRF, Viterbi decoding, CTC
+loss, CTC alignment, chunk evaluation.
+
+Parity: reference operators/linear_chain_crf_op.{cc,h} (forward algorithm
+returning the negative log-likelihood; grads there are hand-derived,
+here jax.vjp of the forward), crf_decoding_op.cc (Viterbi),
+warpctc_op.cc (the warp-ctc CUDA library; here a log-space alpha
+recursion under lax.scan — same loss, no external kernel),
+ctc_align_op.cc, chunk_eval_op.cc.
+
+All ops run on the padded [N, T, ...] + '@LEN' representation (see
+ops/sequence.py module docstring); the scans are time-major so XLA
+compiles one fused loop per op.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.core.registry import register_op
+
+NEG = -1e30
+
+
+def _lens_or_full(ctx, op, slot, n, t):
+    names = (op.inputs.get(slot) or []) if op is not None else []
+    lens = ctx.seq_len_of(names[0]) if names and names[0] else None
+    if lens is None:
+        return jnp.full((n,), t, jnp.int32)
+    return lens.astype(jnp.int32)
+
+
+# ---------------------------------------------------------------------------
+# linear_chain_crf / crf_decoding
+# ---------------------------------------------------------------------------
+
+@register_op("linear_chain_crf", seq_aware=True)
+def _linear_chain_crf(ctx, ins, attrs, op=None):
+    """Emission [N,T,K]; Transition [K+2,K] (row 0 start, row 1 stop,
+    rows 2.. pairwise [K,K]); Label [N,T,1] or [N,T] int.
+    Output LogLikelihood [N,1] = logZ - gold score (the reference's
+    negative log-likelihood, linear_chain_crf_op.h:193 returns -ll)."""
+    em = ins["Emission"]
+    w = ins["Transition"]
+    label = ins["Label"]
+    if label.ndim == 3:
+        label = label[..., 0]
+    label = label.astype(jnp.int32)
+    n, t, k = em.shape
+    lens = _lens_or_full(ctx, op, "Emission", n, t)
+    start, stop, trans = w[0], w[1], w[2:]
+
+    emf = em.astype(jnp.float32)
+    steps = jnp.arange(t)
+    valid = steps[None, :] < lens[:, None]          # [N,T]
+
+    # --- logZ by the forward algorithm (log-space) ---
+    alpha0 = start[None, :] + emf[:, 0, :]          # [N,K]
+
+    def fwd(alpha, tm):
+        e_t, v_t = tm                               # [N,K], [N]
+        nxt = jax.nn.logsumexp(
+            alpha[:, :, None] + trans[None, :, :], axis=1) + e_t
+        return jnp.where(v_t[:, None], nxt, alpha), None
+
+    alpha, _ = jax.lax.scan(
+        fwd, alpha0, (jnp.moveaxis(emf, 1, 0)[1:],
+                      jnp.moveaxis(valid, 1, 0)[1:]))
+    logz = jax.nn.logsumexp(alpha + stop[None, :], axis=1)   # [N]
+
+    # --- gold path score ---
+    em_lab = jnp.take_along_axis(emf, label[:, :, None],
+                                 axis=2)[..., 0]             # [N,T]
+    em_score = jnp.sum(jnp.where(valid, em_lab, 0.0), axis=1)
+    pair = trans[label[:, :-1], label[:, 1:]]                # [N,T-1]
+    pair_valid = valid[:, 1:]
+    trans_score = jnp.sum(jnp.where(pair_valid, pair, 0.0), axis=1)
+    last_idx = jnp.clip(lens - 1, 0, t - 1)
+    last_lab = jnp.take_along_axis(label, last_idx[:, None],
+                                   axis=1)[:, 0]
+    gold = em_score + trans_score + start[label[:, 0]] + stop[last_lab]
+
+    nll = (logz - gold) * (lens > 0)     # empty sequence costs 0
+    return {"LogLikelihood": nll[:, None].astype(em.dtype)}
+
+
+@register_op("crf_decoding", grad_maker=None, seq_aware=True)
+def _crf_decoding(ctx, ins, attrs, op=None):
+    """Viterbi decode (reference crf_decoding_op.h).  With Label given,
+    emits the per-token correctness mask instead of the raw path (that
+    is the reference behavior used by metrics)."""
+    em = ins["Emission"].astype(jnp.float32)
+    w = ins["Transition"]
+    n, t, k = em.shape
+    lens = _lens_or_full(ctx, op, "Emission", n, t)
+    start, stop, trans = w[0], w[1], w[2:]
+    steps = jnp.arange(t)
+    valid = steps[None, :] < lens[:, None]
+
+    delta0 = start[None, :] + em[:, 0, :]
+
+    def fwd(delta, tm):
+        e_t, v_t = tm
+        scores = delta[:, :, None] + trans[None, :, :]       # [N,K,K]
+        best = jnp.max(scores, axis=1) + e_t
+        arg = jnp.argmax(scores, axis=1).astype(jnp.int32)   # [N,K]
+        nxt = jnp.where(v_t[:, None], best, delta)
+        return nxt, arg
+
+    delta, back = jax.lax.scan(
+        fwd, delta0, (jnp.moveaxis(em, 1, 0)[1:],
+                      jnp.moveaxis(valid, 1, 0)[1:]))        # back [T-1,N,K]
+
+    last = jnp.argmax(delta + stop[None, :], axis=1).astype(jnp.int32)
+
+    # backtrack from each sequence's last step; frozen rows (t beyond the
+    # sequence) pass the state through unchanged
+    def bwd(state, tb):
+        ptr, v_t = tb                                        # [N,K],[N]
+        prev = jnp.take_along_axis(ptr, state[:, None], axis=1)[:, 0]
+        new = jnp.where(v_t, prev, state)
+        return new, state
+
+    # path_rev[t] is the tag at t+1; the final carry is the time-0 tag
+    first, path_rev = jax.lax.scan(
+        bwd, last, (back, jnp.moveaxis(valid, 1, 0)[1:]), reverse=True)
+    path = jnp.concatenate([first[None], path_rev], axis=0)  # [T,N]
+    path = jnp.moveaxis(path, 0, 1)                          # [N,T]
+    path = jnp.where(valid, path, 0).astype(jnp.int64)
+
+    label = ins.get("Label")
+    if label is not None:
+        if label.ndim == 3:
+            label = label[..., 0]
+        out = (path == label.astype(jnp.int64)) & valid
+        return {"ViterbiPath": out.astype(jnp.int64)[..., None]}
+    return {"ViterbiPath": path[..., None]}
+
+
+# ---------------------------------------------------------------------------
+# warpctc / ctc_align
+# ---------------------------------------------------------------------------
+
+@register_op("warpctc", seq_aware=True, no_vjp_outputs=("WarpCTCGrad",))
+def _warpctc(ctx, ins, attrs, op=None):
+    """CTC loss (reference warpctc_op.cc wraps the warp-ctc library).
+    Logits [N,T,V] raw (softmax applied internally, like warp-ctc);
+    Label [N,L] int with its own '@LEN'.  Loss [N,1]."""
+    logits = ins["Logits"].astype(jnp.float32)
+    label = ins["Label"]
+    if label.ndim == 3:
+        label = label[..., 0]
+    label = label.astype(jnp.int32)
+    blank = int(attrs.get("blank", 0))
+    n, t, v = logits.shape
+    lmax = label.shape[1]
+    t_lens = _lens_or_full(ctx, op, "Logits", n, t)
+    l_lens = _lens_or_full(ctx, op, "Label", n, lmax)
+
+    logp = jax.nn.log_softmax(logits, axis=-1)
+
+    # extended label sequence [blank, l1, blank, ..., lL, blank]: S=2L+1
+    s = 2 * lmax + 1
+    ext = jnp.full((n, s), blank, jnp.int32)
+    ext = ext.at[:, 1::2].set(label)
+    s_lens = 2 * l_lens + 1
+    pos = jnp.arange(s)[None, :]
+    s_valid = pos < s_lens[:, None]                          # [N,S]
+
+    # skip-transition allowed into odd (label) states whose label differs
+    # from the one two back
+    can_skip = jnp.zeros((n, s), bool)
+    can_skip = can_skip.at[:, 3::2].set(label[:, 1:] != label[:, :-1])
+
+    def emit(t_idx):
+        lp = logp[:, t_idx, :]                               # [N,V]
+        return jnp.take_along_axis(lp, ext, axis=1)          # [N,S]
+
+    alpha = jnp.full((n, s), NEG, jnp.float32)
+    alpha = alpha.at[:, 0].set(logp[:, 0, blank])
+    has_lab = lmax > 0
+    if has_lab:
+        first_lab = jnp.take_along_axis(logp[:, 0, :], label[:, :1],
+                                        axis=1)[:, 0]
+        alpha = alpha.at[:, 1].set(
+            jnp.where(l_lens > 0, first_lab, NEG))
+
+    def shift(a, by):
+        return jnp.concatenate(
+            [jnp.full((n, by), NEG, jnp.float32), a[:, :-by]], axis=1)
+
+    def step(alpha, t_idx):
+        stay = alpha
+        one = shift(alpha, 1)
+        two = jnp.where(can_skip, shift(alpha, 2), NEG)
+        merged = jnp.logaddexp(jnp.logaddexp(stay, one), two)
+        nxt = jnp.where(s_valid, merged + emit(t_idx), NEG)
+        live = t_idx < t_lens[:, None]
+        return jnp.where(live, nxt, alpha), None
+
+    alpha, _ = jax.lax.scan(step, alpha, jnp.arange(1, t))
+
+    last = jnp.clip(s_lens - 1, 0, s - 1)
+    a_last = jnp.take_along_axis(alpha, last[:, None], axis=1)[:, 0]
+    a_prev = jnp.take_along_axis(alpha, jnp.clip(last - 1, 0, s - 1)
+                                 [:, None], axis=1)[:, 0]
+    loss = -jnp.logaddexp(a_last,
+                          jnp.where(l_lens > 0, a_prev, NEG))
+    if attrs.get("norm_by_times", False):
+        loss = loss / jnp.maximum(t_lens.astype(jnp.float32), 1.0)
+    return {"Loss": loss[:, None].astype(ins["Logits"].dtype),
+            "WarpCTCGrad": jnp.zeros_like(logits)}
+
+
+@register_op("ctc_align", grad_maker=None, seq_aware=True)
+def _ctc_align(ctx, ins, attrs, op=None):
+    """Merge repeats then drop blanks, left-aligned (reference
+    ctc_align_op.h).  Input [N,T] (or [N,T,1]) int; Output same shape,
+    tail padded with ``padding_value``; '@LEN' carries new lengths."""
+    x = ins["Input"]
+    squeeze = x.ndim == 3
+    if squeeze:
+        x = x[..., 0]
+    blank = int(attrs.get("blank", 0))
+    pad_val = int(attrs.get("padding_value", 0))
+    n, t = x.shape
+    lens = _lens_or_full(ctx, op, "Input", n, t)
+    steps = jnp.arange(t)[None, :]
+    valid = steps < lens[:, None]
+
+    prev = jnp.concatenate(
+        [jnp.full((n, 1), -1, x.dtype), x[:, :-1]], axis=1)
+    keep = (x != blank) & (x != prev) & valid
+    new_lens = keep.sum(axis=1).astype(jnp.int32)
+    # stable left-compaction: argsort on (drop, position)
+    order = jnp.argsort(jnp.where(keep, steps, t + steps), axis=1,
+                        stable=True)
+    gathered = jnp.take_along_axis(x, order, axis=1)
+    out_pos = jnp.arange(t)[None, :] < new_lens[:, None]
+    out = jnp.where(out_pos, gathered, pad_val)
+    if op is not None:
+        for nm in (op.outputs.get("Output") or []):
+            if nm:
+                ctx.set_seq_len(nm, new_lens)
+    if squeeze:
+        out = out[..., None]
+    return {"Output": out}
+
+
+# ---------------------------------------------------------------------------
+# chunk_eval (host op: scheme-aware chunk extraction, a metric)
+# ---------------------------------------------------------------------------
+
+_SCHEME_KINDS = {"IOB": "BI", "IOE": "IE", "IOBES": "BIES"}
+
+
+def _extract_chunks(tags, scheme, num_types, excluded):
+    """-> set of (begin, end_exclusive, type); conlleval-style begin/end
+    predicates (reference chunk_eval_op.h ChunkBegin/ChunkEnd for
+    plain/IOB/IOE/IOBES; tag encoding = type * n_kinds + kind)."""
+    if scheme == "plain":
+        parsed = [(int(t), "S") for t in tags]
+
+        def begins(prev, cur):
+            return prev is None or prev[0] != cur[0]
+
+        def ends(cur, nxt):
+            return nxt is None or nxt[0] != cur[0]
+    else:
+        kinds = _SCHEME_KINDS[scheme]
+        nk = len(kinds)
+        o_tag = num_types * nk
+
+        def parse(t):
+            t = int(t)
+            if t < 0 or t >= o_tag:
+                return None  # O / out of range
+            return (t // nk, kinds[t % nk])
+
+        parsed = [parse(t) for t in tags]
+
+        def begins(prev, cur):
+            if prev is None or prev[0] != cur[0]:
+                return True
+            if scheme == "IOB":
+                return cur[1] == "B"
+            if scheme == "IOE":
+                return prev[1] == "E"
+            return cur[1] in "BS" or prev[1] in "ES"
+
+        def ends(cur, nxt):
+            if nxt is None or nxt[0] != cur[0]:
+                return True
+            if scheme == "IOB":
+                return nxt[1] == "B"
+            if scheme == "IOE":
+                return cur[1] == "E"
+            return cur[1] in "ES" or nxt[1] in "BS"
+
+    chunks = set()
+    start = None
+    for i, cur in enumerate(parsed):
+        if cur is None:
+            start = None
+            continue
+        prev = parsed[i - 1] if i > 0 else None
+        nxt = parsed[i + 1] if i + 1 < len(parsed) else None
+        if start is None or begins(prev, cur):
+            start = i
+        if ends(cur, nxt):
+            if cur[0] not in excluded:
+                chunks.add((start, i + 1, cur[0]))
+            start = None
+    return chunks
+
+
+from paddle_tpu.ops.io_ops import _host  # noqa: E402  (shared helper)
+
+
+@_host("chunk_eval")
+def _chunk_eval(executor, op, scope, feed, env=None):
+    """Precision/recall/F1 over extracted chunks (reference
+    chunk_eval_op.cc; schemes plain/IOB/IOE/IOBES)."""
+    def read(name, default=None):
+        for src in (env, feed):
+            if src is not None and name in src:
+                return np.asarray(src[name])
+        try:
+            return np.asarray(scope.find_var(name))
+        except KeyError:
+            if default is not None:
+                return default
+            raise
+
+    inf_name = op.input("Inference")[0]
+    lab_name = op.input("Label")[0]
+    inference = read(inf_name)
+    label = read(lab_name)
+    if inference.ndim == 3:
+        inference = inference[..., 0]
+    if label.ndim == 3:
+        label = label[..., 0]
+    lens = read(inf_name + "@LEN",
+                default=np.full((inference.shape[0],),
+                                inference.shape[1], np.int64))
+
+    scheme = op.attr("chunk_scheme", "IOB")
+    num_types = int(op.attr("num_chunk_types"))
+    excluded = set(op.attr("excluded_chunk_types", []) or [])
+
+    n_inf = n_lab = n_correct = 0
+    for row in range(inference.shape[0]):
+        ln = int(lens[row])
+        ic = _extract_chunks(inference[row, :ln].tolist(), scheme,
+                             num_types, excluded)
+        lc = _extract_chunks(label[row, :ln].tolist(), scheme,
+                             num_types, excluded)
+        n_inf += len(ic)
+        n_lab += len(lc)
+        n_correct += len(ic & lc)
+
+    precision = n_correct / n_inf if n_inf else 0.0
+    recall = n_correct / n_lab if n_lab else 0.0
+    f1 = (2 * precision * recall / (precision + recall)
+          if precision + recall else 0.0)
+
+    outs = {"Precision": np.asarray([precision], np.float32),
+            "Recall": np.asarray([recall], np.float32),
+            "F1-Score": np.asarray([f1], np.float32),
+            "NumInferChunks": np.asarray([n_inf], np.int64),
+            "NumLabelChunks": np.asarray([n_lab], np.int64),
+            "NumCorrectChunks": np.asarray([n_correct], np.int64)}
+    for slot, val in outs.items():
+        names = op.outputs.get(slot) or []
+        if names and names[0]:
+            if env is not None:
+                env[names[0]] = val
+            s = scope.find_scope_of(names[0]) or scope
+            s.set(names[0], val)

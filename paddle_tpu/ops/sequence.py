@@ -62,21 +62,14 @@ def _reverse_time(x, lens):
 # Recurrent ops
 # ---------------------------------------------------------------------------
 
-@register_op("lstm", no_vjp_outputs=("BatchGate", "BatchCellPreAct"))
-def _lstm(ctx, ins, attrs, op=None):
-    """LSTM over a padded batch (reference lstm_op.cc:180 equations).
+def _lstm_scan(x, w, b, lens, attrs, h0=None, c0=None, w_proj=None,
+               proj_act=None):
+    """Shared masked-LSTM recurrence for `lstm` and `lstmp`.
 
-    Input [N,T,4H] (pre-projected x), Weight [H,4H] with gate columns
-    ordered [c~, i, f, o] (reference math/detail/lstm_kernel.h memory
-    layout), Bias [1,4H] or [1,7H] with peephole vectors checkI/checkF/
-    checkO appended (use_peepholes).  Outputs Hidden/Cell [N,T,H].
+    With ``w_proj`` the recurrent state is the projection
+    r = proj_act(h @ w_proj) (reference lstmp_op.cc) and the sequence
+    output is [N,T,P]; otherwise it is the hidden state [N,T,H].
     """
-    x = ins["Input"]
-    w = ins["Weight"]
-    b = ins.get("Bias")
-    h0 = ins.get("H0")
-    c0 = ins.get("C0")
-    lens = _lens_of(ctx, op, "Input")
     n, t, h4 = x.shape
     h = h4 // 4
     rev = bool(attrs.get("is_reverse", False))
@@ -94,13 +87,14 @@ def _lstm(ctx, ins, attrs, op=None):
         x = _reverse_time(x, lens)
 
     mask = _mask(lens, n, t, x.dtype)
-    h_prev = h0 if h0 is not None else jnp.zeros((n, h), x.dtype)
+    r_dim = w_proj.shape[1] if w_proj is not None else h
+    r_prev = h0 if h0 is not None else jnp.zeros((n, r_dim), x.dtype)
     c_prev = c0 if c0 is not None else jnp.zeros((n, h), x.dtype)
 
     def step(carry, xm):
-        h_prev, c_prev = carry
+        r_prev, c_prev = carry
         xt, mt = xm                       # [N,4H], [N]
-        g = xt + h_prev @ w
+        g = xt + r_prev @ w
         cand, gi, gf, go = jnp.split(g, 4, axis=-1)
         if peep:
             gi = gi + c_prev * ck_i
@@ -112,20 +106,37 @@ def _lstm(ctx, ins, attrs, op=None):
             go = go + c * ck_o
         o = gate_act(go)
         hh = o * cell_act(c)
+        r = proj_act(hh @ w_proj) if w_proj is not None else hh
         mt = mt[:, None]
         c = mt * c + (1 - mt) * c_prev
-        hh = mt * hh
-        h_keep = mt * hh + (1 - mt) * h_prev
-        return (h_keep, c), (hh, c)
+        r_masked = mt * r
+        r_keep = r_masked + (1 - mt) * r_prev
+        return (r_keep, c), (r_masked, c)
 
-    (_, _), (hs, cs) = jax.lax.scan(
-        step, (h_prev, c_prev),
+    (_, _), (rs, cs) = jax.lax.scan(
+        step, (r_prev, c_prev),
         (jnp.swapaxes(x, 0, 1), jnp.swapaxes(mask, 0, 1)))
-    hidden = jnp.swapaxes(hs, 0, 1)
+    out = jnp.swapaxes(rs, 0, 1)
     cell = jnp.swapaxes(cs, 0, 1)
     if rev:
-        hidden = _reverse_time(hidden, lens)
+        out = _reverse_time(out, lens)
         cell = _reverse_time(cell, lens)
+    return out, cell
+
+
+@register_op("lstm", no_vjp_outputs=("BatchGate", "BatchCellPreAct"))
+def _lstm(ctx, ins, attrs, op=None):
+    """LSTM over a padded batch (reference lstm_op.cc:180 equations).
+
+    Input [N,T,4H] (pre-projected x), Weight [H,4H] with gate columns
+    ordered [c~, i, f, o] (reference math/detail/lstm_kernel.h memory
+    layout), Bias [1,4H] or [1,7H] with peephole vectors checkI/checkF/
+    checkO appended (use_peepholes).  Outputs Hidden/Cell [N,T,H].
+    """
+    hidden, cell = _lstm_scan(
+        ins["Input"], ins["Weight"], ins.get("Bias"),
+        _lens_of(ctx, op, "Input"), attrs,
+        h0=ins.get("H0"), c0=ins.get("C0"))
     return {"Hidden": hidden, "Cell": cell}
 
 
@@ -449,3 +460,92 @@ def _edit_distance(ctx, ins, attrs, op=None):
     if norm:
         dist = dist / jnp.maximum(rlens[:, None].astype(jnp.float32), 1.0)
     return {"Out": dist.astype(jnp.float32), "SequenceNum": seq_num}
+
+
+@register_op("sequence_concat", seq_aware=True)
+def _sequence_concat(ctx, ins, attrs, op=None):
+    """Per-row concatenation along time (reference
+    sequence_concat_op.cc): row n of the output is the valid tokens of
+    every input's row n back to back; '@LEN' = sum of input lens."""
+    xs = [v for v in ins.list("X") if v is not None]
+    n = xs[0].shape[0]
+    t_out = sum(x.shape[1] for x in xs)
+    names = (op.inputs.get("X") or []) if op is not None else []
+    lens = []
+    for i, x in enumerate(xs):
+        l = ctx.seq_len_of(names[i]) if i < len(names) and names[i] \
+            else None
+        lens.append(l.astype(jnp.int32) if l is not None
+                    else jnp.full((n,), x.shape[1], jnp.int32))
+    out = jnp.zeros((n, t_out) + xs[0].shape[2:], xs[0].dtype)
+    offset = jnp.zeros((n,), jnp.int32)
+    rows = jnp.arange(n)[:, None]
+    for x, l in zip(xs, lens):
+        ti = x.shape[1]
+        pos = jnp.arange(ti)[None, :]
+        col = offset[:, None] + pos
+        # invalid tokens scatter out of bounds (dropped)
+        col = jnp.where(pos < l[:, None], col, t_out)
+        out = out.at[rows, col].set(x)
+        offset = offset + l
+    if op is not None:
+        for nm in (op.outputs.get("Out") or []):
+            if nm:
+                ctx.set_seq_len(nm, offset)
+    return {"Out": out}
+
+
+@register_op("sequence_reshape", seq_aware=True)
+def _sequence_reshape(ctx, ins, attrs, op=None):
+    """Change the token width (reference sequence_reshape_op.cc):
+    [N,T,D] -> [N, T*D/nd, nd]; row lengths scale by D/nd.  Valid
+    tokens are row-leading in the padded layout, so a flat reshape is
+    exact."""
+    x = ins["X"]
+    nd = int(attrs["new_dim"])
+    n, t, d = x.shape
+    assert (t * d) % nd == 0, "new_dim must divide T*D"
+    out = x.reshape(n, t * d // nd, nd)
+    lens = _lens_of(ctx, op, "X")
+    if lens is not None and op is not None:
+        for nm in (op.outputs.get("Out") or []):
+            if nm:
+                ctx.set_seq_len(nm, (lens * d) // nd)
+    return {"Out": out}
+
+
+@register_op("sequence_slice", seq_aware=True)
+def _sequence_slice(ctx, ins, attrs, op=None):
+    """Per-sequence [offset, offset+length) slice, left-aligned
+    (reference sequence_slice_op.cc); '@LEN' = Length."""
+    x = ins["X"]
+    off = ins["Offset"].reshape(-1).astype(jnp.int32)
+    length = ins["Length"].reshape(-1).astype(jnp.int32)
+    n, t = x.shape[0], x.shape[1]
+    pos = jnp.arange(t)[None, :]
+    src = jnp.clip(pos + off[:, None], 0, t - 1)
+    rows = jnp.arange(n)[:, None]
+    out = x[rows, src]
+    keep = pos < length[:, None]
+    out = jnp.where(keep.reshape(keep.shape + (1,) * (x.ndim - 2)),
+                    out, 0)
+    if op is not None:
+        for nm in (op.outputs.get("Out") or []):
+            if nm:
+                ctx.set_seq_len(nm, length)
+    return {"Out": out}
+
+
+@register_op("lstmp")
+def _lstmp(ctx, ins, attrs, op=None):
+    """LSTM with recurrent projection (reference lstmp_op.cc): the
+    recurrence feeds the projection r = proj_act(h @ ProjWeight), so
+    Weight is [P, 4H] and the sequence output is the projection
+    [N, T, P]."""
+    proj, cell = _lstm_scan(
+        ins["Input"], ins["Weight"], ins.get("Bias"),
+        _lens_of(ctx, op, "Input"), attrs,
+        h0=ins.get("H0"), c0=ins.get("C0"),
+        w_proj=ins["ProjWeight"],
+        proj_act=_act(attrs.get("proj_activation", "tanh")))
+    return {"Projection": proj, "Cell": cell}

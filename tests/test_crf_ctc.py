@@ -1,0 +1,386 @@
+"""CRF / CTC / chunk_eval / new sequence ops, numerically pinned against
+brute-force enumeration (reference linear_chain_crf_op.h forward
+algorithm, crf_decoding_op.h Viterbi, warpctc_op.cc, ctc_align_op.h,
+chunk_eval_op.h, sequence_{concat,reshape,slice}_op.cc, lstmp_op.cc)."""
+import itertools
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.core.lod import LoDTensor
+
+layers = fluid.layers
+
+
+# --------------------------- linear_chain_crf ----------------------------
+
+def _crf_brute(em, trans, lens):
+    """Enumerate all paths: logZ and per-path scores."""
+    start, stop, pair = trans[0], trans[1], trans[2:]
+    n, t, k = em.shape
+
+    def score(row, path):
+        s = start[path[0]] + em[row, 0, path[0]] + stop[path[-1]]
+        for i in range(1, len(path)):
+            s += em[row, i, path[i]] + pair[path[i - 1], path[i]]
+        return s
+
+    logz = np.zeros(n)
+    for row in range(n):
+        ln = lens[row]
+        scores = [score(row, p)
+                  for p in itertools.product(range(k), repeat=ln)]
+        logz[row] = np.log(np.sum(np.exp(scores)))
+    return logz, score
+
+
+def test_linear_chain_crf_matches_brute_force():
+    rng = np.random.RandomState(0)
+    n, t, k = 2, 3, 3
+    em = rng.randn(n, t, k).astype(np.float32)
+    trans = (rng.randn(k + 2, k) * 0.5).astype(np.float32)
+    lens = [3, 2]
+    label = rng.randint(0, k, (n, t)).astype(np.int64)
+
+    e_lod = LoDTensor.from_sequences(
+        [em[i, :lens[i]] for i in range(n)])
+    lab_lod = LoDTensor.from_sequences(
+        [label[i, :lens[i], None] for i in range(n)])
+    main, startup = fluid.Program(), fluid.Program()
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        with fluid.program_guard(main, startup):
+            with fluid.unique_name.guard():
+                e = layers.data(name="e", shape=[k], lod_level=1,
+                                dtype="float32")
+                lab = layers.data(name="lab", shape=[1], lod_level=1,
+                                  dtype="int64")
+                ll = layers.linear_chain_crf(
+                    e, lab, param_attr=fluid.ParamAttr(name="crf_w"))
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        scope.set("crf_w", trans)
+        got, = exe.run(main, feed={"e": e_lod, "lab": lab_lod},
+                       fetch_list=[ll])
+    got = np.ravel(np.asarray(got))
+
+    logz, score = _crf_brute(em, trans, lens)
+    for row in range(n):
+        gold = score(row, list(label[row, :lens[row]]))
+        np.testing.assert_allclose(got[row], logz[row] - gold,
+                                   rtol=2e-4, atol=2e-4)
+
+
+def test_crf_decoding_matches_brute_force():
+    rng = np.random.RandomState(1)
+    n, t, k = 2, 4, 3
+    em = rng.randn(n, t, k).astype(np.float32)
+    trans = (rng.randn(k + 2, k) * 0.5).astype(np.float32)
+    lens = [4, 2]
+
+    main, startup = fluid.Program(), fluid.Program()
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        with fluid.program_guard(main, startup):
+            with fluid.unique_name.guard():
+                e = layers.data(name="e", shape=[k], lod_level=1,
+                                dtype="float32")
+                lab = layers.data(name="lab", shape=[1], lod_level=1,
+                                  dtype="int64")
+                # build the crf to create the parameter, then decode
+                layers.linear_chain_crf(
+                    e, lab, param_attr=fluid.ParamAttr(name="crf_w"))
+                path = layers.crf_decoding(
+                    e, param_attr=fluid.ParamAttr(name="crf_w"))
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        scope.set("crf_w", trans)
+        e_lod = LoDTensor.from_sequences(
+            [em[i, :lens[i]] for i in range(n)])
+        lab_lod = LoDTensor.from_sequences(
+            [np.zeros((lens[i], 1), np.int64) for i in range(n)])
+        got, = exe.run(main, feed={"e": e_lod, "lab": lab_lod},
+                       fetch_list=[path])
+    got = np.asarray(got)[..., 0]
+
+    _, score = _crf_brute(em, trans, lens)
+    for row in range(n):
+        best = max(itertools.product(range(k), repeat=lens[row]),
+                   key=lambda p: score(row, list(p)))
+        assert got[row, :lens[row]].tolist() == list(best), row
+
+
+# ------------------------------- warpctc ---------------------------------
+
+def _ctc_brute(logits, label, blank):
+    """-log sum of probabilities of all alignments collapsing to label."""
+    t, v = logits.shape
+    p = np.exp(logits - logits.max(-1, keepdims=True))
+    p = p / p.sum(-1, keepdims=True)
+
+    def collapse(path):
+        out = []
+        prev = None
+        for s in path:
+            if s != prev and s != blank:
+                out.append(s)
+            prev = s
+        return out
+
+    total = 0.0
+    for path in itertools.product(range(v), repeat=t):
+        if collapse(path) == list(label):
+            total += np.prod([p[i, s] for i, s in enumerate(path)])
+    return -np.log(total)
+
+
+def test_warpctc_matches_brute_force():
+    rng = np.random.RandomState(2)
+    n, t, v = 2, 4, 3
+    logits = rng.randn(n, t, v).astype(np.float32)
+    labels = [[1, 2], [2]]
+    t_lens = [4, 3]
+
+    main, startup = fluid.Program(), fluid.Program()
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        with fluid.program_guard(main, startup):
+            with fluid.unique_name.guard():
+                lg = layers.data(name="lg", shape=[v], lod_level=1,
+                                 dtype="float32")
+                lab = layers.data(name="lab", shape=[1], lod_level=1,
+                                  dtype="int64")
+                loss = layers.warpctc(lg, lab, blank=0)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        lg_lod = LoDTensor.from_sequences(
+            [logits[i, :t_lens[i]] for i in range(n)])
+        lab_lod = LoDTensor.from_sequences(
+            [np.asarray(labels[i], np.int64)[:, None]
+             for i in range(n)])
+        got, = exe.run(main, feed={"lg": lg_lod, "lab": lab_lod},
+                       fetch_list=[loss])
+    got = np.ravel(np.asarray(got))
+    for i in range(n):
+        expect = _ctc_brute(logits[i, :t_lens[i]], labels[i], 0)
+        np.testing.assert_allclose(got[i], expect, rtol=1e-4)
+
+
+def test_warpctc_trains():
+    """CTC on a one-sample copy task: loss decreases under SGD (grads
+    flow through the scan via jax.vjp)."""
+    rng = np.random.RandomState(3)
+    main, startup = fluid.Program(), fluid.Program()
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        with fluid.program_guard(main, startup):
+            with fluid.unique_name.guard():
+                x = layers.data(name="x", shape=[8], lod_level=1,
+                                dtype="float32")
+                lab = layers.data(name="lab", shape=[1], lod_level=1,
+                                  dtype="int64")
+                h = layers.fc(x, size=5)
+                loss = layers.mean(layers.warpctc(h, lab, blank=0))
+                fluid.optimizer.SGD(learning_rate=0.5).minimize(loss)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        xv = LoDTensor.from_sequences(
+            [rng.randn(6, 8).astype(np.float32),
+             rng.randn(4, 8).astype(np.float32)])
+        labv = LoDTensor.from_sequences(
+            [np.asarray([[1], [3]], np.int64),
+             np.asarray([[2]], np.int64)])
+        ls = []
+        for _ in range(25):
+            l, = exe.run(main, feed={"x": xv, "lab": labv},
+                         fetch_list=[loss])
+            ls.append(float(np.ravel(l)[0]))
+    assert ls[-1] < ls[0] * 0.5, (ls[0], ls[-1])
+
+
+def test_ctc_align(prog_scope, exe):
+    main, startup, scope = prog_scope
+    x = layers.data(name="x", shape=[8], dtype="int64",
+                    append_batch_size=False)
+    out = layers.ctc_greedy_decoder  # noqa: F841 (api presence)
+    helper = fluid.layer_helper.LayerHelper("ctc_align")
+    o = helper.create_tmp_variable(dtype="int64")
+    helper.append_op(type="ctc_align", inputs={"Input": [x]},
+                     outputs={"Output": [o]},
+                     attrs={"blank": 0, "padding_value": 0})
+    exe.run(startup)
+    xv = np.asarray([[0, 1, 1, 0, 2, 2, 0, 3],
+                     [1, 1, 2, 0, 0, 2, 2, 1]], np.int64)
+    got, = exe.run(main, feed={"x": xv}, fetch_list=[o])
+    got = np.asarray(got)
+    np.testing.assert_array_equal(got[0], [1, 2, 3, 0, 0, 0, 0, 0])
+    np.testing.assert_array_equal(got[1], [1, 2, 2, 1, 0, 0, 0, 0])
+
+
+# ------------------------------ chunk_eval -------------------------------
+
+def test_chunk_eval_iob():
+    # 2 types, IOB: tag = type*2 + {B:0, I:1}, O = 4
+    # label row: [B0 I0 O B1] -> chunks {(0,2,0), (3,4,1)}
+    # infer row: [B0 I0 O B0] -> chunks {(0,2,0), (3,4,0)}
+    main, startup = fluid.Program(), fluid.Program()
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        with fluid.program_guard(main, startup):
+            with fluid.unique_name.guard():
+                inf = layers.data(name="inf", shape=[1], lod_level=1,
+                                  dtype="int64")
+                lab = layers.data(name="lab", shape=[1], lod_level=1,
+                                  dtype="int64")
+                outs = layers.chunk_eval(inf, lab, "IOB",
+                                         num_chunk_types=2)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        inf_lod = LoDTensor.from_sequences(
+            [np.asarray([[0], [1], [4], [0]], np.int64)])
+        lab_lod = LoDTensor.from_sequences(
+            [np.asarray([[0], [1], [4], [2]], np.int64)])
+        p, r, f1, ni, nl, nc = exe.run(
+            main, feed={"inf": inf_lod, "lab": lab_lod},
+            fetch_list=list(outs))
+    assert int(ni[0]) == 2 and int(nl[0]) == 2 and int(nc[0]) == 1
+    np.testing.assert_allclose(float(p[0]), 0.5)
+    np.testing.assert_allclose(float(r[0]), 0.5)
+    np.testing.assert_allclose(float(f1[0]), 0.5)
+
+
+def test_chunk_eval_computed_input_respects_lengths():
+    """chunk_eval on a COMPUTED (non-fed) inference var must still see
+    the real sequence lengths, not the padded T."""
+    main, startup = fluid.Program(), fluid.Program()
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        with fluid.program_guard(main, startup):
+            with fluid.unique_name.guard():
+                inf = layers.data(name="inf", shape=[1], lod_level=1,
+                                  dtype="int64")
+                lab = layers.data(name="lab", shape=[1], lod_level=1,
+                                  dtype="int64")
+                # computed temp (scale by 1 keeps values, changes var)
+                inf2 = layers.cast(layers.scale(
+                    layers.cast(inf, "float32"), scale=1.0), "int64")
+                outs = layers.chunk_eval(inf2, lab, "IOB",
+                                         num_chunk_types=2)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        # rows of different lengths; padding would parse as B-type0
+        inf_lod = LoDTensor.from_sequences(
+            [np.asarray([[0], [1], [4], [0]], np.int64),
+             np.asarray([[2]], np.int64)])
+        lab_lod = LoDTensor.from_sequences(
+            [np.asarray([[0], [1], [4], [2]], np.int64),
+             np.asarray([[2]], np.int64)])
+        p, r, f1, ni, nl, nc = exe.run(
+            main, feed={"inf": inf_lod, "lab": lab_lod},
+            fetch_list=list(outs))
+    assert int(ni[0]) == 3 and int(nl[0]) == 3 and int(nc[0]) == 2
+
+
+def test_postlude_host_op_chain():
+    """A host op reading another postlude host op's output (chunk_eval
+    -> Print) must not be treated as a compiled-program fetch."""
+    main, startup = fluid.Program(), fluid.Program()
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        with fluid.program_guard(main, startup):
+            with fluid.unique_name.guard():
+                inf = layers.data(name="inf", shape=[1], lod_level=1,
+                                  dtype="int64")
+                lab = layers.data(name="lab", shape=[1], lod_level=1,
+                                  dtype="int64")
+                inf2 = layers.cast(layers.scale(
+                    layers.cast(inf, "float32"), scale=1.0), "int64")
+                outs = layers.chunk_eval(inf2, lab, "IOB",
+                                         num_chunk_types=2)
+                layers.Print(outs[0], message="prec")
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        seq = [np.asarray([[0], [1]], np.int64)]
+        got = exe.run(main,
+                      feed={"inf": LoDTensor.from_sequences(seq),
+                            "lab": LoDTensor.from_sequences(seq)},
+                      fetch_list=[outs[0]])
+    np.testing.assert_allclose(float(np.ravel(got[0])[0]), 1.0)
+
+
+# --------------------------- new sequence ops ----------------------------
+
+def test_sequence_concat():
+    main, startup = fluid.Program(), fluid.Program()
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        with fluid.program_guard(main, startup):
+            with fluid.unique_name.guard():
+                a = layers.data(name="a", shape=[2], lod_level=1,
+                                dtype="float32")
+                b = layers.data(name="b", shape=[2], lod_level=1,
+                                dtype="float32")
+                out = layers.sequence_concat([a, b])
+                pooled = layers.sequence_pool(out, "sum")
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        a_seqs = [np.ones((2, 2), np.float32),
+                  np.ones((1, 2), np.float32) * 2]
+        b_seqs = [np.ones((3, 2), np.float32) * 10,
+                  np.ones((1, 2), np.float32) * 20]
+        got, = exe.run(main, feed={
+            "a": LoDTensor.from_sequences(a_seqs),
+            "b": LoDTensor.from_sequences(b_seqs)},
+            fetch_list=[pooled])
+    # row sums: row0 = 2*1 + 3*10 = 32; row1 = 2 + 20 = 22 (per feature)
+    np.testing.assert_allclose(np.asarray(got),
+                               [[32, 32], [22, 22]])
+
+
+def test_sequence_reshape_and_slice(prog_scope, exe):
+    main, startup, scope = prog_scope
+    x = layers.data(name="x", shape=[4, 2], dtype="float32")
+    r = layers.sequence_reshape(x, new_dim=4)
+    off = layers.data(name="off", shape=[1], dtype="int64")
+    ln = layers.data(name="ln", shape=[1], dtype="int64")
+    s = layers.sequence_slice(x, off, ln)
+    exe.run(startup)
+    xv = np.arange(16, dtype=np.float32).reshape(2, 4, 2)
+    got_r, got_s = exe.run(
+        main, feed={"x": xv,
+                    "off": np.asarray([[1], [0]], np.int64),
+                    "ln": np.asarray([[2], [1]], np.int64)},
+        fetch_list=[r, s])
+    np.testing.assert_allclose(np.asarray(got_r),
+                               xv.reshape(2, 2, 4))
+    got_s = np.asarray(got_s)
+    np.testing.assert_allclose(got_s[0, :2], xv[0, 1:3])
+    np.testing.assert_allclose(got_s[0, 2:], 0)
+    np.testing.assert_allclose(got_s[1, :1], xv[1, :1])
+
+
+def test_dynamic_lstmp_shapes_and_training(prog_scope, exe):
+    main, startup, scope = prog_scope
+    x = layers.data(name="x", shape=[5, 16], dtype="float32")
+    y = layers.data(name="y", shape=[3], dtype="float32")
+    # a user-supplied ParamAttr must not collide Weight/ProjWeight
+    proj, cell = layers.dynamic_lstmp(
+        x, size=16, proj_size=6,
+        param_attr=fluid.ParamAttr(
+            initializer=fluid.initializer.NormalInitializer(0.0, 0.1)))
+    assert tuple(proj.shape[1:]) == (5, 6)
+    assert tuple(cell.shape[1:]) == (5, 4)
+    pred = layers.fc(layers.reduce_mean(proj, dim=1), size=3)
+    loss = layers.mean(layers.square_error_cost(pred, y))
+    fluid.optimizer.Adam(learning_rate=0.02).minimize(loss)
+    exe.run(startup)
+    rng = np.random.RandomState(5)
+    xv = rng.randn(8, 5, 16).astype(np.float32)
+    yv = np.stack([xv.sum((1, 2)), xv.mean((1, 2)),
+                   xv.std((1, 2))], 1).astype(np.float32)
+    ls = []
+    for _ in range(40):
+        l, = exe.run(main, feed={"x": xv, "y": yv}, fetch_list=[loss])
+        ls.append(float(np.ravel(l)[0]))
+    assert ls[-1] < ls[0] * 0.5, (ls[0], ls[-1])
