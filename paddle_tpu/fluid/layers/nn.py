@@ -836,14 +836,6 @@ def dot_product_attention(querys, keys, values):
     return matmul(attn, values), attn
 
 
-def warpctc(input, label, blank=0, norm_by_times=False):
-    """CTC loss on dense [N, T, C] logits (reference operators/warpctc_op.cc
-    wraps warp-ctc; here lowered as an op once sequence support lands).
-    Placeholder layer for API parity — raises until sequence ops exist."""
-    raise NotImplementedError(
-        "warpctc requires LoD sequence support (round 2)")
-
-
 def bilinear_tensor_product(x, y, size, act=None, name=None,
                             param_attr=None, bias_attr=None):
     helper = LayerHelper("bilinear_tensor_product", **locals())

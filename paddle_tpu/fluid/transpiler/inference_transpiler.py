@@ -1,0 +1,111 @@
+"""Inference transpiler: fold batch_norm into the preceding conv.
+
+Parity: reference python/paddle/fluid/transpiler/inference_transpiler.py
+(fuse_batch_norm): for an inference program, a conv2d (+ optional
+elementwise_add bias) followed by a batch_norm in test mode computes an
+affine function of the conv output, so the bn folds into the conv's
+filter and bias:
+
+    scale_f = scale / sqrt(var + eps)
+    W' = W * scale_f (per output channel)
+    b' = (b - mean) * scale_f + bias
+
+On TPU XLA already fuses the bn arithmetic into adjacent kernels, so
+the throughput win is smaller than the reference's cudnn case — but the
+fold still deletes the bn parameters from the serving footprint and
+removes the op from the graph.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["InferenceTranspiler"]
+
+
+class InferenceTranspiler:
+    def transpile(self, program, place=None, scope=None):
+        """Fold conv2d -> (elementwise_add) -> batch_norm(is_test) chains
+        in-place.  ``scope`` holds the parameters to rewrite (defaults to
+        the global scope)."""
+        from ..executor import global_scope
+
+        scope = scope or global_scope()
+        block = program.desc.blocks[0]
+        ops = block.ops
+        i = 0
+        while i < len(ops):
+            op = ops[i]
+            if op.type != "conv2d":
+                i += 1
+                continue
+            j = i + 1
+            bias_op = None
+            if j < len(ops) and ops[j].type == "elementwise_add":
+                bias_op = ops[j]
+                j += 1
+            if j >= len(ops) or ops[j].type != "batch_norm":
+                i += 1
+                continue
+            bn = ops[j]
+            if not bn.attrs.get("is_test") or not \
+                    bn.attrs["is_test"].value:
+                i += 1
+                continue
+            conv_out = op.outputs["Output"][0]
+            bn_in = bn.inputs["X"][0]
+            chain_out = (bias_op.outputs["Out"][0] if bias_op
+                         else conv_out)
+            if bn_in != chain_out:
+                i += 1
+                continue
+            if bias_op is not None:
+                # only a true bias add folds: X must be the conv output
+                # and Y a per-channel parameter living in the scope —
+                # a residual add (Y = another activation) must be left
+                # alone, and nothing may be mutated before this check
+                b_name = bias_op.inputs["Y"][0]
+                if bias_op.inputs["X"][0] != conv_out or \
+                        not scope.has_var(b_name):
+                    i += 1
+                    continue
+                b_val = np.asarray(scope.find_var(b_name))
+                n_ch = block.vars[op.inputs["Filter"][0]].shape[0]
+                if b_val.size != n_ch:
+                    i += 1
+                    continue
+
+            w_name = op.inputs["Filter"][0]
+            scale = np.asarray(scope.find_var(bn.inputs["Scale"][0]))
+            bias = np.asarray(scope.find_var(bn.inputs["Bias"][0]))
+            mean = np.asarray(scope.find_var(bn.inputs["Mean"][0]))
+            var = np.asarray(scope.find_var(bn.inputs["Variance"][0]))
+            eps = (bn.attrs["epsilon"].value if "epsilon" in bn.attrs
+                   else 1e-5)
+            factor = scale / np.sqrt(var + eps)
+
+            w = np.asarray(scope.find_var(w_name))
+            scope.set(w_name, (w * factor.reshape(-1, 1, 1, 1)).astype(
+                w.dtype))
+            if bias_op is not None:
+                b_name = bias_op.inputs["Y"][0]
+                b = np.asarray(scope.find_var(b_name))
+                scope.set(b_name, ((b - mean) * factor + bias).astype(
+                    b.dtype))
+                # bn output now equals the bias-add output
+                bias_op.outputs["Out"][0:1] = [bn.outputs["Y"][0]]
+                del ops[j]
+            else:
+                # no conv bias: inject the folded bias via the bn's
+                # Bias parameter and turn bn into an elementwise_add
+                b_name = bn.inputs["Bias"][0]
+                scope.set(b_name, ((-mean) * factor + bias).astype(
+                    np.float32).reshape(1, -1, 1, 1))
+                from paddle_tpu.core.desc import OpDesc
+                # bias value reshaped to [1,C,1,1] -> plain broadcast add
+                ops[j] = OpDesc(
+                    "elementwise_add",
+                    inputs={"X": [conv_out], "Y": [b_name]},
+                    outputs={"Out": [bn.outputs["Y"][0]]})
+            program.desc.bump_version()
+            i = j
+        return program

@@ -1,0 +1,46 @@
+"""Memory-optimization transpiler (API parity).
+
+Parity: reference transpiler/memory_optimization_transpiler.py:42
+(ControlFlowGraph liveness + var reuse) and :361 memory_optimize().
+
+On TPU this pass is SUBSUMED BY XLA: the whole block compiles to one
+XLA computation and XLA's buffer assignment performs liveness analysis,
+buffer reuse, and in-place updates on the compiled program — the same
+optimization the reference implements by renaming variables in the
+desc.  The API is kept so reference code ports without edits;
+``memory_optimize`` computes and returns the reuse statistics the
+reference would have acted on (useful for inspection), mutating
+nothing.
+"""
+from __future__ import annotations
+
+__all__ = ["memory_optimize", "release_memory"]
+
+
+def memory_optimize(input_program, print_log=False, level=0):
+    """Liveness analysis over the global block; returns
+    {var: (first_use, last_use)} for non-persistable vars.  No desc
+    mutation — XLA buffer assignment already reuses dead buffers."""
+    block = input_program.desc.blocks[0]
+    first = {}
+    last = {}
+    for idx, op in enumerate(block.ops):
+        for name in op.input_arg_names() + op.output_arg_names():
+            if not name:
+                continue
+            vd = block.vars.get(name)
+            if vd is None or vd.persistable:
+                continue
+            first.setdefault(name, idx)
+            last[name] = idx
+    live = {n: (first[n], last[n]) for n in first}
+    if print_log:
+        for n, (f, l) in sorted(live.items()):
+            print("var %s live [%d, %d]" % (n, f, l))
+    return live
+
+
+def release_memory(input_program):
+    """No-op (reference release_memory inserts delete ops; PJRT frees
+    buffers when the last reference drops)."""
+    return input_program

@@ -19,4 +19,5 @@ from paddle_tpu.ops import (  # noqa: F401
     distributed_ops,
     beam_search,
     crf_ctc,
+    detection,
 )

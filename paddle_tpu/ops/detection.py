@@ -1,0 +1,394 @@
+"""Detection (SSD) operators.
+
+Parity: reference paddle/fluid/operators/detection/ — prior_box_op.cc,
+iou_similarity_op.h, box_coder_op.h, bipartite_match_op.cc,
+target_assign_op.h, mine_hard_examples_op.cc, multiclass_nms_op.cc,
+detection_map_op.cc.
+
+TPU-first split: the dense geometry (priors, IoU matrices, box
+encode/decode, matching, mining, target assignment) is vectorized XLA —
+the matching loop is a fori_loop over ground-truth boxes, everything
+else is pure array math.  multiclass_nms and detection_map stay host
+ops, exactly like the reference (both are CPU-only kernels there:
+multiclass_nms_op.cc registers no CUDA kernel) — they sit at the tail
+of an inference program, after the compiled core.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.core.registry import register_op
+from paddle_tpu.ops.io_ops import _host
+
+
+# ---------------------------------------------------------------------------
+# prior_box
+# ---------------------------------------------------------------------------
+
+@register_op("prior_box", grad_maker=None)
+def _prior_box(ctx, ins, attrs, op=None):
+    """SSD prior (anchor) boxes for one feature map (reference
+    prior_box_op.cc).  Input [N,C,H,W] fixes the grid; Image [N,C,Hi,Wi]
+    fixes the normalization.  Boxes/Variances [H,W,P,4]."""
+    feat = ins["Input"]
+    image = ins["Image"]
+    h, w = feat.shape[2], feat.shape[3]
+    img_h, img_w = image.shape[2], image.shape[3]
+    min_sizes = [float(s) for s in attrs["min_sizes"]]
+    max_sizes = [float(s) for s in attrs.get("max_sizes", [])]
+    ars = [1.0]
+    for ar in attrs.get("aspect_ratios", [1.0]):
+        ar = float(ar)
+        if not any(abs(ar - a) < 1e-6 for a in ars):
+            ars.append(ar)
+            if attrs.get("flip", False):
+                ars.append(1.0 / ar)
+    variances = [float(v) for v in
+                 attrs.get("variances", [0.1, 0.1, 0.2, 0.2])]
+    step_w = float(attrs.get("step_w", 0.0)) or img_w / w
+    step_h = float(attrs.get("step_h", 0.0)) or img_h / h
+    offset = float(attrs.get("offset", 0.5))
+
+    # box widths/heights per prior, reference order: for each min_size:
+    # [square, per-aspect-ratio boxes, max_size geometric-mean square]
+    ws, hs = [], []
+    for k, ms in enumerate(min_sizes):
+        ws.append(ms)
+        hs.append(ms)
+        for ar in ars:
+            if abs(ar - 1.0) < 1e-6:
+                continue
+            ws.append(ms * np.sqrt(ar))
+            hs.append(ms / np.sqrt(ar))
+        if max_sizes:
+            sq = np.sqrt(ms * max_sizes[k])
+            ws.append(sq)
+            hs.append(sq)
+    ws = jnp.asarray(ws, jnp.float32)[None, None, :]
+    hs = jnp.asarray(hs, jnp.float32)[None, None, :]
+    p = ws.shape[-1]
+
+    cx = ((jnp.arange(w, dtype=jnp.float32) + offset) * step_w)[None, :,
+                                                                None]
+    cy = ((jnp.arange(h, dtype=jnp.float32) + offset) * step_h)[:, None,
+                                                                None]
+    xmin = (cx - ws / 2) / img_w
+    xmax = (cx + ws / 2) / img_w
+    ymin = (cy - hs / 2) / img_h
+    ymax = (cy + hs / 2) / img_h
+    boxes = jnp.stack(jnp.broadcast_arrays(xmin, ymin, xmax, ymax),
+                      axis=-1)                        # [H,W,P,4]
+    if attrs.get("clip", False):
+        boxes = jnp.clip(boxes, 0.0, 1.0)
+    var = jnp.broadcast_to(jnp.asarray(variances, jnp.float32),
+                           (h, w, p, 4))
+    return {"Boxes": boxes, "Variances": var}
+
+
+# ---------------------------------------------------------------------------
+# iou_similarity / box_coder
+# ---------------------------------------------------------------------------
+
+def _iou(a, b):
+    """[..., Na, 4] x [Nb, 4] -> [..., Na, Nb] IoU (xmin,ymin,xmax,ymax)."""
+    ax0, ay0, ax1, ay1 = [a[..., i] for i in range(4)]
+    bx0, by0, bx1, by1 = [b[..., i] for i in range(4)]
+    ix0 = jnp.maximum(ax0[..., :, None], bx0[..., None, :])
+    iy0 = jnp.maximum(ay0[..., :, None], by0[..., None, :])
+    ix1 = jnp.minimum(ax1[..., :, None], bx1[..., None, :])
+    iy1 = jnp.minimum(ay1[..., :, None], by1[..., None, :])
+    iw = jnp.maximum(ix1 - ix0, 0.0)
+    ih = jnp.maximum(iy1 - iy0, 0.0)
+    inter = iw * ih
+    area_a = jnp.maximum(ax1 - ax0, 0.0) * jnp.maximum(ay1 - ay0, 0.0)
+    area_b = jnp.maximum(bx1 - bx0, 0.0) * jnp.maximum(by1 - by0, 0.0)
+    union = area_a[..., :, None] + area_b[..., None, :] - inter
+    return jnp.where(union > 0, inter / union, 0.0)
+
+
+@register_op("iou_similarity", grad_maker=None, seq_aware=True)
+def _iou_similarity(ctx, ins, attrs, op=None):
+    """X [N,4] or [B,N,4] vs Y [M,4] -> IoU matrix (reference
+    iou_similarity_op.h)."""
+    out = _iou(ins["X"].astype(jnp.float32),
+               ins["Y"].astype(jnp.float32))
+    if op is not None:   # rows inherit X's ragged lengths
+        for nm in (op.outputs.get("Out") or []):
+            src = (op.inputs.get("X") or [None])[0]
+            if nm and src:
+                lens = ctx.seq_len_of(src)
+                if lens is not None:
+                    ctx.set_seq_len(nm, lens)
+    return {"Out": out}
+
+
+def _center_size(boxes):
+    w = boxes[..., 2] - boxes[..., 0]
+    h = boxes[..., 3] - boxes[..., 1]
+    cx = boxes[..., 0] + w / 2
+    cy = boxes[..., 1] + h / 2
+    return cx, cy, w, h
+
+
+@register_op("box_coder", grad_maker=None)
+def _box_coder(ctx, ins, attrs, op=None):
+    """Encode/decode boxes against priors in center-size form (reference
+    box_coder_op.h).  PriorBox [M,4], PriorBoxVar [M,4],
+    TargetBox [N,M,4] (decode) or [N,4]/[M,4] gt (encode)."""
+    prior = ins["PriorBox"].astype(jnp.float32)
+    pvar = ins.get("PriorBoxVar")
+    tb = ins["TargetBox"].astype(jnp.float32)
+    code_type = attrs.get("code_type", "encode_center_size")
+    pcx, pcy, pw, ph = _center_size(prior)
+    if pvar is None:
+        pvar = jnp.ones_like(prior)
+    v0, v1, v2, v3 = [pvar[..., i] for i in range(4)]
+    if "decode" in code_type:
+        # tb [N,M,4] offsets -> boxes
+        tcx = tb[..., 0] * v0 * pw + pcx
+        tcy = tb[..., 1] * v1 * ph + pcy
+        tw = jnp.exp(tb[..., 2] * v2) * pw
+        th = jnp.exp(tb[..., 3] * v3) * ph
+        out = jnp.stack([tcx - tw / 2, tcy - th / 2,
+                         tcx + tw / 2, tcy + th / 2], axis=-1)
+    else:
+        # encode: tb [G,4] gt boxes vs every prior -> [G,M,4]
+        gcx, gcy, gw, gh = _center_size(tb)
+        tx = (gcx[..., :, None] - pcx[None, :]) / pw[None, :] / v0
+        ty = (gcy[..., :, None] - pcy[None, :]) / ph[None, :] / v1
+        tw = jnp.log(jnp.maximum(gw[..., :, None] / pw[None, :],
+                                 1e-10)) / v2
+        th = jnp.log(jnp.maximum(gh[..., :, None] / ph[None, :],
+                                 1e-10)) / v3
+        out = jnp.stack([tx, ty, tw, th], axis=-1)
+    return {"OutputBox": out}
+
+
+# ---------------------------------------------------------------------------
+# bipartite_match / target_assign / mine_hard_examples
+# ---------------------------------------------------------------------------
+
+@register_op("bipartite_match", grad_maker=None, seq_aware=True)
+def _bipartite_match(ctx, ins, attrs, op=None):
+    """Greedy bipartite matching (reference bipartite_match_op.cc):
+    repeatedly take the global max of DistMat [B,G,M] (gt x priors),
+    binding that gt row and prior column; then (match_type
+    'per_prediction') also match leftover priors whose best-gt overlap
+    exceeds dist_threshold.  Outputs per-prior match [B,M] (gt index or
+    -1) and the matched distance."""
+    dist = ins["DistMat"].astype(jnp.float32)
+    if dist.ndim == 2:
+        dist = dist[None]
+    b, g, m = dist.shape
+    per_pred = attrs.get("match_type", "bipartite") == "per_prediction"
+    thresh = float(attrs.get("dist_threshold", 0.5))
+    glens = _rows_lens(ctx, op, "DistMat", b, g)
+
+    row_valid0 = jnp.arange(g)[None, :] < glens[:, None]     # [B,G]
+
+    def body(i, state):
+        match, matched_dist, row_ok, col_ok = state
+        masked = jnp.where(row_ok[:, :, None] & col_ok[:, None, :],
+                           dist, -1.0)
+        flat = masked.reshape(b, g * m)
+        best = jnp.argmax(flat, axis=1)
+        val = jnp.take_along_axis(flat, best[:, None], axis=1)[:, 0]
+        r, c = best // m, best % m
+        ok = val > 0
+        match = match.at[jnp.arange(b), c].set(
+            jnp.where(ok, r, match[jnp.arange(b), c]))
+        matched_dist = matched_dist.at[jnp.arange(b), c].set(
+            jnp.where(ok, val, matched_dist[jnp.arange(b), c]))
+        row_ok = row_ok.at[jnp.arange(b), r].set(
+            jnp.where(ok, False, row_ok[jnp.arange(b), r]))
+        col_ok = col_ok.at[jnp.arange(b), c].set(
+            jnp.where(ok, False, col_ok[jnp.arange(b), c]))
+        return match, matched_dist, row_ok, col_ok
+
+    init = (jnp.full((b, m), -1, jnp.int32),
+            jnp.zeros((b, m), jnp.float32),
+            row_valid0, jnp.ones((b, m), bool))
+    match, matched_dist, _, col_ok = jax.lax.fori_loop(0, g, body, init)
+
+    if per_pred:
+        # unmatched priors take their best gt if IoU > threshold
+        masked = jnp.where(row_valid0[:, :, None], dist, -1.0)
+        best_g = jnp.argmax(masked, axis=1).astype(jnp.int32)   # [B,M]
+        best_v = jnp.max(masked, axis=1)
+        extra = col_ok & (best_v > thresh)
+        match = jnp.where(extra, best_g, match)
+        matched_dist = jnp.where(extra, best_v, matched_dist)
+    return {"ColToRowMatchIndices": match,
+            "ColToRowMatchDist": matched_dist}
+
+
+def _rows_lens(ctx, op, slot, b, g):
+    names = (op.inputs.get(slot) or []) if op is not None else []
+    lens = ctx.seq_len_of(names[0]) if names and names[0] else None
+    if lens is None:
+        return jnp.full((b,), g, jnp.int32)
+    return lens.astype(jnp.int32)
+
+
+@register_op("target_assign", grad_maker=None, seq_aware=True)
+def _target_assign(ctx, ins, attrs, op=None):
+    """Gather per-prior targets by match indices (reference
+    target_assign_op.h): X [B,G,K] per-gt values, MatchIndices [B,M]
+    (-1 = background).  Out [B,M,K]; OutWeight [B,M,1] = 1 where
+    matched (or where NegIndices marks a negative)."""
+    x = ins["X"]
+    match = ins["MatchIndices"].astype(jnp.int32)
+    mismatch_value = attrs.get("mismatch_value", 0)
+    b, m = match.shape
+    idx = jnp.clip(match, 0, x.shape[1] - 1)
+    out = jnp.take_along_axis(
+        x, idx[:, :, None].astype(jnp.int32), axis=1)
+    matched = (match >= 0)[:, :, None]
+    out = jnp.where(matched, out,
+                    jnp.asarray(mismatch_value, x.dtype))
+    wt = matched.astype(jnp.float32)
+    neg = ins.get("NegIndices")
+    if neg is not None:
+        # NegIndices [B, M] 0/1 mask of mined negatives
+        wt = jnp.maximum(wt, neg.astype(jnp.float32)[:, :, None])
+    return {"Out": out, "OutWeight": wt}
+
+
+@register_op("mine_hard_examples", grad_maker=None)
+def _mine_hard_examples(ctx, ins, attrs, op=None):
+    """Online hard negative mining (reference mine_hard_examples_op.cc,
+    max_negative mode): rank unmatched priors by ClsLoss and keep the
+    top neg_pos_ratio * #positives per image.  Outputs a [B,M] 0/1
+    negative mask (the reference's NegIndices LoD list, densified)."""
+    mining = attrs.get("mining_type", "max_negative")
+    if mining != "max_negative":
+        raise NotImplementedError(
+            "mine_hard_examples: mining_type %r is not implemented "
+            "(only 'max_negative'); reference hard_example mode caps by "
+            "sample_size, which max_negative honors too" % mining)
+    cls_loss = ins["ClsLoss"].astype(jnp.float32)      # [B,M]
+    match = ins["MatchIndices"].astype(jnp.int32)      # [B,M]
+    if cls_loss.ndim == 3:
+        cls_loss = cls_loss[..., 0]
+    ratio = float(attrs.get("neg_pos_ratio", 3.0))
+    sample_size = int(attrs.get("sample_size", -1))
+    b, m = match.shape
+    positive = match >= 0
+    n_pos = positive.sum(axis=1)
+    n_neg = jnp.minimum((n_pos * ratio).astype(jnp.int32),
+                        m - n_pos)
+    if sample_size > 0:
+        n_neg = jnp.minimum(n_neg, sample_size)
+    neg_loss = jnp.where(positive, -jnp.inf, cls_loss)
+    order = jnp.argsort(-neg_loss, axis=1)
+    rank = jnp.argsort(order, axis=1)                  # rank per prior
+    neg_mask = (rank < n_neg[:, None]) & ~positive & \
+        jnp.isfinite(neg_loss)
+    return {"NegIndices": neg_mask.astype(jnp.int32),
+            "UpdatedMatchIndices": match}
+
+
+# ---------------------------------------------------------------------------
+# multiclass_nms / detection_map (host, like the reference CPU kernels)
+# ---------------------------------------------------------------------------
+
+def _nms_one_class(boxes, scores, score_threshold, nms_threshold, top_k,
+                   eta):
+    idx = np.argsort(-scores)
+    idx = idx[scores[idx] > score_threshold]
+    if top_k > -1:
+        idx = idx[:top_k]
+    keep = []
+    adaptive = nms_threshold
+    while idx.size:
+        i = idx[0]
+        keep.append(i)
+        if idx.size == 1:
+            break
+        rest = idx[1:]
+        xx0 = np.maximum(boxes[i, 0], boxes[rest, 0])
+        yy0 = np.maximum(boxes[i, 1], boxes[rest, 1])
+        xx1 = np.minimum(boxes[i, 2], boxes[rest, 2])
+        yy1 = np.minimum(boxes[i, 3], boxes[rest, 3])
+        inter = np.maximum(xx1 - xx0, 0) * np.maximum(yy1 - yy0, 0)
+        area_i = max((boxes[i, 2] - boxes[i, 0]) *
+                     (boxes[i, 3] - boxes[i, 1]), 0)
+        area_r = np.maximum(boxes[rest, 2] - boxes[rest, 0], 0) * \
+            np.maximum(boxes[rest, 3] - boxes[rest, 1], 0)
+        union = area_i + area_r - inter
+        iou = np.where(union > 0, inter / union, 0)
+        idx = rest[iou <= adaptive]
+        if eta < 1 and adaptive > 0.5:
+            adaptive *= eta
+    return keep
+
+
+@_host("multiclass_nms")
+def _multiclass_nms(executor, op, scope, feed, env=None):
+    """Per-class NMS + cross-class keep_top_k (reference
+    multiclass_nms_op.cc — a CPU-only kernel there too).  BBoxes
+    [B,M,4] decoded boxes, Scores [B,C,M].  Out: [No,6] rows
+    [label, score, xmin, ymin, xmax, ymax]; '@ROWS' var holds the
+    per-image detection counts (the LoD analog)."""
+    def read(name):
+        for src in (env, feed):
+            if src is not None and name in src:
+                return np.asarray(src[name])
+        return np.asarray(scope.find_var(name))
+
+    bboxes = read(op.input("BBoxes")[0])
+    scores = read(op.input("Scores")[0])
+    bg = int(op.attr("background_label", 0))
+    score_th = float(op.attr("score_threshold", 0.01))
+    nms_th = float(op.attr("nms_threshold", 0.3))
+    nms_top_k = int(op.attr("nms_top_k", 400))
+    keep_top_k = int(op.attr("keep_top_k", 200))
+    eta = float(op.attr("nms_eta", 1.0))
+
+    all_rows = []
+    counts = []
+    for b in range(bboxes.shape[0]):
+        dets = []
+        for c in range(scores.shape[1]):
+            if c == bg:
+                continue
+            keep = _nms_one_class(bboxes[b], scores[b, c], score_th,
+                                  nms_th, nms_top_k, eta)
+            for i in keep:
+                dets.append((float(scores[b, c, i]), c, i))
+        dets.sort(reverse=True)
+        if keep_top_k > -1:
+            dets = dets[:keep_top_k]
+        counts.append(len(dets))
+        for s, c, i in dets:
+            all_rows.append([float(c), s] + [float(v)
+                                            for v in bboxes[b, i]])
+    out = (np.asarray(all_rows, np.float32) if all_rows
+           else np.zeros((0, 6), np.float32))
+
+    out_name = op.output("Out")[0]
+    for name, val in ((out_name, out),
+                      (out_name + "@ROWS",
+                       np.asarray(counts, np.int64))):
+        if env is not None:
+            env[name] = val
+        (scope.find_scope_of(name) or scope).set(name, val)
+
+
+@register_op("gather_encoded_target", grad_maker=None)
+def _gather_encoded_target(ctx, ins, attrs, op=None):
+    """Per-prior localization target: Out[b,m] = Encoded[b, match[b,m], m]
+    (the gather the reference folds into target_assign's SSD call path;
+    split out here because Encoded carries a per-column prior axis)."""
+    enc = ins["Encoded"]                  # [B,G,M,4]
+    match = ins["MatchIndices"].astype(jnp.int32)   # [B,M]
+    b, g, m, k = enc.shape
+    idx = jnp.clip(match, 0, g - 1)
+    rows = jnp.arange(b)[:, None]
+    cols = jnp.arange(m)[None, :]
+    out = enc[rows, idx, cols]            # [B,M,4]
+    matched = (match >= 0)[:, :, None]
+    out = jnp.where(matched, out, 0.0)
+    return {"Out": out, "OutWeight": matched.astype(jnp.float32)}

@@ -1,0 +1,248 @@
+"""Detection (SSD) ops + layers (reference operators/detection/*,
+layers/detection.py; test shapes from tests/unittests/test_prior_box_op,
+test_bipartite_match_op, test_multiclass_nms_op, book test_image_
+detection usage)."""
+import numpy as np
+
+import paddle_tpu.fluid as fluid
+
+layers = fluid.layers
+
+
+def _exe_prog():
+    main, startup = fluid.Program(), fluid.Program()
+    scope = fluid.Scope()
+    return main, startup, scope
+
+
+def test_prior_box_geometry(prog_scope, exe):
+    main, startup, scope = prog_scope
+    feat = layers.data(name="feat", shape=[8, 2, 2], dtype="float32")
+    img = layers.data(name="img", shape=[3, 32, 32], dtype="float32")
+    boxes, variances = layers.detection.prior_box(
+        feat, img, min_sizes=[4.0], max_sizes=[8.0],
+        aspect_ratios=[2.0], flip=True, clip=True)
+    exe.run(startup)
+    b, v = exe.run(main, feed={
+        "feat": np.zeros((1, 8, 2, 2), np.float32),
+        "img": np.zeros((1, 3, 32, 32), np.float32)},
+        fetch_list=[boxes, variances])
+    b, v = np.asarray(b), np.asarray(v)
+    # priors per cell: square(min) + ar2 + ar0.5 + sqrt(min*max) = 4
+    assert b.shape == (2, 2, 4, 4) and v.shape == b.shape
+    # cell (0,0) center = (0.5*16, 0.5*16) = (8, 8); min square 4x4
+    np.testing.assert_allclose(
+        b[0, 0, 0], [6 / 32, 6 / 32, 10 / 32, 10 / 32], rtol=1e-6)
+    # max-size square sqrt(4*8)
+    s = np.sqrt(32.0)
+    np.testing.assert_allclose(
+        b[0, 0, 3], [(8 - s / 2) / 32, (8 - s / 2) / 32,
+                     (8 + s / 2) / 32, (8 + s / 2) / 32], rtol=1e-6)
+    assert (b >= 0).all() and (b <= 1).all()  # clip
+    np.testing.assert_allclose(v[1, 1, 2], [0.1, 0.1, 0.2, 0.2])
+
+
+def test_iou_similarity_values(prog_scope, exe):
+    main, startup, scope = prog_scope
+    x = layers.data(name="x", shape=[4], dtype="float32")
+    y = layers.data(name="y", shape=[2, 4], dtype="float32",
+                    append_batch_size=False)
+    iou = layers.detection.iou_similarity(x, y)
+    exe.run(startup)
+    xv = np.asarray([[0, 0, 2, 2], [1, 1, 3, 3]], np.float32)
+    yv = np.asarray([[0, 0, 2, 2], [2, 2, 4, 4]], np.float32)
+    got, = exe.run(main, feed={"x": xv, "y": yv}, fetch_list=[iou])
+    got = np.asarray(got)
+    np.testing.assert_allclose(got[0], [1.0, 0.0], atol=1e-6)
+    np.testing.assert_allclose(got[1], [1 / 7, 1 / 7], rtol=1e-5)
+
+
+def test_box_coder_roundtrip(prog_scope, exe):
+    main, startup, scope = prog_scope
+    prior = layers.data(name="prior", shape=[3, 4], dtype="float32",
+                        append_batch_size=False)
+    pvar = layers.data(name="pvar", shape=[3, 4], dtype="float32",
+                       append_batch_size=False)
+    gt = layers.data(name="gt", shape=[2, 4], dtype="float32",
+                     append_batch_size=False)
+    enc = layers.detection.box_coder(prior, pvar, gt,
+                                     "encode_center_size")
+    dec_in = layers.data(name="den", shape=[2, 3, 4], dtype="float32",
+                         append_batch_size=False)
+    dec = layers.detection.box_coder(prior, pvar, dec_in,
+                                     "decode_center_size")
+    exe.run(startup)
+    rng = np.random.RandomState(0)
+    # sort the two corner points per coordinate: [x0,y0,x1,y1] valid
+    priors = np.sort(rng.rand(3, 2, 2), axis=1).reshape(
+        3, 4).astype(np.float32)
+    gts = np.sort(rng.rand(2, 2, 2), axis=1).reshape(
+        2, 4).astype(np.float32)
+    pv = np.full((3, 4), 0.5, np.float32)
+    e, = exe.run(main, feed={"prior": priors, "pvar": pv, "gt": gts,
+                             "den": np.zeros((2, 3, 4), np.float32)},
+                 fetch_list=[enc])
+    d, = exe.run(main, feed={"prior": priors, "pvar": pv, "gt": gts,
+                             "den": np.asarray(e)}, fetch_list=[dec])
+    # decode(encode(gt)) == gt for every (gt, prior) pair
+    d = np.asarray(d)
+    for g in range(2):
+        for m in range(3):
+            np.testing.assert_allclose(d[g, m], gts[g], rtol=1e-4,
+                                       atol=1e-5)
+
+
+def test_bipartite_match_greedy(prog_scope, exe):
+    main, startup, scope = prog_scope
+    dist = layers.data(name="dist", shape=[2, 3], dtype="float32")
+    mi, md = layers.detection.bipartite_match(dist)
+    mi2, md2 = layers.detection.bipartite_match(
+        dist, match_type="per_prediction", dist_threshold=0.55)
+    exe.run(startup)
+    dv = np.asarray([[[0.9, 0.8, 0.1],
+                      [0.85, 0.2, 0.6]]], np.float32)
+    a, b, c, d = exe.run(main, feed={"dist": dv},
+                         fetch_list=[mi, md, mi2, md2])
+    # greedy: global max 0.9 -> gt0<-prior0; next best for gt1 is 0.6
+    np.testing.assert_array_equal(np.asarray(a)[0], [0, -1, 1])
+    np.testing.assert_allclose(np.asarray(b)[0], [0.9, 0.0, 0.6])
+    # per_prediction: leftover prior1's best gt is gt0 at 0.8 > 0.55
+    np.testing.assert_array_equal(np.asarray(c)[0], [0, 0, 1])
+    np.testing.assert_allclose(np.asarray(d)[0], [0.9, 0.8, 0.6])
+
+
+def test_mine_hard_examples(prog_scope, exe):
+    main, startup, scope = prog_scope
+    cls = layers.data(name="cls", shape=[6], dtype="float32")
+    mi = layers.data(name="mi", shape=[6], dtype="int32")
+    helper = fluid.layer_helper.LayerHelper("mine")
+    neg = helper.create_tmp_variable(dtype="int32")
+    upd = helper.create_tmp_variable(dtype="int32")
+    helper.append_op(type="mine_hard_examples",
+                     inputs={"ClsLoss": [cls], "MatchIndices": [mi]},
+                     outputs={"NegIndices": [neg],
+                              "UpdatedMatchIndices": [upd]},
+                     attrs={"neg_pos_ratio": 2.0})
+    exe.run(startup)
+    clsv = np.asarray([[5.0, 1.0, 3.0, 4.0, 2.0, 0.5]], np.float32)
+    miv = np.asarray([[0, -1, -1, -1, -1, -1]], np.int32)
+    got, = exe.run(main, feed={"cls": clsv, "mi": miv},
+                   fetch_list=[neg])
+    # 1 positive -> keep top-2 negatives by loss: priors 3 (4.0), 2 (3.0)
+    np.testing.assert_array_equal(np.asarray(got)[0],
+                                  [0, 0, 1, 1, 0, 0])
+
+
+def test_multiclass_nms_suppression(prog_scope, exe):
+    main, startup, scope = prog_scope
+    bb = layers.data(name="bb", shape=[3, 4], dtype="float32")
+    sc = layers.data(name="sc", shape=[2, 3], dtype="float32")
+    out = layers.detection.multiclass_nms(
+        bb, sc, background_label=0, score_threshold=0.1,
+        nms_threshold=0.4, keep_top_k=10)
+    exe.run(startup)
+    boxes = np.asarray([[[0, 0, 1, 1], [0, 0, 1.05, 1.05],
+                         [2, 2, 3, 3]]], np.float32)
+    scores = np.asarray([[[0.9, 0.8, 0.7],        # class 0 = background
+                          [0.6, 0.95, 0.5]]], np.float32)
+    got, = exe.run(main, feed={"bb": boxes, "sc": scores},
+                   fetch_list=[out])
+    got = np.asarray(got)
+    # class 1 only: box1 (0.95) kept, box0 suppressed (IoU ~0.9),
+    # box2 kept (disjoint); sorted by score
+    assert got.shape == (2, 6)
+    np.testing.assert_allclose(got[0, :2], [1.0, 0.95])
+    np.testing.assert_allclose(got[0, 2:], [0, 0, 1.05, 1.05])
+    np.testing.assert_allclose(got[1, :2], [1.0, 0.5])
+
+
+def test_ssd_head_and_loss_trains(prog_scope, exe):
+    """multi_box_head + ssd_loss smoke: loss is finite and decreases."""
+    main, startup, scope = prog_scope
+    img = layers.data(name="img", shape=[3, 16, 16], dtype="float32")
+    gt_box = layers.data(name="gt_box", shape=[2, 4], dtype="float32")
+    gt_lab = layers.data(name="gt_lab", shape=[2, 1], dtype="int64")
+    c1 = layers.conv2d(img, num_filters=8, filter_size=3, padding=1,
+                       stride=2, act="relu")          # [N,8,8,8]
+    c2 = layers.conv2d(c1, num_filters=8, filter_size=3, padding=1,
+                       stride=2, act="relu")          # [N,8,4,4]
+    locs, confs, boxes, vars_ = layers.detection.multi_box_head(
+        inputs=[c1, c2], image=img, base_size=16, num_classes=3,
+        aspect_ratios=[[2.0], [2.0]], min_sizes=[4.0, 8.0],
+        max_sizes=[8.0, 12.0], flip=True)
+    loss = layers.mean(layers.detection.ssd_loss(
+        locs, confs, gt_box, gt_lab, boxes, vars_))
+    fluid.optimizer.Adam(learning_rate=0.01).minimize(loss)
+    exe.run(startup)
+    rng = np.random.RandomState(0)
+    imgv = rng.rand(2, 3, 16, 16).astype(np.float32)
+    gbv = np.asarray([[[0.1, 0.1, 0.4, 0.4], [0.5, 0.5, 0.9, 0.9]],
+                      [[0.2, 0.3, 0.6, 0.7], [0.0, 0.0, 0.3, 0.2]]],
+                     np.float32)
+    glv = np.asarray([[[1], [2]], [[2], [1]]], np.int64)
+    ls = []
+    for _ in range(15):
+        l, = exe.run(main, feed={"img": imgv, "gt_box": gbv,
+                                 "gt_lab": glv}, fetch_list=[loss])
+        ls.append(float(np.ravel(l)[0]))
+    assert np.isfinite(ls).all()
+    assert ls[-1] < ls[0], (ls[0], ls[-1])
+
+
+def test_ssd_loss_default_prior_var_and_threshold_zero(prog_scope, exe):
+    """prior_box_var=None must run (op defaults variances to 1), and an
+    explicit dist_threshold=0.0 must not be silently replaced."""
+    main, startup, scope = prog_scope
+    loc = layers.data(name="loc", shape=[4, 4], dtype="float32")
+    conf = layers.data(name="conf", shape=[4, 3], dtype="float32")
+    gt_box = layers.data(name="gt_box", shape=[1, 4], dtype="float32")
+    gt_lab = layers.data(name="gt_lab", shape=[1, 1], dtype="int64")
+    prior = layers.data(name="prior", shape=[4, 4], dtype="float32",
+                        append_batch_size=False)
+    loss = layers.detection.ssd_loss(loc, conf, gt_box, gt_lab, prior)
+    dist = layers.data(name="dist", shape=[1, 4], dtype="float32")
+    mi0, _ = layers.detection.bipartite_match(
+        dist, match_type="per_prediction", dist_threshold=0.0)
+    exe.run(startup)
+    rng = np.random.RandomState(0)
+    priors = np.asarray([[0.0, 0.0, 0.5, 0.5], [0.5, 0.5, 1, 1],
+                         [0, 0.5, 0.5, 1], [0.5, 0, 1, 0.5]],
+                        np.float32)
+    got_loss, got_mi = exe.run(main, feed={
+        "loc": rng.randn(1, 4, 4).astype(np.float32) * 0.1,
+        "conf": rng.randn(1, 4, 3).astype(np.float32),
+        "gt_box": np.asarray([[[0.1, 0.1, 0.4, 0.4]]], np.float32),
+        "gt_lab": np.asarray([[[1]]], np.int64),
+        "prior": priors,
+        "dist": np.asarray([[[0.3, 0.2, 0.1, 0.05]]], np.float32)},
+        fetch_list=[loss, mi0])
+    assert np.isfinite(np.asarray(got_loss)).all()
+    # threshold 0.0: EVERY prior with positive best-IoU gets matched
+    np.testing.assert_array_equal(np.asarray(got_mi)[0], [0, 0, 0, 0])
+
+
+def test_detection_output_end_to_end(prog_scope, exe):
+    main, startup, scope = prog_scope
+    loc = layers.data(name="loc", shape=[4, 4], dtype="float32")
+    sc = layers.data(name="sc", shape=[4, 3], dtype="float32")
+    prior = layers.data(name="prior", shape=[4, 4], dtype="float32",
+                        append_batch_size=False)
+    pvar = layers.data(name="pvar", shape=[4, 4], dtype="float32",
+                       append_batch_size=False)
+    out = layers.detection.detection_output(loc, sc, prior, pvar)
+    exe.run(startup)
+    priors = np.asarray([[0.1, 0.1, 0.3, 0.3], [0.4, 0.4, 0.6, 0.6],
+                         [0.6, 0.6, 0.8, 0.8], [0.2, 0.2, 0.5, 0.5]],
+                        np.float32)
+    got, = exe.run(main, feed={
+        "loc": np.zeros((1, 4, 4), np.float32),   # offsets 0 = priors
+        "sc": np.asarray([[[0.1, 0.8, 0.1], [0.2, 0.2, 0.6],
+                           [0.8, 0.1, 0.1], [0.7, 0.2, 0.1]]],
+                         np.float32),
+        "prior": priors, "pvar": np.full((4, 4), 0.1, np.float32)},
+        fetch_list=[out])
+    got = np.asarray(got)
+    assert got.ndim == 2 and got.shape[1] == 6
+    # highest-confidence non-background: class1@prior0 (0.8)
+    np.testing.assert_allclose(got[0, :2], [1.0, 0.8])
+    np.testing.assert_allclose(got[0, 2:], priors[0], atol=1e-6)

@@ -1,0 +1,102 @@
+"""Inference transpiler BN-fold (reference transpiler/
+inference_transpiler.py fuse_batch_norm) + memory_optimize API."""
+import numpy as np
+
+import paddle_tpu.fluid as fluid
+
+layers = fluid.layers
+
+
+def _build_convnet(with_bias):
+    img = fluid.layers.data(name="img", shape=[3, 8, 8],
+                            dtype="float32")
+    conv = layers.conv2d(img, num_filters=4, filter_size=3, padding=1,
+                         bias_attr=True if with_bias else False)
+    bn = layers.batch_norm(conv, is_test=True)
+    out = layers.relu(bn)
+    return out
+
+
+def _count_ops(program, type_):
+    return sum(1 for op in program.desc.blocks[0].ops
+               if op.type == type_)
+
+
+def _run_fold(with_bias):
+    main, startup = fluid.Program(), fluid.Program()
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        with fluid.program_guard(main, startup):
+            with fluid.unique_name.guard():
+                out = _build_convnet(with_bias)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        # make bn stats non-trivial so the fold actually moves numbers
+        for op in main.desc.blocks[0].ops:
+            if op.type == "batch_norm":
+                rng = np.random.RandomState(1)
+                scope.set(op.inputs["Mean"][0],
+                          rng.randn(4).astype(np.float32) * 0.1)
+                scope.set(op.inputs["Variance"][0],
+                          (rng.rand(4) + 0.5).astype(np.float32))
+                scope.set(op.inputs["Scale"][0],
+                          (rng.rand(4) + 0.5).astype(np.float32))
+                scope.set(op.inputs["Bias"][0],
+                          rng.randn(4).astype(np.float32) * 0.1)
+        xv = np.random.RandomState(0).rand(2, 3, 8, 8).astype(
+            np.float32)
+        before, = exe.run(main, feed={"img": xv}, fetch_list=[out])
+        assert _count_ops(main, "batch_norm") == 1
+        fluid.transpiler.InferenceTranspiler().transpile(main,
+                                                         scope=scope)
+        assert _count_ops(main, "batch_norm") == 0
+        after, = exe.run(main, feed={"img": xv}, fetch_list=[out])
+    np.testing.assert_allclose(np.asarray(after), np.asarray(before),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_bn_fold_with_conv_bias():
+    _run_fold(with_bias=True)
+
+
+def test_bn_fold_without_conv_bias():
+    _run_fold(with_bias=False)
+
+
+def test_bn_fold_skips_residual_add():
+    """conv -> elementwise_add(conv_out, skip) -> bn is NOT a bias
+    pattern; the transpiler must leave it (and the weights) untouched."""
+    main, startup = fluid.Program(), fluid.Program()
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        with fluid.program_guard(main, startup):
+            with fluid.unique_name.guard():
+                img = fluid.layers.data(name="img", shape=[3, 8, 8],
+                                        dtype="float32")
+                conv = layers.conv2d(img, num_filters=3, filter_size=3,
+                                     padding=1, bias_attr=False)
+                merged = layers.elementwise_add(x=conv, y=img)
+                out = layers.batch_norm(merged, is_test=True)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        w_name = [op.inputs["Filter"][0]
+                  for op in main.desc.blocks[0].ops
+                  if op.type == "conv2d"][0]
+        w_before = np.asarray(scope.find_var(w_name)).copy()
+        fluid.transpiler.InferenceTranspiler().transpile(main,
+                                                         scope=scope)
+        assert _count_ops(main, "batch_norm") == 1  # untouched
+        np.testing.assert_array_equal(
+            np.asarray(scope.find_var(w_name)), w_before)
+
+
+def test_memory_optimize_liveness():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        with fluid.unique_name.guard():
+            x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+            h = layers.relu(layers.scale(x, scale=2.0))
+            layers.mean(h)
+    live = fluid.transpiler.memory_optimize(main)
+    # every non-persistable temp has a [first, last] interval
+    assert all(f <= l for f, l in live.values()) and live
