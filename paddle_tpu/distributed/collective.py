@@ -1,0 +1,93 @@
+"""Multi-host collective bootstrap — the "nccl2 mode" analog.
+
+Parity: reference operators/gen_nccl_id_op.cc + platform/nccl_helper.h:81
+(NCCLContextMap) and trainer.py:_transpile_nccl2_dist's env contract:
+PADDLE_TRAINER_IPS / PADDLE_PSERVER_PORT / PADDLE_TRAINER_ID elect a
+root that broadcasts the NCCL unique id, then every process joins one
+flat communicator.
+
+TPU-native redesign: `jax.distributed.initialize` plays the
+gen_nccl_id role — process 0 is the coordinator, every host connects,
+and afterwards `jax.devices()` spans ALL hosts so one Mesh covers the
+whole slice and GSPMD lays collectives onto ICI/DCN (there is no
+rank-to-device map to manage; that was NCCLContextMap's job).
+
+This module translates the reference's env contract (and the newer
+PADDLE_TRAINER_ENDPOINTS form) into the initialize() call.  On a
+single-host run with no env set it is a no-op, so code can call
+``init_collective_env`` unconditionally.
+"""
+from __future__ import annotations
+
+import os
+
+__all__ = ["init_collective_env", "collective_env", "global_mesh"]
+
+
+def collective_env(environ=None):
+    """Parse the reference env contract -> (coordinator, num_processes,
+    process_id) or None when not configured.
+
+    Supported forms:
+      PADDLE_TRAINER_ENDPOINTS=ip1:p,ip2:p + PADDLE_CURRENT_ENDPOINT
+      PADDLE_TRAINER_IPS=ip1,ip2 + PADDLE_PSERVER_PORT + POD_IP
+    plus PADDLE_TRAINER_ID in both (reference trainer.py:199-214).
+    """
+    env = environ if environ is not None else os.environ
+    eps = env.get("PADDLE_TRAINER_ENDPOINTS")
+    if not eps:
+        ips = env.get("PADDLE_TRAINER_IPS")
+        port = env.get("PADDLE_PSERVER_PORT")
+        if not ips or not port:
+            return None
+        eps = ",".join(ip + ":" + port for ip in ips.split(","))
+    endpoints = [e.strip() for e in eps.split(",") if e.strip()]
+    if not endpoints:
+        return None
+    tid = env.get("PADDLE_TRAINER_ID")
+    if tid is None:
+        cur = env.get("PADDLE_CURRENT_ENDPOINT") or (
+            (env.get("POD_IP", "") + ":" +
+             env.get("PADDLE_PSERVER_PORT", "")))
+        if cur not in endpoints:
+            # fail FAST: silently degrading to single-host would leave
+            # every other host blocked in jax.distributed.initialize
+            raise ValueError(
+                "collective endpoints %r are configured but this host's "
+                "endpoint %r is not among them (check "
+                "PADDLE_CURRENT_ENDPOINT / POD_IP)" % (endpoints, cur))
+        tid = endpoints.index(cur)
+    return endpoints[0], len(endpoints), int(tid)
+
+
+def init_collective_env(environ=None, **kwargs):
+    """Join the multi-host collective if the env contract is present.
+
+    Returns (num_processes, process_id); (1, 0) when unconfigured (the
+    single-host no-op).  After a successful join, jax.devices() spans
+    every host: build the global Mesh with parallel.make_mesh as usual.
+    """
+    parsed = collective_env(environ)
+    if parsed is None:
+        return 1, 0
+    coordinator, num_processes, process_id = parsed
+    if num_processes == 1:
+        return 1, 0
+    import jax
+
+    jax.distributed.initialize(
+        coordinator_address=coordinator,
+        num_processes=num_processes,
+        process_id=process_id, **kwargs)
+    return num_processes, process_id
+
+
+def global_mesh(axes=None):
+    """Mesh over every device of every joined host.  Default: one 'dp'
+    axis spanning the slice (the reference's flat nccl2 world)."""
+    import jax
+
+    from paddle_tpu.parallel.mesh import make_mesh
+
+    n = len(jax.devices())
+    return make_mesh(axes or {"dp": n})

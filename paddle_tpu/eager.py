@@ -1,0 +1,203 @@
+"""Eager (define-by-run) execution with a gradient tape.
+
+Parity: reference paddle/contrib/tape/ (tape.h:41 Tape, variable.h,
+function.h) — the reference's experimental imperative mode that records
+ops while executing them and pops the tape for backward.
+
+TPU-native redesign: eager ops execute the SAME registered lowerings as
+the graph executor, immediately, on concrete jax arrays; the tape
+records (op_type, inputs, attrs, outputs).  ``Tape.backward`` replays
+the recording as a pure function of the watched leaves and gets every
+gradient from one jax.vjp — so eager mode needs no per-op grad
+definitions, and a replayed tape can even be jitted.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.core.lowering import Ins, LoweringContext
+from paddle_tpu.core.registry import get_op_info
+
+__all__ = ["Variable", "Tape", "default_tape", "op", "fc_like"]
+
+
+class Variable:
+    """Eager value wrapper (reference contrib/tape/variable.h)."""
+
+    __slots__ = ("value", "name", "trainable", "grad")
+
+    _counter = [0]
+
+    def __init__(self, value, name=None, trainable=False):
+        self.value = jnp.asarray(value)
+        Variable._counter[0] += 1
+        self.name = name or ("var_%d" % Variable._counter[0])
+        self.trainable = trainable
+        self.grad = None
+
+    @property
+    def shape(self):
+        return self.value.shape
+
+    @property
+    def dtype(self):
+        return self.value.dtype
+
+    def numpy(self):
+        return np.asarray(self.value)
+
+    def __repr__(self):
+        return "eager.Variable(%s, shape=%s)" % (self.name, self.shape)
+
+
+class _Record:
+    __slots__ = ("op_type", "ins", "attrs", "outs")
+
+    def __init__(self, op_type, ins, attrs, outs):
+        self.op_type = op_type    # str
+        self.ins = ins            # slot -> [Variable|None]
+        self.attrs = attrs
+        self.outs = outs          # slot -> [Variable]
+
+
+class Tape:
+    """Records eager ops; backward() differentiates the whole recording
+    (reference tape.h pops the tape op-by-op; one vjp subsumes that)."""
+
+    def __init__(self, seed=0):
+        self.records = []
+        self._stopped = False
+        self._seed = seed
+        self._live_counter = None  # advances across run_op calls
+
+    # -- recording --
+    def _ctx(self, env=None, counter=None):
+        from paddle_tpu.core.desc import ProgramDesc
+        from paddle_tpu.core.lowering import _Counter
+
+        ctx = LoweringContext(ProgramDesc(), 0, env or {},
+                              jax.random.PRNGKey(self._seed), "train",
+                              counter=counter or _Counter())
+        return ctx
+
+    def stop_recording(self):
+        """Context manager: ops inside execute but are not taped
+        (the no_grad analog)."""
+        import contextlib
+
+        @contextlib.contextmanager
+        def guard():
+            prev = self._stopped
+            self._stopped = True
+            try:
+                yield
+            finally:
+                self._stopped = prev
+
+        return guard()
+
+    def run_op(self, op_type, ins, attrs=None):
+        """Execute one registered op eagerly; ins: slot -> Variable or
+        [Variable].  Returns slot -> Variable (or [Variable])."""
+        from paddle_tpu.core.lowering import _Counter
+
+        info = get_op_info(op_type)
+        norm = {}
+        for slot, vs in ins.items():
+            vs = vs if isinstance(vs, (list, tuple)) else [vs]
+            norm[slot] = [v for v in vs]
+        raw = {s: [None if v is None else v.value for v in vs]
+               for s, vs in norm.items()}
+        # one counter for the tape's whole life: stochastic ops (dropout,
+        # uniform_random) get a fresh key per call, and replay (which
+        # restarts the counter from 0) reproduces the same key sequence
+        if self._live_counter is None:
+            self._live_counter = _Counter()
+        outs = info.lower(self._ctx(counter=self._live_counter),
+                          Ins(raw), dict(attrs or {}), None)
+        out_vars = {}
+        for slot, vals in (outs or {}).items():
+            vals = vals if isinstance(vals, (list, tuple)) else [vals]
+            out_vars[slot] = [None if v is None else Variable(v)
+                              for v in vals]
+        if not self._stopped:
+            self.records.append(_Record(op_type, norm, dict(attrs or {}),
+                                        out_vars))
+        return {s: (vs[0] if len(vs) == 1 else vs)
+                for s, vs in out_vars.items()}
+
+    # -- autodiff --
+    def backward(self, loss):
+        """Populate .grad of every trainable Variable reachable from the
+        recording, d loss / d leaf."""
+        leaves = []
+        seen = set()
+        for rec in self.records:
+            for vs in rec.ins.values():
+                for v in vs:
+                    if v is not None and v.trainable and \
+                            id(v) not in seen:
+                        seen.add(id(v))
+                        leaves.append(v)
+        if not leaves:
+            return []
+
+        def replay(leaf_vals):
+            from paddle_tpu.core.lowering import _Counter
+
+            # one counter across the replay: stochastic ops reproduce
+            # the recording's key sequence (NB: ops executed under
+            # stop_recording consume live keys but are not replayed, so
+            # mixing stochastic ops with stop_recording shifts keys)
+            counter = _Counter()
+            val_of = {id(v): x for v, x in zip(leaves, leaf_vals)}
+
+            def get(v):
+                return val_of.get(id(v), v.value)
+
+            for rec in self.records:
+                raw = {s: [None if v is None else get(v) for v in vs]
+                       for s, vs in rec.ins.items()}
+                outs = get_op_info(rec.op_type).lower(
+                    self._ctx(counter=counter), Ins(raw),
+                    dict(rec.attrs), None)
+                for slot, vals in (outs or {}).items():
+                    vals = (vals if isinstance(vals, (list, tuple))
+                            else [vals])
+                    for ov, x in zip(rec.outs[slot], vals):
+                        if ov is not None:
+                            val_of[id(ov)] = x
+            return val_of[id(loss)].sum()
+
+        grads = jax.grad(replay)([v.value for v in leaves])
+        for v, g in zip(leaves, grads):
+            v.grad = g
+        return list(zip(leaves, grads))
+
+    def reset(self):
+        self.records = []
+
+
+_default = Tape()
+
+
+def default_tape():
+    return _default
+
+
+def op(op_type, ins, attrs=None, tape=None):
+    """Module-level eager op call on the default tape."""
+    return (tape or _default).run_op(op_type, ins, attrs)
+
+
+def fc_like(x, w, b=None, tape=None):
+    """Convenience: mul (+ bias) on the tape — the contrib/tape demo's
+    Linear function (function.h)."""
+    t = tape or _default
+    out = t.run_op("mul", {"X": x, "Y": w},
+                   {"x_num_col_dims": 1, "y_num_col_dims": 1})["Out"]
+    if b is not None:
+        out = t.run_op("elementwise_add", {"X": out, "Y": b})["Out"]
+    return out

@@ -42,6 +42,9 @@ from .trainer import (Trainer, BeginEpochEvent, EndEpochEvent,
 from . import inferencer
 from .inferencer import Inferencer
 from . import debugger
+from . import concurrency
+from .concurrency import (Go, Select, make_channel, channel_send,
+                          channel_recv, channel_close)
 from paddle_tpu.core.flags import FLAGS, define_flag
 from . import transpiler
 from .transpiler import DistributeTranspiler

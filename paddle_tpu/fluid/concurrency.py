@@ -1,0 +1,244 @@
+"""CSP concurrency: Go routines, channels, select.
+
+Parity: reference python/paddle/fluid/concurrency.py (Go:27,
+SelectCase:79, Select:193, make_channel:279, channel_send:335,
+channel_recv:385, channel_close:429) over framework/channel.h's
+Go-style buffered/unbuffered channels.
+
+TPU-native redesign: the reference lowers these to ops executed by a
+threaded C++ executor; here concurrency is HOST-side orchestration
+around compiled device programs (the executor's device step is one XLA
+computation; overlapping steps is what threads are for).  Channels are
+Go-semantics queues (rendezvous when capacity=0, close drains then
+raises); ``Go`` runs a Python callable—typically executor.run on a
+program—in a daemon thread."""
+from __future__ import annotations
+
+import threading
+
+__all__ = ["Channel", "ChannelClosed", "Go", "make_channel",
+           "channel_send", "channel_recv", "channel_close", "Select"]
+
+
+class ChannelClosed(Exception):
+    pass
+
+
+class _Rendezvous:
+    __slots__ = ("value", "ready", "closed")
+
+    def __init__(self, value):
+        self.value = value
+        self.ready = threading.Event()
+        self.closed = False
+
+
+class Channel:
+    """Go-semantics channel.  capacity=0 -> unbuffered (send blocks
+    until a receiver takes the value)."""
+
+    def __init__(self, capacity=0, dtype=None):
+        self.capacity = capacity
+        self.dtype = dtype
+        self._closed = False
+        self._lock = threading.Lock()
+        self._not_empty = threading.Condition(self._lock)
+        self._not_full = threading.Condition(self._lock)
+        self._buf = []
+
+    def send(self, value, timeout=None):
+        """Blocks while full; raises ChannelClosed on a closed channel
+        (Go panics on send-to-closed)."""
+        with self._lock:
+            if self._closed:
+                raise ChannelClosed("send on closed channel")
+            if self.capacity == 0:
+                item = _Rendezvous(value)
+                self._buf.append(item)
+                self._not_empty.notify()
+            else:
+                while len(self._buf) >= self.capacity:
+                    if not self._not_full.wait(timeout):
+                        raise TimeoutError("channel send timed out")
+                    if self._closed:
+                        raise ChannelClosed("send on closed channel")
+                self._buf.append(value)
+                self._not_empty.notify()
+                return
+        # unbuffered: wait outside the lock for the receiver
+        if not item.ready.wait(timeout):
+            with self._lock:
+                if item in self._buf:
+                    # genuinely undelivered
+                    self._buf.remove(item)
+                    raise TimeoutError("channel send timed out")
+            # taken (or closed) between the timeout and the lock:
+            # fall through to the delivered/closed check
+        if item.closed:
+            raise ChannelClosed("channel closed while sending")
+
+    def recv(self, timeout=None):
+        """Blocks while empty; raises ChannelClosed once closed AND
+        drained (Go's `v, ok := <-ch` with ok=False)."""
+        with self._lock:
+            while not self._buf:
+                if self._closed:
+                    raise ChannelClosed("recv on closed, drained channel")
+                if not self._not_empty.wait(timeout):
+                    raise TimeoutError("channel recv timed out")
+            item = self._buf.pop(0)
+            self._not_full.notify()
+        if isinstance(item, _Rendezvous):
+            item.ready.set()
+            return item.value
+        return item
+
+    def close(self):
+        with self._lock:
+            self._closed = True
+            # abort senders parked on a rendezvous (Go panics them; we
+            # raise ChannelClosed from their send call)
+            pending = [it for it in self._buf
+                       if isinstance(it, _Rendezvous)]
+            self._buf = [it for it in self._buf
+                         if not isinstance(it, _Rendezvous)]
+            for it in pending:
+                it.closed = True
+                it.ready.set()
+            self._not_empty.notify_all()
+            self._not_full.notify_all()
+
+    def poll_recv(self):
+        """Non-blocking receive attempt: (True, value) or (False, None).
+        Raises ChannelClosed when closed and drained."""
+        with self._lock:
+            if self._buf:
+                item = self._buf.pop(0)
+                self._not_full.notify()
+            elif self._closed:
+                raise ChannelClosed("recv on closed, drained channel")
+            else:
+                return False, None
+        if isinstance(item, _Rendezvous):
+            item.ready.set()
+            return True, item.value
+        return True, item
+
+    def poll_send(self, value, rendezvous_wait=0.01):
+        """Non-blocking send attempt: True if the value was delivered.
+        On an unbuffered channel this offers a rendezvous and succeeds
+        only if a receiver takes it within ``rendezvous_wait``."""
+        with self._lock:
+            if self._closed:
+                raise ChannelClosed("send on closed channel")
+            if self.capacity > 0:
+                if len(self._buf) < self.capacity:
+                    self._buf.append(value)
+                    self._not_empty.notify()
+                    return True
+                return False
+            item = _Rendezvous(value)
+            self._buf.append(item)
+            self._not_empty.notify()
+        if item.ready.wait(rendezvous_wait):
+            return not item.closed
+        with self._lock:
+            if item in self._buf:
+                self._buf.remove(item)
+                return False
+        return item.ready.wait(0.1) and not item.closed
+
+
+def make_channel(dtype=None, capacity=0):
+    return Channel(capacity=capacity, dtype=dtype)
+
+
+def channel_send(channel, value, is_copy=False):
+    import numpy as np
+
+    if is_copy:
+        value = np.array(value, copy=True)
+    channel.send(value)
+    return True
+
+
+def channel_recv(channel, return_value=None):
+    """-> (value, ok); ok=False once the channel is closed and drained
+    (matches the reference's Status output)."""
+    try:
+        return channel.recv(), True
+    except ChannelClosed:
+        return return_value, False
+
+
+def channel_close(channel):
+    channel.close()
+
+
+class Go:
+    """Run ``fn(*args, **kwargs)`` concurrently (reference Go op runs a
+    sub-block on a new thread); ``join()`` re-raises any exception from
+    the routine."""
+
+    def __init__(self, fn, *args, **kwargs):
+        self._exc = None
+        self._thread = None
+        self._start(fn, args, kwargs)
+
+    def _start(self, fn, args, kwargs):
+        def run():
+            try:
+                fn(*args, **kwargs)
+            except BaseException as e:   # noqa: BLE001 — rethrown in join
+                self._exc = e
+
+        self._thread = threading.Thread(target=run, daemon=True)
+        self._thread.start()
+
+    def join(self, timeout=None):
+        self._thread.join(timeout)
+        if self._thread.is_alive():
+            raise TimeoutError("Go routine still running")
+        if self._exc is not None:
+            raise self._exc
+
+
+class Select:
+    """Multi-channel select (reference Select:193): cases are
+    ("recv", ch, callback(value)) / ("send", ch, value, callback()) /
+    ("default", callback()).  run() executes exactly one ready case;
+    blocks polling until one is ready unless a default case exists."""
+
+    def __init__(self, cases):
+        self.cases = list(cases)
+
+    def run(self, poll_interval=0.001, timeout=None):
+        import time
+
+        deadline = (time.time() + timeout
+                    if timeout is not None else None)
+        while True:
+            default_cb = None
+            for case in self.cases:
+                kind = case[0]
+                if kind == "recv":
+                    _, ch, cb = case
+                    try:
+                        ok, val = ch.poll_recv()
+                    except ChannelClosed:
+                        ok, val = True, None
+                    if ok:
+                        return cb(val)
+                elif kind == "send":
+                    _, ch, value, cb = case
+                    if ch.poll_send(value):
+                        return cb()
+                elif kind == "default":
+                    default_cb = case[1]
+                else:
+                    raise ValueError("unknown select case %r" % kind)
+            if default_cb is not None:
+                return default_cb()
+            if deadline and time.time() > deadline:
+                raise TimeoutError("select timed out")
+            time.sleep(poll_interval)

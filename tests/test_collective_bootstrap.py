@@ -1,0 +1,42 @@
+"""Multi-host bootstrap env contract (reference gen_nccl_id_op.cc /
+trainer.py:_transpile_nccl2_dist env parsing)."""
+from paddle_tpu.distributed.collective import (collective_env,
+                                               init_collective_env)
+
+
+def test_endpoint_form():
+    env = {"PADDLE_TRAINER_ENDPOINTS": "10.0.0.1:7164,10.0.0.2:7164",
+           "PADDLE_CURRENT_ENDPOINT": "10.0.0.2:7164"}
+    assert collective_env(env) == ("10.0.0.1:7164", 2, 1)
+
+
+def test_trainer_id_overrides_endpoint_lookup():
+    env = {"PADDLE_TRAINER_ENDPOINTS": "a:1,b:1,c:1",
+           "PADDLE_TRAINER_ID": "2"}
+    assert collective_env(env) == ("a:1", 3, 2)
+
+
+def test_legacy_ips_form():
+    env = {"PADDLE_TRAINER_IPS": "10.1.1.1,10.1.1.2",
+           "PADDLE_PSERVER_PORT": "6174", "POD_IP": "10.1.1.1"}
+    assert collective_env(env) == ("10.1.1.1:6174", 2, 0)
+
+
+def test_unconfigured_is_noop():
+    assert collective_env({}) is None
+    assert init_collective_env({}) == (1, 0)
+
+
+def test_misconfigured_current_endpoint_fails_fast():
+    import pytest
+
+    env = {"PADDLE_TRAINER_ENDPOINTS": "10.0.0.1:7164,10.0.0.2:7164",
+           "PADDLE_CURRENT_ENDPOINT": "10.0.0.99:7164"}  # typo
+    with pytest.raises(ValueError, match="not among them"):
+        collective_env(env)
+
+
+def test_single_process_is_noop():
+    env = {"PADDLE_TRAINER_ENDPOINTS": "10.0.0.1:7164",
+           "PADDLE_TRAINER_ID": "0"}
+    assert init_collective_env(env) == (1, 0)

@@ -1,0 +1,272 @@
+"""CSP channels/Go/Select (reference python/paddle/fluid/concurrency.py,
+framework/channel.h semantics) and the eager tape prototype (reference
+paddle/contrib/tape/)."""
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu.eager as eager
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid.concurrency import (Channel, ChannelClosed, Go,
+                                          Select, channel_recv,
+                                          make_channel)
+
+
+# ------------------------------ channels --------------------------------
+
+def test_buffered_channel_fifo_and_close():
+    ch = make_channel(capacity=3)
+    for i in range(3):
+        ch.send(i)
+    ch.close()
+    got = []
+    while True:
+        v, ok = channel_recv(ch)
+        if not ok:
+            break
+        got.append(v)
+    assert got == [0, 1, 2]
+    with pytest.raises(ChannelClosed):
+        ch.send(9)
+
+
+def test_unbuffered_channel_rendezvous():
+    ch = make_channel(capacity=0)
+    order = []
+
+    def sender():
+        order.append("send-start")
+        ch.send(42)
+        order.append("send-done")
+
+    g = Go(sender)
+    time.sleep(0.05)
+    assert "send-done" not in order  # blocked until recv
+    assert ch.recv() == 42
+    g.join(timeout=5)
+    assert order == ["send-start", "send-done"]
+
+
+def test_go_producer_consumer_pipeline():
+    src = make_channel(capacity=4)
+    dst = make_channel(capacity=4)
+
+    def producer():
+        for i in range(10):
+            src.send(i)
+        src.close()
+
+    def worker():
+        while True:
+            v, ok = channel_recv(src)
+            if not ok:
+                break
+            dst.send(v * v)
+        dst.close()
+
+    g1, g2 = Go(producer), Go(worker)
+    got = []
+    while True:
+        v, ok = channel_recv(dst)
+        if not ok:
+            break
+        got.append(v)
+    g1.join(5)
+    g2.join(5)
+    assert got == [i * i for i in range(10)]
+
+
+def test_go_reraises():
+    def boom():
+        raise ValueError("inner")
+
+    g = Go(boom)
+    with pytest.raises(ValueError, match="inner"):
+        g.join(5)
+
+
+def test_select_picks_ready_case():
+    a = make_channel(capacity=1)
+    b = make_channel(capacity=1)
+    b.send("hello")
+    hit = []
+    Select([
+        ("recv", a, lambda v: hit.append(("a", v))),
+        ("recv", b, lambda v: hit.append(("b", v))),
+    ]).run(timeout=2)
+    assert hit == [("b", "hello")]
+    # default fires when nothing is ready
+    Select([
+        ("recv", a, lambda v: hit.append(("a", v))),
+        ("default", lambda: hit.append(("default",))),
+    ]).run()
+    assert hit[-1] == ("default",)
+    # send case
+    Select([
+        ("send", a, 7, lambda: hit.append(("sent",))),
+    ]).run(timeout=2)
+    assert hit[-1] == ("sent",) and a.recv() == 7
+
+
+def test_go_with_executor_channel_feed():
+    """The intended pattern: a Go routine runs compiled steps, fed
+    through a channel (reference test_concurrency-style)."""
+    main, startup = fluid.Program(), fluid.Program()
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        with fluid.program_guard(main, startup):
+            with fluid.unique_name.guard():
+                x = fluid.layers.data(name="x", shape=[4],
+                                      dtype="float32")
+                y = fluid.layers.scale(x, scale=3.0)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        feed_ch = make_channel(capacity=2)
+        out_ch = make_channel(capacity=2)
+
+        def trainer():
+            with fluid.scope_guard(scope):
+                while True:
+                    v, ok = channel_recv(feed_ch)
+                    if not ok:
+                        break
+                    o, = exe.run(main, feed={"x": v}, fetch_list=[y])
+                    out_ch.send(np.asarray(o))
+                out_ch.close()
+
+        g = Go(trainer)
+        for i in range(3):
+            feed_ch.send(np.full((1, 4), float(i), np.float32))
+        feed_ch.close()
+        outs = []
+        while True:
+            v, ok = channel_recv(out_ch)
+            if not ok:
+                break
+            outs.append(float(v[0, 0]))
+        g.join(30)
+    assert outs == [0.0, 3.0, 6.0]
+
+
+def test_close_releases_blocked_unbuffered_sender():
+    ch = make_channel(capacity=0)
+    errs = []
+
+    def sender():
+        try:
+            ch.send(1)
+        except ChannelClosed:
+            errs.append("closed")
+
+    g = Go(sender)
+    time.sleep(0.05)
+    ch.close()
+    g.join(5)
+    assert errs == ["closed"]
+
+
+def test_select_send_on_unbuffered_with_waiting_receiver():
+    ch = make_channel(capacity=0)
+    got = []
+
+    def receiver():
+        got.append(ch.recv())
+
+    g = Go(receiver)
+    time.sleep(0.05)  # receiver parked in recv
+    hit = []
+    Select([("send", ch, 5, lambda: hit.append("sent"))]).run(timeout=2)
+    g.join(5)
+    assert hit == ["sent"] and got == [5]
+
+
+def test_select_timeout_zero_polls_once():
+    ch = make_channel(capacity=1)
+    with pytest.raises(TimeoutError):
+        Select([("recv", ch, lambda v: v)]).run(timeout=0)
+
+
+# ------------------------------ eager tape ------------------------------
+
+def test_eager_ops_execute_immediately():
+    t = eager.Tape()
+    x = eager.Variable(np.asarray([[1.0, 2.0]], np.float32))
+    w = eager.Variable(np.asarray([[1.0], [1.0]], np.float32))
+    out = t.run_op("mul", {"X": x, "Y": w},
+                   {"x_num_col_dims": 1, "y_num_col_dims": 1})["Out"]
+    np.testing.assert_allclose(out.numpy(), [[3.0]])
+    assert len(t.records) == 1
+
+
+def test_eager_tape_backward_matches_analytic():
+    t = eager.Tape()
+    rng = np.random.RandomState(0)
+    xv = rng.randn(4, 3).astype(np.float32)
+    wv = rng.randn(3, 2).astype(np.float32)
+    bv = rng.randn(2).astype(np.float32)
+    x = eager.Variable(xv)
+    w = eager.Variable(wv, trainable=True)
+    b = eager.Variable(bv, trainable=True)
+    h = eager.fc_like(x, w, b, tape=t)
+    sq = t.run_op("square", {"X": h})["Out"]
+    loss = t.run_op("mean", {"X": sq})["Out"]
+    t.backward(loss)
+    # d mean((xw+b)^2): pin against jax.grad of the same computation
+    # (matmul precision differs from numpy on some backends); the
+    # analytic value 2 x^T (xw+b) / numel agrees to that precision
+    import jax
+    import jax.numpy as jnp
+
+    def f(w_, b_):
+        return jnp.mean(jnp.square(xv @ w_ + b_))
+
+    gw, gb = jax.grad(f, argnums=(0, 1))(wv, bv)
+    np.testing.assert_allclose(np.asarray(w.grad), np.asarray(gw),
+                               rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(b.grad), np.asarray(gb),
+                               rtol=1e-6)
+    pre = xv @ wv + bv
+    np.testing.assert_allclose(np.asarray(w.grad),
+                               2 * xv.T @ pre / pre.size, rtol=2e-2,
+                               atol=1e-2)
+
+
+def test_eager_stochastic_ops_vary_and_stop_recording():
+    t = eager.Tape(seed=3)
+    x = eager.Variable(np.ones((64, 64), np.float32))
+    d1 = t.run_op("dropout", {"X": x},
+                  {"dropout_prob": 0.5})["Out"]
+    d2 = t.run_op("dropout", {"X": x},
+                  {"dropout_prob": 0.5})["Out"]
+    # distinct keys per call: masks differ
+    assert not np.array_equal(d1.numpy(), d2.numpy())
+    with t.stop_recording():
+        untaped = t.run_op("square", {"X": x})["Out"]
+    assert untaped.numpy().shape == (64, 64)
+    assert all(r.op_type == "dropout" for r in t.records)
+
+
+def test_eager_sgd_training_loop():
+    """Define-by-run training: fresh tape per step, manual sgd update."""
+    rng = np.random.RandomState(1)
+    w_true = rng.randn(5, 1).astype(np.float32)
+    w = eager.Variable(np.zeros((5, 1), np.float32), trainable=True)
+    losses = []
+    for _ in range(40):
+        t = eager.Tape()
+        xv = rng.randn(16, 5).astype(np.float32)
+        yv = xv @ w_true
+        x = eager.Variable(xv)
+        y = eager.Variable(yv)
+        pred = eager.fc_like(x, w, tape=t)
+        diff = t.run_op("elementwise_sub",
+                        {"X": pred, "Y": y})["Out"]
+        loss = t.run_op("mean", {"X": t.run_op(
+            "square", {"X": diff})["Out"]})["Out"]
+        t.backward(loss)
+        w.value = w.value - 0.1 * w.grad
+        losses.append(float(loss.numpy()))
+    assert losses[-1] < losses[0] * 1e-3
+    np.testing.assert_allclose(np.asarray(w.value), w_true, atol=0.05)
