@@ -20,7 +20,8 @@ import numpy as np
 from paddle_tpu.core.lowering import Ins, LoweringContext
 from paddle_tpu.core.registry import get_op_info
 
-__all__ = ["Variable", "Tape", "default_tape", "op", "fc_like"]
+__all__ = ["Variable", "Tape", "default_tape",
+           "reset_default_tape", "op", "fc_like"]
 
 
 class Variable:
@@ -143,6 +144,14 @@ class Tape:
                         leaves.append(v)
         if not leaves:
             return []
+        recorded_out_ids = {id(v) for rec in self.records
+                            for vs in rec.outs.values()
+                            for v in vs if v is not None}
+        if id(loss) not in recorded_out_ids:
+            raise ValueError(
+                "loss %r is not an output of any op recorded on this "
+                "tape (was it computed under stop_recording(), on a "
+                "different tape, or is it a leaf?)" % loss.name)
 
         def replay(leaf_vals):
             from paddle_tpu.core.lowering import _Counter
@@ -178,6 +187,10 @@ class Tape:
 
     def reset(self):
         self.records = []
+        # restart the key counter with the records: replay always counts
+        # from 0, so a live counter that kept running would desync
+        # stochastic ops recorded after the reset
+        self._live_counter = None
 
 
 _default = Tape()
@@ -187,8 +200,18 @@ def default_tape():
     return _default
 
 
+def reset_default_tape():
+    """Drop the default tape's history (it grows without bound
+    otherwise: records pin their arrays and backward() replays the
+    whole history).  Training loops should prefer one fresh Tape per
+    step, like the reference tape's pop-on-backward."""
+    _default.reset()
+
+
 def op(op_type, ins, attrs=None, tape=None):
-    """Module-level eager op call on the default tape."""
+    """Module-level eager op call on the default tape.  NB: the default
+    tape records forever — call reset_default_tape() between steps, or
+    pass a per-step Tape."""
     return (tape or _default).run_op(op_type, ins, attrs)
 
 

@@ -15,6 +15,7 @@ program—in a daemon thread."""
 from __future__ import annotations
 
 import threading
+import time as _time
 
 __all__ = ["Channel", "ChannelClosed", "Go", "make_channel",
            "channel_send", "channel_recv", "channel_close", "Select"]
@@ -57,8 +58,13 @@ class Channel:
                 self._buf.append(item)
                 self._not_empty.notify()
             else:
+                deadline = (None if timeout is None
+                            else _time.monotonic() + timeout)
                 while len(self._buf) >= self.capacity:
-                    if not self._not_full.wait(timeout):
+                    remaining = (None if deadline is None
+                                 else deadline - _time.monotonic())
+                    if remaining is not None and remaining <= 0 or \
+                            not self._not_full.wait(remaining):
                         raise TimeoutError("channel send timed out")
                     if self._closed:
                         raise ChannelClosed("send on closed channel")
@@ -88,8 +94,10 @@ class Channel:
                     raise TimeoutError("channel recv timed out")
             item = self._buf.pop(0)
             self._not_full.notify()
+            if isinstance(item, _Rendezvous):
+                item.ready.set()   # under the lock: poll_send's
+                # taken-check relies on pop & set being atomic
         if isinstance(item, _Rendezvous):
-            item.ready.set()
             return item.value
         return item
 
@@ -115,12 +123,13 @@ class Channel:
             if self._buf:
                 item = self._buf.pop(0)
                 self._not_full.notify()
+                if isinstance(item, _Rendezvous):
+                    item.ready.set()
             elif self._closed:
                 raise ChannelClosed("recv on closed, drained channel")
             else:
                 return False, None
         if isinstance(item, _Rendezvous):
-            item.ready.set()
             return True, item.value
         return True, item
 
@@ -146,7 +155,9 @@ class Channel:
             if item in self._buf:
                 self._buf.remove(item)
                 return False
-        return item.ready.wait(0.1) and not item.closed
+            # gone from the buffer: pop+ready.set happen atomically
+            # under this lock, so delivery status is already decided
+            return item.ready.is_set() and not item.closed
 
 
 def make_channel(dtype=None, capacity=0):

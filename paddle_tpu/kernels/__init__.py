@@ -1,0 +1,8 @@
+"""Hand-written Pallas TPU kernels for the few ops where explicit
+tiling beats XLA's fusion (SURVEY stage 7: the paddle/math +
+paddle/function rewrite targets).  Every kernel has an XLA fallback —
+``interpret=True`` paths keep CPU tests exact."""
+from .flash_attention import flash_attention  # noqa: F401
+from .fused import fused_softmax_cross_entropy  # noqa: F401
+
+__all__ = ["flash_attention", "fused_softmax_cross_entropy"]

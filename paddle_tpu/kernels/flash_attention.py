@@ -1,0 +1,175 @@
+"""Flash attention (Pallas TPU kernel).
+
+The long-context hot path: computes softmax(QK^T * scale [+ causal
+mask]) V without materializing the [T, T] score matrix in HBM.  Q is
+tiled over the grid; K/V stream through VMEM tiles with the online-
+softmax running max/sum rescale (Dao et al. 2022; same math as
+parallel/ring.py's per-chunk accumulator, here per-tile inside one
+chip).
+
+Role parity: reference operators fuse nothing here — attention in the
+reference book models is separate matmul/softmax ops; this kernel is
+the TPU-native replacement for that op chain at long sequence length.
+
+Interface: [B, H, T, D] (batch, heads, time, head_dim).  Falls back to
+the identical-math XLA implementation off-TPU (or under
+``force_xla=True``).
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+
+__all__ = ["flash_attention"]
+
+NEG_INF = -1e30
+
+
+def target_platform():
+    """Platform the computation will actually run on: the executor pins
+    non-mesh runs with jax.default_device (visible in config even during
+    tracing); plain jax.devices()[0] would report the attached TPU even
+    for CPU-pinned programs."""
+    dev = jax.config.jax_default_device
+    if dev is not None:
+        return dev.platform
+    return jax.devices()[0].platform
+
+
+def _attention_xla(q, k, v, scale, causal):
+    s = jnp.einsum("bhtd,bhsd->bhts", q, k) * scale
+    if causal:
+        t, srcs = s.shape[-2], s.shape[-1]
+        mask = jnp.tril(jnp.ones((t, srcs), bool))
+        s = jnp.where(mask, s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhts,bhsd->bhtd", p, v)
+
+
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref, *,
+                  scale, causal, block_q, block_k, n_k):
+    # grid (bh, qi, ki); ki is the innermost SEQUENTIAL axis, so the
+    # VMEM scratch (running max/sum/accumulator) carries across K tiles
+    # while K/V stream block_k rows at a time.
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    if causal:
+        # whole K tile above the diagonal: nothing to add
+        live = ki * block_k <= qi * block_q + block_q - 1
+    else:
+        live = True
+
+    @pl.when(live)
+    def _step():
+        q = q_ref[...].astype(jnp.float32) * scale
+        k = k_ref[...].astype(jnp.float32)
+        v = v_ref[...].astype(jnp.float32)
+        s = q @ k.T                                   # [bq, bk]
+        if causal:
+            q_pos = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, s.shape, 0)
+            k_pos = ki * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, s.shape, 1)
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        m_prev = m_ref[...][:, 0]
+        m_new = jnp.maximum(m_prev, s.max(axis=1))
+        p = jnp.exp(s - m_new[:, None])
+        alpha = jnp.exp(m_prev - m_new)
+        l_ref[...] = (l_ref[...][:, 0] * alpha +
+                      p.sum(axis=1))[:, None]
+        acc_ref[...] = acc_ref[...] * alpha[:, None] + p @ v
+        m_ref[...] = m_new[:, None]
+
+    @pl.when(ki == n_k - 1)
+    def _done():
+        o_ref[...] = (acc_ref[...] /
+                      l_ref[...][:, 0][:, None]).astype(o_ref.dtype)
+
+
+def flash_attention(q, k, v, scale=None, causal=False, block_q=512,
+                    block_k=512, force_xla=False, interpret=False):
+    """softmax(QK^T scale) V, [B,H,T,D] in/out.
+
+    Uses the Pallas kernel on TPU when T divides into the block sizes;
+    anything else takes the XLA path (same math, fp32 accumulation).
+    Differentiable: the backward pass is the XLA attention vjp (flash
+    forward saves the [T,T] HBM materialization; backward re-derives it
+    as XLA's own attention grad would)."""
+    b, h, t, d = q.shape
+    if scale is None:
+        scale = 1.0 / np.sqrt(d)
+    on_tpu = target_platform() == "tpu"
+    block_q = min(block_q, t)
+    block_k = min(block_k, t)
+    usable = (t % block_q == 0 and t % block_k == 0)
+    if force_xla or not usable or not (on_tpu or interpret):
+        return _attention_xla(q, k, v, scale, causal)
+    return _flash_diff(q, k, v, scale, causal, block_q, block_k,
+                       interpret)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash_diff(q, k, v, scale, causal, block_q, block_k, interpret):
+    return _flash_pallas(q, k, v, scale, causal, block_q, block_k,
+                         interpret)
+
+
+def _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret):
+    return _flash_pallas(q, k, v, scale, causal, block_q, block_k,
+                         interpret), (q, k, v)
+
+
+def _flash_bwd(scale, causal, block_q, block_k, interpret, res, g):
+    q, k, v = res
+    _, vjp = jax.vjp(
+        lambda q_, k_, v_: _attention_xla(q_, k_, v_, scale, causal),
+        q, k, v)
+    return vjp(g)
+
+
+_flash_diff.defvjp(_flash_fwd, _flash_bwd)
+
+
+def _flash_pallas(q, k, v, scale, causal, block_q, block_k, interpret):
+    from jax.experimental.pallas import tpu as pltpu
+
+    b, h, t, d = q.shape
+    qf = q.reshape(b * h, t, d)
+    kf = k.reshape(b * h, t, d)
+    vf = v.reshape(b * h, t, d)
+    n_k = t // block_k
+    kernel = functools.partial(_flash_kernel, scale=scale, causal=causal,
+                               block_q=block_q, block_k=block_k, n_k=n_k)
+    out = pl.pallas_call(
+        kernel,
+        grid=(b * h, t // block_q, n_k),
+        in_specs=[
+            pl.BlockSpec((None, block_q, d),
+                         lambda bh, qi, ki: (bh, qi, 0)),
+            pl.BlockSpec((None, block_k, d),
+                         lambda bh, qi, ki: (bh, ki, 0)),
+            pl.BlockSpec((None, block_k, d),
+                         lambda bh, qi, ki: (bh, ki, 0)),
+        ],
+        out_specs=pl.BlockSpec((None, block_q, d),
+                               lambda bh, qi, ki: (bh, qi, 0)),
+        out_shape=jax.ShapeDtypeStruct((b * h, t, d), q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, 1), jnp.float32),
+                        pltpu.VMEM((block_q, 1), jnp.float32),
+                        pltpu.VMEM((block_q, d), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=interpret,
+    )(qf, kf, vf)
+    return out.reshape(b, h, t, d)

@@ -1,0 +1,99 @@
+"""Pallas TPU kernels, validated in interpret mode on CPU against the
+same-math XLA paths (flash attention: Dao et al. online softmax;
+fused softmax+CE: one-pass logsumexp+pick)."""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.kernels import (flash_attention,
+                                fused_softmax_cross_entropy)
+from paddle_tpu.kernels.flash_attention import _attention_xla
+from paddle_tpu.kernels.fused import _xla_path
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_attention_matches_xla(causal):
+    rng = np.random.RandomState(0)
+    b, h, t, d = 2, 3, 256, 32
+    q = jnp.asarray(rng.randn(b, h, t, d), jnp.float32) * 0.2
+    k = jnp.asarray(rng.randn(b, h, t, d), jnp.float32) * 0.2
+    v = jnp.asarray(rng.randn(b, h, t, d), jnp.float32) * 0.2
+    got = flash_attention(q, k, v, causal=causal, block_q=64,
+                          block_k=64, interpret=True)
+    want = _attention_xla(q, k, v, 1.0 / np.sqrt(d), causal)
+    # this host's CPU matmuls run reduced precision (both paths), so
+    # different blockings diverge at ~1e-3 absolute
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-2, atol=2e-3)
+
+
+def test_flash_attention_grads_match_xla():
+    rng = np.random.RandomState(1)
+    b, h, t, d = 1, 2, 128, 16
+    q = jnp.asarray(rng.randn(b, h, t, d), jnp.float32) * 0.2
+    k = jnp.asarray(rng.randn(b, h, t, d), jnp.float32) * 0.2
+    v = jnp.asarray(rng.randn(b, h, t, d), jnp.float32) * 0.2
+
+    def loss_flash(q, k, v):
+        return flash_attention(q, k, v, causal=True, block_q=64,
+                               block_k=64, interpret=True).sum()
+
+    def loss_xla(q, k, v):
+        return _attention_xla(q, k, v, 1.0 / np.sqrt(d), True).sum()
+
+    gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    gx = jax.grad(loss_xla, argnums=(0, 1, 2))(q, k, v)
+    for a, b_ in zip(gf, gx):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                   rtol=2e-4, atol=2e-5)
+
+
+def test_flash_attention_fallback_on_odd_shapes():
+    rng = np.random.RandomState(2)
+    q = jnp.asarray(rng.randn(1, 1, 100, 16), jnp.float32)  # 100 % 64 != 0
+    out = flash_attention(q, q, q, causal=False, interpret=True)
+    want = _attention_xla(q, q, q, 0.25, False)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(want),
+                               rtol=2e-2, atol=5e-3)
+
+
+def test_fused_ce_matches_xla():
+    rng = np.random.RandomState(3)
+    n, c = 64, 4096
+    logits = jnp.asarray(rng.randn(n, c), jnp.float32)
+    labels = jnp.asarray(rng.randint(0, c, n), jnp.int32)
+    got = fused_softmax_cross_entropy(logits, labels, block_n=16,
+                                      block_c=512, interpret=True)
+    want = _xla_path(logits, labels)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_ring_attention_op_dense_path_uses_flash_fallback():
+    """The ring_attention op's dense path routes through
+    flash_attention (XLA fallback off-TPU) and stays trainable."""
+    import paddle_tpu.fluid as fluid
+
+    main, startup = fluid.Program(), fluid.Program()
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        with fluid.program_guard(main, startup):
+            with fluid.unique_name.guard():
+                x = fluid.layers.data(name="x", shape=[2, 64, 16],
+                                      dtype="float32")
+                helper = fluid.layer_helper.LayerHelper("attn")
+                out_v = helper.create_tmp_variable("float32")
+                helper.append_op(type="ring_attention",
+                                 inputs={"Q": [x], "K": [x], "V": [x]},
+                                 outputs={"Out": [out_v]},
+                                 attrs={"causal": True})
+                loss = fluid.layers.mean(out_v)
+                fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        xv = np.random.RandomState(0).randn(2, 2, 64, 16).astype(
+            np.float32)
+        l, = exe.run(main, feed={"x": xv}, fetch_list=[loss])
+        assert np.isfinite(np.asarray(l)).all()
