@@ -86,11 +86,16 @@ class Channel:
     def recv(self, timeout=None):
         """Blocks while empty; raises ChannelClosed once closed AND
         drained (Go's `v, ok := <-ch` with ok=False)."""
+        deadline = (None if timeout is None
+                    else _time.monotonic() + timeout)
         with self._lock:
             while not self._buf:
                 if self._closed:
                     raise ChannelClosed("recv on closed, drained channel")
-                if not self._not_empty.wait(timeout):
+                remaining = (None if deadline is None
+                             else deadline - _time.monotonic())
+                if remaining is not None and remaining <= 0 or \
+                        not self._not_empty.wait(remaining):
                     raise TimeoutError("channel recv timed out")
             item = self._buf.pop(0)
             self._not_full.notify()

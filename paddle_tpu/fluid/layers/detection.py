@@ -13,7 +13,7 @@ from ..layer_helper import LayerHelper
 
 __all__ = ["prior_box", "multi_box_head", "bipartite_match",
            "target_assign", "box_coder", "iou_similarity", "ssd_loss",
-           "detection_output", "multiclass_nms"]
+           "detection_output", "multiclass_nms", "detection_map"]
 
 
 def iou_similarity(x, y, name=None):
@@ -265,3 +265,21 @@ def detection_output(loc, scores, prior_box, prior_box_var,
     return multiclass_nms(decoded, scores_t, background_label,
                           score_threshold, nms_top_k, nms_threshold,
                           keep_top_k, nms_eta)
+
+
+def detection_map(detect_res, label, class_num, background_label=0,
+                  overlap_threshold=0.5, ap_version="integral",
+                  name=None):
+    """mAP over a batch (reference detection.py:157 /
+    detection_map_op.cc)."""
+    helper = LayerHelper("detection_map", **locals())
+    map_out = helper.create_tmp_variable(dtype="float32")
+    helper.append_op(
+        type="detection_map",
+        inputs={"DetectRes": [detect_res], "Label": [label]},
+        outputs={"MAP": [map_out]},
+        attrs={"class_num": int(class_num),
+               "background_label": int(background_label),
+               "overlap_threshold": float(overlap_threshold),
+               "ap_version": ap_version})
+    return map_out

@@ -107,12 +107,13 @@ def flash_attention(q, k, v, scale=None, causal=False, block_q=512,
     forward saves the [T,T] HBM materialization; backward re-derives it
     as XLA's own attention grad would)."""
     b, h, t, d = q.shape
+    tk = k.shape[2]
     if scale is None:
         scale = 1.0 / np.sqrt(d)
     on_tpu = target_platform() == "tpu"
     block_q = min(block_q, t)
-    block_k = min(block_k, t)
-    usable = (t % block_q == 0 and t % block_k == 0)
+    block_k = min(block_k, tk)
+    usable = (t % block_q == 0 and tk % block_k == 0)
     if force_xla or not usable or not (on_tpu or interpret):
         return _attention_xla(q, k, v, scale, causal)
     return _flash_diff(q, k, v, scale, causal, block_q, block_k,
@@ -145,10 +146,11 @@ def _flash_pallas(q, k, v, scale, causal, block_q, block_k, interpret):
     from jax.experimental.pallas import tpu as pltpu
 
     b, h, t, d = q.shape
+    tk = k.shape[2]            # K/V may be longer/shorter than Q
     qf = q.reshape(b * h, t, d)
-    kf = k.reshape(b * h, t, d)
-    vf = v.reshape(b * h, t, d)
-    n_k = t // block_k
+    kf = k.reshape(b * h, tk, d)
+    vf = v.reshape(b * h, tk, d)
+    n_k = tk // block_k
     kernel = functools.partial(_flash_kernel, scale=scale, causal=causal,
                                block_q=block_q, block_k=block_k, n_k=n_k)
     out = pl.pallas_call(

@@ -22,6 +22,21 @@ import numpy as np
 from paddle_tpu.core.registry import register_op
 from paddle_tpu.ops.io_ops import _host
 
+_MISSING = object()
+
+
+def _read_host(scope, feed, env, name, default=_MISSING):
+    """env -> feed -> scope lookup shared by the host detection ops."""
+    for src_ in (env, feed):
+        if src_ is not None and name in src_:
+            return np.asarray(src_[name])
+    try:
+        return np.asarray(scope.find_var(name))
+    except KeyError:
+        if default is not _MISSING:
+            return default
+        raise
+
 
 # ---------------------------------------------------------------------------
 # prior_box
@@ -333,10 +348,7 @@ def _multiclass_nms(executor, op, scope, feed, env=None):
     [label, score, xmin, ymin, xmax, ymax]; '@ROWS' var holds the
     per-image detection counts (the LoD analog)."""
     def read(name):
-        for src in (env, feed):
-            if src is not None and name in src:
-                return np.asarray(src[name])
-        return np.asarray(scope.find_var(name))
+        return _read_host(scope, feed, env, name)
 
     bboxes = read(op.input("BBoxes")[0])
     scores = read(op.input("Scores")[0])
@@ -392,3 +404,103 @@ def _gather_encoded_target(ctx, ins, attrs, op=None):
     matched = (match >= 0)[:, :, None]
     out = jnp.where(matched, out, 0.0)
     return {"Out": out, "OutWeight": matched.astype(jnp.float32)}
+
+
+@_host("detection_map")
+def _detection_map(executor, op, scope, feed, env=None):
+    """mAP metric (reference detection_map_op.cc, CPU-only there too).
+    DetectRes: [No, 6] rows [label, score, x0, y0, x1, y1] with
+    '<name>@ROWS' per-image counts (multiclass_nms's output layout).
+    Label: padded [B, G, 5] rows [label, x0, y0, x1, y1] with '@LEN'.
+    Outputs MAP [1] (11point or integral ap_version).  The reference's
+    cross-batch accumulator inputs are subsumed by metrics.DetectionMAP
+    accumulating host-side."""
+    def read(name, **kw):
+        return _read_host(scope, feed, env, name, **kw)
+
+    det_name = op.input("DetectRes")[0]
+    det = read(det_name)
+    label = read(op.input("Label")[0])
+    rows = read(det_name + "@ROWS", default=None)
+    if rows is None:
+        if label.shape[0] != 1:
+            raise ValueError(
+                "detection_map: %r has no '@ROWS' sidecar but the "
+                "label batch has %d images — per-image detection "
+                "counts are required (multiclass_nms emits them)" %
+                (det_name, label.shape[0]))
+        rows = np.asarray([det.shape[0]])
+    glens = read(op.input("Label")[0] + "@LEN",
+                 default=np.full((label.shape[0],), label.shape[1]))
+    class_num = int(op.attr("class_num"))
+    background = int(op.attr("background_label", 0))
+    thresh = float(op.attr("overlap_threshold", 0.5))
+    ap_version = op.attr("ap_version", "integral")
+
+    # split detections per image
+    offs = np.concatenate([[0], np.cumsum(rows)])
+    n_imgs = len(rows)
+    # collect (score, is_tp) per class + gt count per class
+    scored = {c: [] for c in range(class_num)}
+    n_gt = np.zeros(class_num, np.int64)
+    for b in range(n_imgs):
+        dets_b = det[offs[b]:offs[b + 1]]
+        gts_b = label[b, :int(glens[b])]
+        for g in gts_b:
+            if int(g[0]) != background:
+                n_gt[int(g[0])] += 1
+        used = np.zeros(len(gts_b), bool)
+        # match detections best-first within their class
+        for row in dets_b[np.argsort(-dets_b[:, 1])]:
+            c = int(row[0])
+            if c == background:
+                continue
+            best, best_iou = -1, thresh
+            for gi, g in enumerate(gts_b):
+                if used[gi] or int(g[0]) != c:
+                    continue
+                ix0 = max(row[2], g[1]); iy0 = max(row[3], g[2])
+                ix1 = min(row[4], g[3]); iy1 = min(row[5], g[4])
+                inter = max(ix1 - ix0, 0) * max(iy1 - iy0, 0)
+                ua = ((row[4] - row[2]) * (row[5] - row[3]) +
+                      (g[3] - g[1]) * (g[4] - g[2]) - inter)
+                iou = inter / ua if ua > 0 else 0.0
+                if iou >= best_iou:
+                    best, best_iou = gi, iou
+            if best >= 0:
+                used[best] = True
+                scored[c].append((row[1], 1))
+            else:
+                scored[c].append((row[1], 0))
+
+    aps = []
+    for c in range(class_num):
+        if c == background or n_gt[c] == 0:
+            continue
+        hits = sorted(scored[c], reverse=True)
+        tp = np.cumsum([h[1] for h in hits]) if hits else np.zeros(0)
+        fp = np.cumsum([1 - h[1] for h in hits]) if hits else \
+            np.zeros(0)
+        recall = tp / n_gt[c] if len(tp) else np.zeros(0)
+        precision = tp / np.maximum(tp + fp, 1e-9) if len(tp) else \
+            np.zeros(0)
+        if ap_version == "11point":
+            ap = 0.0
+            for t in np.linspace(0, 1, 11):
+                p = precision[recall >= t].max() if \
+                    (recall >= t).any() else 0.0
+                ap += p / 11.0
+        else:  # integral
+            ap = 0.0
+            prev_r = 0.0
+            for r, p in zip(recall, precision):
+                ap += (r - prev_r) * p
+                prev_r = r
+        aps.append(ap)
+    m = float(np.mean(aps)) if aps else 0.0
+
+    out_name = op.output("MAP")[0]
+    val = np.asarray([m], np.float32)
+    if env is not None:
+        env[out_name] = val
+    (scope.find_scope_of(out_name) or scope).set(out_name, val)

@@ -1,0 +1,47 @@
+"""v2-era API compatibility shim.
+
+Parity: reference python/paddle/v2 (init, batch, reader, dataset,
+minibatch iteration).  The v2 layer/trainer surface predates Fluid and
+the reference itself was migrating off it (python/paddle/v2/__init__.py
+deprecation path); per SURVEY's translation its capability is carried
+by the fluid API here.  This shim keeps the v2 *data* utilities —
+which survived into the fluid workflow unchanged — importable under
+their old names, and points the graph-building entry points at their
+fluid successors instead of silently half-working.
+"""
+from __future__ import annotations
+
+from paddle_tpu import batch  # noqa: F401  (paddle.v2.batch == paddle.batch)
+from paddle_tpu import dataset  # noqa: F401
+from paddle_tpu import reader  # noqa: F401
+
+__all__ = ["init", "batch", "reader", "dataset", "infer"]
+
+_initialized = False
+
+
+def init(use_gpu=False, trainer_count=1, **kwargs):
+    """v2 bootstrap (reference v2/__init__.py init: parses flags, seeds
+    devices).  Device selection happens per-Executor here; this records
+    the call and validates the arguments."""
+    global _initialized
+    if trainer_count < 1:
+        raise ValueError("trainer_count must be >= 1")
+    _initialized = True
+
+
+def infer(output_layer=None, parameters=None, input=None, **kwargs):
+    raise NotImplementedError(
+        "the v2 trainer/infer graph API was superseded by fluid before "
+        "the reference snapshot; build the model with paddle_tpu.fluid "
+        "and serve it with paddle_tpu.inference.create_paddle_predictor")
+
+
+def __getattr__(name):
+    if name in ("layer", "trainer", "optimizer", "parameters",
+                "networks", "activation", "pooling", "attr"):
+        raise AttributeError(
+            "paddle_tpu.v2.%s: the v2 graph API is superseded — use "
+            "paddle_tpu.fluid.layers / fluid.optimizer / fluid.Trainer "
+            "(see SURVEY translation of the v2 stack)" % name)
+    raise AttributeError(name)

@@ -246,3 +246,67 @@ def test_detection_output_end_to_end(prog_scope, exe):
     # highest-confidence non-background: class1@prior0 (0.8)
     np.testing.assert_allclose(got[0, :2], [1.0, 0.8])
     np.testing.assert_allclose(got[0, 2:], priors[0], atol=1e-6)
+
+
+def test_detection_map_hand_computed():
+    """2 images, 2 classes; hand-computed integral AP."""
+    from paddle_tpu.core.lod import LoDTensor
+    main, startup = fluid.Program(), fluid.Program()
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        with fluid.program_guard(main, startup):
+            with fluid.unique_name.guard():
+                det = layers.data(name="det", shape=[6], dtype="float32",
+                                  append_batch_size=False)
+                lab = layers.data(name="lab", shape=[5], lod_level=1,
+                                  dtype="float32")
+                m = layers.detection.detection_map(det, lab,
+                                                   class_num=2)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        # image 0: one gt class0 at [0,0,1,1]; detections: a hit (0.9)
+        # and a miss (0.8).  image 1: one gt class1, detection hits.
+        detv = np.asarray([
+            [0, 0.9, 0.0, 0.0, 1.0, 1.0],    # tp class0
+            [0, 0.8, 5.0, 5.0, 6.0, 6.0],    # fp class0
+            [1, 0.7, 0.0, 0.0, 1.0, 1.0],    # tp class1
+        ], np.float32)
+        scope.set("det@ROWS", np.asarray([2, 1], np.int64))
+        labv = LoDTensor.from_sequences([
+            np.asarray([[0, 0, 0, 1, 1]], np.float32),
+            np.asarray([[1, 0, 0, 1, 1]], np.float32)])
+        got, = exe.run(main, feed={"det": detv, "lab": labv},
+                       fetch_list=[m])
+    # class0: precision-at-recall steps: tp@0.9 -> r=1, p=1; fp after.
+    # integral AP = 1.0.  class1: AP = 1.0.  mAP = 1.0
+    np.testing.assert_allclose(float(np.ravel(got)[0]), 1.0)
+
+
+def test_detection_map_half():
+    from paddle_tpu.core.lod import LoDTensor
+    main, startup = fluid.Program(), fluid.Program()
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        with fluid.program_guard(main, startup):
+            with fluid.unique_name.guard():
+                det = layers.data(name="det", shape=[6], dtype="float32",
+                                  append_batch_size=False)
+                lab = layers.data(name="lab", shape=[5], lod_level=1,
+                                  dtype="float32")
+                m = layers.detection.detection_map(det, lab,
+                                                   class_num=2)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        # 2 gts (class 1; class 0 is background and excluded), detection
+        # hits one with the HIGHER-scored being a miss:
+        # hits order: fp(0.9), tp(0.8) -> recall .5 at precision .5
+        detv = np.asarray([
+            [1, 0.9, 5, 5, 6, 6],
+            [1, 0.8, 0, 0, 1, 1],
+        ], np.float32)
+        scope.set("det@ROWS", np.asarray([2], np.int64))
+        labv = LoDTensor.from_sequences([
+            np.asarray([[1, 0, 0, 1, 1], [1, 2, 2, 3, 3]], np.float32)])
+        got, = exe.run(main, feed={"det": detv, "lab": labv},
+                       fetch_list=[m])
+    np.testing.assert_allclose(float(np.ravel(got)[0]), 0.25, atol=1e-6)

@@ -1,0 +1,74 @@
+"""Inference predictor API (reference paddle/contrib/inference/
+paddle_inference_api.h: PaddleTensor/NativeConfig/AnalysisConfig/
+create_paddle_predictor, Run/Clone contract)."""
+import numpy as np
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.inference import (AnalysisConfig, NativeConfig,
+                                  PaddleTensor, create_paddle_predictor)
+
+layers = fluid.layers
+
+
+def _save_model(tmp_path):
+    main, startup = fluid.Program(), fluid.Program()
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        with fluid.program_guard(main, startup):
+            with fluid.unique_name.guard():
+                img = layers.data(name="img", shape=[3, 8, 8],
+                                  dtype="float32")
+                conv = layers.conv2d(img, num_filters=4, filter_size=3,
+                                     padding=1, bias_attr=True)
+                bn = layers.batch_norm(conv, is_test=True)
+                out = layers.fc(layers.relu(bn), size=5, act="softmax")
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        d = str(tmp_path / "model")
+        fluid.io.save_inference_model(d, ["img"], [out], exe,
+                                      main_program=main)
+        xv = np.random.RandomState(0).rand(2, 3, 8, 8).astype(
+            np.float32)
+        want, = exe.run(main, feed={"img": xv}, fetch_list=[out])
+    return d, xv, np.asarray(want)
+
+
+def test_native_predictor_matches_executor(tmp_path):
+    d, xv, want = _save_model(tmp_path)
+    pred = create_paddle_predictor(NativeConfig(model_dir=d))
+    outs = pred.run([PaddleTensor(name="img", data=xv)])
+    assert len(outs) == 1
+    np.testing.assert_allclose(outs[0].data, want, rtol=1e-5,
+                               atol=1e-6)
+    # dict-feed form and positional (unnamed) form
+    outs2 = pred.run({"img": xv})
+    np.testing.assert_allclose(outs2[0].data, want, rtol=1e-5,
+                               atol=1e-6)
+    outs3 = pred.run([PaddleTensor(data=xv)])
+    np.testing.assert_allclose(outs3[0].data, want, rtol=1e-5,
+                               atol=1e-6)
+
+
+def test_analysis_predictor_folds_bn(tmp_path):
+    d, xv, want = _save_model(tmp_path)
+    pred = create_paddle_predictor(
+        AnalysisConfig(model_dir=d, fold_batch_norm=True))
+    n_bn = sum(1 for op in pred.program.desc.blocks[0].ops
+               if op.type == "batch_norm")
+    assert n_bn == 0  # folded away
+    outs = pred.run({"img": xv})
+    np.testing.assert_allclose(outs[0].data, want, rtol=1e-4,
+                               atol=1e-5)
+
+
+def test_predictor_clone_shares_weights(tmp_path):
+    d, xv, want = _save_model(tmp_path)
+    pred = create_paddle_predictor(NativeConfig(model_dir=d))
+    clone = pred.clone()
+    assert clone.scope is pred.scope  # weights shared
+    np.testing.assert_allclose(clone.run({"img": xv})[0].data, want,
+                               rtol=1e-5, atol=1e-6)
+    # missing feed errors clearly
+    import pytest
+    with pytest.raises(ValueError, match="missing feeds"):
+        pred.run({})

@@ -1,0 +1,21 @@
+"""v2 API shim (reference python/paddle/v2 data utilities)."""
+import pytest
+
+import paddle_tpu.v2 as paddle_v2
+
+
+def test_v2_data_utilities_alias():
+    paddle_v2.init(trainer_count=1)
+    r = paddle_v2.batch(lambda: iter(range(10)), 4)
+    assert list(r()) == [[0, 1, 2, 3], [4, 5, 6, 7]]
+    assert paddle_v2.dataset.mnist is not None
+    assert paddle_v2.reader.shuffle is not None
+
+
+def test_v2_graph_api_points_to_fluid():
+    with pytest.raises(AttributeError, match="superseded"):
+        paddle_v2.layer
+    with pytest.raises(NotImplementedError):
+        paddle_v2.infer()
+    with pytest.raises(ValueError):
+        paddle_v2.init(trainer_count=0)
