@@ -38,17 +38,40 @@ LOD_PAD_MULTIPLE = 8
 
 
 def _prepare_lod_feeds(feed):
-    """LoDTensor feeds -> padded dense array + '<name>@LEN' lengths."""
+    """LoDTensor feeds -> padded dense array + '<name>@LEN' lengths.
+    Level-2 LoD pads to [N, S, W, ...] with '@LEN' = outer sentence
+    lengths and '@LEN@1' = [N, S] inner sub-sequence lengths (reference
+    lod_tensor.h:58 hierarchical LoD)."""
     from .lod import LoDTensor
 
     for name, v in list(feed.items()):
-        if isinstance(v, LoDTensor) and v.lod:
-            lens = v.sequence_lengths(0)
-            t = max(lens) if lens else 1
-            t = -(-max(t, 1) // LOD_PAD_MULTIPLE) * LOD_PAD_MULTIPLE
-            padded, lengths = v.to_padded(max_len=t)
+        if not (isinstance(v, LoDTensor) and v.lod):
+            continue
+        if len(v.lod) > 2:
+            raise NotImplementedError(
+                "feeds with lod_level > 2 are not supported "
+                "(variable %r has %d levels)" % (name, len(v.lod)))
+        if len(v.lod) == 2:
+            # bucket both ragged dims so compiled shapes stay bounded
+            s_max = max(v.lod[0][i + 1] - v.lod[0][i]
+                        for i in range(len(v.lod[0]) - 1))
+            w_max = max((v.lod[1][j + 1] - v.lod[1][j]
+                         for j in range(len(v.lod[1]) - 1)), default=1)
+            s_max = -(-max(s_max, 1) // 4) * 4
+            w_max = -(-max(w_max, 1) // LOD_PAD_MULTIPLE) * \
+                LOD_PAD_MULTIPLE
+            padded, outer, inner = v.to_padded_2level(
+                max_seq=s_max, max_word=w_max)
             feed[name] = padded
-            feed[name + LEN_SUFFIX] = lengths.astype(np.int32)
+            feed[name + LEN_SUFFIX] = outer.astype(np.int32)
+            feed[name + LEN_SUFFIX + "@1"] = inner.astype(np.int32)
+            continue
+        lens = v.sequence_lengths(0)
+        t = max(lens) if lens else 1
+        t = -(-max(t, 1) // LOD_PAD_MULTIPLE) * LOD_PAD_MULTIPLE
+        padded, lengths = v.to_padded(max_len=t)
+        feed[name] = padded
+        feed[name + LEN_SUFFIX] = lengths.astype(np.int32)
     return feed
 
 
@@ -228,9 +251,10 @@ class ExecutorCore:
         # device-side length vector of every LoD input (SURVEY §5.7 —
         # ragged->dense bucketing bridge to XLA static shapes)
         for name in list(external):
-            if name + LEN_SUFFIX in feed and name + LEN_SUFFIX not in seen_ext:
-                seen_ext.add(name + LEN_SUFFIX)
-                external.append(name + LEN_SUFFIX)
+            for suffix in (LEN_SUFFIX, LEN_SUFFIX + "@1"):
+                if name + suffix in feed and name + suffix not in seen_ext:
+                    seen_ext.add(name + suffix)
+                    external.append(name + suffix)
 
         input_names = []
         for name in external:

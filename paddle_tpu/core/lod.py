@@ -68,6 +68,56 @@ class LoDTensor:
                 else np.zeros((0,), np.float32))
         return LoDTensor(data, [offs])
 
+    def to_padded_2level(self, pad_value=0.0, max_seq=None,
+                         max_word=None):
+        """Level-2 LoD -> ([N, S, W, D...], outer_lens [N],
+        inner_lens [N, S]).  N sentences of up to S sub-sequences of up
+        to W tokens — the nested analog of :meth:`to_padded` (reference
+        lod_tensor.h:58 hierarchical LoD).  max_seq/max_word truncate
+        (lengths report the truncated sizes)."""
+        if len(self.lod) != 2:
+            raise NotImplementedError(
+                "to_padded_2level needs exactly a level-2 LoD, got "
+                "%d levels" % len(self.lod))
+        data = np.asarray(self.data)
+        outer, inner = self.lod[0], self.lod[1]
+        n = len(outer) - 1
+        outer_lens = [outer[i + 1] - outer[i] for i in range(n)]
+        s = max_seq or (max(outer_lens) if outer_lens else 0)
+        inner_lens_all = [inner[j + 1] - inner[j]
+                          for j in range(len(inner) - 1)]
+        w = max_word or (max(inner_lens_all) if inner_lens_all else 0)
+        out = np.full((n, s, w) + data.shape[1:], pad_value,
+                      dtype=data.dtype)
+        inner_lens = np.zeros((n, s), np.int64)
+        for i in range(n):
+            for si, j in enumerate(range(outer[i], outer[i + 1])):
+                if si >= s:
+                    break                     # truncated by max_seq
+                seq = data[inner[j]:inner[j + 1]][:w]
+                out[i, si, : len(seq)] = seq
+                inner_lens[i, si] = len(seq)  # post-truncation length
+        outer_clipped = np.minimum(np.asarray(outer_lens, np.int64), s)
+        return out, outer_clipped, inner_lens
+
+    @staticmethod
+    def from_padded_2level(padded, outer_lens, inner_lens):
+        """Inverse of :meth:`to_padded_2level`."""
+        padded = np.asarray(padded)
+        outer_lens = np.asarray(outer_lens).reshape(-1)
+        inner_lens = np.asarray(inner_lens)
+        parts = []
+        outer_offs, inner_offs = [0], [0]
+        for i, ol in enumerate(outer_lens):
+            outer_offs.append(outer_offs[-1] + int(ol))
+            for si in range(int(ol)):
+                il = int(inner_lens[i, si])
+                inner_offs.append(inner_offs[-1] + il)
+                parts.append(padded[i, si, :il])
+        data = (np.concatenate(parts, axis=0) if parts
+                else padded.reshape((0,) + padded.shape[3:]))
+        return LoDTensor(data, [outer_offs, inner_offs])
+
     @staticmethod
     def from_padded(padded, lengths):
         padded = np.asarray(padded)

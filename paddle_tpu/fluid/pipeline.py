@@ -1,0 +1,227 @@
+"""Pipeline parallelism from the fluid front-end.
+
+The pp axis was previously reachable only through the raw-JAX GPipe
+utility (parallel/pipeline.py, homogeneous stages); this module makes a
+fluid Program pipeline-parallel: split the global block at user-chosen
+cut variables, place each stage's ops + parameters on its own device,
+and run a GPipe schedule (all microbatch forwards, then reversed
+backwards, grads accumulated) with per-stage jitted functions whose
+async dispatch overlaps across devices.
+
+No reference analog exists (pipeline arrived after the snapshot); this
+is a beyond-reference axis like sp/ep, SURVEY §2.5 row 52.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["PipelineProgram"]
+
+
+class _Stage:
+    __slots__ = ("ops", "param_names", "in_act", "out_act", "device",
+                 "fn")
+
+    def __init__(self, ops, param_names, in_act, out_act, device):  # noqa: D401
+        self.ops = ops
+        self.param_names = param_names
+        self.in_act = in_act       # activation inputs (from prev stage)
+        self.out_act = out_act     # activation outputs (to next stage)
+        self.device = device
+        self.fn = None
+
+
+class PipelineProgram:
+    """Split ``program`` into len(cut_vars)+1 stages at the given
+    variable names; stage i runs on devices[i].
+
+    The last stage must compute ``loss``.  Feeds enter stage 0;
+    parameters stay resident on their stage's device and are updated
+    in place with SGD (``lr``) each ``train_step``; ``sync_to_scope``
+    writes them back.
+    """
+
+    def __init__(self, program, loss, cut_vars, devices, scope,
+                 feed_names):
+        import jax
+
+        self.program = program
+        self.loss_name = loss if isinstance(loss, str) else loss.name
+        self.feed_names = list(feed_names)
+        cut_names = [v if isinstance(v, str) else v.name
+                     for v in cut_vars]
+        if len(devices) != len(cut_names) + 1:
+            raise ValueError(
+                "%d cut vars make %d stages but %d devices given" %
+                (len(cut_names), len(cut_names) + 1, len(devices)))
+        self.stages = self._split(program, cut_names, devices, scope)
+        for st in self.stages:
+            st.fn = self._build_stage_fn(st)
+        # parameters resident per stage device
+        self.params = [
+            {n: jax.device_put(np.asarray(scope.find_var(n)), st.device)
+             for n in st.param_names}
+            for st in self.stages]
+
+    # ------------------------------------------------------------------
+    def _split(self, program, cut_names, devices, scope):
+        block = program.global_block()
+        ops = [op for op in block.desc.ops
+               if op.type not in ("feed", "fetch")]
+        # drop backward/optimize ops: the pipeline drives its own vjp
+        from .framework import OpRole
+        ops = [op for op in ops
+               if not (op.role & (OpRole.Backward | OpRole.Optimize))]
+
+        stages = []
+        bounds = []
+        cut_left = list(cut_names)
+        for idx, op in enumerate(ops):
+            outs = set(op.output_arg_names())
+            if cut_left and cut_left[0] in outs:
+                bounds.append(idx + 1)
+                cut_left.pop(0)
+        if cut_left:
+            raise ValueError("cut vars %r are not produced by the "
+                             "program" % cut_left)
+        bounds = [0] + bounds + [len(ops)]
+        for i in range(len(bounds) - 1):
+            seg = ops[bounds[i]:bounds[i + 1]]
+            writes = {n for op in seg for n in op.output_arg_names()
+                      if n}
+            reads = {n for op in seg for n in op.input_arg_names()
+                     if n and n not in writes}
+            params = sorted(n for n in reads if scope.has_var(n))
+            in_act = sorted(n for n in reads
+                            if not scope.has_var(n))
+            stages.append(_Stage(seg, params, in_act, None,
+                                 devices[i]))
+        # frozen parameters are vjp'd through but never updated
+        blk_vars = program.global_block().vars
+        self._frozen = {
+            n for st in stages for n in st.param_names
+            if n in blk_vars and not getattr(blk_vars[n], "trainable",
+                                             True)}
+        # activation outputs: what later stages (or the loss) read.
+        # Skip connections (an activation read by a NON-adjacent stage)
+        # would need cotangent forwarding through the middle stages —
+        # unsupported; fail at construction, not with wrong gradients.
+        for i, st in enumerate(stages):
+            produced_here = {n for op in st.ops
+                             for n in op.output_arg_names() if n}
+            for k in range(i + 2, len(stages)):
+                skip = produced_here & set(stages[k].in_act)
+                if skip:
+                    raise ValueError(
+                        "activation(s) %r of stage %d are read by "
+                        "non-adjacent stage %d; move the cut so every "
+                        "activation flows only to the next stage" %
+                        (sorted(skip), i, k))
+            needed = set([self.loss_name]) if i == len(stages) - 1 \
+                else set()
+            if i + 1 < len(stages):
+                needed |= set(stages[i + 1].in_act)
+            st.out_act = sorted(n for n in produced_here if n in needed)
+        return stages
+
+    def _build_stage_fn(self, st):
+        import jax
+
+        from paddle_tpu.core.lowering import LoweringContext, run_op
+
+        program_desc = self.program.desc
+        ops = list(st.ops)
+        out_names = list(st.out_act)
+
+        def fn(params, acts):
+            env = dict(params)
+            env.update(acts)
+            ctx = LoweringContext(program_desc, 0, env,
+                                  jax.random.PRNGKey(0), "train")
+            for op in ops:
+                run_op(ctx, op)
+            return {n: env[n] for n in out_names}
+
+        # placement follows the stage's device_put inputs (params and
+        # activations are committed to st.device before each call)
+        return jax.jit(fn)
+
+    # ------------------------------------------------------------------
+    def train_step(self, feed, n_microbatches, lr=0.01):
+        """One GPipe step: split the feed on dim 0 into microbatches,
+        forward all of them through the stages (async dispatch overlaps
+        stages across devices), then backward in reverse, accumulate
+        per-stage grads, apply SGD.  Returns the mean microbatch loss."""
+        import jax
+
+        mbs = self._split_feed(feed, n_microbatches)
+        # forward: keep vjp closures per (stage, microbatch)
+        vjps = [[None] * len(self.stages) for _ in mbs]
+        losses = []
+        for m, mb in enumerate(mbs):
+            acts = {k: jax.device_put(v, self.stages[0].device)
+                    for k, v in mb.items()}
+            for i, st in enumerate(self.stages):
+                stage_in = {n: acts[n] for n in st.in_act
+                            if n in acts}
+                stage_in.update({k: v for k, v in acts.items()
+                                 if k in self.feed_names and
+                                 any(k in op.input_arg_names()
+                                     for op in st.ops)})
+                # every input committed to this stage's device (feeds
+                # arrive on stage 0's; activations on the previous)
+                stage_in = {k: jax.device_put(v, st.device)
+                            for k, v in stage_in.items()}
+                outs, vjp = jax.vjp(
+                    lambda p, a, f=st.fn: f(p, a), self.params[i],
+                    stage_in)
+                vjps[m][i] = vjp
+                nxt_dev = (self.stages[i + 1].device
+                           if i + 1 < len(self.stages) else None)
+                acts = dict(acts)
+                for k, v in outs.items():
+                    acts[k] = (jax.device_put(v, nxt_dev)
+                               if nxt_dev is not None else v)
+            losses.append(acts[self.loss_name])
+
+        # backward (reverse microbatch order, GPipe drain) + accumulate
+        grads = [None] * len(self.stages)
+        for m in reversed(range(len(mbs))):
+            cot = {self.loss_name:
+                   jax.numpy.ones_like(losses[m]) / len(mbs)}
+            for i in reversed(range(len(self.stages))):
+                st = self.stages[i]
+                # every out_act flows to the adjacent consumer (checked
+                # at construction), so all cotangents are present
+                full_cot = {n: cot[n] for n in st.out_act}
+                gp, ga = vjps[m][i](full_cot)
+                grads[i] = gp if grads[i] is None else \
+                    jax.tree_util.tree_map(jax.numpy.add, grads[i], gp)
+                cot = {k: jax.device_put(
+                    v, self.stages[i - 1].device if i else st.device)
+                    for k, v in ga.items()}
+        # SGD in place, per stage on its device (frozen params skipped)
+        for i, st in enumerate(self.stages):
+            self.params[i] = {
+                n: (self.params[i][n] if n in self._frozen
+                    else self.params[i][n] - lr * grads[i][n])
+                for n in self.params[i]}
+        return float(np.mean([np.asarray(l).ravel()[0]
+                              for l in losses]))
+
+    def _split_feed(self, feed, n):
+        out = [dict() for _ in range(n)]
+        for k, v in feed.items():
+            v = np.asarray(v)
+            if v.shape[0] % n:
+                raise ValueError(
+                    "batch dim %d of %r does not divide into %d "
+                    "microbatches" % (v.shape[0], k, n))
+            for m, part in enumerate(np.split(v, n, axis=0)):
+                out[m][k] = part
+        return out
+
+    def sync_to_scope(self, scope):
+        for st_params in self.params:
+            for n, v in st_params.items():
+                (scope.find_scope_of(n) or scope).set(n, np.asarray(v))

@@ -1,0 +1,81 @@
+"""Pipeline parallelism from the fluid front-end (fluid/pipeline.py):
+a Program split at cut vars trains on a multi-device pipeline and
+matches single-device training exactly."""
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+
+layers = fluid.layers
+
+
+def _build(scope):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.scope_guard(scope):
+        with fluid.program_guard(main, startup):
+            with fluid.unique_name.guard():
+                x = layers.data(name="x", shape=[8], dtype="float32")
+                y = layers.data(name="y", shape=[1], dtype="float32")
+                h1 = layers.fc(x, size=16, act="tanh",
+                               param_attr=fluid.ParamAttr(name="w1"),
+                               bias_attr=fluid.ParamAttr(name="b1"))
+                h2 = layers.fc(h1, size=16, act="tanh",
+                               param_attr=fluid.ParamAttr(name="w2"),
+                               bias_attr=fluid.ParamAttr(name="b2"))
+                pred = layers.fc(h2, size=1,
+                                 param_attr=fluid.ParamAttr(name="w3"),
+                                 bias_attr=fluid.ParamAttr(name="b3"))
+                loss = layers.mean(
+                    layers.square_error_cost(pred, y))
+    return main, startup, h1, h2, loss
+
+
+def test_pipeline_matches_single_device():
+    import jax
+
+    devices = jax.devices("cpu")
+    if len(devices) < 3:
+        pytest.skip("needs 3 host devices")
+
+    rng = np.random.RandomState(0)
+    xv = rng.randn(8, 8).astype(np.float32)
+    yv = (xv.sum(1, keepdims=True) * 0.3).astype(np.float32)
+    lr, steps, n_mb = 0.05, 5, 4
+
+    # pipeline programs + their own init
+    scope_b = fluid.Scope()
+    main_b, startup_b, h1, h2, loss_b = _build(scope_b)
+    with fluid.scope_guard(scope_b):
+        exe_b = fluid.Executor(fluid.CPUPlace())
+        exe_b.run(startup_b)
+
+    # exact baseline: replay single-device training from scope_b's init
+    scope_c = fluid.Scope()
+    main_c, startup_c, _, _, loss_c = _build(scope_c)
+    with fluid.scope_guard(scope_c):
+        with fluid.program_guard(main_c, startup_c):
+            fluid.optimizer.SGD(learning_rate=lr).minimize(loss_c)
+        exe_c = fluid.Executor(fluid.CPUPlace())
+        exe_c.run(startup_c)
+        for n in ("w1", "b1", "w2", "b2", "w3", "b3"):
+            scope_c.set(n, np.asarray(scope_b.find_var(n)))
+        base = []
+        for _ in range(steps):
+            l, = exe_c.run(main_c, feed={"x": xv, "y": yv},
+                           fetch_list=[loss_c])
+            base.append(float(np.ravel(l)[0]))
+        base_w1 = np.asarray(scope_c.find_var("w1"))
+
+    from paddle_tpu.fluid.pipeline import PipelineProgram
+
+    pp = PipelineProgram(main_b, loss_b, cut_vars=[h1, h2],
+                         devices=devices[:3], scope=scope_b,
+                         feed_names=["x", "y"])
+    pipe = [pp.train_step({"x": xv, "y": yv}, n_microbatches=n_mb,
+                          lr=lr) for _ in range(steps)]
+    # microbatch-mean grads == full-batch grads for a mean loss, so the
+    # trajectories must match to float tolerance
+    np.testing.assert_allclose(pipe, base, rtol=1e-4, atol=1e-6)
+    pp.sync_to_scope(scope_b)
+    np.testing.assert_allclose(np.asarray(scope_b.find_var("w1")),
+                               base_w1, rtol=1e-4, atol=1e-6)

@@ -233,3 +233,47 @@ def test_lstm_sentiment_e2e(prog_scope, exe):
         l, = exe.run(main, feed=feeder.feed(batch), fetch_list=[loss])
         ls.append(float(l[0]))
     assert ls[-1] < 0.3, (ls[0], ls[-1])
+
+
+def test_level2_lod_feed_pads_correctly():
+    """data(lod_level=2) round trip: nested padding + both length
+    sidecars reach the device function (reference lod_tensor.h:58
+    hierarchical LoD; previously level-2 feeds mispadded)."""
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.core.lod import LoDTensor
+
+    # 2 sentences: [[a(2 tok), b(3 tok)], [c(1 tok)]], token dim 2
+    seqs = [np.arange(4, dtype=np.float32).reshape(2, 2),
+            np.arange(6, dtype=np.float32).reshape(3, 2) + 10,
+            np.arange(2, dtype=np.float32).reshape(1, 2) + 100]
+    flat = np.concatenate(seqs, axis=0)
+    lt = LoDTensor(flat, [[0, 2, 3], [0, 2, 5, 6]])
+
+    padded, outer, inner = lt.to_padded_2level()
+    assert padded.shape == (2, 2, 3, 2)
+    np.testing.assert_array_equal(outer, [2, 1])
+    np.testing.assert_array_equal(inner, [[2, 3], [1, 0]])
+    np.testing.assert_allclose(padded[0, 0, :2], seqs[0])
+    np.testing.assert_allclose(padded[0, 1, :3], seqs[1])
+    np.testing.assert_allclose(padded[1, 0, :1], seqs[2])
+    np.testing.assert_allclose(padded[1, 1], 0.0)
+    back = LoDTensor.from_padded_2level(padded, outer, inner)
+    np.testing.assert_allclose(np.asarray(back.data), flat)
+    assert back.lod == lt.lod
+
+    # end to end: feed through a program; the reduction sees only the
+    # real tokens when masked by the sidecars
+    main, startup = fluid.Program(), fluid.Program()
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        with fluid.program_guard(main, startup):
+            with fluid.unique_name.guard():
+                x = fluid.layers.data(name="x", shape=[2, 3, 2],
+                                      dtype="float32", lod_level=2,
+                                      append_batch_size=True)
+                total = fluid.layers.reduce_sum(x)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        got, = exe.run(main, feed={"x": lt}, fetch_list=[total])
+    np.testing.assert_allclose(float(np.ravel(got)[0]), flat.sum(),
+                               rtol=1e-6)
