@@ -35,6 +35,8 @@ LEN_SUFFIX = "@LEN"
 # pad ragged batches' time dim up to a multiple of this so the number of
 # distinct compiled shapes stays bounded (bucketing)
 LOD_PAD_MULTIPLE = 8
+# level-2 feeds also bucket the outer (sentence-count) dim
+LOD_SEQ_PAD_MULTIPLE = 4
 
 
 def _prepare_lod_feeds(feed):
@@ -52,12 +54,17 @@ def _prepare_lod_feeds(feed):
                 "feeds with lod_level > 2 are not supported "
                 "(variable %r has %d levels)" % (name, len(v.lod)))
         if len(v.lod) == 2:
-            # bucket both ragged dims so compiled shapes stay bounded
-            s_max = max(v.lod[0][i + 1] - v.lod[0][i]
-                        for i in range(len(v.lod[0]) - 1))
+            # bucket both ragged dims so compiled shapes stay bounded.
+            # NB: this is the FEED bridge (pad + expose '@LEN' outer and
+            # '@LEN@1' inner lengths); sequence ops currently mask on
+            # the outer level only — finest-level pooling over level-2
+            # data needs ops consuming '@LEN@1'.
+            s_max = max((v.lod[0][i + 1] - v.lod[0][i]
+                         for i in range(len(v.lod[0]) - 1)), default=1)
             w_max = max((v.lod[1][j + 1] - v.lod[1][j]
                          for j in range(len(v.lod[1]) - 1)), default=1)
-            s_max = -(-max(s_max, 1) // 4) * 4
+            s_max = -(-max(s_max, 1) // LOD_SEQ_PAD_MULTIPLE) * \
+                LOD_SEQ_PAD_MULTIPLE
             w_max = -(-max(w_max, 1) // LOD_PAD_MULTIPLE) * \
                 LOD_PAD_MULTIPLE
             padded, outer, inner = v.to_padded_2level(

@@ -64,3 +64,6 @@ define_flag("check_nan_inf", False,
             "nan/inf (reference FLAGS_check_nan_inf)")
 define_flag("benchmark", False,
             "print per-run wall time (reference FLAGS_benchmark)")
+define_flag("conv_nhwc", False,
+            "lower conv2d through NHWC (MXU-preferred layout); the "
+            "boundary transposes cancel across conv chains in XLA")

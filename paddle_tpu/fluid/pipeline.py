@@ -20,7 +20,7 @@ __all__ = ["PipelineProgram"]
 
 class _Stage:
     __slots__ = ("ops", "param_names", "in_act", "out_act", "device",
-                 "fn")
+                 "fn", "feed_reads")
 
     def __init__(self, ops, param_names, in_act, out_act, device):  # noqa: D401
         self.ops = ops
@@ -57,6 +57,11 @@ class PipelineProgram:
         self.stages = self._split(program, cut_names, devices, scope)
         for st in self.stages:
             st.fn = self._build_stage_fn(st)
+            # static per-stage feed consumption (hot path reads it)
+            st_ops_inputs = {n for op in st.ops
+                             for n in op.input_arg_names()}
+            st.feed_reads = sorted(set(self.feed_names) & st_ops_inputs)
+        self._rng_counter = 0
         # parameters resident per stage device
         self.params = [
             {n: jax.device_put(np.asarray(scope.find_var(n)), st.device)
@@ -133,11 +138,13 @@ class PipelineProgram:
         ops = list(st.ops)
         out_names = list(st.out_act)
 
-        def fn(params, acts):
+        def fn(params, acts, rng_counter):
             env = dict(params)
             env.update(acts)
-            ctx = LoweringContext(program_desc, 0, env,
-                                  jax.random.PRNGKey(0), "train")
+            # fresh key per (step, microbatch): stochastic ops (dropout)
+            # must not repeat their masks across microbatches or steps
+            key = jax.random.fold_in(jax.random.PRNGKey(0), rng_counter)
+            ctx = LoweringContext(program_desc, 0, env, key, "train")
             for op in ops:
                 run_op(ctx, op)
             return {n: env[n] for n in out_names}
@@ -161,20 +168,20 @@ class PipelineProgram:
         for m, mb in enumerate(mbs):
             acts = {k: jax.device_put(v, self.stages[0].device)
                     for k, v in mb.items()}
+            self._rng_counter += 1
+            counter = self._rng_counter
             for i, st in enumerate(self.stages):
                 stage_in = {n: acts[n] for n in st.in_act
                             if n in acts}
-                stage_in.update({k: v for k, v in acts.items()
-                                 if k in self.feed_names and
-                                 any(k in op.input_arg_names()
-                                     for op in st.ops)})
+                stage_in.update({k: acts[k] for k in st.feed_reads
+                                 if k in acts})
                 # every input committed to this stage's device (feeds
                 # arrive on stage 0's; activations on the previous)
                 stage_in = {k: jax.device_put(v, st.device)
                             for k, v in stage_in.items()}
                 outs, vjp = jax.vjp(
-                    lambda p, a, f=st.fn: f(p, a), self.params[i],
-                    stage_in)
+                    lambda p, a, f=st.fn, c=counter: f(p, a, c),
+                    self.params[i], stage_in)
                 vjps[m][i] = vjp
                 nxt_dev = (self.stages[i + 1].device
                            if i + 1 < len(self.stages) else None)

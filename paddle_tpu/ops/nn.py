@@ -22,20 +22,32 @@ def _pair(v):
 
 
 def _conv_lower(ctx, ins, attrs, op):
-    x = ins["Input"]        # NCHW
+    from paddle_tpu.core.flags import FLAGS
+
+    x = ins["Input"]        # NCHW (the fluid layout contract)
     w = ins["Filter"]       # OIHW (I = C/groups)
     strides = _pair(attrs.get("strides", [1, 1]))
     paddings = _pair(attrs.get("paddings", [0, 0]))
     dilations = _pair(attrs.get("dilations", [1, 1]))
     groups = attrs.get("groups", 1)
+    # conv_nhwc: compute in the MXU's preferred layout; the NCHW<->NHWC
+    # transposes at the op boundary cancel across adjacent conv/
+    # elementwise chains in XLA's layout pass
+    dn = ("NHWC", "HWIO", "NHWC") if FLAGS.conv_nhwc else \
+        ("NCHW", "OIHW", "NCHW")
+    if FLAGS.conv_nhwc:
+        x = jnp.transpose(x, (0, 2, 3, 1))
+        w = jnp.transpose(w, (2, 3, 1, 0))
     out = jax.lax.conv_general_dilated(
         x, w,
         window_strides=strides,
         padding=[(paddings[0], paddings[0]), (paddings[1], paddings[1])],
         rhs_dilation=dilations,
-        dimension_numbers=("NCHW", "OIHW", "NCHW"),
+        dimension_numbers=dn,
         feature_group_count=groups,
         preferred_element_type=jnp.result_type(x, w))
+    if FLAGS.conv_nhwc:
+        out = jnp.transpose(out, (0, 3, 1, 2))
     return {"Output": out}
 
 
