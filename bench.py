@@ -35,6 +35,63 @@ TRAIN_FLOPS_PER_IMG_224 = 12.3e9
 DEFAULT_PEAK_TFLOPS = 197.0  # v5e bf16
 
 
+def transformer_bench(on_accel):
+    """BENCH_MODEL=transformer: bf16 LM training tokens/sec (flash
+    attention on the TPU path; second headline next to ResNet-50)."""
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.models import transformer
+
+    if on_accel:
+        bs = int(os.environ.get("BENCH_BATCH", "16"))
+        seq = int(os.environ.get("BENCH_SEQ", "2048"))
+        iters = int(os.environ.get("BENCH_ITERS", "30"))
+        d_model, n_layers, n_head = 512, 6, 8
+    else:
+        bs, seq, iters = 2, 128, 3
+        d_model, n_layers, n_head = 64, 2, 4
+    vocab = 8192
+    amp = os.environ.get("BENCH_AMP", "1" if on_accel else "0") == "1"
+
+    main_prog, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main_prog, startup):
+        avg_cost, (src, label), _ = transformer.get_model(
+            vocab_size=vocab, seq_len=seq, d_model=d_model,
+            n_head=n_head, n_layers=n_layers, d_ff=4 * d_model)
+    if amp:
+        fluid.transpiler.Float16Transpiler().transpile(main_prog)
+    place = fluid.TPUPlace() if on_accel else fluid.CPUPlace()
+    exe = fluid.Executor(place)
+    exe.run(startup)
+
+    rng = np.random.RandomState(0)
+    feed = {src.name: rng.randint(0, vocab, (bs, seq)).astype(np.int64),
+            label.name: rng.randint(0, vocab,
+                                    (bs, seq, 1)).astype(np.int64)}
+    try:
+        import jax
+        dev = place.jax_device()
+        feed = {k: jax.device_put(v, dev) for k, v in feed.items()}
+    except Exception:
+        pass
+    for _ in range(2):
+        exe.run(main_prog, feed=feed, fetch_list=[avg_cost])
+    t0 = time.time()
+    for _ in range(iters):
+        loss, = exe.run(main_prog, feed=feed, fetch_list=[avg_cost],
+                        return_numpy=False)
+    loss = np.asarray(loss)
+    elapsed = time.time() - t0
+    tokens_per_sec = bs * seq * iters / elapsed
+    print(json.dumps({
+        "metric": "transformer_lm_train_bs%d_seq%d%s" % (
+            bs, seq, "_bf16" if amp else ""),
+        "value": round(tokens_per_sec, 1),
+        "unit": "tokens/sec",
+        "vs_baseline": 0.0,  # no reference transformer baseline exists
+        "amp": amp,
+    }))
+
+
 def main():
     model_name = os.environ.get("BENCH_MODEL", "resnet50")
     on_accel = False
@@ -43,6 +100,8 @@ def main():
         on_accel = any(d.platform != "cpu" for d in jax.devices())
     except Exception:
         pass
+    if model_name == "transformer":
+        return transformer_bench(on_accel)
     # Keep CPU smoke-runs fast; real run uses ImageNet shapes.
     if on_accel:
         batch_size = int(os.environ.get("BENCH_BATCH", "256"))

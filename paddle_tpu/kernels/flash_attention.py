@@ -133,10 +133,12 @@ def _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret):
 
 def _flash_bwd(scale, causal, block_q, block_k, interpret, res, g):
     q, k, v = res
-    _, vjp = jax.vjp(
+    out, vjp = jax.vjp(
         lambda q_, k_, v_: _attention_xla(q_, k_, v_, scale, causal),
         q, k, v)
-    return vjp(g)
+    # the pallas forward emits q.dtype while the XLA path may promote
+    # (e.g. bf16 inputs -> f32 softmax chain): line the cotangent up
+    return vjp(g.astype(out.dtype))
 
 
 _flash_diff.defvjp(_flash_fwd, _flash_bwd)
