@@ -106,6 +106,7 @@ class VariableServer:
         handlers = {
             "SendVariable": self._h(self._send_variable),
             "GetVariable": self._h(self._get_variable),
+            "PrefetchVariable": self._h(self._prefetch_variable),
             "SendBarrier": self._h(self._send_barrier),
             "FetchBarrier": self._h(self._fetch_barrier),
             "SendComplete": self._h(self._send_complete),
@@ -168,6 +169,23 @@ class VariableServer:
             # apply donates the param's device buffer, invalidating it
             val = np.asarray(self.scope.find_var(name))
         return _enc_tensor(name, val)
+
+    def _prefetch_variable(self, req):
+        """Row-subset read of a sharded table (reference
+        send_recv.proto:27 PrefetchVariable + grpc_server.cc prefetch
+        path): request carries LOCAL row ids of this server's block;
+        response is the gathered rows.  Sync-mode waits for the same
+        applied round as GetVariable so a prefetch never reads a table
+        mid-update."""
+        name, ids, round_ = _dec_tensor(req)
+        with self._cv:
+            if self.sync_mode:
+                self._cv.wait_for(
+                    lambda: self._applied_round >= round_
+                    or self._shutdown.is_set())
+            table = np.asarray(self.scope.find_var(name))
+        rows = table[np.asarray(ids, np.int64)]
+        return _enc_tensor(name, rows)
 
     def _fetch_barrier(self, req):
         return b""
@@ -281,6 +299,16 @@ class RPCClient:
         futs = [self._stub(ep, "GetVariable").future(
             _enc_msg(name, round_), wait_for_ready=True)
             for ep, name in pairs]
+        return [_dec_tensor(f.result())[1] for f in futs]
+
+    def prefetch_vars(self, triples, round_=None):
+        """Overlapped row prefetches: [(ep, block_name, local_ids)] ->
+        [rows] (reference AsyncPrefetchVar + Wait)."""
+        round_ = self.step if round_ is None else round_
+        futs = [self._stub(ep, "PrefetchVariable").future(
+            _enc_tensor(name, np.asarray(ids, np.int64), round_),
+            wait_for_ready=True)
+            for ep, name, ids in triples]
         return [_dec_tensor(f.result())[1] for f in futs]
 
     def send_barrier(self, eps):

@@ -155,6 +155,35 @@ class DistributeTranspiler:
             if gvd is not None:
                 gvd.persistable = True
 
+        # -- 2b. distributed lookup tables ---------------------------------
+        # (reference distribute_transpiler.py:611
+        # _replace_lookup_table_op_with_prefetch): embedding tables with
+        # is_distributed=True never exist on the trainer — the forward
+        # becomes a prefetch RPC against the pserver shards and the
+        # backward ships sparse grads without reading W.
+        self.dist_tables = set()
+        for op in block.desc.ops:
+            if op.type == "lookup_table" and op.attr("is_distributed",
+                                                     False):
+                self.dist_tables.add(op.inputs["W"][0])
+        for op in block.desc.ops:
+            w = (op.inputs.get("W") or [None])[0]
+            if w not in self.dist_tables:
+                continue
+            if op.type == "lookup_table":
+                blocks = self.param_blocks[w]
+                op.type = "distributed_lookup"
+                del op.inputs["W"]
+                op.set_attr("epmap", [self.block_ep[b.name]
+                                      for b in blocks])
+                op.set_attr("sections", [b.rows for b in blocks])
+                op.set_attr("block_names", [b.name for b in blocks])
+            elif op.type == "lookup_table_grad":
+                vd = block.desc.find_var_recursive(w)
+                del op.inputs["W"]
+                op.set_attr("table_shape", list(vd.shape))
+                op.set_attr("is_sparse", True)
+
         # -- 3. append trainer-side send/recv chain -------------------------
         used_eps = sorted({ep for ep in self.block_ep.values()})
         for p, g in params_grads:
@@ -171,6 +200,8 @@ class DistributeTranspiler:
                             attrs={"endpoints": used_eps},
                             infer_shape=False)
         for p, g in params_grads:
+            if p in self.dist_tables:
+                continue   # sharded tables stay on the pservers
             blocks = self.param_blocks[p]
             block.append_op(
                 type="recv", inputs={}, outputs={"Out": [p]},
@@ -190,7 +221,25 @@ class DistributeTranspiler:
         # consistent across trainers even though each process draws its
         # own local values first.
         su_block = self.startup_program.global_block()
+        # a distributed table is never materialized on the trainer: drop
+        # its local init ops (stashed first — the PSERVER startup still
+        # clones them to initialize its table shards)
+        self._dist_init_descs = {}
+        if self.dist_tables:
+            kept = []
+            for op in su_block.desc.ops:
+                hit = set(op.output_arg_names()) & self.dist_tables
+                if hit:
+                    for n in hit:
+                        self._dist_init_descs[n] = op
+                else:
+                    kept.append(op)
+            su_block.desc.ops = kept
+            su_block.ops = [o for o in su_block.ops
+                            if o.desc in kept]
         for p, g in params_grads:
+            if p in self.dist_tables:
+                continue
             blocks = self.param_blocks[p]
             if not su_block.has_var(p):
                 vd = block.desc.find_var_recursive(p)
@@ -325,11 +374,13 @@ class DistributeTranspiler:
                 psname)
             if pvd is None:
                 continue
-            init_desc = None
-            for op in s_block.ops:
-                if oname in op.desc.output_arg_names():
-                    init_desc = op.desc
-                    break
+            init_desc = getattr(self, "_dist_init_descs",
+                                {}).get(oname)
+            if init_desc is None:
+                for op in s_block.ops:
+                    if oname in op.desc.output_arg_names():
+                        init_desc = op.desc
+                        break
             if init_desc is None:
                 continue  # e.g. grad blocks: arrive via RPC
             dtype = proto_to_np_dtype(pvd.dtype)

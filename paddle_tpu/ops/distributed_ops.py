@@ -154,3 +154,50 @@ def _listen_and_serv(executor, op, scope, feed, env=None):
         with open(port_file, "w") as f:
             f.write(str(port))
     server.wait()
+
+
+@_host("distributed_lookup")
+def _distributed_lookup(executor, op, scope, feed, env=None):
+    """Embedding lookup against a pserver-sharded table (reference
+    distribute_transpiler.py:611 _replace_lookup_table_op_with_prefetch
+    + prefetch_op / grpc PrefetchVariable).  Ids are split by the
+    table's row ranges, each shard's rows are prefetched over RPC, and
+    the gathered rows reassemble in id order."""
+    from paddle_tpu.distributed.rpc import RPCClient
+
+    name = op.input("Ids")[0]
+    if env is not None and name in env:
+        ids = np.asarray(env[name])
+    elif feed is not None and name in feed:
+        ids = np.asarray(feed[name])
+    else:
+        ids = np.asarray(scope.find_var(name))
+    eps = op.attr("epmap")
+    names = op.attr("block_names")
+    sections = op.attr("sections")
+    padding_idx = int(op.attr("padding_idx", -1))
+    starts = _sections_starts(sections)
+
+    # same shape contract as lookup_table: a trailing ids dim of 1 is
+    # squeezed before the embedding dim is appended
+    id_shape = ids.shape[:-1] if ids.shape and ids.shape[-1] == 1 \
+        else ids.shape
+    flat = ids.reshape(-1).astype(np.int64)
+    # out-of-range ids clamp, matching the local jnp.take semantics
+    flat = np.clip(flat, 0, starts[-1] - 1)
+    out = None
+    triples, masks = [], []
+    for i, (ep, bname) in enumerate(zip(eps, names)):
+        m = (flat >= starts[i]) & (flat < starts[i + 1])
+        triples.append((ep, bname, flat[m] - starts[i]))
+        masks.append(m)
+    client = RPCClient.instance()
+    for m, rows in zip(masks, client.prefetch_vars(triples)):
+        if out is None:
+            out = np.zeros((flat.shape[0], rows.shape[-1]), rows.dtype)
+        if rows.size:
+            out[m] = rows
+    if padding_idx != -1:
+        out[flat == padding_idx] = 0.0   # local lookup_table parity
+    out = out.reshape(tuple(id_shape) + (out.shape[-1],))
+    _write(op.output("Out")[0], out, scope, env)

@@ -255,18 +255,29 @@ def _lookup_table_grad(ctx, ins, attrs, op):
     (reference lookup_table_op.cc grad kernels + selected_rows_functor)."""
     from paddle_tpu.core.selected_rows import SelectedRows
 
-    w, ids, g = ins["W"], ins["Ids"], ins["Out@GRAD"]
+    ids, g = ins["Ids"], ins["Out@GRAD"]
+    w = ins.get("W")
+    # distributed lookup tables never exist on the trainer: shape comes
+    # from the 'table_shape' attr the transpiler stamps instead
+    if w is not None:
+        height, d, wdtype = int(w.shape[0]), int(w.shape[1]), w.dtype
+    else:
+        height, d = [int(s) for s in attrs["table_shape"]]
+        wdtype = g.dtype
     padding_idx = attrs.get("padding_idx", -1)
     idx = _lookup_idx(ids)
-    d = w.shape[1]
     rows = idx.reshape(-1)
-    vals = g.reshape(-1, d).astype(w.dtype)
+    vals = g.reshape(-1, d).astype(wdtype)
     if padding_idx != -1:
         # vjp of the padding mask: those rows contribute nothing
         vals = jnp.where((rows == padding_idx)[:, None],
                          jnp.zeros_like(vals), vals)
     if attrs.get("is_sparse", False):
-        return {"W@GRAD": SelectedRows(rows, vals, int(w.shape[0]))}
+        return {"W@GRAD": SelectedRows(rows, vals, height)}
+    if w is None:
+        raise ValueError(
+            "lookup_table_grad without W requires is_sparse=True "
+            "(distributed tables always ship sparse grads)")
     dense = jnp.zeros_like(w).at[rows].add(vals)
     return {"W@GRAD": dense}
 

@@ -27,14 +27,16 @@ def build_model(kind="softmax"):
     # zero init everywhere -> every process starts from identical params,
     # so sync-SGD losses must match the single-process run exactly
     zinit = fluid.initializer.ConstantInitializer(0.0)
-    if kind in ("emb_sparse", "emb_dense"):
+    if kind in ("emb_sparse", "emb_dense", "emb_dist"):
         ids = fluid.layers.data(name="ids", shape=[4], dtype="int64")
         y = fluid.layers.data(name="y", shape=[1], dtype="float32")
         # NON-zero constant inits (still identical across processes):
         # with emb_w=fc_w=0 both grads vanish identically and the test
         # could not distinguish a broken sparse path from a working one
         emb = fluid.layers.embedding(
-            ids, size=[50, 8], is_sparse=(kind == "emb_sparse"),
+            ids, size=[50, 8],
+            is_sparse=(kind in ("emb_sparse", "emb_dist")),
+            is_distributed=(kind == "emb_dist"),
             param_attr=fluid.ParamAttr(
                 name="emb_w",
                 initializer=fluid.initializer.ConstantInitializer(0.02)))
@@ -63,7 +65,7 @@ def build_model(kind="softmax"):
 
 def make_batch(step, kind="softmax"):
     rng = np.random.RandomState(1234 + step)
-    if kind in ("emb_sparse", "emb_dense"):
+    if kind in ("emb_sparse", "emb_dense", "emb_dist"):
         # one FIXED batch (step-independent): squared loss on a linear
         # model then descends monotonically, a clean learning signal
         rng = np.random.RandomState(1234)

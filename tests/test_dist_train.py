@@ -88,6 +88,14 @@ def test_dist_train_matches_local():
     assert local[-1] < local[0] * 0.8  # actually learning
 
 
+def test_dist_train_distributed_lookup_table():
+    """embedding(is_distributed=True): the table lives ONLY on the
+    pservers (sharded by rows); trainers prefetch rows over RPC in the
+    forward and ship sparse grads back.  Must match the local run."""
+    local = _run_dist("emb_dist")
+    assert local[-1] < local[0]
+
+
 def test_dist_train_sparse_embedding():
     """Distributed SelectedRows: sparse grads travel the wire split by
     row range and the pserver applies them; must match the local run."""
