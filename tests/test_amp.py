@@ -152,3 +152,47 @@ def test_amp_flag_survives_clone():
     fluid.transpiler.Float16Transpiler().revert(main)
     assert not main.desc.amp_bf16
     assert test_prog.desc.amp_bf16  # clone is independent
+
+
+def test_amp_under_parallel_executor():
+    """AMP + SPMD together: a bf16 program compiled over the data-
+    parallel mesh matches its own single-device loss trajectory."""
+    import jax
+
+    if len(jax.devices("cpu")) < 8:
+        import pytest
+        pytest.skip("needs 8 host devices")
+
+    def train(parallel):
+        main, startup = fluid.Program(), fluid.Program()
+        scope = fluid.Scope()
+        with fluid.scope_guard(scope):
+            with fluid.program_guard(main, startup):
+                with fluid.unique_name.guard():
+                    img, label, conv, loss = _build_convnet()
+                    fluid.optimizer.SGD(learning_rate=0.1).minimize(
+                        loss)
+            fluid.transpiler.Float16Transpiler().transpile(main)
+            main.random_seed = startup.random_seed = 9
+            exe = fluid.Executor(fluid.CPUPlace())
+            exe.run(startup)
+            rng = np.random.RandomState(1)
+            x = rng.rand(16, 1, 16, 16).astype(np.float32)
+            y = rng.randint(0, 10, (16, 1)).astype(np.int64)
+            if parallel:
+                pexe = fluid.ParallelExecutor(
+                    use_cuda=False, loss_name=loss.name,
+                    main_program=main, scope=scope)
+                runner = lambda: pexe.run([loss.name],
+                                          feed={"img": x, "label": y})
+            else:
+                runner = lambda: exe.run(main,
+                                         feed={"img": x, "label": y},
+                                         fetch_list=[loss])
+            return [float(np.ravel(np.asarray(runner()[0]))[0])
+                    for _ in range(6)]
+
+    single = train(False)
+    spmd = train(True)
+    np.testing.assert_allclose(spmd, single, rtol=2e-2, atol=1e-2)
+    assert spmd[-1] < spmd[0]
