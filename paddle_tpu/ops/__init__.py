@@ -20,4 +20,5 @@ from paddle_tpu.ops import (  # noqa: F401
     beam_search,
     crf_ctc,
     detection,
+    misc,
 )

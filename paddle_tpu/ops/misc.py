@@ -1,0 +1,226 @@
+"""Remaining specialty operators closing the reference op census.
+
+Parity: reference operators/{conv_shift,fake_dequantize,
+polygon_box_transform,pool_with_index,unpool,roi_pool,
+positive_negative_pair}_op.cc — the last same-name gaps after aliases
+(activation/compare/conv/... register per-op) and by-design
+subsumptions (mkldnn/tensorrt/nccl variants, reader chain, channels).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.core.registry import register_op
+from paddle_tpu.ops.io_ops import _host
+
+
+@register_op("conv_shift")
+def _conv_shift(ctx, ins, attrs, op=None):
+    """Circular correlation (reference conv_shift_op.cc): X [B, M],
+    Y [B, N] with N odd, N <= M; Out[b, i] = sum_j X[b, (i+j-N/2) % M]
+    * Y[b, j]."""
+    x, y = ins["X"], ins["Y"]
+    m = x.shape[1]
+    n = y.shape[1]
+    half = n // 2
+    # gather the N circularly-shifted views: [B, M, N]
+    offs = (jnp.arange(m)[:, None] + jnp.arange(n)[None, :] - half) % m
+    gathered = x[:, offs]                       # [B, M, N]
+    return {"Out": jnp.einsum("bmn,bn->bm", gathered, y)}
+
+
+@register_op("fake_dequantize_max_abs", grad_maker=None)
+def _fake_dequantize_max_abs(ctx, ins, attrs, op=None):
+    """Out = Scale * X / max_range (reference fake_dequantize_op.cc) —
+    the int8 simulation's dequantize step."""
+    x = ins["X"].astype(jnp.float32)
+    scale = ins["Scale"].reshape(()).astype(jnp.float32)
+    max_range = float(attrs.get("max_range", 127.0))
+    return {"Out": x * scale / max_range}
+
+
+@register_op("polygon_box_transform", grad_maker=None)
+def _polygon_box_transform(ctx, ins, attrs, op=None):
+    """EAST-style geometry decode (reference
+    polygon_box_transform_op.cc): input [N, K*2, H, W] per-pixel
+    offsets; output = pixel coordinate (index*4) minus the offset at
+    even channels (x) / odd channels (y)."""
+    x = ins["Input"]
+    n, c, h, w = x.shape
+    xs = jnp.arange(w, dtype=x.dtype)[None, None, None, :] * 4
+    ys = jnp.arange(h, dtype=x.dtype)[None, None, :, None] * 4
+    is_x = (jnp.arange(c) % 2 == 0)[None, :, None, None]
+    base = jnp.where(is_x, xs, ys)
+    return {"Output": base - x}
+
+
+def _pool_index_common(x, ksize, strides, paddings):
+    """Max pool returning values + flat argmax within each input map
+    (reference pool_with_index_op.h: Mask holds h*W + w)."""
+    n, c, h, w = x.shape
+    kh, kw = ksize
+    sh, sw = strides
+    ph, pw = paddings
+    oh = (h + 2 * ph - kh) // sh + 1
+    ow = (w + 2 * pw - kw) // sw + 1
+    neg = jnp.finfo(x.dtype).min
+    xp = jnp.pad(x, ((0, 0), (0, 0), (ph, ph), (pw, pw)),
+                 constant_values=neg)
+    # index map of the ORIGINAL coordinates, padded with -1
+    flat_idx = (jnp.arange(h)[:, None] * w +
+                jnp.arange(w)[None, :]).astype(jnp.int32)
+    idxp = jnp.pad(flat_idx, ((ph, ph), (pw, pw)), constant_values=-1)
+
+    # extract windows: [OH, OW, KH, KW] index grids
+    hh = (jnp.arange(oh)[:, None] * sh + jnp.arange(kh)[None, :])
+    ww = (jnp.arange(ow)[:, None] * sw + jnp.arange(kw)[None, :])
+    win = xp[:, :, hh[:, :, None, None], ww[None, None, :, :]]
+    # win: [N, C, OH, KH, OW, KW] -> [N, C, OH, OW, KH*KW]
+    win = jnp.moveaxis(win, 3, 4).reshape(n, c, oh, ow, kh * kw)
+    arg = jnp.argmax(win, axis=-1)
+    out = jnp.max(win, axis=-1)
+    iwin = idxp[hh[:, :, None, None], ww[None, None, :, :]]
+    iwin = jnp.moveaxis(iwin, 1, 2).reshape(oh, ow, kh * kw)
+    mask = jnp.take_along_axis(
+        jnp.broadcast_to(iwin, (n, c, oh, ow, kh * kw)),
+        arg[..., None], axis=-1)[..., 0]
+    return out, mask.astype(jnp.int32)
+
+
+@register_op("max_pool2d_with_index",
+             no_vjp_outputs=("Mask",))
+def _max_pool2d_with_index(ctx, ins, attrs, op=None):
+    x = ins["X"]
+    ksize = [int(k) for k in attrs["ksize"]]
+    strides = [int(s) for s in attrs.get("strides", [1, 1])]
+    paddings = [int(p) for p in attrs.get("paddings", [0, 0])]
+    out, mask = _pool_index_common(x, ksize, strides, paddings)
+    return {"Out": out, "Mask": mask}
+
+
+@register_op("unpool")
+def _unpool(ctx, ins, attrs, op=None):
+    """Max unpooling (reference unpool_op.cc): scatter X back to the
+    positions recorded in Indices; everything else zero."""
+    x = ins["X"]                      # [N, C, OH, OW]
+    idx = ins["Indices"].astype(jnp.int32)
+    ksize = [int(k) for k in attrs["ksize"]]
+    strides = [int(s) for s in attrs.get("strides", [1, 1])]
+    paddings = [int(p) for p in attrs.get("paddings", [0, 0])]
+    n, c, oh, ow = x.shape
+    h = (oh - 1) * strides[0] - 2 * paddings[0] + ksize[0]
+    w = (ow - 1) * strides[1] - 2 * paddings[1] + ksize[1]
+    flat = jnp.zeros((n, c, h * w), x.dtype)
+    sc = idx.reshape(n, c, oh * ow)
+    # -1 marks pad-region argmax (never selected in practice): drop via
+    # out-of-bounds scatter
+    sc = jnp.where(sc < 0, h * w, sc)
+    # ASSIGN, not add: overlapping pooling windows record the same
+    # source index several times and must not sum it (reference
+    # unpool_op.h writes output[index] = input[i])
+    flat = flat.at[
+        jnp.arange(n)[:, None, None],
+        jnp.arange(c)[None, :, None], sc].set(
+        x.reshape(n, c, oh * ow))
+    return {"Out": flat.reshape(n, c, h, w)}
+
+
+@register_op("roi_pool", no_vjp_outputs=("Argmax",))
+def _roi_pool(ctx, ins, attrs, op=None):
+    """ROI max pooling (reference roi_pool_op.cc): X [N,C,H,W]; ROIs
+    [R, 5] rows [batch_idx, x1, y1, x2, y2] (image coordinates, scaled
+    by spatial_scale).  Out [R, C, PH, PW]."""
+    x = ins["X"]
+    rois = ins["ROIs"].astype(jnp.float32)
+    scale = float(attrs.get("spatial_scale", 1.0))
+    ph = int(attrs["pooled_height"])
+    pw = int(attrs["pooled_width"])
+    n, c, h, w = x.shape
+
+    def one_roi(roi):
+        b = roi[0].astype(jnp.int32)
+        x1 = jnp.round(roi[1] * scale).astype(jnp.int32)
+        y1 = jnp.round(roi[2] * scale).astype(jnp.int32)
+        x2 = jnp.round(roi[3] * scale).astype(jnp.int32)
+        y2 = jnp.round(roi[4] * scale).astype(jnp.int32)
+        rh = jnp.maximum(y2 - y1 + 1, 1).astype(jnp.float32)
+        rw = jnp.maximum(x2 - x1 + 1, 1).astype(jnp.float32)
+        img = x[b]                    # [C, H, W]
+        ys = jnp.arange(h)
+        xs = jnp.arange(w)
+
+        # cells are masked full-map reductions rather than a one-pass
+        # segment max: the reference's floor/ceil boundaries make
+        # adjacent cells OVERLAP (a pixel may win two cells), which a
+        # pixel->one-cell bucketing cannot express.  PH/PW are small
+        # constants (7x7 in standard configs), so the unroll is bounded.
+        def cell(i, j):
+            hstart = y1 + jnp.floor(i * rh / ph).astype(jnp.int32)
+            hend = y1 + jnp.ceil((i + 1) * rh / ph).astype(jnp.int32)
+            wstart = x1 + jnp.floor(j * rw / pw).astype(jnp.int32)
+            wend = x1 + jnp.ceil((j + 1) * rw / pw).astype(jnp.int32)
+            m = ((ys[:, None] >= hstart) & (ys[:, None] < hend) &
+                 (xs[None, :] >= wstart) & (xs[None, :] < wend))
+            neg = jnp.finfo(x.dtype).min
+            vals = jnp.where(m[None], img, neg).reshape(c, -1)
+            best = vals.max(axis=1)
+            arg = vals.argmax(axis=1).astype(jnp.int32)
+            any_m = jnp.any(m)
+            return jnp.where(any_m, best, 0.0), \
+                jnp.where(any_m, arg, 0)
+
+        pairs = [[cell(i, j) for j in range(pw)] for i in range(ph)]
+        cells = jnp.stack(
+            [jnp.stack([pairs[i][j][0] for j in range(pw)], axis=-1)
+             for i in range(ph)], axis=-2)
+        args = jnp.stack(
+            [jnp.stack([pairs[i][j][1] for j in range(pw)], axis=-1)
+             for i in range(ph)], axis=-2)
+        return cells, args             # each [C, PH, PW]
+
+    out, argmax = jax.vmap(one_roi)(rois)
+    return {"Out": out, "Argmax": argmax}
+
+
+@_host("positive_negative_pair")
+def _positive_negative_pair(executor, op, scope, feed, env=None):
+    """Ranking-pair metric (reference positive_negative_pair_op.cc):
+    within each query id, count prediction-score pairs ordered
+    consistently (positive) / inconsistently (negative) with the label
+    order; a score tie increments NeutralPair by 1."""
+    def read(name):
+        for src in (env, feed):
+            if src is not None and name in src:
+                return np.asarray(src[name])
+        return np.asarray(scope.find_var(name))
+
+    score = read(op.input("Score")[0]).reshape(-1)
+    label = read(op.input("Label")[0]).reshape(-1)
+    qid = read(op.input("QueryID")[0]).reshape(-1)
+    pos = neg = neu = 0.0
+    for q in np.unique(qid):
+        idx = np.where(qid == q)[0]
+        for a in range(len(idx)):
+            for b in range(a + 1, len(idx)):
+                i, j = idx[a], idx[b]
+                if label[i] == label[j]:
+                    continue
+                ds = score[i] - score[j]
+                dl = label[i] - label[j]
+                if ds == 0:
+                    neu += 1
+                elif (ds > 0) == (dl > 0):
+                    pos += 1
+                else:
+                    neg += 1
+    outs = {"PositivePair": pos, "NegativePair": neg,
+            "NeutralPair": neu}
+    for slot, val in outs.items():
+        names = op.outputs.get(slot) or []
+        if names and names[0]:
+            arr = np.asarray([val], np.float32)
+            if env is not None:
+                env[names[0]] = arr
+            (scope.find_scope_of(names[0]) or scope).set(names[0], arr)

@@ -1,0 +1,151 @@
+"""Specialty ops closing the reference op census (reference
+operators/{conv_shift,fake_dequantize,polygon_box_transform,
+pool_with_index,unpool,roi_pool,positive_negative_pair}_op.cc),
+pinned against hand-computed values."""
+import numpy as np
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.core.registry import get_op_info
+from paddle_tpu.core.lowering import Ins, LoweringContext
+from paddle_tpu.core.desc import ProgramDesc
+
+import jax
+import jax.numpy as jnp
+
+
+def _run(op_type, ins, attrs=None):
+    ctx = LoweringContext(ProgramDesc(), 0, {}, jax.random.PRNGKey(0),
+                          "train")
+    wrapped = {k: [jnp.asarray(v)] for k, v in ins.items()}
+    return get_op_info(op_type).lower(ctx, Ins(wrapped), attrs or {},
+                                      None)
+
+
+def test_conv_shift_matches_naive():
+    rng = np.random.RandomState(0)
+    x = rng.randn(2, 7).astype(np.float32)
+    y = rng.randn(2, 3).astype(np.float32)
+    got = np.asarray(_run("conv_shift", {"X": x, "Y": y})["Out"])
+    want = np.zeros_like(x)
+    m, n = 7, 3
+    for b in range(2):
+        for i in range(m):
+            for j in range(n):
+                want[b, i] += x[b, (i + j - n // 2) % m] * y[b, j]
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+
+
+def test_fake_dequantize():
+    x = np.asarray([[127.0, -63.5]], np.float32)
+    got = _run("fake_dequantize_max_abs",
+               {"X": x, "Scale": np.asarray([0.5], np.float32)},
+               {"max_range": 127.0})["Out"]
+    np.testing.assert_allclose(np.asarray(got), [[0.5, -0.25]],
+                               rtol=1e-6)
+
+
+def test_polygon_box_transform():
+    x = np.ones((1, 4, 2, 2), np.float32)
+    got = np.asarray(_run("polygon_box_transform",
+                          {"Input": x})["Output"])
+    # even channels: x-coord = col*4 - 1; odd: row*4 - 1
+    np.testing.assert_allclose(got[0, 0], [[-1, 3], [-1, 3]])
+    np.testing.assert_allclose(got[0, 1], [[-1, -1], [3, 3]])
+
+
+def test_pool_with_index_and_unpool_roundtrip():
+    rng = np.random.RandomState(1)
+    # positive data: the unpool re-pool check compares against zeros
+    x = rng.rand(2, 3, 4, 4).astype(np.float32) + 0.1
+    outs = _run("max_pool2d_with_index", {"X": x},
+                {"ksize": [2, 2], "strides": [2, 2],
+                 "paddings": [0, 0]})
+    out, mask = np.asarray(outs["Out"]), np.asarray(outs["Mask"])
+    np.testing.assert_allclose(
+        out, x.reshape(2, 3, 2, 2, 2, 2).max(axis=(3, 5)), rtol=1e-6)
+    # mask points at the argmax in the ORIGINAL map
+    flat = x.reshape(2, 3, 16)
+    np.testing.assert_allclose(
+        np.take_along_axis(flat, mask.reshape(2, 3, 4), axis=2),
+        out.reshape(2, 3, 4), rtol=1e-6)
+    # unpool scatters back: re-pooling recovers the same maxima
+    up = _run("unpool", {"X": jnp.asarray(out),
+                         "Indices": jnp.asarray(mask)},
+              {"ksize": [2, 2], "strides": [2, 2],
+               "paddings": [0, 0]})["Out"]
+    up = np.asarray(up)
+    assert up.shape == x.shape
+    np.testing.assert_allclose(
+        up.reshape(2, 3, 2, 2, 2, 2).max(axis=(3, 5)), out, rtol=1e-6)
+    assert np.count_nonzero(up) <= 2 * 3 * 4
+
+
+def test_roi_pool_hand_case():
+    x = np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4)
+    rois = np.asarray([[0, 0, 0, 1, 1],     # top-left 2x2
+                       [0, 2, 2, 3, 3]], np.float32)
+    got = np.asarray(_run("roi_pool", {"X": x, "ROIs": rois},
+                          {"spatial_scale": 1.0, "pooled_height": 1,
+                           "pooled_width": 1})["Out"])
+    np.testing.assert_allclose(got[:, 0, 0, 0], [5.0, 15.0])
+
+
+def test_positive_negative_pair():
+    main, startup = fluid.Program(), fluid.Program()
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        with fluid.program_guard(main, startup):
+            with fluid.unique_name.guard():
+                s = fluid.layers.data(name="s", shape=[1],
+                                      dtype="float32")
+                l = fluid.layers.data(name="l", shape=[1],
+                                      dtype="float32")
+                q = fluid.layers.data(name="q", shape=[1],
+                                      dtype="int64")
+                helper = fluid.layer_helper.LayerHelper("pnp")
+                pos = helper.create_tmp_variable("float32")
+                neg = helper.create_tmp_variable("float32")
+                neu = helper.create_tmp_variable("float32")
+                helper.append_op(
+                    type="positive_negative_pair",
+                    inputs={"Score": [s], "Label": [l],
+                            "QueryID": [q]},
+                    outputs={"PositivePair": [pos],
+                             "NegativePair": [neg],
+                             "NeutralPair": [neu]})
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        # query 0: scores (3,1) labels (1,0) -> positive pair
+        #          scores (3,2) labels (1,1) -> same label, skipped
+        # query 1: scores (1,2) labels (1,0) -> negative pair
+        got = exe.run(main, feed={
+            "s": np.asarray([[3], [1], [3], [1], [2]], np.float32),
+            "l": np.asarray([[1], [0], [1], [1], [0]], np.float32),
+            "q": np.asarray([[0], [0], [0], [1], [1]], np.int64)},
+            fetch_list=[pos, neg, neu])
+    p, n, u = [float(np.ravel(g)[0]) for g in got]
+    assert (p, n, u) == (2.0, 1.0, 0.0)
+
+
+def test_unpool_overlapping_windows_assigns_once():
+    x = np.zeros((1, 1, 3, 3), np.float32)
+    x[0, 0, 1, 1] = 5.0
+    outs = _run("max_pool2d_with_index", {"X": x},
+                {"ksize": [2, 2], "strides": [1, 1],
+                 "paddings": [0, 0]})
+    up = _run("unpool", {"X": outs["Out"], "Indices": outs["Mask"]},
+              {"ksize": [2, 2], "strides": [1, 1],
+               "paddings": [0, 0]})["Out"]
+    # every window recorded index (1,1); unpool must ASSIGN 5, not 20
+    np.testing.assert_allclose(np.asarray(up)[0, 0, 1, 1], 5.0)
+
+
+def test_roi_pool_argmax():
+    x = np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4)
+    rois = np.asarray([[0, 0, 0, 1, 1]], np.float32)
+    outs = _run("roi_pool", {"X": x, "ROIs": rois},
+                {"spatial_scale": 1.0, "pooled_height": 1,
+                 "pooled_width": 1})
+    # max of the top-left 2x2 is 5 at flat index 5
+    np.testing.assert_allclose(np.asarray(outs["Out"])[0, 0, 0, 0], 5.0)
+    assert int(np.asarray(outs["Argmax"])[0, 0, 0, 0]) == 5
