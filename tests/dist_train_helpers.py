@@ -97,7 +97,8 @@ def run_local_baseline(steps, kind="softmax"):
     return losses
 
 
-def _transpile(trainer_id, pservers, trainers, kind="softmax"):
+def _transpile(trainer_id, pservers, trainers, kind="softmax",
+               sync_mode=True):
     import paddle_tpu.fluid as fluid
 
     main, startup = fluid.Program(), fluid.Program()
@@ -109,15 +110,17 @@ def _transpile(trainer_id, pservers, trainers, kind="softmax"):
     t = fluid.DistributeTranspiler()
     t.transpile(trainer_id=trainer_id, program=main,
                 startup_program=startup, pservers=pservers,
-                trainers=trainers, min_block_size=64)
+                trainers=trainers, min_block_size=64,
+                sync_mode=sync_mode)
     return t, main, startup, scope, loss
 
 
-def run_pserver(endpoint, pservers, trainers, kind="softmax"):
+def run_pserver(endpoint, pservers, trainers, kind="softmax",
+                sync_mode=True):
     import paddle_tpu.fluid as fluid
 
     t, main, startup, scope, loss = _transpile(0, pservers, trainers,
-                                               kind)
+                                               kind, sync_mode)
     ps_prog = t.get_pserver_program(endpoint)
     ps_startup = t.get_startup_program(endpoint, ps_prog)
     exe = fluid.Executor(fluid.CPUPlace())
@@ -127,12 +130,13 @@ def run_pserver(endpoint, pservers, trainers, kind="softmax"):
 
 
 def run_trainer(trainer_id, pservers, trainers, steps, queue,
-                kind="softmax"):
+                kind="softmax", sync_mode=True):
     import paddle_tpu.fluid as fluid
     from paddle_tpu.distributed.rpc import RPCClient
 
     t, main, startup, scope, loss = _transpile(trainer_id, pservers,
-                                               trainers, kind)
+                                               trainers, kind,
+                                               sync_mode)
     exe = fluid.Executor(fluid.CPUPlace())
     with fluid.scope_guard(scope):
         exe.run(startup)
