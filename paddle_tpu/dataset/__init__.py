@@ -9,5 +9,11 @@ from . import mnist  # noqa: F401
 from . import cifar  # noqa: F401
 from . import uci_housing  # noqa: F401
 from . import flowers  # noqa: F401
+from . import imdb  # noqa: F401
+from . import imikolov  # noqa: F401
+from . import movielens  # noqa: F401
+from . import conll05  # noqa: F401
+from . import wmt14  # noqa: F401
 
-__all__ = ["common", "mnist", "cifar", "uci_housing", "flowers"]
+__all__ = ["common", "mnist", "cifar", "uci_housing", "flowers",
+           "imdb", "imikolov", "movielens", "conll05", "wmt14"]

@@ -1,0 +1,138 @@
+"""IMDB movie-review sentiment (parity: python/paddle/dataset/imdb.py —
+build_dict/word_dict over the aclImdb tarball, train(word_idx)/
+test(word_idx) yielding (token-id list, 0/1 label)).
+
+Parses the real aclImdb tarball when cached under DATA_HOME; otherwise a
+deterministic synthetic corpus with class-conditional word distributions
+(positive reviews oversample the low word ids, negative the high ones),
+so sentiment models genuinely learn from it.
+"""
+from __future__ import annotations
+
+import re
+import string
+import tarfile
+
+import numpy as np
+
+from . import common
+
+__all__ = ["build_dict", "word_dict", "train", "test", "is_synthetic"]
+
+URL = ("http://ai.stanford.edu/%7Eamaas/data/sentiment/aclImdb_v1.tar.gz")
+MD5 = "7c2ac02c03563afcf9b574c7e56c153a"
+
+_SYN_VOCAB = 1000
+_SYN_TRAIN = 600
+_SYN_TEST = 120
+_SYN_MAXLEN = 60
+
+
+_IS_SYNTHETIC = None
+
+
+def is_synthetic():
+    global _IS_SYNTHETIC
+    if _IS_SYNTHETIC is None:
+        try:
+            common.download(URL, "imdb", MD5)
+            _IS_SYNTHETIC = False
+        except (FileNotFoundError, IOError):
+            _IS_SYNTHETIC = True
+    return _IS_SYNTHETIC
+
+
+def tokenize(pattern):
+    """Yield each matching file in the cached tarball as a token list
+    (reference imdb.py:35)."""
+    path = common.download(URL, "imdb", MD5)
+    with tarfile.open(path) as tarf:
+        tf = tarf.next()
+        while tf is not None:
+            if bool(pattern.match(tf.name)):
+                data = tarf.extractfile(tf).read().decode("latin-1")
+                data = data.rstrip("\n\r").translate(
+                    str.maketrans("", "", string.punctuation)).lower()
+                yield data.split()
+            tf = tarf.next()
+
+
+def build_dict(pattern, cutoff):
+    """word -> id by descending frequency, words rarer than ``cutoff``
+    dropped, '<unk>' appended last (reference imdb.py:54)."""
+    word_freq = {}
+    for doc in tokenize(pattern):
+        for word in doc:
+            word_freq[word] = word_freq.get(word, 0) + 1
+    word_freq = [x for x in word_freq.items() if x[1] > cutoff]
+    dictionary = sorted(word_freq, key=lambda x: (-x[1], x[0]))
+    words, _ = list(zip(*dictionary))
+    word_idx = dict(list(zip(words, list(range(len(words))))))
+    word_idx["<unk>"] = len(words)
+    return word_idx
+
+
+def _synthetic_word_dict():
+    d = {"w%04d" % i: i for i in range(_SYN_VOCAB)}
+    d["<unk>"] = _SYN_VOCAB
+    return d
+
+
+def word_dict():
+    try:
+        return build_dict(
+            re.compile(r"aclImdb/((train)|(test))/((pos)|(neg))/.*\.txt$"),
+            150)
+    except (FileNotFoundError, IOError):
+        return _synthetic_word_dict()
+
+
+def _synthetic_reader(n, seed):
+    def reader():
+        rng = np.random.RandomState(seed)
+        half = _SYN_VOCAB // 2
+        for _ in range(n):
+            label = int(rng.randint(0, 2))
+            length = int(rng.randint(8, _SYN_MAXLEN))
+            # positive (label 0) docs skew to ids [0, half), negative
+            # (label 1) to [half, V)
+            biased = rng.randint(0, half, length) + (half if label else 0)
+            uniform = rng.randint(0, _SYN_VOCAB, length)
+            take = rng.rand(length) < 0.75
+            doc = np.where(take, biased, uniform).astype(np.int64)
+            yield doc.tolist(), label
+
+    return reader
+
+
+def _real_reader(pos_pattern, neg_pattern, word_idx):
+    unk = word_idx["<unk>"]
+
+    def load(pattern, out, label):
+        for doc in tokenize(pattern):
+            out.append(([word_idx.get(w, unk) for w in doc], label))
+
+    def reader():
+        data = []
+        load(pos_pattern, data, 0)
+        load(neg_pattern, data, 1)
+        for doc, label in data:
+            yield doc, label
+
+    return reader
+
+
+def train(word_idx):
+    """(token ids, label) per review; label 0 = positive like the
+    reference (reference imdb.py:92)."""
+    if is_synthetic():
+        return _synthetic_reader(_SYN_TRAIN, seed=11)
+    return _real_reader(re.compile(r"aclImdb/train/pos/.*\.txt$"),
+                        re.compile(r"aclImdb/train/neg/.*\.txt$"), word_idx)
+
+
+def test(word_idx):
+    if is_synthetic():
+        return _synthetic_reader(_SYN_TEST, seed=13)
+    return _real_reader(re.compile(r"aclImdb/test/pos/.*\.txt$"),
+                        re.compile(r"aclImdb/test/neg/.*\.txt$"), word_idx)

@@ -1,0 +1,141 @@
+"""PTB language-model dataset (parity: python/paddle/dataset/imikolov.py
+— build_dict over ptb.train.txt, train/test readers in NGRAM mode
+(word2vec's 5-gram tuples) or SEQ mode ((src, trg) shifted id lists)).
+
+Parses the real simple-examples tarball when cached; otherwise a
+deterministic synthetic corpus from a sparse first-order Markov chain,
+so n-gram models have real structure to fit.
+"""
+from __future__ import annotations
+
+import tarfile
+
+import numpy as np
+
+from . import common
+
+__all__ = ["build_dict", "train", "test", "DataType", "is_synthetic"]
+
+URL = "http://www.fit.vutbr.cz/~imikolov/rnnlm/simple-examples.tgz"
+MD5 = "30177ea32e27c525793142b6bf2c8e2d"
+
+_SYN_VOCAB = 200
+_SYN_TRAIN_SENT = 500
+_SYN_TEST_SENT = 80
+
+
+class DataType(object):
+    NGRAM = 1
+    SEQ = 2
+
+
+_IS_SYNTHETIC = None
+
+
+def is_synthetic():
+    global _IS_SYNTHETIC
+    if _IS_SYNTHETIC is None:
+        try:
+            common.download(URL, "imikolov", MD5)
+            _IS_SYNTHETIC = False
+        except (FileNotFoundError, IOError):
+            _IS_SYNTHETIC = True
+    return _IS_SYNTHETIC
+
+
+def word_count(f, word_freq=None):
+    if word_freq is None:
+        word_freq = {}
+    for line in f:
+        if isinstance(line, bytes):
+            line = line.decode("utf-8")
+        for w in line.strip().split():
+            word_freq[w] = word_freq.get(w, 0) + 1
+        word_freq["<s>"] = word_freq.get("<s>", 0) + 1
+        word_freq["<e>"] = word_freq.get("<e>", 0) + 1
+    return word_freq
+
+
+def _synthetic_sentences(n_sent, seed):
+    """Markov-chain sentences: each word strongly prefers a fixed set of
+    successors, so 5-gram context is predictive."""
+    rng = np.random.RandomState(seed)
+    succ = np.random.RandomState(3).randint(0, _SYN_VOCAB, (_SYN_VOCAB, 4))
+    for _ in range(n_sent):
+        length = int(rng.randint(5, 25))
+        w = int(rng.randint(0, _SYN_VOCAB))
+        sent = [w]
+        for _ in range(length - 1):
+            if rng.rand() < 0.8:
+                w = int(succ[w, rng.randint(0, 4)])
+            else:
+                w = int(rng.randint(0, _SYN_VOCAB))
+            sent.append(w)
+        yield ["w%03d" % i for i in sent]
+
+
+def build_dict(min_word_freq=50):
+    """word -> id, most-frequent first, '<unk>' last (reference
+    imikolov.py:49)."""
+    if is_synthetic():
+        d = {"w%03d" % i: i for i in range(_SYN_VOCAB)}
+        d["<s>"] = _SYN_VOCAB
+        d["<e>"] = _SYN_VOCAB + 1
+        d["<unk>"] = _SYN_VOCAB + 2
+        return d
+    path = common.download(URL, "imikolov", MD5)
+    with tarfile.open(path) as tf:
+        trainf = tf.extractfile("./simple-examples/data/ptb.train.txt")
+        word_freq = word_count(trainf)
+    if "<unk>" in word_freq:
+        word_freq.pop("<unk>")
+    word_freq = [x for x in word_freq.items() if x[1] > min_word_freq]
+    dictionary = sorted(word_freq, key=lambda x: (-x[1], x[0]))
+    words, _ = list(zip(*dictionary))
+    word_idx = dict(list(zip(words, list(range(len(words))))))
+    word_idx["<unk>"] = len(words)
+    return word_idx
+
+
+def _sentence_source(is_test):
+    if is_synthetic():
+        return list(_synthetic_sentences(
+            _SYN_TEST_SENT if is_test else _SYN_TRAIN_SENT,
+            seed=23 if is_test else 19))
+    path = common.download(URL, "imikolov", MD5)
+    name = ("./simple-examples/data/ptb.valid.txt" if is_test
+            else "./simple-examples/data/ptb.train.txt")
+    with tarfile.open(path) as tf:
+        f = tf.extractfile(name)
+        return [line.decode("utf-8").strip().split() for line in f]
+
+
+def reader_creator(word_idx, n, data_type, is_test):
+    def reader():
+        unk = word_idx["<unk>"]
+        for sent in _sentence_source(is_test):
+            if DataType.NGRAM == data_type:
+                assert n > -1, "Invalid gram length"
+                ids = (["<s>"] + sent + ["<e>"])
+                ids = [word_idx.get(w, unk) for w in ids]
+                for i in range(n, len(ids) + 1):
+                    yield tuple(ids[i - n:i])
+            elif DataType.SEQ == data_type:
+                ids = [word_idx.get(w, unk) for w in sent]
+                src_seq = [word_idx["<s>"]] + ids
+                trg_seq = ids + [word_idx["<e>"]]
+                if n > 0 and len(src_seq) > n:
+                    continue
+                yield src_seq, trg_seq
+            else:
+                assert False, "Unknown data type"
+
+    return reader
+
+
+def train(word_idx, n, data_type=DataType.NGRAM):
+    return reader_creator(word_idx, n, data_type, is_test=False)
+
+
+def test(word_idx, n, data_type=DataType.NGRAM):
+    return reader_creator(word_idx, n, data_type, is_test=True)

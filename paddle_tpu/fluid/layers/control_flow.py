@@ -403,6 +403,17 @@ class DynamicRNN(_RNNBase):
     def block(self):
         return self._guard()
 
+    def memory(self, init=None, shape=None, value=0.0, need_reorder=False,
+               dtype="float32", batch_ref=None, init_value=None):
+        """DynamicRNN's parameter order (reference control_flow.py:1460:
+        memory(init, shape, value, need_reorder, dtype)) — positional
+        calls ported from the reference bind correctly.  The StaticRNN
+        spellings (batch_ref=, init_value=) stay accepted as keywords."""
+        return super().memory(
+            init=init, shape=shape, batch_ref=batch_ref,
+            init_value=value if init_value is None else init_value,
+            need_reorder=need_reorder, dtype=dtype)
+
 
 # ---------------------------------------------------------------------------
 # ConditionalBlock / Switch / IfElse

@@ -6,7 +6,9 @@ network builder(s) plus a ``get_model(...)`` returning
 (loss, feeds, extra_fetches) built into the current default program.
 """
 from . import (mnist, resnet, vgg, transformer,  # noqa: F401
-               stacked_dynamic_lstm, machine_translation)
+               stacked_dynamic_lstm, machine_translation,
+               understand_sentiment, recommender, label_semantic_roles)
 
 __all__ = ["mnist", "resnet", "vgg", "transformer",
-           "stacked_dynamic_lstm", "machine_translation"]
+           "stacked_dynamic_lstm", "machine_translation",
+           "understand_sentiment", "recommender", "label_semantic_roles"]

@@ -1,0 +1,142 @@
+"""The remaining book chapters end-to-end (reference book tests:
+notest_understand_sentiment, test_recommender_system,
+test_label_semantic_roles) on their dataset adapters' synthetic
+fallbacks — each must genuinely train, not just run."""
+import itertools
+
+import numpy as np
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu import dataset
+
+
+def _batches(reader, batch_size):
+    it = reader()
+    while True:
+        b = list(itertools.islice(it, batch_size))
+        if len(b) < batch_size:
+            return
+        yield b
+
+
+def test_understand_sentiment_conv(prog_scope, exe):
+    from paddle_tpu.models.understand_sentiment import get_model
+    main, startup, scope = prog_scope
+    word_dict = dataset.imdb.word_dict()
+    loss, feeds, (acc,) = get_model(dict_dim=len(word_dict), net="conv",
+                                    learning_rate=0.05)
+    exe.run(startup)
+    feeder = fluid.DataFeeder(feeds, program=main)
+    train = dataset.imdb.train(word_dict)
+
+    ls = []
+    for _ in range(3):  # epochs over the synthetic corpus
+        for batch in _batches(train, 32):
+            batch = [(doc, [label]) for doc, label in batch]
+            l, = exe.run(main, feed=feeder.feed(batch), fetch_list=[loss])
+            ls.append(float(np.asarray(l).ravel()[0]))
+    # class-conditional word distributions are separable: conv tower
+    # must cut the initial ~0.693 binary cross-entropy roughly in half
+    assert ls[-1] < 0.4, (ls[0], ls[-1])
+
+
+def test_understand_sentiment_dyn_rnn(prog_scope, exe):
+    from paddle_tpu.models.understand_sentiment import get_model
+    main, startup, scope = prog_scope
+    loss, feeds, _ = get_model(dict_dim=200, net="dyn_rnn", emb_dim=16,
+                               hid_dim=32, learning_rate=0.05)
+    exe.run(startup)
+    feeder = fluid.DataFeeder(feeds, program=main)
+    rng = np.random.RandomState(5)
+    ls = []
+    for _ in range(40):
+        batch = []
+        for _ in range(16):
+            y = int(rng.randint(0, 2))
+            L = int(rng.randint(3, 10))
+            toks = rng.randint(0, 100, L) + (100 if y else 0)
+            batch.append((toks.tolist(), [y]))
+        l, = exe.run(main, feed=feeder.feed(batch), fetch_list=[loss])
+        ls.append(float(np.asarray(l).ravel()[0]))
+    assert ls[-1] < 0.45, (ls[0], ls[-1])
+
+
+def test_recommender_system(prog_scope, exe):
+    from paddle_tpu.models.recommender import get_model
+    main, startup, scope = prog_scope
+    loss, feeds, _ = get_model(learning_rate=0.3)
+    exe.run(startup)
+    feeder = fluid.DataFeeder(feeds, program=main)
+
+    epoch_means = []
+    for _ in range(6):
+        ls = []
+        for batch in _batches(dataset.movielens.train(), 64):
+            l, = exe.run(main, feed=feeder.feed(batch), fetch_list=[loss])
+            ls.append(float(np.asarray(l).ravel()[0]))
+        epoch_means.append(float(np.mean(ls)))
+    # synthetic ratings follow the model's own cos-similarity form;
+    # must beat predict-the-mean (~6.5 MSE on the +-5 scale) and keep
+    # improving epoch over epoch
+    assert epoch_means[-1] < epoch_means[0] * 0.85, epoch_means
+    assert epoch_means[-1] < 6.2, epoch_means
+
+
+def test_machine_translation_wmt14(prog_scope, exe):
+    """Seq2seq-attention on the wmt14 adapter's permutation-cipher
+    synthetic corpus (reference book test_machine_translation trains on
+    the real wmt14)."""
+    from paddle_tpu.models.machine_translation import get_model
+    main, startup, scope = prog_scope
+    dict_size = 80
+    loss, feeds, _ = get_model(src_dict_dim=dict_size,
+                               trg_dict_dim=dict_size, emb_dim=32,
+                               hidden_dim=32, learning_rate=1e-2)
+    exe.run(startup)
+    feeder = fluid.DataFeeder(feeds, program=main)
+    src_dict, trg_dict = dataset.wmt14.get_dict(dict_size)
+    assert len(src_dict) == dict_size and src_dict[0] == "<s>"
+
+    ls = []
+    for _ in range(8):
+        for batch in _batches(dataset.wmt14.train(dict_size), 16):
+            l, = exe.run(main, feed=feeder.feed(batch), fetch_list=[loss])
+            ls.append(float(np.asarray(l).ravel()[0]))
+    # token-level cipher: cross-entropy must fall far below its
+    # ln(dict_size)~4.4 start once attention locks on (~epoch 6)
+    assert ls[-1] < ls[0] * 0.5, (ls[0], ls[-1])
+
+
+def test_label_semantic_roles(prog_scope, exe):
+    from paddle_tpu.models.label_semantic_roles import get_model
+    main, startup, scope = prog_scope
+    word_dict, verb_dict, label_dict = dataset.conll05.get_dict()
+    loss, feeds, (crf_decode,) = get_model(
+        word_dict_len=len(word_dict), label_dict_len=len(label_dict),
+        pred_dict_len=len(verb_dict), hidden_dim=64, depth=2,
+        train_word_emb=True, learning_rate=0.1)
+    exe.run(startup)
+    feeder = fluid.DataFeeder(feeds, program=main)
+
+    epoch_first, epoch_last = [], []
+    for _ in range(3):
+        ls = []
+        for batch in _batches(dataset.conll05.test(), 16):
+            l, = exe.run(main, feed=feeder.feed(batch), fetch_list=[loss])
+            ls.append(float(np.asarray(l).ravel()[0]))
+        assert np.isfinite(ls).all()
+        epoch_first.append(ls[0])
+        epoch_last.append(ls[-1])
+    # per-sequence CRF NLL starts at ~len*ln(K)~31; it must fall hard
+    # within the first epoch and keep improving across epochs (full
+    # convergence takes hours even in the reference — not a unit test)
+    assert epoch_last[0] < epoch_first[0] * 0.85, (epoch_first, epoch_last)
+    assert epoch_last[-1] < epoch_last[0], (epoch_first, epoch_last)
+
+    # decode path: predicted tags are valid label ids with plausible
+    # agreement given the label/word correlation in the synthetic corpus
+    batch = next(_batches(dataset.conll05.test(), 8))
+    decoded, = exe.run(main, feed=feeder.feed(batch),
+                       fetch_list=[crf_decode])
+    decoded = np.asarray(decoded)
+    assert decoded.min() >= 0 and decoded.max() < len(label_dict)
