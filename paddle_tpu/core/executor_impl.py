@@ -18,6 +18,7 @@ from __future__ import annotations
 
 import sys
 import time
+import warnings
 
 import jax
 import jax.numpy as jnp
@@ -212,17 +213,19 @@ class ExecutorCore:
         for i, name in enumerate(entry.input_names):
             target = (entry.input_shardings[i]
                       if entry.input_shardings is not None else dev)
+            if target is None:  # auto-layout path: feeds use the device
+                target = dev
             if name in feed:
                 val = feed[name]
                 vd = block.find_var_recursive(name)
                 if vd is not None and not hasattr(val, "dtype"):
                     val = np.asarray(val, dtype=proto_to_np_dtype(vd.dtype))
-                args.append(jax.device_put(val, target))
+                args.append(_put(val, target))
             else:
                 # Always commit to the target device: mixing committed and
                 # uncommitted arrays across steps would miss jit's C++ cache
                 # and recompile (device_put is a no-op when already there).
-                args.append(jax.device_put(scope.find_var(name), target))
+                args.append(_put(scope.find_var(name), target))
         seed, counter = self._rng_counter(program, scope)
 
         fetches, persists = entry.fn(tuple(args), seed, counter)
@@ -332,13 +335,21 @@ class ExecutorCore:
             jit_kwargs["out_shardings"] = (
                 tuple(repl for _ in fetch_list),
                 tuple(shard_of(n) for n in persist_outs))
-        jflat = jax.jit(fn_flat, **jit_kwargs)
-
         # Pin trace/compile/execute to the place's device: with zero inputs
         # (every startup program) nothing else commits the computation, and
         # jit would otherwise compile for the process-default backend — e.g.
         # a CPUPlace startup run landing on the host's TPU.
         pin = None if self.mesh is not None else self.place.jax_device()
+
+        if (pin is not None and pin.platform == "tpu" and FLAGS.auto_layout
+                and input_names):
+            entry = self._build_auto_layout(
+                fn_flat, jit_kwargs, input_names, persist_outs, fetch_list,
+                block, feed, scope, pin)
+            if entry is not None:
+                return entry
+
+        jflat = jax.jit(fn_flat, **jit_kwargs)
 
         def jfn(inputs, seed, counter):
             if pin is None:
@@ -348,6 +359,68 @@ class ExecutorCore:
 
         return _CacheEntry(jfn, input_names, persist_outs, tuple(fetch_list),
                            input_shardings)
+
+    def _build_auto_layout(self, fn_flat, jit_kwargs, input_names,
+                           persist_outs, fetch_list, block, feed, scope,
+                           dev):
+        """Single-chip fast path: AOT-compile with AUTO argument layouts.
+
+        With default jit, every persistable enters in the row-major
+        argument layout, so XLA inserts per-step relayout copies into the
+        layouts convolution/matmul actually want (and back again for the
+        donated update) — measured at ~20% of the ResNet-50 step.  AUTO
+        lets layout assignment pick the argument layouts; donation then
+        aliases input and output buffers in that SAME layout, so weights
+        live in MXU-preferred form across steps and the copies vanish.
+        device_put into the chosen Format is a one-time cost (a no-op
+        once the scope holds the formatted buffer)."""
+        try:
+            from jax.experimental.layout import Format, Layout
+        except ImportError:
+            return None
+        try:
+            fmt = Format(Layout.AUTO)
+            specs = []
+            for name in input_names:
+                val = feed.get(name)
+                if val is None:
+                    val = scope.find_var(name)
+                if not hasattr(val, "dtype"):
+                    vd = block.find_var_recursive(name)
+                    val = np.asarray(val, dtype=proto_to_np_dtype(vd.dtype)
+                                     if vd is not None else None)
+                specs.append(jax.ShapeDtypeStruct(np.shape(val), val.dtype))
+            specs += [jax.ShapeDtypeStruct((), np.uint32)] * 2
+            kw = dict(jit_kwargs)
+            # feeds keep default layouts (host arrays stream in each step);
+            # persistables get AUTO
+            feed_only = {n for n in input_names
+                         if _in_feed_only(n, feed, scope)}
+            kw["in_shardings"] = tuple(
+                (None if n in feed_only else fmt) for n in input_names
+            ) + (None, None)
+            # fetches need AUTO too: donated AUTO inputs with a
+            # default-layout output subtree is rejected by jax ("Input
+            # layout being donated was AUTO while output layout was
+            # None"); host reads convert on transfer regardless
+            kw["out_shardings"] = (fmt, fmt)  # (fetches, persists)
+            with jax.default_device(dev):
+                compiled = jax.jit(fn_flat, **kw).lower(*specs).compile()
+            in_fmts = compiled.input_formats[0]
+            input_shardings = [
+                (None if n in feed_only else in_fmts[i])
+                for i, n in enumerate(input_names)]
+
+            def jfn(inputs, seed, counter):
+                with jax.default_device(dev):
+                    return compiled(*inputs, seed, counter)
+
+            return _CacheEntry(jfn, input_names, persist_outs,
+                               tuple(fetch_list), input_shardings)
+        except Exception as e:  # any version/platform mismatch: plain jit
+            warnings.warn("auto_layout compile failed (%s); falling back "
+                          "to default layouts" % e)
+            return None
 
     def _run_interpreted(self, program, block, scope, feed, fetch_list, mode):
         dev = self.place.jax_device()
@@ -425,6 +498,26 @@ def _check_op_outputs(op, env):
 
 def _in_feed_only(name, feed, scope):
     return name in feed and not scope.has_var(name)
+
+
+def _put(val, target):
+    """device_put that tolerates Format targets.  The TPU runtime here
+    rejects device_put of a jax.Array onto a Format EVEN when the array
+    already has exactly that layout (the relayout-by-jit path fails on
+    the backend), so the already-formatted steady-state case must be a
+    true no-op, and a genuine relayout goes through the host."""
+    fmt_layout = getattr(target, "layout", None)
+    if fmt_layout is not None and isinstance(val, jax.Array):
+        try:
+            if val.format == target:
+                return val
+        except Exception:
+            pass
+        try:
+            return jax.device_put(val, target)
+        except Exception:
+            return jax.device_put(np.asarray(val), target)
+    return jax.device_put(val, target)
 
 
 def _segment(block):

@@ -67,3 +67,11 @@ define_flag("benchmark", False,
 define_flag("conv_nhwc", False,
             "lower conv2d through NHWC (MXU-preferred layout); the "
             "boundary transposes cancel across conv chains in XLA")
+define_flag("auto_layout", False,
+            "single-device accelerator path: AOT-compile with XLA-chosen "
+            "(AUTO) parameter layouts and keep persistable buffers in "
+            "them across steps.  Experimental knob: measured neutral on "
+            "ResNet-50/transformer (XLA's default argument layouts "
+            "already match; the profile's relayout copies are internal "
+            "to conv scheduling), but it removes boundary copies when a "
+            "model's parameters do want non-default layouts")
