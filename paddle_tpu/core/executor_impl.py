@@ -201,7 +201,8 @@ class ExecutorCore:
             for name, v in feed.items()))
         key = (program.uid, program.version, block_id, feed_spec,
                tuple(fetch_list), mode,
-               bool(getattr(program, "amp_bf16", False)))
+               bool(getattr(program, "amp_bf16", False)),
+               bool(FLAGS.auto_layout))
         entry = self._cache.get(key)
         if entry is None:
             entry = self._build(program, block_id, core_ops, scope, feed,
@@ -363,15 +364,16 @@ class ExecutorCore:
     def _build_auto_layout(self, fn_flat, jit_kwargs, input_names,
                            persist_outs, fetch_list, block, feed, scope,
                            dev):
-        """Single-chip fast path: AOT-compile with AUTO argument layouts.
-
-        With default jit, every persistable enters in the row-major
-        argument layout, so XLA inserts per-step relayout copies into the
-        layouts convolution/matmul actually want (and back again for the
-        donated update) — measured at ~20% of the ResNet-50 step.  AUTO
-        lets layout assignment pick the argument layouts; donation then
-        aliases input and output buffers in that SAME layout, so weights
-        live in MXU-preferred form across steps and the copies vanish.
+        """Single-chip experiment path: AOT-compile with AUTO argument
+        layouts.  AUTO lets XLA's layout assignment pick the parameter
+        layouts; donation then aliases input and output buffers in that
+        SAME layout, so weights stay in whatever form the compiler
+        prefers across steps with no boundary relayouts.  Measured
+        NEUTRAL on ResNet-50 and the transformer LM (the profile's
+        relayout copies turned out to be internal to conv scheduling,
+        not argument-boundary conversions — XLA's default argument
+        layouts already matched), hence FLAGS.auto_layout defaults off;
+        kept for models whose parameters do want non-default layouts.
         device_put into the chosen Format is a one-time cost (a no-op
         once the scope holds the formatted buffer)."""
         try:

@@ -14,6 +14,12 @@ from . import imikolov  # noqa: F401
 from . import movielens  # noqa: F401
 from . import conll05  # noqa: F401
 from . import wmt14  # noqa: F401
+from . import wmt16  # noqa: F401
+from . import sentiment  # noqa: F401
+from . import mq2007  # noqa: F401
+from . import voc2012  # noqa: F401
+from . import image  # noqa: F401
 
 __all__ = ["common", "mnist", "cifar", "uci_housing", "flowers",
-           "imdb", "imikolov", "movielens", "conll05", "wmt14"]
+           "imdb", "imikolov", "movielens", "conll05", "wmt14", "wmt16",
+           "sentiment", "mq2007", "voc2012", "image"]

@@ -1,0 +1,111 @@
+"""Image preprocessing utilities (parity:
+python/paddle/dataset/image.py — load/resize/crop/flip/transform
+helpers the image datasets compose).  PIL replaces the reference's cv2
+(not in this image); all functions keep the reference's HWC-uint8
+in / out convention with to_chw as the final CHW conversion.
+"""
+from __future__ import annotations
+
+import io
+
+import numpy as np
+
+__all__ = [
+    "load_image_bytes", "load_image", "resize_short", "to_chw",
+    "center_crop", "random_crop", "left_right_flip", "simple_transform",
+    "load_and_transform",
+]
+
+
+def _pil():
+    from PIL import Image
+    return Image
+
+
+def load_image_bytes(bytes_, is_color=True):
+    """Decode an encoded image buffer to an HWC uint8 array."""
+    img = _pil().open(io.BytesIO(bytes_))
+    img = img.convert("RGB" if is_color else "L")
+    return np.asarray(img)
+
+
+def load_image(file, is_color=True):
+    img = _pil().open(file)
+    img = img.convert("RGB" if is_color else "L")
+    return np.asarray(img)
+
+
+def resize_short(im, size):
+    """Resize so the SHORTER edge is ``size``, keeping aspect ratio
+    (reference image.py:163)."""
+    h, w = im.shape[:2]
+    h_new, w_new = size, size
+    if h > w:
+        h_new = size * h // w
+    else:
+        w_new = size * w // h
+    pil_im = _pil().fromarray(im)
+    pil_im = pil_im.resize((w_new, h_new), _pil().Resampling.LANCZOS)
+    return np.asarray(pil_im)
+
+
+def to_chw(im, order=(2, 0, 1)):
+    """HWC -> CHW (reference image.py:189)."""
+    assert len(im.shape) == len(order)
+    return im.transpose(order)
+
+
+def center_crop(im, size, is_color=True):
+    h, w = im.shape[:2]
+    h_start = (h - size) // 2
+    w_start = (w - size) // 2
+    h_end, w_end = h_start + size, w_start + size
+    if is_color:
+        return im[h_start:h_end, w_start:w_end, :]
+    return im[h_start:h_end, w_start:w_end]
+
+
+def random_crop(im, size, is_color=True):
+    h, w = im.shape[:2]
+    h_start = np.random.randint(0, h - size + 1)
+    w_start = np.random.randint(0, w - size + 1)
+    h_end, w_end = h_start + size, w_start + size
+    if is_color:
+        return im[h_start:h_end, w_start:w_end, :]
+    return im[h_start:h_end, w_start:w_end]
+
+
+def left_right_flip(im, is_color=True):
+    if len(im.shape) == 3 and is_color:
+        return im[:, ::-1, :]
+    return im[:, ::-1]
+
+
+def simple_transform(im, resize_size, crop_size, is_train, is_color=True,
+                     mean=None):
+    """resize_short -> (random|center) crop (+ random flip when
+    training) -> CHW float32, optionally mean-subtracted (reference
+    image.py:291)."""
+    im = resize_short(im, resize_size)
+    if is_train:
+        im = random_crop(im, crop_size, is_color=is_color)
+        if np.random.randint(2) == 0:
+            im = left_right_flip(im, is_color)
+    else:
+        im = center_crop(im, crop_size, is_color=is_color)
+    if len(im.shape) == 3:
+        im = to_chw(im)
+    im = im.astype("float32")
+    if mean is not None:
+        mean = np.array(mean, dtype=np.float32)
+        if mean.ndim == 1 and is_color:
+            mean = mean[:, np.newaxis, np.newaxis]
+        im -= mean
+    return im
+
+
+def load_and_transform(filename, resize_size, crop_size, is_train,
+                       is_color=True, mean=None):
+    im = load_image(filename, is_color)
+    return simple_transform(im, resize_size, crop_size, is_train,
+                            is_color, mean)

@@ -1,0 +1,197 @@
+"""LETOR MQ2007 learning-to-rank dataset (parity:
+python/paddle/dataset/mq2007.py — Query/QueryList containers parsed
+from the LETOR text format, and the pointwise/pairwise/listwise reader
+generators gen_point/gen_pair/gen_list behind train()/test()).
+
+Reads the real extracted MQ2007 fold when cached under
+DATA_HOME/MQ2007/<Fold>/<split>.txt (the reference's .rar needs an
+unrar the image lacks — drop the extracted text files in); otherwise a
+deterministic synthetic ranking problem whose relevance is a noisy
+linear function of the 46-dim feature vector.
+"""
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+from . import common
+
+__all__ = ["train", "test", "Query", "QueryList", "gen_point", "gen_pair",
+           "gen_list", "query_filter", "load_from_text", "is_synthetic"]
+
+FEATURE_DIM = 46
+_SYN_QUERIES_TRAIN = 80
+_SYN_QUERIES_TEST = 20
+_SYN_DOCS_PER_QUERY = 12
+
+
+class Query(object):
+    """One query-document pair: relevance score + dense features
+    (reference mq2007.py:48)."""
+
+    def __init__(self, query_id=-1, relevance_score=-1,
+                 feature_vector=None, description=""):
+        self.query_id = query_id
+        self.relevance_score = relevance_score
+        self.feature_vector = feature_vector or []
+        self.description = description
+
+    def __str__(self):
+        return "%s %s %s" % (self.relevance_score, self.query_id,
+                             " ".join(str(f) for f in self.feature_vector))
+
+    def _parse_(self, text):
+        """Parse a LETOR line: '<rel> qid:<id> 1:<v> 2:<v> ... # doc'."""
+        comment_position = text.find("#")
+        comment = ""
+        if comment_position != -1:
+            comment = text[comment_position + 1:].strip()
+            text = text[:comment_position]
+        parts = text.strip().split()
+        if len(parts) < 2:
+            return None
+        self.relevance_score = int(parts[0])
+        self.query_id = int(parts[1].split(":")[1])
+        self.feature_vector = [float(p.split(":")[1]) for p in parts[2:]]
+        self.description = comment
+        return self
+
+
+class QueryList(object):
+    """All documents of one query (reference mq2007.py:109)."""
+
+    def __init__(self, querylist=None):
+        self.query_id = -1
+        self.querylist = querylist or []
+        if self.querylist:
+            self.query_id = self.querylist[0].query_id
+
+    def __iter__(self):
+        return iter(self.querylist)
+
+    def __len__(self):
+        return len(self.querylist)
+
+    def __getitem__(self, i):
+        return self.querylist[i]
+
+    def _correct_ranking_(self):
+        self.querylist.sort(key=lambda q: -q.relevance_score)
+
+    def _add_query(self, query):
+        if self.query_id == -1:
+            self.query_id = query.query_id
+        elif self.query_id != query.query_id:
+            raise ValueError("query in list must be of the same query_id")
+        self.querylist.append(query)
+
+
+def is_synthetic():
+    return not os.path.isdir(os.path.join(common.DATA_HOME, "MQ2007"))
+
+
+def load_from_text(filepath, shuffle=False, fill_missing=-1):
+    """Parse a LETOR text file into QueryLists (reference
+    mq2007.py:267)."""
+    path = os.path.join(common.DATA_HOME, "MQ2007", filepath)
+    querylists, querylist, prev = [], None, None
+    with open(path) as f:
+        for line in f:
+            q = Query()._parse_(line)
+            if q is None:
+                continue
+            if q.query_id != prev:
+                if querylist is not None:
+                    querylists.append(querylist)
+                querylist = QueryList()
+                prev = q.query_id
+            querylist._add_query(q)
+    if querylist is not None:
+        querylists.append(querylist)
+    return querylists
+
+
+def _synthetic_querylists(n_queries, seed):
+    rng = np.random.RandomState(seed)
+    w = np.random.RandomState(21).randn(FEATURE_DIM)
+    out = []
+    for qid in range(n_queries):
+        ql = QueryList()
+        for _ in range(_SYN_DOCS_PER_QUERY):
+            fv = rng.rand(FEATURE_DIM)
+            raw = fv @ w + rng.randn() * 0.3
+            rel = int(np.clip(np.digitize(raw, [2.0, 3.5]), 0, 2))
+            ql._add_query(Query(query_id=qid, relevance_score=rel,
+                                feature_vector=fv.tolist()))
+        out.append(ql)
+    return out
+
+
+def gen_point(querylist):
+    """Pointwise view: (relevance, feature vector) per document."""
+    if not isinstance(querylist, QueryList):
+        querylist = QueryList(querylist)
+    querylist._correct_ranking_()
+    for query in querylist:
+        yield query.relevance_score, np.array(query.feature_vector)
+
+
+def gen_pair(querylist, partial_order="full"):
+    """Pairwise view: (label=+1, better_doc, worse_doc) for every
+    relevance-ordered pair."""
+    if not isinstance(querylist, QueryList):
+        querylist = QueryList(querylist)
+    querylist._correct_ranking_()
+    pairs = []
+    for i, query_left in enumerate(querylist):
+        for query_right in querylist[i + 1:]:
+            if query_left.relevance_score > query_right.relevance_score:
+                pairs.append((np.array(query_left.feature_vector),
+                              np.array(query_right.feature_vector)))
+    for a, b in pairs:
+        yield np.array([1.0]), a, b
+
+
+def gen_list(querylist):
+    """Listwise view: (all relevances, all feature vectors) per query."""
+    if not isinstance(querylist, QueryList):
+        querylist = QueryList(querylist)
+    querylist._correct_ranking_()
+    relevance = [q.relevance_score for q in querylist]
+    features = [q.feature_vector for q in querylist]
+    yield np.array(relevance), np.array(features)
+
+
+def query_filter(querylists):
+    """Drop queries whose documents are all irrelevant (reference
+    mq2007.py:249)."""
+    return [ql for ql in querylists
+            if sum(q.relevance_score for q in ql) != 0]
+
+
+_GEN = {"pointwise": gen_point, "pairwise": gen_pair,
+        "listwise": gen_list}
+
+
+def _creator(split, n_queries, seed):
+    def make(format="pairwise"):
+        gen = _GEN[format]
+
+        def reader():
+            if is_synthetic():
+                querylists = _synthetic_querylists(n_queries, seed)
+            else:
+                querylists = load_from_text(
+                    os.path.join("Fold1", split + ".txt"))
+            for ql in query_filter(querylists):
+                for sample in gen(ql):
+                    yield sample
+
+        return reader
+
+    return make
+
+
+train = _creator("train", _SYN_QUERIES_TRAIN, 37)
+test = _creator("test", _SYN_QUERIES_TEST, 41)

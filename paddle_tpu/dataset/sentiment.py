@@ -1,0 +1,104 @@
+"""NLTK movie-reviews polarity dataset (parity:
+python/paddle/dataset/sentiment.py — get_word_dict() over the corpus,
+train()/test() yielding (token ids, 0/1 polarity); NUM_TRAINING_INSTANCES
+split).
+
+The reference pulls the corpus through NLTK; with no egress this module
+reads an nltk-format movie_reviews directory when cached under
+DATA_HOME/sentiment (pos/ and neg/ subdirs of .txt files) and otherwise
+serves the same class-conditional synthetic corpus recipe as
+dataset.imdb (distinct seed/vocab).
+"""
+from __future__ import annotations
+
+import glob
+import os
+
+import numpy as np
+
+from . import common
+
+__all__ = ["get_word_dict", "train", "test", "is_synthetic"]
+
+NUM_TRAINING_INSTANCES = 1600
+NUM_TOTAL_INSTANCES = 2000
+
+_SYN_VOCAB = 800
+_DATA_DIR = os.path.join(common.DATA_HOME, "sentiment", "movie_reviews")
+
+
+def is_synthetic():
+    return not (os.path.isdir(os.path.join(_DATA_DIR, "pos"))
+                and os.path.isdir(os.path.join(_DATA_DIR, "neg")))
+
+
+def _read_corpus():
+    """[(words, polarity)] — 0 = negative, 1 = positive, interleaved
+    like the reference's sort_files()."""
+    docs = {"neg": [], "pos": []}
+    for pol in ("neg", "pos"):
+        for path in sorted(glob.glob(os.path.join(_DATA_DIR, pol,
+                                                  "*.txt"))):
+            with open(path, "r", errors="ignore") as f:
+                docs[pol].append(f.read().lower().split())
+    out = []
+    for neg, pos in zip(docs["neg"], docs["pos"]):
+        out.append((pos, 1))
+        out.append((neg, 0))
+    return out
+
+
+def _synthetic_corpus():
+    rng = np.random.RandomState(29)
+    half = _SYN_VOCAB // 2
+    out = []
+    for _ in range(NUM_TOTAL_INSTANCES):
+        label = int(rng.randint(0, 2))
+        length = int(rng.randint(8, 50))
+        biased = rng.randint(0, half, length) + (0 if label else half)
+        uniform = rng.randint(0, _SYN_VOCAB, length)
+        take = rng.rand(length) < 0.75
+        words = ["s%04d" % w for w in np.where(take, biased, uniform)]
+        out.append((words, label))
+    return out
+
+
+def _corpus():
+    return _synthetic_corpus() if is_synthetic() else _read_corpus()
+
+
+def _word_dict_of(corpus):
+    freq = {}
+    for words, _ in corpus:
+        for w in words:
+            freq[w] = freq.get(w, 0) + 1
+    return sorted(freq.items(), key=lambda x: (-x[1], x[0]))
+
+
+def get_word_dict():
+    """[(word, freq)] sorted by descending frequency — the reference
+    returns this list form; index in the list is the word id."""
+    return _word_dict_of(_corpus())
+
+
+def _ids(corpus):
+    # one corpus read serves both the dict and the id conversion
+    word_idx = {w: i for i, (w, _) in enumerate(_word_dict_of(corpus))}
+    return [([word_idx[w] for w in words], label)
+            for words, label in corpus]
+
+
+def reader_creator(data):
+    def reader():
+        for doc, label in data:
+            yield doc, label
+
+    return reader
+
+
+def train():
+    return reader_creator(_ids(_corpus())[:NUM_TRAINING_INSTANCES])
+
+
+def test():
+    return reader_creator(_ids(_corpus())[NUM_TRAINING_INSTANCES:])

@@ -1,0 +1,104 @@
+"""Pascal VOC2012 segmentation dataset (parity:
+python/paddle/dataset/voc2012.py — train()/test()/val() yielding
+(image HWC uint8, segmentation mask HW uint8) pairs from the
+VOCtrainval tarball).
+
+Reads the real tarball when cached; otherwise deterministic synthetic
+scenes — random rectangles of the 20 VOC classes painted onto both the
+image and the mask, so segmentation models have consistent
+pixel-labeled structure to fit.
+"""
+from __future__ import annotations
+
+import io
+import tarfile
+
+import numpy as np
+
+from . import common
+
+__all__ = ["train", "test", "val", "is_synthetic"]
+
+VOC_URL = ("http://host.robots.ox.ac.uk/pascal/VOC/voc2012/"
+           "VOCtrainval_11-May-2012.tar")
+VOC_MD5 = "6cd6e144f989b92b3379bac3b3de84fd"
+SET_FILE = "VOCdevkit/VOC2012/ImageSets/Segmentation/{}.txt"
+DATA_FILE = "VOCdevkit/VOC2012/JPEGImages/{}.jpg"
+LABEL_FILE = "VOCdevkit/VOC2012/SegmentationClass/{}.png"
+CACHE_DIR = "voc2012"
+
+N_CLASSES = 21  # background + 20 object classes
+_SYN = {"trainval": (80, 53), "train": (60, 59), "val": (20, 61)}
+_SYN_HW = 96
+
+
+_IS_SYNTHETIC = None
+
+
+def is_synthetic():
+    global _IS_SYNTHETIC
+    if _IS_SYNTHETIC is None:
+        try:
+            common.download(VOC_URL, CACHE_DIR, VOC_MD5)
+            _IS_SYNTHETIC = False
+        except (FileNotFoundError, IOError):
+            _IS_SYNTHETIC = True
+    return _IS_SYNTHETIC
+
+
+def _synthetic_reader(sub_name):
+    n, seed = _SYN[sub_name]
+
+    def reader():
+        rng = np.random.RandomState(seed)
+        palette = np.random.RandomState(2).randint(
+            40, 255, (N_CLASSES, 3)).astype(np.uint8)
+        for _ in range(n):
+            img = rng.randint(0, 40, (_SYN_HW, _SYN_HW, 3)).astype(
+                np.uint8)
+            mask = np.zeros((_SYN_HW, _SYN_HW), np.uint8)
+            for _ in range(int(rng.randint(1, 4))):
+                cls = int(rng.randint(1, N_CLASSES))
+                h0, w0 = rng.randint(0, _SYN_HW - 16, 2)
+                h1 = h0 + int(rng.randint(12, 40))
+                w1 = w0 + int(rng.randint(12, 40))
+                img[h0:h1, w0:w1] = palette[cls]
+                mask[h0:h1, w0:w1] = cls
+            yield img, mask
+
+    return reader
+
+
+def reader_creator(filename, sub_name):
+    from PIL import Image
+
+    tarobject = tarfile.open(filename)
+    name2mem = {ele.name: ele for ele in tarobject.getmembers()}
+
+    def reader():
+        sets = tarobject.extractfile(name2mem[SET_FILE.format(sub_name)])
+        for line in sets:
+            line = line.strip().decode("utf-8")
+            data = tarobject.extractfile(
+                name2mem[DATA_FILE.format(line)]).read()
+            label = tarobject.extractfile(
+                name2mem[LABEL_FILE.format(line)]).read()
+            yield (np.array(Image.open(io.BytesIO(data))),
+                   np.array(Image.open(io.BytesIO(label))))
+
+    return reader
+
+
+def _creator(sub_name):
+    def make():
+        if is_synthetic():
+            return _synthetic_reader(sub_name)
+        return reader_creator(
+            common.download(VOC_URL, CACHE_DIR, VOC_MD5), sub_name)
+
+    return make
+
+
+train = _creator("trainval")
+test = _creator("train")
+val = _creator("val")

@@ -1,0 +1,147 @@
+"""WMT-16 English<->German translation (parity:
+python/paddle/dataset/wmt16.py — BPE-tokenized corpus with per-language
+dict sizes, train/test/validation readers yielding (src ids, trg ids
+with <s>, shifted trg ids), get_dict(lang, dict_size)).
+
+Parses the real preprocessed tarball when cached; otherwise the same
+deterministic permutation-cipher synthetic corpus as wmt14 (distinct
+seed), so seq2seq models genuinely learn alignment.
+"""
+from __future__ import annotations
+
+import os
+import tarfile
+
+import numpy as np
+
+from . import common
+
+__all__ = ["train", "test", "validation", "get_dict", "fetch",
+           "is_synthetic"]
+
+DATA_URL = "http://paddlemodels.bj.bcebos.com/wmt/wmt16.tar.gz"
+DATA_MD5 = "0c38be43600334966403524a40dcd81e"
+
+TOTAL_EN_WORDS = 11250
+TOTAL_DE_WORDS = 19220
+
+START_MARK = "<s>"
+END_MARK = "<e>"
+UNK_MARK = "<unk>"
+
+_SYN = {"train": (400, 7), "test": (60, 11), "validation": (60, 13)}
+
+
+_IS_SYNTHETIC = None
+
+
+def is_synthetic():
+    global _IS_SYNTHETIC
+    if _IS_SYNTHETIC is None:
+        try:
+            common.download(DATA_URL, "wmt16", DATA_MD5)
+            _IS_SYNTHETIC = False
+        except (FileNotFoundError, IOError):
+            _IS_SYNTHETIC = True
+    return _IS_SYNTHETIC
+
+
+def _syn_vocab(dict_size):
+    words = [START_MARK, END_MARK, UNK_MARK] + [
+        "tok%05d" % i for i in range(dict_size - 3)]
+    return {w: i for i, w in enumerate(words)}
+
+
+def _synthetic_reader(src_dict_size, trg_dict_size, split):
+    n_sents, seed = _SYN[split]
+    content = min(src_dict_size, trg_dict_size) - 3
+
+    def reader():
+        rng = np.random.RandomState(seed)
+        perm = np.random.RandomState(17).permutation(content)
+        for _ in range(n_sents):
+            length = int(rng.randint(3, 12))
+            src = rng.randint(0, content, length)
+            trg = perm[src]
+            src_ids = [0] + (src + 3).tolist() + [1]
+            trg_core = (trg + 3).tolist()
+            yield src_ids, [0] + trg_core, trg_core + [1]
+
+    return reader
+
+
+def _build_dict_from_tar(tar_path, lang, dict_size):
+    # word frequencies over the train split's `lang` column
+    word_freq = {}
+    col = 0 if lang == "en" else 1
+    with tarfile.open(tar_path) as f:
+        for line in f.extractfile("wmt16/train"):
+            fields = line.decode("utf-8").strip().split("\t")
+            if len(fields) != 2:
+                continue
+            for w in fields[col].split():
+                word_freq[w] = word_freq.get(w, 0) + 1
+    words = [w for w, _ in sorted(word_freq.items(),
+                                  key=lambda x: (-x[1], x[0]))]
+    words = [START_MARK, END_MARK, UNK_MARK] + words[:dict_size - 3]
+    return {w: i for i, w in enumerate(words)}
+
+
+def get_dict(lang, dict_size, reverse=False):
+    """word dict for ``lang`` ('en'|'de'); id->word when ``reverse``."""
+    dict_size = min(dict_size, TOTAL_EN_WORDS if lang == "en"
+                    else TOTAL_DE_WORDS)
+    if is_synthetic():
+        d = _syn_vocab(dict_size)
+    else:
+        d = _build_dict_from_tar(
+            common.download(DATA_URL, "wmt16", DATA_MD5), lang, dict_size)
+    if reverse:
+        d = {v: k for k, v in d.items()}
+    return d
+
+
+def _real_reader(split, src_dict_size, trg_dict_size, src_lang):
+    tar_path = common.download(DATA_URL, "wmt16", DATA_MD5)
+    src_dict = get_dict(src_lang, src_dict_size)
+    trg_lang = "de" if src_lang == "en" else "en"
+    trg_dict = get_dict(trg_lang, trg_dict_size)
+    src_col = 0 if src_lang == "en" else 1
+
+    def reader():
+        unk_s, unk_t = src_dict[UNK_MARK], trg_dict[UNK_MARK]
+        with tarfile.open(tar_path) as f:
+            for line in f.extractfile(os.path.join("wmt16", split)):
+                fields = line.decode("utf-8").strip().split("\t")
+                if len(fields) != 2:
+                    continue
+                src_words = fields[src_col].split()
+                trg_words = fields[1 - src_col].split()
+                src_ids = ([src_dict[START_MARK]]
+                           + [src_dict.get(w, unk_s) for w in src_words]
+                           + [src_dict[END_MARK]])
+                trg_ids = [trg_dict.get(w, unk_t) for w in trg_words]
+                yield (src_ids, [trg_dict[START_MARK]] + trg_ids,
+                       trg_ids + [trg_dict[END_MARK]])
+
+    return reader
+
+
+def _creator(split):
+    def make(src_dict_size, trg_dict_size, src_lang="en"):
+        if src_lang not in ("en", "de"):
+            raise ValueError("src_lang must be 'en' or 'de'")
+        if is_synthetic():
+            return _synthetic_reader(src_dict_size, trg_dict_size, split)
+        return _real_reader(split, src_dict_size, trg_dict_size, src_lang)
+
+    return make
+
+
+train = _creator("train")
+test = _creator("test")
+validation = _creator("val")
+
+
+def fetch():
+    common.download(DATA_URL, "wmt16", DATA_MD5)

@@ -42,6 +42,12 @@ from .trainer import (Trainer, BeginEpochEvent, EndEpochEvent,
 from . import inferencer
 from .inferencer import Inferencer
 from . import debugger
+from . import average
+from . import evaluator
+from . import lod_tensor
+from .lod_tensor import create_lod_tensor, create_random_int_lodtensor
+from . import recordio_writer
+from . import default_scope_funcs
 from . import concurrency
 from .concurrency import (Go, Select, make_channel, channel_send,
                           channel_recv, channel_close)
@@ -77,4 +83,7 @@ __all__ = [
     "io", "save_inference_model", "load_inference_model", "DataFeeder",
     "ParallelExecutor", "ExecutionStrategy", "BuildStrategy",
     "CPUPlace", "TPUPlace", "CUDAPlace", "Scope",
+    "average", "evaluator", "lod_tensor", "create_lod_tensor",
+    "create_random_int_lodtensor", "recordio_writer",
+    "default_scope_funcs",
 ]

@@ -1,0 +1,197 @@
+"""Parity modules: evaluator / lod_tensor / average / recordio_writer /
+default_scope_funcs, and the long-tail dataset adapters (reference
+python/paddle/fluid/{evaluator,lod_tensor,average,recordio_writer,
+default_scope_funcs}.py, python/paddle/dataset/)."""
+import itertools
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu import dataset
+
+
+def test_weighted_average():
+    import warnings
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        avg = fluid.average.WeightedAverage()
+    avg.add(value=2.0, weight=1)
+    avg.add(value=4.0, weight=3)
+    assert abs(avg.eval() - 3.5) < 1e-9
+    avg.reset()
+    with pytest.raises(ValueError):
+        avg.eval()
+
+
+def test_create_lod_tensor():
+    lt = fluid.create_lod_tensor(np.arange(10).reshape(5, 2).astype(
+        "float32"), [[2, 3]], fluid.CPUPlace())
+    assert lt.lod == [[0, 2, 5]]
+    assert lt.shape == (5, 2)
+    # list-of-sequences form
+    lt2 = fluid.create_lod_tensor([[1, 2], [3, 4, 5]], [[2, 3]],
+                                  fluid.CPUPlace())
+    assert lt2.shape == (5, 1) and lt2.lod == [[0, 2, 5]]
+    # invalid lod rejected
+    with pytest.raises(AssertionError):
+        fluid.create_lod_tensor(np.zeros((5, 2), "float32"), [[2, 2]],
+                                fluid.CPUPlace())
+    # level-2: sentence counts over word counts
+    rand = fluid.create_random_int_lodtensor(
+        [[2, 1], [3, 2, 4]], base_shape=[1], place=fluid.CPUPlace(),
+        low=0, high=9)
+    assert rand.shape == (9, 1)
+    assert rand.lod == [[0, 2, 3], [0, 3, 5, 9]]
+    assert np.asarray(rand).max() <= 9
+
+
+def test_lod_tensor_feeds_executor(prog_scope, exe):
+    """create_lod_tensor output is feedable wherever a ragged batch is."""
+    main, startup, scope = prog_scope
+    words = fluid.layers.data(name="w", shape=[1], dtype="int64",
+                              lod_level=1)
+    emb = fluid.layers.embedding(words, size=[20, 4])
+    pooled = fluid.layers.sequence_pool(emb, "sum")
+    out = fluid.layers.reduce_sum(pooled)
+    exe.run(startup)
+    lt = fluid.create_lod_tensor([[1, 2], [3, 4, 5]], [[2, 3]],
+                                 fluid.CPUPlace())
+    v, = exe.run(main, feed={"w": lt}, fetch_list=[out])
+    assert np.isfinite(np.asarray(v)).all()
+
+
+def test_chunk_evaluator_streaming(prog_scope, exe):
+    """Evaluator states accumulate across runs; F1 matches a
+    hand-accumulated computation over the same batches."""
+    main, startup, scope = prog_scope
+    pred = fluid.layers.data(name="pred", shape=[1], dtype="int64",
+                             lod_level=1)
+    label = fluid.layers.data(name="label", shape=[1], dtype="int64",
+                              lod_level=1)
+    ev = fluid.evaluator.ChunkEvaluator(input=pred, label=label,
+                                        chunk_scheme="IOB",
+                                        num_chunk_types=2)
+    exe.run(startup)
+    ev.reset(exe)
+    feeder = fluid.DataFeeder([pred, label], program=main)
+    rng = np.random.RandomState(0)
+    batches = []
+    for _ in range(3):
+        batch = []
+        for _ in range(4):
+            L = int(rng.randint(3, 8))
+            p = rng.randint(0, 5, L).tolist()
+            t = rng.randint(0, 5, L).tolist()
+            batch.append((p, t))
+        batches.append(batch)
+    for batch in batches:
+        exe.run(main, feed=feeder.feed(batch), fetch_list=[])
+    precision, recall, f1 = ev.eval(exe)
+    assert 0.0 <= float(precision[0]) <= 1.0
+    assert 0.0 <= float(f1[0]) <= 1.0
+
+    # independent recomputation through the op's own batch counts
+    main2 = fluid.Program()
+    with fluid.program_guard(main2):
+        p2 = fluid.layers.data(name="pred", shape=[1], dtype="int64",
+                               lod_level=1)
+        l2 = fluid.layers.data(name="label", shape=[1], dtype="int64",
+                               lod_level=1)
+        _, _, _, ni, nl, nc = fluid.layers.chunk_eval(
+            input=p2, label=l2, chunk_scheme="IOB", num_chunk_types=2)
+    tot = np.zeros(3)
+    feeder2 = fluid.DataFeeder([p2, l2], program=main2)
+    for batch in batches:
+        vals = exe.run(main2, feed=feeder2.feed(batch),
+                       fetch_list=[ni, nl, nc])
+        tot += [float(np.asarray(v).ravel()[0]) for v in vals]
+    want_p = tot[2] / tot[0] if tot[0] else 0.0
+    want_r = tot[2] / tot[1] if tot[1] else 0.0
+    assert abs(float(precision[0]) - want_p) < 1e-6
+    assert abs(float(recall[0]) - want_r) < 1e-6
+
+
+def test_edit_distance_evaluator(prog_scope, exe):
+    main, startup, scope = prog_scope
+    hyp = fluid.layers.data(name="hyp", shape=[1], dtype="int64",
+                            lod_level=1)
+    ref = fluid.layers.data(name="ref", shape=[1], dtype="int64",
+                            lod_level=1)
+    ev = fluid.evaluator.EditDistance(input=hyp, label=ref)
+    exe.run(startup)
+    ev.reset(exe)
+    feeder = fluid.DataFeeder([hyp, ref], program=main)
+    # distances: ("ab" vs "ab")=0, ("abc" vs "axc")=1 -> avg 0.5 norm’d
+    exe.run(main, feed=feeder.feed([([1, 2], [1, 2]),
+                                    ([1, 2, 3], [1, 9, 3])]),
+            fetch_list=[])
+    dist, err = ev.eval(exe)
+    assert abs(float(err[0]) - 0.5) < 1e-6  # one of two seqs wrong
+    assert float(dist[0]) > 0.0
+
+
+def test_recordio_writer_roundtrip(tmp_path):
+    import pickle
+    from paddle_tpu import recordio
+
+    def reader():
+        for i in range(7):
+            yield (np.full((2,), i, np.float32), i)
+
+    path = os.path.join(str(tmp_path), "data.recordio")
+    n = fluid.recordio_writer.convert_reader_to_recordio_file(path, reader)
+    assert n == 7
+    got = [pickle.loads(r) for r in recordio.Scanner(path)]
+    assert len(got) == 7
+    assert got[3][1] == 3 and np.allclose(got[3][0], 3.0)
+
+    counts = fluid.recordio_writer.convert_reader_to_recordio_files(
+        os.path.join(str(tmp_path), "part.recordio"), 3, reader)
+    assert counts == [3, 3, 1]
+
+
+def test_default_scope_funcs():
+    dsf = fluid.default_scope_funcs
+    base = dsf.get_cur_scope()
+    dsf.enter_local_scope()
+    assert dsf.get_cur_scope() is not base
+    dsf.get_cur_scope().set("x", 42)
+    assert np.asarray(dsf.find_var("x")) == 42
+    dsf.leave_local_scope()
+    assert dsf.get_cur_scope() is base
+
+    out = dsf.scoped_function(lambda: 7)
+    assert out == 7
+
+
+def test_long_tail_datasets():
+    # wmt16: reader + dict
+    d = dataset.wmt16.get_dict("en", 50)
+    assert d["<s>"] == 0 and len(d) == 50
+    s = list(itertools.islice(dataset.wmt16.train(50, 50)(), 3))
+    assert all(len(x) == 3 for x in s)
+    src, trg_in, trg_next = s[0]
+    assert trg_in[0] == 0 and trg_next[-1] == 1
+    # sentiment: word dict + split sizes
+    wd = dataset.sentiment.get_word_dict()
+    assert len(wd) > 100 and isinstance(wd[0], tuple)
+    tr = list(dataset.sentiment.train()())
+    te = list(dataset.sentiment.test()())
+    assert len(tr) == dataset.sentiment.NUM_TRAINING_INSTANCES
+    assert len(te) == (dataset.sentiment.NUM_TOTAL_INSTANCES
+                       - dataset.sentiment.NUM_TRAINING_INSTANCES)
+    # mq2007: three ranking views
+    lbl, a, b = next(dataset.mq2007.train(format="pairwise")())
+    assert a.shape == (dataset.mq2007.FEATURE_DIM,) and lbl[0] == 1.0
+    rel, fv = next(dataset.mq2007.train(format="listwise")())
+    assert fv.shape[1] == dataset.mq2007.FEATURE_DIM
+    assert (np.diff(rel) <= 0).all()  # sorted by descending relevance
+    # voc2012: image/mask pair agreement
+    img, mask = next(dataset.voc2012.train()())
+    assert img.shape[:2] == mask.shape and img.dtype == np.uint8
+    assert mask.max() > 0
+    # image utils: full transform pipeline
+    chw = dataset.image.simple_transform(img, 64, 48, is_train=True)
+    assert chw.shape == (3, 48, 48) and chw.dtype == np.float32
