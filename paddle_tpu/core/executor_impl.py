@@ -32,6 +32,12 @@ from .types import proto_to_np_dtype, VarKind
 
 from .flags import FLAGS
 
+class EOFException(Exception):
+    """A program-level reader has no next batch (parity: the enforce
+    the reference's read op raises at end-of-data — callers catch it
+    and reset the reader, reader/read_op.cc)."""
+
+
 LEN_SUFFIX = "@LEN"
 # pad ragged batches' time dim up to a multiple of this so the number of
 # distinct compiled shapes stays bounded (bucketing)

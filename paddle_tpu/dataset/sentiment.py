@@ -63,8 +63,15 @@ def _synthetic_corpus():
     return out
 
 
+_CORPUS = None
+
+
 def _corpus():
-    return _synthetic_corpus() if is_synthetic() else _read_corpus()
+    global _CORPUS
+    if _CORPUS is None:
+        _CORPUS = (_synthetic_corpus() if is_synthetic()
+                   else _read_corpus())
+    return _CORPUS
 
 
 def _word_dict_of(corpus):

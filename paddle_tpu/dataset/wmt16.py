@@ -29,7 +29,7 @@ START_MARK = "<s>"
 END_MARK = "<e>"
 UNK_MARK = "<unk>"
 
-_SYN = {"train": (400, 7), "test": (60, 11), "validation": (60, 13)}
+_SYN = {"train": (400, 7), "test": (60, 11), "val": (60, 13)}
 
 
 _IS_SYNTHETIC = None

@@ -1,15 +1,26 @@
 """Data-input layers.
 
-Parity: reference python/paddle/fluid/layers/io.py (`data`, readers,
-ListenAndServ/Send are added by the distributed transpiler work).
+Parity: reference python/paddle/fluid/layers/io.py (`data` plus the
+reader-op chain: open_recordio_file -> shuffle -> batch ->
+double_buffer -> read_file, over operators/reader/*; ListenAndServ/Send
+are added by the distributed transpiler work).
+
+Readers are program state: the create ops run in the STARTUP program
+and leave a host-side reader chain in the scope (ops/reader_ops.py);
+the `read` op is a prelude host op of the main block that pops one
+batch into the data vars each executor.run.  End of data raises
+fluid.core.EOFException — catch it and call reader.reset().
 """
 from __future__ import annotations
 
-from ..framework import default_main_program, default_startup_program
+from ..framework import (Variable, default_main_program,
+                         default_startup_program)
 from ..layer_helper import LayerHelper
+from .. import unique_name
 from paddle_tpu.core.types import VarKind
 
-__all__ = ["data"]
+__all__ = ["data", "open_recordio_file", "shuffle", "batch",
+           "double_buffer", "read_file"]
 
 
 def data(name, shape, append_batch_size=True, dtype="float32", lod_level=0,
@@ -25,3 +36,102 @@ def data(name, shape, append_batch_size=True, dtype="float32", lod_level=0,
     return helper.create_global_variable(
         name=name, shape=shape, dtype=dtype, lod_level=lod_level,
         stop_gradient=stop_gradient)
+
+
+class _ReaderVariable(Variable):
+    """A reader handle: a Variable plus shape/dtype metadata for
+    read_file and a reset() that rewinds the scope-resident chain."""
+
+    def reset(self):
+        from ..executor import _scope_stack
+        try:
+            state = _scope_stack[-1].find_var(self.name)
+        except KeyError:
+            raise RuntimeError(
+                "reader %r is not initialized in the current scope (run "
+                "the startup program first)" % self.name)
+        state.reset()
+
+
+def _reader_var(block, name, shapes, dtypes, lod_levels):
+    var = _ReaderVariable(block, name=name, shape=[0], dtype="float32",
+                          persistable=True, kind=VarKind.READER)
+    block.vars[name] = var
+    var._reader_shapes = [list(s) for s in shapes]
+    var._reader_dtypes = list(dtypes)
+    var._reader_lod_levels = list(lod_levels)
+    return var
+
+
+def open_recordio_file(filename, shapes, lod_levels, dtypes,
+                       pass_num=1, for_parallel=False):
+    """Reader over a recordio file written by
+    fluid.recordio_writer.convert_reader_to_recordio_file (reference
+    io.py open_recordio_file / create_recordio_file_reader op).
+    ``shapes`` include the batch dim as -1."""
+    startup = default_startup_program()
+    main = default_main_program()
+    name = unique_name.generate("open_recordio_file")
+    su_var = _reader_var(startup.global_block(), name, shapes, dtypes,
+                        lod_levels)
+    startup.global_block().append_op(
+        type="create_recordio_file_reader",
+        inputs={}, outputs={"Out": [name]},
+        attrs={"filename": filename, "pass_num": int(pass_num)},
+        infer_shape=False)
+    # the main program sees the same-named var (state lives in the scope)
+    return _reader_var(main.global_block(), name, shapes, dtypes,
+                       lod_levels)
+
+
+def _decorate(op_type, reader, attrs):
+    startup = default_startup_program()
+    main = default_main_program()
+    name = unique_name.generate(op_type)
+    _reader_var(startup.global_block(), name, reader._reader_shapes,
+                reader._reader_dtypes, reader._reader_lod_levels)
+    startup.global_block().append_op(
+        type=op_type,
+        inputs={"UnderlyingReader": [reader.name]},
+        outputs={"Out": [name]}, attrs=attrs, infer_shape=False)
+    return _reader_var(main.global_block(), name, reader._reader_shapes,
+                       reader._reader_dtypes, reader._reader_lod_levels)
+
+
+def shuffle(reader, buffer_size):
+    """Shuffling decorator (reference create_shuffle_reader op)."""
+    return _decorate("create_shuffle_reader", reader,
+                     {"buffer_size": int(buffer_size)})
+
+
+def batch(reader, batch_size):
+    """Sample->minibatch decorator (reference create_batch_reader op)."""
+    return _decorate("create_batch_reader", reader,
+                     {"batch_size": int(batch_size)})
+
+
+def double_buffer(reader, place=None, name=None):
+    """Device-staging prefetch decorator (reference
+    create_double_buffer_reader op)."""
+    return _decorate("create_double_buffer_reader", reader, {})
+
+
+def read_file(reader):
+    """Pop one batch into fresh data vars (reference read_op).  Raises
+    fluid.core.EOFException when the chain is drained."""
+    helper = LayerHelper("read_file")
+    main = default_main_program()
+    outs = []
+    for shape, dtype, lod in zip(reader._reader_shapes,
+                                 reader._reader_dtypes,
+                                 reader._reader_lod_levels):
+        var = main.current_block().create_var(
+            name=unique_name.generate("read_file"), shape=list(shape),
+            dtype=dtype, lod_level=lod)
+        outs.append(var)
+    helper.append_op(type="read", inputs={"Reader": [reader.name]},
+                     outputs={"Out": [v.name for v in outs]},
+                     infer_shape=False)
+    if len(outs) == 1:
+        return outs[0]
+    return outs

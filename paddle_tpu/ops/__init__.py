@@ -12,6 +12,7 @@ from paddle_tpu.ops import (  # noqa: F401
     random,
     optimizer_ops,
     io_ops,
+    reader_ops,
     metric,
     parallel_ops,
     sequence,

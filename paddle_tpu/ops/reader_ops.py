@@ -1,0 +1,243 @@
+"""Program-level reader-op chain (parity: paddle/fluid/operators/reader/
+— create_recordio_file_reader_op, create_shuffle_reader_op,
+create_batch_reader_op, create_double_buffer_reader_op, read_op, and
+framework/reader.h's ReaderBase chain).
+
+The reference builds a C++ decorator chain of ReaderBase objects living
+in the scope; here the same chain is host-side Python state objects the
+'read' host op pops, with the double-buffer stage prefetching device-put
+batches on a thread exactly where the reference staged pinned-memory
+copies (reader/create_double_buffer_reader_op.cc).
+"""
+from __future__ import annotations
+
+import pickle
+import queue
+import threading
+
+import numpy as np
+
+from paddle_tpu.core.registry import register_op
+from paddle_tpu.core.executor_impl import EOFException
+
+
+def _host(name):
+    def deco(impl):
+        register_op(name, lower=impl, host_op=True, grad_maker=None)
+        return impl
+
+    return deco
+
+
+class _ReaderBase:
+    """next() -> tuple of per-slot numpy arrays for ONE sample/batch;
+    raises EOFException when drained; reset() rewinds."""
+
+    def next(self):
+        raise NotImplementedError
+
+    def reset(self):
+        raise NotImplementedError
+
+
+class _RecordIOReader(_ReaderBase):
+    def __init__(self, filename, pass_num=1):
+        self.filename = filename
+        self.pass_num = max(1, int(pass_num))
+        self._iter = None
+        self._passes_left = self.pass_num
+
+    def _scanner(self):
+        from paddle_tpu import recordio
+        for rec in recordio.Scanner(self.filename):
+            sample = pickle.loads(rec)
+            if isinstance(sample, dict):  # feeder-serialized form
+                sample = tuple(sample.values())
+            yield tuple(np.asarray(x) for x in sample)
+
+    def next(self):
+        if self._iter is None:
+            self._iter = self._scanner()
+        try:
+            return next(self._iter)
+        except StopIteration:
+            self._iter = None
+            self._passes_left -= 1
+            if self._passes_left > 0:  # pass_num epochs before EOF
+                return self.next()
+            self._passes_left = self.pass_num
+            raise EOFException(self.filename)
+
+    def reset(self):
+        self._iter = None
+        self._passes_left = self.pass_num
+
+
+class _ShuffleReader(_ReaderBase):
+    def __init__(self, parent, buffer_size, seed=0):
+        self.parent = parent
+        self.buffer_size = int(buffer_size)
+        self.rng = np.random.RandomState(seed)
+        self.buf = []
+        self.drained = False
+
+    def next(self):
+        while not self.drained and len(self.buf) < self.buffer_size:
+            try:
+                self.buf.append(self.parent.next())
+            except EOFException:
+                self.drained = True
+        if not self.buf:
+            self.drained = False
+            raise EOFException("shuffle")
+        idx = self.rng.randint(len(self.buf))
+        self.buf[idx], self.buf[-1] = self.buf[-1], self.buf[idx]
+        return self.buf.pop()
+
+    def reset(self):
+        self.buf = []
+        self.drained = False
+        self.parent.reset()
+
+
+class _BatchReader(_ReaderBase):
+    def __init__(self, parent, batch_size, drop_last=True):
+        self.parent = parent
+        self.batch_size = int(batch_size)
+        self.drop_last = drop_last
+
+    def next(self):
+        rows = []
+        try:
+            for _ in range(self.batch_size):
+                rows.append(self.parent.next())
+        except EOFException:
+            if not rows or self.drop_last:
+                raise EOFException("batch")
+        return tuple(np.stack([r[i] for r in rows])
+                     for i in range(len(rows[0])))
+
+    def reset(self):
+        self.parent.reset()
+
+
+class _DoubleBufferReader(_ReaderBase):
+    """Thread prefetches upcoming batches and stages them on the target
+    device, overlapping host decode + transfer with device compute."""
+
+    def __init__(self, parent, capacity=2, place=None):
+        self.parent = parent
+        self.capacity = int(capacity)
+        self.place = place
+        self._q = None
+        self._thread = None
+        self._stop = None
+
+    def _start(self):
+        q = queue.Queue(self.capacity)
+        stop = threading.Event()
+        self._q, self._stop = q, stop
+
+        def work():
+            # q/stop are captured locally: a superseded worker can never
+            # touch the queue of the thread that replaced it
+            try:
+                while not stop.is_set():
+                    batch = self.parent.next()
+                    if self.place is not None:
+                        import jax
+                        dev = self.place.jax_device()
+                        batch = tuple(jax.device_put(x, dev)
+                                      for x in batch)
+                    q.put(batch)
+            except EOFException:
+                q.put(EOFException("double_buffer"))
+            except Exception as e:  # surface decode errors to the reader
+                q.put(e)
+
+        self._thread = threading.Thread(target=work, daemon=True)
+        self._thread.start()
+
+    def next(self):
+        if self._thread is None:
+            self._start()
+        item = self._q.get()
+        if isinstance(item, Exception):
+            self._thread = None
+            raise item
+        return item
+
+    def reset(self):
+        thread, q, stop = self._thread, self._q, self._stop
+        self._thread = None
+        if thread is not None and thread.is_alive():
+            # mid-epoch reset: signal the worker, unblock any pending
+            # put, and WAIT for it to die before rewinding the parent —
+            # otherwise two threads race on the unsynchronized chain
+            stop.set()
+            while thread.is_alive():
+                try:
+                    q.get_nowait()
+                except queue.Empty:
+                    pass
+                thread.join(timeout=0.05)
+        self.parent.reset()
+
+
+def _set_state(scope, name, state):
+    (scope.find_scope_of(name) or scope).set(name, state)
+
+
+def _get_state(scope, name):
+    state = scope.find_var(name)
+    if not isinstance(state, _ReaderBase):
+        raise RuntimeError(
+            "%r is not an initialized reader (run the startup program "
+            "first)" % name)
+    return state
+
+
+@_host("create_recordio_file_reader")
+def _create_recordio(executor, op, scope, feed, env=None):
+    _set_state(scope, op.output("Out")[0],
+               _RecordIOReader(op.attr("filename"),
+                               pass_num=op.attr("pass_num") or 1))
+
+
+@_host("create_shuffle_reader")
+def _create_shuffle(executor, op, scope, feed, env=None):
+    parent = _get_state(scope, op.input("UnderlyingReader")[0])
+    _set_state(scope, op.output("Out")[0],
+               _ShuffleReader(parent, op.attr("buffer_size")))
+
+
+@_host("create_batch_reader")
+def _create_batch(executor, op, scope, feed, env=None):
+    parent = _get_state(scope, op.input("UnderlyingReader")[0])
+    _set_state(scope, op.output("Out")[0],
+               _BatchReader(parent, op.attr("batch_size")))
+
+
+@_host("create_double_buffer_reader")
+def _create_double_buffer(executor, op, scope, feed, env=None):
+    parent = _get_state(scope, op.input("UnderlyingReader")[0])
+    _set_state(scope, op.output("Out")[0],
+               _DoubleBufferReader(parent, capacity=2,
+                                   place=executor.place))
+
+
+@_host("read")
+def _read(executor, op, scope, feed, env=None):
+    state = _get_state(scope, op.input("Reader")[0])
+    batch = state.next()  # EOFException propagates to the caller
+    outs = op.output("Out")
+    if len(batch) != len(outs):
+        raise ValueError(
+            "reader yields %d slots but read op has %d outputs"
+            % (len(batch), len(outs)))
+    for name, val in zip(outs, batch):
+        if env is not None:
+            env[name] = val
+        # data vars go in the scope so the compiled core block (which
+        # runs after this prelude host op) picks them up as inputs
+        (scope.find_scope_of(name) or scope).set(name, val)

@@ -107,6 +107,32 @@ def test_machine_translation_wmt14(prog_scope, exe):
     assert ls[-1] < ls[0] * 0.5, (ls[0], ls[-1])
 
 
+def test_word2vec_imikolov(prog_scope, exe):
+    """The reference 5-gram word2vec net on the imikolov adapter's
+    Markov-chain synthetic corpus (reference book test_word2vec)."""
+    from paddle_tpu.models.word2vec import get_model, N
+    main, startup, scope = prog_scope
+    word_dict = dataset.imikolov.build_dict()
+    loss, feeds, _ = get_model(dict_size=len(word_dict),
+                               hidden_size=64, learning_rate=0.3)
+    exe.run(startup)
+    feeder = fluid.DataFeeder(feeds, program=main)
+
+    epoch_means = []
+    for _ in range(2):
+        ls = []
+        for batch in _batches(dataset.imikolov.train(word_dict, N), 64):
+            batch = [tuple([w] for w in gram) for gram in batch]
+            l, = exe.run(main, feed=feeder.feed(batch), fetch_list=[loss])
+            ls.append(float(np.asarray(l).ravel()[0]))
+        epoch_means.append(float(np.mean(ls)))
+    # the reference book test's own bar is just avg_cost < 5.8 (SGD is
+    # glacial on this net — test_word2vec.py bails once under 5.8);
+    # require dipping below the ln(203)=5.31 uniform start instead
+    assert epoch_means[-1] < 5.2, epoch_means
+    assert epoch_means[-1] < epoch_means[0], epoch_means
+
+
 def test_label_semantic_roles(prog_scope, exe):
     from paddle_tpu.models.label_semantic_roles import get_model
     main, startup, scope = prog_scope
