@@ -45,7 +45,9 @@ def transformer_bench(on_accel):
         bs = int(os.environ.get("BENCH_BATCH", "16"))
         seq = int(os.environ.get("BENCH_SEQ", "2048"))
         iters = int(os.environ.get("BENCH_ITERS", "30"))
-        d_model, n_layers, n_head = 512, 6, 8
+        d_model = int(os.environ.get("BENCH_DMODEL", "512"))
+        n_layers = int(os.environ.get("BENCH_LAYERS", "6"))
+        n_head = int(os.environ.get("BENCH_HEADS", "8"))
     else:
         bs, seq, iters = 2, 128, 3
         d_model, n_layers, n_head = 64, 2, 4
@@ -82,14 +84,29 @@ def transformer_bench(on_accel):
     loss = np.asarray(loss)
     elapsed = time.time() - t0
     tokens_per_sec = bs * seq * iters / elapsed
-    print(json.dumps({
-        "metric": "transformer_lm_train_bs%d_seq%d%s" % (
-            bs, seq, "_bf16" if amp else ""),
+    out = {
+        "metric": "transformer_lm_d%d_L%d_train_bs%d_seq%d%s" % (
+            d_model, n_layers, bs, seq, "_bf16" if amp else ""),
         "value": round(tokens_per_sec, 1),
         "unit": "tokens/sec",
         "vs_baseline": 0.0,  # no reference transformer baseline exists
         "amp": amp,
-    }))
+    }
+    if on_accel:
+        # standard analytic count: 6*N_params FLOPs/token (fwd+bwd) +
+        # causal attention 6*L*d_model*T (the scaling-book estimate)
+        n_params = sum(
+            int(np.prod(p.shape))
+            for p in main_prog.global_block().all_parameters())
+        flops_tok = 6.0 * n_params + 6.0 * n_layers * d_model * seq
+        tflops = tokens_per_sec * flops_tok / 1e12
+        out["params_m"] = round(n_params / 1e6, 1)
+        out["tflops"] = round(tflops, 1)
+        if amp:
+            peak = float(os.environ.get("BENCH_PEAK_TFLOPS",
+                                        DEFAULT_PEAK_TFLOPS))
+            out["mfu"] = round(tflops / peak, 3)
+    print(json.dumps(out))
 
 
 def main():

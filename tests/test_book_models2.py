@@ -107,6 +107,35 @@ def test_machine_translation_wmt14(prog_scope, exe):
     assert ls[-1] < ls[0] * 0.5, (ls[0], ls[-1])
 
 
+def test_image_classification_resnet_cifar(prog_scope, exe):
+    """The image_classification book chapter: resnet_cifar10 trained on
+    the cifar adapter (reference book test_image_classification)."""
+    from paddle_tpu.models.resnet import resnet_cifar10
+    main, startup, scope = prog_scope
+    images = fluid.layers.data(name="pixel", shape=[3, 32, 32],
+                               dtype="float32")
+    label = fluid.layers.data(name="label", shape=[1], dtype="int64")
+    logits = resnet_cifar10(images, 10, depth=20)
+    loss = fluid.layers.mean(
+        fluid.layers.cross_entropy(input=logits, label=label))
+    acc = fluid.layers.accuracy(input=logits, label=label)
+    fluid.optimizer.Adam(learning_rate=2e-3).minimize(loss)
+    exe.run(startup)
+
+    samples = list(itertools.islice(dataset.cifar.train10()(), 64))
+    xs = np.stack([np.asarray(s[0], np.float32).reshape(3, 32, 32)
+                   for s in samples])
+    ys = np.asarray([[s[1]] for s in samples], np.int64)
+    ls = []
+    for _ in range(15):
+        l, a = exe.run(main, feed={"pixel": xs, "label": ys},
+                       fetch_list=[loss, acc])
+        ls.append(float(np.asarray(l).ravel()[0]))
+    # 20-layer resnet must overfit 64 cifar images to ~zero loss
+    assert ls[-1] < 0.1, (ls[0], ls[-1])
+    assert float(np.asarray(a).ravel()[0]) > 0.95
+
+
 def test_word2vec_imikolov(prog_scope, exe):
     """The reference 5-gram word2vec net on the imikolov adapter's
     Markov-chain synthetic corpus (reference book test_word2vec)."""
