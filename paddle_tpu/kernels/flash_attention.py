@@ -50,8 +50,8 @@ def _attention_xla(q, k, v, scale, causal):
     return jnp.einsum("bhts,bhsd->bhtd", p, v)
 
 
-def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref, *,
-                  scale, causal, block_q, block_k, n_k):
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref,
+                  acc_ref, *, scale, causal, block_q, block_k, n_k):
     # grid (bh, qi, ki); ki is the innermost SEQUENTIAL axis, so the
     # VMEM scratch (running max/sum/accumulator) carries across K tiles
     # while K/V stream block_k rows at a time.
@@ -93,8 +93,11 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref, *,
 
     @pl.when(ki == n_k - 1)
     def _done():
-        o_ref[...] = (acc_ref[...] /
-                      l_ref[...][:, 0][:, None]).astype(o_ref.dtype)
+        l = l_ref[...][:, 0]
+        o_ref[...] = (acc_ref[...] / l[:, None]).astype(o_ref.dtype)
+        # log-sum-exp per query row: all the softmax state the backward
+        # kernels need to rebuild P tile-by-tile
+        lse_ref[...] = (m_ref[...][:, 0] + jnp.log(l))[:, None]
 
 
 def flash_attention(q, k, v, scale=None, causal=False, block_q=512,
@@ -103,9 +106,10 @@ def flash_attention(q, k, v, scale=None, causal=False, block_q=512,
 
     Uses the Pallas kernel on TPU when T divides into the block sizes;
     anything else takes the XLA path (same math, fp32 accumulation).
-    Differentiable: the backward pass is the XLA attention vjp (flash
-    forward saves the [T,T] HBM materialization; backward re-derives it
-    as XLA's own attention grad would)."""
+    Differentiable end-to-end in O(T) memory: the forward saves the
+    per-row log-sum-exp and the backward is two Pallas kernels (dQ;
+    dK/dV) that rebuild P tile-by-tile — no [T, T] materialization in
+    either direction (Dao et al. 2022 alg. 2)."""
     b, h, t, d = q.shape
     tk = k.shape[2]
     if scale is None:
@@ -122,26 +126,183 @@ def flash_attention(q, k, v, scale=None, causal=False, block_q=512,
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
 def _flash_diff(q, k, v, scale, causal, block_q, block_k, interpret):
-    return _flash_pallas(q, k, v, scale, causal, block_q, block_k,
-                         interpret)
+    out, _ = _flash_pallas(q, k, v, scale, causal, block_q, block_k,
+                           interpret)
+    return out
 
 
 def _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret):
-    return _flash_pallas(q, k, v, scale, causal, block_q, block_k,
-                         interpret), (q, k, v)
+    out, lse = _flash_pallas(q, k, v, scale, causal, block_q, block_k,
+                             interpret)
+    return out, (q, k, v, out, lse)
 
 
 def _flash_bwd(scale, causal, block_q, block_k, interpret, res, g):
-    q, k, v = res
-    out, vjp = jax.vjp(
-        lambda q_, k_, v_: _attention_xla(q_, k_, v_, scale, causal),
-        q, k, v)
-    # the pallas forward emits q.dtype while the XLA path may promote
-    # (e.g. bf16 inputs -> f32 softmax chain): line the cotangent up
-    return vjp(g.astype(out.dtype))
+    """Flash backward (Dao et al. 2022, alg. 2): with the forward's
+    per-row log-sum-exp saved, P rebuilds tile-by-tile as
+    exp(scale*QK^T - lse), so the backward never materializes [T, T]
+    in HBM either — dQ streams K/V per Q tile, dK/dV stream Q/dO per
+    K tile, and D = rowsum(dO*O) replaces the softmax-jacobian term."""
+    q, k, v, out, lse = res
+    do = g.astype(out.dtype)
+    delta = (do.astype(jnp.float32) * out.astype(jnp.float32)).sum(-1)
+    dq = _flash_bwd_dq(q, k, v, do, lse, delta, scale, causal, block_q,
+                       block_k, interpret)
+    dk, dv = _flash_bwd_dkv(q, k, v, do, lse, delta, scale, causal,
+                            block_q, block_k, interpret)
+    return dq, dk, dv
 
 
 _flash_diff.defvjp(_flash_fwd, _flash_bwd)
+
+
+def _rebuild_p_ds(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, qi, ki,
+                  scale, causal, block_q, block_k):
+    """Shared backward tile math: rebuild the probability tile from the
+    saved LSE and form dS = P*(dO V^T - D).  Returns (q, k, p, ds) as
+    f32 — everything either backward kernel contracts with."""
+    q = q_ref[...].astype(jnp.float32)
+    k = k_ref[...].astype(jnp.float32)
+    v = v_ref[...].astype(jnp.float32)
+    do = do_ref[...].astype(jnp.float32)
+    s = (q @ k.T) * scale                             # [bq, bk]
+    p = jnp.exp(s - lse_ref[...][:, 0][:, None])
+    if causal:
+        q_pos = qi * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, p.shape, 0)
+        k_pos = ki * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, p.shape, 1)
+        p = jnp.where(q_pos >= k_pos, p, 0.0)
+    dp = do @ v.T                                     # [bq, bk]
+    ds = p * (dp - delta_ref[...][:, 0][:, None])
+    return q, k, do, p, ds
+
+
+def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+               acc_ref, *, scale, causal, block_q, block_k, n_k):
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    live = (ki * block_k <= qi * block_q + block_q - 1) if causal else True
+
+    @pl.when(live)
+    def _step():
+        _, k, _, _, ds = _rebuild_p_ds(
+            q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, qi, ki,
+            scale, causal, block_q, block_k)
+        acc_ref[...] += (ds @ k) * scale
+
+    @pl.when(ki == n_k - 1)
+    def _done():
+        dq_ref[...] = acc_ref[...].astype(dq_ref.dtype)
+
+
+def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref,
+                dv_ref, dk_acc, dv_acc, *, scale, causal, block_q,
+                block_k, n_q):
+    ki = pl.program_id(1)
+    qi = pl.program_id(2)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_acc[...] = jnp.zeros_like(dk_acc)
+        dv_acc[...] = jnp.zeros_like(dv_acc)
+
+    live = (ki * block_k <= qi * block_q + block_q - 1) if causal else True
+
+    @pl.when(live)
+    def _step():
+        q, _, do, p, ds = _rebuild_p_ds(
+            q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, qi, ki,
+            scale, causal, block_q, block_k)
+        dv_acc[...] += p.T @ do                       # [bk, d]
+        dk_acc[...] += (ds.T @ q) * scale
+
+    @pl.when(qi == n_q - 1)
+    def _done():
+        dk_ref[...] = dk_acc[...].astype(dk_ref.dtype)
+        dv_ref[...] = dv_acc[...].astype(dv_ref.dtype)
+
+
+def _bwd_operands(q, k, v, do, lse, delta):
+    b, h, t, d = q.shape
+    tk = k.shape[2]
+    return (q.reshape(b * h, t, d), k.reshape(b * h, tk, d),
+            v.reshape(b * h, tk, d), do.reshape(b * h, t, d),
+            lse.reshape(b * h, t, 1),
+            delta.astype(jnp.float32).reshape(b * h, t, 1))
+
+
+def _flash_bwd_dq(q, k, v, do, lse, delta, scale, causal, block_q,
+                  block_k, interpret):
+    from jax.experimental.pallas import tpu as pltpu
+
+    b, h, t, d = q.shape
+    tk = k.shape[2]
+    n_k = tk // block_k
+    kernel = functools.partial(_dq_kernel, scale=scale, causal=causal,
+                               block_q=block_q, block_k=block_k, n_k=n_k)
+    qf, kf, vf, dof, lsef, deltaf = _bwd_operands(q, k, v, do, lse, delta)
+    dq = pl.pallas_call(
+        kernel,
+        grid=(b * h, t // block_q, n_k),
+        in_specs=[
+            pl.BlockSpec((None, block_q, d), lambda bh, qi, ki: (bh, qi, 0)),
+            pl.BlockSpec((None, block_k, d), lambda bh, qi, ki: (bh, ki, 0)),
+            pl.BlockSpec((None, block_k, d), lambda bh, qi, ki: (bh, ki, 0)),
+            pl.BlockSpec((None, block_q, d), lambda bh, qi, ki: (bh, qi, 0)),
+            pl.BlockSpec((None, block_q, 1), lambda bh, qi, ki: (bh, qi, 0)),
+            pl.BlockSpec((None, block_q, 1), lambda bh, qi, ki: (bh, qi, 0)),
+        ],
+        out_specs=pl.BlockSpec((None, block_q, d),
+                               lambda bh, qi, ki: (bh, qi, 0)),
+        out_shape=jax.ShapeDtypeStruct((b * h, t, d), q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=interpret,
+    )(qf, kf, vf, dof, lsef, deltaf)
+    return dq.reshape(b, h, t, d)
+
+
+def _flash_bwd_dkv(q, k, v, do, lse, delta, scale, causal, block_q,
+                   block_k, interpret):
+    from jax.experimental.pallas import tpu as pltpu
+
+    b, h, t, d = q.shape
+    tk = k.shape[2]
+    n_q = t // block_q
+    kernel = functools.partial(_dkv_kernel, scale=scale, causal=causal,
+                               block_q=block_q, block_k=block_k, n_q=n_q)
+    qf, kf, vf, dof, lsef, deltaf = _bwd_operands(q, k, v, do, lse, delta)
+    dk, dv = pl.pallas_call(
+        kernel,
+        grid=(b * h, tk // block_k, n_q),
+        in_specs=[
+            pl.BlockSpec((None, block_q, d), lambda bh, ki, qi: (bh, qi, 0)),
+            pl.BlockSpec((None, block_k, d), lambda bh, ki, qi: (bh, ki, 0)),
+            pl.BlockSpec((None, block_k, d), lambda bh, ki, qi: (bh, ki, 0)),
+            pl.BlockSpec((None, block_q, d), lambda bh, ki, qi: (bh, qi, 0)),
+            pl.BlockSpec((None, block_q, 1), lambda bh, ki, qi: (bh, qi, 0)),
+            pl.BlockSpec((None, block_q, 1), lambda bh, ki, qi: (bh, qi, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((None, block_k, d), lambda bh, ki, qi: (bh, ki, 0)),
+            pl.BlockSpec((None, block_k, d), lambda bh, ki, qi: (bh, ki, 0)),
+        ],
+        out_shape=[jax.ShapeDtypeStruct((b * h, tk, d), k.dtype),
+                   jax.ShapeDtypeStruct((b * h, tk, d), v.dtype)],
+        scratch_shapes=[pltpu.VMEM((block_k, d), jnp.float32),
+                        pltpu.VMEM((block_k, d), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=interpret,
+    )(qf, kf, vf, dof, lsef, deltaf)
+    return dk.reshape(*k.shape), dv.reshape(*v.shape)
 
 
 def _flash_pallas(q, k, v, scale, causal, block_q, block_k, interpret):
@@ -155,7 +316,7 @@ def _flash_pallas(q, k, v, scale, causal, block_q, block_k, interpret):
     n_k = tk // block_k
     kernel = functools.partial(_flash_kernel, scale=scale, causal=causal,
                                block_q=block_q, block_k=block_k, n_k=n_k)
-    out = pl.pallas_call(
+    out, lse = pl.pallas_call(
         kernel,
         grid=(b * h, t // block_q, n_k),
         in_specs=[
@@ -166,9 +327,14 @@ def _flash_pallas(q, k, v, scale, causal, block_q, block_k, interpret):
             pl.BlockSpec((None, block_k, d),
                          lambda bh, qi, ki: (bh, ki, 0)),
         ],
-        out_specs=pl.BlockSpec((None, block_q, d),
-                               lambda bh, qi, ki: (bh, qi, 0)),
-        out_shape=jax.ShapeDtypeStruct((b * h, t, d), q.dtype),
+        out_specs=[
+            pl.BlockSpec((None, block_q, d),
+                         lambda bh, qi, ki: (bh, qi, 0)),
+            pl.BlockSpec((None, block_q, 1),
+                         lambda bh, qi, ki: (bh, qi, 0)),
+        ],
+        out_shape=[jax.ShapeDtypeStruct((b * h, t, d), q.dtype),
+                   jax.ShapeDtypeStruct((b * h, t, 1), jnp.float32)],
         scratch_shapes=[pltpu.VMEM((block_q, 1), jnp.float32),
                         pltpu.VMEM((block_q, 1), jnp.float32),
                         pltpu.VMEM((block_q, d), jnp.float32)],
@@ -176,4 +342,4 @@ def _flash_pallas(q, k, v, scale, causal, block_q, block_k, interpret):
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
     )(qf, kf, vf)
-    return out.reshape(b, h, t, d)
+    return out.reshape(b, h, t, d), lse.reshape(b, h, t)

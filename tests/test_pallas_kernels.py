@@ -45,9 +45,12 @@ def test_flash_attention_grads_match_xla():
 
     gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
     gx = jax.grad(loss_xla, argnums=(0, 1, 2))(q, k, v)
+    # the backward is now the tiled Pallas kernel pair (dQ; dK/dV), not
+    # XLA's vjp: different reduction order + this host's reduced-
+    # precision CPU matmuls need the usual ~1e-3 comparison window
     for a, b_ in zip(gf, gx):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
-                                   rtol=2e-4, atol=2e-5)
+                                   rtol=2e-3, atol=2e-3)
 
 
 def test_flash_attention_fallback_on_odd_shapes():
