@@ -3,10 +3,10 @@ samples (parity: python/paddle/reader/__init__.py docs).  Decorators
 compose readers; creators build them from arrays/files."""
 from .decorator import (map_readers, shuffle, chain, compose, buffered,
                         firstn, xmap_readers, cache,
-                        ComposeNotAligned)  # noqa: F401
+                        ComposeNotAligned, PipeReader)  # noqa: F401
 from . import creator  # noqa: F401
 from .device_loader import DeviceLoader, batch  # noqa: F401
 
 __all__ = ["map_readers", "shuffle", "chain", "compose", "buffered",
-           "firstn", "xmap_readers", "cache", "ComposeNotAligned",
+           "firstn", "xmap_readers", "cache", "ComposeNotAligned", "PipeReader",
            "creator", "DeviceLoader", "batch"]

@@ -198,3 +198,59 @@ def xmap_readers(mapper, reader, process_num, buffer_size, order=False):
             pending[i] = mapped
 
     return xreader
+
+
+class PipeReader:
+    """Stream records from a shell command's stdout (reference
+    reader/decorator.py:337 — the HDFS/S3/curl ingestion path).  Plain
+    or gzip streams; ``get_line`` yields decoded lines."""
+
+    def __init__(self, command, bufsize=8192, file_type="plain"):
+        import subprocess
+        import zlib
+
+        if not isinstance(command, str):
+            raise TypeError("command must be a string")
+        if file_type not in ("plain", "gzip"):
+            raise TypeError("file_type %s is not allowed" % file_type)
+        if file_type == "gzip":
+            self.dec = zlib.decompressobj(32 + zlib.MAX_WBITS)
+        self.file_type = file_type
+        self.bufsize = bufsize
+        self.process = subprocess.Popen(
+            command.split(" "), bufsize=bufsize, stdout=subprocess.PIPE)
+
+    def get_line(self, cut_lines=True, line_break="\n"):
+        import codecs
+
+        # incremental decode: a multi-byte utf-8 char may straddle a
+        # read-chunk boundary (the reference decodes per chunk and
+        # crashes on that)
+        decoder = codecs.getincrementaldecoder("utf-8")()
+        remained = ""
+        while True:
+            buff = self.process.stdout.read(self.bufsize)
+            if not buff:
+                decomp_buff = decoder.decode(b"", final=True)
+            elif self.file_type == "gzip":
+                decomp_buff = decoder.decode(self.dec.decompress(buff))
+            else:
+                decomp_buff = decoder.decode(buff)
+            if decomp_buff:
+                if not cut_lines:
+                    yield decomp_buff
+                else:
+                    lines = (remained + decomp_buff).split(line_break)
+                    remained = lines.pop(-1)
+                    for line in lines:
+                        yield line
+            if not buff:
+                break
+        if remained:
+            yield remained
+        # reap the child and surface failures: a dead `hadoop fs -cat`
+        # must not masquerade as an empty dataset
+        rc = self.process.wait()
+        if rc != 0:
+            raise RuntimeError(
+                "PipeReader command exited with status %d" % rc)

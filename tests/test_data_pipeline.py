@@ -236,3 +236,19 @@ def test_device_loader_feeds_training():
         assert len(losses) == 2048 // 64
         # learnable synthetic blobs: one epoch must cut loss in half
         assert np.mean(losses[-4:]) < losses[0] * 0.5
+
+
+def test_pipe_reader_plain_and_gzip(tmp_path):
+    import gzip
+    import os
+
+    from paddle_tpu.reader import PipeReader
+
+    pr = PipeReader("echo alpha beta")
+    assert list(pr.get_line()) == ["alpha beta"]
+
+    path = os.path.join(str(tmp_path), "x.gz")
+    with gzip.open(path, "wb") as f:
+        f.write(b"l1\nl2\nl3\n")
+    pr = PipeReader("cat %s" % path, file_type="gzip")
+    assert list(pr.get_line()) == ["l1", "l2", "l3"]
