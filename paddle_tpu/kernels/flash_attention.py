@@ -100,8 +100,8 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref,
         lse_ref[...] = (m_ref[...][:, 0] + jnp.log(l))[:, None]
 
 
-def flash_attention(q, k, v, scale=None, causal=False, block_q=512,
-                    block_k=512, force_xla=False, interpret=False):
+def flash_attention(q, k, v, scale=None, causal=False, block_q=1024,
+                    block_k=1024, force_xla=False, interpret=False):
     """softmax(QK^T scale) V, [B,H,T,D] in/out.
 
     Uses the Pallas kernel on TPU when T divides into the block sizes;
@@ -115,8 +115,18 @@ def flash_attention(q, k, v, scale=None, causal=False, block_q=512,
     if scale is None:
         scale = 1.0 / np.sqrt(d)
     on_tpu = target_platform() == "tpu"
-    block_q = min(block_q, t)
-    block_k = min(block_k, tk)
+
+    def fit(block, size):
+        # largest power-of-two tile <= requested that divides the dim,
+        # so raising the default never demotes a previously-kernel-
+        # eligible length (e.g. T=7680: 1024 fails, 512 divides)
+        block = min(block, size)
+        while block > 8 and size % block:
+            block //= 2
+        return block
+
+    block_q = fit(block_q, t)
+    block_k = fit(block_k, tk)
     usable = (t % block_q == 0 and tk % block_k == 0)
     if force_xla or not usable or not (on_tpu or interpret):
         return _attention_xla(q, k, v, scale, causal)
