@@ -19,8 +19,9 @@ from ..layer_helper import LayerHelper
 from .. import unique_name
 from paddle_tpu.core.types import VarKind
 
-__all__ = ["data", "open_recordio_file", "shuffle", "batch",
-           "double_buffer", "read_file"]
+__all__ = ["data", "open_recordio_file", "open_files",
+           "random_data_generator", "shuffle", "batch", "double_buffer",
+           "read_file"]
 
 
 def data(name, shape, append_batch_size=True, dtype="float32", lod_level=0,
@@ -63,25 +64,60 @@ def _reader_var(block, name, shapes, dtypes, lod_levels):
     return var
 
 
+def _create_reader(op_type, attrs, shapes, dtypes, lod_levels):
+    """Shared creator wiring: declare the reader var in the STARTUP
+    program (where the create op runs and leaves the scope state) and
+    mirror it in the main program for read_file/decorators."""
+    startup = default_startup_program()
+    main = default_main_program()
+    name = unique_name.generate(op_type)
+    _reader_var(startup.global_block(), name, shapes, dtypes, lod_levels)
+    startup.global_block().append_op(
+        type=op_type, inputs={}, outputs={"Out": [name]}, attrs=attrs,
+        infer_shape=False)
+    return _reader_var(main.global_block(), name, shapes, dtypes,
+                       lod_levels)
+
+
 def open_recordio_file(filename, shapes, lod_levels, dtypes,
                        pass_num=1, for_parallel=False):
     """Reader over a recordio file written by
     fluid.recordio_writer.convert_reader_to_recordio_file (reference
     io.py open_recordio_file / create_recordio_file_reader op).
     ``shapes`` include the batch dim as -1."""
-    startup = default_startup_program()
-    main = default_main_program()
-    name = unique_name.generate("open_recordio_file")
-    su_var = _reader_var(startup.global_block(), name, shapes, dtypes,
-                        lod_levels)
-    startup.global_block().append_op(
-        type="create_recordio_file_reader",
-        inputs={}, outputs={"Out": [name]},
-        attrs={"filename": filename, "pass_num": int(pass_num)},
-        infer_shape=False)
-    # the main program sees the same-named var (state lives in the scope)
-    return _reader_var(main.global_block(), name, shapes, dtypes,
-                       lod_levels)
+    return _create_reader(
+        "create_recordio_file_reader",
+        {"filename": filename, "pass_num": int(pass_num)},
+        shapes, dtypes, lod_levels)
+
+
+def open_files(filenames, shapes, lod_levels, dtypes, thread_num=1,
+               buffer_size=None, pass_num=1, for_parallel=False):
+    """Reader over a LIST of recordio files, concatenated (reference
+    io.py open_files / open_files_op)."""
+    return _create_reader(
+        "open_files",
+        {"filenames": list(filenames), "pass_num": int(pass_num)},
+        shapes, dtypes, lod_levels)
+
+
+def random_data_generator(low, high, shapes, lod_levels,
+                          for_parallel=False):
+    """Uniform-random dummy reader (reference io.py
+    random_data_generator) — drive a net without any file; all slots
+    are float32.  Batch (-1) dims are stripped here: the generator
+    yields per-sample arrays and the batch decorator stacks them."""
+    dtypes = ["float32"] * len(shapes)
+    shape_concat, ranks = [], []
+    for s in shapes:
+        dims = [int(x) for x in s if int(x) != -1]
+        shape_concat.extend(dims)
+        ranks.append(len(dims))
+    return _create_reader(
+        "create_random_data_generator",
+        {"low": float(low), "high": float(high),
+         "shape_concat": shape_concat, "ranks": ranks},
+        shapes, dtypes, lod_levels)
 
 
 def _decorate(op_type, reader, attrs):

@@ -204,6 +204,80 @@ def _create_recordio(executor, op, scope, feed, env=None):
                                pass_num=op.attr("pass_num") or 1))
 
 
+class _MultiFileReader(_ReaderBase):
+    """Concatenate several recordio files (reference
+    open_files_op/multi_file_reader: N prefetch threads over a file
+    list; here files stream sequentially — the double-buffer decorator
+    supplies the prefetch thread)."""
+
+    def __init__(self, filenames, pass_num=1):
+        self.readers = [_RecordIOReader(f) for f in filenames]
+        self.pass_num = max(1, int(pass_num))
+        self._idx = 0
+        self._passes_left = self.pass_num
+
+    def next(self):
+        while True:
+            if self._idx >= len(self.readers):
+                self._idx = 0
+                self._passes_left -= 1
+                if self._passes_left <= 0:
+                    self._passes_left = self.pass_num
+                    raise EOFException("open_files")
+            try:
+                return self.readers[self._idx].next()
+            except EOFException:
+                self._idx += 1
+
+    def reset(self):
+        self._idx = 0
+        self._passes_left = self.pass_num
+        for r in self.readers:
+            r.reset()
+
+
+class _RandomDataReader(_ReaderBase):
+    """Uniform random sample generator (reference
+    create_random_data_generator_op) — a dummy reader to drive a
+    network without any file."""
+
+    def __init__(self, low, high, shapes, seed=0):
+        # shapes are concrete per-sample dims (the layer strips the
+        # batch dim before flattening into attrs)
+        self.low, self.high = float(low), float(high)
+        self.shapes = [tuple(int(x) for x in s) for s in shapes]
+        self.seed = seed
+        self.rng = np.random.RandomState(seed)
+
+    def next(self):
+        return tuple(
+            self.rng.uniform(self.low, self.high, s).astype(np.float32)
+            for s in self.shapes)
+
+    def reset(self):
+        self.rng = np.random.RandomState(self.seed)
+
+
+@_host("open_files")
+def _open_files(executor, op, scope, feed, env=None):
+    _set_state(scope, op.output("Out")[0],
+               _MultiFileReader(list(op.attr("filenames") or []),
+                                pass_num=op.attr("pass_num") or 1))
+
+
+@_host("create_random_data_generator")
+def _create_random(executor, op, scope, feed, env=None):
+    # shapes travel flattened (attrs hold flat lists only):
+    # shape_concat=[3,224,224,1], ranks=[3,1] -> [(3,224,224), (1,)]
+    concat = list(op.attr("shape_concat") or [])
+    shapes, i = [], 0
+    for r in (op.attr("ranks") or []):
+        shapes.append(tuple(concat[i:i + r]))
+        i += r
+    _set_state(scope, op.output("Out")[0],
+               _RandomDataReader(op.attr("low"), op.attr("high"), shapes))
+
+
 @_host("create_shuffle_reader")
 def _create_shuffle(executor, op, scope, feed, env=None):
     parent = _get_state(scope, op.input("UnderlyingReader")[0])

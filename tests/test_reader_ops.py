@@ -100,6 +100,39 @@ def test_double_buffer_mid_epoch_reset(prog_scope, exe, tmp_path):
         exe.run(main, fetch_list=[out])
 
 
+def test_open_files_concatenates(prog_scope, exe, tmp_path):
+    p1 = os.path.join(str(tmp_path), "a.recordio")
+    p2 = os.path.join(str(tmp_path), "b.recordio")
+    _write_samples(p1, n=15, seed=1)
+    _write_samples(p2, n=15, seed=2)
+    main, startup, scope = prog_scope
+    reader = fluid.layers.io.open_files(
+        [p1, p2], shapes=[[-1, 784], [-1, 1]], lod_levels=[0, 0],
+        dtypes=["float32", "int64"])
+    reader = fluid.layers.io.batch(reader, batch_size=10)
+    img, label = fluid.layers.io.read_file(reader)
+    out = fluid.layers.reduce_sum(img)
+    exe.run(startup)
+    for _ in range(3):  # 30 samples across both files / batch 10
+        exe.run(main, fetch_list=[out])
+    with pytest.raises(fluid.core.EOFException):
+        exe.run(main, fetch_list=[out])
+    reader.reset()
+    exe.run(main, fetch_list=[out])  # rewound across the file list
+
+
+def test_random_data_generator(prog_scope, exe):
+    main, startup, scope = prog_scope
+    reader = fluid.layers.io.random_data_generator(
+        low=-1.0, high=1.0, shapes=[[-1, 8], [-1, 3]], lod_levels=[0, 0])
+    reader = fluid.layers.io.batch(reader, batch_size=4)
+    a, b = fluid.layers.io.read_file(reader)
+    out = fluid.layers.reduce_max(a)
+    exe.run(startup)
+    v, = exe.run(main, fetch_list=[out])
+    assert -1.0 <= float(np.asarray(v).ravel()[0]) <= 1.0
+
+
 def test_batch_reader_drops_partial(prog_scope, exe, tmp_path):
     path = os.path.join(str(tmp_path), "odd.recordio")
     _write_samples(path, n=25, seed=3)
