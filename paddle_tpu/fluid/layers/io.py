@@ -94,7 +94,9 @@ def open_recordio_file(filename, shapes, lod_levels, dtypes,
 def open_files(filenames, shapes, lod_levels, dtypes, thread_num=1,
                buffer_size=None, pass_num=1, for_parallel=False):
     """Reader over a LIST of recordio files, concatenated (reference
-    io.py open_files / open_files_op)."""
+    io.py open_files / open_files_op).  ``thread_num``/``buffer_size``
+    are accepted for signature parity but files stream sequentially —
+    chain double_buffer() for the prefetch thread."""
     return _create_reader(
         "open_files",
         {"filenames": list(filenames), "pass_num": int(pass_num)},
@@ -105,12 +107,20 @@ def random_data_generator(low, high, shapes, lod_levels,
                           for_parallel=False):
     """Uniform-random dummy reader (reference io.py
     random_data_generator) — drive a net without any file; all slots
-    are float32.  Batch (-1) dims are stripped here: the generator
-    yields per-sample arrays and the batch decorator stacks them."""
+    are float32.  The LEADING batch (-1) dim is stripped: the
+    generator yields per-sample arrays and the batch decorator stacks
+    them; interior dims must be concrete (random data has no ragged
+    axis)."""
     dtypes = ["float32"] * len(shapes)
     shape_concat, ranks = [], []
     for s in shapes:
-        dims = [int(x) for x in s if int(x) != -1]
+        dims = [int(x) for x in s]
+        if dims and dims[0] == -1:
+            dims = dims[1:]
+        if any(d <= 0 for d in dims):
+            raise ValueError(
+                "random_data_generator shapes must be concrete after "
+                "the leading batch dim, got %r" % (list(s),))
         shape_concat.extend(dims)
         ranks.append(len(dims))
     return _create_reader(

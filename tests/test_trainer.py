@@ -143,3 +143,56 @@ def test_trainer_checkpoint_kill_and_resume(tmp_path):
         assert key in resumed, (key, sorted(resumed))
         np.testing.assert_allclose(resumed[key], baseline[key],
                                    rtol=1e-4, err_msg=str(key))
+
+
+def test_trainer_fit_a_line_uci_housing(tmp_path):
+    """The high-level-api fit_a_line chapter end-to-end: Trainer over
+    the uci_housing adapter, EndEpoch test() gate, then Inferencer
+    (reference book/high-level-api/fit_a_line/test_fit_a_line.py)."""
+    import paddle_tpu as pt
+    from paddle_tpu import dataset
+
+    def train_func():
+        x = fluid.layers.data(name="x", shape=[13], dtype="float32")
+        y = fluid.layers.data(name="y", shape=[1], dtype="float32")
+        pred = fluid.layers.fc(x, size=1, act=None,
+                               param_attr=fluid.ParamAttr(name="fw"),
+                               bias_attr=fluid.ParamAttr(name="fb"))
+        return [fluid.layers.mean(
+            fluid.layers.square_error_cost(input=pred, label=y))]
+
+    def infer_func():
+        x = fluid.layers.data(name="x", shape=[13], dtype="float32")
+        return fluid.layers.fc(x, size=1, act=None,
+                               param_attr=fluid.ParamAttr(name="fw"),
+                               bias_attr=fluid.ParamAttr(name="fb"))
+
+    trainer = fluid.Trainer(
+        train_func=train_func,
+        optimizer_func=lambda: fluid.optimizer.SGD(learning_rate=0.01),
+        place=fluid.CPUPlace())
+
+    test_losses = []
+
+    def handler(ev):
+        if isinstance(ev, fluid.EndEpochEvent):
+            test_losses.append(trainer.test(
+                reader=pt.batch(dataset.uci_housing.test(), 32),
+                feed_order=["x", "y"])[0])
+
+    trainer.train(num_epochs=12,
+                  event_handler=handler,
+                  reader=pt.batch(dataset.uci_housing.train(), 32),
+                  feed_order=["x", "y"])
+    # held-out MSE must fall substantially from the untrained start
+    assert test_losses[-1] < test_losses[0] * 0.5, test_losses[:3]
+
+    param_path = str(tmp_path / "fit_a_line")
+    trainer.save_params(param_path)
+    inferencer = fluid.Inferencer(infer_func=infer_func,
+                                  param_path=param_path,
+                                  place=fluid.CPUPlace())
+    batch = np.stack([s[0] for s in list(
+        dataset.uci_housing.test()())[:8]]).astype(np.float32)
+    preds = np.asarray(inferencer.infer({"x": batch})[0])
+    assert preds.shape == (8, 1) and np.isfinite(preds).all()
