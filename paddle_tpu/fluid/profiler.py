@@ -14,7 +14,8 @@ import threading
 import time
 
 __all__ = ["RecordEvent", "profiler", "start_profiler", "stop_profiler",
-           "reset_profiler", "cuda_profiler", "export_chrome_tracing"]
+           "reset_profiler", "cuda_profiler", "export_chrome_tracing",
+           "device_op_profile"]
 
 _state = {
     "enabled": False,
@@ -62,6 +63,14 @@ def start_profiler(state="All", trace_dir=None):
             _state["jax_trace_dir"] = trace_dir
         except Exception:
             _state["jax_trace_dir"] = None
+
+
+def device_op_profile(trace_dir, top=20):
+    """Per-op device-time table from a captured trace dir (the XPlane
+    files a ``start_profiler(trace_dir=...)`` / ``jax.profiler.trace``
+    run leaves behind) — utils/xplane.py does the parsing."""
+    from paddle_tpu.utils import xplane
+    return xplane.print_op_profile(trace_dir, top=top)
 
 
 def stop_profiler(sorted_key=None, profile_path="/tmp/profile"):

@@ -1,0 +1,174 @@
+"""XPlane trace reader: aggregate per-op device time from a
+``jax.profiler.trace`` capture (SURVEY §5.1 — the device-tracer half of
+the profiling story; fluid/profiler.py covers the host half).
+
+``jax.profiler.trace(dir)`` writes
+``<dir>/plugins/profile/<run>/*.xplane.pb``; the usual viewer
+(tensorboard-plugin-profile) needs a working TF protobuf stack, which
+this environment lacks.  This module reads the XSpace container with a
+minimal protobuf wire-format walker — no generated code, no
+tensorflow — and reduces the "XLA Ops" line to per-op totals, which is
+what perf work actually consumes (it found the flash-attention backward
+and block-size wins).
+
+Wire schema (public tensorflow/core/profiler/protobuf/xplane.proto):
+XSpace.planes=1; XPlane{name=2, lines=3, event_metadata=4(map)};
+XLine{name=2, events=4}; XEvent{metadata_id=1, duration_ps=3};
+XEventMetadata{id=1, name=2}.
+"""
+from __future__ import annotations
+
+import collections
+import glob
+import os
+import re
+
+__all__ = ["read_xspace", "op_totals", "print_op_profile"]
+
+
+def _varint(buf, i):
+    x = s = 0
+    while True:
+        b = buf[i]
+        i += 1
+        x |= (b & 0x7F) << s
+        if not b & 0x80:
+            return x, i
+        s += 7
+
+
+def _fields(buf):
+    """Yield (field_number, wire_type, value) over a message buffer;
+    length-delimited values come back as memoryview slices."""
+    i, n = 0, len(buf)
+    while i < n:
+        key, i = _varint(buf, i)
+        fno, wt = key >> 3, key & 7
+        if wt == 0:                      # varint
+            v, i = _varint(buf, i)
+        elif wt == 1:                    # fixed64
+            v = int.from_bytes(buf[i:i + 8], "little")
+            i += 8
+        elif wt == 2:                    # length-delimited
+            ln, i = _varint(buf, i)
+            v = buf[i:i + ln]
+            i += ln
+        elif wt == 5:                    # fixed32
+            v = int.from_bytes(buf[i:i + 4], "little")
+            i += 4
+        else:
+            raise ValueError("unsupported wire type %d" % wt)
+        yield fno, wt, v
+
+
+def _parse_event(buf):
+    meta_id = 0
+    dur_ps = 0
+    for fno, wt, v in _fields(buf):
+        if fno == 1 and wt == 0:
+            meta_id = v
+        elif fno == 3 and wt == 0:
+            dur_ps = v
+    return meta_id, dur_ps
+
+
+def _parse_line(buf):
+    name = ""
+    events = []
+    for fno, wt, v in _fields(buf):
+        if fno == 2 and wt == 2:
+            name = bytes(v).decode("utf-8", "replace")
+        elif fno == 4 and wt == 2:
+            events.append(_parse_event(v))
+    return name, events
+
+
+def _parse_metadata_entry(buf):
+    """map<int64, XEventMetadata> entry: key=1, value=2."""
+    key = 0
+    name = ""
+    for fno, wt, v in _fields(buf):
+        if fno == 1 and wt == 0:
+            key = v
+        elif fno == 2 and wt == 2:
+            for f2, w2, v2 in _fields(v):
+                if f2 == 2 and w2 == 2:
+                    name = bytes(v2).decode("utf-8", "replace")
+    return key, name
+
+
+def _parse_plane(buf):
+    name = ""
+    lines = []
+    metadata = {}
+    for fno, wt, v in _fields(buf):
+        if fno == 2 and wt == 2:
+            name = bytes(v).decode("utf-8", "replace")
+        elif fno == 3 and wt == 2:
+            lines.append(_parse_line(v))
+        elif fno == 4 and wt == 2:
+            k, nm = _parse_metadata_entry(v)
+            metadata[k] = nm
+    return {"name": name, "lines": lines, "event_metadata": metadata}
+
+
+def read_xspace(path):
+    """Parse .xplane.pb file(s) into [{name, lines: [(line_name,
+    [(metadata_id, duration_ps)])], event_metadata: {id: name}}].
+
+    Given a trace DIR, reads every host's .xplane.pb in the most
+    recently modified run directory (multi-host captures write one file
+    per host into the same plugins/profile/<run>/)."""
+    if os.path.isdir(path):
+        runs = glob.glob(os.path.join(path, "plugins", "profile", "*"))
+        runs = [r for r in runs
+                if glob.glob(os.path.join(r, "*.xplane.pb"))]
+        if not runs:
+            raise FileNotFoundError(
+                "no .xplane.pb under %s (pass a jax.profiler.trace "
+                "output dir)" % path)
+        run = max(runs, key=os.path.getmtime)
+        files = sorted(glob.glob(os.path.join(run, "*.xplane.pb")))
+    else:
+        files = [path]
+    planes = []
+    for f in files:
+        buf = memoryview(open(f, "rb").read())
+        for fno, wt, v in _fields(buf):
+            if fno == 1 and wt == 2:
+                planes.append(_parse_plane(v))
+    return planes
+
+
+def op_totals(path, plane_re=r"/device:", line_name="XLA Ops",
+              strip_suffix=True):
+    """{op_name: total_duration_ps} summed over EVERY matching plane's
+    op line (all chips of a multi-device trace).  ``strip_suffix``
+    folds '%fusion.123' into '%fusion' families."""
+    agg = collections.Counter()
+    for plane in read_xspace(path):
+        if not re.search(plane_re, plane["name"]):
+            continue
+        md = plane["event_metadata"]
+        for lname, events in plane["lines"]:
+            if lname != line_name:
+                continue
+            for meta_id, dur in events:
+                name = md.get(meta_id, "#%d" % meta_id)
+                name = name.split(" = ")[0]
+                if strip_suffix:
+                    name = re.sub(r"\.\d+$", "", name)
+                agg[name] += dur
+    return dict(agg)
+
+
+def print_op_profile(path, top=20, **kwargs):
+    """Top-N op families by device time, with shares — the quick look
+    that drives kernel work."""
+    agg = op_totals(path, **kwargs)
+    total = sum(agg.values()) or 1
+    print("%-50s %10s %7s" % ("op", "ms", "share"))
+    for name, ps in sorted(agg.items(), key=lambda kv: -kv[1])[:top]:
+        print("%-50s %10.3f %6.2f%%"
+              % (name[:50], ps / 1e9, 100.0 * ps / total))
+    return agg

@@ -1,0 +1,110 @@
+"""XPlane trace reader (paddle_tpu/utils/xplane.py): minimal protobuf
+wire parsing validated against a hand-encoded XSpace."""
+import os
+
+import pytest
+
+from paddle_tpu.utils import xplane
+
+
+def _varint(x):
+    out = b""
+    while True:
+        b = x & 0x7F
+        x >>= 7
+        if x:
+            out += bytes([b | 0x80])
+        else:
+            return out + bytes([b])
+
+
+def _ld(fno, payload):  # length-delimited field
+    return _varint((fno << 3) | 2) + _varint(len(payload)) + payload
+
+
+def _vi(fno, v):  # varint field
+    return _varint(fno << 3) + _varint(v)
+
+
+def _event(meta_id, dur_ps):
+    return _vi(1, meta_id) + _vi(3, dur_ps)
+
+
+def _line(name, events):
+    return _ld(2, name.encode()) + b"".join(_ld(4, e) for e in events)
+
+
+def _md_entry(mid, name):
+    inner = _vi(1, mid) + _ld(2, name.encode())
+    return _vi(1, mid) + _ld(2, inner)
+
+
+def _plane(name, lines, metadata):
+    return (_ld(2, name.encode())
+            + b"".join(_ld(3, ln) for ln in lines)
+            + b"".join(_ld(4, _md_entry(k, v))
+                       for k, v in metadata.items()))
+
+
+def _xspace(planes):
+    return b"".join(_ld(1, p) for p in planes)
+
+
+@pytest.fixture
+def trace_file(tmp_path):
+    md = {1: "%fusion.1 = f32[8]{0} fusion(...)",
+          2: "%fusion.2 = f32[8]{0} fusion(...)",
+          3: "%convolution"}
+    ops = _line("XLA Ops", [_event(1, 1000), _event(2, 500),
+                            _event(1, 250), _event(3, 2000)])
+    steps = _line("Steps", [_event(1, 4000)])
+    dev = _plane("/device:TPU:0", [steps, ops], md)
+    host = _plane("/host:CPU", [_line("python", [_event(9, 7)])], {9: "py"})
+    run_dir = tmp_path / "plugins" / "profile" / "run1"
+    os.makedirs(run_dir)
+    path = run_dir / "host.xplane.pb"
+    path.write_bytes(_xspace([dev, host]))
+    return str(tmp_path)
+
+
+def test_read_xspace_structure(trace_file):
+    planes = xplane.read_xspace(trace_file)
+    names = [p["name"] for p in planes]
+    assert names == ["/device:TPU:0", "/host:CPU"]
+    dev = planes[0]
+    assert dev["event_metadata"][3] == "%convolution"
+    lines = dict(dev["lines"])
+    assert lines["XLA Ops"] == [(1, 1000), (2, 500), (1, 250), (3, 2000)]
+
+
+def test_op_totals_folds_suffixes(trace_file):
+    agg = xplane.op_totals(trace_file)
+    # %fusion.1 + %fusion.2 fold into one family; names cut at " = "
+    assert agg == {"%fusion": 1750, "%convolution": 2000}
+    raw = xplane.op_totals(trace_file, strip_suffix=False)
+    assert raw == {"%fusion.1": 1250, "%fusion.2": 500,
+                   "%convolution": 2000}
+
+
+def test_op_totals_missing_plane(trace_file):
+    assert xplane.op_totals(trace_file, plane_re="no-such-plane") == {}
+
+
+def test_op_totals_sums_all_device_planes(tmp_path):
+    """Multi-chip traces must aggregate EVERY matching plane, and a dir
+    read must include every host's file in the newest run dir."""
+    md = {1: "%fusion"}
+    planes0 = [_plane("/device:TPU:0",
+                      [_line("XLA Ops", [_event(1, 100)])], md)]
+    planes1 = [_plane("/device:TPU:1",
+                      [_line("XLA Ops", [_event(1, 40)])], md)]
+    run_dir = tmp_path / "plugins" / "profile" / "run1"
+    os.makedirs(run_dir)
+    (run_dir / "hostA.xplane.pb").write_bytes(_xspace(planes0))
+    (run_dir / "hostB.xplane.pb").write_bytes(_xspace(planes1))
+    assert xplane.op_totals(str(tmp_path)) == {"%fusion": 140}
+
+
+def test_read_xspace_missing_dir(tmp_path):
+    with pytest.raises(FileNotFoundError):
+        xplane.read_xspace(str(tmp_path))
