@@ -32,6 +32,7 @@ import time
 import numpy as np
 
 TRAIN_FLOPS_PER_IMG_224 = 12.3e9
+TRAIN_FLOPS_PER_IMG_VGG16_224 = 46.5e9  # ~15.5 GF fwd x3
 DEFAULT_PEAK_TFLOPS = 197.0  # v5e bf16
 
 
@@ -111,6 +112,10 @@ def transformer_bench(on_accel):
 
 def main():
     model_name = os.environ.get("BENCH_MODEL", "resnet50")
+    if model_name not in ("resnet50", "resnet32", "vgg", "transformer"):
+        raise SystemExit(
+            "BENCH_MODEL must be resnet50|resnet32|vgg|transformer, "
+            "got %r" % model_name)
     on_accel = False
     try:
         import jax
@@ -131,12 +136,19 @@ def main():
     amp = os.environ.get("BENCH_AMP", "1" if on_accel else "0") == "1"
 
     import paddle_tpu.fluid as fluid
-    from paddle_tpu.models import resnet
+    from paddle_tpu.models import resnet, vgg
 
     main_prog, startup = fluid.Program(), fluid.Program()
     with fluid.program_guard(main_prog, startup):
-        avg_cost, (data, label), (acc,) = resnet.get_model(
-            data_set=data_set, depth=50 if model_name == "resnet50" else 32)
+        if model_name == "vgg":
+            # vgg16_bn_drop — the fluid-benchmark VGG config; the only
+            # published reference number is legacy VGG-19 on CPU
+            avg_cost, (data, label), (acc,) = vgg.get_model(
+                data_set=data_set)
+        else:
+            avg_cost, (data, label), (acc,) = resnet.get_model(
+                data_set=data_set, depth=50 if model_name == "resnet50"
+                else 32)
     if amp:
         fluid.transpiler.Float16Transpiler().transpile(main_prog)
 
@@ -210,19 +222,27 @@ def main():
     elapsed = time.time() - t0
 
     images_per_sec = batch_size * iters / elapsed
-    baseline = 81.69  # MKL-DNN CPU ResNet-50 bs64 (IntelOptimizedPaddle.md:41)
+    if model_name == "vgg":
+        # closest published number: legacy VGG-19 train, MKL-DNN CPU,
+        # bs256 (IntelOptimizedPaddle.md:36) — vgg16 here, so the ratio
+        # is indicative, not exact
+        baseline = 30.44
+    else:
+        baseline = 81.69  # MKL-DNN CPU ResNet-50 bs64 (IntelOptimizedPaddle.md:41)
     out = {
-        "metric": "resnet50_%s_train_bs%d%s" % (
-            data_set, batch_size, "_bf16" if amp else ""),
+        "metric": "%s_%s_train_bs%d%s" % (
+            model_name, data_set, batch_size, "_bf16" if amp else ""),
         "value": round(images_per_sec, 2),
         "unit": "images/sec",
         "vs_baseline": round(images_per_sec / baseline, 3),
         "amp": amp,
         "fake_data": use_fake,
     }
-    # 224x224 ResNet-50 only: that's what the analytic FLOP count is for
-    if data_set in ("flowers", "imagenet") and model_name == "resnet50":
-        tflops = images_per_sec * TRAIN_FLOPS_PER_IMG_224 / 1e12
+    # 224x224 only: that's what the analytic FLOP counts are for
+    per_img = {"resnet50": TRAIN_FLOPS_PER_IMG_224,
+               "vgg": TRAIN_FLOPS_PER_IMG_VGG16_224}.get(model_name)
+    if data_set in ("flowers", "imagenet") and per_img:
+        tflops = images_per_sec * per_img / 1e12
         out["tflops"] = round(tflops, 1)
         if amp:  # MFU only vs the bf16 peak the run actually targets
             peak = float(os.environ.get("BENCH_PEAK_TFLOPS",

@@ -28,7 +28,10 @@ __all__ = ["read_xspace", "op_totals", "print_op_profile"]
 
 def _varint(buf, i):
     x = s = 0
+    n = len(buf)
     while True:
+        if i >= n:
+            raise ValueError("truncated protobuf (varint past buffer)")
         b = buf[i]
         i += 1
         x |= (b & 0x7F) << s
@@ -39,7 +42,9 @@ def _varint(buf, i):
 
 def _fields(buf):
     """Yield (field_number, wire_type, value) over a message buffer;
-    length-delimited values come back as memoryview slices."""
+    length-delimited values come back as memoryview slices.  Raises
+    ValueError on truncation instead of silently under-reading — a
+    half-written capture must not produce quietly-wrong totals."""
     i, n = 0, len(buf)
     while i < n:
         key, i = _varint(buf, i)
@@ -47,13 +52,21 @@ def _fields(buf):
         if wt == 0:                      # varint
             v, i = _varint(buf, i)
         elif wt == 1:                    # fixed64
+            if i + 8 > n:
+                raise ValueError("truncated protobuf (fixed64)")
             v = int.from_bytes(buf[i:i + 8], "little")
             i += 8
         elif wt == 2:                    # length-delimited
             ln, i = _varint(buf, i)
+            if ln > n - i:
+                raise ValueError(
+                    "truncated protobuf (field of %d bytes, %d left)"
+                    % (ln, n - i))
             v = buf[i:i + ln]
             i += ln
         elif wt == 5:                    # fixed32
+            if i + 4 > n:
+                raise ValueError("truncated protobuf (fixed32)")
             v = int.from_bytes(buf[i:i + 4], "little")
             i += 4
         else:
@@ -134,9 +147,12 @@ def read_xspace(path):
     planes = []
     for f in files:
         buf = memoryview(open(f, "rb").read())
-        for fno, wt, v in _fields(buf):
-            if fno == 1 and wt == 2:
-                planes.append(_parse_plane(v))
+        try:
+            for fno, wt, v in _fields(buf):
+                if fno == 1 and wt == 2:
+                    planes.append(_parse_plane(v))
+        except ValueError as e:
+            raise ValueError("%s: %s" % (f, e))
     return planes
 
 

@@ -108,3 +108,14 @@ def test_op_totals_sums_all_device_planes(tmp_path):
 def test_read_xspace_missing_dir(tmp_path):
     with pytest.raises(FileNotFoundError):
         xplane.read_xspace(str(tmp_path))
+
+
+def test_truncated_file_raises(tmp_path):
+    md = {1: "%fusion"}
+    good = _xspace([_plane("/device:TPU:0",
+                           [_line("XLA Ops", [_event(1, 100)])], md)])
+    run_dir = tmp_path / "plugins" / "profile" / "r"
+    os.makedirs(run_dir)
+    (run_dir / "t.xplane.pb").write_bytes(good[:-3])  # cut mid-field
+    with pytest.raises(ValueError, match="truncated"):
+        xplane.read_xspace(str(tmp_path))
