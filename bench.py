@@ -110,12 +110,72 @@ def transformer_bench(on_accel):
     print(json.dumps(out))
 
 
+def lstm_bench(on_accel):
+    """BENCH_MODEL=lstm: the stacked dynamic-LSTM text classifier
+    (fluid-benchmark stacked_dynamic_lstm).  Reports ms/batch alongside
+    examples/sec — the reference's legacy LSTM numbers are ms/batch
+    (benchmark/README.md:113-135: 184 ms at bs64/hidden512 on a K40m)."""
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.models import stacked_dynamic_lstm
+
+    if on_accel:
+        bs = int(os.environ.get("BENCH_BATCH", "64"))
+        hidden = int(os.environ.get("BENCH_HIDDEN", "512"))
+        seq = int(os.environ.get("BENCH_SEQ", "80"))
+        iters = int(os.environ.get("BENCH_ITERS", "30"))
+    else:
+        bs, hidden, seq, iters = 4, 32, 16, 3
+    amp = os.environ.get("BENCH_AMP", "1" if on_accel else "0") == "1"
+
+    main_prog, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main_prog, startup):
+        avg_cost, (words, label), _ = stacked_dynamic_lstm.get_model(
+            dict_dim=5000, hidden_dim=hidden)
+    if amp:
+        fluid.transpiler.Float16Transpiler().transpile(main_prog)
+    place = fluid.TPUPlace() if on_accel else fluid.CPUPlace()
+    exe = fluid.Executor(place)
+    exe.run(startup)
+
+    rng = np.random.RandomState(0)
+    feeder = fluid.DataFeeder([words, label], program=main_prog)
+    batch = [(rng.randint(0, 5000, seq).tolist(), [int(rng.randint(2))])
+             for _ in range(bs)]
+    feed = feeder.feed(batch)
+    for _ in range(2):
+        exe.run(main_prog, feed=feed, fetch_list=[avg_cost])
+    t0 = time.time()
+    for _ in range(iters):
+        loss, = exe.run(main_prog, feed=feed, fetch_list=[avg_cost],
+                        return_numpy=False)
+    np.asarray(loss)
+    elapsed = time.time() - t0
+    ms_per_batch = elapsed / iters * 1000
+    # K40m, bs64 hidden512 (benchmark/README.md:113-119 via
+    # BASELINE.md:20).  Indicative: that net is a 2-layer LSTM stack,
+    # this model is the fluid-benchmark 3-stack — and the ratio is only
+    # emitted when the run matches the baseline's bs/hidden config.
+    baseline_ms = 184.0
+    vs = (round(baseline_ms / ms_per_batch, 3)
+          if (bs, hidden) == (64, 512) else 0.0)
+    print(json.dumps({
+        "metric": "stacked_lstm_train_bs%d_h%d_seq%d%s" % (
+            bs, hidden, seq, "_bf16" if amp else ""),
+        "value": round(ms_per_batch, 2),
+        "unit": "ms/batch",
+        "vs_baseline": vs,
+        "examples_per_sec": round(bs * iters / elapsed, 1),
+        "amp": amp,
+    }))
+
+
 def main():
     model_name = os.environ.get("BENCH_MODEL", "resnet50")
-    if model_name not in ("resnet50", "resnet32", "vgg", "transformer"):
+    if model_name not in ("resnet50", "resnet32", "vgg", "transformer",
+                          "lstm", "alexnet", "googlenet"):
         raise SystemExit(
-            "BENCH_MODEL must be resnet50|resnet32|vgg|transformer, "
-            "got %r" % model_name)
+            "BENCH_MODEL must be resnet50|resnet32|vgg|transformer|"
+            "lstm|alexnet|googlenet, got %r" % model_name)
     on_accel = False
     try:
         import jax
@@ -124,6 +184,8 @@ def main():
         pass
     if model_name == "transformer":
         return transformer_bench(on_accel)
+    if model_name == "lstm":
+        return lstm_bench(on_accel)
     # Keep CPU smoke-runs fast; real run uses ImageNet shapes.
     if on_accel:
         batch_size = int(os.environ.get("BENCH_BATCH", "256"))
@@ -136,7 +198,7 @@ def main():
     amp = os.environ.get("BENCH_AMP", "1" if on_accel else "0") == "1"
 
     import paddle_tpu.fluid as fluid
-    from paddle_tpu.models import resnet, vgg
+    from paddle_tpu.models import alexnet, googlenet, resnet, vgg
 
     main_prog, startup = fluid.Program(), fluid.Program()
     with fluid.program_guard(main_prog, startup):
@@ -145,6 +207,15 @@ def main():
             # published reference number is legacy VGG-19 on CPU
             avg_cost, (data, label), (acc,) = vgg.get_model(
                 data_set=data_set)
+        elif model_name in ("alexnet", "googlenet"):
+            # legacy-benchmark families: 224x224 only (googlenet's final
+            # 7x7 avg pool requires it), so BENCH_DATASET is ignored and
+            # the CPU smoke path shrinks batch/iters instead of shapes
+            data_set = "flowers"
+            if not on_accel:
+                batch_size, iters = min(batch_size, 4), min(iters, 2)
+            mod = alexnet if model_name == "alexnet" else googlenet
+            avg_cost, (data, label), (acc,) = mod.get_model()
         else:
             avg_cost, (data, label), (acc,) = resnet.get_model(
                 data_set=data_set, depth=50 if model_name == "resnet50"
@@ -227,6 +298,10 @@ def main():
         # bs256 (IntelOptimizedPaddle.md:36) — vgg16 here, so the ratio
         # is indicative, not exact
         baseline = 30.44
+    elif model_name == "alexnet":
+        baseline = 626.53  # MKL-DNN CPU bs256 (IntelOptimizedPaddle.md:63)
+    elif model_name == "googlenet":
+        baseline = 269.50  # MKL-DNN CPU bs256 (IntelOptimizedPaddle.md:54)
     else:
         baseline = 81.69  # MKL-DNN CPU ResNet-50 bs64 (IntelOptimizedPaddle.md:41)
     out = {

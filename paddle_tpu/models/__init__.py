@@ -8,9 +8,9 @@ network builder(s) plus a ``get_model(...)`` returning
 from . import (mnist, resnet, vgg, transformer,  # noqa: F401
                stacked_dynamic_lstm, machine_translation,
                understand_sentiment, recommender, label_semantic_roles,
-               word2vec)
+               word2vec, alexnet, googlenet)
 
 __all__ = ["mnist", "resnet", "vgg", "transformer",
            "stacked_dynamic_lstm", "machine_translation",
            "understand_sentiment", "recommender", "label_semantic_roles",
-           "word2vec"]
+           "word2vec", "alexnet", "googlenet"]
