@@ -91,15 +91,16 @@ def _prepare_lod_feeds(feed):
 
 class _CacheEntry:
     __slots__ = ("fn", "input_names", "persist_outs", "fetch_names",
-                 "input_shardings")
+                 "input_shardings", "jit_fn")
 
     def __init__(self, fn, input_names, persist_outs, fetch_names,
-                 input_shardings=None):
+                 input_shardings=None, jit_fn=None):
         self.fn = fn
         self.input_names = input_names
         self.persist_outs = persist_outs
         self.fetch_names = fetch_names
         self.input_shardings = input_shardings
+        self.jit_fn = jit_fn  # the raw jax.jit object (AOT lower/compile)
 
 
 class ExecutorCore:
@@ -159,8 +160,13 @@ class ExecutorCore:
                 n for n in post_in
                 if n not in feed and not scope.has_var(n)
                 and n not in post_writes})
-            outs = self._run_compiled(program, block_id, core_ops, scope,
-                                      feed, core_fetch + post_reads, mode)
+            if core_ops or core_fetch or post_reads:
+                outs = self._run_compiled(program, block_id, core_ops,
+                                          scope, feed,
+                                          core_fetch + post_reads, mode)
+            else:
+                outs = []  # all-host program (save/load/...): nothing to
+                #            compile — don't jit an empty computation
             by_name = dict(zip(core_fetch, outs[:len(core_fetch)]))
             post_env = dict(zip(post_reads, outs[len(core_fetch):]))
             for op in postlude:
@@ -177,8 +183,9 @@ class ExecutorCore:
                   file=sys.stderr)
 
         if return_numpy:
-            fetches = [np.asarray(v) if v is not None and not isinstance(
-                v, (list, tuple)) else v for v in fetches]
+            fetches = [_to_host_numpy(v) if v is not None and
+                       not isinstance(v, (list, tuple)) else v
+                       for v in fetches]
         return fetches
 
     # ------------------------------------------------------------------
@@ -365,7 +372,7 @@ class ExecutorCore:
                 return jflat(*inputs, seed, counter)
 
         return _CacheEntry(jfn, input_names, persist_outs, tuple(fetch_list),
-                           input_shardings)
+                           input_shardings, jit_fn=jflat)
 
     def _build_auto_layout(self, fn_flat, jit_kwargs, input_names,
                            persist_outs, fetch_list, block, feed, scope,
@@ -508,12 +515,42 @@ def _in_feed_only(name, feed, scope):
     return name in feed and not scope.has_var(name)
 
 
+def _to_host_numpy(v):
+    """np.asarray that also handles multi-host global arrays: fetches
+    are replicated (out_shardings in _build), so this process's first
+    addressable shard IS the value."""
+    if isinstance(v, jax.Array) and not v.is_fully_addressable:
+        return np.asarray(v.addressable_data(0))
+    return np.asarray(v)
+
+
 def _put(val, target):
-    """device_put that tolerates Format targets.  The TPU runtime here
-    rejects device_put of a jax.Array onto a Format EVEN when the array
-    already has exactly that layout (the relayout-by-jit path fails on
-    the backend), so the already-formatted steady-state case must be a
-    true no-op, and a genuine relayout goes through the host."""
+    """device_put that tolerates Format targets and multi-host shardings.
+
+    Multi-host (jax.distributed) shardings span devices this process
+    cannot address; host values are assembled with
+    ``make_array_from_process_local_data`` — batch-sharded feeds carry
+    each process's LOCAL rows (the reference nccl2 contract: every
+    trainer feeds its own batch, parallel_executor.cc:84-95) and
+    replicated values carry the full array.  Already-global jax.Arrays
+    (last step's persistables) pass through untouched.
+
+    Format targets: the TPU runtime here rejects device_put of a
+    jax.Array onto a Format EVEN when the array already has exactly that
+    layout (the relayout-by-jit path fails on the backend), so the
+    already-formatted steady-state case must be a true no-op, and a
+    genuine relayout goes through the host."""
+    from jax.sharding import Sharding
+    if isinstance(target, Sharding) and not target.is_fully_addressable:
+        if isinstance(val, jax.Array):
+            if val.sharding == target:
+                return val
+            if not val.is_fully_addressable:  # global -> global reshard
+                return jax.device_put(val, target)
+            val = np.asarray(val)  # local array -> rebuild globally
+        elif not isinstance(val, np.ndarray):
+            val = np.asarray(val)  # scope value / list / scalar
+        return jax.make_array_from_process_local_data(target, val)
     fmt_layout = getattr(target, "layout", None)
     if fmt_layout is not None and isinstance(val, jax.Array):
         try:

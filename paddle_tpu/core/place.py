@@ -33,13 +33,17 @@ class Place:
 
 
 def _devices_for(kind):
+    # local_devices, not devices: under jax.distributed the global list
+    # spans every process, and a Place must resolve to a device THIS
+    # process can address (a multi-host run would otherwise pin local
+    # work to another host's device id and die on a cross-host reshard)
     if kind == "cpu":
         try:
-            return jax.devices("cpu")
+            return jax.local_devices(backend="cpu")
         except RuntimeError:
             return []
     # "accelerator": whatever the default backend exposes, minus pure-host
-    devs = jax.devices()
+    devs = jax.local_devices()
     accel = [d for d in devs if d.platform != "cpu"]
     return accel or devs  # fall back to CPU so tests run anywhere
 

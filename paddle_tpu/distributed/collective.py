@@ -21,7 +21,17 @@ from __future__ import annotations
 
 import os
 
-__all__ = ["init_collective_env", "collective_env", "global_mesh"]
+__all__ = ["init_collective_env", "collective_env", "global_mesh",
+           "is_initialized"]
+
+
+def is_initialized():
+    """True once this process has joined a jax.distributed world."""
+    try:
+        from jax._src import distributed
+        return distributed.global_state.client is not None
+    except Exception:
+        return False
 
 
 def collective_env(environ=None):
@@ -73,6 +83,8 @@ def init_collective_env(environ=None, **kwargs):
     coordinator, num_processes, process_id = parsed
     if num_processes == 1:
         return 1, 0
+    if is_initialized():  # idempotent: the caller may have joined already
+        return num_processes, process_id
     import jax
 
     jax.distributed.initialize(

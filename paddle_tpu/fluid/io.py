@@ -132,7 +132,13 @@ def get_inference_program(target_vars, main_program=None):
 
 def save_inference_model(dirname, feeded_var_names, target_vars, executor,
                          main_program=None, model_filename=None,
-                         params_filename=None):
+                         params_filename=None, aot_feed_specs=None):
+    """aot_feed_specs ({feed_name: (shape, dtype)}): additionally
+    AOT-compile the pruned program for those input specs and serialize
+    the finished XLA executable next to the model (inference/aot.py —
+    the TPU-native pre-compiled-engine analog of the reference's
+    TensorRT subgraph plan, inference/tensorrt/engine.cc); the
+    predictor then serves without re-tracing or re-compiling."""
     if main_program is None:
         main_program = default_main_program()
     if not isinstance(target_vars, list):
@@ -153,6 +159,12 @@ def save_inference_model(dirname, feeded_var_names, target_vars, executor,
     with open(os.path.join(dirname, model_filename), "wb") as f:
         f.write(inference_program.serialize_to_string())
     save_persistables(executor, dirname, main_program, params_filename)
+    if aot_feed_specs:
+        from paddle_tpu.inference.aot import save_aot
+        from .executor import _current_scope
+        save_aot(dirname, inference_program, dict(aot_feed_specs),
+                 [v.name for v in target_vars], _current_scope(),
+                 executor.place)
     return inference_program
 
 

@@ -64,14 +64,31 @@ class ParallelExecutor:
                  mesh_axes=None):
         if use_tpu is None:
             use_tpu = use_cuda  # migration: use_cuda=True means accelerator
+        self._num_trainers, self._trainer_id = num_trainers, trainer_id
         if num_trainers != 1 or trainer_id != 0:
-            # Accepting-and-ignoring the multi-host API would be a trap
-            # (reference parallel_executor.cc:88 builds flat NCCL world
-            # ranks from these); raise until the multi-host path exists.
-            raise NotImplementedError(
-                "multi-host ParallelExecutor (num_trainers/trainer_id) is "
-                "not wired up yet; use the distribute transpiler for "
-                "multi-process training")
+            # Multi-host ("nccl2") mode: join the jax.distributed world
+            # (the gen_nccl_id analog, reference parallel_executor.cc:84-95
+            # + platform/nccl_helper.h:81) and build the mesh over EVERY
+            # process's devices; each trainer then feeds its local batch
+            # shard and GSPMD lays the gradient psums onto ICI/DCN.
+            from paddle_tpu.distributed import collective
+            if not collective.is_initialized():
+                nproc, pid = collective.init_collective_env()
+                if nproc == 1:
+                    raise RuntimeError(
+                        "num_trainers=%d but neither jax.distributed is "
+                        "initialized nor the PADDLE_TRAINER_ENDPOINTS env "
+                        "contract is set" % num_trainers)
+            else:
+                parsed = collective.collective_env()
+                nproc, pid = (parsed[1], parsed[2]) if parsed else (
+                    num_trainers, trainer_id)
+            if (nproc, pid) != (num_trainers, trainer_id):
+                raise ValueError(
+                    "collective world is (num_processes=%d, process_id=%d) "
+                    "but ParallelExecutor got (num_trainers=%d, "
+                    "trainer_id=%d)" % (nproc, pid, num_trainers,
+                                        trainer_id))
         self._program = main_program or default_main_program()
         self._scope = scope or _current_scope()
         self._build_strategy = build_strategy or BuildStrategy()
@@ -117,12 +134,15 @@ class ParallelExecutor:
         names = [f.name if isinstance(f, Variable) else f
                  for f in fetch_list]
         n = dict(self.mesh.shape).get("dp", 1)  # batch splits over dp only
+        # multi-host: each trainer feeds its LOCAL batch shard, which
+        # must split over this process's share of the dp axis
+        n_local = max(n // max(self._num_trainers, 1), 1)
         for k, v in feed.items():
             bs = np.shape(v)[0] if np.ndim(v) else 0
-            if bs % max(n, 1) != 0:
+            if bs % max(n_local, 1) != 0:
                 raise ValueError(
-                    "feed %r batch %d not divisible by %d devices"
-                    % (k, bs, n))
+                    "feed %r batch %d not divisible by %d local devices"
+                    % (k, bs, n_local))
         return self._core.run(self._program.desc, self._scope, 0, feed,
                               names, mode="train",
                               return_numpy=return_numpy)

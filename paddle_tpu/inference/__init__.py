@@ -48,11 +48,13 @@ class NativeConfig:
     use_tpu replaces use_gpu/device."""
 
     def __init__(self, model_dir=None, prog_file=None, param_file=None,
-                 use_tpu=False):
+                 use_tpu=False, use_aot=True):
         self.model_dir = model_dir
         self.prog_file = prog_file
         self.param_file = param_file
         self.use_tpu = use_tpu
+        # load a pre-compiled executable when the model dir has one
+        self.use_aot = use_aot
 
 
 class AnalysisConfig(NativeConfig):
@@ -76,7 +78,7 @@ class PaddlePredictor:
         if _shared is not None:
             # clone(): share weights scope + program + compiled cache
             (self.scope, self.program, self.feed_names,
-             self.fetch_vars, self.exe) = _shared
+             self.fetch_vars, self.exe, self.aot) = _shared
             return
         self.scope = fluid.Scope()
         self.exe = fluid.Executor(self.place)
@@ -102,6 +104,18 @@ class PaddlePredictor:
         self.program = prog
         self.feed_names = list(feeds)
         self.fetch_vars = fetches
+        # Pre-compiled executable (save_inference_model aot_feed_specs):
+        # serve without re-tracing/re-compiling when the feed matches.
+        # Skipped when ANY analysis pass ran — BN-fold mutates the
+        # parameter scope and bf16 rewrites the program, but the
+        # artifact was compiled from the exact exported program, so
+        # serving it against transpiled state would be silently wrong.
+        analyzed = isinstance(config, AnalysisConfig) and (
+            config.fold_batch_norm or config.use_bf16)
+        self.aot = None
+        if getattr(config, "use_aot", True) and not analyzed:
+            from .aot import load_aot
+            self.aot = load_aot(dirname, self.scope, self.place)
 
     def run(self, inputs):
         """inputs: list[PaddleTensor] (or dict name->array).  Returns
@@ -121,9 +135,12 @@ class PaddlePredictor:
         if missing:
             raise ValueError("missing feeds %r (model expects %r)" %
                              (missing, self.feed_names))
-        with fluid.scope_guard(self.scope):
-            outs = self.exe.run(self.program, feed=feed,
-                                fetch_list=self.fetch_vars)
+        if self.aot is not None and self.aot.matches(feed):
+            outs = self.aot.run(feed)  # no trace, no compile
+        else:
+            with fluid.scope_guard(self.scope):
+                outs = self.exe.run(self.program, feed=feed,
+                                    fetch_list=self.fetch_vars)
         return [PaddleTensor(name=getattr(v, "name", str(i)),
                              data=np.asarray(o))
                 for i, (v, o) in enumerate(zip(self.fetch_vars, outs))]
@@ -137,7 +154,7 @@ class PaddlePredictor:
         return PaddlePredictor(
             self.config,
             _shared=(self.scope, self.program, self.feed_names,
-                     self.fetch_vars, self.exe))
+                     self.fetch_vars, self.exe, self.aot))
 
     Clone = clone
 

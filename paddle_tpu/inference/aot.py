@@ -1,0 +1,194 @@
+"""AOT inference export: serialize the XLA-compiled executable next to
+the saved model so a server loads and runs without re-tracing or
+re-compiling the Python program.
+
+Role parity: the reference's pre-compiled-subgraph serving story — the
+C++ NativePredictor loads a ProgramDesc and runs pre-registered kernels
+(contrib/inference/paddle_inference_api.h:61), and its TensorRT engine
+caches a compiled plan per subgraph (inference/tensorrt/engine.cc).
+TPU-native: the whole inference program is ONE XLA executable; `jax.jit
+... .lower().compile()` + jax.experimental.serialize_executable persists
+the final binary, keyed on the feed specs it was compiled for.  Loading
+deserializes straight into the runtime — no Python trace, no XLA
+compile.  A spec/platform mismatch falls back to the normal executor
+path (which re-jits), never fails.
+
+Artifacts inside the model dir:
+  __aot__.pkl   pickled (payload, in_tree, out_tree) from
+                serialize_executable.serialize
+  __aot__.json  {"specs": {feed: [shape, dtype]}, "input_names": [...],
+                 "fetch": [...], "platform": ..., "jax": version}
+"""
+from __future__ import annotations
+
+import json
+import os
+import pickle
+
+import numpy as np
+
+__all__ = ["save_aot", "AotExecutable", "load_aot"]
+
+AOT_BIN = "__aot__.pkl"
+AOT_META = "__aot__.json"
+
+
+def _example_feed(specs):
+    return {name: np.zeros(shape, dtype)
+            for name, (shape, dtype) in specs.items()}
+
+
+def save_aot(dirname, inference_program, feed_specs, fetch_names, scope,
+             place, mode="test"):
+    """Compile block 0 of ``inference_program`` for ``feed_specs``
+    ({name: (shape, dtype)}) and write the serialized executable into
+    ``dirname``.  Parameters come from ``scope`` (their values don't
+    matter for compilation — shapes/dtypes do)."""
+    import jax
+    from jax.experimental import serialize_executable
+
+    from paddle_tpu.core.executor_impl import (ExecutorCore, _put,
+                                               _segment)
+
+    feed = _example_feed(feed_specs)
+    core = ExecutorCore(place)
+    desc = inference_program.desc if hasattr(inference_program, "desc") \
+        else inference_program
+    block = desc.blocks[0]
+    prelude, core_ops, postlude, mixed = _segment(block)
+    host_tail = [op.type for op in prelude + postlude
+                 if op.type not in ("feed", "fetch")]
+    if mixed or host_tail:
+        raise ValueError(
+            "AOT export needs a pure-compute inference program; found "
+            "host ops %r" % (host_tail or "mixed segment"))
+    entry = core._build(desc, 0, core_ops, scope, feed,
+                        list(fetch_names), mode)
+    if entry.jit_fn is None:
+        raise RuntimeError("executor built a non-jit entry (auto_layout "
+                           "experiment?) — AOT export unsupported there")
+    dev = place.jax_device()
+    flat = []
+    for name in entry.input_names:
+        val = feed[name] if name in feed else scope.find_var(name)
+        flat.append(_put(np.asarray(val) if not hasattr(val, "dtype")
+                         else val, dev))
+    flat += [np.uint32(0), np.uint32(0)]  # seed/counter slots
+    compiled = entry.jit_fn.lower(*flat).compile()
+    payload = serialize_executable.serialize(compiled)
+    with open(os.path.join(dirname, AOT_BIN), "wb") as f:
+        pickle.dump(payload, f)
+    meta = {
+        "specs": {k: [list(v[0]), np.dtype(v[1]).name]
+                  for k, v in feed_specs.items()},
+        "input_names": list(entry.input_names),
+        "persists": list(entry.persist_outs),
+        "fetch": list(fetch_names),
+        "platform": dev.platform,
+        "jax": jax.__version__,
+    }
+    with open(os.path.join(dirname, AOT_META), "w") as f:
+        json.dump(meta, f, indent=1)
+    return meta
+
+
+class AotExecutable:
+    """A deserialized inference executable + its feed contract.
+
+    ``run(feed)`` stages the feed values and calls the executable
+    directly — no tracing, no compilation.  ``matches(feed)`` tells the
+    predictor whether this executable serves a given feed."""
+
+    def __init__(self, compiled, meta, scope, place):
+        self.compiled = compiled
+        self.meta = meta
+        self.specs = {k: (tuple(s), np.dtype(d))
+                      for k, (s, d) in meta["specs"].items()}
+        self.fetch = list(meta["fetch"])
+        dev = place.jax_device()
+        self._dev = dev
+        # parameters staged once at load — the serving steady state
+        from paddle_tpu.core.executor_impl import _put
+        self._args = []
+        self._feed_slots = {}
+        name_index = {}
+        for i, name in enumerate(meta["input_names"]):
+            name_index[name] = i
+            if name in self.specs:
+                self._feed_slots[name] = i
+                self._args.append(None)
+            else:
+                var = scope.find_var(name)
+                if var is None:
+                    raise KeyError(
+                        "AOT executable input %r missing from the loaded "
+                        "parameter scope" % name)
+                self._args.append(_put(var, dev))
+        # The executable was jitted with donation for written
+        # persistables (BN running stats &c., executor_impl donate
+        # tuple): each call consumes those input buffers, so the fresh
+        # outputs must be written back into the staged slots or the
+        # second call would hand over deleted arrays.
+        self._persist_slots = [
+            (j, name_index[n])
+            for j, n in enumerate(meta.get("persists", []))
+            if n in name_index]
+
+    def matches(self, feed):
+        if set(feed) != set(self.specs):
+            return False
+        for k, v in feed.items():
+            shape, dtype = self.specs[k]
+            if tuple(np.shape(v)) != shape:
+                return False
+            vd = v.dtype if hasattr(v, "dtype") else np.asarray(v).dtype
+            if np.dtype(vd) != dtype:
+                return False
+        return True
+
+    def run(self, feed):
+        import jax
+
+        args = list(self._args)
+        for name, i in self._feed_slots.items():
+            args[i] = jax.device_put(np.asarray(feed[name])
+                                     if not isinstance(feed[name],
+                                                       jax.Array)
+                                     else feed[name], self._dev)
+        fetches, persists = self.compiled(*args, np.uint32(0),
+                                          np.uint32(0))
+        for j, i in self._persist_slots:
+            self._args[i] = persists[j]
+        return list(fetches)
+
+
+def load_aot(dirname, scope, place):
+    """Load the serialized executable if present AND usable on this
+    backend; None (silently) otherwise — callers fall back to re-jit."""
+    bin_path = os.path.join(dirname, AOT_BIN)
+    meta_path = os.path.join(dirname, AOT_META)
+    if not (os.path.exists(bin_path) and os.path.exists(meta_path)):
+        return None
+    with open(meta_path) as f:
+        meta = json.load(f)
+    if meta.get("platform") != place.jax_device().platform:
+        return None
+    try:
+        from jax.experimental import serialize_executable
+        with open(bin_path, "rb") as f:
+            payload = pickle.load(f)
+        dev = place.jax_device()
+        # backend must be the PLACE's client, not the process default —
+        # with an accelerator plugin present, a cpu-compiled artifact
+        # would otherwise be handed to the accelerator runtime
+        compiled = serialize_executable.deserialize_and_load(
+            *payload, backend=dev.client,
+            execution_devices=[dev])
+        return AotExecutable(compiled, meta, scope, place)
+    except Exception as e:
+        # version/backend drift — the re-jit path still works, but say so
+        import warnings
+        warnings.warn("AOT executable in %s could not be loaded (%s: %s); "
+                      "falling back to re-jit" %
+                      (dirname, type(e).__name__, e))
+        return None

@@ -90,11 +90,17 @@ def resnet_cifar10(input, class_dim, depth=32, is_test=False):
 
 
 def get_model(data_set="flowers", depth=50, learning_rate=0.01,
-              is_test=False):
+              is_test=False, input_dtype="float32"):
     """Build train graph; (avg_cost, [input, label], [batch_acc]).
 
     data_set 'cifar10' → 32×32/10-way resnet_cifar10; 'flowers'/'imagenet'
     → 224×224 resnet_imagenet (reference resnet.py get_model:119).
+
+    input_dtype 'uint8': the data layer takes raw bytes and the graph
+    casts + scales by 1/255 on device — the TPU-native input pipeline
+    (the reference normalizes on host CPU before the feed,
+    image/image.py; over a narrow host link shipping uint8 and
+    normalizing on device is the same math at a quarter the traffic).
     """
     if data_set == "cifar10":
         class_dim, dshape, model = 10, [3, 32, 32], resnet_cifar10
@@ -104,9 +110,13 @@ def get_model(data_set="flowers", depth=50, learning_rate=0.01,
         dshape, model = [3, 224, 224], resnet_imagenet
         kwargs = {"depth": depth}
 
-    input = fluid.layers.data(name="data", shape=dshape, dtype="float32")
+    input = fluid.layers.data(name="data", shape=dshape, dtype=input_dtype)
     label = fluid.layers.data(name="label", shape=[1], dtype="int64")
-    predict = model(input, class_dim, is_test=is_test, **kwargs)
+    x = input
+    if input_dtype == "uint8":
+        x = fluid.layers.scale(fluid.layers.cast(input, "float32"),
+                               scale=1.0 / 255.0)
+    predict = model(x, class_dim, is_test=is_test, **kwargs)
     cost = fluid.layers.cross_entropy(input=predict, label=label)
     avg_cost = fluid.layers.mean(cost)
     batch_acc = fluid.layers.accuracy(input=predict, label=label)

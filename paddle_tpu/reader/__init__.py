@@ -5,8 +5,10 @@ from .decorator import (map_readers, shuffle, chain, compose, buffered,
                         firstn, xmap_readers, cache,
                         ComposeNotAligned, PipeReader)  # noqa: F401
 from . import creator  # noqa: F401
-from .device_loader import DeviceLoader, batch  # noqa: F401
+from .device_loader import (DatasetExceedsBudget,  # noqa: F401
+                            DeviceDatasetCache, DeviceLoader, batch)
 
 __all__ = ["map_readers", "shuffle", "chain", "compose", "buffered",
            "firstn", "xmap_readers", "cache", "ComposeNotAligned", "PipeReader",
-           "creator", "DeviceLoader", "batch"]
+           "creator", "DeviceLoader", "DeviceDatasetCache",
+           "DatasetExceedsBudget", "batch"]

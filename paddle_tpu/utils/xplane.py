@@ -23,7 +23,8 @@ import glob
 import os
 import re
 
-__all__ = ["read_xspace", "op_totals", "print_op_profile"]
+__all__ = ["read_xspace", "op_totals", "print_op_profile",
+           "op_profile", "category_profile", "print_category_profile"]
 
 
 def _varint(buf, i):
@@ -96,8 +97,51 @@ def _parse_line(buf):
     return name, events
 
 
+def _parse_stat(buf):
+    """XStat: metadata_id=1, value oneof {double=2, uint64=3, int64=4,
+    str=5, bytes=6, ref=7}.  ref values point at an XStatMetadata entry
+    whose *name* holds the (deduplicated) string — the caller resolves
+    them through the plane's stat-metadata table, so is_ref rides along.
+    """
+    mid = 0
+    val = None
+    is_ref = False
+    for fno, wt, v in _fields(buf):
+        if fno == 1 and wt == 0:
+            mid = v
+        elif fno in (3, 4) and wt == 0:
+            val = v
+        elif fno == 7 and wt == 0:
+            val = v
+            is_ref = True
+        elif fno == 2 and wt == 1:
+            import struct
+            val = struct.unpack("<d", v.to_bytes(8, "little"))[0]
+        elif fno in (5, 6) and wt == 2:
+            val = bytes(v).decode("utf-8", "replace")
+    return mid, val, is_ref
+
+
 def _parse_metadata_entry(buf):
-    """map<int64, XEventMetadata> entry: key=1, value=2."""
+    """map<int64, XEventMetadata> entry: key=1, value=2.
+    XEventMetadata: id=1, name=2, display_name=4, stats=5."""
+    key = 0
+    name = ""
+    stats = []
+    for fno, wt, v in _fields(buf):
+        if fno == 1 and wt == 0:
+            key = v
+        elif fno == 2 and wt == 2:
+            for f2, w2, v2 in _fields(v):
+                if f2 == 2 and w2 == 2:
+                    name = bytes(v2).decode("utf-8", "replace")
+                elif f2 == 5 and w2 == 2:
+                    stats.append(_parse_stat(v2))
+    return key, name, stats
+
+
+def _parse_stat_metadata_entry(buf):
+    """map<int64, XStatMetadata> entry: key=1, value=2{id=1, name=2}."""
     key = 0
     name = ""
     for fno, wt, v in _fields(buf):
@@ -114,15 +158,32 @@ def _parse_plane(buf):
     name = ""
     lines = []
     metadata = {}
+    stats_by_id = {}
+    stat_names = {}
     for fno, wt, v in _fields(buf):
         if fno == 2 and wt == 2:
             name = bytes(v).decode("utf-8", "replace")
         elif fno == 3 and wt == 2:
             lines.append(_parse_line(v))
         elif fno == 4 and wt == 2:
-            k, nm = _parse_metadata_entry(v)
+            k, nm, stats = _parse_metadata_entry(v)
             metadata[k] = nm
-    return {"name": name, "lines": lines, "event_metadata": metadata}
+            if stats:
+                stats_by_id[k] = stats
+        elif fno == 5 and wt == 2:
+            k, nm = _parse_stat_metadata_entry(v)
+            stat_names[k] = nm
+    # resolve stat metadata_ids to names (and ref values to the
+    # stat-metadata entry's name, the dedup convention for strings):
+    # {event_metadata_id: {stat: value}}
+    event_stats = {}
+    for k, stats in stats_by_id.items():
+        event_stats[k] = {
+            stat_names.get(mid, "#%d" % mid):
+                (stat_names.get(val, "#%d" % val) if is_ref else val)
+            for mid, val, is_ref in stats}
+    return {"name": name, "lines": lines, "event_metadata": metadata,
+            "event_stats": event_stats}
 
 
 def read_xspace(path):
@@ -188,3 +249,72 @@ def print_op_profile(path, top=20, **kwargs):
         print("%-50s %10.3f %6.2f%%"
               % (name[:50], ps / 1e9, 100.0 * ps / total))
     return agg
+
+
+def op_profile(path, plane_re=r"/device:", line_name="XLA Ops"):
+    """Per-op roofline rows from the device trace:
+    [{name, category, time_ps, count, flops, bytes, source}] — the
+    hlo_category / flops / bytes_accessed stats the XLA runtime attaches
+    to each op's event metadata (what tensorboard's op_profile view
+    shows, without the TF stack).  flops/bytes are per-execution; time_ps
+    is summed over all executions in the capture."""
+    rows = {}
+    for plane in read_xspace(path):
+        if not re.search(plane_re, plane["name"]):
+            continue
+        md = plane["event_metadata"]
+        st = plane.get("event_stats", {})
+        for lname, events in plane["lines"]:
+            if lname != line_name:
+                continue
+            for meta_id, dur in events:
+                name = md.get(meta_id, "#%d" % meta_id).split(" = ")[0]
+                r = rows.get(name)
+                if r is None:
+                    s = st.get(meta_id, {})
+                    rows[name] = r = {
+                        "name": name,
+                        "category": s.get("hlo_category", "?"),
+                        "time_ps": 0, "count": 0,
+                        "flops": s.get("flops", 0) or 0,
+                        "bytes": s.get("bytes_accessed", 0) or 0,
+                        "source": s.get("source", "")}
+                r["time_ps"] += dur
+                r["count"] += 1
+    return sorted(rows.values(), key=lambda r: -r["time_ps"])
+
+
+def category_profile(path, peak_tflops=197.0, peak_gbps=819.0, **kwargs):
+    """Aggregate ``op_profile`` rows by hlo_category with achieved
+    TFLOP/s and GB/s against the given chip peaks (defaults: TPU v5e
+    bf16 / HBM).  The first stop for 'where did my step time go'."""
+    cats = {}
+    for r in op_profile(path, **kwargs):
+        c = cats.setdefault(r["category"], {
+            "category": r["category"], "time_ps": 0, "flops": 0,
+            "bytes": 0, "count": 0})
+        c["time_ps"] += r["time_ps"]
+        c["flops"] += r["flops"] * r["count"]
+        c["bytes"] += r["bytes"] * r["count"]
+        c["count"] += r["count"]
+    out = sorted(cats.values(), key=lambda c: -c["time_ps"])
+    for c in out:
+        secs = c["time_ps"] / 1e12 or 1e-12
+        c["tflops_per_s"] = c["flops"] / secs / 1e12
+        c["gbps"] = c["bytes"] / secs / 1e9
+        c["mxu_util"] = c["tflops_per_s"] / peak_tflops
+        c["hbm_util"] = c["gbps"] / peak_gbps
+    return out
+
+
+def print_category_profile(path, top=12, **kwargs):
+    cats = category_profile(path, **kwargs)
+    total = sum(c["time_ps"] for c in cats) or 1
+    print("%-28s %9s %7s %9s %8s %9s %8s" % (
+        "category", "ms", "share", "TFLOP/s", "mxu", "GB/s", "hbm"))
+    for c in cats[:top]:
+        print("%-28s %9.3f %6.2f%% %9.1f %7.1f%% %9.0f %7.1f%%" % (
+            c["category"][:28], c["time_ps"] / 1e9,
+            100.0 * c["time_ps"] / total, c["tflops_per_s"],
+            100.0 * c["mxu_util"], c["gbps"], 100.0 * c["hbm_util"]))
+    return cats

@@ -1,0 +1,34 @@
+"""Spawned-process worker for the AOT inference test: loads a saved
+model in a FRESH process and serves it, recording every XLA compilation
+the process performs (own module — multiprocessing 'spawn' re-imports
+the worker's module in the child)."""
+import logging
+
+import numpy as np
+
+
+def aot_serve_worker(model_dir, x_list, q):
+    try:
+        records = []
+
+        class Capture(logging.Handler):
+            def emit(self, r):
+                records.append(r.getMessage())
+
+        import jax
+
+        jax.config.update("jax_log_compiles", True)
+        logger = logging.getLogger("jax._src.dispatch")
+        logger.addHandler(Capture())
+        logger.setLevel(logging.WARNING)
+
+        from paddle_tpu import inference as inf
+
+        pred = inf.create_paddle_predictor(
+            inf.NativeConfig(model_dir=model_dir))
+        x = np.asarray(x_list, np.float32)
+        out = pred.run({"x": x})
+        compiles = [m for m in records if "compilation" in m.lower()]
+        q.put((out[0].data.tolist(), compiles, pred.aot is not None))
+    except Exception as e:
+        q.put(("ERROR: %r" % e, [], False))

@@ -1,0 +1,80 @@
+"""Spawned-process workers for the multi-host ParallelExecutor test.
+
+Lives in its own module (not the test file): multiprocessing 'spawn'
+re-imports the worker's module in the child, and the child must not
+re-run pytest collection or the conftest of the parent.  The parent
+sets the platform env (JAX_PLATFORMS/XLA_FLAGS/PADDLE_* contract)
+BEFORE Process.start(): sitecustomize touches jax at interpreter
+startup, so env set inside the worker would be too late.
+"""
+import numpy as np
+
+
+def _build_and_train(num_trainers, trainer_id, steps=3):
+    """Tiny deterministic regression program trained with the SPMD
+    ParallelExecutor; returns (losses, n_global_devices).
+
+    Feed contract: the GLOBAL batch is 8 fixed rows; a multi-host
+    trainer feeds only its own 8/num_trainers rows (reference nccl2
+    semantics, parallel_executor.cc:84-95)."""
+    import jax
+
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.core.scope import Scope
+    from paddle_tpu.distributed import collective
+
+    if num_trainers > 1:
+        # must happen before ANY jax backend touch (jax.distributed
+        # contract) — a real trainer joins the world first thing, the
+        # same place the reference ran gen_nccl_id
+        collective.init_collective_env()
+
+    rng = np.random.RandomState(0)
+    xs = rng.randn(8, 16).astype(np.float32)
+    ws = rng.randn(16, 1).astype(np.float32)
+    ys = (xs @ ws).astype(np.float32)
+    lo = trainer_id * (8 // num_trainers)
+    hi = lo + 8 // num_trainers
+    x_local, y_local = xs[lo:hi], ys[lo:hi]
+
+    main, startup = fluid.Program(), fluid.Program()
+    scope = Scope()
+    with fluid.scope_guard(scope):
+        with fluid.program_guard(main, startup):
+            with fluid.unique_name.guard():
+                x = fluid.layers.data(name="x", shape=[16],
+                                      dtype="float32")
+                y = fluid.layers.data(name="y", shape=[1],
+                                      dtype="float32")
+                h = fluid.layers.fc(x, size=8, act="tanh")
+                pred = fluid.layers.fc(h, size=1)
+                loss = fluid.layers.mean(
+                    fluid.layers.square_error_cost(pred, y))
+                fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+        fluid.Executor(fluid.CPUPlace()).run(startup)
+        pe = fluid.ParallelExecutor(
+            use_tpu=False, loss_name=loss.name, main_program=main,
+            scope=scope, num_trainers=num_trainers, trainer_id=trainer_id)
+        losses = []
+        for _ in range(steps):
+            out, = pe.run(feed={x.name: x_local, y.name: y_local},
+                          fetch_list=[loss])
+            losses.append(float(np.asarray(out).ravel()[0]))
+    return losses, len(jax.devices())
+
+
+def baseline_worker(q):
+    """Single-process 8-device SPMD run over the full batch."""
+    try:
+        q.put(("baseline",) + _build_and_train(1, 0))
+    except Exception as e:  # surface the child's failure to the parent
+        q.put(("baseline", "ERROR: %r" % e, 0))
+
+
+def trainer_worker(i, q):
+    """One of two jax.distributed processes; the PE joins the world
+    itself through the PADDLE_TRAINER_ENDPOINTS env contract."""
+    try:
+        q.put(("trainer%d" % i,) + _build_and_train(2, i))
+    except Exception as e:
+        q.put(("trainer%d" % i, "ERROR: %r" % e, 0))

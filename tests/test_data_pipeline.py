@@ -252,3 +252,83 @@ def test_pipe_reader_plain_and_gzip(tmp_path):
         f.write(b"l1\nl2\nl3\n")
     pr = PipeReader("cat %s" % path, file_type="gzip")
     assert list(pr.get_line()) == ["l1", "l2", "l3"]
+
+
+# ----------------------- DeviceDatasetCache -----------------------------
+
+def _labeled_reader(n, dim=4):
+    def r():
+        for i in range(n):
+            yield (np.full((dim,), i, np.float32),
+                   np.asarray([i], np.int64))
+
+    return r
+
+
+def test_device_dataset_cache_epoch_coverage_and_reshuffle():
+    import paddle_tpu.fluid as fluid
+
+    n, bs = 20, 5
+    cache = reader.DeviceDatasetCache(
+        _labeled_reader(n), ["x", "y"], fluid.CPUPlace(), bs, seed=7)
+
+    def epoch_ids():
+        ids = []
+        batches = 0
+        for d in cache:
+            assert d["x"].shape == (bs, 4)
+            assert d["y"].shape == (bs, 1)
+            # field alignment: the label matches the image fill value
+            assert np.array_equal(np.asarray(d["x"])[:, 0],
+                                  np.asarray(d["y"])[:, 0])
+            ids.extend(np.asarray(d["y"])[:, 0].tolist())
+            batches += 1
+        assert batches == n // bs
+        return ids
+
+    e0, e1 = epoch_ids(), epoch_ids()
+    # every sample exactly once per epoch, different order across epochs
+    assert sorted(e0) == list(range(n))
+    assert sorted(e1) == list(range(n))
+    assert e0 != e1
+
+
+def test_device_dataset_cache_budget_and_small_dataset():
+    import paddle_tpu.fluid as fluid
+
+    with pytest.raises(ValueError, match="max_bytes"):
+        reader.DeviceDatasetCache(_labeled_reader(8), ["x", "y"],
+                                  fluid.CPUPlace(), 2, max_bytes=16)
+    with pytest.raises(ValueError, match="smaller than one batch"):
+        reader.DeviceDatasetCache(_labeled_reader(3), ["x", "y"],
+                                  fluid.CPUPlace(), 4)
+
+
+def test_resnet_uint8_input_matches_float(tmp_path):
+    """get_model(input_dtype='uint8') — device-side cast+scale gives the
+    same forward loss as feeding img/255 as float32."""
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.core.scope import Scope
+    from paddle_tpu.models import resnet
+
+    rng = np.random.RandomState(0)
+    u8 = rng.randint(0, 256, (2, 3, 32, 32)).astype(np.uint8)
+    lab = rng.randint(0, 10, (2, 1)).astype(np.int64)
+    losses = {}
+    for dt in ("uint8", "float32"):
+        main, startup = fluid.Program(), fluid.Program()
+        scope = Scope()
+        with fluid.scope_guard(scope):
+            with fluid.program_guard(main, startup):
+                with fluid.unique_name.guard():
+                    avg_cost, (data, label), _ = resnet.get_model(
+                        data_set="cifar10", input_dtype=dt, is_test=True)
+            exe = fluid.Executor(fluid.CPUPlace())
+            exe.run(startup)
+            feed = {data.name: u8 if dt == "uint8"
+                    else (u8.astype(np.float32) / 255.0),
+                    label.name: lab}
+            loss, = exe.run(main, feed=feed, fetch_list=[avg_cost])
+        losses[dt] = float(np.asarray(loss).ravel()[0])
+    assert np.isfinite(losses["uint8"])
+    assert abs(losses["uint8"] - losses["float32"]) < 1e-4

@@ -1,0 +1,133 @@
+"""AOT inference export/serve: save_inference_model(aot_feed_specs=...)
+serializes the compiled XLA executable (inference/aot.py — the
+pre-compiled-engine analog of reference inference/tensorrt/engine.cc and
+the native predictor, contrib/inference/paddle_inference_api.h:61); a
+fresh process loads it and serves with ZERO XLA compilations and
+identical outputs."""
+import multiprocessing as mp
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.core.scope import Scope
+
+
+def _build_and_save(tmpdir):
+    main, startup = fluid.Program(), fluid.Program()
+    scope = Scope()
+    with fluid.scope_guard(scope):
+        with fluid.program_guard(main, startup):
+            with fluid.unique_name.guard():
+                x = fluid.layers.data(name="x", shape=[6], dtype="float32")
+                h = fluid.layers.fc(x, size=5, act="tanh")
+                out = fluid.layers.fc(h, size=3, act="softmax")
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        fluid.io.save_inference_model(
+            tmpdir, ["x"], [out], exe, main_program=main,
+            aot_feed_specs={"x": ((4, 6), "float32")})
+        # reference outputs computed through the normal executor path
+        xs = np.linspace(-1, 1, 24).astype(np.float32).reshape(4, 6)
+        infer = main.clone(for_test=True)
+        ref, = exe.run(infer, feed={"x": xs}, fetch_list=[out])
+    return xs, np.asarray(ref)
+
+
+def test_aot_artifacts_written(tmp_path):
+    d = str(tmp_path / "m")
+    _build_and_save(d)
+    assert os.path.exists(os.path.join(d, "__aot__.pkl"))
+    assert os.path.exists(os.path.join(d, "__aot__.json"))
+
+
+def test_aot_serves_fresh_process_no_compile(tmp_path):
+    from tests import inference_helpers as H
+
+    d = str(tmp_path / "m")
+    xs, ref = _build_and_save(d)
+
+    ctx = mp.get_context("spawn")
+    q = ctx.Queue()
+    p = ctx.Process(target=H.aot_serve_worker,
+                    args=(d, xs.tolist(), q))
+    p.start()
+    got, compiles, used_aot = q.get(timeout=180)
+    p.join(timeout=30)
+    assert not (isinstance(got, str) and got.startswith("ERROR")), got
+    assert used_aot, "predictor did not load the AOT executable"
+    assert compiles == [], "fresh process compiled: %r" % compiles
+    np.testing.assert_allclose(np.asarray(got), ref, atol=1e-6)
+
+
+def test_aot_spec_mismatch_falls_back(tmp_path):
+    """A feed whose shape differs from the exported spec must still be
+    served (re-jit path), with correct results."""
+    from paddle_tpu import inference as inf
+
+    d = str(tmp_path / "m")
+    xs, ref = _build_and_save(d)
+    pred = inf.create_paddle_predictor(inf.NativeConfig(model_dir=d))
+    assert pred.aot is not None
+    other = np.vstack([xs, xs])  # batch 8 != exported batch 4
+    out = pred.run({"x": other})
+    np.testing.assert_allclose(out[0].data[:4], ref, atol=1e-6)
+    np.testing.assert_allclose(out[0].data[4:], ref, atol=1e-6)
+    # the exported batch still goes through the AOT executable
+    out2 = pred.run({"x": xs})
+    np.testing.assert_allclose(out2[0].data, ref, atol=1e-6)
+
+
+def _build_and_save_bn(tmpdir):
+    """conv+BN model: exercises donated persistables (BN running stats)
+    and the analysis-pass interaction."""
+    main, startup = fluid.Program(), fluid.Program()
+    scope = Scope()
+    with fluid.scope_guard(scope):
+        with fluid.program_guard(main, startup):
+            with fluid.unique_name.guard():
+                x = fluid.layers.data(name="x", shape=[2, 8, 8],
+                                      dtype="float32")
+                c = fluid.layers.conv2d(x, num_filters=3, filter_size=3,
+                                        padding=1)
+                b = fluid.layers.batch_norm(c)
+                out = fluid.layers.reduce_mean(b, dim=[2, 3])
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        fluid.io.save_inference_model(
+            tmpdir, ["x"], [out], exe, main_program=main,
+            aot_feed_specs={"x": ((2, 2, 8, 8), "float32")})
+        xs = np.linspace(-1, 1, 256).astype(np.float32).reshape(2, 2, 8, 8)
+        infer = main.clone(for_test=True)
+        ref, = exe.run(infer, feed={"x": xs}, fetch_list=[out])
+    return xs, np.asarray(ref)
+
+
+def test_aot_bn_model_repeat_runs(tmp_path):
+    """Donated BN running-stat buffers must be written back between
+    calls — the second run() used to hand the executable deleted
+    arrays."""
+    from paddle_tpu import inference as inf
+
+    d = str(tmp_path / "m")
+    xs, ref = _build_and_save_bn(d)
+    pred = inf.create_paddle_predictor(inf.NativeConfig(model_dir=d))
+    assert pred.aot is not None
+    for _ in range(3):  # repeated serving through the same executable
+        out = pred.run({"x": xs})
+        np.testing.assert_allclose(out[0].data, ref, atol=1e-5)
+
+
+def test_aot_skipped_under_analysis_passes(tmp_path):
+    """AnalysisConfig's BN-fold mutates the parameter scope; the AOT
+    artifact (compiled from the unfolded program) must not be served
+    against it."""
+    from paddle_tpu import inference as inf
+
+    d = str(tmp_path / "m")
+    xs, ref = _build_and_save_bn(d)
+    pred = inf.create_paddle_predictor(inf.AnalysisConfig(model_dir=d))
+    assert pred.aot is None
+    out = pred.run({"x": xs})
+    np.testing.assert_allclose(out[0].data, ref, atol=1e-4)
