@@ -62,10 +62,11 @@ def _prepare_lod_feeds(feed):
                 "(variable %r has %d levels)" % (name, len(v.lod)))
         if len(v.lod) == 2:
             # bucket both ragged dims so compiled shapes stay bounded.
-            # NB: this is the FEED bridge (pad + expose '@LEN' outer and
-            # '@LEN@1' inner lengths); sequence ops currently mask on
-            # the outer level only — finest-level pooling over level-2
-            # data needs ops consuming '@LEN@1'.
+            # This is the FEED bridge (pad + expose '@LEN' outer and
+            # '@LEN@1' inner lengths); sequence_pool/softmax/conv
+            # consume '@LEN@1' and operate at the FINEST level
+            # (ops/sequence.py _fold_level2, reference
+            # lod_tensor.h:58-110 semantics).
             s_max = max((v.lod[0][i + 1] - v.lod[0][i]
                          for i in range(len(v.lod[0]) - 1)), default=1)
             w_max = max((v.lod[1][j + 1] - v.lod[1][j]

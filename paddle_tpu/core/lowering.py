@@ -210,10 +210,12 @@ def _propagate_seq_lens(ctx, op):
     layout (embedding/fc/activation/elementwise chains), the padded-batch
     analog of the reference's ShareLoD in InferShape."""
     lens = None
+    inner = None
     src = None
     for n in op.input_arg_names():
         if n and n + "@LEN" in ctx.env:
             lens = ctx.env[n + "@LEN"]
+            inner = ctx.env.get(n + "@LEN@1")  # level-2 inner lengths
             src = ctx.env.get(n)
             break
     if lens is None or src is None or getattr(src, "ndim", 0) < 2:
@@ -226,6 +228,9 @@ def _propagate_seq_lens(ctx, op):
         if getattr(val, "ndim", 0) >= 2 and tuple(val.shape[:2]) == \
                 tuple(lead):
             ctx.env[n + "@LEN"] = lens
+            if inner is not None and getattr(val, "ndim", 0) >= 3 and \
+                    val.shape[2] == src.shape[2]:
+                ctx.env[n + "@LEN@1"] = inner
 
 
 def _gather_inputs(env, op):

@@ -72,13 +72,18 @@ def load_label_dict(filename):
                 tag_dict.add(line[2:])
             elif line.startswith("I-"):
                 tag_dict.add(line[2:])
-        index = 1
+        # reference id layout (conll05.py:44-61): tag ids first from 0,
+        # "O" LAST — artifacts trained against the published dicts
+        # (embeddings, CRF transitions) depend on it.  Deviation: tags
+        # are sorted here (the reference iterates a set, whose order is
+        # itself unstable across interpreter runs).
+        index = 0
         for tag in sorted(tag_dict):
             d["B-" + tag] = index
             index += 1
             d["I-" + tag] = index
             index += 1
-        d["O"] = 0
+        d["O"] = index
     return d
 
 
@@ -223,7 +228,10 @@ def reader_creator(corpus_reader, word_dict=None, predicate_dict=None,
             ctx_p1_idx = [word_dict.get(ctx_p1, UNK_IDX)] * sen_len
             ctx_p2_idx = [word_dict.get(ctx_p2, UNK_IDX)] * sen_len
             pred_idx = [predicate_dict.get(predicate, 0)] * sen_len
-            label_idx = [label_dict.get(w, 0) for w in labels]
+            # unknown labels fall back to "O" (its id is LAST in the
+            # reference layout, not 0 — 0 is the first B- tag)
+            o_id = label_dict.get("O", 0)
+            label_idx = [label_dict.get(w, o_id) for w in labels]
 
             yield (word_idx, ctx_n2_idx, ctx_n1_idx, ctx_0_idx, ctx_p1_idx,
                    ctx_p2_idx, pred_idx, mark, label_idx)

@@ -1,0 +1,301 @@
+"""Service discovery + leader election over a shared filesystem.
+
+Parity: the reference's etcd usage — pservers/master register endpoints
+that trainers watch (go/pserver/etcd_client.go, go/master/etcd_client.go
+:27-31 with a leader lock so a standby master can take over).  The
+TPU-native deployment substrate here is a shared filesystem (every
+multi-host TPU pod has one); the same three primitives are provided:
+
+  EndpointRegistry  register/list/wait_for with heartbeat TTLs
+                    (etcd key leases)
+  FileLock          single-writer lock with stale-holder takeover
+                    (etcd election: the master's AddOwner campaign)
+  MasterHA          standby master loop: campaign, recover from
+                    snapshot, serve, republish the endpoint
+
+Files are written atomically (tmp + rename), heartbeats are mtime-based,
+and a crashed holder's lock is reclaimed after ``ttl`` seconds.
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+
+__all__ = ["EndpointRegistry", "FileLock", "MasterHA"]
+
+DEFAULT_TTL = 10.0
+
+
+class EndpointRegistry:
+    """Register live endpoints under <root>/<kind>/; liveness = file
+    mtime heartbeat within ttl."""
+
+    def __init__(self, root, ttl=DEFAULT_TTL):
+        self.root = root
+        self.ttl = float(ttl)
+        self._beats = {}  # (kind, endpoint) -> stop Event
+
+    def _path(self, kind, endpoint):
+        safe = endpoint.replace("/", "_").replace(":", "_")
+        return os.path.join(self.root, kind, safe + ".json")
+
+    def register(self, kind, endpoint, meta=None, heartbeat=True):
+        """Publish endpoint; a daemon thread refreshes the heartbeat
+        until unregister (etcd lease keep-alive analog)."""
+        path = self._path(kind, endpoint)
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        payload = {"endpoint": endpoint, "pid": os.getpid(),
+                   "meta": meta or {}}
+        tmp = path + ".%d.tmp" % os.getpid()
+        with open(tmp, "w") as f:
+            json.dump(payload, f)
+        os.replace(tmp, path)
+        if heartbeat:
+            stop = threading.Event()
+            self._beats[(kind, endpoint)] = stop
+
+            def beat():
+                while not stop.wait(self.ttl / 3.0):
+                    try:
+                        os.utime(path)
+                    except OSError:
+                        return  # unregistered underneath us
+
+            threading.Thread(target=beat, daemon=True).start()
+        return path
+
+    def unregister(self, kind, endpoint):
+        stop = self._beats.pop((kind, endpoint), None)
+        if stop is not None:
+            stop.set()
+        try:
+            os.remove(self._path(kind, endpoint))
+        except FileNotFoundError:
+            pass
+
+    def list(self, kind):
+        """Endpoints with a fresh heartbeat, sorted."""
+        d = os.path.join(self.root, kind)
+        out = []
+        now = time.time()
+        try:
+            names = os.listdir(d)
+        except FileNotFoundError:
+            return []
+        for fn in names:
+            p = os.path.join(d, fn)
+            try:
+                if now - os.stat(p).st_mtime > self.ttl:
+                    continue
+                with open(p) as f:
+                    out.append(json.load(f)["endpoint"])
+            except (OSError, ValueError, KeyError):
+                continue  # torn write / removed underneath us
+        return sorted(out)
+
+    def wait_for(self, kind, n=1, timeout=30.0, poll=0.1):
+        """Block until >= n live endpoints of ``kind`` exist (trainers
+        discovering pservers / the master)."""
+        deadline = time.time() + timeout
+        while True:
+            eps = self.list(kind)
+            if len(eps) >= n:
+                return eps
+            if time.time() > deadline:
+                raise TimeoutError(
+                    "only %d/%d %r endpoints appeared within %.1fs"
+                    % (len(eps), n, kind, timeout))
+            time.sleep(poll)
+
+
+class FileLock:
+    """Single-writer lock with stale-holder takeover — the leader-
+    election analog (go/master/etcd_client.go:27-31 AddOwner).  The
+    holder heartbeats the lock file; a candidate steals it when the
+    heartbeat is older than ttl (the holder crashed)."""
+
+    def __init__(self, path, ttl=DEFAULT_TTL):
+        self.path = path
+        self.ttl = float(ttl)
+        self._stop = None
+        self.token = "%d.%d" % (os.getpid(), threading.get_ident())
+
+    def try_acquire(self):
+        os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
+        try:
+            fd = os.open(self.path, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+            with os.fdopen(fd, "w") as f:
+                f.write(self.token)
+        except FileExistsError:
+            try:
+                age = time.time() - os.stat(self.path).st_mtime
+            except FileNotFoundError:
+                return self.try_acquire()  # raced a release
+            if age <= self.ttl:
+                return False
+            # Stale holder: the steal itself must be single-winner, or
+            # two standbys both become master (split brain).  An
+            # O_EXCL ".steal" intent file is the election: exactly one
+            # candidate creates it, removes the stale lock, and
+            # recurses into the O_CREAT|O_EXCL path above; a stealer
+            # that died mid-steal leaves a stale intent file that ages
+            # out the same way.
+            steal = self.path + ".steal"
+            try:
+                fd = os.open(steal, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+            except FileExistsError:
+                try:
+                    if time.time() - os.stat(steal).st_mtime > self.ttl:
+                        os.remove(steal)  # dead stealer; retry later
+                except FileNotFoundError:
+                    pass
+                return False
+            try:
+                with os.fdopen(fd, "w") as f:
+                    f.write(self.token)
+                try:
+                    os.remove(self.path)
+                except FileNotFoundError:
+                    pass
+                return self.try_acquire()
+            finally:
+                try:
+                    os.remove(steal)
+                except FileNotFoundError:
+                    pass
+        self._heartbeat()
+        return True
+
+    def acquire(self, timeout=60.0, poll=0.2):
+        deadline = time.time() + timeout
+        while not self.try_acquire():
+            if time.time() > deadline:
+                raise TimeoutError("lock %s not acquired in %.1fs"
+                                   % (self.path, timeout))
+            time.sleep(poll)
+        return self
+
+    def _heartbeat(self):
+        stop = threading.Event()
+        self._stop = stop
+
+        def beat():
+            while not stop.wait(self.ttl / 3.0):
+                try:
+                    os.utime(self.path)
+                except OSError:
+                    return
+
+        threading.Thread(target=beat, daemon=True).start()
+
+    def release(self):
+        if self._stop is not None:
+            self._stop.set()
+            self._stop = None
+        try:
+            with open(self.path) as f:
+                if f.read() == self.token:
+                    os.remove(self.path)
+        except OSError:
+            pass
+
+
+class MasterHA:
+    """Run a Master behind leader election: campaign on the lock,
+    recover state from the shared snapshot, serve, publish the endpoint
+    in the registry.  A standby started the same way blocks in
+    ``campaign()`` until the active master dies, then takes over from
+    the snapshot — trainers re-resolve via the registry and the dataset
+    completes exactly once (done-queue accounting survives in the
+    snapshot)."""
+
+    KIND = "master"
+
+    def __init__(self, root, endpoint, lease_timeout=None, ttl=None,
+                 **master_kwargs):
+        from .master import DEFAULT_LEASE, Master, MasterServer
+
+        ttl = DEFAULT_TTL if ttl is None else ttl
+        self.registry = EndpointRegistry(root, ttl=ttl)
+        self.lock = FileLock(os.path.join(root, "master.lock"), ttl=ttl)
+        self.endpoint = endpoint
+        master_kwargs.setdefault("snapshot_path",
+                                 os.path.join(root, "master.snapshot"))
+        self.master = Master(
+            lease_timeout=lease_timeout or DEFAULT_LEASE,
+            **master_kwargs)
+        self.server = MasterServer(self.master)
+
+    def campaign(self, timeout=120.0):
+        """Block until leadership is won, then serve + register."""
+        self.lock.acquire(timeout=timeout)
+        # leadership won: (re)load whatever the previous master durably
+        # finished — pending leases are void, their tasks return to todo
+        if os.path.exists(self.master._snapshot_path):
+            self.master._recover()
+        self.server.start(self.endpoint)
+        self.registry.register(self.KIND, self.endpoint)
+        return self
+
+    def stop(self):
+        self.registry.unregister(self.KIND, self.endpoint)
+        self.server.stop()
+        self.lock.release()
+
+
+def resolve_master(root, timeout=30.0, ttl=DEFAULT_TTL):
+    """Trainer-side: the active master's endpoint (first live one)."""
+    return EndpointRegistry(root, ttl=ttl).wait_for(
+        "master", 1, timeout=timeout)[0]
+
+
+class HAMasterClient:
+    """MasterClient that discovers the active master through the
+    registry and transparently re-resolves + reconnects when it dies
+    mid-call (go/master/client.go re-watches etcd the same way)."""
+
+    def __init__(self, root, timeout=60.0, ttl=DEFAULT_TTL):
+        self.root = root
+        self.timeout = float(timeout)
+        self.ttl = ttl
+        self._client = None
+        self._endpoint = None
+
+    def _ensure(self):
+        from .master import MasterClient
+
+        if self._client is None:
+            self._endpoint = resolve_master(self.root, self.timeout,
+                                            self.ttl)
+            self._client = MasterClient(self._endpoint)
+        return self._client
+
+    def _retry(self, fn, *args, **kwargs):
+        deadline = time.time() + self.timeout
+        while True:
+            try:
+                return fn(self._ensure(), *args, **kwargs)
+            except Exception:
+                # master gone (or not up yet): drop the channel, wait
+                # for a (possibly new) one to register, try again
+                self._client = None
+                if time.time() > deadline:
+                    raise
+                time.sleep(0.2)
+
+    def set_dataset(self, payloads):
+        return self._retry(lambda c: c.set_dataset(payloads))
+
+    def get_task(self, block=True):
+        return self._retry(lambda c: c.get_task(block=block))
+
+    def task_finished(self, task_id):
+        return self._retry(lambda c: c.task_finished(task_id))
+
+    def task_failed(self, task_id):
+        return self._retry(lambda c: c.task_failed(task_id))
+
+    def counts(self):
+        return self._retry(lambda c: c.counts())

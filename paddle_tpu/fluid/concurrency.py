@@ -18,7 +18,9 @@ import threading
 import time as _time
 
 __all__ = ["Channel", "ChannelClosed", "Go", "make_channel",
-           "channel_send", "channel_recv", "channel_close", "Select"]
+           "channel_send", "channel_recv", "channel_close", "Select",
+           "ProgramGo", "program_make_channel", "program_channel_send",
+           "program_channel_recv", "program_channel_close"]
 
 
 class ChannelClosed(Exception):
@@ -258,3 +260,110 @@ class Select:
             if deadline and time.time() > deadline:
                 raise TimeoutError("select timed out")
             time.sleep(poll_interval)
+
+
+# ---------------------------------------------------------------------------
+# In-PROGRAM CSP (reference concurrency.py builds ops; ops/
+# concurrency_ops.py executes them): these builders put channel_create/
+# send/recv/close and go ops INTO the current fluid program, so a
+# serialized ProgramDesc carries the concurrency structure (reference
+# channel_create_op.cc &c., framework/channel.h:33).
+# ---------------------------------------------------------------------------
+
+def program_make_channel(dtype="float32", capacity=0):
+    """Append channel_create to the CURRENT program (reference
+    make_channel:279); returns the channel Variable (scope holds the
+    live Channel once the op runs)."""
+    from .framework import default_main_program
+    from .layer_helper import LayerHelper
+    from . import unique_name
+
+    helper = LayerHelper("channel_create")
+    name = unique_name.generate("channel")
+    block = default_main_program().current_block()
+    ch = block.create_var(name=name, shape=[0], dtype=str(dtype),
+                          persistable=True)
+    block.append_op(type="channel_create", inputs={},
+                    outputs={"Out": [name]},
+                    attrs={"data_type": str(dtype),
+                           "capacity": int(capacity)},
+                    infer_shape=False)
+    return ch
+
+
+def _status_var(block):
+    from . import unique_name
+
+    name = unique_name.generate("channel_status")
+    return block.create_var(name=name, shape=[1], dtype="bool",
+                            persistable=False)
+
+
+def program_channel_send(channel, value):
+    """Append channel_send (reference channel_send:335); returns the
+    Status variable."""
+    from .framework import default_main_program
+
+    block = default_main_program().current_block()
+    st = _status_var(block)
+    block.append_op(type="channel_send",
+                    inputs={"Channel": [channel.name],
+                            "X": [value.name]},
+                    outputs={"Status": [st.name]}, infer_shape=False)
+    return st
+
+
+def program_channel_recv(channel, return_value):
+    """Append channel_recv (reference channel_recv:385); the received
+    value lands in ``return_value``; returns the Status variable."""
+    from .framework import default_main_program
+
+    block = default_main_program().current_block()
+    st = _status_var(block)
+    block.append_op(type="channel_recv",
+                    inputs={"Channel": [channel.name]},
+                    outputs={"Out": [return_value.name],
+                             "Status": [st.name]}, infer_shape=False)
+    return st
+
+
+def program_channel_close(channel):
+    from .framework import default_main_program
+
+    default_main_program().current_block().append_op(
+        type="channel_close", inputs={"Channel": [channel.name]},
+        outputs={}, infer_shape=False)
+
+
+class ProgramGo:
+    """``with ProgramGo():`` — ops built inside the guard form a
+    sub-block launched concurrently by a ``go`` op in the parent block
+    (reference Go:27 BlockGuard + go_op.cc)."""
+
+    def __init__(self, name=None):
+        from .framework import default_main_program
+
+        self.main_program = default_main_program()
+
+    def __enter__(self):
+        self.sub_block = self.main_program.create_block()
+        return self
+
+    def __exit__(self, exc_type, exc_val, exc_tb):
+        self.main_program.rollback()
+        if exc_type is not None:
+            return False
+        # declare the sub-block's outer reads as X inputs (reference
+        # construct_go_op:41): the executor then fetches parent-block
+        # temporaries into the host-op env so the routine can capture
+        # them at launch (ops/concurrency_ops._go)
+        from .layers.control_flow import _collect_outer_io
+
+        reads, _writes = _collect_outer_io(self.sub_block)
+        parent = self.main_program.current_block()
+        parent.append_op(type="go",
+                         inputs={"X": reads} if reads else {},
+                         outputs={},
+                         attrs={"sub_block": self.sub_block.idx},
+                         infer_shape=False)
+        return False

@@ -21,7 +21,7 @@ from paddle_tpu.core.types import VarKind
 
 __all__ = ["data", "open_recordio_file", "open_files",
            "random_data_generator", "shuffle", "batch", "double_buffer",
-           "read_file"]
+           "multi_pass", "threaded", "read_file"]
 
 
 def data(name, shape, append_batch_size=True, dtype="float32", lod_level=0,
@@ -43,13 +43,19 @@ class _ReaderVariable(Variable):
     """A reader handle: a Variable plus shape/dtype metadata for
     read_file and a reset() that rewinds the scope-resident chain."""
 
-    def reset(self):
-        from ..executor import _scope_stack
+    def reset(self, scope=None):
+        """Rewind the chain.  ``scope``: the scope the executor actually
+        ran with — callers using ``exe.run(..., scope=s)`` without a
+        scope_guard must pass it, or the chain in the guard-stack top
+        would be (wrongly) the one rewound."""
+        if scope is None:
+            from ..executor import _scope_stack
+            scope = _scope_stack[-1]
         try:
-            state = _scope_stack[-1].find_var(self.name)
+            state = scope.find_var(self.name)
         except KeyError:
             raise RuntimeError(
-                "reader %r is not initialized in the current scope (run "
+                "reader %r is not initialized in the given scope (run "
                 "the startup program first)" % self.name)
         state.reset()
 
@@ -93,13 +99,15 @@ def open_recordio_file(filename, shapes, lod_levels, dtypes,
 
 def open_files(filenames, shapes, lod_levels, dtypes, thread_num=1,
                buffer_size=None, pass_num=1, for_parallel=False):
-    """Reader over a LIST of recordio files, concatenated (reference
-    io.py open_files / open_files_op).  ``thread_num``/``buffer_size``
-    are accepted for signature parity but files stream sequentially —
-    chain double_buffer() for the prefetch thread."""
+    """Reader over a LIST of recordio files (reference io.py open_files
+    / open_files_op).  thread_num > 1 scans files with a worker pool
+    into a bounded queue (sample order across files nondeterministic,
+    like the reference's multi_file_reader); thread_num == 1 streams
+    them concatenated in order."""
     return _create_reader(
         "open_files",
-        {"filenames": list(filenames), "pass_num": int(pass_num)},
+        {"filenames": list(filenames), "pass_num": int(pass_num),
+         "thread_num": int(thread_num)},
         shapes, dtypes, lod_levels)
 
 
@@ -150,10 +158,29 @@ def shuffle(reader, buffer_size):
                      {"buffer_size": int(buffer_size)})
 
 
-def batch(reader, batch_size):
-    """Sample->minibatch decorator (reference create_batch_reader op)."""
+def batch(reader, batch_size, drop_last=True):
+    """Sample->minibatch decorator (reference create_batch_reader op).
+    drop_last=True diverges from the reference default deliberately: a
+    ragged tail batch would recompile the XLA step every epoch; pass
+    False to emit it anyway (reference BatchReader::ReadNext)."""
     return _decorate("create_batch_reader", reader,
-                     {"batch_size": int(batch_size)})
+                     {"batch_size": int(batch_size),
+                      "drop_last": bool(drop_last)})
+
+
+def multi_pass(reader, pass_num):
+    """Replay the chain ``pass_num`` epochs before EOF (reference
+    io.py multi_pass / create_multi_pass_reader_op)."""
+    return _decorate("create_multi_pass_reader", reader,
+                     {"pass_num": int(pass_num)})
+
+
+def threaded(reader, capacity=16):
+    """Thread-safe prefetching front (reference
+    create_threaded_reader_op): a worker drains the chain into a
+    bounded queue so concurrent consumers can pop safely."""
+    return _decorate("create_threaded_reader", reader,
+                     {"capacity": int(capacity)})
 
 
 def double_buffer(reader, place=None, name=None):

@@ -36,9 +36,13 @@ class PipelineProgram:
     variable names; stage i runs on devices[i].
 
     The last stage must compute ``loss``.  Feeds enter stage 0;
-    parameters stay resident on their stage's device and are updated
-    in place with SGD (``lr``) each ``train_step``; ``sync_to_scope``
-    writes them back.
+    parameters stay resident on their stage's device.  If the program
+    contains optimizer ops (``optimizer.minimize`` ran on it), each
+    ``train_step`` applies THOSE per stage — Adam trains as Adam; a
+    program without optimizer ops uses the explicit ``lr`` SGD instead
+    (and mixing the two raises rather than silently ignoring one).
+    ``sync_to_scope`` writes parameters (and optimizer accumulators)
+    back.
     """
 
     def __init__(self, program, loss, cut_vars, devices, scope,
@@ -67,6 +71,81 @@ class PipelineProgram:
             {n: jax.device_put(np.asarray(scope.find_var(n)), st.device)
              for n in st.param_names}
             for st in self.stages]
+        self._collect_optimizer_ops(program, scope)
+
+    def _collect_optimizer_ops(self, program, scope):
+        """Assign the program's optimizer ops (and their accumulator /
+        LR state) to the stage owning their Param; refuse programs with
+        global optimizer-role ops (LR schedules &c.) loudly — running a
+        pipelined program with a silently-dropped schedule would train
+        wrong."""
+        import jax
+
+        from .framework import OpRole
+
+        block = program.global_block()
+        opt_ops = [op for op in block.desc.ops
+                   if op.role & OpRole.Optimize]
+        self._opt_ops = [[] for _ in self.stages]
+        self._opt_state = [{} for _ in self.stages]
+        self.has_program_optimizer = bool(opt_ops)
+        if not opt_ops:
+            return
+        nonparam = [op.type for op in opt_ops
+                    if not (op.inputs.get("Param") or [None])[0]]
+        if nonparam:
+            raise NotImplementedError(
+                "pipeline: program has global optimizer-role ops %r "
+                "(e.g. an LR schedule) that have no owning stage" %
+                nonparam)
+        owner = {n: i for i, st in enumerate(self.stages)
+                 for n in st.param_names}
+        for op in opt_ops:
+            pname = op.inputs["Param"][0]
+            if pname not in owner:
+                raise ValueError(
+                    "optimizer op %r updates %r which no stage owns"
+                    % (op.type, pname))
+            self._opt_ops[owner[pname]].append(op)
+        for i, st in enumerate(self.stages):
+            state_names = sorted({
+                n for op in self._opt_ops[i]
+                for slot, ns in op.inputs.items()
+                for n in ns
+                if slot not in ("Param", "Grad") and n})
+            self._opt_state[i] = {
+                n: jax.device_put(np.asarray(scope.find_var(n)),
+                                  st.device)
+                for n in state_names}
+
+    def _apply_program_optimizer(self, grads):
+        """Run each stage's optimizer ops on its device: env carries
+        params + accumulators, Grad slots get the accumulated pipeline
+        grads, and fluid's in-place contract (ParamOut/MomentOut alias
+        the input names) hands back the updated state."""
+        import jax
+
+        from paddle_tpu.core.lowering import LoweringContext, run_op
+
+        desc = self.program.desc
+        for i, st in enumerate(self.stages):
+            if not self._opt_ops[i]:
+                continue
+            env = dict(self.params[i])
+            env.update(self._opt_state[i])
+            for op in self._opt_ops[i]:
+                pn = op.inputs["Param"][0]
+                gn = op.inputs["Grad"][0]
+                g = grads[i].get(pn)
+                env[gn] = (g if g is not None
+                           else jax.numpy.zeros_like(env[pn]))
+            ctx = LoweringContext(desc, 0, env, jax.random.PRNGKey(0),
+                                  mode="train")
+            ctx.block = desc.blocks[0]
+            for op in self._opt_ops[i]:
+                run_op(ctx, op)
+            self.params[i] = {n: env[n] for n in self.params[i]}
+            self._opt_state[i] = {n: env[n] for n in self._opt_state[i]}
 
     # ------------------------------------------------------------------
     def _split(self, program, cut_names, devices, scope):
@@ -154,12 +233,27 @@ class PipelineProgram:
         return jax.jit(fn)
 
     # ------------------------------------------------------------------
-    def train_step(self, feed, n_microbatches, lr=0.01):
+    def train_step(self, feed, n_microbatches, lr=None):
         """One GPipe step: split the feed on dim 0 into microbatches,
         forward all of them through the stages (async dispatch overlaps
         stages across devices), then backward in reverse, accumulate
-        per-stage grads, apply SGD.  Returns the mean microbatch loss."""
+        per-stage grads, apply the update.  Returns the mean microbatch
+        loss.
+
+        Update source: the program's own optimizer ops when present
+        (``lr`` must then be None); otherwise plain SGD with ``lr``."""
         import jax
+
+        if self.has_program_optimizer:
+            if lr is not None:
+                raise ValueError(
+                    "program has optimizer ops (minimize ran on it) — "
+                    "drop lr=...: train_step applies the program's "
+                    "optimizer, the manual-SGD lr would be ignored")
+        elif lr is None:
+            raise ValueError(
+                "program has no optimizer ops: pass lr= for the "
+                "manual-SGD update (or run optimizer.minimize on it)")
 
         mbs = self._split_feed(feed, n_microbatches)
         # forward: keep vjp closures per (stage, microbatch)
@@ -207,12 +301,15 @@ class PipelineProgram:
                 cot = {k: jax.device_put(
                     v, self.stages[i - 1].device if i else st.device)
                     for k, v in ga.items()}
-        # SGD in place, per stage on its device (frozen params skipped)
-        for i, st in enumerate(self.stages):
-            self.params[i] = {
-                n: (self.params[i][n] if n in self._frozen
-                    else self.params[i][n] - lr * grads[i][n])
-                for n in self.params[i]}
+        if self.has_program_optimizer:
+            self._apply_program_optimizer(grads)
+        else:
+            # SGD in place, per stage on its device (frozen skipped)
+            for i, st in enumerate(self.stages):
+                self.params[i] = {
+                    n: (self.params[i][n] if n in self._frozen
+                        else self.params[i][n] - lr * grads[i][n])
+                    for n in self.params[i]}
         return float(np.mean([np.asarray(l).ravel()[0]
                               for l in losses]))
 
@@ -231,4 +328,7 @@ class PipelineProgram:
     def sync_to_scope(self, scope):
         for st_params in self.params:
             for n, v in st_params.items():
+                (scope.find_scope_of(n) or scope).set(n, np.asarray(v))
+        for st_state in self._opt_state:
+            for n, v in st_state.items():
                 (scope.find_scope_of(n) or scope).set(n, np.asarray(v))

@@ -100,6 +100,16 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref,
         lse_ref[...] = (m_ref[...][:, 0] + jnp.log(l))[:, None]
 
 
+def _fit_block(block, size):
+    """Largest power-of-two tile <= requested that divides the dim, so
+    raising a default never demotes a previously-kernel-eligible length
+    (e.g. T=7680: 1024 fails, 512 divides)."""
+    block = min(block, size)
+    while block > 8 and size % block:
+        block //= 2
+    return block
+
+
 def flash_attention(q, k, v, scale=None, causal=False, block_q=1024,
                     block_k=1024, force_xla=False, interpret=False):
     """softmax(QK^T scale) V, [B,H,T,D] in/out.
@@ -116,17 +126,8 @@ def flash_attention(q, k, v, scale=None, causal=False, block_q=1024,
         scale = 1.0 / np.sqrt(d)
     on_tpu = target_platform() == "tpu"
 
-    def fit(block, size):
-        # largest power-of-two tile <= requested that divides the dim,
-        # so raising the default never demotes a previously-kernel-
-        # eligible length (e.g. T=7680: 1024 fails, 512 divides)
-        block = min(block, size)
-        while block > 8 and size % block:
-            block //= 2
-        return block
-
-    block_q = fit(block_q, t)
-    block_k = fit(block_k, tk)
+    block_q = _fit_block(block_q, t)
+    block_k = _fit_block(block_k, tk)
     usable = (t % block_q == 0 and tk % block_k == 0)
     if force_xla or not usable or not (on_tpu or interpret):
         return _attention_xla(q, k, v, scale, causal)
@@ -156,10 +157,19 @@ def _flash_bwd(scale, causal, block_q, block_k, interpret, res, g):
     q, k, v, out, lse = res
     do = g.astype(out.dtype)
     delta = (do.astype(jnp.float32) * out.astype(jnp.float32)).sum(-1)
-    dq = _flash_bwd_dq(q, k, v, do, lse, delta, scale, causal, block_q,
-                       block_k, interpret)
+
+    # The backward kernels keep several [block_q, block_k] f32
+    # intermediates (p, ds + operand tiles) live in VMEM per grid step —
+    # at 1024x1024 that flirts with the ~16MB/core budget at d=128, so
+    # cap the backward tiles at 512 (power-of-two halving keeps
+    # divisibility) while the forward keeps the bigger tiles it profits
+    # from.
+    bq = _fit_block(min(block_q, 512), q.shape[2])
+    bk = _fit_block(min(block_k, 512), k.shape[2])
+    dq = _flash_bwd_dq(q, k, v, do, lse, delta, scale, causal, bq,
+                       bk, interpret)
     dk, dv = _flash_bwd_dkv(q, k, v, do, lse, delta, scale, causal,
-                            block_q, block_k, interpret)
+                            bq, bk, interpret)
     return dq, dk, dv
 
 

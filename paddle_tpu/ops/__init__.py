@@ -22,4 +22,5 @@ from paddle_tpu.ops import (  # noqa: F401
     crf_ctc,
     detection,
     misc,
+    concurrency_ops,
 )

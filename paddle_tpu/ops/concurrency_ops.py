@@ -1,0 +1,162 @@
+"""In-program CSP ops: channels + go routines usable INSIDE a fluid
+ProgramDesc.
+
+Parity: reference framework/channel.h:33 (buffered/unbuffered Go-style
+channels) and the ops operators/channel_create_op.cc,
+channel_send_op.cc, channel_recv_op.cc, channel_close_op.cc, go_op.cc.
+The host-level orchestration API lives in fluid/concurrency.py; these
+ops make a *program* contain channel traffic — channel_create leaves a
+Channel in the scope, send/recv are host ops reading/writing program
+variables, and ``go`` launches its sub-block on a daemon thread through
+a nested interpreted executor (go_op.cc:84 ExecuteOnThread).  ``select``
+stays a host-level facility (fluid.concurrency.Select) — a data-driven
+select inside a ProgramDesc would need per-case sub-blocks wired by the
+front-end, which the superseded reference API never stabilized.
+"""
+from __future__ import annotations
+
+import threading
+
+import numpy as np
+
+from paddle_tpu.core.registry import register_op
+
+
+def _host(name):
+    def deco(impl):
+        register_op(name, lower=impl, host_op=True, grad_maker=None)
+        return impl
+
+    return deco
+
+
+def _scope_set(scope, name, value):
+    (scope.find_scope_of(name) or scope).set(name, value)
+
+
+@_host("channel_create")
+def _channel_create(executor, op, scope, feed, env=None):
+    from paddle_tpu.fluid.concurrency import Channel
+
+    out = op.output("Out")[0]
+    if scope.has_var(out) and isinstance(scope.find_var(out), Channel):
+        return  # idempotent: re-running startup keeps the live channel
+    _scope_set(scope, out,
+               Channel(capacity=int(op.attr("capacity") or 0),
+                       dtype=op.attr("data_type")))
+
+
+def _value_of(name, scope, feed, env):
+    if env is not None and name in env:
+        return env[name]
+    if feed and name in feed:
+        return feed[name]
+    return scope.find_var(name)
+
+
+@_host("channel_send")
+def _channel_send(executor, op, scope, feed, env=None):
+    from paddle_tpu.fluid.concurrency import Channel, ChannelClosed
+
+    ch = scope.find_var(op.input("Channel")[0])
+    if not isinstance(ch, Channel):
+        raise RuntimeError("channel_send: %r is not a live channel"
+                           % op.input("Channel")[0])
+    val = _value_of(op.input("X")[0], scope, feed, env)
+    ok = True
+    try:
+        ch.send(np.asarray(val))
+    except ChannelClosed:
+        ok = False  # reference: send on closed sets Status false
+    status = op.outputs.get("Status")
+    if status and status[0]:
+        out = np.asarray([ok])
+        _scope_set(scope, status[0], out)
+        if env is not None:
+            env[status[0]] = out
+
+
+@_host("channel_recv")
+def _channel_recv(executor, op, scope, feed, env=None):
+    from paddle_tpu.fluid.concurrency import channel_recv as _recv
+
+    ch = scope.find_var(op.input("Channel")[0])
+    val, ok = _recv(ch)
+    out = op.output("Out")[0]
+    if val is None:  # closed + drained: typed zero like the reference
+        dt = np.dtype(getattr(ch, "dtype", None) or np.float32)
+        val = np.zeros((1,), dt)
+    val = np.asarray(val)
+    _scope_set(scope, out, val)
+    status = op.outputs.get("Status")
+    if env is not None:
+        env[out] = val
+    if status and status[0]:
+        st = np.asarray([ok])
+        _scope_set(scope, status[0], st)
+        if env is not None:
+            env[status[0]] = st
+
+
+@_host("channel_close")
+def _channel_close(executor, op, scope, feed, env=None):
+    scope.find_var(op.input("Channel")[0]).close()
+
+
+@_host("go")
+def _go(executor, op, scope, feed, env=None):
+    """Launch the sub-block on a daemon thread (reference go_op.cc:84):
+    the routine runs through a nested interpreted executor against a
+    CHILD scope (kid-scope semantics) sharing the parent's channels and
+    parameters; exceptions surface on join via scope._go_threads."""
+    from paddle_tpu.core.executor_impl import ExecutorCore
+
+    program = executor._current_program
+    block_id = op.attr("sub_block")
+    if hasattr(block_id, "idx"):
+        block_id = block_id.idx
+    sub = ExecutorCore(executor.place)
+    child = scope.new_scope() if hasattr(scope, "new_scope") else scope
+    captured_feed = dict(feed or {})
+    # Capture the sub-block's external reads AT LAUNCH (reference go_op
+    # captures its X inputs the same way): parent-block temporaries live
+    # in the running step's env, not the scope, so a routine reading one
+    # would otherwise see a missing var and die — deadlocking whoever
+    # recvs on its channel.
+    blk = program.blocks[int(block_id)]
+    written = set()
+    for sop in blk.ops:
+        for n in sop.input_arg_names():
+            if (n and n not in written and n not in captured_feed
+                    and not scope.has_var(n)
+                    and env is not None and n in env):
+                captured_feed[n] = env[n]
+        for n in sop.output_arg_names():
+            if n:
+                written.add(n)
+    record = {"thread": None, "error": None}
+
+    def run():
+        try:
+            sub.run(program, child, block_id=int(block_id),
+                    feed=captured_feed)
+        except Exception as e:  # surfaced on join()
+            record["error"] = e
+
+    t = threading.Thread(target=run, daemon=True)
+    record["thread"] = t
+    if not hasattr(scope, "_go_threads"):
+        scope._go_threads = []
+    scope._go_threads.append(record)
+    t.start()
+
+
+def join_go_threads(scope, timeout=30.0):
+    """Wait for every go routine launched under ``scope``; re-raise the
+    first routine error (test/teardown helper — the reference leaks the
+    thread, go_op.cc's documented FIXME)."""
+    for rec in getattr(scope, "_go_threads", []):
+        rec["thread"].join(timeout)
+        if rec["error"] is not None:
+            raise rec["error"]
+    scope._go_threads = []

@@ -101,6 +101,12 @@ class _ShuffleReader(_ReaderBase):
 
 
 class _BatchReader(_ReaderBase):
+    """drop_last=True is the default here (NOT the reference's: its
+    BatchReader emits the final partial batch,
+    create_batch_reader_op.cc) — a ragged tail batch would trigger an
+    XLA recompile per epoch; pass drop_last=False through
+    layers.io.batch to restore reference semantics."""
+
     def __init__(self, parent, batch_size, drop_last=True):
         self.parent = parent
         self.batch_size = int(batch_size)
@@ -184,6 +190,97 @@ class _DoubleBufferReader(_ReaderBase):
         self.parent.reset()
 
 
+class _MultiPassReader(_ReaderBase):
+    """Replay the underlying chain pass_num times before raising EOF
+    (reference create_multi_pass_reader_op.cc: the trainer loop sees N
+    epochs as one stream); tracks the current pass for introspection."""
+
+    def __init__(self, parent, pass_num):
+        self.parent = parent
+        self.pass_num = max(1, int(pass_num))
+        self.current_pass = 0
+
+    def next(self):
+        # loop, don't recurse into parent.next() bare: an EOF right
+        # after an intra-pass reset (empty parent) must keep counting
+        # passes, or the NEXT epoch starts with a stale current_pass
+        while True:
+            try:
+                return self.parent.next()
+            except EOFException:
+                self.current_pass += 1
+                if self.current_pass >= self.pass_num:
+                    self.current_pass = 0
+                    raise
+                self.parent.reset()
+
+    def reset(self):
+        self.current_pass = 0
+        self.parent.reset()
+
+
+class _ThreadedReader(_ReaderBase):
+    """Thread-safe prefetching front (reference
+    create_threaded_reader_op.cc: wraps a chain so concurrent ReadNext
+    calls are safe).  A single worker drains the (unsynchronized)
+    parent into a bounded queue; any number of consumer threads pop."""
+
+    def __init__(self, parent, capacity=16):
+        self.parent = parent
+        self.capacity = int(capacity)
+        self._lock = threading.Lock()
+        self._q = None
+        self._thread = None
+        self._stop = None
+
+    def _start(self):
+        q = queue.Queue(self.capacity)
+        stop = threading.Event()
+        self._q, self._stop = q, stop
+
+        def work():
+            try:
+                while not stop.is_set():
+                    q.put(self.parent.next())
+            except EOFException:
+                q.put(EOFException("threaded"))
+            except Exception as e:
+                q.put(e)
+
+        self._thread = threading.Thread(target=work, daemon=True)
+        self._thread.start()
+
+    def next(self):
+        with self._lock:
+            if self._thread is None:
+                self._start()
+            q = self._q
+        item = q.get()
+        if isinstance(item, Exception):
+            with self._lock:
+                self._thread = None
+            if isinstance(item, EOFException):
+                # re-enqueue the sentinel so EVERY blocked consumer
+                # sees end-of-stream, not just the first one to pop
+                q.put(item)
+            raise item
+        return item
+
+    def reset(self):
+        with self._lock:
+            thread, q, stop = self._thread, self._q, self._stop
+            self._thread = None
+            if thread is not None and thread.is_alive():
+                stop.set()
+                while thread.is_alive():
+                    try:
+                        q.get_nowait()
+                    except queue.Empty:
+                        pass
+                    thread.join(timeout=0.05)
+            self.parent.reset()
+
+
 def _set_state(scope, name, state):
     (scope.find_scope_of(name) or scope).set(name, state)
 
@@ -236,6 +333,80 @@ class _MultiFileReader(_ReaderBase):
             r.reset()
 
 
+class _ParallelFilesReader(_ReaderBase):
+    """N worker threads each scan a round-robin subset of the files
+    into one bounded queue (reference open_files_op's multi_file_reader
+    thread pool); sample order across files is nondeterministic, EOF
+    fires once every worker drained its subset."""
+
+    def __init__(self, filenames, thread_num, capacity=64):
+        self.filenames = list(filenames)
+        self.thread_num = max(1, min(int(thread_num),
+                                     len(self.filenames) or 1))
+        self.capacity = int(capacity)
+        self._q = None
+        self._threads = None
+        self._stop = None
+
+    def _start(self):
+        q = queue.Queue(self.capacity)
+        stop = threading.Event()
+        done = []
+
+        def work(files):
+            try:
+                for f in files:
+                    r = _RecordIOReader(f)
+                    while not stop.is_set():
+                        try:
+                            q.put(r.next())
+                        except EOFException:
+                            break
+            except Exception as e:
+                q.put(e)
+            finally:
+                done.append(1)
+                if len(done) == self.thread_num:
+                    q.put(EOFException("open_files"))
+
+        self._q, self._stop = q, stop
+        self._threads = []
+        for i in range(self.thread_num):
+            t = threading.Thread(
+                target=work, args=(self.filenames[i::self.thread_num],),
+                daemon=True)
+            t.start()
+            self._threads.append(t)
+
+    def next(self):
+        if self._threads is None:
+            self._start()
+        item = self._q.get()
+        if isinstance(item, Exception):
+            # wind the POOL down before dropping it: surviving workers
+            # are blocked putting into this bounded queue and would
+            # leak (threads + open scanners) if just abandoned
+            self._shutdown()
+            raise item
+        return item
+
+    def _shutdown(self):
+        threads, q, stop = self._threads, self._q, self._stop
+        self._threads = None
+        if threads:
+            stop.set()
+            while any(t.is_alive() for t in threads):
+                try:
+                    q.get_nowait()
+                except queue.Empty:
+                    pass
+                for t in threads:
+                    t.join(timeout=0.02)
+
+    def reset(self):
+        self._shutdown()
+
+
 class _RandomDataReader(_ReaderBase):
     """Uniform random sample generator (reference
     create_random_data_generator_op) — a dummy reader to drive a
@@ -260,9 +431,17 @@ class _RandomDataReader(_ReaderBase):
 
 @_host("open_files")
 def _open_files(executor, op, scope, feed, env=None):
-    _set_state(scope, op.output("Out")[0],
-               _MultiFileReader(list(op.attr("filenames") or []),
-                                pass_num=op.attr("pass_num") or 1))
+    files = list(op.attr("filenames") or [])
+    threads = int(op.attr("thread_num") or 1)
+    if threads > 1:
+        # thread-pool scan (order nondeterministic across files);
+        # pass_num epochs compose via the multi_pass decorator
+        rd = _ParallelFilesReader(files, threads)
+        if (op.attr("pass_num") or 1) > 1:
+            rd = _MultiPassReader(rd, op.attr("pass_num"))
+    else:
+        rd = _MultiFileReader(files, pass_num=op.attr("pass_num") or 1)
+    _set_state(scope, op.output("Out")[0], rd)
 
 
 @_host("create_random_data_generator")
@@ -278,6 +457,20 @@ def _create_random(executor, op, scope, feed, env=None):
                _RandomDataReader(op.attr("low"), op.attr("high"), shapes))
 
 
+@_host("create_multi_pass_reader")
+def _create_multi_pass(executor, op, scope, feed, env=None):
+    parent = _get_state(scope, op.input("UnderlyingReader")[0])
+    _set_state(scope, op.output("Out")[0],
+               _MultiPassReader(parent, op.attr("pass_num") or 1))
+
+
+@_host("create_threaded_reader")
+def _create_threaded(executor, op, scope, feed, env=None):
+    parent = _get_state(scope, op.input("UnderlyingReader")[0])
+    _set_state(scope, op.output("Out")[0],
+               _ThreadedReader(parent, op.attr("capacity") or 16))
+
+
 @_host("create_shuffle_reader")
 def _create_shuffle(executor, op, scope, feed, env=None):
     parent = _get_state(scope, op.input("UnderlyingReader")[0])
@@ -289,7 +482,10 @@ def _create_shuffle(executor, op, scope, feed, env=None):
 def _create_batch(executor, op, scope, feed, env=None):
     parent = _get_state(scope, op.input("UnderlyingReader")[0])
     _set_state(scope, op.output("Out")[0],
-               _BatchReader(parent, op.attr("batch_size")))
+               _BatchReader(parent, op.attr("batch_size"),
+                            drop_last=bool(op.attr("drop_last")
+                                           if op.attr("drop_last")
+                                           is not None else True)))
 
 
 @_host("create_double_buffer_reader")

@@ -230,14 +230,30 @@ def _gru_unit(ctx, ins, attrs, op=None):
 # Sequence manipulation ops
 # ---------------------------------------------------------------------------
 
-@register_op("sequence_pool", seq_aware=True,
-             no_vjp_outputs=("MaxIndex",))
-def _sequence_pool(ctx, ins, attrs, op=None):
-    """Pool each sequence to one vector (reference sequence_pool_op.cc):
-    SUM/AVERAGE/SQRT/MAX/LAST/FIRST.  [N,T,D] -> [N,D]."""
-    x = ins["X"]
-    lens = _lens_of(ctx, op, "X")
-    ptype = attrs.get("pooltype", "AVERAGE").upper()
+def _inner_lens_of(ctx, op, slot):
+    """[N, S] inner sub-sequence lengths of a level-2 LoD input
+    ('<name>@LEN@1', core/executor_impl._prepare_lod_feeds), or None."""
+    if op is None:
+        return None
+    names = op.inputs.get(slot) or []
+    if names and names[0]:
+        return ctx.env.get(names[0] + "@LEN@1")
+    return None
+
+
+def _fold_level2(x, inner):
+    """[N, S, W, ...] + [N, S] -> ([N*S, W, ...], [N*S]): level-2 data
+    folded so a level-1 op body works at the FINEST level (reference
+    sequence ops always operate at the finest LoD level,
+    lod_tensor.h:58-110)."""
+    n, s = x.shape[:2]
+    return (x.reshape((n * s,) + x.shape[2:]),
+            inner.reshape(n * s), (n, s))
+
+
+def _pool_core(x, lens, ptype):
+    """[N,T,...] -> ({Out: [N,...], MaxIndex?}, counts) masked by
+    lens."""
     n, t = x.shape[:2]
     mask = _mask(lens, n, t, x.dtype)
     mshape = mask.shape + (1,) * (x.ndim - 2)
@@ -255,7 +271,9 @@ def _sequence_pool(ctx, ins, attrs, op=None):
     elif ptype == "MAX":
         neg = jnp.finfo(x.dtype).min
         masked = jnp.where(m > 0, x, neg)
-        out = jnp.max(masked, axis=1)
+        # empty (all-padding) sequences pool to 0, not -inf — they only
+        # exist as level-2 outer padding and get masked downstream
+        out = jnp.where(counts > 0, jnp.max(masked, axis=1), 0)
         outs["MaxIndex"] = jnp.argmax(masked, axis=1).astype(jnp.int32)
     elif ptype == "LAST":
         idx = (jnp.maximum(lens - 1, 0) if lens is not None
@@ -273,19 +291,59 @@ def _sequence_pool(ctx, ins, attrs, op=None):
     return outs
 
 
-@register_op("sequence_softmax", seq_aware=True)
-def _sequence_softmax(ctx, ins, attrs, op=None):
-    """Softmax within each sequence over the time axis, masked."""
+@register_op("sequence_pool", seq_aware=True,
+             no_vjp_outputs=("MaxIndex",))
+def _sequence_pool(ctx, ins, attrs, op=None):
+    """Pool each sequence to one vector (reference sequence_pool_op.cc):
+    SUM/AVERAGE/SQRT/MAX/LAST/FIRST.  [N,T,D] -> [N,D]; level-2 input
+    [N,S,W,D] pools each INNER sub-sequence (finest level) -> [N,S,D]
+    with the outer lengths carried to the output."""
     x = ins["X"]
-    lens = _lens_of(ctx, op, "X")
+    ptype = attrs.get("pooltype", "AVERAGE").upper()
+    inner = _inner_lens_of(ctx, op, "X")
+    if inner is not None:
+        xf, lf, (n, s) = _fold_level2(x, inner)
+        outs = _pool_core(xf, lf, ptype)
+        outs = {k: v.reshape((n, s) + v.shape[1:])
+                for k, v in outs.items()}
+        if op is not None and op.outputs.get("Out"):
+            outer = _lens_of(ctx, op, "X")
+            if outer is not None:  # output is level-1: row per sub-seq
+                ctx.set_seq_len(op.outputs["Out"][0], outer)
+        return outs
+    return _pool_core(x, _lens_of(ctx, op, "X"), ptype)
+
+
+def _softmax_core(x, lens):
     n, t = x.shape[:2]
     mask = _mask(lens, n, t, x.dtype).reshape(
         (n, t) + (1,) * (x.ndim - 2))
     neg = jnp.finfo(x.dtype).min
-    e = jnp.exp(x - jnp.max(jnp.where(mask > 0, x, neg), axis=1,
-                            keepdims=True))
-    e = e * mask
-    out = e / jnp.maximum(jnp.sum(e, axis=1, keepdims=True), 1e-20)
+    mx = jnp.max(jnp.where(mask > 0, x, neg), axis=1, keepdims=True)
+    # where, not multiply: an all-padding (length-0) sequence has
+    # mx=finfo.min and exp(x-mx) overflows to inf — inf*0 would be NaN
+    e = jnp.where(mask > 0, jnp.exp(x - mx), 0)
+    return e / jnp.maximum(jnp.sum(e, axis=1, keepdims=True), 1e-20)
+
+
+@register_op("sequence_softmax", seq_aware=True)
+def _sequence_softmax(ctx, ins, attrs, op=None):
+    """Softmax within each sequence over the time axis, masked; level-2
+    input normalizes within each INNER sub-sequence (finest level)."""
+    x = ins["X"]
+    inner = _inner_lens_of(ctx, op, "X")
+    if inner is not None:
+        xf, lf, (n, s) = _fold_level2(x, inner)
+        out = _softmax_core(xf, lf).reshape(x.shape)
+        if op is not None and op.outputs.get("Out"):
+            oname = op.outputs["Out"][0]
+            outer = _lens_of(ctx, op, "X")
+            if outer is not None:  # shape-preserving: both levels carry
+                ctx.set_seq_len(oname, outer)
+            ctx.env[oname + "@LEN@1"] = inner
+        return {"Out": out}
+    lens = _lens_of(ctx, op, "X")
+    out = _softmax_core(x, lens)
     if op is not None and op.outputs.get("Out") and lens is not None:
         ctx.set_seq_len(op.outputs["Out"][0], lens)
     return {"Out": out}
@@ -308,15 +366,7 @@ def _sequence_expand(ctx, ins, attrs, op=None):
     return {"Out": out}
 
 
-@register_op("sequence_conv", seq_aware=True)
-def _sequence_conv(ctx, ins, attrs, op=None):
-    """Context-window convolution over time (reference
-    sequence_conv_op.cc): X [N,T,D], Filter [ctx_len*D, F]."""
-    x = ins["X"]
-    filt = ins["Filter"]
-    lens = _lens_of(ctx, op, "X")
-    ctx_len = int(attrs.get("contextLength", 3))
-    ctx_start = int(attrs.get("contextStart", -(ctx_len // 2)))
+def _seq_conv_core(x, lens, filt, ctx_len, ctx_start):
     n, t, d = x.shape
     m = _mask(lens, n, t, x.dtype)[..., None]
     xm = x * m
@@ -326,10 +376,36 @@ def _sequence_conv(ctx, ins, attrs, op=None):
         cols.append(jnp.roll(xm, -shift, axis=1) * _shift_valid(
             n, t, shift, x.dtype))
     col = jnp.concatenate(cols, axis=-1)          # [N,T,ctx*D]
-    out = col @ filt
+    return (col @ filt) * m
+
+
+@register_op("sequence_conv", seq_aware=True)
+def _sequence_conv(ctx, ins, attrs, op=None):
+    """Context-window convolution over time (reference
+    sequence_conv_op.cc): X [N,T,D], Filter [ctx_len*D, F].  Level-2
+    input convolves within each INNER sub-sequence — the window never
+    crosses a sub-sequence boundary (finest-level semantics)."""
+    x = ins["X"]
+    filt = ins["Filter"]
+    ctx_len = int(attrs.get("contextLength", 3))
+    ctx_start = int(attrs.get("contextStart", -(ctx_len // 2)))
+    inner = _inner_lens_of(ctx, op, "X")
+    if inner is not None:
+        xf, lf, (n, s) = _fold_level2(x, inner)
+        out = _seq_conv_core(xf, lf, filt, ctx_len, ctx_start)
+        out = out.reshape((n, s) + out.shape[1:])
+        if op is not None and op.outputs.get("Out"):
+            oname = op.outputs["Out"][0]
+            outer = _lens_of(ctx, op, "X")
+            if outer is not None:
+                ctx.set_seq_len(oname, outer)
+            ctx.env[oname + "@LEN@1"] = inner
+        return {"Out": out}
+    lens = _lens_of(ctx, op, "X")
+    out = _seq_conv_core(x, lens, filt, ctx_len, ctx_start)
     if op is not None and op.outputs.get("Out") and lens is not None:
         ctx.set_seq_len(op.outputs["Out"][0], lens)
-    return {"Out": out * m}
+    return {"Out": out}
 
 
 def _shift_valid(n, t, shift, dtype):

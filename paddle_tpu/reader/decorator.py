@@ -231,7 +231,13 @@ class PipeReader:
         while True:
             buff = self.process.stdout.read(self.bufsize)
             if not buff:
-                decomp_buff = decoder.decode(b"", final=True)
+                tail = b""
+                if self.file_type == "gzip":
+                    # drain the decompressor: bytes still buffered in
+                    # zlib (or a trailing partial member) would be
+                    # silently dropped otherwise
+                    tail = self.dec.flush()
+                decomp_buff = decoder.decode(tail, final=True)
             elif self.file_type == "gzip":
                 decomp_buff = decoder.decode(self.dec.decompress(buff))
             else:

@@ -150,3 +150,104 @@ def test_master_over_grpc_with_dead_worker(tmp_path):
         assert m.counts()["done"] == 4
     finally:
         server.stop()
+
+
+def test_master_ha_takeover_completes_dataset_once(tmp_path):
+    """Kill the active master mid-epoch; a standby takes over the
+    leader lock, recovers from the shared snapshot, re-registers, and
+    the HA client finishes the dataset — every task completed exactly
+    once (reference go/master/etcd_client.go:27-31 leader election +
+    snapshot recovery)."""
+    from paddle_tpu.distributed.discovery import (HAMasterClient,
+                                                  MasterHA)
+
+    root = str(tmp_path / "svc")
+    os.makedirs(root)
+    n_tasks = 8
+    ttl = 1.0
+
+    ep_a = "127.0.0.1:%d" % _free_port()
+    a = MasterHA(root, ep_a, ttl=ttl, lease_timeout=5.0)
+    a.campaign(timeout=10)
+
+    client = HAMasterClient(root, timeout=30.0, ttl=ttl)
+    client.set_dataset(list(range(n_tasks)))
+
+    finished = []
+    for _ in range(3):  # first tranche under master A
+        t = client.get_task()
+        client.task_finished(t.task_id)
+        finished.append(t.task_id)
+
+    # A dies (no clean release: simulate a crash by only stopping the
+    # server; the lock goes stale and is STOLEN after ttl)
+    a.registry.unregister(MasterHA.KIND, ep_a)
+    a.server.stop()
+    if a.lock._stop is not None:
+        a.lock._stop.set()  # heartbeat stops; holder looks dead
+
+    ep_b = "127.0.0.1:%d" % _free_port()
+    b = MasterHA(root, ep_b, ttl=ttl, lease_timeout=5.0)
+    b.campaign(timeout=30)  # blocks until A's lock is stale, recovers
+
+    try:
+        while True:
+            t = client.get_task()
+            if t is None:
+                break
+            client.task_finished(t.task_id)
+            finished.append(t.task_id)
+        # exactly once: completed set == dataset, no duplicates (the
+        # finished tasks survived in the snapshot; only unleased todo
+        # work was re-dispatched)
+        assert sorted(finished) == list(range(n_tasks)), finished
+        counts = client.counts()
+        assert counts["done"] == n_tasks and counts["failed"] == 0
+    finally:
+        b.stop()
+
+
+def test_endpoint_registry_and_lock(tmp_path):
+    from paddle_tpu.distributed.discovery import (EndpointRegistry,
+                                                  FileLock)
+
+    root = str(tmp_path / "reg")
+    reg = EndpointRegistry(root, ttl=0.5)
+    reg.register("pserver", "h1:1", heartbeat=False)
+    reg.register("pserver", "h2:2", heartbeat=False)
+    assert reg.wait_for("pserver", 2, timeout=2) == ["h1:1", "h2:2"]
+    time.sleep(0.7)  # no heartbeat -> both expire
+    assert reg.list("pserver") == []
+
+    l1 = FileLock(os.path.join(root, "l"), ttl=0.5)
+    l2 = FileLock(os.path.join(root, "l"), ttl=0.5)
+    assert l1.try_acquire()
+    assert not l2.try_acquire()     # held + heartbeating
+    l1._stop.set()                  # holder "crashes"
+    time.sleep(0.8)
+    assert l2.try_acquire()         # stale lock stolen
+    l2.release()
+
+
+def test_lock_steal_is_single_winner(tmp_path):
+    """The stale-lock steal goes through an O_EXCL intent file: while
+    one candidate's steal is in flight, every other candidate backs
+    off (split-brain guard)."""
+    from paddle_tpu.distributed.discovery import FileLock
+
+    path = os.path.join(str(tmp_path), "l")
+    holder = FileLock(path, ttl=0.4)
+    assert holder.try_acquire()
+    holder._stop.set()          # holder crashes (heartbeat stops)
+    time.sleep(0.6)
+
+    a = FileLock(path, ttl=0.4)
+    b = FileLock(path, ttl=0.4)
+    # b observes a steal in progress -> must NOT acquire
+    open(path + ".steal", "w").write("other")
+    assert not b.try_acquire()
+    os.remove(path + ".steal")
+    # now a steals cleanly; b then sees a FRESH lock and backs off
+    assert a.try_acquire()
+    assert not b.try_acquire()
+    a.release()

@@ -1,0 +1,39 @@
+"""Host-side memory discipline across long trainings (SURVEY §2.1 row
+10 / memory_optimize subsumption): XLA buffer assignment owns device
+memory, but the HOST scope must not grow either — the compiled path
+keeps temporaries in the traced env and writes back only persistables,
+so step count must not change the scope's var census."""
+import numpy as np
+
+import paddle_tpu.fluid as fluid
+
+
+def test_scope_var_count_stable_over_steps(prog_scope, exe):
+    main, startup, scope = prog_scope
+    x = fluid.layers.data(name="x", shape=[16], dtype="float32")
+    y = fluid.layers.data(name="y", shape=[1], dtype="float32")
+    h = fluid.layers.fc(x, size=32, act="relu")
+    h2 = fluid.layers.fc(h, size=32, act="relu")
+    pred = fluid.layers.fc(h2, size=1)
+    loss = fluid.layers.mean(fluid.layers.square_error_cost(pred, y))
+    fluid.optimizer.Adam(learning_rate=0.01).minimize(loss)
+    exe.run(startup)
+
+    rng = np.random.RandomState(0)
+    feed = {"x": rng.randn(8, 16).astype(np.float32),
+            "y": rng.randn(8, 1).astype(np.float32)}
+    exe.run(main, feed=feed, fetch_list=[loss])
+    baseline = len(scope.local_var_names())
+    for _ in range(50):
+        exe.run(main, feed=feed, fetch_list=[loss])
+    after = len(scope.local_var_names())
+    # temporaries live inside the jitted step, not the scope: fifty
+    # steps add zero host vars (the memory_optimize guarantee the
+    # transpiler shim documents as subsumed)
+    assert after == baseline, (baseline, after)
+    # and only persistables landed there at all
+    names = set(scope.local_var_names())
+    block = main.global_block()
+    non_persist = [n for n in names
+                   if n in block.vars and not block.vars[n].persistable]
+    assert non_persist == [], non_persist

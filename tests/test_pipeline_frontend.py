@@ -79,3 +79,86 @@ def test_pipeline_matches_single_device():
     pp.sync_to_scope(scope_b)
     np.testing.assert_allclose(np.asarray(scope_b.find_var("w1")),
                                base_w1, rtol=1e-4, atol=1e-6)
+
+
+def _build_with_adam(scope, lr):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.scope_guard(scope):
+        with fluid.program_guard(main, startup):
+            with fluid.unique_name.guard():
+                x = layers.data(name="x", shape=[8], dtype="float32")
+                y = layers.data(name="y", shape=[1], dtype="float32")
+                h1 = layers.fc(x, size=16, act="tanh",
+                               param_attr=fluid.ParamAttr(name="w1"),
+                               bias_attr=fluid.ParamAttr(name="b1"))
+                pred = layers.fc(h1, size=1,
+                                 param_attr=fluid.ParamAttr(name="w2"),
+                                 bias_attr=fluid.ParamAttr(name="b2"))
+                loss = layers.mean(layers.square_error_cost(pred, y))
+                fluid.optimizer.Adam(learning_rate=lr).minimize(loss)
+    return main, startup, h1, loss
+
+
+def test_pipeline_runs_program_adam():
+    """A pipelined program that minimized with Adam trains with ADAM —
+    trajectory matches single-device Adam; passing lr= raises."""
+    import jax
+
+    devices = jax.devices("cpu")
+    if len(devices) < 2:
+        pytest.skip("needs 2 host devices")
+
+    rng = np.random.RandomState(1)
+    xv = rng.randn(8, 8).astype(np.float32)
+    yv = (xv.sum(1, keepdims=True) * 0.3).astype(np.float32)
+    lr, steps, n_mb = 0.01, 4, 4
+
+    scope_p = fluid.Scope()
+    main_p, startup_p, h1, loss_p = _build_with_adam(scope_p, lr)
+    with fluid.scope_guard(scope_p):
+        fluid.Executor(fluid.CPUPlace()).run(startup_p)
+
+    # single-device Adam baseline from the same init
+    scope_c = fluid.Scope()
+    main_c, startup_c, _, loss_c = _build_with_adam(scope_c, lr)
+    with fluid.scope_guard(scope_c):
+        exe_c = fluid.Executor(fluid.CPUPlace())
+        exe_c.run(startup_c)
+        for n in ("w1", "b1", "w2", "b2"):
+            scope_c.set(n, np.asarray(scope_p.find_var(n)))
+        base = []
+        for _ in range(steps):
+            l, = exe_c.run(main_c, feed={"x": xv, "y": yv},
+                           fetch_list=[loss_c])
+            base.append(float(np.ravel(l)[0]))
+
+    from paddle_tpu.fluid.pipeline import PipelineProgram
+
+    pp = PipelineProgram(main_p, loss_p, cut_vars=[h1],
+                         devices=devices[:2], scope=scope_p,
+                         feed_names=["x", "y"])
+    with pytest.raises(ValueError, match="drop lr"):
+        pp.train_step({"x": xv, "y": yv}, n_microbatches=n_mb, lr=lr)
+    pipe = [pp.train_step({"x": xv, "y": yv}, n_microbatches=n_mb)
+            for _ in range(steps)]
+    np.testing.assert_allclose(pipe, base, rtol=1e-4, atol=1e-6)
+
+
+def test_pipeline_without_optimizer_requires_lr():
+    import jax
+
+    devices = jax.devices("cpu")
+    if len(devices) < 3:
+        pytest.skip("needs 3 host devices")
+    scope = fluid.Scope()
+    main, startup, h1, h2, loss = _build(scope)
+    with fluid.scope_guard(scope):
+        fluid.Executor(fluid.CPUPlace()).run(startup)
+    from paddle_tpu.fluid.pipeline import PipelineProgram
+    pp = PipelineProgram(main, loss, cut_vars=[h1, h2],
+                         devices=devices[:3], scope=scope,
+                         feed_names=["x", "y"])
+    x = np.zeros((4, 8), np.float32)
+    y = np.zeros((4, 1), np.float32)
+    with pytest.raises(ValueError, match="pass lr"):
+        pp.train_step({"x": x, "y": y}, n_microbatches=2)

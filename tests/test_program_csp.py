@@ -1,0 +1,91 @@
+"""In-program CSP: channel + go ops INSIDE a fluid ProgramDesc
+(reference framework/channel.h:33, operators/channel_*_op.cc, go_op.cc;
+front-end concurrency.py Go:27/make_channel:279).  A producer go-block
+computes on device and sends through a channel; the main block receives
+and keeps computing — all expressed as program ops, surviving
+serialization."""
+import numpy as np
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import concurrency as C
+
+
+def test_program_channel_producer_consumer(prog_scope, exe):
+    main, startup, scope = prog_scope
+    x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+    ch = C.program_make_channel(dtype="float32", capacity=2)
+
+    with C.ProgramGo():
+        # producer sub-block: a real device computation feeds the send
+        doubled = fluid.layers.scale(x, scale=2.0)
+        C.program_channel_send(ch, doubled)
+
+    got = fluid.layers.data(name="got_buf", shape=[4], dtype="float32")
+    C.program_channel_recv(ch, got)
+    out = fluid.layers.scale(got, scale=10.0)
+
+    exe.run(startup)
+    xs = np.arange(8, dtype=np.float32).reshape(2, 4)
+    res, = exe.run(main, feed={"x": xs}, fetch_list=[out])
+    np.testing.assert_allclose(np.asarray(res), xs * 20.0, rtol=1e-5)
+
+    from paddle_tpu.ops.concurrency_ops import join_go_threads
+    join_go_threads(scope)
+
+
+def test_program_channel_roundtrip_serialized(prog_scope, exe):
+    """The CSP structure lives in the ProgramDesc: serialize, reparse,
+    run — same behavior (this is exactly what the host-thread-only CSP
+    could not do)."""
+    main, startup, scope = prog_scope
+    x = fluid.layers.data(name="x", shape=[3], dtype="float32")
+    ch = C.program_make_channel(dtype="float32", capacity=1)
+    with C.ProgramGo():
+        C.program_channel_send(ch, x)
+    got = fluid.layers.data(name="got2", shape=[3], dtype="float32")
+    C.program_channel_recv(ch, got)
+    out = fluid.layers.scale(got, scale=3.0)
+
+    reparsed = fluid.Program.parse_from_string(
+        main.serialize_to_string())
+    exe.run(startup)
+    xs = np.ones((1, 3), np.float32)
+    res, = exe.run(reparsed, feed={"x": xs},
+                   fetch_list=[out.name])
+    np.testing.assert_allclose(np.asarray(res), xs * 3.0, rtol=1e-5)
+    from paddle_tpu.ops.concurrency_ops import join_go_threads
+    join_go_threads(scope)
+
+
+def test_channel_close_unblocks_recv(prog_scope, exe):
+    """close -> drained recv reports Status=False (reference
+    channel_recv:385 Status out)."""
+    main, startup, scope = prog_scope
+    ch = C.program_make_channel(dtype="float32", capacity=1)
+    C.program_channel_close(ch)
+    got = fluid.layers.data(name="g3", shape=[1], dtype="float32")
+    st = C.program_channel_recv(ch, got)
+    exe.run(startup)
+    sv, = exe.run(main, feed={}, fetch_list=[st.name])
+    assert not bool(np.asarray(sv).ravel()[0])
+
+
+def test_go_block_captures_parent_temp(prog_scope, exe):
+    """A go routine reading a temporary computed by the PARENT block
+    must capture it at launch (reference go_op X inputs) — this used to
+    deadlock: the temp lived only in the traced env, the routine died
+    on the missing var, and recv blocked forever."""
+    main, startup, scope = prog_scope
+    x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+    h = fluid.layers.scale(x, scale=5.0)  # parent-block temp
+    ch = C.program_make_channel(dtype="float32", capacity=1)
+    with C.ProgramGo():
+        C.program_channel_send(ch, h)
+    got = fluid.layers.data(name="got_t", shape=[4], dtype="float32")
+    C.program_channel_recv(ch, got)
+    exe.run(startup)
+    xs = np.arange(4, dtype=np.float32).reshape(1, 4)
+    res, = exe.run(main, feed={"x": xs}, fetch_list=[got])
+    np.testing.assert_allclose(np.asarray(res), xs * 5.0, rtol=1e-5)
+    from paddle_tpu.ops.concurrency_ops import join_go_threads
+    join_go_threads(scope)

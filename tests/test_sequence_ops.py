@@ -277,3 +277,120 @@ def test_level2_lod_feed_pads_correctly():
         got, = exe.run(main, feed={"x": lt}, fetch_list=[total])
     np.testing.assert_allclose(float(np.ravel(got)[0]), flat.sum(),
                                rtol=1e-6)
+
+
+def _lod2(seqs_nested, width):
+    """LoDTensor from nested [doc][sent] lists of [W_i, width] arrays."""
+    outer = [0]
+    inner = [0]
+    flat = []
+    for doc in seqs_nested:
+        outer.append(outer[-1] + len(doc))
+        for sent in doc:
+            inner.append(inner[-1] + len(sent))
+            flat.append(np.asarray(sent, np.float32).reshape(-1, width))
+    return LoDTensor(np.concatenate(flat, 0), [outer, inner])
+
+
+def test_level2_sequence_pool_finest_level(prog_scope, exe):
+    """sequence_pool over level-2 LoD pools each INNER sub-sequence
+    (reference finest-level semantics, lod_tensor.h:58-110 +
+    sequence_pool_op.cc).  AVERAGE makes the answer CHANGE if inner
+    padding leaks into the divisor; pinned against a host-side LoD
+    oracle."""
+    rng = np.random.RandomState(0)
+    # ragged docs: [2 sents (3, 5 toks)], [1 sent (2 toks)] — widths
+    # force real inner padding inside the [N, S, W, D] bridge
+    docs = [[rng.randn(3, 4), rng.randn(5, 4)], [rng.randn(2, 4)]]
+    lt = _lod2(docs, 4)
+
+    main, startup, scope = prog_scope
+    x = fluid.layers.data(name="x", shape=[4], dtype="float32",
+                          lod_level=2)
+    pooled = fluid.layers.sequence_pool(x, pool_type="average")
+    # second hop: outer-level pool of the per-sentence vectors -> [N, D]
+    doc_vec = fluid.layers.sequence_pool(pooled, pool_type="sum")
+    exe.run(startup)
+    got_pool, got_doc = exe.run(main, feed={"x": lt},
+                                fetch_list=[pooled, doc_vec])
+
+    # host oracle straight off the raw LoD
+    sent_means = [[np.mean(s, axis=0) for s in doc] for doc in docs]
+    got_pool = np.asarray(got_pool)
+    for i, doc in enumerate(sent_means):
+        for j, v in enumerate(doc):
+            np.testing.assert_allclose(got_pool[i, j], v, rtol=1e-5,
+                                       atol=1e-6)
+    doc_sums = np.stack([np.sum(np.stack(d, 0), 0) if d else 0
+                         for d in sent_means])
+    np.testing.assert_allclose(np.asarray(got_doc), doc_sums, rtol=1e-5,
+                               atol=1e-6)
+
+
+def test_level2_sequence_softmax_finest_level(prog_scope, exe):
+    """sequence_softmax normalizes within each inner sub-sequence —
+    pinned vs a host-side oracle on ragged level-2 data."""
+    rng = np.random.RandomState(1)
+    docs = [[rng.randn(3, 1), rng.randn(6, 1)], [rng.randn(2, 1)]]
+    lt = _lod2(docs, 1)
+
+    main, startup, scope = prog_scope
+    x = fluid.layers.data(name="x", shape=[1], dtype="float32",
+                          lod_level=2)
+    sm = fluid.layers.sequence_softmax(x)
+    exe.run(startup)
+    got, = exe.run(main, feed={"x": lt}, fetch_list=[sm])
+    got = np.asarray(got)
+    for i, doc in enumerate(docs):
+        for j, sent in enumerate(doc):
+            v = sent[:, 0]
+            e = np.exp(v - v.max())
+            np.testing.assert_allclose(got[i, j, :len(v), 0],
+                                       e / e.sum(), rtol=1e-5,
+                                       atol=1e-6)
+            # padding rows carry zero probability mass
+            np.testing.assert_allclose(got[i, j, len(v):, 0], 0,
+                                       atol=1e-7)
+    # all-padding sentences (outer padding) contribute nothing
+    np.testing.assert_allclose(got[1, 1:], 0, atol=1e-7)
+
+
+def test_level2_sequence_conv_window_stays_inside_subseq(prog_scope, exe):
+    """sequence_conv over level-2 LoD: the context window never crosses
+    an inner sub-sequence boundary (finest-level semantics,
+    sequence_conv_op.cc) — pinned against a host-side per-sentence
+    conv oracle whose answer CHANGES if windows leak across sentences
+    or into padding."""
+    rng = np.random.RandomState(2)
+    d, f = 3, 2
+    docs = [[rng.randn(4, d), rng.randn(6, d)], [rng.randn(3, d)]]
+    lt = _lod2(docs, d)
+    filt = rng.randn(3 * d, f).astype(np.float32)
+
+    main, startup, scope = prog_scope
+    x = fluid.layers.data(name="x", shape=[d], dtype="float32",
+                          lod_level=2)
+    conv = fluid.layers.sequence_conv(
+        x, num_filters=f, filter_size=3,
+        param_attr=fluid.ParamAttr(name="seqconv_w"), bias_attr=False)
+    exe.run(startup)
+    scope.set("seqconv_w", filt)
+    got, = exe.run(main, feed={"x": lt}, fetch_list=[conv])
+    got = np.asarray(got)
+
+    def oracle(sent):
+        L = len(sent)
+        out = np.zeros((L, f), np.float32)
+        for t in range(L):
+            col = []
+            for k in (-1, 0, 1):  # contextStart=-1, len 3
+                col.append(sent[t + k] if 0 <= t + k < L
+                           else np.zeros(d, np.float32))
+            out[t] = np.concatenate(col) @ filt
+        return out
+
+    for i, doc in enumerate(docs):
+        for j, sent in enumerate(doc):
+            np.testing.assert_allclose(
+                got[i, j, :len(sent)], oracle(sent.astype(np.float32)),
+                rtol=1e-4, atol=1e-5)
