@@ -1,0 +1,47 @@
+"""Native C inference API (reference paddle/capi analog).
+
+``build()`` compiles libpaddle_tpu_capi.so with g++ against the
+embedding Python (lazy, cached next to the sources — the same
+self-build pattern as the recordio C++ core).  C programs include
+``paddle_capi.h`` and link the library; see tests/test_capi.py for a
+complete C serving program driven end to end.
+"""
+from __future__ import annotations
+
+import os
+import subprocess
+import sysconfig
+
+__all__ = ["build", "header_path"]
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+
+
+def header_path():
+    return os.path.join(_DIR, "paddle_capi.h")
+
+
+def _python_embed_flags():
+    inc = sysconfig.get_path("include")
+    libdir = sysconfig.get_config_var("LIBDIR")
+    ver = sysconfig.get_config_var("LDVERSION") or \
+        sysconfig.get_config_var("VERSION")
+    return (["-I" + inc],
+            ["-L" + libdir, "-lpython" + ver,
+             "-Wl,-rpath," + libdir])
+
+
+def build(force=False):
+    """Compile (once) and return the path of libpaddle_tpu_capi.so."""
+    src = os.path.join(_DIR, "capi.cc")
+    out = os.path.join(_DIR, "libpaddle_tpu_capi.so")
+    if not force and os.path.exists(out) and \
+            os.path.getmtime(out) >= os.path.getmtime(src):
+        return out
+    cflags, ldflags = _python_embed_flags()
+    cmd = (["g++", "-O2", "-fPIC", "-shared", "-o", out, src]
+           + cflags + ldflags)
+    proc = subprocess.run(cmd, capture_output=True, text=True)
+    if proc.returncode != 0:
+        raise RuntimeError("capi build failed:\n%s" % proc.stderr[-4000:])
+    return out

@@ -1,0 +1,198 @@
+// C inference API implementation: embedded CPython hosting the
+// paddle_tpu predictor (see paddle_capi.h for the contract; reference
+// paddle/capi/ exposed the C++ GradientMachine the same way).
+//
+// Numpy arrays are built through Python calls (np.frombuffer), so no
+// numpy C headers are needed — the only build dependency is Python.h.
+#include "paddle_capi.h"
+
+#include <Python.h>
+
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <string>
+
+static std::string g_err;
+static PyObject* g_inference = nullptr;  // paddle_tpu.inference module
+static PyObject* g_np = nullptr;         // numpy module
+
+static void set_err_from_python() {
+  PyObject *type, *value, *tb;
+  PyErr_Fetch(&type, &value, &tb);
+  PyErr_NormalizeException(&type, &value, &tb);
+  PyObject* s = value ? PyObject_Str(value) : nullptr;
+  g_err = s ? PyUnicode_AsUTF8(s) : "unknown python error";
+  Py_XDECREF(s);
+  Py_XDECREF(type);
+  Py_XDECREF(value);
+  Py_XDECREF(tb);
+}
+
+extern "C" const char* pd_last_error(void) { return g_err.c_str(); }
+
+extern "C" int pd_init(const char* repo_path) {
+  if (g_inference != nullptr) return 0;
+  bool we_initialized = false;
+  if (!Py_IsInitialized()) {
+    Py_InitializeEx(0);
+    we_initialized = true;
+  }
+  PyGILState_STATE gil = PyGILState_Ensure();
+  if (repo_path != nullptr) {
+    PyObject* sys_path = PySys_GetObject("path");  // borrowed
+    PyObject* p = PyUnicode_FromString(repo_path);
+    PyList_Insert(sys_path, 0, p);
+    Py_DECREF(p);
+  }
+  g_np = PyImport_ImportModule("numpy");
+  g_inference = g_np ? PyImport_ImportModule("paddle_tpu.inference")
+                     : nullptr;
+  int rc = 0;
+  if (g_inference == nullptr) {
+    set_err_from_python();
+    rc = -1;
+  }
+  PyGILState_Release(gil);
+  if (we_initialized) {
+    // Py_InitializeEx leaves the calling thread owning the GIL; a C
+    // server that never re-enters Python from this thread would
+    // otherwise deadlock every worker's PyGILState_Ensure.  Release it
+    // — all API entry points re-acquire via PyGILState_Ensure.
+    PyEval_SaveThread();
+  }
+  return rc;
+}
+
+extern "C" pd_predictor_t pd_create_predictor(const char* model_dir,
+                                              int use_accelerator) {
+  if (g_inference == nullptr) {
+    g_err = "pd_init not called (or failed)";
+    return nullptr;
+  }
+  PyGILState_STATE gil = PyGILState_Ensure();
+  pd_predictor_t out = nullptr;
+  PyObject* cfg = nullptr;
+  PyObject* pred = nullptr;
+  PyObject* cfg_cls = PyObject_GetAttrString(g_inference, "NativeConfig");
+  if (cfg_cls != nullptr) {
+    PyObject* kwargs = Py_BuildValue("{s:s,s:O}", "model_dir", model_dir,
+                                     "use_tpu",
+                                     use_accelerator ? Py_True : Py_False);
+    PyObject* args = PyTuple_New(0);
+    cfg = PyObject_Call(cfg_cls, args, kwargs);
+    Py_DECREF(args);
+    Py_DECREF(kwargs);
+    Py_DECREF(cfg_cls);
+  }
+  if (cfg != nullptr) {
+    pred = PyObject_CallMethod(g_inference, "create_paddle_predictor",
+                               "O", cfg);
+    Py_DECREF(cfg);
+  }
+  if (pred == nullptr) {
+    set_err_from_python();
+  } else {
+    out = static_cast<pd_predictor_t>(pred);  // owned reference
+  }
+  PyGILState_Release(gil);
+  return out;
+}
+
+extern "C" int pd_predictor_run(pd_predictor_t pred_, const char** names,
+                                const float** data,
+                                const int64_t* const* shapes,
+                                const int* ndims, int n_inputs,
+                                float** out_data, int64_t (*out_shapes)[8],
+                                int* out_ndims, int* n_outputs_inout) {
+  if (pred_ == nullptr) {
+    g_err = "null predictor";
+    return -1;
+  }
+  PyObject* pred = static_cast<PyObject*>(pred_);
+  PyGILState_STATE gil = PyGILState_Ensure();
+  int rc = -1;
+  PyObject* feed = PyDict_New();
+  PyObject* outs = nullptr;
+  for (int i = 0; i < n_inputs && feed != nullptr; i++) {
+    int64_t numel = 1;
+    for (int d = 0; d < ndims[i]; d++) numel *= shapes[i][d];
+    PyObject* bytes = PyBytes_FromStringAndSize(
+        reinterpret_cast<const char*>(data[i]),
+        static_cast<Py_ssize_t>(numel * sizeof(float)));
+    PyObject* flat =
+        bytes ? PyObject_CallMethod(g_np, "frombuffer", "Os", bytes,
+                                    "float32")
+              : nullptr;
+    Py_XDECREF(bytes);
+    PyObject* shape = PyTuple_New(ndims[i]);
+    for (int d = 0; d < ndims[i]; d++) {
+      PyTuple_SET_ITEM(shape, d, PyLong_FromLongLong(shapes[i][d]));
+    }
+    PyObject* arr =
+        flat ? PyObject_CallMethod(flat, "reshape", "O", shape) : nullptr;
+    Py_XDECREF(flat);
+    Py_DECREF(shape);
+    if (arr == nullptr) {
+      set_err_from_python();
+      Py_DECREF(feed);
+      feed = nullptr;
+      break;
+    }
+    PyDict_SetItemString(feed, names[i], arr);
+    Py_DECREF(arr);
+  }
+  if (feed != nullptr) {
+    outs = PyObject_CallMethod(pred, "run", "O", feed);
+    Py_DECREF(feed);
+  }
+  if (outs != nullptr) {
+    Py_ssize_t n = PySequence_Length(outs);
+    if (n > *n_outputs_inout) n = *n_outputs_inout;
+    rc = 0;
+    for (Py_ssize_t j = 0; j < n && rc == 0; j++) {
+      PyObject* t = PySequence_GetItem(outs, j);
+      PyObject* arr = t ? PyObject_GetAttrString(t, "data") : nullptr;
+      Py_XDECREF(t);
+      PyObject* f32 =
+          arr ? PyObject_CallMethod(arr, "astype", "s", "float32")
+              : nullptr;
+      Py_XDECREF(arr);
+      PyObject* shp = f32 ? PyObject_GetAttrString(f32, "shape") : nullptr;
+      PyObject* buf = f32 ? PyObject_CallMethod(f32, "tobytes", nullptr)
+                          : nullptr;
+      if (shp == nullptr || buf == nullptr) {
+        set_err_from_python();
+        rc = -2;
+      } else {
+        int nd = static_cast<int>(PyTuple_Size(shp));
+        out_ndims[j] = nd;
+        for (int d = 0; d < nd && d < 8; d++) {
+          out_shapes[j][d] =
+              PyLong_AsLongLong(PyTuple_GetItem(shp, d));
+        }
+        Py_ssize_t len = PyBytes_Size(buf);
+        out_data[j] = static_cast<float*>(malloc(len));
+        memcpy(out_data[j], PyBytes_AsString(buf), len);
+      }
+      Py_XDECREF(shp);
+      Py_XDECREF(buf);
+      Py_XDECREF(f32);
+    }
+    *n_outputs_inout = static_cast<int>(n);
+    Py_DECREF(outs);
+  } else if (rc != 0) {
+    set_err_from_python();
+  }
+  PyGILState_Release(gil);
+  return rc;
+}
+
+extern "C" void pd_predictor_destroy(pd_predictor_t pred) {
+  if (pred == nullptr) return;
+  PyGILState_STATE gil = PyGILState_Ensure();
+  Py_DECREF(static_cast<PyObject*>(pred));
+  PyGILState_Release(gil);
+}
+
+extern "C" void pd_free(void* buf) { free(buf); }

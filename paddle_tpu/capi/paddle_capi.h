@@ -1,0 +1,63 @@
+/* Plain-C inference API — the reference paddle/capi/capi.h analog.
+ *
+ * A C (or C++/Rust/Go) server links libpaddle_tpu_capi.so, loads a model
+ * directory written by fluid.io.save_inference_model (including its AOT
+ * pre-compiled executable when present) and serves it without writing a
+ * line of Python.  The implementation embeds the CPython runtime hosting
+ * the paddle_tpu predictor (capi.cc); on TPU hosts the heavy lifting is
+ * the serialized XLA executable, so the embedded interpreter is a thin
+ * dispatcher, exactly the role the reference's C++ NativePredictor
+ * played around its kernel registry.
+ *
+ * All functions return 0 on success, negative on failure (call
+ * pd_last_error() for the message).  float32 tensors only — the
+ * reference C API's paddle_matrix was float-only too.
+ */
+#ifndef PADDLE_TPU_CAPI_H
+#define PADDLE_TPU_CAPI_H
+
+#include <stdint.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+typedef void* pd_predictor_t;
+
+/* Initialize the runtime (idempotent).  repo_path: directory holding
+ * the paddle_tpu package (prepended to the module search path); NULL
+ * uses the environment's Python path as-is. */
+int pd_init(const char* repo_path);
+
+/* Load a saved inference model directory.  use_accelerator != 0 places
+ * the predictor on the attached accelerator, 0 on host CPU. */
+pd_predictor_t pd_create_predictor(const char* model_dir,
+                                   int use_accelerator);
+
+/* Run one batch.
+ *   names[i]          feed variable name
+ *   data[i]           float32 buffer, C-order
+ *   shapes[i][0..ndims[i]-1]  dims of input i
+ * Outputs: the model's fetch targets in order.  For output j,
+ * out_data[j] receives a malloc'd float32 buffer (caller frees with
+ * pd_free), out_shapes[j] receives up to 8 dims, out_ndims[j] the rank.
+ * n_outputs_inout: capacity in, actual count out. */
+int pd_predictor_run(pd_predictor_t pred,
+                     const char** names,
+                     const float** data,
+                     const int64_t* const* shapes,
+                     const int* ndims,
+                     int n_inputs,
+                     float** out_data,
+                     int64_t (*out_shapes)[8],
+                     int* out_ndims,
+                     int* n_outputs_inout);
+
+void pd_predictor_destroy(pd_predictor_t pred);
+void pd_free(void* buf);
+const char* pd_last_error(void);
+
+#ifdef __cplusplus
+}
+#endif
+#endif /* PADDLE_TPU_CAPI_H */

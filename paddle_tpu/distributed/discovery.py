@@ -116,11 +116,13 @@ class FileLock:
     holder heartbeats the lock file; a candidate steals it when the
     heartbeat is older than ttl (the holder crashed)."""
 
-    def __init__(self, path, ttl=DEFAULT_TTL):
+    def __init__(self, path, ttl=DEFAULT_TTL, on_lost=None):
         self.path = path
         self.ttl = float(ttl)
         self._stop = None
         self.token = "%d.%d" % (os.getpid(), threading.get_ident())
+        self.lost = False      # set when another holder stole the lock
+        self._on_lost = on_lost
 
     def try_acquire(self):
         os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
@@ -180,12 +182,27 @@ class FileLock:
     def _heartbeat(self):
         stop = threading.Event()
         self._stop = stop
+        self.lost = False
 
         def beat():
             while not stop.wait(self.ttl / 3.0):
+                # verify we STILL hold it before touching: a holder that
+                # stalled past ttl may have been stolen from — blindly
+                # utime-ing the new holder's file would hide the loss
+                # and leave two live leaders (split brain)
                 try:
+                    with open(self.path) as f:
+                        if f.read() != self.token:
+                            raise OSError("stolen")
                     os.utime(self.path)
                 except OSError:
+                    self.lost = True
+                    cb = self._on_lost
+                    if cb is not None:
+                        try:
+                            cb()
+                        except Exception:
+                            pass
                     return
 
         threading.Thread(target=beat, daemon=True).start()
@@ -219,7 +236,10 @@ class MasterHA:
 
         ttl = DEFAULT_TTL if ttl is None else ttl
         self.registry = EndpointRegistry(root, ttl=ttl)
-        self.lock = FileLock(os.path.join(root, "master.lock"), ttl=ttl)
+        # fencing: if another master steals the (stale) lock while this
+        # one is stalled, stop serving the moment the beat notices
+        self.lock = FileLock(os.path.join(root, "master.lock"), ttl=ttl,
+                             on_lost=self._on_leadership_lost)
         self.endpoint = endpoint
         master_kwargs.setdefault("snapshot_path",
                                  os.path.join(root, "master.snapshot"))
@@ -238,6 +258,10 @@ class MasterHA:
         self.server.start(self.endpoint)
         self.registry.register(self.KIND, self.endpoint)
         return self
+
+    def _on_leadership_lost(self):
+        self.registry.unregister(self.KIND, self.endpoint)
+        self.server.stop()
 
     def stop(self):
         self.registry.unregister(self.KIND, self.endpoint)
@@ -273,13 +297,20 @@ class HAMasterClient:
         return self._client
 
     def _retry(self, fn, *args, **kwargs):
+        try:
+            import grpc
+            transient = (grpc.RpcError, ConnectionError, OSError,
+                         TimeoutError)
+        except ImportError:
+            transient = (ConnectionError, OSError, TimeoutError)
         deadline = time.time() + self.timeout
         while True:
             try:
                 return fn(self._ensure(), *args, **kwargs)
-            except Exception:
+            except transient:
                 # master gone (or not up yet): drop the channel, wait
-                # for a (possibly new) one to register, try again
+                # for a (possibly new) one to register, try again —
+                # programming errors (TypeError &c.) surface at once
                 self._client = None
                 if time.time() > deadline:
                     raise

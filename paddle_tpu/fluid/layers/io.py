@@ -21,7 +21,7 @@ from paddle_tpu.core.types import VarKind
 
 __all__ = ["data", "open_recordio_file", "open_files",
            "random_data_generator", "shuffle", "batch", "double_buffer",
-           "multi_pass", "threaded", "read_file"]
+           "multi_pass", "threaded", "Preprocessor", "read_file"]
 
 
 def data(name, shape, append_batch_size=True, dtype="float32", lod_level=0,
@@ -208,3 +208,88 @@ def read_file(reader):
     if len(outs) == 1:
         return outs[0]
     return outs
+
+
+class Preprocessor:
+    """Per-batch preprocessing sub-block over a decorated reader
+    (reference layers/io.py Preprocessor:587 + create_custom_reader_op):
+
+        p = Preprocessor(reader)
+        with p.block():
+            img, lbl = p.inputs()
+            p.outputs(some_layers(img), lbl)
+        reader = p()
+    """
+
+    def __init__(self, reader, name=None):
+        self.underlying = reader
+        self.main_prog = default_main_program()
+        self.sub_block = None
+        self.source_var_names = None
+        self.sink_var_names = None
+        self._sink_shapes = None
+        self._in_block = False
+
+    def block(self):
+        import contextlib
+
+        @contextlib.contextmanager
+        def guard():
+            self._in_block = True
+            self.sub_block = self.main_prog.create_block()
+            try:
+                yield
+            finally:
+                # rollback even when the body raises: leaving the
+                # program pointed at the orphaned sub-block would eat
+                # every op built afterwards
+                self.main_prog.rollback()
+                self._in_block = False
+            if not (self.sub_block is not None and self.source_var_names
+                    and self.sink_var_names):
+                raise RuntimeError(
+                    "incomplete Preprocessor: call inputs() and "
+                    "outputs() inside the block")
+
+        return guard()
+
+    def inputs(self):
+        if not self._in_block:
+            raise RuntimeError("Preprocessor.inputs() belongs inside "
+                               "the block()")
+        blk = self.main_prog.current_block()
+        self.source_var_names = []
+        vars_ = []
+        for shape, dtype in zip(self.underlying._reader_shapes,
+                                self.underlying._reader_dtypes):
+            name = unique_name.generate("preprocessor_source")
+            self.source_var_names.append(name)
+            vars_.append(blk.create_var(name=name, shape=shape,
+                                        dtype=dtype))
+        return vars_
+
+    def outputs(self, *outs):
+        if not self._in_block:
+            raise RuntimeError("Preprocessor.outputs() belongs inside "
+                               "the block()")
+        self.sink_var_names = [v.name for v in outs]
+        self._sink_shapes = [list(getattr(v, "shape", [0]) or [0])
+                             for v in outs]
+        self._sink_dtypes = [str(getattr(v, "dtype", "float32"))
+                             for v in outs]
+
+    def __call__(self):
+        name = unique_name.generate("create_custom_reader")
+        main = self.main_prog
+        out = _reader_var(main.current_block(), name,
+                          self._sink_shapes, self._sink_dtypes,
+                          [0] * len(self._sink_shapes))
+        main.current_block().append_op(
+            type="create_custom_reader",
+            inputs={"UnderlyingReader": [self.underlying.name]},
+            outputs={"Out": [name]},
+            attrs={"sub_block": self.sub_block.idx,
+                   "source_var_names": list(self.source_var_names),
+                   "sink_var_names": list(self.sink_var_names)},
+            infer_shape=False)
+        return out

@@ -165,11 +165,29 @@ class Trainer:
         port = os.getenv("PADDLE_PSERVER_PORT", "6174")
         pserver_ips = os.getenv("PADDLE_PSERVER_IPS", "")
         eps = [ip + ":" + port for ip in pserver_ips.split(",") if ip]
+        # Dynamic discovery (reference go/pserver/etcd_client.go:
+        # pservers register, trainers watch): PADDLE_DISCOVERY_ROOT
+        # names a shared registry dir; with PADDLE_PSERVERS_EXPECTED
+        # set, the static IP list is replaced by whatever registered.
+        disc_root = os.getenv("PADDLE_DISCOVERY_ROOT")
+        expected = int(os.getenv("PADDLE_PSERVERS_EXPECTED", "0"))
+        role = os.getenv("PADDLE_TRAINING_ROLE")
+        if disc_root and expected:
+            from paddle_tpu.distributed.discovery import EndpointRegistry
+
+            registry = EndpointRegistry(disc_root)
+            if role == "PSERVER":
+                registry.register(
+                    "pserver",
+                    os.getenv("PADDLE_CURRENT_IP", "") + ":" + port)
+            eps = registry.wait_for(
+                "pserver", expected,
+                timeout=float(os.getenv("PADDLE_DISCOVERY_TIMEOUT",
+                                        "60")))
         pserver_endpoints = ",".join(eps)
         trainers = int(os.getenv("PADDLE_TRAINERS", "1"))
         current_endpoint = os.getenv("PADDLE_CURRENT_IP", "") + ":" + port
         self.trainer_id = int(os.getenv("PADDLE_TRAINER_ID", "0"))
-        role = os.getenv("PADDLE_TRAINING_ROLE")
         with self._prog_and_scope_guard():
             t = DistributeTranspiler()
             t.transpile(self.trainer_id, program=self.train_program,

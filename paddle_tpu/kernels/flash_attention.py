@@ -166,6 +166,14 @@ def _flash_bwd(scale, causal, block_q, block_k, interpret, res, g):
     # from.
     bq = _fit_block(min(block_q, 512), q.shape[2])
     bk = _fit_block(min(block_k, 512), k.shape[2])
+    # _fit_block stops halving at 8 even when 8 doesn't divide (e.g.
+    # T=1002): a non-dividing tile would silently drop the tail rows of
+    # the grid, so fall back to the forward's blocks, which divide by
+    # construction (the kernel path was only taken because they do)
+    if q.shape[2] % bq:
+        bq = block_q
+    if k.shape[2] % bk:
+        bk = block_k
     dq = _flash_bwd_dq(q, k, v, do, lse, delta, scale, causal, bq,
                        bk, interpret)
     dk, dv = _flash_bwd_dkv(q, k, v, do, lse, delta, scale, causal,

@@ -259,10 +259,10 @@ class _ThreadedReader(_ReaderBase):
         if isinstance(item, Exception):
             with self._lock:
                 self._thread = None
-            if isinstance(item, EOFException):
-                # re-enqueue the sentinel so EVERY blocked consumer
-                # sees end-of-stream, not just the first one to pop
-                q.put(item)
+            # re-enqueue terminal items (EOF or an error) so EVERY
+            # blocked consumer sees them, not just the first to pop —
+            # the worker has exited and will produce nothing else
+            q.put(item)
             raise item
         return item
 
@@ -279,6 +279,42 @@ class _ThreadedReader(_ReaderBase):
                         pass
                     thread.join(timeout=0.05)
             self.parent.reset()
+
+
+class _CustomReader(_ReaderBase):
+    """Per-batch preprocessing through a fluid sub-block (reference
+    create_custom_reader_op.cc CustomReader::ReadNext): each batch's
+    slots land in the source vars, the sub-block runs through a nested
+    executor, and the sink vars come back as the decorated batch."""
+
+    def __init__(self, parent, program, block_id, source_names,
+                 sink_names, place, scope):
+        from paddle_tpu.core.executor_impl import ExecutorCore
+
+        self.parent = parent
+        self.program = program
+        self.block_id = int(block_id)
+        self.source_names = list(source_names)
+        self.sink_names = list(sink_names)
+        self._core = ExecutorCore(place)
+        # kid scope of the RUN scope (reference CustomReader executes in
+        # the run scope): a parameterized sub-block (fc etc.) must see
+        # the weights the startup program initialized
+        self._scope = scope.new_scope()
+
+    def next(self):
+        batch = self.parent.next()
+        if len(batch) != len(self.source_names):
+            raise ValueError(
+                "custom reader: batch has %d slots but %d source vars"
+                % (len(batch), len(self.source_names)))
+        feed = dict(zip(self.source_names, batch))
+        outs = self._core.run(self.program, self._scope, self.block_id,
+                              feed=feed, fetch_list=self.sink_names)
+        return tuple(np.asarray(o) for o in outs)
+
+    def reset(self):
+        self.parent.reset()
 
 
 def _set_state(scope, name, state):
@@ -455,6 +491,22 @@ def _create_random(executor, op, scope, feed, env=None):
         i += r
     _set_state(scope, op.output("Out")[0],
                _RandomDataReader(op.attr("low"), op.attr("high"), shapes))
+
+
+@_host("create_custom_reader")
+def _create_custom(executor, op, scope, feed, env=None):
+    out = op.output("Out")[0]
+    if scope.has_var(out) and isinstance(scope.find_var(out),
+                                         _CustomReader):
+        return  # main-block op: idempotent across steps
+    parent = _get_state(scope, op.input("UnderlyingReader")[0])
+    block_id = op.attr("sub_block")
+    if hasattr(block_id, "idx"):
+        block_id = block_id.idx
+    _set_state(scope, out, _CustomReader(
+        parent, executor._current_program, block_id,
+        op.attr("source_var_names") or [],
+        op.attr("sink_var_names") or [], executor.place, scope))
 
 
 @_host("create_multi_pass_reader")

@@ -1,0 +1,80 @@
+/* C serving program for the capi test: loads a saved model dir, runs
+ * one batch, prints the first output tensor as CSV on stdout.
+ * Usage: capi_main <repo_path> <model_dir> <feed_name> <n> <d>
+ * Feeds an [n, d] float32 ramp (i*0.01). */
+#include <stdio.h>
+#include <stdlib.h>
+
+#include "paddle_capi.h"
+
+int main(int argc, char** argv) {
+  if (argc != 6) {
+    fprintf(stderr, "usage: %s repo model_dir feed n d\n", argv[0]);
+    return 2;
+  }
+  const char* repo = argv[1];
+  const char* model_dir = argv[2];
+  const char* feed_name = argv[3];
+  int n = atoi(argv[4]);
+  int d = atoi(argv[5]);
+
+  if (pd_init(repo) != 0) {
+    fprintf(stderr, "pd_init: %s\n", pd_last_error());
+    return 3;
+  }
+  pd_predictor_t pred = pd_create_predictor(model_dir, 0);
+  if (pred == NULL) {
+    fprintf(stderr, "create: %s\n", pd_last_error());
+    return 4;
+  }
+
+  float* input = (float*)malloc(sizeof(float) * n * d);
+  for (int i = 0; i < n * d; i++) input[i] = 0.01f * (float)i;
+  int64_t shape[2];
+  shape[0] = n;
+  shape[1] = d;
+  const char* names[1];
+  const float* datas[1];
+  const int64_t* shapes[1];
+  int ndims[1];
+  names[0] = feed_name;
+  datas[0] = input;
+  shapes[0] = shape;
+  ndims[0] = 2;
+
+  float* out_data[4];
+  int64_t out_shapes[4][8];
+  int out_ndims[4];
+  int n_out = 4;
+  int rc = pd_predictor_run(pred, names, datas, shapes, ndims, 1,
+                            out_data, out_shapes, out_ndims, &n_out);
+  if (rc != 0) {
+    fprintf(stderr, "run: %s\n", pd_last_error());
+    return 5;
+  }
+  /* second run through the same (AOT) executable — repeatability */
+  float* out2[4];
+  int64_t shp2[4][8];
+  int nd2[4];
+  int n2 = 4;
+  rc = pd_predictor_run(pred, names, datas, shapes, ndims, 1, out2, shp2,
+                        nd2, &n2);
+  if (rc != 0) {
+    fprintf(stderr, "run2: %s\n", pd_last_error());
+    return 6;
+  }
+
+  int64_t numel = 1;
+  for (int i = 0; i < out_ndims[0]; i++) numel *= out_shapes[0][i];
+  for (int64_t i = 0; i < numel; i++) {
+    if (out_data[0][i] != out2[0][i]) {
+      fprintf(stderr, "runs disagree at %lld\n", (long long)i);
+      return 7;
+    }
+    printf(i + 1 < numel ? "%.6f," : "%.6f\n", (double)out_data[0][i]);
+  }
+  for (int j = 0; j < n_out; j++) pd_free(out_data[j]);
+  for (int j = 0; j < n2; j++) pd_free(out2[j]);
+  pd_predictor_destroy(pred);
+  return 0;
+}

@@ -251,3 +251,47 @@ def test_lock_steal_is_single_winner(tmp_path):
     assert a.try_acquire()
     assert not b.try_acquire()
     a.release()
+
+
+def test_trainer_discovers_pservers_via_registry(tmp_path, monkeypatch):
+    """Trainer._dist_transpile_if_necessary resolves pserver endpoints
+    from the discovery registry when PADDLE_DISCOVERY_ROOT +
+    PADDLE_PSERVERS_EXPECTED are set (reference
+    go/pserver/etcd_client.go registration/watch), instead of the
+    static IP list."""
+    from paddle_tpu.distributed.discovery import EndpointRegistry
+
+    root = str(tmp_path / "disc")
+    reg = EndpointRegistry(root)
+    reg.register("pserver", "10.0.0.1:6174", heartbeat=False)
+    reg.register("pserver", "10.0.0.2:6174", heartbeat=False)
+
+    captured = {}
+
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.fluid import trainer as trainer_mod
+
+    class FakeTranspiler:
+        def transpile(self, tid, program=None, startup_program=None,
+                      pservers=None, trainers=None):
+            captured["pservers"] = pservers
+
+        def get_trainer_program(self):
+            return fluid.Program()
+
+    monkeypatch.setattr(trainer_mod, "DistributeTranspiler",
+                        FakeTranspiler)
+    monkeypatch.setenv("PADDLE_TRAINING_ROLE", "TRAINER")
+    monkeypatch.setenv("PADDLE_DISCOVERY_ROOT", root)
+    monkeypatch.setenv("PADDLE_PSERVERS_EXPECTED", "2")
+    monkeypatch.setenv("PADDLE_TRAINER_ID", "0")
+    monkeypatch.setenv("PADDLE_TRAINERS", "1")
+    monkeypatch.delenv("PADDLE_PSERVER_IPS", raising=False)
+
+    t = trainer_mod.Trainer.__new__(trainer_mod.Trainer)
+    t.train_program = fluid.Program()
+    t.startup_program = fluid.Program()
+    t.scope = fluid.Scope()
+    t.checkpoint_cfg = None
+    t._dist_transpile_if_necessary()
+    assert captured["pservers"] == "10.0.0.1:6174,10.0.0.2:6174"
