@@ -34,14 +34,25 @@ def _python_embed_flags():
 def build(force=False):
     """Compile (once) and return the path of libpaddle_tpu_capi.so."""
     src = os.path.join(_DIR, "capi.cc")
+    hdr = header_path()
     out = os.path.join(_DIR, "libpaddle_tpu_capi.so")
+    newest_src = max(os.path.getmtime(src), os.path.getmtime(hdr))
     if not force and os.path.exists(out) and \
-            os.path.getmtime(out) >= os.path.getmtime(src):
+            os.path.getmtime(out) >= newest_src:
         return out
     cflags, ldflags = _python_embed_flags()
-    cmd = (["g++", "-O2", "-fPIC", "-shared", "-o", out, src]
+    # tmp + rename (the recordio self-build pattern): a concurrent
+    # builder or an interrupted compile must never leave a half-written
+    # .so at the final path
+    tmp = out + ".%d.tmp" % os.getpid()
+    cmd = (["g++", "-O2", "-fPIC", "-shared", "-o", tmp, src]
            + cflags + ldflags)
     proc = subprocess.run(cmd, capture_output=True, text=True)
     if proc.returncode != 0:
+        try:
+            os.remove(tmp)
+        except FileNotFoundError:
+            pass
         raise RuntimeError("capi build failed:\n%s" % proc.stderr[-4000:])
+    os.replace(tmp, out)
     return out

@@ -145,11 +145,15 @@ extern "C" int pd_predictor_run(pd_predictor_t pred_, const char** names,
   if (feed != nullptr) {
     outs = PyObject_CallMethod(pred, "run", "O", feed);
     Py_DECREF(feed);
+    if (outs == nullptr) {
+      set_err_from_python();  // record the run() failure HERE, while
+    }                         // the Python exception is still pending
   }
   if (outs != nullptr) {
     Py_ssize_t n = PySequence_Length(outs);
     if (n > *n_outputs_inout) n = *n_outputs_inout;
     rc = 0;
+    Py_ssize_t produced = 0;
     for (Py_ssize_t j = 0; j < n && rc == 0; j++) {
       PyObject* t = PySequence_GetItem(outs, j);
       PyObject* arr = t ? PyObject_GetAttrString(t, "data") : nullptr;
@@ -161,29 +165,42 @@ extern "C" int pd_predictor_run(pd_predictor_t pred_, const char** names,
       PyObject* shp = f32 ? PyObject_GetAttrString(f32, "shape") : nullptr;
       PyObject* buf = f32 ? PyObject_CallMethod(f32, "tobytes", nullptr)
                           : nullptr;
+      int nd = shp ? static_cast<int>(PyTuple_Size(shp)) : 0;
       if (shp == nullptr || buf == nullptr) {
         set_err_from_python();
         rc = -2;
+      } else if (nd > 8) {
+        g_err = "output rank > 8 unsupported by the C API";
+        rc = -3;
       } else {
-        int nd = static_cast<int>(PyTuple_Size(shp));
         out_ndims[j] = nd;
-        for (int d = 0; d < nd && d < 8; d++) {
+        for (int d = 0; d < nd; d++) {
           out_shapes[j][d] =
               PyLong_AsLongLong(PyTuple_GetItem(shp, d));
         }
         Py_ssize_t len = PyBytes_Size(buf);
         out_data[j] = static_cast<float*>(malloc(len));
         memcpy(out_data[j], PyBytes_AsString(buf), len);
+        produced++;
       }
       Py_XDECREF(shp);
       Py_XDECREF(buf);
       Py_XDECREF(f32);
     }
-    *n_outputs_inout = static_cast<int>(n);
+    if (rc == 0) {
+      *n_outputs_inout = static_cast<int>(n);
+    } else {
+      // contract on failure: nothing is handed to the caller — free
+      // the buffers already produced so a rc<0 path neither leaks nor
+      // exposes uninitialized pointers
+      for (Py_ssize_t j = 0; j < produced; j++) free(out_data[j]);
+      *n_outputs_inout = 0;
+    }
     Py_DECREF(outs);
-  } else if (rc != 0) {
-    set_err_from_python();
   }
+  // when outs == nullptr the error (run failure OR feed-construction
+  // failure) was already recorded by set_err_from_python above; do not
+  // fetch again — a cleared error would overwrite the real message
   PyGILState_Release(gil);
   return rc;
 }

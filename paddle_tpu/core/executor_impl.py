@@ -235,12 +235,17 @@ class ExecutorCore:
                 vd = block.find_var_recursive(name)
                 if vd is not None and not hasattr(val, "dtype"):
                     val = np.asarray(val, dtype=proto_to_np_dtype(vd.dtype))
-                args.append(_put(val, target))
+                args.append(_put(val, target, local_rows=True))
             else:
                 # Always commit to the target device: mixing committed and
                 # uncommitted arrays across steps would miss jit's C++ cache
                 # and recompile (device_put is a no-op when already there).
-                args.append(_put(scope.find_var(name), target))
+                # reader-op batches in the scope are per-process LOCAL
+                # rows, not global values (see reader_ops._read)
+                args.append(_put(
+                    scope.find_var(name), target,
+                    local_rows=name in getattr(scope,
+                                               "_reader_batch_vars", ())))
         seed, counter = self._rng_counter(program, scope)
 
         fetches, persists = entry.fn(tuple(args), seed, counter)
@@ -525,16 +530,25 @@ def _to_host_numpy(v):
     return np.asarray(v)
 
 
-def _put(val, target):
+def _put(val, target, local_rows=False):
     """device_put that tolerates Format targets and multi-host shardings.
 
     Multi-host (jax.distributed) shardings span devices this process
-    cannot address; host values are assembled with
-    ``make_array_from_process_local_data`` — batch-sharded feeds carry
-    each process's LOCAL rows (the reference nccl2 contract: every
-    trainer feeds its own batch, parallel_executor.cc:84-95) and
-    replicated values carry the full array.  Already-global jax.Arrays
-    (last step's persistables) pass through untouched.
+    cannot address; host values carry one of two semantics:
+
+    - ``local_rows=True`` (feeds): the value is this process's LOCAL
+      batch shard (the reference nccl2 contract: every trainer feeds
+      its own batch, parallel_executor.cc:84-95) — assembled with
+      ``make_array_from_process_local_data``.
+    - ``local_rows=False`` (scope values): the value is the FULL global
+      array, identical in every process (deterministic startup); each
+      process materializes its addressable shards from it via
+      ``make_array_from_callback`` — which is also what makes SHARDED
+      (tensor-parallel) parameters work across hosts, where treating
+      the full value as a local shard would double the global shape.
+
+    Already-global jax.Arrays (last step's persistables) pass through
+    untouched.
 
     Format targets: the TPU runtime here rejects device_put of a
     jax.Array onto a Format EVEN when the array already has exactly that
@@ -551,7 +565,11 @@ def _put(val, target):
             val = np.asarray(val)  # local array -> rebuild globally
         elif not isinstance(val, np.ndarray):
             val = np.asarray(val)  # scope value / list / scalar
-        return jax.make_array_from_process_local_data(target, val)
+        if local_rows:
+            return jax.make_array_from_process_local_data(target, val)
+        full = val
+        return jax.make_array_from_callback(
+            full.shape, target, lambda idx: full[idx])
     fmt_layout = getattr(target, "layout", None)
     if fmt_layout is not None and isinstance(val, jax.Array):
         try:

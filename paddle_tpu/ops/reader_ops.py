@@ -561,5 +561,11 @@ def _read(executor, op, scope, feed, env=None):
         if env is not None:
             env[name] = val
         # data vars go in the scope so the compiled core block (which
-        # runs after this prelude host op) picks them up as inputs
+        # runs after this prelude host op) picks them up as inputs;
+        # they are tagged as LOCAL-row data — on a multi-host mesh a
+        # reader batch is this process's shard, not a replicated global
+        # value (executor_impl._put local_rows semantics)
         (scope.find_scope_of(name) or scope).set(name, val)
+        if not hasattr(scope, "_reader_batch_vars"):
+            scope._reader_batch_vars = set()
+        scope._reader_batch_vars.add(name)

@@ -10,7 +10,8 @@ startup, so env set inside the worker would be too late.
 import numpy as np
 
 
-def _build_and_train(num_trainers, trainer_id, steps=3):
+def _build_and_train(num_trainers, trainer_id, steps=3, mesh_axes=None,
+                     tp=False):
     """Tiny deterministic regression program trained with the SPMD
     ParallelExecutor; returns (losses, n_global_devices).
 
@@ -39,6 +40,8 @@ def _build_and_train(num_trainers, trainer_id, steps=3):
 
     main, startup = fluid.Program(), fluid.Program()
     scope = Scope()
+    col = fluid.param_attr.ParamAttr(sharding=(None, "tp")) if tp else None
+    row = fluid.param_attr.ParamAttr(sharding=("tp", None)) if tp else None
     with fluid.scope_guard(scope):
         with fluid.program_guard(main, startup):
             with fluid.unique_name.guard():
@@ -46,15 +49,17 @@ def _build_and_train(num_trainers, trainer_id, steps=3):
                                       dtype="float32")
                 y = fluid.layers.data(name="y", shape=[1],
                                       dtype="float32")
-                h = fluid.layers.fc(x, size=8, act="tanh")
-                pred = fluid.layers.fc(h, size=1)
+                h = fluid.layers.fc(x, size=8, act="tanh",
+                                    param_attr=col)
+                pred = fluid.layers.fc(h, size=1, param_attr=row)
                 loss = fluid.layers.mean(
                     fluid.layers.square_error_cost(pred, y))
                 fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
         fluid.Executor(fluid.CPUPlace()).run(startup)
         pe = fluid.ParallelExecutor(
             use_tpu=False, loss_name=loss.name, main_program=main,
-            scope=scope, num_trainers=num_trainers, trainer_id=trainer_id)
+            scope=scope, num_trainers=num_trainers, trainer_id=trainer_id,
+            mesh_axes=mesh_axes)
         losses = []
         for _ in range(steps):
             out, = pe.run(feed={x.name: x_local, y.name: y_local},
@@ -78,3 +83,22 @@ def trainer_worker(i, q):
         q.put(("trainer%d" % i,) + _build_and_train(2, i))
     except Exception as e:
         q.put(("trainer%d" % i, "ERROR: %r" % e, 0))
+
+
+def trainer_worker_tp(i, q):
+    """dp=2 x tp=4 over two processes: tensor-parallel parameter shards
+    span hosts; each process contributes its addressable shards of the
+    full (deterministically initialized) value."""
+    try:
+        q.put(("tp%d" % i,) + _build_and_train(
+            2, i, mesh_axes={"dp": 2, "tp": 4}, tp=True))
+    except Exception as e:
+        q.put(("tp%d" % i, "ERROR: %r" % e, 0))
+
+
+def baseline_worker_tp(q):
+    try:
+        q.put(("tpbase",) + _build_and_train(
+            1, 0, mesh_axes={"dp": 2, "tp": 4}, tp=True))
+    except Exception as e:
+        q.put(("tpbase", "ERROR: %r" % e, 0))

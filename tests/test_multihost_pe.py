@@ -105,3 +105,56 @@ def test_two_process_pe_matches_single_process():
     assert np.allclose(base, t0, atol=1e-5), (base, t0)
     # and training actually trains
     assert base[-1] < base[0]
+
+
+@pytest.mark.timeout(300)
+def test_two_process_pe_with_tensor_parallel_params():
+    """dp=2 x tp=4 mesh spanning two processes: TENSOR-PARALLEL weight
+    shards cross the host boundary — each process materializes its
+    addressable shards from the full deterministic init
+    (executor_impl._put global-value semantics).  Losses must match a
+    single-process run of the same mesh."""
+    from tests import multihost_helpers as H
+
+    ctx = mp.get_context("spawn")
+    q = ctx.Queue()
+    procs = []
+
+    with _child_env(JAX_PLATFORMS="cpu",
+                    XLA_FLAGS="--xla_force_host_platform_device_count=8",
+                    PALLAS_AXON_POOL_IPS=None,
+                    PADDLE_TRAINER_ENDPOINTS=None,
+                    PADDLE_TRAINER_ID=None):
+        procs.append(ctx.Process(target=H.baseline_worker_tp, args=(q,)))
+        procs[-1].start()
+
+    port = _free_port()
+    eps = "127.0.0.1:%d,127.0.0.1:%d" % (port, port + 1)
+    for i in range(2):
+        with _child_env(
+                JAX_PLATFORMS="cpu",
+                XLA_FLAGS="--xla_force_host_platform_device_count=4",
+                PALLAS_AXON_POOL_IPS=None,
+                PADDLE_TRAINER_ENDPOINTS=eps,
+                PADDLE_TRAINER_ID=str(i)):
+            procs.append(ctx.Process(target=H.trainer_worker_tp,
+                                     args=(i, q)))
+            procs[-1].start()
+
+    try:
+        results = {}
+        for _ in range(3):
+            tag, losses, ndev = q.get(timeout=240)
+            results[tag] = (losses, ndev)
+    finally:
+        for p in procs:
+            p.join(timeout=30)
+            if p.is_alive():
+                p.terminate()
+
+    for tag, (losses, _) in results.items():
+        assert not isinstance(losses, str), (tag, losses)
+    base = results["tpbase"][0]
+    t0, t1 = results["tp0"][0], results["tp1"][0]
+    assert np.allclose(t0, t1, atol=1e-6), (t0, t1)
+    assert np.allclose(base, t0, atol=1e-5), (base, t0)
