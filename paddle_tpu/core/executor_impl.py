@@ -335,13 +335,19 @@ class ExecutorCore:
 
             axis_names = set(self.mesh.axis_names)
 
+            reader_vars = getattr(scope, "_reader_batch_vars", ())
+
             def shard_of(name):
                 if name in annotated:
                     spec = tuple(a if a in axis_names else None
                                  for a in annotated[name])
                     return NamedSharding(self.mesh, P(*spec))
                 vd = block.find_var_recursive(name)
-                if (name in feed and vd is not None and len(vd.shape) >= 1
+                # batch-dim data shards over dp whether it arrives as a
+                # feed or from a program-level reader chain (the read
+                # host op tags its outputs in the scope)
+                if ((name in feed or name in reader_vars)
+                        and vd is not None and len(vd.shape) >= 1
                         and vd.shape[0] == -1 and self.dp_axis in axis_names):
                     return NamedSharding(self.mesh, P(
                         self.dp_axis, *([None] * (len(vd.shape) - 1))))

@@ -18,6 +18,7 @@ Implementation notes (TPU-host path):
 from __future__ import annotations
 
 import io
+import os
 import threading
 from concurrent import futures
 
@@ -87,7 +88,8 @@ class VariableServer:
     """
 
     def __init__(self, scope, grad_to_block, apply_block, fanin,
-                 sync_mode=True):
+                 sync_mode=True, checkpoint_dir=None,
+                 checkpoint_every_n=0):
         import grpc
 
         self.scope = scope
@@ -95,6 +97,11 @@ class VariableServer:
         self.apply_block = apply_block
         self.fanin_total = int(fanin)
         self.sync_mode = bool(sync_mode)
+        # shard checkpointing (reference go/pserver/service.go:346:
+        # each pserver persists ITS parameter shard so a restarted
+        # server resumes instead of reinitializing)
+        self.checkpoint_dir = checkpoint_dir
+        self.checkpoint_every_n = int(checkpoint_every_n or 0)
 
         self._cv = threading.Condition()
         self._pending = {g: [] for g in self.grad_to_block}
@@ -102,6 +109,16 @@ class VariableServer:
         self._barriers = 0
         self._alive = self.fanin_total
         self._shutdown = threading.Event()
+        if checkpoint_dir:
+            # restore AFTER the round counter exists: load_shard also
+            # recovers _applied_round from _SUCCESS, or trainers
+            # blocked in GetVariable(round=N) would wait forever on a
+            # restarted server stuck at round 0
+            for cand in (checkpoint_dir, checkpoint_dir + ".old"):
+                if os.path.isdir(cand) and os.path.exists(
+                        os.path.join(cand, "_SUCCESS")):
+                    self.load_shard(cand)
+                    break
 
         handlers = {
             "SendVariable": self._h(self._send_variable),
@@ -152,11 +169,75 @@ class VariableServer:
         return b""
 
     def _send_barrier(self, req):
+        snapshot = None
         with self._cv:
             self._barriers += 1
             if self._barriers >= self._alive:
                 self._apply_round()
+                if (self.checkpoint_every_n and self.checkpoint_dir and
+                        self._applied_round %
+                        self.checkpoint_every_n == 0):
+                    # collect under the lock, WRITE outside it — disk
+                    # I/O must not stall every other RPC handler
+                    snapshot = self._collect_state()
+        if snapshot is not None:
+            self.save_shard(self.checkpoint_dir, snapshot)
         return b""
+
+    # -- shard checkpointing ------------------------------------------
+    def _collect_state(self):
+        """Snapshot (name, array) pairs — cheap reference grabs; scope
+        writes REPLACE values, so held arrays stay consistent."""
+        snap = []
+        for name in self.scope.local_var_names():
+            try:
+                arr = np.asarray(self.scope.find_var(name))
+            except Exception:
+                continue  # live channels/readers &c. are not state
+            if arr.dtype == object:
+                continue
+            snap.append((name, arr))
+        return snap, self._applied_round
+
+    def save_shard(self, dirname, snapshot=None):
+        """Persist the shard.  Crash-safe: write to a tmp dir, keep the
+        previous checkpoint at <dirname>.old until the new one is in
+        place (load falls back to .old, so a kill between the renames
+        cannot lose the only good checkpoint).  Filenames are
+        URL-quoted var names (injective both ways)."""
+        from urllib.parse import quote
+
+        snap, round_ = snapshot if snapshot is not None \
+            else self._collect_state()
+        tmp = dirname + ".tmp.%d" % os.getpid()
+        os.makedirs(tmp, exist_ok=True)
+        for name, arr in snap:
+            with open(os.path.join(tmp, quote(name, safe="")),
+                      "wb") as f:
+                np.save(f, arr)
+        with open(os.path.join(tmp, "_SUCCESS"), "w") as f:
+            f.write(str(round_))
+        import shutil
+        old = dirname + ".old"
+        shutil.rmtree(old, ignore_errors=True)
+        if os.path.isdir(dirname):
+            os.rename(dirname, old)
+        os.rename(tmp, dirname)
+        shutil.rmtree(old, ignore_errors=True)
+
+    def load_shard(self, dirname):
+        from urllib.parse import unquote
+
+        for fn in os.listdir(dirname):
+            if fn == "_SUCCESS":
+                with open(os.path.join(dirname, fn)) as f:
+                    try:
+                        self._applied_round = int(f.read().strip() or 0)
+                    except ValueError:
+                        pass
+                continue
+            with open(os.path.join(dirname, fn), "rb") as f:
+                self.scope.set(unquote(fn), np.load(f))
 
     def _get_variable(self, req):
         name, round_ = _dec_msg(req)

@@ -144,8 +144,12 @@ def _listen_and_serv(executor, op, scope, feed, env=None):
     def apply_block(block_id):
         sub_exec.run(program, scope, block_id=block_id)
 
-    server = VariableServer(scope, grad_to_block, apply_block, fanin,
-                            sync_mode)
+    server = VariableServer(
+        scope, grad_to_block, apply_block, fanin, sync_mode,
+        # shard checkpointing (reference go/pserver/service.go:346):
+        # restart resumes from the last snapshot instead of fresh init
+        checkpoint_dir=op.attr("checkpoint_dir", "") or None,
+        checkpoint_every_n=int(op.attr("checkpoint_every_n", 0) or 0))
     port = server.start(endpoint)
     port_file = op.attr("port_file", "")
     if port_file:

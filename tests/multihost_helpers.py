@@ -102,3 +102,40 @@ def baseline_worker_tp(q):
             1, 0, mesh_axes={"dp": 2, "tp": 4}, tp=True))
     except Exception as e:
         q.put(("tpbase", "ERROR: %r" % e, 0))
+
+
+def trainer_worker_reader(i, q, data_dir):
+    """Program-level reader chain under num_trainers=2: each process
+    reads ITS OWN recordio shard; the read batches must assemble as
+    local rows (executor_impl._put reader tag), giving the same global
+    loss both processes (and matching the arithmetic oracle)."""
+    try:
+        import jax
+
+        import paddle_tpu.fluid as fluid
+        from paddle_tpu.core.scope import Scope
+        from paddle_tpu.distributed import collective
+
+        collective.init_collective_env()
+
+        main, startup = fluid.Program(), fluid.Program()
+        scope = Scope()
+        with fluid.scope_guard(scope):
+            with fluid.program_guard(main, startup):
+                with fluid.unique_name.guard():
+                    reader = fluid.layers.io.open_recordio_file(
+                        "%s/shard%d.recordio" % (data_dir, i),
+                        shapes=[[-1, 4]], lod_levels=[0],
+                        dtypes=["float32"])
+                    reader = fluid.layers.io.batch(reader, batch_size=4)
+                    x = fluid.layers.io.read_file(reader)
+                    loss = fluid.layers.mean(x)
+            fluid.Executor(fluid.CPUPlace()).run(startup)
+            pe = fluid.ParallelExecutor(
+                use_tpu=False, loss_name=loss.name, main_program=main,
+                scope=scope, num_trainers=2, trainer_id=i)
+            out, = pe.run(feed={}, fetch_list=[loss])
+        q.put(("reader%d" % i,
+               float(np.asarray(out).ravel()[0]), len(jax.devices())))
+    except Exception as e:
+        q.put(("reader%d" % i, "ERROR: %r" % e, 0))

@@ -295,3 +295,53 @@ def test_trainer_discovers_pservers_via_registry(tmp_path, monkeypatch):
     t.checkpoint_cfg = None
     t._dist_transpile_if_necessary()
     assert captured["pservers"] == "10.0.0.1:6174,10.0.0.2:6174"
+
+
+def test_pserver_shard_checkpoint_roundtrip(tmp_path):
+    """VariableServer persists its parameter shard and a restarted
+    server resumes from it (reference go/pserver/service.go:346)."""
+    from paddle_tpu.core.scope import Scope
+    from paddle_tpu.distributed.rpc import VariableServer
+
+    d = os.path.join(str(tmp_path), "shard")
+    scope = Scope()
+    scope.set("w", np.arange(6, dtype=np.float32).reshape(2, 3))
+    scope.set("emb/part0", np.ones((4,), np.float32))
+    srv = VariableServer(scope, {}, lambda b: None, fanin=1,
+                         checkpoint_dir=d, checkpoint_every_n=1)
+    srv.save_shard(d)
+    # mutate (a later round), snapshot again: atomic replace
+    scope.set("w", np.full((2, 3), 7.0, np.float32))
+    srv.save_shard(d)
+
+    scope2 = Scope()
+    VariableServer(scope2, {}, lambda b: None, fanin=1,
+                   checkpoint_dir=d)  # auto-restores on construction
+    np.testing.assert_allclose(np.asarray(scope2.find_var("w")),
+                               np.full((2, 3), 7.0))
+    np.testing.assert_allclose(np.asarray(scope2.find_var("emb/part0")),
+                               np.ones((4,)))
+
+
+def test_pserver_checkpoint_survives_crash_between_renames(tmp_path):
+    """Crash window: dirname renamed to .old but tmp not yet in place —
+    restore must find the .old fallback, and _applied_round must come
+    back from _SUCCESS."""
+    from paddle_tpu.core.scope import Scope
+    from paddle_tpu.distributed.rpc import VariableServer
+
+    d = os.path.join(str(tmp_path), "shard")
+    s1 = Scope()
+    s1.set("under__scored", np.full((3,), 5.0, np.float32))
+    srv = VariableServer(s1, {}, lambda b: None, fanin=1)
+    srv._applied_round = 17
+    srv.save_shard(d)
+    os.rename(d, d + ".old")  # simulate the torn swap
+
+    s2 = Scope()
+    srv2 = VariableServer(s2, {}, lambda b: None, fanin=1,
+                          checkpoint_dir=d)
+    assert srv2._applied_round == 17
+    # injective name mapping: double underscores survive round-trip
+    np.testing.assert_allclose(
+        np.asarray(s2.find_var("under__scored")), 5.0)

@@ -158,3 +158,56 @@ def test_two_process_pe_with_tensor_parallel_params():
     t0, t1 = results["tp0"][0], results["tp1"][0]
     assert np.allclose(t0, t1, atol=1e-6), (t0, t1)
     assert np.allclose(base, t0, atol=1e-5), (base, t0)
+
+
+@pytest.mark.timeout(300)
+def test_two_process_pe_with_reader_chain(tmp_path):
+    """Each trainer reads its own recordio shard through program-level
+    reader ops; the global loss is the mean over BOTH shards — wrong
+    (halved/duplicated) assembly of the scope-resident batches would
+    change the value."""
+    import paddle_tpu.fluid as fluid
+
+    data_dir = str(tmp_path)
+    vals = {}
+    for i in range(2):
+        rows = np.full((8, 4), float(i + 1), np.float32)  # shard i: i+1
+        def reader(rows=rows):
+            for r in rows:
+                yield (r,)
+        fluid.recordio_writer.convert_reader_to_recordio_file(
+            "%s/shard%d.recordio" % (data_dir, i), reader)
+        vals[i] = rows.mean()
+    expect = (vals[0] + vals[1]) / 2.0  # 1.5
+
+    from tests import multihost_helpers as H
+
+    ctx = mp.get_context("spawn")
+    q = ctx.Queue()
+    procs = []
+    port = _free_port()
+    eps = "127.0.0.1:%d,127.0.0.1:%d" % (port, port + 1)
+    for i in range(2):
+        with _child_env(
+                JAX_PLATFORMS="cpu",
+                XLA_FLAGS="--xla_force_host_platform_device_count=4",
+                PALLAS_AXON_POOL_IPS=None,
+                PADDLE_TRAINER_ENDPOINTS=eps,
+                PADDLE_TRAINER_ID=str(i)):
+            procs.append(ctx.Process(target=H.trainer_worker_reader,
+                                     args=(i, q, data_dir)))
+            procs[-1].start()
+    try:
+        results = {}
+        for _ in range(2):
+            tag, val, ndev = q.get(timeout=240)
+            results[tag] = (val, ndev)
+    finally:
+        for p in procs:
+            p.join(timeout=30)
+            if p.is_alive():
+                p.terminate()
+    for tag, (val, _) in results.items():
+        assert not isinstance(val, str), (tag, val)
+    assert abs(results["reader0"][0] - expect) < 1e-6, results
+    assert abs(results["reader1"][0] - expect) < 1e-6, results
