@@ -109,6 +109,7 @@ class VariableServer:
         self._barriers = 0
         self._alive = self.fanin_total
         self._shutdown = threading.Event()
+        self._ckpt_lock = threading.Lock()  # one save at a time
         if checkpoint_dir:
             # restore AFTER the round counter exists: load_shard also
             # recovers _applied_round from _SUCCESS, or trainers
@@ -207,23 +208,29 @@ class VariableServer:
         URL-quoted var names (injective both ways)."""
         from urllib.parse import quote
 
+        import shutil
+
         snap, round_ = snapshot if snapshot is not None \
             else self._collect_state()
-        tmp = dirname + ".tmp.%d" % os.getpid()
-        os.makedirs(tmp, exist_ok=True)
-        for name, arr in snap:
-            with open(os.path.join(tmp, quote(name, safe="")),
-                      "wb") as f:
-                np.save(f, arr)
-        with open(os.path.join(tmp, "_SUCCESS"), "w") as f:
-            f.write(str(round_))
-        import shutil
-        old = dirname + ".old"
-        shutil.rmtree(old, ignore_errors=True)
-        if os.path.isdir(dirname):
-            os.rename(dirname, old)
-        os.rename(tmp, dirname)
-        shutil.rmtree(old, ignore_errors=True)
+        with self._ckpt_lock:  # overlapping rounds must not interleave
+            tmp = dirname + ".tmp.%d" % os.getpid()
+            # start CLEAN: a previously aborted save must not leak its
+            # stale files into this checkpoint (load_shard reads every
+            # file in the dir)
+            shutil.rmtree(tmp, ignore_errors=True)
+            os.makedirs(tmp)
+            for name, arr in snap:
+                with open(os.path.join(tmp, quote(name, safe="")),
+                          "wb") as f:
+                    np.save(f, arr)
+            with open(os.path.join(tmp, "_SUCCESS"), "w") as f:
+                f.write(str(round_))
+            old = dirname + ".old"
+            shutil.rmtree(old, ignore_errors=True)
+            if os.path.isdir(dirname):
+                os.rename(dirname, old)
+            os.rename(tmp, dirname)
+            shutil.rmtree(old, ignore_errors=True)
 
     def load_shard(self, dirname):
         from urllib.parse import unquote

@@ -1,0 +1,61 @@
+"""Deployment manifest generator (reference benchmark/fluid/
+kube_gen_job.py + kube_templates): pserver/trainer/master manifests
+carry the PADDLE_* env contract the Trainer consumes."""
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run(args):
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "kube_gen_job.py")]
+        + args, capture_output=True, text=True, timeout=60)
+    assert out.returncode == 0, out.stderr
+    return [json.loads(doc) for doc in out.stdout.split("---") if
+            doc.strip()]
+
+
+def _envmap(doc):
+    c = doc["spec"]["template"]["spec"]["containers"][0]
+    return {e["name"]: e.get("value") for e in c["env"]}
+
+
+def test_pserver_mode_manifests():
+    docs = _run(["--jobname", "j1", "--pservers", "2", "--trainers", "4",
+                 "--pserver-ips", "10.0.0.1,10.0.0.2", "--tpu", "4",
+                 "--master"])
+    kinds = [d["kind"] for d in docs]
+    assert kinds == ["ReplicaSet", "Job", "ReplicaSet"]
+    ps, tr, master = docs
+    assert ps["spec"]["replicas"] == 2
+    # ReplicaSet pod templates only allow Always
+    assert ps["spec"]["template"]["spec"]["restartPolicy"] == "Always"
+    assert _envmap(ps)["PADDLE_TRAINING_ROLE"] == "PSERVER"
+    assert tr["spec"]["completions"] == 4
+    env = _envmap(tr)
+    assert env["PADDLE_TRAINING_ROLE"] == "TRAINER"
+    assert env["PADDLE_PSERVER_IPS"] == "10.0.0.1,10.0.0.2"
+    res = tr["spec"]["template"]["spec"]["containers"][0]["resources"]
+    assert res["limits"]["google.com/tpu"] == "4"
+    assert master["spec"]["replicas"] == 2  # active + standby (HA)
+
+
+def test_nccl2_mode_endpoints_and_discovery():
+    docs = _run(["--jobname", "j2", "--trainers", "2",
+                 "--disttype", "nccl2",
+                 "--discovery-root", "/shared/disc"])
+    svc, tr = docs
+    # headless Service + pod subdomain make the per-pod endpoint DNS
+    # names actually resolvable
+    assert svc["kind"] == "Service"
+    assert svc["spec"]["clusterIP"] == "None"
+    assert svc["metadata"]["name"] == "j2-trainer"
+    assert tr["spec"]["template"]["spec"]["subdomain"] == "j2-trainer"
+    assert tr["spec"]["template"]["spec"]["restartPolicy"] == "Never"
+    env = _envmap(tr)
+    eps = env["PADDLE_TRAINER_ENDPOINTS"].split(",")
+    assert len(eps) == 2 and eps[0].startswith("j2-trainer-0.")
+    assert env["PADDLE_DISCOVERY_ROOT"] == "/shared/disc"

@@ -1,0 +1,218 @@
+#!/usr/bin/env python
+"""Generate Kubernetes manifests for distributed training jobs.
+
+Parity: reference benchmark/fluid/kube_gen_job.py + kube_templates/
+(pserver ReplicaSet + trainer Job carrying the PADDLE_* env contract).
+TPU-native deltas:
+
+- trainer pods request ``google.com/tpu`` resources instead of GPUs and
+  mesh over their chips via ParallelExecutor (no per-GPU pod fanout);
+- ``--disttype nccl2`` emits the jax.distributed contract
+  (PADDLE_TRAINER_ENDPOINTS via a headless service + pod index);
+- ``--discovery-root`` mounts a shared volume and sets
+  PADDLE_DISCOVERY_ROOT so pservers/master register dynamically
+  (distributed/discovery.py) instead of baking static IPs.
+
+Emits plain JSON manifests (a strict YAML subset — kubectl accepts
+them), no external yaml dependency.
+"""
+from __future__ import annotations
+
+import argparse
+import copy
+import json
+import sys
+
+
+def base_env(args):
+    return [
+        {"name": "PADDLE_PSERVER_PORT", "value": str(args.port)},
+        {"name": "PADDLE_TRAINERS", "value": str(args.trainers)},
+        {"name": "JOB_NAME", "value": args.jobname},
+    ]
+
+
+def _pod(name, image, cmd, env, resources, labels,
+         restart_policy="Always", subdomain=None):
+    spec = {
+        # ReplicaSet templates only allow Always; the trainer Job
+        # overrides with Never
+        "restartPolicy": restart_policy,
+        "containers": None,  # filled below
+    }
+    if subdomain:
+        spec["subdomain"] = subdomain
+    return {
+        "metadata": {"labels": dict(labels)},
+        "spec": dict(spec, containers=[{
+                "name": name,
+                "image": image,
+                "command": ["sh", "-c", cmd],
+                "env": list(env),
+                "resources": resources,
+            }]),
+    }
+
+
+def gen_pserver(args):
+    env = base_env(args) + [
+        {"name": "PADDLE_TRAINING_ROLE", "value": "PSERVER"},
+        {"name": "PADDLE_CURRENT_IP",
+         "valueFrom": {"fieldRef": {"fieldPath": "status.podIP"}}},
+    ]
+    if args.discovery_root:
+        env += [{"name": "PADDLE_DISCOVERY_ROOT",
+                 "value": args.discovery_root},
+                {"name": "PADDLE_PSERVERS_EXPECTED",
+                 "value": str(args.pservers)}]
+    else:
+        env.append({"name": "PADDLE_PSERVER_IPS",
+                    "value": args.pserver_ips})
+    res = {"requests": {"cpu": str(args.pscpu),
+                        "memory": "%dGi" % args.psmemory}}
+    labels = {"paddle-job-pserver": args.jobname}
+    return {
+        "apiVersion": "apps/v1",
+        "kind": "ReplicaSet",
+        "metadata": {"name": args.jobname + "-pserver"},
+        "spec": {
+            "replicas": args.pservers,
+            "selector": {"matchLabels": labels},
+            "template": _pod("pserver", args.image, args.entry, env, res,
+                             labels),
+        },
+    }
+
+
+def gen_trainer(args):
+    env = base_env(args) + [
+        {"name": "PADDLE_TRAINING_ROLE", "value": "TRAINER"},
+        {"name": "PADDLE_TRAINER_ID", "valueFrom": {"fieldRef": {
+            "fieldPath":
+                "metadata.annotations['batch.kubernetes.io/"
+                "job-completion-index']"}}},
+    ]
+    if args.disttype == "nccl2":
+        # jax.distributed bootstrap: pod 0 of the headless service is
+        # the coordinator (distributed/collective.py env contract)
+        eps = ",".join(
+            "%s-trainer-%d.%s-trainer:%d"
+            % (args.jobname, i, args.jobname, args.port + 1)
+            for i in range(args.trainers))
+        env.append({"name": "PADDLE_TRAINER_ENDPOINTS", "value": eps})
+    if args.discovery_root:
+        env += [{"name": "PADDLE_DISCOVERY_ROOT",
+                 "value": args.discovery_root},
+                {"name": "PADDLE_PSERVERS_EXPECTED",
+                 "value": str(args.pservers)}]
+    elif args.disttype == "pserver":
+        env.append({"name": "PADDLE_PSERVER_IPS",
+                    "value": args.pserver_ips})
+    res = {"requests": {"cpu": str(args.cpu),
+                        "memory": "%dGi" % args.memory}}
+    if args.tpu:
+        res["limits"] = {"google.com/tpu": str(args.tpu)}
+    labels = {"paddle-job": args.jobname}
+    return {
+        "apiVersion": "batch/v1",
+        "kind": "Job",
+        "metadata": {"name": args.jobname + "-trainer"},
+        "spec": {
+            "completions": args.trainers,
+            "parallelism": args.trainers,
+            "completionMode": "Indexed",
+            # Indexed Jobs get stable per-pod hostnames; with the
+            # headless Service below + subdomain, pod DNS names like
+            # <job>-trainer-0.<job>-trainer resolve (nccl2 coordinator)
+            "template": _pod("trainer", args.image, args.entry, env, res,
+                             labels, restart_policy="Never",
+                             subdomain=args.jobname + "-trainer"
+                             if args.disttype == "nccl2" else None),
+        },
+    }
+
+
+def gen_trainer_service(args):
+    """Headless Service backing the trainers' per-pod DNS (required for
+    the nccl2 PADDLE_TRAINER_ENDPOINTS names to resolve)."""
+    return {
+        "apiVersion": "v1",
+        "kind": "Service",
+        "metadata": {"name": args.jobname + "-trainer"},
+        "spec": {
+            "clusterIP": "None",
+            "selector": {"paddle-job": args.jobname},
+            "ports": [{"port": args.port + 1,
+                       "targetPort": args.port + 1}],
+        },
+    }
+
+
+def gen_master(args):
+    env = base_env(args)
+    if args.discovery_root:
+        env.append({"name": "PADDLE_DISCOVERY_ROOT",
+                    "value": args.discovery_root})
+    labels = {"paddle-job-master": args.jobname}
+    return {
+        "apiVersion": "apps/v1",
+        "kind": "ReplicaSet",
+        "metadata": {"name": args.jobname + "-master"},
+        "spec": {
+            # active + standby: MasterHA leader election picks one
+            "replicas": 2,
+            "selector": {"matchLabels": labels},
+            "template": _pod("master", args.image, args.master_entry,
+                             env, {"requests": {"cpu": "1"}}, labels),
+        },
+    }
+
+
+def build(args):
+    out = []
+    if args.disttype == "pserver":
+        out.append(gen_pserver(args))
+    if args.disttype == "nccl2":
+        out.append(gen_trainer_service(args))
+    out.append(gen_trainer(args))
+    if args.master:
+        out.append(gen_master(args))
+    return out
+
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser(
+        description="Generate dist job manifests (reference "
+                    "kube_gen_job.py).")
+    p.add_argument("--jobname", default="paddlejob")
+    p.add_argument("--image", default="paddle-tpu:latest")
+    p.add_argument("--entry", default="python train.py")
+    p.add_argument("--master-entry",
+                   default="python -m paddle_tpu.distributed.master")
+    p.add_argument("--pservers", type=int, default=1)
+    p.add_argument("--trainers", type=int, default=1)
+    p.add_argument("--cpu", type=int, default=1)
+    p.add_argument("--pscpu", type=int, default=1)
+    p.add_argument("--memory", type=int, default=1)
+    p.add_argument("--psmemory", type=int, default=1)
+    p.add_argument("--tpu", type=int, default=0,
+                   help="TPU chips per trainer pod")
+    p.add_argument("--port", type=int, default=30236)
+    p.add_argument("--disttype", default="pserver",
+                   choices=["pserver", "nccl2", "local"])
+    p.add_argument("--pserver-ips", default="")
+    p.add_argument("--discovery-root", default="")
+    p.add_argument("--master", action="store_true",
+                   help="also emit the HA master ReplicaSet")
+    return p.parse_args(argv)
+
+
+def main(argv=None):
+    args = parse_args(argv)
+    for doc in build(args):
+        json.dump(doc, sys.stdout, indent=2)
+        sys.stdout.write("\n---\n")
+
+
+if __name__ == "__main__":
+    main()
