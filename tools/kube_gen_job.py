@@ -19,7 +19,6 @@ them), no external yaml dependency.
 from __future__ import annotations
 
 import argparse
-import copy
 import json
 import sys
 
