@@ -13,7 +13,9 @@
 #include <cstring>
 #include <string>
 
-static std::string g_err;
+// thread-local: concurrent worker threads in a C server each see their
+// own last error (the header pitches the library at such servers)
+static thread_local std::string g_err;
 static PyObject* g_inference = nullptr;  // paddle_tpu.inference module
 static PyObject* g_np = nullptr;         // numpy module
 
@@ -22,7 +24,14 @@ static void set_err_from_python() {
   PyErr_Fetch(&type, &value, &tb);
   PyErr_NormalizeException(&type, &value, &tb);
   PyObject* s = value ? PyObject_Str(value) : nullptr;
-  g_err = s ? PyUnicode_AsUTF8(s) : "unknown python error";
+  // PyUnicode_AsUTF8 returns NULL on encoding failure; std::string
+  // from NULL is UB
+  const char* msg = s ? PyUnicode_AsUTF8(s) : nullptr;
+  if (msg == nullptr) {
+    PyErr_Clear();  // AsUTF8 failure sets its own exception
+    msg = "unknown python error";
+  }
+  g_err = msg;
   Py_XDECREF(s);
   Py_XDECREF(type);
   Py_XDECREF(value);

@@ -22,6 +22,7 @@ import json
 import os
 import threading
 import time
+import uuid
 
 __all__ = ["EndpointRegistry", "FileLock", "MasterHA"]
 
@@ -120,7 +121,11 @@ class FileLock:
         self.path = path
         self.ttl = float(ttl)
         self._stop = None
-        self.token = "%d.%d" % (os.getpid(), threading.get_ident())
+        # pid.thread alone collides for two FileLock instances in one
+        # thread (in-process active+standby); the nonce makes ownership
+        # checks identify the instance, not just the thread.
+        self.token = "%d.%d.%s" % (os.getpid(), threading.get_ident(),
+                                   uuid.uuid4().hex[:8])
         self.lost = False      # set when another holder stole the lock
         self._on_lost = on_lost
 

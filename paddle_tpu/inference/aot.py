@@ -24,6 +24,7 @@ from __future__ import annotations
 import json
 import os
 import pickle
+import threading
 
 import numpy as np
 
@@ -102,6 +103,11 @@ class AotExecutable:
     def __init__(self, compiled, meta, scope, place):
         self.compiled = compiled
         self.meta = meta
+        # run() donates the staged persistable buffers (BN stats &c.)
+        # and writes the fresh ones back into self._args; cloned
+        # predictors share this object, so two in-flight run() calls
+        # would hand the same donated buffer to two executions.
+        self._run_lock = threading.Lock()
         self.specs = {k: (tuple(s), np.dtype(d))
                       for k, (s, d) in meta["specs"].items()}
         self.fetch = list(meta["fetch"])
@@ -149,16 +155,21 @@ class AotExecutable:
     def run(self, feed):
         import jax
 
-        args = list(self._args)
-        for name, i in self._feed_slots.items():
-            args[i] = jax.device_put(np.asarray(feed[name])
-                                     if not isinstance(feed[name],
-                                                       jax.Array)
-                                     else feed[name], self._dev)
-        fetches, persists = self.compiled(*args, np.uint32(0),
-                                          np.uint32(0))
-        for j, i in self._persist_slots:
-            self._args[i] = persists[j]
+        # feed staging touches no shared state — keep it outside the
+        # lock so concurrent clones overlap their h2d transfers
+        staged = {i: jax.device_put(np.asarray(feed[name])
+                                    if not isinstance(feed[name],
+                                                      jax.Array)
+                                    else feed[name], self._dev)
+                  for name, i in self._feed_slots.items()}
+        with self._run_lock:
+            args = list(self._args)
+            for i, v in staged.items():
+                args[i] = v
+            fetches, persists = self.compiled(*args, np.uint32(0),
+                                              np.uint32(0))
+            for j, i in self._persist_slots:
+                self._args[i] = persists[j]
         return list(fetches)
 
 

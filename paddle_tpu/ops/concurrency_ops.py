@@ -103,6 +103,36 @@ def _channel_close(executor, op, scope, feed, env=None):
     scope.find_var(op.input("Channel")[0]).close()
 
 
+def _block_idx(attr_val):
+    return attr_val.idx if hasattr(attr_val, "idx") else int(attr_val)
+
+
+def _sole_sender_channels(program, block_id):
+    """Channel names sent on from ``block_id``'s control-flow subtree
+    (NOT descending into nested go ops — those routines own their own
+    channels' lifecycle) and from nowhere else in the program."""
+    def subtree(bid, acc):
+        acc.add(bid)
+        for sop in program.blocks[bid].ops:
+            if sop.type != "go" and sop.has_attr("sub_block"):
+                subtree(_block_idx(sop.attr("sub_block")), acc)
+        return acc
+
+    mine = subtree(block_id, set())
+    sends = set()
+    for bid in mine:
+        for sop in program.blocks[bid].ops:
+            if sop.type == "channel_send":
+                sends.update(sop.input("Channel"))
+    for bid, b in enumerate(program.blocks):
+        if bid in mine:
+            continue
+        for sop in b.ops:
+            if sop.type == "channel_send":
+                sends.difference_update(sop.input("Channel"))
+    return sends
+
+
 @_host("go")
 def _go(executor, op, scope, feed, env=None):
     """Launch the sub-block on a daemon thread (reference go_op.cc:84):
@@ -134,6 +164,14 @@ def _go(executor, op, scope, feed, env=None):
         for n in sop.output_arg_names():
             if n:
                 written.add(n)
+    # Channels this routine is the SOLE sender on — closed if it dies,
+    # so a main-block channel_recv blocked on this producer observes
+    # ChannelClosed instead of hanging.  Recv-only channels, fan-in
+    # channels with other senders (main block or sibling routines), and
+    # channels fed by NESTED go routines (which install their own
+    # handler when their go op runs) stay open: closing those would
+    # poison live producers.
+    chan_names = _sole_sender_channels(program, int(block_id))
     record = {"thread": None, "error": None}
 
     def run():
@@ -142,11 +180,24 @@ def _go(executor, op, scope, feed, env=None):
                     feed=captured_feed)
         except Exception as e:  # surfaced on join()
             record["error"] = e
+            for cn in chan_names:
+                ch = scope.find_var(cn)
+                if ch is not None and hasattr(ch, "close"):
+                    try:
+                        ch.close()
+                    except Exception:
+                        pass
 
     t = threading.Thread(target=run, daemon=True)
     record["thread"] = t
     if not hasattr(scope, "_go_threads"):
         scope._go_threads = []
+    # Prune finished, error-free records so a training loop running a
+    # main-block go op each step doesn't grow the list unboundedly
+    # (errored records are kept for join_go_threads to surface).
+    scope._go_threads = [
+        r for r in scope._go_threads
+        if r["error"] is not None or r["thread"].is_alive()]
     scope._go_threads.append(record)
     t.start()
 

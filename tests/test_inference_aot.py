@@ -119,6 +119,39 @@ def test_aot_bn_model_repeat_runs(tmp_path):
         np.testing.assert_allclose(out[0].data, ref, atol=1e-5)
 
 
+def test_aot_concurrent_cloned_predictors(tmp_path):
+    """clone() shares the AotExecutable; run() donates the staged BN
+    running-stat buffers, so two in-flight calls without the per-
+    executable lock would hand the same donated buffer to two
+    executions (crash / corrupt outputs)."""
+    import threading
+
+    from paddle_tpu import inference as inf
+
+    d = str(tmp_path / "m")
+    xs, ref = _build_and_save_bn(d)
+    pred = inf.create_paddle_predictor(inf.NativeConfig(model_dir=d))
+    assert pred.aot is not None
+    preds = [pred] + [pred.clone() for _ in range(3)]
+    errors = []
+
+    def serve(p):
+        try:
+            for _ in range(8):
+                out = p.run({"x": xs})
+                np.testing.assert_allclose(out[0].data, ref, atol=1e-5)
+        except Exception as e:
+            errors.append(e)
+
+    ts = [threading.Thread(target=serve, args=(p,)) for p in preds]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(120)
+    assert not any(t.is_alive() for t in ts), "serve thread hung"
+    assert not errors, errors[0]
+
+
 def test_aot_skipped_under_analysis_passes(tmp_path):
     """AnalysisConfig's BN-fold mutates the parameter scope; the AOT
     artifact (compiled from the unfolded program) must not be served

@@ -253,6 +253,25 @@ def test_lock_steal_is_single_winner(tmp_path):
     a.release()
 
 
+def test_lock_tokens_distinct_within_one_thread(tmp_path):
+    """Two FileLock instances created in the SAME thread must carry
+    distinct tokens, or the non-holder's release()/heartbeat could act
+    on the holder's lock (in-process active+standby fencing)."""
+    from paddle_tpu.distributed.discovery import FileLock
+
+    path = os.path.join(str(tmp_path), "l")
+    a = FileLock(path, ttl=5.0)
+    b = FileLock(path, ttl=5.0)
+    assert a.token != b.token
+    assert a.try_acquire()
+    # b never acquired: its release must NOT remove a's lock file
+    b.release()
+    assert os.path.exists(path)
+    assert not b.try_acquire()
+    a.release()
+    assert not os.path.exists(path)
+
+
 def test_trainer_discovers_pservers_via_registry(tmp_path, monkeypatch):
     """Trainer._dist_transpile_if_necessary resolves pserver endpoints
     from the discovery registry when PADDLE_DISCOVERY_ROOT +

@@ -70,6 +70,81 @@ def test_channel_close_unblocks_recv(prog_scope, exe):
     assert not bool(np.asarray(sv).ravel()[0])
 
 
+def test_go_thread_records_pruned_across_steps(prog_scope, exe):
+    """A training loop executing a main-block go op each step must not
+    grow scope._go_threads unboundedly — finished clean records are
+    pruned at the next launch."""
+    main, startup, scope = prog_scope
+    x = fluid.layers.data(name="x", shape=[2], dtype="float32")
+    ch = C.program_make_channel(dtype="float32", capacity=4)
+    with C.ProgramGo():
+        C.program_channel_send(ch, x)
+    got = fluid.layers.data(name="gp", shape=[2], dtype="float32")
+    C.program_channel_recv(ch, got)
+    exe.run(startup)
+    xs = np.ones((1, 2), np.float32)
+    for _ in range(20):
+        exe.run(main, feed={"x": xs}, fetch_list=[got])
+    from paddle_tpu.ops.concurrency_ops import join_go_threads
+    join_go_threads(scope)
+    # after join the list is empty; the invariant under test is that it
+    # never accumulated 20 dead records mid-loop
+    exe.run(main, feed={"x": xs}, fetch_list=[got])
+    assert len(scope._go_threads) <= 2
+    join_go_threads(scope)
+
+
+def test_dead_go_routine_closes_its_channels(prog_scope, exe):
+    """A go routine that dies must close the channels its sub-block
+    touches, so a blocked main-block recv observes ChannelClosed
+    (Status=False) instead of hanging forever."""
+    main, startup, scope = prog_scope
+    x = fluid.layers.data(name="x", shape=[2], dtype="float32")
+    ch = C.program_make_channel(dtype="float32", capacity=1)
+    with C.ProgramGo():
+        bad = fluid.layers.scale(x, scale=1.0)
+        C.program_channel_send(ch, bad)
+    got = fluid.layers.data(name="gd", shape=[2], dtype="float32")
+    st = C.program_channel_recv(ch, got)
+    exe.run(startup)
+    # feed omits x entirely AND the var is absent from the scope -> the
+    # routine raises on the missing input before sending
+    sv, = exe.run(main, feed={}, fetch_list=[st.name])
+    assert not bool(np.asarray(sv).ravel()[0])
+    # the error is still surfaced on join
+    from paddle_tpu.ops.concurrency_ops import join_go_threads
+    try:
+        join_go_threads(scope)
+        raised = False
+    except Exception:
+        raised = True
+    assert raised
+
+
+def test_dead_routine_spares_fan_in_channel(prog_scope, exe):
+    """A dying routine must NOT close a channel that a healthy sibling
+    sender still feeds (fan-in): only sole-sender channels are closed
+    on death."""
+    main, startup, scope = prog_scope
+    x = fluid.layers.data(name="x", shape=[2], dtype="float32")
+    ch = C.program_make_channel(dtype="float32", capacity=2)
+    with C.ProgramGo():          # healthy producer
+        C.program_channel_send(ch, x)
+    with C.ProgramGo():          # dies (reads a var that is never fed)
+        dead = fluid.layers.data(name="never_fed", shape=[2],
+                                 dtype="float32")
+        C.program_channel_send(ch, fluid.layers.scale(dead, scale=1.0))
+    got = fluid.layers.data(name="gf", shape=[2], dtype="float32")
+    st = C.program_channel_recv(ch, got)
+    exe.run(startup)
+    xs = np.full((1, 2), 7.0, np.float32)
+    sv, g = exe.run(main, feed={"x": xs}, fetch_list=[st.name, got])
+    # the healthy sibling's value arrives with Status=True
+    assert bool(np.asarray(sv).ravel()[0])
+    np.testing.assert_allclose(np.asarray(g), xs, rtol=1e-6)
+    scope._go_threads = []  # the dead routine's error is expected
+
+
 def test_go_block_captures_parent_temp(prog_scope, exe):
     """A go routine reading a temporary computed by the PARENT block
     must capture it at launch (reference go_op X inputs) — this used to
