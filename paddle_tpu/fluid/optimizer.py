@@ -124,9 +124,13 @@ class Optimizer:
         params_grads = append_backward(loss, parameter_list, no_grad_set,
                                        [error_clip_callback])
         params_grads = sorted(params_grads, key=lambda x: x[0].name)
-        params_grads = append_gradient_clip_ops(params_grads)
-        params_grads = append_regularization_ops(params_grads,
-                                                 self.regularization)
+        # clip/regularization ops consume gradients: they must carry the
+        # Optimize role or clone(for_test=True) would keep them in
+        # inference programs (reading @GRAD vars that no longer exist)
+        with loss.block.program.optimized_guard(params_grads):
+            params_grads = append_gradient_clip_ops(params_grads)
+            params_grads = append_regularization_ops(params_grads,
+                                                     self.regularization)
         optimize_ops = self._create_optimization_pass(
             params_grads, loss, startup_program)
         return optimize_ops, params_grads

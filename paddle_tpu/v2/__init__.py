@@ -1,0 +1,54 @@
+"""v2-era API (reference python/paddle/v2).
+
+Round-3 state was a data-utilities shim that *raised* on the graph API;
+this package closes the last census row: ``layer`` / ``activation`` /
+``pooling`` / ``attr`` / ``data_type`` / ``optimizer`` / ``parameters``
+/ ``trainer`` / ``event`` / ``networks`` / ``infer`` are thin builders
+over the fluid stack (see each module's docstring for the reference
+anchor).  A reference v2 script over the ported layer subset
+(``layer.py __all__``: data/fc/embedding/conv/pool/bn/sequence/lstm/
+gru/cost layers) — layers declared at import time,
+``parameters.create(cost)``, ``trainer.SGD(...).train(reader)`` — runs
+with only the import line changed; unported v1 layer names raise with
+their fluid equivalent named.
+
+The *mechanics* differ on purpose: layer calls build a deferred DAG
+that materializes into ONE fluid Program (a single XLA computation),
+not a per-layer gserver config — same API, TPU-native execution.
+"""
+from __future__ import annotations
+
+from paddle_tpu import batch  # noqa: F401  (paddle.v2.batch == paddle.batch)
+from paddle_tpu import dataset  # noqa: F401
+from paddle_tpu import reader  # noqa: F401
+
+from . import activation  # noqa: F401
+from . import attr  # noqa: F401
+from . import config_base  # noqa: F401
+from . import data_type  # noqa: F401
+from . import event  # noqa: F401
+from . import layer  # noqa: F401
+from . import networks  # noqa: F401
+from . import optimizer  # noqa: F401
+from . import parameters  # noqa: F401
+from . import pooling  # noqa: F401
+from . import topology  # noqa: F401
+from . import trainer  # noqa: F401
+from .inference import Inference, infer  # noqa: F401
+
+__all__ = ["init", "batch", "reader", "dataset", "infer", "Inference",
+           "layer", "activation", "pooling", "attr", "data_type",
+           "optimizer", "parameters", "trainer", "event", "networks",
+           "topology", "config_base"]
+
+_initialized = False
+
+
+def init(use_gpu=False, trainer_count=1, **kwargs):
+    """v2 bootstrap (reference v2/__init__.py init: parses flags, seeds
+    devices).  Device selection happens per-Executor here; this records
+    the call and validates the arguments."""
+    global _initialized
+    if trainer_count < 1:
+        raise ValueError("trainer_count must be >= 1")
+    _initialized = True

@@ -1,0 +1,82 @@
+"""v2 activation objects (reference python/paddle/v2/activation.py /
+trainer_config_helpers/activations.py).  Each maps to a registered
+fluid op type so XLA fuses it into the producing matmul."""
+from __future__ import annotations
+
+__all__ = ["Base", "Tanh", "Sigmoid", "Softmax", "Relu", "BRelu",
+           "SoftRelu", "STanh", "Linear", "Square", "Exp", "Log",
+           "Abs", "SequenceSoftmax", "Identity"]
+
+
+class Base:
+    fluid_act = None  # op type string, or None for identity
+
+    def __repr__(self):
+        return "activation.%s()" % type(self).__name__
+
+
+class Tanh(Base):
+    fluid_act = "tanh"
+
+
+class Sigmoid(Base):
+    fluid_act = "sigmoid"
+
+
+class Softmax(Base):
+    fluid_act = "softmax"
+
+
+class SequenceSoftmax(Base):
+    fluid_act = "sequence_softmax"
+
+
+class Relu(Base):
+    fluid_act = "relu"
+
+
+class BRelu(Base):
+    fluid_act = "brelu"
+
+
+class SoftRelu(Base):
+    fluid_act = "soft_relu"
+
+
+class STanh(Base):
+    fluid_act = "stanh"
+
+
+class Linear(Base):
+    fluid_act = None
+
+
+class Identity(Base):
+    fluid_act = None
+
+
+class Square(Base):
+    fluid_act = "square"
+
+
+class Exp(Base):
+    fluid_act = "exp"
+
+
+class Log(Base):
+    fluid_act = "log"
+
+
+class Abs(Base):
+    fluid_act = "abs"
+
+
+def to_fluid_act(act):
+    """v2 activation object (or None / fluid act string) -> fluid act
+    string or None."""
+    if act is None or isinstance(act, str):
+        return act
+    if isinstance(act, Base):
+        return act.fluid_act
+    raise TypeError("expected a paddle_tpu.v2.activation object, got %r"
+                    % (act,))

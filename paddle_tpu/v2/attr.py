@@ -1,0 +1,86 @@
+"""v2 attribute objects (reference python/paddle/v2/attr.py ->
+trainer_config_helpers/attrs.py ParameterAttribute/ExtraLayerAttribute).
+``Param`` converts to a fluid ParamAttr; ``Extra`` carries drop_rate.
+"""
+from __future__ import annotations
+
+from paddle_tpu.fluid.initializer import (NormalInitializer,
+                                          UniformInitializer)
+from paddle_tpu.fluid.param_attr import ParamAttr
+from paddle_tpu.fluid.regularizer import (L1DecayRegularizer,
+                                          L2DecayRegularizer)
+
+__all__ = ["Param", "Extra", "ParameterAttribute", "ExtraAttribute",
+           "ExtraLayerAttribute"]
+
+
+class ParameterAttribute:
+    def __init__(self, name=None, is_static=False, initial_std=None,
+                 initial_mean=None, initial_max=None, initial_min=None,
+                 l1_rate=None, l2_rate=None, learning_rate=None,
+                 momentum=None, gradient_clipping_threshold=None,
+                 sparse_update=False):
+        self.name = name
+        self.is_static = is_static
+        self.initial_std = initial_std
+        self.initial_mean = initial_mean
+        self.initial_max = initial_max
+        self.initial_min = initial_min
+        self.l1_rate = l1_rate
+        self.l2_rate = l2_rate
+        self.learning_rate = learning_rate
+        self.sparse_update = sparse_update
+        self.gradient_clipping_threshold = gradient_clipping_threshold
+        if momentum is not None:
+            raise NotImplementedError(
+                "per-parameter momentum is not supported; set momentum "
+                "on the optimizer (v2.optimizer.Momentum)")
+
+    def to_fluid(self):
+        init = None
+        if self.initial_std is not None or self.initial_mean is not None:
+            init = NormalInitializer(loc=self.initial_mean or 0.0,
+                                     scale=self.initial_std
+                                     if self.initial_std is not None
+                                     else 0.01)
+        elif self.initial_max is not None or self.initial_min is not None:
+            init = UniformInitializer(low=self.initial_min or 0.0,
+                                      high=self.initial_max or 1.0)
+        reg = None
+        if self.l2_rate:
+            reg = L2DecayRegularizer(self.l2_rate)
+        elif self.l1_rate:
+            reg = L1DecayRegularizer(self.l1_rate)
+        clip = None
+        if self.gradient_clipping_threshold:
+            from paddle_tpu.fluid.clip import GradientClipByNorm
+            clip = GradientClipByNorm(self.gradient_clipping_threshold)
+        return ParamAttr(name=self.name, initializer=init,
+                         learning_rate=self.learning_rate
+                         if self.learning_rate is not None else 1.0,
+                         regularizer=reg, trainable=not self.is_static,
+                         gradient_clip=clip)
+
+
+class ExtraAttribute:
+    def __init__(self, error_clipping_threshold=None, drop_rate=None,
+                 device=None):
+        self.error_clipping_threshold = error_clipping_threshold
+        self.drop_rate = drop_rate
+        self.device = device
+
+
+Param = ParameterAttribute
+Extra = ExtraAttribute
+ExtraLayerAttribute = ExtraAttribute
+
+
+def to_param_attr(attr):
+    """v2 Param | fluid ParamAttr | None -> fluid ParamAttr | None."""
+    if attr is None or isinstance(attr, ParamAttr):
+        return attr
+    if isinstance(attr, ParameterAttribute):
+        return attr.to_fluid()
+    if attr is False:
+        return False  # v2 bias_attr=False means "no bias"
+    raise TypeError("expected paddle_tpu.v2.attr.Param, got %r" % (attr,))

@@ -1,0 +1,60 @@
+"""v2 graph nodes: a declarative DAG materialized into a fluid Program.
+
+Parity: reference python/paddle/v2/config_base.py — there, Layer wraps
+a v1 trainer-config call whose side effects accumulate into a global
+protobuf parsed later by ``parse_network``.  TPU-native redesign: each
+v2 layer call returns a :class:`Layer` node holding a *builder* closure
+over fluid layer functions; nothing is traced or configured until a
+:class:`~paddle_tpu.v2.topology.Topology` walks the DAG and emits one
+fluid Program (which the executor jits into a single XLA computation).
+This keeps the v2 deferred-construction contract — layers may be
+declared at module import time, outside any program context — without
+the v1 global-config machinery.
+"""
+from __future__ import annotations
+
+import itertools
+
+__all__ = ["Layer"]
+
+_counter = itertools.count()
+
+
+class Layer:
+    """One node of the v2 model DAG.
+
+    ``builder(ctx, *fluid_inputs)`` receives the materialization context
+    and the already-built fluid variables of ``inputs`` and returns the
+    node's fluid variable.
+    """
+
+    def __init__(self, name, builder, inputs=(), data_type=None,
+                 size=None):
+        self.name = name
+        self.builder = builder
+        self.inputs = list(inputs)
+        self.data_type = data_type    # InputType, data layers only
+        self.size = size              # layer width when statically known
+        self.index = next(_counter)   # global declaration order
+
+    # -- DAG helpers -------------------------------------------------
+    def ancestors(self):
+        """All transitive inputs (self included), depth-first, deduped."""
+        seen, out, stack = set(), [], [self]
+        while stack:
+            node = stack.pop()
+            if id(node) in seen:
+                continue
+            seen.add(id(node))
+            out.append(node)
+            stack.extend(node.inputs)
+        return out
+
+    def data_layers(self):
+        """Reachable data layers in global declaration order (the v2
+        default feeding order)."""
+        ds = [n for n in self.ancestors() if n.data_type is not None]
+        return sorted(ds, key=lambda n: n.index)
+
+    def __repr__(self):
+        return "v2.Layer(%s)" % self.name

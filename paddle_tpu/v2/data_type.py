@@ -1,0 +1,125 @@
+"""v2 input type declarations (reference python/paddle/v2/data_type.py,
+which re-exports trainer/PyDataProvider2.py types).
+
+An InputType tells the topology what fluid ``data`` var a v2 data layer
+becomes and tells the feeder how to convert a sample column:
+
+==========================  ==========================================
+dense_vector(d)             float32 [d]
+integer_value(r)            int64   [1]          (class id in [0, r))
+dense_vector_sequence(d)    float32 [d], lod 1   (ragged over time)
+integer_value_sequence(r)   int64   [1], lod 1
+sparse_binary_vector(d)     float32 [d]  (fed as index list, densified
+                            host-side — SelectedRows covers the sparse
+                            *parameter* path, the input stays dense for
+                            the MXU)
+sparse_float_vector(d)      float32 [d]  ((index, value) pairs)
+==========================  ==========================================
+"""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = [
+    "InputType", "DataType", "SequenceType",
+    "dense_vector", "dense_array", "integer_value",
+    "dense_vector_sequence", "integer_value_sequence",
+    "sparse_binary_vector", "sparse_float_vector",
+    "sparse_binary_vector_sequence", "sparse_float_vector_sequence",
+]
+
+
+class DataType:
+    Dense = 0
+    SparseNonValue = 1
+    SparseValue = 2
+    Index = 3
+
+
+class SequenceType:
+    NO_SEQUENCE = 0
+    SEQUENCE = 1
+    SUB_SEQUENCE = 2
+
+
+class InputType:
+    def __init__(self, dim, seq_type, type_):
+        self.dim = int(dim)
+        self.seq_type = seq_type
+        self.type = type_
+
+    # -- topology-facing ---------------------------------------------
+    @property
+    def lod_level(self):
+        return {SequenceType.NO_SEQUENCE: 0,
+                SequenceType.SEQUENCE: 1,
+                SequenceType.SUB_SEQUENCE: 2}[self.seq_type]
+
+    @property
+    def dtype(self):
+        return "int64" if self.type == DataType.Index else "float32"
+
+    @property
+    def shape(self):
+        return [1] if self.type == DataType.Index else [self.dim]
+
+    # -- feeder-facing -----------------------------------------------
+    def convert_column(self, value):
+        """One sample's column -> the array the fluid DataFeeder
+        expects (sequences stay nested lists; the feeder builds LoD)."""
+        if self.seq_type != SequenceType.NO_SEQUENCE:
+            if self.type == DataType.Index:
+                return [[int(v)] for v in value]
+            if self.type == DataType.Dense:
+                return [np.asarray(v, np.float32) for v in value]
+            return [self._densify(v) for v in value]
+        if self.type == DataType.Index:
+            return [int(value)]
+        if self.type == DataType.Dense:
+            return np.asarray(value, np.float32)
+        return self._densify(value)
+
+    def _densify(self, value):
+        out = np.zeros(self.dim, np.float32)
+        if self.type == DataType.SparseNonValue:
+            out[np.asarray(list(value), np.int64)] = 1.0
+        else:  # SparseValue: iterable of (index, value)
+            for i, v in value:
+                out[int(i)] = float(v)
+        return out
+
+
+def dense_vector(dim, seq_type=SequenceType.NO_SEQUENCE):
+    return InputType(dim, seq_type, DataType.Dense)
+
+
+def dense_array(dim, seq_type=SequenceType.NO_SEQUENCE):
+    return InputType(dim, seq_type, DataType.Dense)
+
+
+def integer_value(value_range, seq_type=SequenceType.NO_SEQUENCE):
+    return InputType(value_range, seq_type, DataType.Index)
+
+
+def dense_vector_sequence(dim):
+    return dense_vector(dim, SequenceType.SEQUENCE)
+
+
+def integer_value_sequence(value_range):
+    return integer_value(value_range, SequenceType.SEQUENCE)
+
+
+def sparse_binary_vector(dim, seq_type=SequenceType.NO_SEQUENCE):
+    return InputType(dim, seq_type, DataType.SparseNonValue)
+
+
+def sparse_float_vector(dim, seq_type=SequenceType.NO_SEQUENCE):
+    return InputType(dim, seq_type, DataType.SparseValue)
+
+
+def sparse_binary_vector_sequence(dim):
+    return sparse_binary_vector(dim, SequenceType.SEQUENCE)
+
+
+def sparse_float_vector_sequence(dim):
+    return sparse_float_vector(dim, SequenceType.SEQUENCE)

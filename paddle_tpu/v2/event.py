@@ -1,0 +1,51 @@
+"""v2 training events (reference python/paddle/v2/event.py).
+
+``metrics`` replaces the reference's swig Evaluator handle: a plain
+dict of name -> float for the batch/pass (e.g.
+``classification_error_evaluator``)."""
+from __future__ import annotations
+
+__all__ = ["BeginPass", "EndPass", "BeginIteration", "EndIteration",
+           "EndForwardBackward", "TestResult"]
+
+
+class WithMetric:
+    def __init__(self, metrics):
+        self.metrics = dict(metrics or {})
+
+
+class TestResult(WithMetric):
+    def __init__(self, cost, metrics=None):
+        super().__init__(metrics)
+        self.cost = cost
+
+
+class BeginPass:
+    def __init__(self, pass_id):
+        self.pass_id = pass_id
+
+
+class EndPass(WithMetric):
+    def __init__(self, pass_id, metrics=None):
+        super().__init__(metrics)
+        self.pass_id = pass_id
+
+
+class BeginIteration:
+    def __init__(self, pass_id, batch_id):
+        self.pass_id = pass_id
+        self.batch_id = batch_id
+
+
+class EndForwardBackward:
+    def __init__(self, pass_id, batch_id):
+        self.pass_id = pass_id
+        self.batch_id = batch_id
+
+
+class EndIteration(WithMetric):
+    def __init__(self, pass_id, batch_id, cost, metrics=None):
+        super().__init__(metrics)
+        self.pass_id = pass_id
+        self.batch_id = batch_id
+        self.cost = cost

@@ -1,0 +1,71 @@
+"""v2 inference (reference python/paddle/v2/inference.py): run the
+forward graph for an output layer with trained parameters."""
+from __future__ import annotations
+
+import numpy as np
+
+import paddle_tpu.fluid as fluid
+
+from .config_base import Layer
+from .topology import Topology
+from .trainer import _Feeder
+
+__all__ = ["Inference", "infer"]
+
+
+class Inference:
+    def __init__(self, output_layer, parameters):
+        outputs = output_layer if isinstance(output_layer, (list, tuple)) \
+            else [output_layer]
+        if not all(isinstance(o, Layer) for o in outputs):
+            raise TypeError("output_layer must be v2 layer(s)")
+        self.outputs = outputs
+        topo = parameters.topology
+        if topo is not None and all(id(o) in topo._memo for o in outputs):
+            # same DAG the parameters were created from: reuse it (and
+            # its trained scope), pruned to the forward subgraph so
+            # label feeds and loss/update ops drop away
+            self.topology = topo
+            self.program = topo.main_program.clone(for_test=True).prune(
+                [topo.var_of(o) for o in outputs])
+        else:
+            self.topology = Topology(outputs[0],
+                                     extra_layers=outputs[1:],
+                                     is_test=True)
+            self.topology.run_startup()
+            for name in self.topology.parameter_names():
+                if parameters.has_key(name):
+                    self.topology.scope.set(name, parameters.get(name))
+            self.program = self.topology.main_program
+        self.fetch_vars = [self.topology.var_of(o) for o in outputs]
+        # only data layers feeding the requested outputs are required
+        self.data_types = []
+        seen = set()
+        for o in outputs:
+            for d in o.data_layers():
+                if d.name not in seen:
+                    seen.add(d.name)
+                    self.data_types.append((d.name, d.data_type))
+
+    def run(self, input, feeding=None, field="value"):
+        feeder = _Feeder(self.data_types, feeding)
+        exe = fluid.Executor(fluid.CPUPlace())
+        fields = [field] if isinstance(field, str) else list(field)
+        with fluid.scope_guard(self.topology.scope):
+            outs = exe.run(self.program, feed=feeder(list(input)),
+                           fetch_list=[v.name for v in self.fetch_vars])
+        results = [np.asarray(o) for o in outs]
+        out = []
+        for f in fields:
+            if f == "value":
+                out.extend(results)
+            elif f == "id":
+                out.extend(np.argmax(r, axis=-1) for r in results)
+            else:
+                raise ValueError("unsupported field %r" % f)
+        return out[0] if len(out) == 1 else out
+
+
+def infer(output_layer, parameters, input, feeding=None, field="value"):
+    return Inference(output_layer, parameters).run(
+        input, feeding=feeding, field=field)

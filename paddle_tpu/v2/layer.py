@@ -1,0 +1,483 @@
+"""v2 layer builders (reference python/paddle/v2/layer.py wrapping
+trainer_config_helpers/layers.py, ~7k LoC of v1 config calls).
+
+Each function returns a deferred :class:`~config_base.Layer` node; a
+Topology materializes the DAG into ONE fluid Program, so the whole v2
+model compiles to a single XLA computation — there is no per-layer
+gserver evaluation as in the reference's GradientMachine
+(paddle/gserver/layers/Layer.h).
+
+Naming follows the v2 convention: anonymous layers get
+``__<kind>_<n>__`` and their parameters ``_<layer>.w0`` / ``.wbias``.
+"""
+from __future__ import annotations
+
+import collections
+import copy
+import math
+
+import numpy as np
+
+from paddle_tpu.fluid.param_attr import ParamAttr
+
+from . import activation as v2_act
+from . import pooling as v2_pool
+from .attr import to_param_attr
+from .config_base import Layer
+
+__all__ = [
+    "data", "fc", "embedding", "table_projection", "img_conv", "img_pool",
+    "batch_norm", "concat", "addto", "dropout", "cos_sim", "max_id",
+    "pooling", "last_seq", "first_seq", "lstmemory", "gru_memory",
+    "classification_cost", "cross_entropy_cost", "square_error_cost",
+    "mse_cost", "regression_cost", "crf", "crf_decoding", "ctc",
+    "AggregateLevel", "ExpandLevel", "parse_network",
+]
+
+_name_counters = collections.defaultdict(lambda: iter(range(1 << 30)))
+
+
+def _auto_name(kind, name=None):
+    if name is not None:
+        return name
+    return "__%s_%d__" % (kind, next(_name_counters[kind]))
+
+
+def _layer_param_attr(layer_name, attr, suffix):
+    """v2 parameter naming: anonymous params are owned by the layer
+    (``_<layer>.w0``) so Parameters.keys() is stable and savable.
+    The user's attr object is copied before naming — one anonymous
+    ParamAttr reused across layers must NOT alias their weights."""
+    fa = to_param_attr(attr)
+    if fa is None:
+        fa = ParamAttr()
+    if isinstance(fa, ParamAttr) and fa.name is None:
+        fa = copy.copy(fa)
+        fa.name = "_%s.%s" % (layer_name, suffix)
+    return fa
+
+
+def _bias_attr(layer_name, attr):
+    if attr is False:
+        return False
+    return _layer_param_attr(layer_name, None if attr in (None, True)
+                             else attr, "wbias")
+
+
+class AggregateLevel:
+    TO_NO_SEQUENCE = "non-seq"
+    TO_SEQUENCE = "seq"
+    # legacy aliases
+    EACH_TIMESTEP = TO_NO_SEQUENCE
+    EACH_SEQUENCE = TO_SEQUENCE
+
+
+class ExpandLevel:
+    FROM_NO_SEQUENCE = AggregateLevel.TO_NO_SEQUENCE
+    FROM_SEQUENCE = AggregateLevel.TO_SEQUENCE
+
+
+def _inputs(input):
+    return list(input) if isinstance(input, (list, tuple)) else [input]
+
+
+# ---------------------------------------------------------------- data
+def data(name, type, height=None, width=None, **kwargs):
+    def build(ctx):
+        return ctx.fluid.layers.data(
+            name=name, shape=type.shape, dtype=type.dtype,
+            lod_level=type.lod_level)
+
+    return Layer(name, build, inputs=(), data_type=type, size=type.dim)
+
+
+# ------------------------------------------------------------------ fc
+def fc(input, size, act=None, name=None, param_attr=None, bias_attr=None,
+       layer_attr=None):
+    name = _auto_name("fc_layer", name)
+    ins = _inputs(input)
+    fluid_act = v2_act.to_fluid_act(act)
+
+    def build(ctx, *xs):
+        pas = [_layer_param_attr(name, param_attr, "w%d" % i)
+               for i in range(len(xs))]
+        return ctx.fluid.layers.fc(
+            list(xs), size=size, act=fluid_act,
+            param_attr=pas if len(pas) > 1 else pas[0],
+            bias_attr=_bias_attr(name, bias_attr), name=name)
+
+    return Layer(name, build, inputs=ins, size=size)
+
+
+# ----------------------------------------------------------- embedding
+def embedding(input, size, param_attr=None, name=None, layer_attr=None):
+    name = _auto_name("embedding", name)
+    ins = _inputs(input)
+    vocab = ins[0].size
+
+    def build(ctx, x):
+        return ctx.fluid.layers.embedding(
+            x, size=[vocab, size],
+            param_attr=_layer_param_attr(name, param_attr, "w0"))
+
+    return Layer(name, build, inputs=ins, size=size)
+
+
+def table_projection(input, size, param_attr=None, name=None):
+    """v1 table_projection == embedding lookup (the projection /
+    layer split is a gserver artifact; one lookup_table op here)."""
+    return embedding(input, size, param_attr=param_attr, name=name)
+
+
+# ---------------------------------------------------------------- conv
+def _img_hw(layer, num_channels, height=None, width=None):
+    if height and width:
+        return int(height), int(width)
+    if layer.size is None:
+        raise ValueError("cannot infer image size for %s" % layer.name)
+    hw = int(round(math.sqrt(layer.size // num_channels)))
+    if hw * hw * num_channels != layer.size:
+        raise ValueError(
+            "input of %d values is not a square %d-channel image; pass "
+            "height=/width=" % (layer.size, num_channels))
+    return hw, hw
+
+
+def img_conv(input, filter_size, num_filters, num_channels=None, stride=1,
+             padding=None, act=None, name=None, param_attr=None,
+             bias_attr=None, groups=None, filter_size_y=None, stride_y=None,
+             padding_y=None, trans=False, layer_attr=None, shared_biases=True):
+    name = _auto_name("conv", name)
+    ins = _inputs(input)
+    src = ins[0]
+    nc = num_channels if num_channels is not None else 1
+    # reference img_conv_layer defaults padding=0 — keep output shapes
+    # (and parameter tars) compatible with migrated scripts
+    pad = padding if padding is not None else 0
+    fluid_act = v2_act.to_fluid_act(act)
+    fsize = [filter_size, filter_size_y or filter_size]
+    strd = [stride, stride_y or stride]
+    padv = [pad, padding_y if padding_y is not None else pad]
+
+    def build(ctx, x):
+        if len(x.shape) == 2:  # dense_vector input: recover C,H,W
+            h, w = _img_hw(src, nc)
+            x = ctx.fluid.layers.reshape(x, [-1, nc, h, w])
+        conv_fn = ctx.fluid.layers.conv2d_transpose if trans \
+            else ctx.fluid.layers.conv2d
+        return conv_fn(
+            x, num_filters=num_filters, filter_size=fsize, stride=strd,
+            padding=padv, groups=groups, act=fluid_act,
+            param_attr=_layer_param_attr(name, param_attr, "w0"),
+            bias_attr=_bias_attr(name, bias_attr), name=name)
+
+    out = Layer(name, build, inputs=ins)
+    out.num_channels = num_filters
+    return out
+
+
+def img_pool(input, pool_size, num_channels=None, pool_type=None, stride=1,
+             padding=0, name=None, pool_size_y=None, stride_y=None,
+             padding_y=None, layer_attr=None, ceil_mode=True,
+             exclude_mode=None):
+    name = _auto_name("pool", name)
+    ins = _inputs(input)
+    src = ins[0]
+    nc = num_channels or getattr(src, "num_channels", 1)
+    ptype = v2_pool.to_fluid_pool(pool_type)
+
+    def build(ctx, x):
+        if len(x.shape) == 2:
+            h, w = _img_hw(src, nc)
+            x = ctx.fluid.layers.reshape(x, [-1, nc, h, w])
+        return ctx.fluid.layers.pool2d(
+            x, pool_size=[pool_size, pool_size_y or pool_size],
+            pool_type=ptype, pool_stride=[stride, stride_y or stride],
+            pool_padding=[padding,
+                          padding_y if padding_y is not None else padding],
+            ceil_mode=ceil_mode)
+
+    out = Layer(name, build, inputs=ins)
+    out.num_channels = nc
+    return out
+
+
+def batch_norm(input, act=None, name=None, img3D=False, num_channels=None,
+               bias_attr=None, param_attr=None, layer_attr=None,
+               batch_norm_type=None, moving_average_fraction=0.9,
+               use_global_stats=None, mean_var_names=None):
+    name = _auto_name("batch_norm", name)
+    ins = _inputs(input)
+    fluid_act = v2_act.to_fluid_act(act)
+
+    def build(ctx, x):
+        return ctx.fluid.layers.batch_norm(
+            x, act=fluid_act, is_test=ctx.is_test,
+            momentum=moving_average_fraction,
+            param_attr=_layer_param_attr(name, param_attr, "w0"),
+            bias_attr=_bias_attr(name, bias_attr), name=name)
+
+    out = Layer(name, build, inputs=ins)
+    out.num_channels = getattr(ins[0], "num_channels", None)
+    return out
+
+
+# ------------------------------------------------------- combinations
+def concat(input, name=None, act=None, layer_attr=None):
+    name = _auto_name("concat", name)
+    ins = _inputs(input)
+    fluid_act = v2_act.to_fluid_act(act)
+
+    def build(ctx, *xs):
+        out = ctx.fluid.layers.concat(list(xs), axis=len(xs[0].shape) - 1)
+        if fluid_act:
+            out = getattr(ctx.fluid.layers, fluid_act)(out)
+        return out
+
+    size = sum(x.size for x in ins) if all(x.size for x in ins) else None
+    return Layer(name, build, inputs=ins, size=size)
+
+
+def addto(input, act=None, name=None, bias_attr=None, layer_attr=None):
+    name = _auto_name("addto", name)
+    ins = _inputs(input)
+    fluid_act = v2_act.to_fluid_act(act)
+
+    def build(ctx, *xs):
+        out = ctx.fluid.layers.sums(list(xs))
+        if fluid_act:
+            out = getattr(ctx.fluid.layers, fluid_act)(out)
+        return out
+
+    return Layer(name, build, inputs=ins, size=ins[0].size)
+
+
+def dropout(input, dropout_rate, name=None):
+    name = _auto_name("dropout", name)
+    ins = _inputs(input)
+
+    def build(ctx, x):
+        return ctx.fluid.layers.dropout(x, dropout_prob=dropout_rate,
+                                        is_test=ctx.is_test)
+
+    return Layer(name, build, inputs=ins, size=ins[0].size)
+
+
+def cos_sim(a, b, scale=1, size=1, name=None, layer_attr=None):
+    name = _auto_name("cos_sim", name)
+
+    def build(ctx, xa, xb):
+        out = ctx.fluid.layers.cos_sim(xa, xb)
+        if scale != 1:
+            out = ctx.fluid.layers.scale(out, scale=float(scale))
+        return out
+
+    return Layer(name, build, inputs=[a, b], size=1)
+
+
+def max_id(input, name=None, layer_attr=None):
+    name = _auto_name("maxid", name)
+    ins = _inputs(input)
+
+    def build(ctx, x):
+        return ctx.fluid.layers.argmax(x, axis=len(x.shape) - 1)
+
+    return Layer(name, build, inputs=ins, size=1)
+
+
+# ------------------------------------------------------------ sequence
+def pooling(input, pooling_type=None, agg_level=None, name=None,
+            layer_attr=None):
+    name = _auto_name("seq_pool", name)
+    ins = _inputs(input)
+    ptype = v2_pool.to_fluid_pool(pooling_type, default="sum")
+    # sequence_pool spells the mean reduction "average" (pool2d: "avg")
+    ptype = {"avg": "average"}.get(ptype, ptype)
+
+    def build(ctx, x):
+        return ctx.fluid.layers.sequence_pool(x, pool_type=ptype)
+
+    return Layer(name, build, inputs=ins, size=ins[0].size)
+
+
+def last_seq(input, agg_level=None, name=None, layer_attr=None):
+    name = _auto_name("last_seq", name)
+    ins = _inputs(input)
+
+    def build(ctx, x):
+        return ctx.fluid.layers.sequence_last_step(x)
+
+    return Layer(name, build, inputs=ins, size=ins[0].size)
+
+
+def first_seq(input, agg_level=None, name=None, layer_attr=None):
+    name = _auto_name("first_seq", name)
+    ins = _inputs(input)
+
+    def build(ctx, x):
+        return ctx.fluid.layers.sequence_first_step(x)
+
+    return Layer(name, build, inputs=ins, size=ins[0].size)
+
+
+def lstmemory(input, name=None, size=None, reverse=False, act=None,
+              gate_act=None, state_act=None, bias_attr=None,
+              param_attr=None, layer_attr=None):
+    """v1 lstmemory consumes a 4x-projected input (networks.simple_lstm
+    does fc(size*4) first); same contract here over fluid dynamic_lstm
+    lowered to lax.scan."""
+    name = _auto_name("lstmemory", name)
+    ins = _inputs(input)
+    width = size if size is not None else ins[0].size // 4
+
+    # an EXPLICIT Linear()/Identity() must stay linear — only an omitted
+    # activation falls back to the v1 defaults
+    def _act_or(a, default):
+        return default if a is None else v2_act.to_fluid_act(a)
+
+    def build(ctx, x):
+        h, _c = ctx.fluid.layers.dynamic_lstm(
+            x, size=width * 4, is_reverse=reverse,
+            gate_activation=_act_or(gate_act, "sigmoid"),
+            cell_activation=_act_or(state_act, "tanh"),
+            candidate_activation=_act_or(act, "tanh"),
+            param_attr=_layer_param_attr(name, param_attr, "w0"),
+            bias_attr=_bias_attr(name, bias_attr))
+        return h
+
+    return Layer(name, build, inputs=ins, size=width)
+
+
+def gru_memory(input, size=None, name=None, reverse=False, act=None,
+               gate_act=None, param_attr=None, bias_attr=None):
+    name = _auto_name("gru", name)
+    ins = _inputs(input)
+    width = size if size is not None else ins[0].size // 3
+
+    def build(ctx, x):
+        return ctx.fluid.layers.dynamic_gru(
+            x, size=width, is_reverse=reverse,
+            param_attr=_layer_param_attr(name, param_attr, "w0"),
+            bias_attr=_bias_attr(name, bias_attr))
+
+    return Layer(name, build, inputs=ins, size=width)
+
+
+# --------------------------------------------------------------- costs
+def classification_cost(input, label, weight=None, name=None,
+                        evaluator=None, layer_attr=None):
+    """Softmax-output + cross-entropy; attaches the v2
+    classification_error evaluator as a topology metric."""
+    name = _auto_name("cost", name)
+
+    def build(ctx, pred, lab, *rest):
+        ce = ctx.fluid.layers.cross_entropy(input=pred, label=lab)
+        if rest:
+            ce = ctx.fluid.layers.elementwise_mul(ce, rest[0])
+        cost = ctx.fluid.layers.mean(ce)
+        acc = ctx.fluid.layers.accuracy(input=pred, label=lab)
+        err = ctx.fluid.layers.scale(acc, scale=-1.0, bias=1.0)
+        ctx.add_metric("classification_error_evaluator", err)
+        return cost
+
+    ins = [input, label] + ([weight] if weight is not None else [])
+    return Layer(name, build, inputs=ins, size=1)
+
+
+def cross_entropy_cost(input, label, name=None, coeff=1.0, weight=None,
+                       layer_attr=None):
+    name = _auto_name("cost", name)
+
+    def build(ctx, pred, lab):
+        ce = ctx.fluid.layers.cross_entropy(input=pred, label=lab)
+        out = ctx.fluid.layers.mean(ce)
+        if coeff != 1.0:
+            out = ctx.fluid.layers.scale(out, scale=float(coeff))
+        return out
+
+    return Layer(name, build, inputs=[input, label], size=1)
+
+
+def square_error_cost(input, label, name=None, coeff=1.0, layer_attr=None):
+    name = _auto_name("cost", name)
+
+    def build(ctx, pred, lab):
+        out = ctx.fluid.layers.mean(
+            ctx.fluid.layers.square_error_cost(input=pred, label=lab))
+        if coeff != 1.0:
+            out = ctx.fluid.layers.scale(out, scale=float(coeff))
+        return out
+
+    return Layer(name, build, inputs=[input, label], size=1)
+
+
+mse_cost = square_error_cost
+regression_cost = square_error_cost
+
+
+def crf(input, label, size=None, weight=None, param_attr=None, name=None):
+    name = _auto_name("crf", name)
+
+    def build(ctx, x, lab):
+        ll = ctx.fluid.layers.linear_chain_crf(
+            x, lab, param_attr=_layer_param_attr(name, param_attr, "w0"))
+        return ctx.fluid.layers.mean(ll)
+
+    return Layer(name, build, inputs=[input, label], size=1)
+
+
+def crf_decoding(input, size=None, label=None, param_attr=None, name=None):
+    name = _auto_name("crf_decoding", name)
+    ins = [input] + ([label] if label is not None else [])
+
+    def build(ctx, x, *rest):
+        return ctx.fluid.layers.crf_decoding(
+            x, param_attr=_layer_param_attr(name, param_attr, "w0"),
+            label=rest[0] if rest else None)
+
+    return Layer(name, build, inputs=ins)
+
+
+def ctc(input, label, size=None, name=None, norm_by_times=False):
+    name = _auto_name("ctc", name)
+
+    def build(ctx, x, lab):
+        return ctx.fluid.layers.mean(
+            ctx.fluid.layers.warpctc(x, lab,
+                                     norm_by_times=norm_by_times))
+
+    return Layer(name, build, inputs=[input, label], size=1)
+
+
+_FLUID_POINTERS = {
+    "recurrent_group": "fluid.layers.DynamicRNN / StaticRNN",
+    "memory": "fluid.layers.DynamicRNN memories",
+    "mixed": "explicit fc/embedding + layer.addto",
+    "beam_search": "fluid.layers.beam_search",
+    "seq_concat": "fluid.layers.sequence_concat",
+    "expand": "fluid.layers.sequence_expand",
+    "conv_projection": "fluid.layers.conv2d",
+    "full_matrix_projection": "layer.fc",
+}
+
+
+def __getattr__(name):
+    """Unported v1 layer names fail loudly with their fluid equivalent
+    instead of a bare AttributeError (the migration contract covers the
+    subset in __all__; everything else has a fluid successor)."""
+    hint = _FLUID_POINTERS.get(name)
+    raise AttributeError(
+        "paddle_tpu.v2.layer.%s is not in the ported v2 subset "
+        "(see paddle_tpu/v2/layer.py __all__); use %s"
+        % (name, hint or "the fluid.layers equivalent"))
+
+
+# ------------------------------------------------------------- utility
+def parse_network(*outputs):
+    """Materialize the DAG ending at ``outputs`` and return the fluid
+    ProgramDesc (reference returns the parsed ModelConfig proto)."""
+    from .topology import Topology
+    outs = []
+    for o in outputs:
+        outs.extend(o if isinstance(o, (list, tuple)) else [o])
+    return Topology(outs[0], extra_layers=outs[1:]).main_program.desc

@@ -1,0 +1,76 @@
+"""v2 composite networks (reference python/paddle/v2/networks.py ->
+trainer_config_helpers/networks.py): stock combinations of layers."""
+from __future__ import annotations
+
+from . import activation as v2_act
+from . import layer as v2_layer
+from . import pooling as v2_pooling
+
+__all__ = ["simple_img_conv_pool", "img_conv_pool", "simple_lstm",
+           "simple_gru", "sequence_conv_pool"]
+
+
+def simple_img_conv_pool(input, filter_size, num_filters, pool_size,
+                         pool_stride, act=None, num_channel=None,
+                         param_attr=None, pool_type=None, **kwargs):
+    conv = v2_layer.img_conv(
+        input=input, filter_size=filter_size, num_filters=num_filters,
+        num_channels=num_channel, act=act, param_attr=param_attr,
+        **kwargs)
+    return v2_layer.img_pool(input=conv, pool_size=pool_size,
+                             num_channels=num_filters,
+                             pool_type=pool_type, stride=pool_stride)
+
+
+img_conv_pool = simple_img_conv_pool
+
+
+def simple_lstm(input, size, name=None, reverse=False, mat_param_attr=None,
+                bias_param_attr=None, inner_param_attr=None, act=None,
+                gate_act=None, state_act=None, **kwargs):
+    """fc(4*size) feeding an lstmemory — the v1 composition
+    (trainer_config_helpers/networks.py simple_lstm)."""
+    mixed = v2_layer.fc(input=input, size=size * 4, act=v2_act.Linear(),
+                        param_attr=mat_param_attr, bias_attr=False)
+    return v2_layer.lstmemory(
+        input=mixed, name=name, size=size, reverse=reverse, act=act,
+        gate_act=gate_act, state_act=state_act,
+        param_attr=inner_param_attr, bias_attr=bias_param_attr)
+
+
+def simple_gru(input, size, name=None, reverse=False, mixed_param_attr=None,
+               gru_param_attr=None, gru_bias_attr=None, act=None,
+               gate_act=None, **kwargs):
+    mixed = v2_layer.fc(input=input, size=size * 3, act=v2_act.Linear(),
+                        param_attr=mixed_param_attr, bias_attr=False)
+    return v2_layer.gru_memory(
+        input=mixed, name=name, size=size, reverse=reverse, act=act,
+        gate_act=gate_act, param_attr=gru_param_attr,
+        bias_attr=gru_bias_attr)
+
+
+def sequence_conv_pool(input, context_len, hidden_size, name=None,
+                       context_start=None, pool_type=None,
+                       context_proj_param_attr=None, fc_param_attr=None,
+                       fc_bias_attr=None, fc_act=None, **kwargs):
+    """Text-conv + pooling (v1 sequence_conv_pool): a real context
+    window of ``context_len`` timesteps via the fluid sequence_conv
+    op, then sequence pooling."""
+    from .config_base import Layer
+    from .layer import _auto_name, _bias_attr, _layer_param_attr
+
+    conv_name = _auto_name("seq_conv", name)
+    ins = [input]
+    # explicit Linear() stays linear; only an omitted act gets tanh
+    act = "tanh" if fc_act is None else v2_act.to_fluid_act(fc_act)
+
+    def build(ctx, x):
+        return ctx.fluid.layers.sequence_conv(
+            x, num_filters=hidden_size, filter_size=context_len,
+            act=act,
+            param_attr=_layer_param_attr(conv_name, fc_param_attr, "w0"),
+            bias_attr=_bias_attr(conv_name, fc_bias_attr))
+
+    conv = Layer(conv_name, build, inputs=ins, size=hidden_size)
+    return v2_layer.pooling(
+        input=conv, pooling_type=pool_type or v2_pooling.Max(), name=name)

@@ -1,0 +1,154 @@
+"""v2 optimizers (reference python/paddle/v2/optimizer.py wrapping the
+swig ParameterUpdater).  Each maps onto the fluid optimizer family —
+one jitted update fused into the training step, not a per-parameter
+updater loop."""
+from __future__ import annotations
+
+import paddle_tpu.fluid as fluid
+
+__all__ = ["Optimizer", "Momentum", "Adam", "Adamax", "AdaGrad",
+           "DecayedAdaGrad", "AdaDelta", "RMSProp",
+           "L1Regularization", "L2Regularization", "ModelAverage"]
+
+
+class L2Regularization:
+    def __init__(self, rate):
+        self.rate = float(rate)
+
+    def to_fluid(self):
+        return fluid.regularizer.L2DecayRegularizer(self.rate)
+
+
+class L1Regularization:
+    def __init__(self, rate):
+        self.rate = float(rate)
+
+    def to_fluid(self):
+        return fluid.regularizer.L1DecayRegularizer(self.rate)
+
+
+class ModelAverage:
+    """Accepted for signature parity; the fluid ModelAverage wrapper is
+    the supported route (fluid/average.py)."""
+
+    def __init__(self, average_window, max_average_window=None,
+                 do_average_in_cpu=False):
+        self.average_window = average_window
+
+
+class Optimizer:
+    def __init__(self, learning_rate=1e-3, regularization=None,
+                 model_average=None, gradient_clipping_threshold=None,
+                 learning_rate_decay_a=0.0, learning_rate_decay_b=0.0,
+                 learning_rate_schedule=None, **kwargs):
+        self.learning_rate = learning_rate
+        self.regularization = regularization
+        self.model_average = model_average
+        self.gradient_clipping_threshold = gradient_clipping_threshold
+        # decaying schedules rode the v1 trainer's sample counter; the
+        # fluid lr-scheduler layers are the supported route — fail loud
+        # rather than silently train at a constant lr
+        if learning_rate_schedule not in (None, "constant"):
+            raise NotImplementedError(
+                "learning_rate_schedule=%r: use "
+                "fluid.layers.learning_rate_scheduler (exponential/"
+                "polynomial/piecewise decay) with fluid.optimizer"
+                % (learning_rate_schedule,))
+
+    def _reg(self):
+        return self.regularization.to_fluid() \
+            if self.regularization is not None else None
+
+    def _apply_clip(self, topo):
+        """Install the v1 per-parameter L2-norm clip before minimize
+        (reference gradient_clipping_threshold semantics)."""
+        if not self.gradient_clipping_threshold:
+            return
+        import paddle_tpu.fluid.clip as fclip
+        fclip.set_gradient_clip(
+            fclip.GradientClipByNorm(self.gradient_clipping_threshold),
+            program=topo.main_program)
+
+    def to_fluid(self):
+        raise NotImplementedError
+
+    def enable_types(self):  # reference-API shim
+        return []
+
+
+class Momentum(Optimizer):
+    def __init__(self, momentum=None, sparse=False, **kwargs):
+        super().__init__(**kwargs)
+        self.momentum = momentum or 0.0
+        self.sparse = sparse
+
+    def to_fluid(self):
+        if not self.momentum:
+            return fluid.optimizer.SGD(
+                learning_rate=self.learning_rate,
+                regularization=self._reg())
+        return fluid.optimizer.Momentum(
+            learning_rate=self.learning_rate, momentum=self.momentum,
+            regularization=self._reg())
+
+
+class Adam(Optimizer):
+    def __init__(self, beta1=0.9, beta2=0.999, epsilon=1e-8, **kwargs):
+        super().__init__(**kwargs)
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+
+    def to_fluid(self):
+        return fluid.optimizer.Adam(
+            learning_rate=self.learning_rate, beta1=self.beta1,
+            beta2=self.beta2, epsilon=self.epsilon,
+            regularization=self._reg())
+
+
+class Adamax(Optimizer):
+    def __init__(self, beta1=0.9, beta2=0.999, **kwargs):
+        super().__init__(**kwargs)
+        self.beta1, self.beta2 = beta1, beta2
+
+    def to_fluid(self):
+        return fluid.optimizer.Adamax(
+            learning_rate=self.learning_rate, beta1=self.beta1,
+            beta2=self.beta2, regularization=self._reg())
+
+
+class AdaGrad(Optimizer):
+    def to_fluid(self):
+        return fluid.optimizer.Adagrad(
+            learning_rate=self.learning_rate, regularization=self._reg())
+
+
+class DecayedAdaGrad(Optimizer):
+    def __init__(self, rho=0.95, epsilon=1e-06, **kwargs):
+        super().__init__(**kwargs)
+        self.rho, self.epsilon = rho, epsilon
+
+    def to_fluid(self):
+        return fluid.optimizer.DecayedAdagrad(
+            learning_rate=self.learning_rate, decay=self.rho,
+            epsilon=self.epsilon, regularization=self._reg())
+
+
+class AdaDelta(Optimizer):
+    def __init__(self, rho=0.95, epsilon=1e-06, **kwargs):
+        super().__init__(**kwargs)
+        self.rho, self.epsilon = rho, epsilon
+
+    def to_fluid(self):
+        return fluid.optimizer.Adadelta(
+            learning_rate=self.learning_rate, rho=self.rho,
+            epsilon=self.epsilon, regularization=self._reg())
+
+
+class RMSProp(Optimizer):
+    def __init__(self, rho=0.95, epsilon=1e-6, **kwargs):
+        super().__init__(**kwargs)
+        self.rho, self.epsilon = rho, epsilon
+
+    def to_fluid(self):
+        return fluid.optimizer.RMSProp(
+            learning_rate=self.learning_rate, rho=self.rho,
+            epsilon=self.epsilon, regularization=self._reg())

@@ -1,0 +1,124 @@
+"""v2 Parameters: a name->ndarray view over the topology's scope
+(reference python/paddle/v2/parameters.py backed by the C++
+GradientMachine's parameter blocks).
+
+``create(cost)`` materializes the topology, runs its startup program
+(random init) and returns the live view; training through
+:class:`~paddle_tpu.v2.trainer.SGD` mutates the same scope, so reads
+after training see trained values — matching the reference's shared
+parameter storage without the swig mirror copies.
+
+Serialization is a plain POSIX tar of ``<name>.npy`` members (the
+reference used its own header+body binary inside a tar).
+"""
+from __future__ import annotations
+
+import io
+import json
+import tarfile
+
+import numpy as np
+
+from .topology import Topology
+
+__all__ = ["Parameters", "create"]
+
+
+def create(cost, extra_layers=None):
+    topo = Topology(cost, extra_layers=extra_layers)
+    topo.run_startup()
+    return Parameters(topo)
+
+
+class Parameters:
+    def __init__(self, topology=None):
+        self.topology = topology
+        self._loaded = {}  # values staged before a topology exists
+
+    # -- dict-ish ----------------------------------------------------
+    def names(self):
+        if self.topology is not None:
+            return list(self.topology.parameter_names())
+        return list(self._loaded)
+
+    keys = names
+
+    def has_key(self, name):
+        return name in self.names()
+
+    def __contains__(self, name):
+        return self.has_key(name)
+
+    def __iter__(self):
+        return iter(self.names())
+
+    def get(self, name):
+        if self.topology is not None:
+            if not self.topology.scope.has_var(name):
+                raise KeyError("no parameter %r" % name)
+            return np.asarray(self.topology.scope.find_var(name))
+        return self._loaded[name]
+
+    __getitem__ = get
+
+    def set(self, name, value):
+        value = np.asarray(value)
+        if self.topology is not None:
+            if self.topology.scope.has_var(name):
+                cur = self.topology.scope.find_var(name)
+                if cur is not None and tuple(np.shape(cur)) != value.shape:
+                    raise ValueError(
+                        "shape mismatch for %r: scope %r vs value %r"
+                        % (name, tuple(np.shape(cur)), value.shape))
+            self.topology.scope.set(name, value)
+        else:
+            self._loaded[name] = value
+
+    __setitem__ = set
+
+    def get_shape(self, name):
+        return tuple(np.shape(self.get(name)))
+
+    # -- tar serialization -------------------------------------------
+    def to_tar(self, f):
+        with tarfile.open(fileobj=f, mode="w") as tf:
+            meta = json.dumps({"names": self.names()}).encode()
+            self._add_member(tf, "__meta__.json", meta)
+            for name in self.names():
+                buf = io.BytesIO()
+                np.save(buf, self.get(name), allow_pickle=False)
+                self._add_member(tf, name + ".npy", buf.getvalue())
+
+    @staticmethod
+    def _add_member(tf, name, payload):
+        info = tarfile.TarInfo(name)
+        info.size = len(payload)
+        tf.addfile(info, io.BytesIO(payload))
+
+    @staticmethod
+    def from_tar(f):
+        p = Parameters()
+        p.init_from_tar(f)
+        return p
+
+    def init_from_tar(self, f):
+        """Merge values from a tar written by ``to_tar`` — only names
+        known to this Parameters' topology (if any) are applied, like
+        the reference's name-matched init."""
+        with tarfile.open(fileobj=f, mode="r") as tf:
+            for member in tf.getmembers():
+                if not member.name.endswith(".npy"):
+                    continue
+                name = member.name[:-len(".npy")]
+                arr = np.load(io.BytesIO(tf.extractfile(member).read()))
+                if self.topology is None or \
+                        self.topology.scope.has_var(name):
+                    self.set(name, arr)
+
+    # -- reference-API shims -----------------------------------------
+    def append_gradient_machine(self, gm):  # pragma: no cover
+        """No gradient machine exists here — training shares the scope
+        already (kept so reference scripts don't crash)."""
+
+    def update_param_conf(self, proto):  # pragma: no cover
+        pass

@@ -1,0 +1,49 @@
+"""v2 pooling type objects (reference python/paddle/v2/pooling.py /
+trainer_config_helpers poolings)."""
+from __future__ import annotations
+
+__all__ = ["BasePool", "Max", "Avg", "Sum", "CudnnMax", "CudnnAvg",
+           "SquareRootN"]
+
+
+class BasePool:
+    fluid_pool = None
+
+    def __repr__(self):
+        return "pooling.%s()" % type(self).__name__
+
+
+class Max(BasePool):
+    fluid_pool = "max"
+
+
+class Avg(BasePool):
+    fluid_pool = "avg"
+
+
+class Sum(BasePool):
+    fluid_pool = "sum"
+
+
+class SquareRootN(BasePool):
+    fluid_pool = "sqrt"
+
+
+# device-specific aliases: on TPU there is one lowering
+class CudnnMax(Max):
+    pass
+
+
+class CudnnAvg(Avg):
+    pass
+
+
+def to_fluid_pool(pool_type, default="max"):
+    if pool_type is None:
+        return default
+    if isinstance(pool_type, str):
+        return pool_type
+    if isinstance(pool_type, BasePool):
+        return pool_type.fluid_pool
+    raise TypeError("expected a paddle_tpu.v2.pooling object, got %r"
+                    % (pool_type,))

@@ -1,0 +1,108 @@
+"""Topology: materialize a v2 layer DAG into a fluid Program
+(reference python/paddle/v2/topology.py, which serializes the v1 global
+config into a ModelConfig proto for the GradientMachine).
+
+Here the product is a fluid Program + Scope: one whole-model XLA
+computation instead of a per-layer gserver graph.  The topology owns
+
+  * ``main_program`` / ``startup_program`` / ``scope``
+  * the ordered data layers (the v2 default feeding order)
+  * named metric vars attached by cost layers (classification_error)
+
+Startup runs are *incremental*: ``run_startup`` executes only ops
+appended since the last call, so appending an optimizer (SGD trainer)
+initializes accumulators without re-randomizing weights the user may
+already have loaded into the scope.
+"""
+from __future__ import annotations
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.core.scope import Scope
+
+from .config_base import Layer
+
+__all__ = ["Topology"]
+
+
+class Topology:
+    def __init__(self, cost, extra_layers=None, is_test=False):
+        if not isinstance(cost, Layer):
+            raise TypeError("expected a paddle_tpu.v2 layer, got %r"
+                            % (cost,))
+        self.cost_layer = cost
+        self.extra_layers = list(extra_layers or [])
+        self.is_test = is_test
+        self.fluid = fluid
+        self.metrics = {}          # name -> fluid var
+        self.scope = Scope()
+        self.main_program = fluid.Program()
+        self.startup_program = fluid.Program()
+        self._memo = {}
+        self._startup_ops_run = 0
+        roots = [cost] + self.extra_layers
+        with fluid.scope_guard(self.scope):
+            with fluid.program_guard(self.main_program,
+                                     self.startup_program):
+                with fluid.unique_name.guard():
+                    for root in roots:
+                        self._build(root)
+        self.cost_var = self._memo[id(cost)]
+
+    # -- ctx interface used by layer builders ------------------------
+    def add_metric(self, name, var):
+        self.metrics[name] = var
+
+    # -- materialization ---------------------------------------------
+    def _build(self, node):
+        if id(node) in self._memo:
+            return self._memo[id(node)]
+        xs = [self._build(i) for i in node.inputs]
+        var = node.builder(self, *xs)
+        self._memo[id(node)] = var
+        return var
+
+    def var_of(self, node):
+        """Fluid variable for a layer node, building it into the main
+        program if the node wasn't on the cost path."""
+        if id(node) not in self._memo:
+            with fluid.scope_guard(self.scope):
+                with fluid.program_guard(self.main_program,
+                                         self.startup_program):
+                    with fluid.unique_name.guard():
+                        self._build(node)
+        return self._memo[id(node)]
+
+    # -- v2 contract -------------------------------------------------
+    def data_layers(self):
+        seen, out = set(), []
+        for root in [self.cost_layer] + self.extra_layers:
+            for d in root.data_layers():
+                if d.name not in seen:
+                    seen.add(d.name)
+                    out.append(d)
+        return sorted(out, key=lambda n: n.index)
+
+    def data_type(self):
+        """[(name, InputType)] in feeding order (reference
+        Topology.data_type)."""
+        return [(d.name, d.data_type) for d in self.data_layers()]
+
+    def parameter_names(self):
+        return [p.name for p in self.main_program.global_block()
+                .all_parameters()]
+
+    def run_startup(self, place=None):
+        """Execute startup ops appended since the last call."""
+        ops = self.startup_program.desc.blocks[0].ops
+        if self._startup_ops_run >= len(ops):
+            return
+        delta = self.startup_program.clone()
+        del delta.desc.blocks[0].ops[:self._startup_ops_run]
+        exe = fluid.Executor(place or fluid.CPUPlace())
+        exe.run(delta, scope=self.scope)
+        self._startup_ops_run = len(ops)
+
+    def proto(self):
+        """Serialized program (reference returns the ModelConfig
+        proto; the ProgramDesc is this framework's model config)."""
+        return self.main_program.desc

@@ -1,0 +1,187 @@
+"""v2 SGD trainer (reference python/paddle/v2/trainer.py:137 SGD.train):
+reader + topology + update_equation -> training loop with events.
+
+TPU-native: instead of the reference's per-batch
+GradientMachine.forwardBackward + per-parameter updater loop, the whole
+step (forward, backward, update) is ONE fluid program the executor jits
+to a single XLA computation; the event loop only moves host data and
+fires callbacks.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import paddle_tpu.fluid as fluid
+
+from . import event as v2_event
+from . import optimizer as v2_optimizer
+from . import parameters as v2_parameters
+from .config_base import Layer
+from .topology import Topology
+
+__all__ = ["SGD"]
+
+
+def default_event_handler(event):
+    pass
+
+
+class _Feeder:
+    """minibatch rows -> fluid feed dict, honoring v2 ``feeding``
+    (name -> column index) and InputType column conversion."""
+
+    def __init__(self, data_types, feeding=None):
+        self.slots = []  # (name, InputType, column)
+        if feeding is None:
+            feeding = {name: i for i, (name, _) in enumerate(data_types)}
+        elif isinstance(feeding, (list, tuple)):
+            feeding = {name: i for i, name in enumerate(feeding)}
+        for name, itype in data_types:
+            self.slots.append((name, itype, feeding[name]))
+
+    def __call__(self, batch):
+        feed = {}
+        for name, itype, col in self.slots:
+            cols = [itype.convert_column(row[col]) for row in batch]
+            if itype.lod_level == 0:
+                arr = np.asarray(
+                    cols, dtype=np.int64
+                    if itype.dtype == "int64" else np.float32)
+                if itype.dtype == "int64" and arr.ndim == 1:
+                    arr = arr[:, None]
+                feed[name] = arr
+            else:
+                from paddle_tpu.fluid.data_feeder import \
+                    DataToLoDTensorConverter
+                conv = DataToLoDTensorConverter(
+                    shape=itype.shape if itype.dtype != "int64" else [1],
+                    dtype=itype.dtype, lod_level=itype.lod_level)
+                for c in cols:
+                    conv.feed(c)
+                feed[name] = conv.done()
+        return feed
+
+
+class SGD:
+    """Combines reader, topology and update_equation (the v2 training
+    entry).  ``parameters`` must come from ``paddle.parameters.create``
+    on the same cost layer — trainer and parameters then share one
+    scope, as the reference shares one GradientMachine."""
+
+    def __init__(self, cost, parameters, update_equation,
+                 extra_layers=None, is_local=True, pserver_spec=None,
+                 use_etcd=True):
+        if not isinstance(parameters, v2_parameters.Parameters):
+            raise TypeError("parameters should be "
+                            "paddle_tpu.v2.parameters.Parameters")
+        if not isinstance(update_equation, v2_optimizer.Optimizer):
+            raise TypeError("update equation parameter must be "
+                            "paddle_tpu.v2.optimizer.Optimizer")
+        if not isinstance(cost, Layer):
+            raise TypeError("cost should be a paddle_tpu.v2 layer")
+        if not is_local:
+            raise NotImplementedError(
+                "v2 cluster training rode the Go pserver stack; use "
+                "fluid.Trainer + the distribute transpiler "
+                "(paddle_tpu.distributed) for distributed runs")
+        topo = parameters.topology
+        if (topo is None or topo.cost_layer is not cost
+                or getattr(topo, "_minimized", False)):
+            # parameters created elsewhere (from_tar), for a different
+            # cost, or already claimed by an earlier trainer (its
+            # program holds that trainer's backward pass): build a
+            # fresh topology and pour the current values in by name —
+            # the new trainer continues from them, and ``parameters``
+            # follows the newest trainer's scope
+            values = {n: parameters.get(n) for n in parameters.names()}
+            topo = Topology(cost, extra_layers=extra_layers)
+            topo.run_startup()
+            for name, val in values.items():
+                if topo.scope.has_var(name):
+                    topo.scope.set(name, val)
+            parameters.topology = topo
+            parameters._loaded.clear()
+        self.__topology__ = topo
+        self.__parameters__ = parameters
+        self.__optimizer__ = update_equation
+        # append backward+update to the SHARED main program, then init
+        # only the optimizer's new accumulator vars (incremental
+        # startup keeps user-loaded weights intact)
+        update_equation._apply_clip(topo)
+        with fluid.scope_guard(topo.scope):
+            with fluid.program_guard(topo.main_program,
+                                     topo.startup_program):
+                with fluid.unique_name.guard():
+                    update_equation.to_fluid().minimize(topo.cost_var)
+        topo._minimized = True
+        topo.run_startup()
+        self.__test_program__ = None
+        self.__data_types__ = topo.data_type()
+
+    def get_topology_proto(self):
+        return self.__topology__.proto()
+
+    def __metric_vars__(self):
+        return list(self.__topology__.metrics.items())
+
+    def train(self, reader, num_passes=1, event_handler=None,
+              feeding=None):
+        if event_handler is None:
+            event_handler = default_event_handler
+        topo = self.__topology__
+        feeder = _Feeder(self.__data_types__, feeding)
+        metric_names = [n for n, _ in self.__metric_vars__()]
+        fetch = [topo.cost_var] + [v for _, v in self.__metric_vars__()]
+        exe = fluid.Executor(fluid.CPUPlace())
+        with fluid.scope_guard(topo.scope):
+            for pass_id in range(num_passes):
+                event_handler(v2_event.BeginPass(pass_id))
+                pass_costs, pass_metrics = [], []
+                for batch_id, batch in enumerate(reader()):
+                    event_handler(v2_event.BeginIteration(pass_id,
+                                                          batch_id))
+                    outs = exe.run(topo.main_program,
+                                   feed=feeder(batch),
+                                   fetch_list=fetch)
+                    event_handler(v2_event.EndForwardBackward(pass_id,
+                                                              batch_id))
+                    cost = float(np.asarray(outs[0]).ravel()[0])
+                    metrics = {n: float(np.asarray(v).ravel()[0])
+                               for n, v in zip(metric_names, outs[1:])}
+                    pass_costs.append(cost)
+                    pass_metrics.append(metrics)
+                    event_handler(v2_event.EndIteration(
+                        pass_id, batch_id, cost, metrics))
+                avg = {n: float(np.mean([m[n] for m in pass_metrics]))
+                       for n in metric_names} if pass_metrics else {}
+                event_handler(v2_event.EndPass(pass_id, avg))
+
+    def test(self, reader, feeding=None):
+        topo = self.__topology__
+        if self.__test_program__ is None:
+            self.__test_program__ = topo.main_program.clone(
+                for_test=True)
+        feeder = _Feeder(self.__data_types__, feeding)
+        metric_names = [n for n, _ in self.__metric_vars__()]
+        fetch = [topo.cost_var.name] + [v.name for _, v in
+                                        self.__metric_vars__()]
+        exe = fluid.Executor(fluid.CPUPlace())
+        costs, metrics, weights = [], [], []
+        with fluid.scope_guard(topo.scope):
+            for batch in reader():
+                outs = exe.run(self.__test_program__,
+                               feed=feeder(batch), fetch_list=fetch)
+                costs.append(float(np.asarray(outs[0]).ravel()[0]))
+                metrics.append([float(np.asarray(v).ravel()[0])
+                                for v in outs[1:]])
+                weights.append(len(batch))
+        w = np.asarray(weights, np.float64)
+        w = w / w.sum() if len(w) else w
+        avg_metrics = {
+            n: float(np.dot(w, [m[i] for m in metrics]))
+            for i, n in enumerate(metric_names)} if metrics else {}
+        cost = float(np.dot(w, costs)) if costs else float("nan")
+        return v2_event.TestResult(cost=cost, metrics=avg_metrics)
+
+    def save_parameter_to_tar(self, f):
+        self.__parameters__.to_tar(f)

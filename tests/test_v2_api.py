@@ -1,0 +1,449 @@
+"""v2 graph-building API (reference python/paddle/v2: layer.py,
+trainer.py:137 SGD.train, parameters.py, inference.py,
+tests/test_layer.py).  A reference v2 script runs with only the import
+line changed: layers declared anywhere, parameters.create(cost),
+trainer.SGD(...).train(reader, event_handler), paddle.infer(...)."""
+import io
+
+import numpy as np
+import pytest
+
+import paddle_tpu.v2 as paddle
+
+
+def _digit_reader(rng, n_batches=20, batch_size=16, dim=64, classes=10):
+    stride = dim // classes
+    def reader():
+        for _ in range(n_batches):
+            batch = []
+            for _ in range(batch_size):
+                y = int(rng.randint(classes))
+                x = np.zeros(dim, np.float32)
+                x[y * stride] = 1.0
+                batch.append((x, y))
+            yield batch
+    return reader
+
+
+def _mlp(dim=64, classes=10, named=False):
+    images = paddle.layer.data(name="pixel",
+                               type=paddle.data_type.dense_vector(dim))
+    label = paddle.layer.data(name="label",
+                              type=paddle.data_type.integer_value(classes))
+    # explicit names keep parameter names stable across re-declarations
+    # (anonymous __fc_layer_N__ counters are process-global, as in the
+    # reference's v1 config naming)
+    h1 = paddle.layer.fc(input=images, size=32,
+                         act=paddle.activation.Relu(),
+                         name="h1" if named else None)
+    predict = paddle.layer.fc(input=h1, size=classes,
+                              act=paddle.activation.Softmax(),
+                              name="pred" if named else None)
+    cost = paddle.layer.classification_cost(input=predict, label=label)
+    return predict, cost
+
+
+def test_v2_mnist_style_mlp_trains_and_infers():
+    """The reference MNIST v2 script shape: declare layers, create
+    parameters, train with Momentum+L2, events fire with cost and the
+    classification_error metric, then paddle.infer serves."""
+    paddle.init(use_gpu=False, trainer_count=1)
+    predict, cost = _mlp()
+    parameters = paddle.parameters.create(cost)
+    assert len(parameters.names()) == 4  # 2x fc (w + bias)
+    optimizer = paddle.optimizer.Momentum(
+        learning_rate=0.05, momentum=0.9,
+        regularization=paddle.optimizer.L2Regularization(rate=1e-4))
+    trainer = paddle.trainer.SGD(cost=cost, parameters=parameters,
+                                 update_equation=optimizer)
+    events = {"begin_pass": 0, "end_pass": 0, "iters": []}
+
+    def handler(event):
+        if isinstance(event, paddle.event.BeginPass):
+            events["begin_pass"] += 1
+        elif isinstance(event, paddle.event.EndPass):
+            events["end_pass"] += 1
+            assert "classification_error_evaluator" in event.metrics
+        elif isinstance(event, paddle.event.EndIteration):
+            events["iters"].append(
+                (event.pass_id, event.batch_id, event.cost,
+                 event.metrics["classification_error_evaluator"]))
+
+    rng = np.random.RandomState(0)
+    trainer.train(reader=_digit_reader(rng), num_passes=3,
+                  event_handler=handler)
+    assert events["begin_pass"] == 3 and events["end_pass"] == 3
+    costs = [c for _, _, c, _ in events["iters"]]
+    assert costs[-1] < costs[0] * 0.5
+    # the separable toy task should be fully learned
+    assert events["iters"][-1][3] < 0.1
+
+    probs = paddle.infer(
+        output_layer=predict, parameters=parameters,
+        input=[(np.eye(64, dtype=np.float32)[y * 6],) for y in range(10)])
+    assert list(np.argmax(np.asarray(probs), axis=1)) == list(range(10))
+
+    result = trainer.test(reader=_digit_reader(np.random.RandomState(7)))
+    assert result.cost < costs[0]
+    assert result.metrics["classification_error_evaluator"] < 0.1
+
+
+def test_v2_conv_network_via_networks():
+    """simple_img_conv_pool on dense_vector image input (the v2 conv
+    MNIST config): v1 infers the 2-D image shape from the flat size."""
+    paddle.init(use_gpu=False, trainer_count=1)
+    images = paddle.layer.data(
+        name="cimg", type=paddle.data_type.dense_vector(144))
+    label = paddle.layer.data(
+        name="clabel", type=paddle.data_type.integer_value(4))
+    conv = paddle.networks.simple_img_conv_pool(
+        input=images, filter_size=3, num_filters=4, num_channel=1,
+        pool_size=2, pool_stride=2, act=paddle.activation.Relu())
+    predict = paddle.layer.fc(input=conv, size=4,
+                              act=paddle.activation.Softmax())
+    cost = paddle.layer.classification_cost(input=predict, label=label)
+    parameters = paddle.parameters.create(cost)
+    trainer = paddle.trainer.SGD(
+        cost=cost, parameters=parameters,
+        update_equation=paddle.optimizer.Adam(learning_rate=0.01))
+    rng = np.random.RandomState(3)
+
+    def reader():
+        for _ in range(15):
+            batch = []
+            for _ in range(8):
+                y = int(rng.randint(4))
+                img = np.zeros((12, 12), np.float32)
+                img[y * 3: y * 3 + 3, :] = 1.0
+                batch.append((img.ravel(), y))
+            yield batch
+
+    costs = []
+    trainer.train(reader=reader, num_passes=2, event_handler=lambda e:
+                  costs.append(e.cost)
+                  if isinstance(e, paddle.event.EndIteration) else None)
+    assert costs[-1] < costs[0] * 0.6
+
+
+def test_v2_sequence_embedding_pooling():
+    """integer_value_sequence -> embedding -> seq pooling -> fc: the
+    text-classification v2 config over the LoD bridge."""
+    paddle.init(use_gpu=False, trainer_count=1)
+    words = paddle.layer.data(
+        name="words", type=paddle.data_type.integer_value_sequence(20))
+    label = paddle.layer.data(
+        name="slabel", type=paddle.data_type.integer_value(2))
+    emb = paddle.layer.embedding(input=words, size=8)
+    pooled = paddle.layer.pooling(input=emb,
+                                  pooling_type=paddle.pooling.Avg())
+    predict = paddle.layer.fc(input=pooled, size=2,
+                              act=paddle.activation.Softmax())
+    cost = paddle.layer.classification_cost(input=predict, label=label)
+    parameters = paddle.parameters.create(cost)
+    trainer = paddle.trainer.SGD(
+        cost=cost, parameters=parameters,
+        update_equation=paddle.optimizer.Adam(learning_rate=0.05))
+    rng = np.random.RandomState(5)
+
+    def reader():
+        for _ in range(20):
+            batch = []
+            for _ in range(8):
+                y = int(rng.randint(2))
+                # class decides which half of the vocab words come from
+                length = int(rng.randint(2, 6))
+                seq = rng.randint(y * 10, y * 10 + 10,
+                                  size=length).tolist()
+                batch.append((seq, y))
+            yield batch
+
+    costs = []
+    trainer.train(reader=reader, num_passes=3, event_handler=lambda e:
+                  costs.append(e.cost)
+                  if isinstance(e, paddle.event.EndIteration) else None)
+    assert costs[-1] < costs[0] * 0.6
+
+
+def test_v2_word2vec_shared_embedding():
+    """The reference test_paramconf_order.py topology: N context words
+    through table projections sharing one named parameter, concat, fc
+    — shared param_attr names must alias ONE parameter."""
+    paddle.init(use_gpu=False, trainer_count=1)
+    shared = paddle.attr.Param(name="wordvecs")
+    ws = [paddle.layer.data(
+        name="w%d" % i, type=paddle.data_type.integer_value(30))
+        for i in range(4)]
+    nextw = paddle.layer.data(
+        name="wnext", type=paddle.data_type.integer_value(30))
+    embs = [paddle.layer.table_projection(input=w, size=6,
+                                          param_attr=shared) for w in ws]
+    ctx = paddle.layer.concat(input=embs)
+    hidden = paddle.layer.fc(input=ctx, size=16,
+                             act=paddle.activation.Sigmoid())
+    predict = paddle.layer.fc(input=hidden, size=30,
+                              act=paddle.activation.Softmax())
+    cost = paddle.layer.classification_cost(input=predict, label=nextw)
+    parameters = paddle.parameters.create(cost)
+    assert parameters.names().count("wordvecs") == 1
+    assert parameters.get_shape("wordvecs") == (30, 6)
+
+
+def test_v2_parameters_tar_roundtrip_and_warm_start():
+    """to_tar/from_tar roundtrip; a NEW trainer warm-started from the
+    tar continues from the saved weights (reference
+    Parameters.from_tar + init_from_tar)."""
+    paddle.init(use_gpu=False, trainer_count=1)
+    predict, cost = _mlp(named=True)
+    parameters = paddle.parameters.create(cost)
+    trainer = paddle.trainer.SGD(
+        cost=cost, parameters=parameters,
+        update_equation=paddle.optimizer.Adam(learning_rate=0.05))
+    rng = np.random.RandomState(1)
+    trainer.train(reader=_digit_reader(rng, n_batches=15), num_passes=2)
+    buf = io.BytesIO()
+    trainer.save_parameter_to_tar(buf)
+    buf.seek(0)
+
+    loaded = paddle.parameters.Parameters.from_tar(buf)
+    assert sorted(loaded.names()) == sorted(parameters.names())
+    np.testing.assert_allclose(loaded.get(parameters.names()[0]),
+                               parameters.get(parameters.names()[0]))
+
+    # fresh DAG + trainer warm-started from the tar: first-batch cost
+    # must match the trained model's, not a random init's
+    predict2, cost2 = _mlp(named=True)
+    trainer2 = paddle.trainer.SGD(
+        cost=cost2, parameters=loaded,
+        update_equation=paddle.optimizer.Adam(learning_rate=0.05))
+    first = []
+
+    def grab_first(event):
+        if isinstance(event, paddle.event.EndIteration) and not first:
+            first.append(event.cost)
+
+    trainer2.train(reader=_digit_reader(np.random.RandomState(2),
+                                        n_batches=2),
+                   num_passes=1, event_handler=grab_first)
+    assert first[0] < 0.7  # random init would sit near ln(10) ~ 2.3
+
+
+def test_v2_regression_cost_and_sgd():
+    paddle.init(use_gpu=False, trainer_count=1)
+    x = paddle.layer.data(name="rx",
+                          type=paddle.data_type.dense_vector(13))
+    y = paddle.layer.data(name="ry",
+                          type=paddle.data_type.dense_vector(1))
+    pred = paddle.layer.fc(input=x, size=1,
+                           act=paddle.activation.Linear())
+    cost = paddle.layer.square_error_cost(input=pred, label=y)
+    parameters = paddle.parameters.create(cost)
+    trainer = paddle.trainer.SGD(
+        cost=cost, parameters=parameters,
+        update_equation=paddle.optimizer.Momentum(learning_rate=0.01,
+                                                  momentum=0.0))
+    rng = np.random.RandomState(0)
+    true_w = rng.randn(13, 1).astype(np.float32)
+
+    def reader():
+        for _ in range(40):
+            xs = rng.randn(16, 13).astype(np.float32)
+            ys = xs @ true_w
+            yield [(xs[i], ys[i]) for i in range(16)]
+
+    costs = []
+    trainer.train(reader=reader, num_passes=2, event_handler=lambda e:
+                  costs.append(e.cost)
+                  if isinstance(e, paddle.event.EndIteration) else None)
+    assert costs[-1] < costs[0] * 0.2
+
+
+def test_v2_feeding_map_reorders_columns():
+    """feeding={name: index} must pick reader columns by index, not
+    declaration order (reference trainer.py feeding contract)."""
+    paddle.init(use_gpu=False, trainer_count=1)
+    predict, cost = _mlp(dim=16, classes=4)
+    parameters = paddle.parameters.create(cost)
+    trainer = paddle.trainer.SGD(
+        cost=cost, parameters=parameters,
+        update_equation=paddle.optimizer.Adam(learning_rate=0.05))
+    rng = np.random.RandomState(4)
+
+    def reader():  # label FIRST, pixels second
+        for _ in range(10):
+            batch = []
+            for _ in range(8):
+                yv = int(rng.randint(4))
+                x = np.zeros(16, np.float32)
+                x[yv * 4] = 1.0
+                batch.append((yv, x))
+            yield batch
+
+    costs = []
+    trainer.train(reader=reader, num_passes=2,
+                  feeding={"pixel": 1, "label": 0},
+                  event_handler=lambda e: costs.append(e.cost)
+                  if isinstance(e, paddle.event.EndIteration) else None)
+    assert costs[-1] < costs[0] * 0.7
+
+
+def test_v2_parse_network_and_data_utilities():
+    paddle.init(trainer_count=1)
+    r = paddle.batch(lambda: iter(range(10)), 4)
+    assert list(r()) == [[0, 1, 2, 3], [4, 5, 6, 7]]
+    assert paddle.dataset.mnist is not None
+    assert paddle.reader.shuffle is not None
+    with pytest.raises(ValueError):
+        paddle.init(trainer_count=0)
+
+    x = paddle.layer.data(name="pn_x",
+                          type=paddle.data_type.dense_vector(8))
+    h = paddle.layer.fc(input=x, size=4, act=paddle.activation.Tanh())
+    desc = paddle.layer.parse_network(h)
+    assert any(op.type == "mul" for op in desc.blocks[0].ops)
+
+
+def test_v2_anonymous_param_attr_not_aliased():
+    """One anonymous ParamAttr object reused across two layers must
+    produce two distinct parameters, not silently share weights."""
+    from paddle_tpu.fluid.param_attr import ParamAttr
+    shared_anon = ParamAttr()
+    x = paddle.layer.data(name="ap_x",
+                          type=paddle.data_type.dense_vector(8))
+    y = paddle.layer.data(name="ap_y",
+                          type=paddle.data_type.integer_value(2))
+    h = paddle.layer.fc(input=x, size=6, param_attr=shared_anon,
+                        name="ap_h")
+    p = paddle.layer.fc(input=h, size=2, param_attr=shared_anon,
+                        act=paddle.activation.Softmax(), name="ap_p")
+    cost = paddle.layer.classification_cost(input=p, label=y)
+    params = paddle.parameters.create(cost)
+    assert params.get_shape("_ap_h.w0") == (8, 6)
+    assert params.get_shape("_ap_p.w0") == (6, 2)
+    assert shared_anon.name is None  # user's object untouched
+
+
+def test_v2_init_from_tar_skips_unknown_names():
+    paddle.init(trainer_count=1)
+    predict, cost = _mlp(dim=16, classes=4, named=True)
+    params = paddle.parameters.create(cost)
+    extra = paddle.parameters.Parameters()
+    extra.set("_h1.w0", params.get("_h1.w0") * 0 + 1.0)
+    extra.set("not_in_topology", np.zeros(3, np.float32))
+    buf = io.BytesIO()
+    extra.to_tar(buf)
+    buf.seek(0)
+    params.init_from_tar(buf)  # must not raise on the unknown name
+    assert float(params.get("_h1.w0").ravel()[0]) == 1.0
+    assert not params.has_key("not_in_topology")
+
+
+def test_v2_sequence_conv_pool_has_context_window():
+    """sequence_conv_pool must apply a real context_len window (a
+    sequence_conv op), not a per-timestep fc."""
+    words = paddle.layer.data(
+        name="scp_w", type=paddle.data_type.dense_vector_sequence(5))
+    out = paddle.networks.sequence_conv_pool(
+        input=words, context_len=3, hidden_size=7)
+    desc = paddle.layer.parse_network(out)
+    assert any(op.type == "sequence_conv"
+               for op in desc.blocks[0].ops)
+
+
+def test_v2_img_conv_trans_builds_transpose():
+    img = paddle.layer.data(
+        name="tc_img", type=paddle.data_type.dense_vector(64))
+    up = paddle.layer.img_conv(input=img, filter_size=3, num_filters=2,
+                               num_channels=1, stride=2, trans=True)
+    desc = paddle.layer.parse_network(up)
+    assert any("transpose" in op.type for op in desc.blocks[0].ops)
+
+
+def test_v2_second_trainer_on_same_parameters():
+    """A second SGD over the same cost/parameters (re-train with a
+    different optimizer) must work and continue from the current
+    weights, not crash on a second backward pass."""
+    paddle.init(trainer_count=1)
+    predict, cost = _mlp(dim=16, classes=4, named=True)
+    params = paddle.parameters.create(cost)
+    t1 = paddle.trainer.SGD(
+        cost=cost, parameters=params,
+        update_equation=paddle.optimizer.Adam(learning_rate=0.05))
+    rng = np.random.RandomState(9)
+    t1.train(reader=_digit_reader(rng, n_batches=10, dim=16, classes=4),
+             num_passes=2)
+    w_after_t1 = params.get("_h1.w0").copy()
+    t2 = paddle.trainer.SGD(
+        cost=cost, parameters=params,
+        update_equation=paddle.optimizer.Momentum(learning_rate=0.01,
+                                                  momentum=0.9))
+    # t2 starts from t1's weights
+    np.testing.assert_allclose(params.get("_h1.w0"), w_after_t1)
+    costs = []
+    t2.train(reader=_digit_reader(rng, n_batches=5, dim=16, classes=4),
+             num_passes=1, event_handler=lambda e:
+             costs.append(e.cost)
+             if isinstance(e, paddle.event.EndIteration) else None)
+    assert costs[0] < 1.0  # warm start, not a random re-init (~ln 4)
+
+
+def test_v2_explicit_linear_activation_preserved():
+    """activation.Linear() passed explicitly must not be coerced to the
+    tanh/sigmoid defaults (lstm gates, sequence_conv_pool)."""
+    words = paddle.layer.data(
+        name="lin_w", type=paddle.data_type.dense_vector_sequence(4))
+    out = paddle.networks.sequence_conv_pool(
+        input=words, context_len=2, hidden_size=3,
+        fc_act=paddle.activation.Linear())
+    desc = paddle.layer.parse_network(out)
+    conv_ops = [op for op in desc.blocks[0].ops
+                if op.type == "sequence_conv"]
+    assert conv_ops and not any(op.type == "tanh"
+                                for op in desc.blocks[0].ops)
+
+
+def test_v2_img_conv_default_padding_is_zero():
+    """Reference img_conv_layer pads 0 by default: a 12x12 input with
+    filter 3 must give 10x10 maps, keeping migrated shapes identical."""
+    img = paddle.layer.data(
+        name="pz_img", type=paddle.data_type.dense_vector(144))
+    conv = paddle.layer.img_conv(input=img, filter_size=3,
+                                 num_filters=2, num_channels=1,
+                                 name="pz_conv")
+    pool = paddle.layer.img_pool(input=conv, pool_size=2, stride=2,
+                                 num_channels=2)
+    fc = paddle.layer.fc(input=pool, size=3, name="pz_fc")
+    from paddle_tpu.v2.topology import Topology
+    topo = Topology(fc)
+    # conv2d(12,k3,p0)->10; pool(2,s2,ceil)->5; fc in = 2*5*5 = 50
+    assert topo.var_of(fc).shape[-1] == 3
+    w = topo.main_program.global_block().var("_pz_fc.w0")
+    assert w.shape[0] == 2 * 5 * 5
+
+
+def test_v2_optimizer_strictness_and_clip():
+    with pytest.raises(NotImplementedError, match="learning_rate_sch"):
+        paddle.optimizer.Adam(learning_rate=0.01,
+                              learning_rate_schedule="poly")
+    with pytest.raises(NotImplementedError, match="momentum"):
+        paddle.attr.Param(momentum=0.9)
+    # gradient_clipping_threshold reaches the fluid clip attr
+    a = paddle.attr.Param(name="clip_p", gradient_clipping_threshold=5.0)
+    fa = a.to_fluid()
+    assert fa.gradient_clip is not None
+
+
+def test_v2_unported_layer_names_fail_loudly():
+    with pytest.raises(AttributeError, match="fluid"):
+        paddle.layer.recurrent_group
+    with pytest.raises(AttributeError, match="DynamicRNN"):
+        paddle.layer.recurrent_group
+
+
+def test_v2_sparse_binary_input_densified():
+    paddle.init(trainer_count=1)
+    t = paddle.data_type.sparse_binary_vector(10)
+    col = t.convert_column([1, 4, 7])
+    assert col.shape == (10,) and col[1] == col[4] == col[7] == 1.0
+    tv = paddle.data_type.sparse_float_vector(6)
+    col = tv.convert_column([(0, 0.5), (5, 2.0)])
+    assert col[0] == 0.5 and col[5] == 2.0 and col[1] == 0.0
