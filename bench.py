@@ -256,7 +256,15 @@ def main():
     uint8_input = not use_fake and model_name == "resnet50"
 
     import paddle_tpu.fluid as fluid
+    from paddle_tpu.core.flags import FLAGS
     from paddle_tpu.models import alexnet, googlenet, resnet, vgg
+
+    # measured knobs (see PROFILE_r04.md for the numbers behind the
+    # defaults): bf16 pass-through batch_norm and NHWC conv lowering
+    if os.environ.get("BENCH_BN_BF16", "1" if amp else "0") == "1":
+        FLAGS.bn_bf16 = True
+    if os.environ.get("BENCH_NHWC", "0") == "1":
+        FLAGS.conv_nhwc = True
 
     main_prog, startup = fluid.Program(), fluid.Program()
     with fluid.program_guard(main_prog, startup):
@@ -303,13 +311,16 @@ def main():
     #
     # Datasets that fit in HBM go through DeviceDatasetCache (recordio
     # scanner -> stage once -> per-epoch jitted shuffle + gather, zero
-    # per-step host traffic — the tf.data cache()-on-accelerator idiom;
-    # this rig's device tunnel serializes host->device copies behind
-    # executes at ~10 MB/s effective, so streaming overlap physically
-    # cannot keep a 100 ms step fed, while the cache path is how small
-    # datasets are trained on TPU anyway).  Larger datasets stream
-    # through the decorated chain — recordio -> shuffle -> batch ->
-    # double-buffered DeviceLoader (reference reader decorators +
+    # per-step host traffic — the tf.data cache()-on-accelerator idiom).
+    # MEASURED (the post-loop stream probe emits these fields every
+    # run; r4 numbers): h2d_mb_per_sec_idle = 6.3 MB/s sustained over
+    # this rig's tunnel, streaming_imgs_per_sec = 362 through the
+    # double-buffered DeviceLoader vs 2698 cached — feeding bs256
+    # uint8 images at the cached step rate needs ~405 MB/s, ~64x what
+    # the tunnel delivers, so streaming overlap physically cannot keep
+    # a ~95 ms step fed here.  Larger datasets stream through the
+    # decorated chain — recordio -> shuffle -> batch -> double-buffered
+    # DeviceLoader (reference reader decorators +
     # create_recordio_file_reader / create_double_buffer_reader_op).
     loader_iter = None
     device_cached = False
@@ -371,6 +382,14 @@ def main():
 
     # Timed loop: steps are dispatched asynchronously (XLA execution is
     # async like the reference's CUDA streams); one sync at the end.
+    # BENCH_PROFILE=<dir> wraps the loop in jax.profiler.trace and
+    # prints the per-hlo-category breakdown (utils/xplane.py) to stderr.
+    profile_dir = os.environ.get("BENCH_PROFILE")
+    prof_ctx = None
+    if profile_dir:
+        import jax
+        prof_ctx = jax.profiler.trace(profile_dir)
+        prof_ctx.__enter__()
     t0 = time.time()
     loss = None
     for _ in range(iters):
@@ -379,8 +398,73 @@ def main():
                         return_numpy=False)
     loss = np.asarray(loss)  # blocks until the chain has drained
     elapsed = time.time() - t0
+    if prof_ctx is not None:
+        import glob
+
+        prof_ctx.__exit__(None, None, None)
+        from paddle_tpu.utils.xplane import print_category_profile
+        pbs = sorted(glob.glob(os.path.join(
+            profile_dir, "**", "*.xplane.pb"), recursive=True),
+            key=os.path.getmtime)
+        if pbs:
+            stdout, sys.stdout = sys.stdout, sys.stderr
+            try:
+                print("category profile (%s):" % pbs[-1])
+                print_category_profile(pbs[-1])
+            finally:
+                sys.stdout = stdout
 
     images_per_sec = batch_size * iters / elapsed
+
+    # Streaming-input evidence (round-3 VERDICT weak #2): measure the
+    # tunnel and the streaming DeviceLoader path so the cache-vs-stream
+    # decision above cites numbers, not an assertion.  Runs AFTER the
+    # timed loop so the headline is undisturbed.  BENCH_STREAM_PROBE=0
+    # skips.
+    stream_stats = {}
+    if (not use_fake and on_accel
+            and os.environ.get("BENCH_STREAM_PROBE", "1") == "1"):
+        import jax
+
+        import paddle_tpu as pt
+        from paddle_tpu.reader import creator
+
+        dev = place.jax_device()
+        # (a) idle-device h2d bandwidth: one big uint8 buffer, drained
+        # by a 1-element d2h fetch (block_until_ready alone returns
+        # before the remote transfer lands on this rig)
+        nbytes = 64 << 20
+        buf = np.ones(nbytes, np.uint8)
+        t0 = time.time()
+        x = jax.device_put(buf, dev)
+        _ = np.asarray(x[:1])
+        stream_stats["h2d_mb_per_sec_idle"] = round(
+            nbytes / (time.time() - t0) / 1e6, 1)
+        del x
+        # (b) the streaming DeviceLoader path end-to-end (recordio ->
+        # shuffle -> batch -> double-buffered h2d overlapped with the
+        # training step): images/sec over a short run
+        base = creator.recordio(rio_path, _deser)
+        sloader = pt.reader.DeviceLoader(
+            pt.batch(pt.reader.shuffle(base, buf_size=batch_size * 4),
+                     batch_size=batch_size),
+            [data.name, label.name], place, capacity=3)
+        sit = iter(sloader)
+        sfeed = next(sit)
+        exe.run(main_prog, feed=sfeed, fetch_list=[avg_cost])  # warm
+        s_iters = int(os.environ.get("BENCH_STREAM_ITERS", "8"))
+        t0 = time.time()
+        sloss = None
+        n_done = 0
+        for sfeed in sit:
+            sloss, = exe.run(main_prog, feed=sfeed,
+                             fetch_list=[avg_cost], return_numpy=False)
+            n_done += 1
+            if n_done >= s_iters:
+                break
+        np.asarray(sloss)
+        stream_stats["streaming_imgs_per_sec"] = round(
+            batch_size * n_done / (time.time() - t0), 1)
     if model_name == "vgg":
         # closest published number: legacy VGG-19 train, MKL-DNN CPU,
         # bs256 (IntelOptimizedPaddle.md:36) — vgg16 here, so the ratio
@@ -403,6 +487,7 @@ def main():
     }
     if not use_fake:
         out["device_cached"] = device_cached
+        out.update(stream_stats)
     # 224x224 only: that's what the analytic FLOP counts are for
     per_img = {"resnet50": TRAIN_FLOPS_PER_IMG_224,
                "vgg": TRAIN_FLOPS_PER_IMG_VGG16_224}.get(model_name)
@@ -414,20 +499,23 @@ def main():
                                         DEFAULT_PEAK_TFLOPS))
             out["mfu"] = round(tflops / peak, 3)
             # Roofline context, measured via utils/xplane.py category
-            # profile on exactly this configuration (v5e defaults:
-            # peak 197 TF/s, bs256): ResNet-50 bf16 is HBM-bound —
-            # conv fusions run at ~85% of the 819 GB/s HBM peak but
-            # only ~39% MXU, because the model's arithmetic intensity
-            # (~70-110 FLOP/byte over the whole step) sits far below
-            # the chip's ridge point (197e12/819e9 ≈ 240).  At 100%
-            # HBM with intrinsic activation traffic the cap is ~0.29
-            # MFU; a compute-bound workload on the same stack reaches
-            # 0.55 (see secondary).  Only emitted for the measured
-            # config so another chip/batch never inherits it.
+            # profiles committed in PROFILE_r04.md (v5e defaults: peak
+            # 197 TF/s, bs256): ResNet-50 bf16 is HBM-bound — 94% of
+            # device step time runs inside XLA fusions at 82-85% of the
+            # 819 GB/s HBM peak (conv fusions: 85% HBM, 38% MXU),
+            # because the model's arithmetic intensity sits far below
+            # the chip's ridge point (197e12/819e9 ≈ 240 FLOP/byte).
+            # At 100% HBM for the bytes XLA actually schedules the
+            # analytic-FLOP MFU caps at ~0.20 (0.167/0.85); bf16-BN,
+            # NHWC and bs512 are all measured ≤±1% (PROFILE_r04.md
+            # knob table).  A compute-bound workload on the same stack
+            # reaches 0.52 (see secondary).  Only emitted for the
+            # measured config so another chip/batch never inherits it.
             if (model_name == "resnet50" and batch_size == 256
                     and peak == DEFAULT_PEAK_TFLOPS):
                 out["hbm_bound"] = True
-                out["mfu_roofline_cap"] = 0.29
+                out["mfu_roofline_cap"] = 0.20
+                out["profile_evidence"] = "PROFILE_r04.md"
     if on_accel and model_name == "resnet50" and \
             os.environ.get("BENCH_SECONDARY", "1") == "1":
         try:

@@ -49,17 +49,34 @@ LOD_SEQ_PAD_MULTIPLE = 4
 def _prepare_lod_feeds(feed):
     """LoDTensor feeds -> padded dense array + '<name>@LEN' lengths.
     Level-2 LoD pads to [N, S, W, ...] with '@LEN' = outer sentence
-    lengths and '@LEN@1' = [N, S] inner sub-sequence lengths (reference
-    lod_tensor.h:58 hierarchical LoD)."""
+    lengths and '@LEN@1' = [N, S] inner sub-sequence lengths; deeper
+    LoD generalizes recursively — one padded dim and one '@LEN@j'
+    array per level (reference lod_tensor.h:58 depth-unbounded LoD)."""
     from .lod import LoDTensor
 
     for name, v in list(feed.items()):
         if not (isinstance(v, LoDTensor) and v.lod):
             continue
         if len(v.lod) > 2:
-            raise NotImplementedError(
-                "feeds with lod_level > 2 are not supported "
-                "(variable %r has %d levels)" % (name, len(v.lod)))
+            # level-k (k>=3): general recursive pad — outer ragged dims
+            # bucket to LOD_SEQ_PAD_MULTIPLE, the innermost time dim to
+            # LOD_PAD_MULTIPLE; '@LEN@j' carries level-j lengths
+            # (reference lod_tensor.h:58 depth-unbounded LoD)
+            k = len(v.lod)
+            # padded fan-out per level: max segment length, bucketed
+            max_dims = []
+            for j in range(k):
+                mult = LOD_PAD_MULTIPLE if j == k - 1 \
+                    else LOD_SEQ_PAD_MULTIPLE
+                mx = max(v.sequence_lengths(j), default=1)
+                max_dims.append(-(-max(mx, 1) // mult) * mult)
+            padded, lens = v.to_padded_klevel(max_dims=max_dims)
+            feed[name] = padded
+            feed[name + LEN_SUFFIX] = lens[0].astype(np.int32)
+            for j in range(1, k):
+                feed[name + LEN_SUFFIX + "@%d" % j] = \
+                    lens[j].astype(np.int32)
+            continue
         if len(v.lod) == 2:
             # bucket both ragged dims so compiled shapes stay bounded.
             # This is the FEED bridge (pad + expose '@LEN' outer and
@@ -216,7 +233,10 @@ class ExecutorCore:
         key = (program.uid, program.version, block_id, feed_spec,
                tuple(fetch_list), mode,
                bool(getattr(program, "amp_bf16", False)),
-               bool(FLAGS.auto_layout))
+               bool(FLAGS.auto_layout),
+               # read at trace time by _amp_cast_ins: toggling it must
+               # not hit a stale executable
+               bool(FLAGS.bn_bf16))
         entry = self._cache.get(key)
         if entry is None:
             entry = self._build(program, block_id, core_ops, scope, feed,
@@ -281,7 +301,12 @@ class ExecutorCore:
         # device-side length vector of every LoD input (SURVEY §5.7 —
         # ragged->dense bucketing bridge to XLA static shapes)
         for name in list(external):
-            for suffix in (LEN_SUFFIX, LEN_SUFFIX + "@1"):
+            suffixes = [LEN_SUFFIX]
+            j = 1
+            while name + LEN_SUFFIX + "@%d" % j in feed:
+                suffixes.append(LEN_SUFFIX + "@%d" % j)
+                j += 1
+            for suffix in suffixes:
                 if name + suffix in feed and name + suffix not in seen_ext:
                     seen_ext.add(name + suffix)
                     external.append(name + suffix)

@@ -67,6 +67,11 @@ define_flag("benchmark", False,
 define_flag("conv_nhwc", False,
             "lower conv2d through NHWC (MXU-preferred layout); the "
             "boundary transposes cancel across conv chains in XLA")
+define_flag("bn_bf16", False,
+            "under AMP, let batch_norm consume/produce bf16 (statistics "
+            "stay f32 internally, like layer_norm) instead of casting "
+            "its inputs to f32; halves BN-chain activation bytes on "
+            "HBM-bound conv nets")
 define_flag("auto_layout", False,
             "single-device accelerator path: AOT-compile with XLA-chosen "
             "(AUTO) parameter layouts and keep persistable buffers in "

@@ -100,6 +100,78 @@ class LoDTensor:
         outer_clipped = np.minimum(np.asarray(outer_lens, np.int64), s)
         return out, outer_clipped, inner_lens
 
+    def to_padded_klevel(self, pad_value=0.0, max_dims=None):
+        """Arbitrary-depth LoD -> (padded [N, S1, ..., S_{k-1}, D...],
+        [lens_0 [N], lens_1 [N,S1], ..., lens_{k-1} [N,..,S_{k-2}]]).
+
+        The general form of :meth:`to_padded` / :meth:`to_padded_2level`
+        — the reference LoD is a vector of levels with no depth cap
+        (framework/lod_tensor.h:58-110).  Level j's segments nest inside
+        level j-1's; the padded array gains one dense dim per level
+        (level 0's fan-out is the batch dim N; the deepest level is the
+        time dim).  ``max_dims`` (one entry per level: [cap_1, ...,
+        cap_{k-1}, cap_time]) truncates; lengths report post-truncation
+        sizes."""
+        k = len(self.lod)
+        if k == 0:
+            raise ValueError("to_padded_klevel needs a LoD")
+        data = np.asarray(self.data)
+        seg_lens = [self.sequence_lengths(j) for j in range(k)]
+        # dims[j] = padded fan-out OF level j (max segment length);
+        # dims[0].. dims[k-1] become the S1..S_{k-1},W dims
+        dims = [max(l, default=1) or 1 for l in seg_lens]
+        if max_dims is not None:
+            dims = [md or d for md, d in zip(max_dims, dims)]
+        n = len(seg_lens[0])
+        out = np.full((n,) + tuple(dims) + data.shape[1:], pad_value,
+                      dtype=data.dtype)
+        # lens_arrays[j] indexes by the j+1 leading dims of `out`
+        lens_arrays = [np.zeros((n,) + tuple(dims[:j]), np.int64)
+                       for j in range(k)]
+
+        def fill(level, seg, idx):
+            length = seg_lens[level][seg]
+            if level == k - 1:      # deepest: segments are data rows
+                start = self.lod[level][seg]
+                used = min(length, dims[level])
+                out[idx + (slice(0, used),)] = data[start:start + used]
+                lens_arrays[level][idx] = used
+                return
+            kids_start = self.lod[level][seg]
+            used = min(length, dims[level])
+            lens_arrays[level][idx] = used
+            for si in range(used):
+                fill(level + 1, kids_start + si, idx + (si,))
+
+        for i in range(n):
+            fill(0, i, (i,))
+        return out, lens_arrays
+
+    @staticmethod
+    def from_padded_klevel(padded, lens_arrays):
+        """Inverse of :meth:`to_padded_klevel`."""
+        padded = np.asarray(padded)
+        k = len(lens_arrays)
+        lod = [[0] for _ in range(k)]
+        parts = []
+
+        def walk(level, idx):
+            length = int(np.asarray(lens_arrays[level])[idx])
+            lod[level].append(lod[level][-1] + length)
+            if level == k - 1:
+                parts.append(padded[idx][:length])
+                return
+            for si in range(length):
+                walk(level + 1, idx + (si,))
+
+        for i in range(np.shape(lens_arrays[0])[0]):
+            walk(0, (i,))
+        # structural dims are [N, S1..S_{k-1}, W] = k+1; features follow
+        # (fresh zeros: reshape can't shrink a nonempty padded block)
+        data = (np.concatenate(parts, axis=0) if parts
+                else np.zeros((0,) + padded.shape[k + 1:], padded.dtype))
+        return LoDTensor(data, lod)
+
     @staticmethod
     def from_padded_2level(padded, outer_lens, inner_lens):
         """Inverse of :meth:`to_padded_2level`."""
@@ -115,7 +187,7 @@ class LoDTensor:
                 inner_offs.append(inner_offs[-1] + il)
                 parts.append(padded[i, si, :il])
         data = (np.concatenate(parts, axis=0) if parts
-                else padded.reshape((0,) + padded.shape[3:]))
+                else np.zeros((0,) + padded.shape[3:], padded.dtype))
         return LoDTensor(data, [outer_offs, inner_offs])
 
     @staticmethod
@@ -124,7 +196,7 @@ class LoDTensor:
         lengths = [int(l) for l in np.asarray(lengths).reshape(-1)]
         parts = [padded[i, :l] for i, l in enumerate(lengths)]
         data = (np.concatenate(parts, axis=0) if parts
-                else padded.reshape((0,) + padded.shape[2:]))
+                else np.zeros((0,) + padded.shape[2:], padded.dtype))
         offs = [0]
         for l in lengths:
             offs.append(offs[-1] + l)

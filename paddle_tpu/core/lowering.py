@@ -49,9 +49,11 @@ AMP_WHITE = frozenset({
 # Numerically sensitive ops: always compute in fp32 (inputs cast back).
 # layer_norm is NOT here: its lowering computes statistics in f32
 # internally while keeping the normalized output in the input dtype, so
-# transformer activation chains stay bf16.  batch_norm (which does the
-# same internally) IS here — measured on ResNet-50/v5e, the fp32 BN
-# segments fuse better and train ~10% faster than bf16-out BN.
+# transformer activation chains stay bf16.  batch_norm does the same
+# internally and FLAGS.bn_bf16 opts it out of this list (round-4
+# re-measurement, PROFILE_r04.md: bf16-out BN is +0.9% on
+# ResNet-50/v5e — the earlier "fp32 BN fuses better" claim was stale);
+# it stays listed by default for reference-parity numerics.
 AMP_BLACK = frozenset({
     "softmax", "softmax_with_cross_entropy", "cross_entropy", "mean",
     "reduce_mean", "reduce_sum", "sum", "batch_norm",
@@ -82,6 +84,13 @@ def _amp_cast_ins(op_type, ins, role=0):
                 return x.astype(jnp.bfloat16)
             return x
     elif op_type in AMP_BLACK:
+        if op_type == "batch_norm":
+            from paddle_tpu.core.flags import FLAGS
+            if FLAGS.bn_bf16:
+                # pass-through: the lowering computes statistics in f32
+                # and applies the affine in x.dtype, so bf16 stays bf16
+                return ins
+
         def conv(x):
             if x is not None and getattr(x, "dtype", None) == jnp.bfloat16:
                 return x.astype(jnp.float32)
@@ -210,12 +219,15 @@ def _propagate_seq_lens(ctx, op):
     layout (embedding/fc/activation/elementwise chains), the padded-batch
     analog of the reference's ShareLoD in InferShape."""
     lens = None
-    inner = None
+    nested = []  # ('@LEN@j', value) for every nested level present
     src = None
     for n in op.input_arg_names():
         if n and n + "@LEN" in ctx.env:
             lens = ctx.env[n + "@LEN"]
-            inner = ctx.env.get(n + "@LEN@1")  # level-2 inner lengths
+            j = 1
+            while n + "@LEN@%d" % j in ctx.env:
+                nested.append(("@LEN@%d" % j, ctx.env[n + "@LEN@%d" % j]))
+                j += 1
             src = ctx.env.get(n)
             break
     if lens is None or src is None or getattr(src, "ndim", 0) < 2:
@@ -228,9 +240,13 @@ def _propagate_seq_lens(ctx, op):
         if getattr(val, "ndim", 0) >= 2 and tuple(val.shape[:2]) == \
                 tuple(lead):
             ctx.env[n + "@LEN"] = lens
-            if inner is not None and getattr(val, "ndim", 0) >= 3 and \
-                    val.shape[2] == src.shape[2]:
-                ctx.env[n + "@LEN@1"] = inner
+            # nested levels carry only while the nested dims survive:
+            # level j occupies dim j+1 of the padded layout
+            for sfx, v in nested:
+                j = int(sfx.rsplit("@", 1)[1])
+                if getattr(val, "ndim", 0) >= j + 2 and \
+                        val.shape[j + 1] == src.shape[j + 1]:
+                    ctx.env[n + sfx] = v
 
 
 def _gather_inputs(env, op):

@@ -26,6 +26,12 @@ import numpy as np
 
 SERVICE = "paddle_tpu.PServer"
 
+# gRPC defaults cap messages at 4 MB; one fc shard of a real model is
+# routinely 10-100 MB (the reference moved such blocks over raw sockets,
+# ParameterServer2.h).  Unlimited on both directions.
+GRPC_OPTIONS = [("grpc.max_send_message_length", -1),
+                ("grpc.max_receive_message_length", -1)]
+
 
 def _enc_tensor(name, arr, extra=0):
     """Wire format: name | extra | kind (0 dense, 1 SelectedRows) | arrays.
@@ -127,12 +133,15 @@ class VariableServer:
             "PrefetchVariable": self._h(self._prefetch_variable),
             "SendBarrier": self._h(self._send_barrier),
             "FetchBarrier": self._h(self._fetch_barrier),
+            "ToggleProfile": self._h(self._toggle_profile),
             "SendComplete": self._h(self._send_complete),
         }
         # enough workers that fanin-1 blocked GetVariable waiters can never
         # starve the SendBarrier that would wake them
-        self._server = grpc.server(futures.ThreadPoolExecutor(
-            max_workers=max(16, 4 * self.fanin_total + 4)))
+        self._server = grpc.server(
+            futures.ThreadPoolExecutor(
+                max_workers=max(16, 4 * self.fanin_total + 4)),
+            options=GRPC_OPTIONS)
         self._server.add_generic_rpc_handlers((
             grpc.method_handlers_generic_handler(SERVICE, handlers),))
 
@@ -278,6 +287,23 @@ class VariableServer:
     def _fetch_barrier(self, req):
         return b""
 
+    def _toggle_profile(self, req):
+        """Trainer-driven server profiling (reference
+        send_recv.proto:76 VariableMessage.profile: the trainer's
+        profiler state rides the RPC envelope and switches the
+        pserver's profiler).  extra=1 starts, extra=0 stops and writes
+        the table to the named path (default /tmp/pserver_profile)."""
+        from paddle_tpu.fluid import profiler as prof
+
+        path, on = _dec_msg(req)
+        if on:
+            prof.start_profiler(state="CPU")
+        else:
+            prof.stop_profiler(sorted_key="total",
+                               profile_path=path or
+                               "/tmp/pserver_profile")
+        return b""
+
     def _send_complete(self, req):
         with self._cv:
             self._alive -= 1
@@ -347,7 +373,7 @@ class RPCClient:
         with self._lock:
             ch = self._channels.get(ep)
             if ch is None:
-                ch = grpc.insecure_channel(ep)
+                ch = grpc.insecure_channel(ep, options=GRPC_OPTIONS)
                 self._channels[ep] = ch
         fn = ch.unary_unary("/%s/%s" % (SERVICE, method))
         return fn(payload, wait_for_ready=True)
@@ -358,7 +384,7 @@ class RPCClient:
         with self._lock:
             ch = self._channels.get(ep)
             if ch is None:
-                ch = grpc.insecure_channel(ep)
+                ch = grpc.insecure_channel(ep, options=GRPC_OPTIONS)
                 self._channels[ep] = ch
         return ch.unary_unary("/%s/%s" % (SERVICE, method))
 
@@ -407,6 +433,13 @@ class RPCClient:
     def fetch_barrier(self, eps):
         for ep in eps:
             self._call(ep, "FetchBarrier", b"")
+
+    def toggle_profile(self, eps, on, profile_path=""):
+        """Switch profiling on every pserver from the trainer side
+        (reference VariableMessage.profile envelope bit)."""
+        for ep in eps:
+            self._call(ep, "ToggleProfile",
+                       _enc_msg(profile_path, 1 if on else 0))
 
     def send_complete(self, eps):
         for ep in eps:

@@ -116,6 +116,7 @@ class ParallelExecutor:
         if share_vars_from is not None:
             self._scope = share_vars_from._scope
         self._core = ExecutorCore(place, mesh=self.mesh)
+        self._runs_since_drop = 0
 
     @property
     def device_count(self):
@@ -143,6 +144,31 @@ class ParallelExecutor:
                 raise ValueError(
                     "feed %r batch %d not divisible by %d local devices"
                     % (k, bs, n_local))
-        return self._core.run(self._program.desc, self._scope, 0, feed,
+        outs = self._core.run(self._program.desc, self._scope, 0, feed,
                               names, mode="train",
                               return_numpy=return_numpy)
+        self._maybe_drop_scope_temps()
+        return outs
+
+    def _maybe_drop_scope_temps(self):
+        """Every ``num_iteration_per_drop_scope`` runs, erase
+        non-persistable program vars (and dead kid scopes) from the
+        scope — the reference's ScopeBufferedSSAGraphExecutor role
+        (details/scope_buffered_ssa_graph_executor.cc): without it a
+        long training accumulates host copies of activations written by
+        host ops/fetches.  Parameters, optimizer state, reader states
+        (all persistable) survive."""
+        every = getattr(self._exec_strategy,
+                        "num_iteration_per_drop_scope", 0) or 0
+        if every <= 0:
+            return
+        self._runs_since_drop += 1
+        if self._runs_since_drop < every:
+            return
+        self._runs_since_drop = 0
+        block = self._program.desc.blocks[0]
+        drop = [name for name in self._scope.local_var_names()
+                if name in block.vars
+                and not block.vars[name].persistable]
+        self._scope.erase(drop)
+        self._scope.drop_kids()

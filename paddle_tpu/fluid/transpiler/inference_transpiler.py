@@ -1,19 +1,30 @@
-"""Inference transpiler: fold batch_norm into the preceding conv.
+"""Inference transpiler: program-rewriting analysis passes for LOADED
+inference programs.
 
-Parity: reference python/paddle/fluid/transpiler/inference_transpiler.py
-(fuse_batch_norm): for an inference program, a conv2d (+ optional
-elementwise_add bias) followed by a batch_norm in test mode computes an
-affine function of the conv output, so the bn folds into the conv's
-filter and bias:
+1. ``fuse_batch_norm`` (reference
+   python/paddle/fluid/transpiler/inference_transpiler.py): a conv2d
+   (+ optional elementwise_add bias) followed by a test-mode batch_norm
+   is an affine function of the conv output — fold into the conv's
+   filter and bias:
 
-    scale_f = scale / sqrt(var + eps)
-    W' = W * scale_f (per output channel)
-    b' = (b - mean) * scale_f + bias
+       scale_f = scale / sqrt(var + eps)
+       W' = W * scale_f (per output channel)
+       b' = (b - mean) * scale_f + bias
+
+2. ``fuse_attention``: pattern-match a plain
+   matmul(transpose_y) -> [scale] -> softmax -> matmul chain and
+   rewrite it to ONE ``ring_attention`` op, so models saved from the
+   plain front-end get the Pallas flash-attention kernel (and the
+   sequence-parallel ring under a mesh) when served.  This is the
+   subgraph->engine role of the reference's inference analysis
+   framework (inference/analysis/subgraph_splitter.cc feeding
+   tensorrt/convert): detect a fusable subgraph in a LOADED program,
+   replace it with the engine op.
 
 On TPU XLA already fuses the bn arithmetic into adjacent kernels, so
-the throughput win is smaller than the reference's cudnn case — but the
-fold still deletes the bn parameters from the serving footprint and
-removes the op from the graph.
+pass 1's throughput win is smaller than the reference's cudnn case —
+but it still deletes parameters from the serving footprint; pass 2 is
+a real kernel swap (flash vs materialized [T,T] scores).
 """
 from __future__ import annotations
 
@@ -24,6 +35,104 @@ __all__ = ["InferenceTranspiler"]
 
 class InferenceTranspiler:
     def transpile(self, program, place=None, scope=None):
+        """Run every analysis pass in-place: BN fold, then attention
+        fusion.  ``scope`` holds the parameters to rewrite (defaults to
+        the global scope)."""
+        self.fuse_batch_norm(program, place, scope)
+        self.fuse_attention(program)
+        return program
+
+    def fuse_attention(self, program):
+        """matmul(QK^T) -> [scale] -> softmax -> matmul(.V)  =>  one
+        ring_attention op (flash kernel / ring under a mesh).
+
+        Match conditions (semantics-preserving only):
+        - first matmul: transpose_Y, 4-D [B,H,T,D] operands;
+        - optional scale op (bias 0) or matmul alpha != 1 between the
+          matmuls: folded into the ring_attention ``scale`` attr;
+        - softmax directly on the (scaled) scores — an arbitrary mask
+          add is NOT fused (the flash kernel only knows causal);
+        - every intermediate is consumed exactly once (else the scores
+          are observed elsewhere and must stay materialized).
+        """
+        from paddle_tpu.core.desc import OpDesc
+
+        block = program.desc.blocks[0]
+        ops = block.ops
+
+        def consumers(name, start):
+            return [(j, o) for j in range(start, len(ops))
+                    for o in [ops[j]]
+                    if name in o.input_arg_names()]
+
+        def rank(name):
+            vd = block.vars.get(name)
+            return len(vd.shape) if vd is not None and vd.shape else 0
+
+        i = 0
+        fused = 0
+        while i < len(ops):
+            m1 = ops[i]
+            if m1.type != "matmul" or \
+                    not m1.attr("transpose_Y", False) or \
+                    m1.attr("transpose_X", False):
+                i += 1
+                continue
+            q_name, k_name = m1.input("X")[0], m1.input("Y")[0]
+            if rank(q_name) != 4 or rank(k_name) != 4:
+                i += 1
+                continue
+            scale = float(m1.attr("alpha", 1.0))
+            cur = m1.output("Out")[0]
+            chain = [i]
+            cons = consumers(cur, i + 1)
+            if len(cons) == 1 and cons[0][1].type == "scale":
+                j, s_op = cons[0]
+                if float(s_op.attr("bias", 0.0)) != 0.0:
+                    i += 1
+                    continue
+                scale *= float(s_op.attr("scale", 1.0))
+                cur = s_op.output("Out")[0]
+                chain.append(j)
+                cons = consumers(cur, j + 1)
+            if len(cons) != 1 or cons[0][1].type != "softmax":
+                i += 1
+                continue
+            j, sm = cons[0]
+            cur = sm.output("Out")[0]
+            chain.append(j)
+            cons = consumers(cur, j + 1)
+            if len(cons) != 1 or cons[0][1].type != "matmul":
+                i += 1
+                continue
+            j, m2 = cons[0]
+            if m2.input("X")[0] != cur or \
+                    m2.attr("transpose_X", False) or \
+                    m2.attr("transpose_Y", False) or \
+                    float(m2.attr("alpha", 1.0)) != 1.0:
+                i += 1
+                continue
+            v_name = m2.input("Y")[0]
+            if rank(v_name) != 4:
+                i += 1
+                continue
+            chain.append(j)
+            ring = OpDesc(
+                "ring_attention",
+                inputs={"Q": [q_name], "K": [k_name], "V": [v_name]},
+                outputs={"Out": [m2.output("Out")[0]]},
+                attrs={"causal": False, "scale": float(scale)})
+            # replace the first op of the chain, delete the rest
+            ops[chain[0]] = ring
+            for j in sorted(chain[1:], reverse=True):
+                del ops[j]
+            fused += 1
+            i = chain[0] + 1
+        if fused:
+            program.desc.bump_version()
+        return fused
+
+    def fuse_batch_norm(self, program, place=None, scope=None):
         """Fold conv2d -> (elementwise_add) -> batch_norm(is_test) chains
         in-place.  ``scope`` holds the parameters to rewrite (defaults to
         the global scope)."""

@@ -173,7 +173,11 @@ def _batch_norm(ctx, ins, attrs, op):
 
     inv_std = jax.lax.rsqrt(var.astype(x.dtype).reshape(bshape) + eps)
     y = (x - mean.astype(x.dtype).reshape(bshape)) * inv_std
-    y = y * scale.reshape(bshape) + bias.reshape(bshape)
+    # affine in x.dtype: an f32 scale would promote every post-BN
+    # activation back to f32 and lose the bf16 bandwidth win under the
+    # bn_bf16 AMP pass-through (stats above stay f32 either way)
+    y = y * scale.astype(x.dtype).reshape(bshape) \
+        + bias.astype(x.dtype).reshape(bshape)
     return {"Y": y, "MeanOut": mean_out, "VarianceOut": var_out,
             "SavedMean": saved_mean, "SavedVariance": saved_var}
 

@@ -40,11 +40,18 @@ def _ring_attention_lower(ctx, ins, attrs, op=None):
 
     q, k, v = ins["Q"], ins["K"], ins["V"]
     causal = bool(attrs.get("causal", True))
+    # scale attr: explicit softmax scale (the attention transpiler sets
+    # it when fusing a plain matmul-softmax-matmul chain whose scaling
+    # differs from the 1/sqrt(D) default).  ABSENT means default; a
+    # present value — including 0.0 — is used verbatim, or the fusion
+    # pass would not be semantics-preserving.
+    scale = attrs["scale"] if "scale" in attrs else None
     sp_axis = _axis_or_none(ctx.mesh, attrs.get("sp_axis", "sp"))
     if sp_axis is not None:
         from paddle_tpu.parallel.ring import ring_attention
         out = ring_attention(
             q, k, v, ctx.mesh, axis_name=sp_axis, causal=causal,
+            scale=scale,
             batch_axis=_axis_or_none(ctx.mesh, attrs.get("batch_axis", "dp")),
             head_axis=_axis_or_none(ctx.mesh, attrs.get("head_axis", "tp")))
         return {"Out": out}
@@ -55,7 +62,7 @@ def _ring_attention_lower(ctx, ins, attrs, op=None):
     from paddle_tpu.kernels import flash_attention
     not_tpu = (ctx.mesh is not None and
                ctx.mesh.devices.flat[0].platform != "tpu")
-    return {"Out": flash_attention(q, k, v, causal=causal,
+    return {"Out": flash_attention(q, k, v, scale=scale, causal=causal,
                                    force_xla=not_tpu)}
 
 

@@ -231,24 +231,47 @@ def _gru_unit(ctx, ins, attrs, op=None):
 # ---------------------------------------------------------------------------
 
 def _inner_lens_of(ctx, op, slot):
-    """[N, S] inner sub-sequence lengths of a level-2 LoD input
-    ('<name>@LEN@1', core/executor_impl._prepare_lod_feeds), or None."""
+    """DEEPEST nested lengths of a level>=2 LoD input: the largest m
+    with '<name>@LEN@m' present (core/executor_impl._prepare_lod_feeds
+    emits one per level), returned as (lens [N,S1,..,Sm], m); None for
+    dense/level-1 inputs."""
     if op is None:
         return None
     names = op.inputs.get(slot) or []
-    if names and names[0]:
-        return ctx.env.get(names[0] + "@LEN@1")
-    return None
+    if not (names and names[0]):
+        return None
+    name, m = names[0], 0
+    while (name + "@LEN@%d" % (m + 1)) in ctx.env:
+        m += 1
+    if m == 0:
+        return None
+    return ctx.env[name + "@LEN@%d" % m], m
 
 
 def _fold_level2(x, inner):
-    """[N, S, W, ...] + [N, S] -> ([N*S, W, ...], [N*S]): level-2 data
-    folded so a level-1 op body works at the FINEST level (reference
-    sequence ops always operate at the finest LoD level,
-    lod_tensor.h:58-110)."""
-    n, s = x.shape[:2]
-    return (x.reshape((n * s,) + x.shape[2:]),
-            inner.reshape(n * s), (n, s))
+    """[N, S1, .., Sm, W, ...] + [N, S1, .., Sm] ->
+    ([N*S1*..*Sm, W, ...], [M]): nested data folded so a level-1 op
+    body works at the FINEST level (reference sequence ops always
+    operate at the finest LoD level, lod_tensor.h:58-110).  The name
+    survives from the level-2-only era; it now folds any depth —
+    ``inner.ndim`` leading dims collapse."""
+    lead = x.shape[:inner.ndim]
+    m = int(np.prod(lead))
+    return (x.reshape((m,) + x.shape[inner.ndim:]),
+            inner.reshape(m), lead)
+
+
+def _copy_nested_lens(ctx, op, oname, upto):
+    """Propagate '@LEN@1'..'@LEN@upto' from the X input to an output
+    (shape-preserving ops keep every level; pooling keeps upto-1)."""
+    names = op.inputs.get("X") or []
+    if not (names and names[0]):
+        return
+    src = names[0]
+    for j in range(1, upto + 1):
+        v = ctx.env.get(src + "@LEN@%d" % j)
+        if v is not None:
+            ctx.env[oname + "@LEN@%d" % j] = v
 
 
 def _pool_core(x, lens, ptype):
@@ -300,16 +323,21 @@ def _sequence_pool(ctx, ins, attrs, op=None):
     with the outer lengths carried to the output."""
     x = ins["X"]
     ptype = attrs.get("pooltype", "AVERAGE").upper()
-    inner = _inner_lens_of(ctx, op, "X")
-    if inner is not None:
-        xf, lf, (n, s) = _fold_level2(x, inner)
+    nested = _inner_lens_of(ctx, op, "X")
+    if nested is not None:
+        inner, depth = nested
+        xf, lf, lead = _fold_level2(x, inner)
         outs = _pool_core(xf, lf, ptype)
-        outs = {k: v.reshape((n, s) + v.shape[1:])
+        outs = {k: v.reshape(lead + v.shape[1:])
                 for k, v in outs.items()}
         if op is not None and op.outputs.get("Out"):
+            # pooling consumes the finest level: output LoD drops one
+            # level (level-k input -> level-(k-1) output)
+            oname = op.outputs["Out"][0]
             outer = _lens_of(ctx, op, "X")
-            if outer is not None:  # output is level-1: row per sub-seq
-                ctx.set_seq_len(op.outputs["Out"][0], outer)
+            if outer is not None:
+                ctx.set_seq_len(oname, outer)
+            _copy_nested_lens(ctx, op, oname, depth - 1)
         return outs
     return _pool_core(x, _lens_of(ctx, op, "X"), ptype)
 
@@ -331,16 +359,17 @@ def _sequence_softmax(ctx, ins, attrs, op=None):
     """Softmax within each sequence over the time axis, masked; level-2
     input normalizes within each INNER sub-sequence (finest level)."""
     x = ins["X"]
-    inner = _inner_lens_of(ctx, op, "X")
-    if inner is not None:
-        xf, lf, (n, s) = _fold_level2(x, inner)
+    nested = _inner_lens_of(ctx, op, "X")
+    if nested is not None:
+        inner, depth = nested
+        xf, lf, _lead = _fold_level2(x, inner)
         out = _softmax_core(xf, lf).reshape(x.shape)
         if op is not None and op.outputs.get("Out"):
             oname = op.outputs["Out"][0]
             outer = _lens_of(ctx, op, "X")
-            if outer is not None:  # shape-preserving: both levels carry
+            if outer is not None:  # shape-preserving: all levels carry
                 ctx.set_seq_len(oname, outer)
-            ctx.env[oname + "@LEN@1"] = inner
+            _copy_nested_lens(ctx, op, oname, depth)
         return {"Out": out}
     lens = _lens_of(ctx, op, "X")
     out = _softmax_core(x, lens)
@@ -389,17 +418,18 @@ def _sequence_conv(ctx, ins, attrs, op=None):
     filt = ins["Filter"]
     ctx_len = int(attrs.get("contextLength", 3))
     ctx_start = int(attrs.get("contextStart", -(ctx_len // 2)))
-    inner = _inner_lens_of(ctx, op, "X")
-    if inner is not None:
-        xf, lf, (n, s) = _fold_level2(x, inner)
+    nested = _inner_lens_of(ctx, op, "X")
+    if nested is not None:
+        inner, depth = nested
+        xf, lf, lead = _fold_level2(x, inner)
         out = _seq_conv_core(xf, lf, filt, ctx_len, ctx_start)
-        out = out.reshape((n, s) + out.shape[1:])
+        out = out.reshape(lead + out.shape[1:])
         if op is not None and op.outputs.get("Out"):
             oname = op.outputs["Out"][0]
             outer = _lens_of(ctx, op, "X")
             if outer is not None:
                 ctx.set_seq_len(oname, outer)
-            ctx.env[oname + "@LEN@1"] = inner
+            _copy_nested_lens(ctx, op, oname, depth)
         return {"Out": out}
     lens = _lens_of(ctx, op, "X")
     out = _seq_conv_core(x, lens, filt, ctx_len, ctx_start)

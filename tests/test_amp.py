@@ -72,6 +72,66 @@ def test_amp_loss_parity_and_dtypes():
                for n in fp_params)
 
 
+def test_amp_bn_bf16_passthrough():
+    """FLAGS.bn_bf16: batch_norm consumes/produces bf16 under AMP
+    (activation bytes halve on conv nets) while statistics stay f32 —
+    loss must track the f32-BN AMP run and the BN output dtype must be
+    bfloat16."""
+    import jax.numpy as jnp
+
+    from paddle_tpu.core.flags import FLAGS
+
+    def build_bn():
+        img = fluid.layers.data(name="img", shape=[1, 16, 16],
+                                dtype="float32")
+        label = fluid.layers.data(name="label", shape=[1], dtype="int64")
+        conv = fluid.layers.conv2d(input=img, num_filters=8,
+                                   filter_size=3, padding=1)
+        bn = fluid.layers.batch_norm(input=conv, act="relu")
+        fc = fluid.layers.fc(input=bn, size=10, act="softmax")
+        loss = fluid.layers.mean(
+            fluid.layers.cross_entropy(input=fc, label=label))
+        return bn, loss
+
+    def train(bn_bf16, steps=8):
+        old = FLAGS.bn_bf16
+        FLAGS.bn_bf16 = bn_bf16
+        try:
+            main, startup = fluid.Program(), fluid.Program()
+            scope = fluid.Scope()
+            with fluid.scope_guard(scope):
+                with fluid.program_guard(main, startup):
+                    with fluid.unique_name.guard():
+                        bn, loss = build_bn()
+                        fluid.optimizer.SGD(
+                            learning_rate=0.1).minimize(loss)
+                fluid.transpiler.Float16Transpiler().transpile(main)
+                main.random_seed = 7
+                startup.random_seed = 7
+                exe = fluid.Executor(fluid.CPUPlace())
+                exe.run(startup)
+                rng = np.random.RandomState(0)
+                x = rng.rand(16, 1, 16, 16).astype(np.float32)
+                y = rng.randint(0, 10, (16, 1)).astype(np.int64)
+                losses, bn_v = [], None
+                for _ in range(steps):
+                    l, b = exe.run(main, feed={"img": x, "label": y},
+                                   fetch_list=[loss, bn],
+                                   return_numpy=False)
+                    losses.append(float(np.ravel(np.asarray(l))[0]))
+                    bn_v = b
+            return losses, bn_v
+        finally:
+            FLAGS.bn_bf16 = old
+
+    f32_l, f32_bn = train(False)
+    b16_l, b16_bn = train(True)
+    assert f32_bn.dtype == jnp.float32
+    assert b16_bn.dtype == jnp.bfloat16
+    np.testing.assert_allclose(b16_l, f32_l, rtol=0.15, atol=0.03)
+    assert b16_l[-1] < b16_l[0]
+
+
 def test_amp_with_dynamic_rnn():
     """AMP through lax.scan control flow: fp32 carries + bf16 body ops
     must not break carry dtype invariance."""

@@ -364,3 +364,34 @@ def test_pserver_checkpoint_survives_crash_between_renames(tmp_path):
     # injective name mapping: double underscores survive round-trip
     np.testing.assert_allclose(
         np.asarray(s2.find_var("under__scored")), 5.0)
+
+
+def test_pserver_remote_profile_toggle(tmp_path):
+    """Trainer-driven pserver profiling (reference send_recv.proto:76
+    VariableMessage.profile): ToggleProfile(on) starts the server-side
+    profiler, ToggleProfile(off) writes the table to the given path."""
+    import numpy as np
+
+    from paddle_tpu.core.scope import Scope
+    from paddle_tpu.distributed.rpc import RPCClient, VariableServer
+
+    scope = Scope()
+    scope.set("w", np.zeros(4, np.float32))
+    applied = []
+    srv = VariableServer(scope, {"w@GRAD": 0}, applied.append, fanin=1)
+    port = srv.start("127.0.0.1:0")
+    ep = "127.0.0.1:%d" % port
+    cli = RPCClient.instance()
+    prof_path = str(tmp_path / "ps_profile")
+    try:
+        cli.toggle_profile([ep], True)
+        # profiled work: one sync round through the server
+        cli.send_var(ep, "w@GRAD", np.ones(4, np.float32))
+        cli.send_barrier([ep])
+        cli.toggle_profile([ep], False, profile_path=prof_path)
+        assert applied == [0]
+        text = open(prof_path).read()
+        assert "Event" in text or len(text) > 0
+    finally:
+        cli.send_complete([ep])
+        srv.wait()

@@ -37,3 +37,57 @@ def test_scope_var_count_stable_over_steps(prog_scope, exe):
     non_persist = [n for n in names
                    if n in block.vars and not block.vars[n].persistable]
     assert non_persist == [], non_persist
+
+
+def test_num_iteration_per_drop_scope_bounds_growth():
+    """ExecutionStrategy.num_iteration_per_drop_scope is REAL: a
+    program whose interpreted/host tail writes non-persistable values
+    into the scope stays bounded over 1k iterations because the PE
+    erases them every N runs (the reference
+    ScopeBufferedSSAGraphExecutor role,
+    details/scope_buffered_ssa_graph_executor.cc)."""
+    from paddle_tpu.core.scope import Scope
+
+    main, startup = fluid.Program(), fluid.Program()
+    scope = Scope()
+    with fluid.scope_guard(scope):
+        with fluid.program_guard(main, startup):
+            with fluid.unique_name.guard():
+                x = fluid.layers.data(name="x", shape=[4],
+                                      dtype="float32")
+                y = fluid.layers.data(name="y", shape=[1],
+                                      dtype="float32")
+                pred = fluid.layers.fc(x, size=1)
+                loss = fluid.layers.mean(
+                    fluid.layers.square_error_cost(pred, y))
+                fluid.optimizer.SGD(learning_rate=0.01).minimize(loss)
+        fluid.Executor(fluid.CPUPlace()).run(startup)
+        strat = fluid.ExecutionStrategy()
+        strat.num_iteration_per_drop_scope = 10
+        pe = fluid.ParallelExecutor(
+            use_tpu=False, loss_name=loss.name, main_program=main,
+            scope=scope, num_devices=1, exec_strategy=strat)
+        rng = np.random.RandomState(0)
+        feed = {"x": rng.randn(4, 4).astype(np.float32),
+                "y": rng.randn(4, 1).astype(np.float32)}
+        block = main.global_block()
+        temp = next(n for n in block.vars
+                    if not block.vars[n].persistable
+                    and n not in feed and "tmp" in n)
+        sizes = []
+        for i in range(1000):
+            pe.run(feed=feed, fetch_list=[loss.name])
+            # simulate a host op leaving a non-persistable temp in the
+            # scope each step (distinct payloads, same program var)
+            scope.set(temp, np.full((64,), i, np.float32))
+            scope.new_scope()  # and a kid step-scope
+            sizes.append(len(scope.local_var_names()))
+        # the census never exceeds baseline + the one leaked temp, and
+        # the drop pass reclaims the temp and the kid scopes
+        assert max(sizes) <= sizes[0] + 1, (sizes[0], max(sizes))
+        assert len(scope._kids) <= 10
+        leaked = [n for n in scope.local_var_names()
+                  if n in block.vars and not block.vars[n].persistable
+                  and n not in feed]
+        # at most the current cycle's leak survives between drops
+        assert len(leaked) <= 1, leaked
