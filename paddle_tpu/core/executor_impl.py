@@ -234,9 +234,9 @@ class ExecutorCore:
                tuple(fetch_list), mode,
                bool(getattr(program, "amp_bf16", False)),
                bool(FLAGS.auto_layout),
-               # read at trace time by _amp_cast_ins: toggling it must
-               # not hit a stale executable
-               bool(FLAGS.bn_bf16))
+               # read at trace time (_amp_cast_ins / conv2d lowering):
+               # toggling either must not hit a stale executable
+               bool(FLAGS.bn_bf16), bool(FLAGS.conv_nhwc))
         entry = self._cache.get(key)
         if entry is None:
             entry = self._build(program, block_id, core_ops, scope, feed,

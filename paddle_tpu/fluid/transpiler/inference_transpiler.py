@@ -60,10 +60,28 @@ class InferenceTranspiler:
         block = program.desc.blocks[0]
         ops = block.ops
 
+        def build_index():
+            """name -> [(block_idx, op_idx)] over EVERY block: a chain
+            intermediate read by a while/cond sub-block must count as an
+            extra consumer (fusing would delete its producer)."""
+            idx = {}
+            for bi, b in enumerate(program.desc.blocks):
+                for oi, o in enumerate(b.ops):
+                    for n in o.input_arg_names():
+                        if n:
+                            idx.setdefault(n, []).append((bi, oi))
+            return idx
+
+        index = build_index()
+
         def consumers(name, start):
-            return [(j, o) for j in range(start, len(ops))
-                    for o in [ops[j]]
-                    if name in o.input_arg_names()]
+            """Block-0 consumers of ``name`` at index >= start, or None
+            when a sub-block also reads it (never fusable — deleting
+            the producer would strand the sub-block reader)."""
+            locs = index.get(name, [])
+            if any(bi != 0 for bi, _ in locs):
+                return None
+            return [(oi, ops[oi]) for _, oi in locs if oi >= start]
 
         def rank(name):
             vd = block.vars.get(name)
@@ -85,8 +103,10 @@ class InferenceTranspiler:
             scale = float(m1.attr("alpha", 1.0))
             cur = m1.output("Out")[0]
             chain = [i]
+            chain_outs = {cur}
             cons = consumers(cur, i + 1)
-            if len(cons) == 1 and cons[0][1].type == "scale":
+            if cons is not None and len(cons) == 1 \
+                    and cons[0][1].type == "scale":
                 j, s_op = cons[0]
                 if float(s_op.attr("bias", 0.0)) != 0.0:
                     i += 1
@@ -94,15 +114,19 @@ class InferenceTranspiler:
                 scale *= float(s_op.attr("scale", 1.0))
                 cur = s_op.output("Out")[0]
                 chain.append(j)
+                chain_outs.add(cur)
                 cons = consumers(cur, j + 1)
-            if len(cons) != 1 or cons[0][1].type != "softmax":
+            if cons is None or len(cons) != 1 \
+                    or cons[0][1].type != "softmax":
                 i += 1
                 continue
             j, sm = cons[0]
             cur = sm.output("Out")[0]
             chain.append(j)
+            chain_outs.add(cur)
             cons = consumers(cur, j + 1)
-            if len(cons) != 1 or cons[0][1].type != "matmul":
+            if cons is None or len(cons) != 1 \
+                    or cons[0][1].type != "matmul":
                 i += 1
                 continue
             j, m2 = cons[0]
@@ -113,7 +137,9 @@ class InferenceTranspiler:
                 i += 1
                 continue
             v_name = m2.input("Y")[0]
-            if rank(v_name) != 4:
+            # V must come from OUTSIDE the chain: matmul(attn, attn)
+            # would fuse away its own V producer
+            if rank(v_name) != 4 or v_name in chain_outs:
                 i += 1
                 continue
             chain.append(j)
@@ -127,6 +153,7 @@ class InferenceTranspiler:
             for j in sorted(chain[1:], reverse=True):
                 del ops[j]
             fused += 1
+            index = build_index()  # op indices shifted
             i = chain[0] + 1
         if fused:
             program.desc.bump_version()

@@ -21,6 +21,9 @@ from __future__ import annotations
 from paddle_tpu import batch  # noqa: F401  (paddle.v2.batch == paddle.batch)
 from paddle_tpu import dataset  # noqa: F401
 from paddle_tpu import reader  # noqa: F401
+from paddle_tpu.dataset import image  # noqa: F401  (paddle.v2.image)
+
+from . import minibatch  # noqa: F401
 
 from . import activation  # noqa: F401
 from . import attr  # noqa: F401
@@ -39,7 +42,7 @@ from .inference import Inference, infer  # noqa: F401
 __all__ = ["init", "batch", "reader", "dataset", "infer", "Inference",
            "layer", "activation", "pooling", "attr", "data_type",
            "optimizer", "parameters", "trainer", "event", "networks",
-           "topology", "config_base"]
+           "topology", "config_base", "image", "minibatch"]
 
 _initialized = False
 

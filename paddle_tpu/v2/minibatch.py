@@ -1,0 +1,5 @@
+"""paddle.v2.minibatch alias (reference python/paddle/v2/minibatch.py:
+the batch() combinator lived in its own module)."""
+from paddle_tpu import batch  # noqa: F401
+
+__all__ = ["batch"]

@@ -195,3 +195,23 @@ def test_attention_fuse_skips_observed_scores():
         n = fluid.transpiler.InferenceTranspiler().fuse_attention(main)
         assert n == 0
         assert _count_ops(main, "matmul") == 2
+
+
+def test_attention_fuse_rejects_self_attention_v():
+    """matmul(attn, attn) must NOT fuse: V would name a chain
+    intermediate whose producer the fusion deletes."""
+    main, startup = fluid.Program(), fluid.Program()
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        with fluid.program_guard(main, startup):
+            with fluid.unique_name.guard():
+                q = fluid.layers.data(name="sq", shape=[2, 8, 8],
+                                      dtype="float32")
+                k = fluid.layers.data(name="sk", shape=[2, 8, 8],
+                                      dtype="float32")
+                scores = layers.matmul(q, k, transpose_y=True)
+                attn = layers.softmax(scores)
+                out = layers.matmul(attn, attn)
+        n = fluid.transpiler.InferenceTranspiler().fuse_attention(main)
+        assert n == 0
+        assert _count_ops(main, "softmax") == 1

@@ -160,19 +160,28 @@ def bench(kind, steps, n_pservers=2, n_trainers=2, base_port=19310):
     ps_procs = [ctx.Process(target=run_pserver,
                             args=(ep, pservers, n_trainers, kind))
                 for ep in eps]
-    for p in ps_procs:
-        p.start()
-    time.sleep(2.0)
-    q = ctx.Queue()
-    tr_procs = [ctx.Process(target=run_trainer,
-                            args=(i, pservers, n_trainers, steps, q,
-                                  kind))
-                for i in range(n_trainers)]
-    for p in tr_procs:
-        p.start()
-    results = [q.get(timeout=900) for _ in tr_procs]
-    for p in tr_procs + ps_procs:
-        p.join(timeout=120)
+    tr_procs = []
+    try:
+        for p in ps_procs:
+            p.start()
+        time.sleep(2.0)
+        q = ctx.Queue()
+        tr_procs = [ctx.Process(target=run_trainer,
+                                args=(i, pservers, n_trainers, steps, q,
+                                      kind))
+                    for i in range(n_trainers)]
+        for p in tr_procs:
+            p.start()
+        results = [q.get(timeout=900) for _ in tr_procs]
+        for p in tr_procs + ps_procs:
+            p.join(timeout=120)
+    finally:
+        # a crashed child must not leave non-daemon orphans holding the
+        # ports (and blocking interpreter exit)
+        for p in tr_procs + ps_procs:
+            if p.is_alive():
+                p.terminate()
+                p.join(timeout=10)
     dt = max(r[1] for r in results)  # rounds complete at the slowest
     return steps / dt
 
