@@ -1,0 +1,91 @@
+"""The classic v2 MNIST script, unchanged except the import line
+(reference: python/paddle/v2 usage in the book's recognize_digits
+chapter — layer.data/fc chains, parameters.create, trainer.SGD with
+Momentum, event handler, paddle.infer).
+
+Run:  python examples/v2_mnist.py        (a couple of minutes on CPU;
+      set PASSES/BATCHES_PER_PASS down for a smoke run)
+"""
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import paddle_tpu.v2 as paddle  # was: import paddle.v2 as paddle
+
+PASSES = int(os.environ.get("PASSES", "2"))
+BATCHES_PER_PASS = int(os.environ.get("BATCHES_PER_PASS", "50"))
+
+
+def main():
+    paddle.init(use_gpu=False, trainer_count=1)
+
+    images = paddle.layer.data(
+        name="pixel", type=paddle.data_type.dense_vector(784))
+    label = paddle.layer.data(
+        name="label", type=paddle.data_type.integer_value(10))
+    hidden1 = paddle.layer.fc(input=images, size=128,
+                              act=paddle.activation.Relu(), name="h1")
+    hidden2 = paddle.layer.fc(input=hidden1, size=64,
+                              act=paddle.activation.Relu(), name="h2")
+    predict = paddle.layer.fc(input=hidden2, size=10,
+                              act=paddle.activation.Softmax(),
+                              name="pred")
+    cost = paddle.layer.classification_cost(input=predict, label=label)
+
+    parameters = paddle.parameters.create(cost)
+    optimizer = paddle.optimizer.Momentum(
+        learning_rate=0.1 / 128.0, momentum=0.9,
+        regularization=paddle.optimizer.L2Regularization(
+            rate=0.0005 * 128))
+    trainer = paddle.trainer.SGD(cost=cost, parameters=parameters,
+                                 update_equation=optimizer)
+
+    def bounded(reader, n):
+        def r():
+            for i, item in enumerate(reader()):
+                if i >= n:
+                    return
+                yield item
+        return r
+
+    train_reader = bounded(
+        paddle.batch(paddle.reader.shuffle(paddle.dataset.mnist.train(),
+                                           buf_size=8192),
+                     batch_size=128),
+        BATCHES_PER_PASS)
+
+    def event_handler(event):
+        if isinstance(event, paddle.event.EndIteration):
+            if event.batch_id % 20 == 0:
+                print("pass %d batch %d cost %.4f err %.3f" % (
+                    event.pass_id, event.batch_id, event.cost,
+                    event.metrics["classification_error_evaluator"]))
+        elif isinstance(event, paddle.event.EndPass):
+            result = trainer.test(reader=bounded(
+                paddle.batch(paddle.dataset.mnist.test(),
+                             batch_size=128), 10))
+            print("pass %d test cost %.4f err %.3f" % (
+                event.pass_id, result.cost,
+                result.metrics["classification_error_evaluator"]))
+
+    trainer.train(reader=train_reader, num_passes=PASSES,
+                  event_handler=event_handler)
+
+    # serve a few digits through paddle.infer (same [-1,1] images the
+    # trainer consumed)
+    test_rows = []
+    for i, (img, lab) in enumerate(paddle.dataset.mnist.test()()):
+        test_rows.append((img, lab))
+        if i >= 7:
+            break
+    probs = paddle.infer(output_layer=predict, parameters=parameters,
+                         input=[(r[0],) for r in test_rows])
+    got = np.argmax(np.asarray(probs), axis=1)
+    print("infer:", list(got), "labels:", [r[1] for r in test_rows])
+
+
+if __name__ == "__main__":
+    main()

@@ -29,6 +29,7 @@ from . import activation  # noqa: F401
 from . import attr  # noqa: F401
 from . import config_base  # noqa: F401
 from . import data_type  # noqa: F401
+from . import evaluator  # noqa: F401
 from . import event  # noqa: F401
 from . import layer  # noqa: F401
 from . import networks  # noqa: F401
@@ -42,7 +43,7 @@ from .inference import Inference, infer  # noqa: F401
 __all__ = ["init", "batch", "reader", "dataset", "infer", "Inference",
            "layer", "activation", "pooling", "attr", "data_type",
            "optimizer", "parameters", "trainer", "event", "networks",
-           "topology", "config_base", "image", "minibatch"]
+           "topology", "config_base", "image", "minibatch", "evaluator"]
 
 _initialized = False
 

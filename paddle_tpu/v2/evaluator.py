@@ -1,0 +1,98 @@
+"""v2 evaluators (reference python/paddle/v2/evaluator.py, which wraps
+trainer_config_helpers/evaluators.py).
+
+An evaluator call returns a Layer node whose build attaches a named
+metric to the topology; pass it to ``parameters.create(cost,
+extra_layers=[...])`` (or ``trainer.SGD(extra_layers=...)``) — only
+nodes reachable from the roots are built, so merely declaring the
+evaluator is NOT enough.  Attached metrics show up in every
+EndIteration/EndPass event and ``test()`` result, like the reference's
+auto-collected evaluator outputs.  ``classification_cost`` already
+attaches ``classification_error_evaluator`` implicitly, matching v1's
+default evaluator.
+
+Streaming evaluators (``auc``) register their accumulator vars as
+topology metric state; the trainer zeroes that state at every
+BeginPass and at the start of ``test()`` (the reference evaluator's
+start() reset).
+"""
+from __future__ import annotations
+
+from .config_base import Layer
+from .layer import _attach_classification_error, _auto_name
+
+__all__ = ["classification_error", "auc", "precision_recall"]
+
+
+def _reject_kwargs(fn_name, kwargs):
+    if kwargs:
+        raise NotImplementedError(
+            "%s: unsupported argument(s) %s — supported surface is "
+            "input/label/name (+top_k for classification_error)"
+            % (fn_name, sorted(kwargs)))
+
+
+def classification_error(input, label, name=None, top_k=1, **kwargs):
+    _reject_kwargs("evaluator.classification_error", kwargs)
+    name = _auto_name("eval_cls_err", name)
+
+    def build(ctx, pred, lab):
+        return _attach_classification_error(ctx, name, pred, lab,
+                                            k=top_k)
+
+    return Layer(name, build, inputs=[input, label], size=1)
+
+
+def auc(input, label, name=None, **kwargs):
+    _reject_kwargs("evaluator.auc", kwargs)
+    name = _auto_name("eval_auc", name)
+
+    def build(ctx, pred, lab):
+        blk = ctx.main_program.global_block()
+        before = set(blk.vars)
+        a = ctx.fluid.layers.auc(input=pred, label=lab)
+        # the layer created persistable TP/FP/TN/FN accumulators:
+        # register them as metric state so the trainer can reset them
+        # per pass / per test run (reference evaluator start())
+        ctx.add_metric_state([n for n in blk.vars
+                              if n not in before
+                              and n.startswith("auc_")])
+        ctx.add_metric(name, a)
+        return a
+
+    return Layer(name, build, inputs=[input, label], size=1)
+
+
+def precision_recall(input, label, name=None, **kwargs):
+    """BINARY precision/recall at the argmax decision; attaches
+    '<name>.precision' and '<name>.recall'.  Multi-class streaming
+    precision_recall (the reference op semantics) is available as the
+    registered ``precision_recall`` op; this evaluator guards against
+    silently wrong multi-class use."""
+    _reject_kwargs("evaluator.precision_recall", kwargs)
+    if getattr(input, "size", None) not in (None, 2):
+        raise NotImplementedError(
+            "evaluator.precision_recall supports binary predictions "
+            "(width 2); got width %r — use the precision_recall op "
+            "for multi-class" % (input.size,))
+    name = _auto_name("eval_pr", name)
+
+    def build(ctx, pred, lab):
+        L = ctx.fluid.layers
+        hard = L.argmax(pred, axis=len(pred.shape) - 1)
+        hard = L.reshape(hard, [-1, 1])
+        labf = L.cast(lab, "float32")
+        hardf = L.cast(hard, "float32")
+        tp = L.reduce_sum(L.elementwise_mul(hardf, labf))
+        eps = 1e-6
+        prec = L.elementwise_div(
+            tp, L.elementwise_add(L.reduce_sum(hardf),
+                                  L.fill_constant([1], "float32", eps)))
+        rec = L.elementwise_div(
+            tp, L.elementwise_add(L.reduce_sum(labf),
+                                  L.fill_constant([1], "float32", eps)))
+        ctx.add_metric(name + ".precision", prec)
+        ctx.add_metric(name + ".recall", rec)
+        return prec
+
+    return Layer(name, build, inputs=[input, label], size=1)

@@ -364,6 +364,16 @@ def gru_memory(input, size=None, name=None, reverse=False, act=None,
 
 
 # --------------------------------------------------------------- costs
+def _attach_classification_error(ctx, metric_name, pred, lab, k=1):
+    """error = 1 - top-k accuracy, registered as a topology metric
+    (shared by classification_cost's implicit evaluator and
+    v2.evaluator.classification_error)."""
+    acc = ctx.fluid.layers.accuracy(input=pred, label=lab, k=k)
+    err = ctx.fluid.layers.scale(acc, scale=-1.0, bias=1.0)
+    ctx.add_metric(metric_name, err)
+    return err
+
+
 def classification_cost(input, label, weight=None, name=None,
                         evaluator=None, layer_attr=None):
     """Softmax-output + cross-entropy; attaches the v2
@@ -375,9 +385,8 @@ def classification_cost(input, label, weight=None, name=None,
         if rest:
             ce = ctx.fluid.layers.elementwise_mul(ce, rest[0])
         cost = ctx.fluid.layers.mean(ce)
-        acc = ctx.fluid.layers.accuracy(input=pred, label=lab)
-        err = ctx.fluid.layers.scale(acc, scale=-1.0, bias=1.0)
-        ctx.add_metric("classification_error_evaluator", err)
+        _attach_classification_error(
+            ctx, "classification_error_evaluator", pred, lab)
         return cost
 
     ins = [input, label] + ([weight] if weight is not None else [])

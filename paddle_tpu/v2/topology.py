@@ -34,6 +34,7 @@ class Topology:
         self.is_test = is_test
         self.fluid = fluid
         self.metrics = {}          # name -> fluid var
+        self.metric_states = []    # persistable accumulator var names
         self.scope = Scope()
         self.main_program = fluid.Program()
         self.startup_program = fluid.Program()
@@ -51,6 +52,18 @@ class Topology:
     # -- ctx interface used by layer builders ------------------------
     def add_metric(self, name, var):
         self.metrics[name] = var
+
+    def add_metric_state(self, var_names):
+        """Register streaming-evaluator accumulators; the trainer zeroes
+        them at BeginPass / test() start (reference evaluator start())."""
+        self.metric_states.extend(var_names)
+
+    def reset_metric_states(self):
+        import numpy as np
+        for n in self.metric_states:
+            if self.scope.has_var(n):
+                cur = np.asarray(self.scope.find_var(n))
+                self.scope.set(n, np.zeros_like(cur))
 
     # -- materialization ---------------------------------------------
     def _build(self, node):

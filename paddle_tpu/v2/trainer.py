@@ -92,7 +92,12 @@ class SGD:
             # program holds that trainer's backward pass): build a
             # fresh topology and pour the current values in by name —
             # the new trainer continues from them, and ``parameters``
-            # follows the newest trainer's scope
+            # follows the newest trainer's scope.  Evaluators attached
+            # to the original topology (parameters.create extra_layers)
+            # carry over unless the caller overrides.
+            if extra_layers is None and topo is not None \
+                    and topo.cost_layer is cost:
+                extra_layers = topo.extra_layers
             values = {n: parameters.get(n) for n in parameters.names()}
             topo = Topology(cost, extra_layers=extra_layers)
             topo.run_startup()
@@ -135,6 +140,7 @@ class SGD:
         exe = fluid.Executor(fluid.CPUPlace())
         with fluid.scope_guard(topo.scope):
             for pass_id in range(num_passes):
+                topo.reset_metric_states()
                 event_handler(v2_event.BeginPass(pass_id))
                 pass_costs, pass_metrics = [], []
                 for batch_id, batch in enumerate(reader()):
@@ -168,6 +174,7 @@ class SGD:
         exe = fluid.Executor(fluid.CPUPlace())
         costs, metrics, weights = [], [], []
         with fluid.scope_guard(topo.scope):
+            topo.reset_metric_states()
             for batch in reader():
                 outs = exe.run(self.__test_program__,
                                feed=feeder(batch), fetch_list=fetch)

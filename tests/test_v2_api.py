@@ -439,6 +439,137 @@ def test_v2_unported_layer_names_fail_loudly():
         paddle.layer.recurrent_group
 
 
+def test_v2_sentiment_lstm_via_networks():
+    """The v2 sentiment config shape: integer_value_sequence ->
+    embedding -> networks.simple_lstm -> last_seq -> softmax fc; must
+    train on a separable toy task (exercises the lstmemory builder
+    over the LoD bridge)."""
+    paddle.init(trainer_count=1)
+    words = paddle.layer.data(
+        name="sl_w", type=paddle.data_type.integer_value_sequence(20))
+    label = paddle.layer.data(
+        name="sl_y", type=paddle.data_type.integer_value(2))
+    emb = paddle.layer.embedding(input=words, size=8)
+    lstm = paddle.networks.simple_lstm(input=emb, size=8)
+    last = paddle.layer.last_seq(input=lstm)
+    predict = paddle.layer.fc(input=last, size=2,
+                              act=paddle.activation.Softmax())
+    cost = paddle.layer.classification_cost(input=predict, label=label)
+    parameters = paddle.parameters.create(cost)
+    trainer = paddle.trainer.SGD(
+        cost=cost, parameters=parameters,
+        update_equation=paddle.optimizer.Adam(learning_rate=0.05))
+    rng = np.random.RandomState(11)
+
+    def reader():
+        for _ in range(15):
+            batch = []
+            for _ in range(8):
+                y = int(rng.randint(2))
+                length = int(rng.randint(3, 7))
+                seq = rng.randint(y * 10, y * 10 + 10,
+                                  size=length).tolist()
+                batch.append((seq, y))
+            yield batch
+
+    costs = []
+    trainer.train(reader=reader, num_passes=4, event_handler=lambda e:
+                  costs.append(e.cost)
+                  if isinstance(e, paddle.event.EndIteration) else None)
+    assert costs[-1] < costs[0] * 0.6, (costs[0], costs[-1])
+
+
+def test_v2_evaluator_attaches_metric():
+    """paddle.v2.evaluator.* layers attach named metrics that surface
+    in events and test() results via extra_layers."""
+    paddle.init(trainer_count=1)
+    predict, cost = _mlp(dim=16, classes=4)
+    ev = paddle.evaluator.classification_error(
+        input=predict,
+        label=cost.inputs[1],  # the label data layer of the cost
+        name="my_err")
+    parameters = paddle.parameters.create(cost, extra_layers=[ev])
+    trainer = paddle.trainer.SGD(
+        cost=cost, parameters=parameters,
+        update_equation=paddle.optimizer.Adam(learning_rate=0.05))
+    seen = []
+    trainer.train(
+        reader=_digit_reader(np.random.RandomState(6), n_batches=4,
+                             dim=16, classes=4),
+        num_passes=1,
+        event_handler=lambda e: seen.append(e.metrics)
+        if isinstance(e, paddle.event.EndIteration) else None)
+    assert seen and all("my_err" in m for m in seen)
+    res = trainer.test(reader=_digit_reader(np.random.RandomState(8),
+                                            n_batches=2, dim=16,
+                                            classes=4))
+    assert "my_err" in res.metrics
+
+
+def test_v2_auc_evaluator_state_resets():
+    """Streaming auc accumulators reset at each pass / test() start:
+    two identical test() calls must return the SAME auc, and train
+    statistics must not leak into test results."""
+    paddle.init(trainer_count=1)
+    x = paddle.layer.data(name="auc_x",
+                          type=paddle.data_type.dense_vector(8))
+    y = paddle.layer.data(name="auc_y",
+                          type=paddle.data_type.integer_value(2))
+    predict = paddle.layer.fc(input=x, size=2,
+                              act=paddle.activation.Softmax())
+    cost = paddle.layer.classification_cost(input=predict, label=y)
+    ev = paddle.evaluator.auc(input=predict, label=y, name="the_auc")
+    parameters = paddle.parameters.create(cost, extra_layers=[ev])
+    trainer = paddle.trainer.SGD(
+        cost=cost, parameters=parameters,
+        update_equation=paddle.optimizer.Adam(learning_rate=0.05))
+    rng = np.random.RandomState(12)
+
+    def reader():
+        for _ in range(6):
+            batch = []
+            for _ in range(16):
+                yv = int(rng.randint(2))
+                xv = rng.randn(8).astype(np.float32)
+                xv[0] += 2.0 * yv
+                batch.append((xv, yv))
+            yield batch
+
+    trainer.train(reader=reader, num_passes=2)
+    fixed = np.random.RandomState(13)
+
+    def fixed_reader():
+        for _ in range(4):
+            batch = []
+            for _ in range(16):
+                yv = int(fixed.randint(2))
+                xv = fixed.randn(8).astype(np.float32)
+                xv[0] += 2.0 * yv
+                batch.append((xv, yv))
+            yield batch
+
+    rows = list(fixed_reader())
+    r1 = trainer.test(reader=lambda: iter(rows))
+    r2 = trainer.test(reader=lambda: iter(rows))
+    assert abs(r1.metrics["the_auc"] - r2.metrics["the_auc"]) < 1e-6
+    assert r1.metrics["the_auc"] > 0.5  # learned the separable signal
+
+
+def test_v2_evaluator_rejects_unknown_kwargs():
+    x = paddle.layer.data(name="ek_x",
+                          type=paddle.data_type.dense_vector(4))
+    y = paddle.layer.data(name="ek_y",
+                          type=paddle.data_type.integer_value(2))
+    p = paddle.layer.fc(input=x, size=2,
+                        act=paddle.activation.Softmax())
+    with pytest.raises(NotImplementedError, match="chunk_scheme"):
+        paddle.evaluator.auc(input=p, label=y, chunk_scheme="plain")
+    with pytest.raises(NotImplementedError, match="binary"):
+        p4 = paddle.layer.fc(input=x, size=4,
+                             act=paddle.activation.Softmax())
+        paddle.evaluator.precision_recall(input=p4, label=y)
+
+
 def test_v2_sparse_binary_input_densified():
     paddle.init(trainer_count=1)
     t = paddle.data_type.sparse_binary_vector(10)
