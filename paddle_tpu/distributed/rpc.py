@@ -7,7 +7,8 @@ operators/listen_and_serv_op.cc:99,166.
 
 Implementation notes (TPU-host path):
 - gRPC *generic* method handlers with a numpy-native wire format — no
-  protoc codegen; tensors travel as raw ``np.lib.format`` bytes.
+  protoc codegen; tensors travel as a raw dtype|shape|bytes frame
+  (memcpy-speed encode, zero-copy decode — see _enc_arr).
 - The sync protocol is barrier-counted like the reference: trainers send
   every grad, then SendBarrier; once ``fanin`` barriers arrive the server
   aggregates (mean over trainers), runs the per-param optimize blocks, and
@@ -17,7 +18,6 @@ Implementation notes (TPU-host path):
 """
 from __future__ import annotations
 
-import io
 import os
 import threading
 from concurrent import futures
@@ -33,42 +33,90 @@ GRPC_OPTIONS = [("grpc.max_send_message_length", -1),
                 ("grpc.max_receive_message_length", -1)]
 
 
+def _enc_arr(parts, arr):
+    """Append one array as dtype | ndim | shape | raw bytes.  Raw
+    tobytes instead of np.save: the npy framing costs a full extra
+    buffer pass (~650 MB/s measured vs memcpy), and a 100 MB dense
+    round serializes ~400 MB — the hot path the reference served with
+    zero-copy sockets (ParameterServer2.h)."""
+    # NOT np.ascontiguousarray unconditionally: it promotes 0-d to 1-d
+    arr = np.asarray(arr)
+    if arr.dtype.hasobject:
+        # fail at the SENDER: tobytes() on an object array would ship
+        # heap pointers and only blow up at the remote decoder
+        raise TypeError("cannot send object-dtype array over the "
+                        "pserver wire (got dtype=%s)" % arr.dtype)
+    if not arr.flags.c_contiguous:
+        arr = np.ascontiguousarray(arr)
+    dt = arr.dtype.str.encode("ascii")
+    parts.append(len(dt).to_bytes(2, "little"))
+    parts.append(dt)
+    parts.append(arr.ndim.to_bytes(1, "little"))
+    for d in arr.shape:
+        parts.append(int(d).to_bytes(8, "little"))
+    parts.append(arr.tobytes())
+
+
+def _dec_arr(view, off):
+    """Zero-copy array decode from a memoryview.  The result is a
+    READ-ONLY view over the message buffer — every in-repo consumer is
+    functional (aggregation, optimize blocks, device_put all produce
+    fresh arrays); a caller that wants to mutate must .copy()."""
+    n = int.from_bytes(view[off:off + 2], "little")
+    off += 2
+    dtype = np.dtype(view[off:off + n].tobytes().decode("ascii"))
+    off += n
+    ndim = view[off]
+    off += 1
+    shape = []
+    for _ in range(ndim):
+        shape.append(int.from_bytes(view[off:off + 8], "little"))
+        off += 8
+    count = int(np.prod(shape)) if shape else 1
+    nbytes = count * dtype.itemsize
+    arr = np.frombuffer(view[off:off + nbytes],
+                        dtype=dtype).reshape(shape)
+    return arr, off + nbytes
+
+
 def _enc_tensor(name, arr, extra=0):
     """Wire format: name | extra | kind (0 dense, 1 SelectedRows) | arrays.
     SelectedRows travel as (rows, values, height) — reference
     VariableMessage's SELECTED_ROWS type (send_recv.proto:48)."""
     from paddle_tpu.core.selected_rows import SelectedRows
 
-    buf = io.BytesIO()
     nb = name.encode("utf-8")
-    buf.write(len(nb).to_bytes(4, "little"))
-    buf.write(nb)
-    buf.write(int(extra).to_bytes(8, "little", signed=True))
+    parts = [len(nb).to_bytes(4, "little"), nb,
+             int(extra).to_bytes(8, "little", signed=True)]
     if isinstance(arr, SelectedRows):
-        buf.write(b"\x01")
-        buf.write(int(arr.height).to_bytes(8, "little"))
-        np.save(buf, np.asarray(arr.rows), allow_pickle=False)
-        np.save(buf, np.asarray(arr.values), allow_pickle=False)
+        parts.append(b"\x01")
+        parts.append(int(arr.height).to_bytes(8, "little"))
+        _enc_arr(parts, np.asarray(arr.rows))
+        _enc_arr(parts, np.asarray(arr.values))
     else:
-        buf.write(b"\x00")
-        np.save(buf, np.asarray(arr), allow_pickle=False)
-    return buf.getvalue()
+        parts.append(b"\x00")
+        _enc_arr(parts, np.asarray(arr))
+    return b"".join(parts)
 
 
 def _dec_tensor(data):
     from paddle_tpu.core.selected_rows import SelectedRows
 
-    buf = io.BytesIO(data)
-    n = int.from_bytes(buf.read(4), "little")
-    name = buf.read(n).decode("utf-8")
-    extra = int.from_bytes(buf.read(8), "little", signed=True)
-    kind = buf.read(1)
-    if kind == b"\x01":
-        height = int.from_bytes(buf.read(8), "little")
-        rows = np.load(buf, allow_pickle=False)
-        values = np.load(buf, allow_pickle=False)
+    view = memoryview(data)
+    n = int.from_bytes(view[:4], "little")
+    name = view[4:4 + n].tobytes().decode("utf-8")
+    off = 4 + n
+    extra = int.from_bytes(view[off:off + 8], "little", signed=True)
+    off += 8
+    kind = view[off]
+    off += 1
+    if kind == 1:
+        height = int.from_bytes(view[off:off + 8], "little")
+        off += 8
+        rows, off = _dec_arr(view, off)
+        values, off = _dec_arr(view, off)
         return name, SelectedRows(rows, values, height), extra
-    arr = np.load(buf, allow_pickle=False)
+    arr, off = _dec_arr(view, off)
     return name, arr, extra
 
 

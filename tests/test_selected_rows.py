@@ -269,3 +269,38 @@ def test_shared_table_grads_sum_one_step():
         np.testing.assert_allclose(w[:3], expect_touched, rtol=1e-5,
                                    err_msg=f"is_sparse={is_sparse}")
         np.testing.assert_allclose(w[3:], 0.02, rtol=1e-6)
+
+
+def test_rpc_wire_format_roundtrip():
+    """The raw dtype|shape|bytes RPC frame (distributed/rpc.py
+    _enc_tensor/_dec_tensor) roundtrips dense arrays of every common
+    dtype/rank, 0-d scalars, empty arrays, and SelectedRows."""
+    import numpy as np
+
+    from paddle_tpu.core.selected_rows import SelectedRows
+    from paddle_tpu.distributed.rpc import _dec_tensor, _enc_tensor
+
+    cases = [
+        np.arange(12, dtype=np.float32).reshape(3, 4),
+        np.arange(8, dtype=np.int64),
+        np.float32(3.5),                       # 0-d
+        np.zeros((0, 5), np.float32),          # empty
+        np.random.RandomState(0).randn(2, 3, 4).astype(np.float64),
+        np.array([True, False]),
+    ]
+    for i, arr in enumerate(cases):
+        name, got, extra = _dec_tensor(
+            _enc_tensor("var_%d" % i, arr, extra=i - 2))
+        assert name == "var_%d" % i and extra == i - 2
+        assert got.dtype == np.asarray(arr).dtype
+        assert got.shape == np.asarray(arr).shape
+        np.testing.assert_array_equal(got, arr)
+
+    sr = SelectedRows(np.array([1, 5, 7]),
+                      np.random.RandomState(1).randn(3, 4)
+                      .astype(np.float32), 10)
+    name, got, _ = _dec_tensor(_enc_tensor("emb@GRAD", sr, 3))
+    assert isinstance(got, SelectedRows) and got.height == 10
+    np.testing.assert_array_equal(got.rows, sr.rows)
+    np.testing.assert_array_equal(np.asarray(got.values),
+                                  np.asarray(sr.values))
