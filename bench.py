@@ -127,12 +127,18 @@ def transformer_bench(on_accel, as_dict=False):
         pass
     for _ in range(2):
         exe.run(main_prog, feed=feed, fetch_list=[avg_cost])
-    t0 = time.time()
-    for _ in range(iters):
-        loss, = exe.run(main_prog, feed=feed, fetch_list=[avg_cost],
-                        return_numpy=False)
-    loss = np.asarray(loss)
-    elapsed = time.time() - t0
+    import contextlib
+    prof_ctx = contextlib.nullcontext()
+    if os.environ.get("BENCH_PROFILE"):
+        import jax
+        prof_ctx = jax.profiler.trace(os.environ["BENCH_PROFILE"])
+    with prof_ctx:
+        t0 = time.time()
+        for _ in range(iters):
+            loss, = exe.run(main_prog, feed=feed,
+                            fetch_list=[avg_cost], return_numpy=False)
+        loss = np.asarray(loss)
+        elapsed = time.time() - t0
     tokens_per_sec = bs * seq * iters / elapsed
     out = {
         "metric": "transformer_lm_d%d_L%d_train_bs%d_seq%d%s" % (
@@ -384,24 +390,25 @@ def main():
     # async like the reference's CUDA streams); one sync at the end.
     # BENCH_PROFILE=<dir> wraps the loop in jax.profiler.trace and
     # prints the per-hlo-category breakdown (utils/xplane.py) to stderr.
+    import contextlib
     profile_dir = os.environ.get("BENCH_PROFILE")
-    prof_ctx = None
+    prof_ctx = contextlib.nullcontext()
     if profile_dir:
         import jax
         prof_ctx = jax.profiler.trace(profile_dir)
-        prof_ctx.__enter__()
-    t0 = time.time()
-    loss = None
-    for _ in range(iters):
-        step_feed = next(loader_iter) if loader_iter is not None else feed
-        loss, = exe.run(main_prog, feed=step_feed, fetch_list=[avg_cost],
-                        return_numpy=False)
-    loss = np.asarray(loss)  # blocks until the chain has drained
-    elapsed = time.time() - t0
-    if prof_ctx is not None:
+    with prof_ctx:  # exception-safe: a mid-run OOM still finalizes
+        t0 = time.time()
+        loss = None
+        for _ in range(iters):
+            step_feed = next(loader_iter) if loader_iter is not None \
+                else feed
+            loss, = exe.run(main_prog, feed=step_feed,
+                            fetch_list=[avg_cost], return_numpy=False)
+        loss = np.asarray(loss)  # blocks until the chain has drained
+        elapsed = time.time() - t0
+    if profile_dir:
         import glob
 
-        prof_ctx.__exit__(None, None, None)
         from paddle_tpu.utils.xplane import print_category_profile
         pbs = sorted(glob.glob(os.path.join(
             profile_dir, "**", "*.xplane.pb"), recursive=True),

@@ -54,7 +54,9 @@ def _enc_arr(parts, arr):
     parts.append(arr.ndim.to_bytes(1, "little"))
     for d in arr.shape:
         parts.append(int(d).to_bytes(8, "little"))
-    parts.append(arr.tobytes())
+    # memoryview, not tobytes(): join copies it once — tobytes would
+    # make that two full passes over a 100 MB payload
+    parts.append(arr.data)
 
 
 def _dec_arr(view, off):
