@@ -31,6 +31,7 @@ __all__ = [
     "pooling", "last_seq", "first_seq", "lstmemory", "gru_memory",
     "classification_cost", "cross_entropy_cost", "square_error_cost",
     "mse_cost", "regression_cost", "crf", "crf_decoding", "ctc",
+    "recurrent_group", "memory", "StaticInput",
     "AggregateLevel", "ExpandLevel", "parse_network",
 ]
 
@@ -363,6 +364,150 @@ def gru_memory(input, size=None, name=None, reverse=False, act=None,
     return Layer(name, build, inputs=ins, size=width)
 
 
+# --------------------------------------------------- recurrent groups
+class StaticInput:
+    """Mark a recurrent_group input as read WHOLE every step instead of
+    sliced along time (reference trainer_config_helpers StaticInput)."""
+
+    def __init__(self, input, is_seq=False, size=None):
+        if is_seq:
+            raise NotImplementedError(
+                "StaticInput(is_seq=True) (whole-sequence static reads) "
+                "is not ported; fail loudly rather than silently "
+                "changing the recurrence")
+        self.input = input
+        self.is_seq = is_seq
+        self.size = size
+
+
+def memory(name=None, size=None, is_seq=False, boot_layer=None,
+           boot_bias=None, boot_bias_active_type=None,
+           boot_with_const_id=None, memory_name=None):
+    """The previous timestep's value of the step layer called ``name``
+    (reference trainer_config_helpers memory()): only meaningful inside
+    a recurrent_group step function.  ``boot_layer`` seeds step 0;
+    otherwise zeros of [N, size]."""
+    if name is None:
+        raise ValueError("memory() needs name= of the step layer whose "
+                         "previous value it reads")
+    if boot_with_const_id is not None or boot_bias is not None or \
+            boot_bias_active_type is not None:
+        raise NotImplementedError(
+            "memory boot_bias/boot_with_const_id are not ported; use "
+            "boot_layer=")
+    if is_seq:
+        raise NotImplementedError(
+            "memory(is_seq=True) (sequence-level memory) is not "
+            "ported; fail loudly rather than silently changing the "
+            "recurrence")
+    node_name = _auto_name("memory", memory_name)
+    holder = []
+
+    def build(ctx, *boot):
+        stack = getattr(ctx, "_drnn_stack", None)
+        if not stack:
+            raise RuntimeError(
+                "layer.memory(%r) used outside a recurrent_group step"
+                % name)
+        drnn, records = stack[-1]
+        if boot:
+            mem = drnn.memory(init=boot[0])
+        else:
+            if size is None:
+                raise ValueError("memory(%r) needs size= (no boot_layer)"
+                                 % name)
+            mem = drnn.memory(shape=[size])
+        records.append((holder[0], mem, name))
+        return mem
+
+    node = Layer(node_name, build,
+                 inputs=[boot_layer] if boot_layer is not None else [],
+                 size=size)
+    node._is_memory = True
+    holder.append(node)
+    return node
+
+
+def recurrent_group(step, input, reverse=False, name=None, **kwargs):
+    """Run ``step`` over every timestep of the sequence inputs
+    (reference trainer_config_helpers recurrent_group / v2 layer.py
+    wrapping it).  TPU-native: the whole group lowers to ONE fluid
+    DynamicRNN — a masked lax.scan — instead of the reference's
+    per-step gserver evaluation.
+
+    ``step(*ins)`` receives one per-timestep layer per input
+    (StaticInput entries arrive whole) and returns ONE output layer;
+    ``layer.memory(name=...)`` inside the step reads the previous
+    timestep's value of the step layer with that name.  The group's
+    output is the sequence of step outputs (a LoD layer)."""
+    if kwargs:
+        raise NotImplementedError(
+            "recurrent_group: unsupported argument(s) %s — supported "
+            "surface is step/input/reverse/name" % sorted(kwargs))
+    name = _auto_name("recurrent_group", name)
+    specs = _inputs(input)
+    dag_inputs = [s.input if isinstance(s, StaticInput) else s
+                  for s in specs]
+    # step() runs at DECLARATION time: it only constructs the deferred
+    # DAG (no fluid ops), which lets us (a) list memory boot subtrees
+    # as real node inputs — so boot data layers join the feeding order
+    # and materialize in the PARENT block, not inside the scan — and
+    # (b) keep ancestors()/data_layers() truthful about the group.
+    cells = [[] for _ in specs]  # bound to fluid vars at build time
+    proxies = [Layer(_auto_name("step_in"),
+                     (lambda c, _cell=cell: _cell[0]), inputs=(),
+                     size=(s.size or getattr(s.input, "size", None))
+                     if isinstance(s, StaticInput)
+                     else getattr(s, "size", None))
+               for s, cell in zip(specs, cells)]
+    out = step(*proxies) if len(proxies) != 1 else step(proxies[0])
+    if isinstance(out, (list, tuple)):
+        raise NotImplementedError(
+            "recurrent_group with multiple step outputs is not ported; "
+            "return one layer (concat inside the step)")
+    mem_nodes = [a for a in out.ancestors()
+                 if getattr(a, "_is_memory", False)]
+    boot_roots = [b for m in mem_nodes for b in m.inputs]
+
+    def build(ctx, *xs):
+        # xs = seq/static vars + boot vars; boots were built in the
+        # parent block as node inputs and reach the memory builders
+        # through the memo
+        seq_vars = xs[:len(specs)]
+        drnn = ctx.fluid.layers.DynamicRNN()
+        drnn._reverse = bool(reverse)
+        records = []
+        with drnn.block():
+            for spec, var, cell in zip(specs, seq_vars, cells):
+                if isinstance(spec, StaticInput):
+                    cell[:] = [drnn.static_input(var)]
+                else:
+                    cell[:] = [drnn.step_input(var)]
+            stack = getattr(ctx, "_drnn_stack", [])
+            ctx._drnn_stack = stack + [(drnn, records)]
+            try:
+                out_var = ctx._build(out)
+            finally:
+                ctx._drnn_stack = stack
+            # wire memories: each memory(name=N) updates from the step
+            # layer called N produced by this step's DAG
+            for mem_node, mem_var, target in records:
+                cand = None
+                for a in out.ancestors():
+                    if a.name == target and a is not mem_node:
+                        cand = a
+                        break
+                if cand is None or id(cand) not in ctx._memo:
+                    raise ValueError(
+                        "memory(%r): no step layer with that name was "
+                        "produced by the step function" % target)
+                drnn.update_memory(mem_var, ctx._memo[id(cand)])
+            drnn.output(out_var)
+        return drnn()
+
+    return Layer(name, build, inputs=dag_inputs + boot_roots, size=None)
+
+
 # --------------------------------------------------------------- costs
 def _attach_classification_error(ctx, metric_name, pred, lab, k=1):
     """error = 1 - top-k accuracy, registered as a topology metric
@@ -459,8 +604,6 @@ def ctc(input, label, size=None, name=None, norm_by_times=False):
 
 
 _FLUID_POINTERS = {
-    "recurrent_group": "fluid.layers.DynamicRNN / StaticRNN",
-    "memory": "fluid.layers.DynamicRNN memories",
     "mixed": "explicit fc/embedding + layer.addto",
     "beam_search": "fluid.layers.beam_search",
     "seq_concat": "fluid.layers.sequence_concat",
