@@ -31,7 +31,7 @@ __all__ = [
     "pooling", "last_seq", "first_seq", "lstmemory", "gru_memory",
     "classification_cost", "cross_entropy_cost", "square_error_cost",
     "mse_cost", "regression_cost", "crf", "crf_decoding", "ctc",
-    "recurrent_group", "memory", "StaticInput",
+    "recurrent_group", "memory", "StaticInput", "seq_concat", "expand",
     "AggregateLevel", "ExpandLevel", "parse_network",
 ]
 
@@ -364,6 +364,47 @@ def gru_memory(input, size=None, name=None, reverse=False, act=None,
     return Layer(name, build, inputs=ins, size=width)
 
 
+def seq_concat(a, b, act=None, name=None, layer_attr=None,
+               bias_attr=None):
+    """Concatenate two ragged sequences along time, row by row
+    (reference seq_concat_layer -> sequence_concat_op.cc; positional
+    order (a, b, act, name) matches the reference)."""
+    if a.size is not None and b.size is not None and a.size != b.size:
+        raise ValueError(
+            "seq_concat inputs must share the feature width; got "
+            "%r vs %r" % (a.size, b.size))
+    name = _auto_name("seqconcat", name)
+    fluid_act = v2_act.to_fluid_act(act)
+
+    def build(ctx, xa, xb):
+        out = ctx.fluid.layers.sequence_concat([xa, xb])
+        if fluid_act:
+            out = getattr(ctx.fluid.layers, fluid_act)(out)
+        return out
+
+    return Layer(name, build, inputs=[a, b], size=a.size)
+
+
+def expand(input, expand_as, name=None, bias_attr=None,
+           expand_level=None, layer_attr=None):
+    """Broadcast per-sequence vectors over the timesteps of a reference
+    ragged batch (reference expand_layer -> sequence_expand_op.cc;
+    positional order (input, expand_as, name, bias_attr, expand_level)
+    matches the reference).  Only the default FROM_NO_SEQUENCE level is
+    ported — a nested-level expand must fail loudly, not mis-expand."""
+    if expand_level not in (None, ExpandLevel.FROM_NO_SEQUENCE):
+        raise NotImplementedError(
+            "expand(expand_level=%r): only FROM_NO_SEQUENCE is ported"
+            % (expand_level,))
+    name = _auto_name("expand", name)
+
+    def build(ctx, x, y):
+        return ctx.fluid.layers.sequence_expand(x, y)
+
+    return Layer(name, build, inputs=[input, expand_as],
+                 size=input.size)
+
+
 # --------------------------------------------------- recurrent groups
 class StaticInput:
     """Mark a recurrent_group input as read WHOLE every step instead of
@@ -606,8 +647,6 @@ def ctc(input, label, size=None, name=None, norm_by_times=False):
 _FLUID_POINTERS = {
     "mixed": "explicit fc/embedding + layer.addto",
     "beam_search": "fluid.layers.beam_search",
-    "seq_concat": "fluid.layers.sequence_concat",
-    "expand": "fluid.layers.sequence_expand",
     "conv_projection": "fluid.layers.conv2d",
     "full_matrix_projection": "layer.fc",
 }

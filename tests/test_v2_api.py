@@ -738,6 +738,30 @@ def test_v2_recurrent_group_boot_layer():
     assert costs[-1] < costs[0], (costs[0], costs[-1])
 
 
+def test_v2_seq_concat_and_expand_build():
+    """seq_concat / expand materialize to the fluid sequence ops."""
+    a = paddle.layer.data(
+        name="sc_a", type=paddle.data_type.dense_vector_sequence(3))
+    b = paddle.layer.data(
+        name="sc_b", type=paddle.data_type.dense_vector_sequence(3))
+    cat = paddle.layer.seq_concat(a=a, b=b)
+    per_seq = paddle.layer.pooling(input=cat,
+                                   pooling_type=paddle.pooling.Avg())
+    ex = paddle.layer.expand(input=per_seq, expand_as=cat)
+    desc = paddle.layer.parse_network(ex)
+    types = [op.type for op in desc.blocks[0].ops]
+    assert "sequence_concat" in types and "sequence_expand" in types
+    # guarded surface: width mismatch and nested expand fail loudly
+    w5 = paddle.layer.data(
+        name="sc_w5", type=paddle.data_type.dense_vector_sequence(5))
+    with pytest.raises(ValueError, match="feature width"):
+        paddle.layer.seq_concat(a=a, b=w5)
+    with pytest.raises(NotImplementedError, match="FROM_NO_SEQUENCE"):
+        paddle.layer.expand(
+            input=per_seq, expand_as=cat,
+            expand_level=paddle.layer.ExpandLevel.FROM_SEQUENCE)
+
+
 def test_v2_sparse_binary_input_densified():
     paddle.init(trainer_count=1)
     t = paddle.data_type.sparse_binary_vector(10)
