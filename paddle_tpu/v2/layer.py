@@ -32,6 +32,8 @@ __all__ = [
     "classification_cost", "cross_entropy_cost", "square_error_cost",
     "mse_cost", "regression_cost", "crf", "crf_decoding", "ctc",
     "recurrent_group", "memory", "StaticInput", "seq_concat", "expand",
+    "mixed", "full_matrix_projection", "identity_projection",
+    "table_projection",
     "AggregateLevel", "ExpandLevel", "parse_network",
 ]
 
@@ -364,11 +366,94 @@ def gru_memory(input, size=None, name=None, reverse=False, act=None,
     return Layer(name, build, inputs=ins, size=width)
 
 
+# -------------------------------------------------- mixed/projections
+class _Projection:
+    """A projection INTO a mixed layer (reference
+    trainer_config_helpers projections.py): carries the source layer
+    and a builder emitting its contribution [N, mixed_size]."""
+
+    def __init__(self, input, builder, size=None):
+        self.input = input
+        self.builder = builder
+        self.size = size  # declared/known output width, if any
+
+
+def full_matrix_projection(input, size=0, param_attr=None):
+    """x @ W (reference full_matrix_projection): W is [in, mixed_size],
+    learned per projection.  A declared ``size`` must agree with the
+    owning mixed()'s width (validated there)."""
+    def build(ctx, x, owner_name, j, width):
+        return ctx.fluid.layers.fc(
+            x, size=width, bias_attr=False,
+            param_attr=_layer_param_attr(owner_name, param_attr,
+                                         "w%d" % j))
+
+    return _Projection(input, build, size=size or None)
+
+
+def identity_projection(input, offset=None, size=None):
+    """Pass-through (reference identity_projection); offset slices a
+    feature window."""
+    if offset is not None:
+        raise NotImplementedError(
+            "identity_projection(offset=...) is not ported")
+
+    def build(ctx, x, owner_name, j, width):
+        return x
+
+    return _Projection(input, build, size=input.size)
+
+
+def mixed(size=0, name=None, input=None, act=None, bias_attr=False,
+          layer_attr=None):
+    """Sum of projections (reference mixed_layer): each projection maps
+    its source into [N, size] and the contributions add, plus optional
+    bias/activation.  TPU-native: the whole container is a handful of
+    fused matmul/add ops, not a gserver 'mixed' evaluation."""
+    projs = input if isinstance(input, (list, tuple)) else [input]
+    if not projs or any(p is None for p in projs):
+        raise ValueError("mixed() needs input= projection(s)")
+    projs = [p if isinstance(p, _Projection)
+             else full_matrix_projection(p) for p in projs]
+    name = _auto_name("mixed", name)
+    width = size or next((p.size for p in projs if p.size), None)
+    if width is None:
+        raise ValueError("mixed() needs size= (no projection fixes one)")
+    for p in projs:
+        # a projection with a KNOWN width must agree with the mixed
+        # width; unknown (None, e.g. identity over a recurrent_group
+        # output) defers to the runtime shapes
+        if p.size is not None and p.size != width:
+            raise ValueError(
+                "projection width %r != mixed size %r" % (p.size, width))
+    fluid_act = v2_act.to_fluid_act(act)
+
+    def build(ctx, *xs):
+        parts = [p.builder(ctx, x, name, j, width)
+                 for j, (p, x) in enumerate(zip(projs, xs))]
+        out = parts[0] if len(parts) == 1 else \
+            ctx.fluid.layers.sums(parts)
+        if bias_attr is not False:
+            b = ctx.fluid.layers.create_parameter(
+                shape=[width], dtype="float32", is_bias=True,
+                attr=_bias_attr(name, bias_attr))
+            out = ctx.fluid.layers.elementwise_add(out, b)
+        if fluid_act:
+            out = getattr(ctx.fluid.layers, fluid_act)(out)
+        return out
+
+    return Layer(name, build, inputs=[p.input for p in projs],
+                 size=width)
+
+
 def seq_concat(a, b, act=None, name=None, layer_attr=None,
                bias_attr=None):
     """Concatenate two ragged sequences along time, row by row
     (reference seq_concat_layer -> sequence_concat_op.cc; positional
     order (a, b, act, name) matches the reference)."""
+    if bias_attr not in (None, False):
+        raise NotImplementedError(
+            "seq_concat bias is not ported; apply layer.addto/fc after")
     if a.size is not None and b.size is not None and a.size != b.size:
         raise ValueError(
             "seq_concat inputs must share the feature width; got "
@@ -396,6 +481,9 @@ def expand(input, expand_as, name=None, bias_attr=None,
         raise NotImplementedError(
             "expand(expand_level=%r): only FROM_NO_SEQUENCE is ported"
             % (expand_level,))
+    if bias_attr not in (None, False):
+        raise NotImplementedError(
+            "expand bias is not ported; apply layer.addto/fc after")
     name = _auto_name("expand", name)
 
     def build(ctx, x, y):
@@ -645,10 +733,8 @@ def ctc(input, label, size=None, name=None, norm_by_times=False):
 
 
 _FLUID_POINTERS = {
-    "mixed": "explicit fc/embedding + layer.addto",
     "beam_search": "fluid.layers.beam_search",
     "conv_projection": "fluid.layers.conv2d",
-    "full_matrix_projection": "layer.fc",
 }
 
 

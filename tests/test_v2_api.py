@@ -434,7 +434,7 @@ def test_v2_optimizer_strictness_and_clip():
 
 def test_v2_unported_layer_names_fail_loudly():
     with pytest.raises(AttributeError, match="ported v2 subset"):
-        paddle.layer.mixed
+        paddle.layer.conv_projection
     with pytest.raises(AttributeError, match="beam_search"):
         paddle.layer.beam_search
 
@@ -760,6 +760,60 @@ def test_v2_seq_concat_and_expand_build():
         paddle.layer.expand(
             input=per_seq, expand_as=cat,
             expand_level=paddle.layer.ExpandLevel.FROM_SEQUENCE)
+
+
+def test_v2_mixed_projections_train():
+    """mixed + full_matrix/identity projections (the v1 projection-sum
+    container): contributions add into [N, size], bias + act apply, and
+    the whole thing trains."""
+    paddle.init(trainer_count=1)
+    x = paddle.layer.data(name="mx",
+                          type=paddle.data_type.dense_vector(6))
+    z = paddle.layer.data(name="mz",
+                          type=paddle.data_type.dense_vector(8))
+    y = paddle.layer.data(name="my",
+                          type=paddle.data_type.dense_vector(1))
+    h = paddle.layer.mixed(
+        size=8,
+        input=[paddle.layer.full_matrix_projection(input=x),
+               paddle.layer.identity_projection(input=z)],
+        act=paddle.activation.Tanh(), bias_attr=True, name="mh")
+    pred = paddle.layer.fc(input=h, size=1)
+    cost = paddle.layer.mse_cost(input=pred, label=y)
+    params = paddle.parameters.create(cost)
+    tr = paddle.trainer.SGD(
+        cost=cost, parameters=params,
+        update_equation=paddle.optimizer.Adam(learning_rate=0.05))
+    rng = np.random.RandomState(0)
+
+    def reader():
+        for _ in range(20):
+            b = []
+            for _ in range(16):
+                xv = rng.rand(6).astype(np.float32)
+                zv = rng.rand(8).astype(np.float32)
+                b.append((xv, zv,
+                          np.asarray([xv.sum() - zv.sum()],
+                                     np.float32)))
+            yield b
+
+    costs = []
+    tr.train(reader=reader, num_passes=5, event_handler=lambda e:
+             costs.append(e.cost)
+             if isinstance(e, paddle.event.EndIteration) else None)
+    assert costs[-1] < costs[0] * 0.2, (costs[0], costs[-1])
+    # declaration-time guards
+    with pytest.raises(ValueError, match="size"):
+        paddle.layer.mixed(input=[
+            paddle.layer.full_matrix_projection(input=x)])
+    with pytest.raises(ValueError, match="width"):
+        paddle.layer.mixed(size=5, input=[
+            paddle.layer.identity_projection(input=z)])
+    with pytest.raises(ValueError, match="width"):
+        paddle.layer.mixed(size=8, input=[
+            paddle.layer.full_matrix_projection(input=x, size=4)])
+    with pytest.raises(NotImplementedError, match="offset"):
+        paddle.layer.identity_projection(input=z, offset=2)
 
 
 def test_v2_sparse_binary_input_densified():
