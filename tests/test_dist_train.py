@@ -106,6 +106,34 @@ def test_dist_train_sparse_embedding():
     assert local[-1] < local[0]  # embedding actually moved
 
 
+def test_large_shard_over_the_wire():
+    """A parameter shard well past gRPC's 4MB default message cap must
+    roundtrip (regression: GRPC_OPTIONS unlimited sizes — a 100MB fc
+    shard used to fail with 'Received message larger than max')."""
+    import numpy as np
+
+    from paddle_tpu.core.scope import Scope
+    from paddle_tpu.distributed.rpc import RPCClient, VariableServer
+
+    big = np.random.RandomState(0).rand(1200, 2048).astype(np.float32)
+    scope = Scope()
+    scope.set("w", big)                       # ~9.8 MB
+    applied = []
+    srv = VariableServer(scope, {"w@GRAD": 0}, applied.append, fanin=1)
+    port = srv.start("127.0.0.1:0")
+    ep = "127.0.0.1:%d" % port
+    cli = RPCClient.instance()
+    try:
+        cli.send_var(ep, "w@GRAD", big * 0.5)  # >4MB up
+        cli.send_barrier([ep])
+        got, = cli.get_vars([(ep, "w")])       # >4MB down
+        np.testing.assert_array_equal(np.asarray(got), big)
+        assert applied == [0]
+    finally:
+        cli.send_complete([ep])
+        srv.wait()
+
+
 def test_dist_train_async_mode():
     """Async pserver (reference listen_and_serv RunAsyncLoop): no
     barriers, grads applied on arrival.  Losses cannot match the sync
