@@ -56,7 +56,8 @@ def auc(input, label, name=None, **kwargs):
         # per pass / per test run (reference evaluator start())
         ctx.add_metric_state([n for n in blk.vars
                               if n not in before
-                              and n.startswith("auc_")])
+                              and n.startswith("auc_")],
+                             metric_name=name)
         ctx.add_metric(name, a)
         return a
 

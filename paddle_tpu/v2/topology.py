@@ -35,6 +35,7 @@ class Topology:
         self.fluid = fluid
         self.metrics = {}          # name -> fluid var
         self.metric_states = []    # persistable accumulator var names
+        self.streaming_metrics = set()  # metric names that accumulate
         self.scope = Scope()
         self.main_program = fluid.Program()
         self.startup_program = fluid.Program()
@@ -53,10 +54,14 @@ class Topology:
     def add_metric(self, name, var):
         self.metrics[name] = var
 
-    def add_metric_state(self, var_names):
+    def add_metric_state(self, var_names, metric_name=None):
         """Register streaming-evaluator accumulators; the trainer zeroes
-        them at BeginPass / test() start (reference evaluator start())."""
+        them at BeginPass / test() start (reference evaluator start()).
+        ``metric_name`` marks that metric as CUMULATIVE — pass/test
+        aggregation reports its final value, not a batch average."""
         self.metric_states.extend(var_names)
+        if metric_name is not None:
+            self.streaming_metrics.add(metric_name)
 
     def reset_metric_states(self):
         import numpy as np

@@ -158,7 +158,10 @@ class SGD:
                     pass_metrics.append(metrics)
                     event_handler(v2_event.EndIteration(
                         pass_id, batch_id, cost, metrics))
-                avg = {n: float(np.mean([m[n] for m in pass_metrics]))
+                streaming = topo.streaming_metrics
+                avg = {n: (pass_metrics[-1][n] if n in streaming
+                           else float(np.mean([m[n]
+                                               for m in pass_metrics])))
                        for n in metric_names} if pass_metrics else {}
                 event_handler(v2_event.EndPass(pass_id, avg))
 
@@ -184,8 +187,12 @@ class SGD:
                 weights.append(len(batch))
         w = np.asarray(weights, np.float64)
         w = w / w.sum() if len(w) else w
+        streaming = topo.streaming_metrics
+        # streaming (cumulative) metrics: the LAST batch holds the
+        # whole-set value; per-batch metrics weight-average
         avg_metrics = {
-            n: float(np.dot(w, [m[i] for m in metrics]))
+            n: (metrics[-1][i] if n in streaming
+                else float(np.dot(w, [m[i] for m in metrics])))
             for i, n in enumerate(metric_names)} if metrics else {}
         cost = float(np.dot(w, costs)) if costs else float("nan")
         return v2_event.TestResult(cost=cost, metrics=avg_metrics)

@@ -122,6 +122,10 @@ def test_large_shard_over_the_wire():
     srv = VariableServer(scope, {"w@GRAD": 0}, applied.append, fanin=1)
     port = srv.start("127.0.0.1:0")
     ep = "127.0.0.1:%d" % port
+    # the singleton's step counter may have advanced in earlier tests;
+    # a fresh server starts at round 0 and sync get_vars would wait
+    # forever on a higher round
+    RPCClient.reset()
     cli = RPCClient.instance()
     try:
         cli.send_var(ep, "w@GRAD", big * 0.5)  # >4MB up

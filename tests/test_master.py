@@ -381,6 +381,7 @@ def test_pserver_remote_profile_toggle(tmp_path):
     srv = VariableServer(scope, {"w@GRAD": 0}, applied.append, fanin=1)
     port = srv.start("127.0.0.1:0")
     ep = "127.0.0.1:%d" % port
+    RPCClient.reset()  # fresh round counter for the fresh server
     cli = RPCClient.instance()
     prof_path = str(tmp_path / "ps_profile")
     try:
