@@ -628,6 +628,9 @@ def create_array(dtype, element_shape=None, capacity=64):
              "capacity": int(capacity)}
     if element_shape is not None:
         attrs["element_shape"] = [int(d) for d in element_shape]
+        # record it on the var too so array_read's shape propagation
+        # works when the first write happens inside a While body
+        out.desc.shape = tuple(int(d) for d in element_shape)
     helper.append_op(type="create_array", outputs={"Out": [out]},
                      attrs=attrs)
     return out
@@ -644,6 +647,12 @@ def array_write(x, i, array=None, capacity=64):
     helper.append_op(type="write_to_array", inputs=inputs,
                      outputs={"Out": [array]},
                      attrs={"capacity": int(capacity)})
+    # record the element shape on the ARRAY var: abstract shape
+    # inference cannot evaluate the runtime TensorArray, so array_read
+    # (possibly in another block) copies this — without it an fc on a
+    # read value sees shape () and mis-sizes its parameter
+    if x.shape and array.desc is not None:
+        array.desc.shape = tuple(x.shape)
     return array
 
 
@@ -653,6 +662,9 @@ def array_read(array, i):
     helper.append_op(type="read_from_array",
                      inputs={"X": [array], "I": [i]},
                      outputs={"Out": [out]})
+    # element shape recorded by array_write / create_array
+    if array.shape:
+        out.desc.shape = tuple(array.shape)
     return out
 
 

@@ -7,7 +7,7 @@ this package closes the last census row: ``layer`` / ``activation`` /
 over the fluid stack (see each module's docstring for the reference
 anchor).  A reference v2 script over the ported layer subset
 (``layer.py __all__``: data/fc/embedding/conv/pool/bn/sequence/lstm/
-gru/recurrent_group+memory/mixed+projections/seq_concat/expand/cost layers) — layers declared at import time,
+gru/recurrent_group+memory/mixed+projections/beam_search generation/seq_concat/expand/cost layers) — layers declared at import time,
 ``parameters.create(cost)``, ``trainer.SGD(...).train(reader)`` — runs
 with only the import line changed; unported v1 layer names raise with
 their fluid equivalent named.

@@ -33,7 +33,7 @@ __all__ = [
     "mse_cost", "regression_cost", "crf", "crf_decoding", "ctc",
     "recurrent_group", "memory", "StaticInput", "seq_concat", "expand",
     "mixed", "full_matrix_projection", "identity_projection",
-    "table_projection",
+    "table_projection", "beam_search", "GeneratedInput",
     "AggregateLevel", "ExpandLevel", "parse_network",
 ]
 
@@ -637,6 +637,237 @@ def recurrent_group(step, input, reverse=False, name=None, **kwargs):
     return Layer(name, build, inputs=dag_inputs + boot_roots, size=None)
 
 
+# ----------------------------------------------------- beam generation
+class GeneratedInput:
+    """The decoding-time input of a beam_search step: the previous
+    step's SELECTED token, embedded through ``embedding_name``
+    (reference trainer_config_helpers GeneratedInput)."""
+
+    def __init__(self, size, embedding_name, embedding_size):
+        self.size = int(size)                  # vocab
+        self.embedding_name = embedding_name
+        self.embedding_size = int(embedding_size)
+
+
+class _BeamHost:
+    """The _drnn_stack member during a beam_search build: memories read
+    the previous iteration's (parent-gathered) state from arrays."""
+
+    def __init__(self, read_vars):
+        self._reads = read_vars  # list populated per memory order
+        self._taken = 0
+        self.records = []        # (mem_node, mem_var, target_name)
+
+    def memory(self, init=None, shape=None):
+        v = self._reads[self._taken]
+        self._taken += 1
+        return v
+
+
+def beam_search(step, input, bos_id, eos_id, beam_size,
+                max_length=100, name=None, num_results_per_sample=None,
+                **kwargs):
+    """Generate with beam search (reference trainer_config_helpers
+    beam_search): run the ``step`` function in decoding mode — its
+    GeneratedInput is the previous step's selected token — growing
+    ``beam_size`` beams until ``eos_id``/``max_length``.
+
+    TPU-native: the loop is a fluid While over the beam_search /
+    beam_search_decode ops (device top-k growth + reverse backtrack),
+    one compiled program — not a per-step host loop.  The layer's value
+    is ``sentence_ids`` [N, beam, T] best-first; pair it with
+    ``layer.memory(name=..., boot_layer=...)`` for decoder state (the
+    state is parent-gathered between steps).  ``step`` must return the
+    per-token PROBABILITY layer [*, vocab] (softmax output)."""
+    if kwargs:
+        raise NotImplementedError(
+            "beam_search: unsupported argument(s) %s" % sorted(kwargs))
+    specs = _inputs(input)
+    gens = [s for s in specs if isinstance(s, GeneratedInput)]
+    if len(gens) != 1:
+        raise ValueError("beam_search needs exactly one GeneratedInput")
+    gen = gens[0]
+    statics = [s for s in specs if isinstance(s, StaticInput)]
+    if len(gens) + len(statics) != len(specs):
+        raise ValueError(
+            "beam_search inputs must be GeneratedInput/StaticInput")
+    name = _auto_name("beam_search", name)
+    # declaration-time step capture (the recurrent_group protocol):
+    # proxies bind at build time
+    cells = {"gen": []}
+    static_cells = [[] for _ in statics]
+    proxies = []
+    for s in specs:
+        if isinstance(s, GeneratedInput):
+            proxies.append(Layer(
+                _auto_name("gen_in"),
+                (lambda c, _cell=cells["gen"]: _cell[0]), inputs=(),
+                size=s.embedding_size))
+        else:
+            idx = statics.index(s)
+            proxies.append(Layer(
+                _auto_name("beam_static"),
+                (lambda c, _cell=static_cells[idx]: _cell[0]),
+                inputs=(),
+                size=s.size or getattr(s.input, "size", None)))
+    out = step(*proxies) if len(proxies) != 1 else step(proxies[0])
+    if isinstance(out, (list, tuple)):
+        raise NotImplementedError(
+            "beam_search steps must return one probability layer")
+    # memory nodes in ctx._build POSTORDER (inputs left-to-right): the
+    # _BeamHost hands its array reads out positionally in memory-CALL
+    # order, which is exactly this order — ancestors() (stack-pop
+    # order) would cross-wire sibling memories' states
+    def _build_order(node, seen, order):
+        if id(node) in seen:
+            return order
+        seen.add(id(node))
+        for i in node.inputs:
+            _build_order(i, seen, order)
+        order.append(node)
+        return order
+
+    ordered = _build_order(out, set(), [])
+    mem_nodes = [a for a in ordered if getattr(a, "_is_memory", False)]
+    boot_roots = [b for m in mem_nodes for b in m.inputs]
+    dag_inputs = [s.input for s in statics] + boot_roots
+
+    def build(ctx, *xs):
+        L = ctx.fluid.layers
+        static_vars = list(xs[:len(statics)])
+        nb = beam_size  # beams per sample, flattened [N*B, ...]
+        # ANY batch-carrying input sizes N: a static var or a memory
+        # boot var — boot-only multi-sample decodes must not silently
+        # shrink to sample 0
+        ref = xs[0] if xs else None
+        if ref is not None:
+            # [N, B] zeros -> flattened [N*B, 1] template
+            template = L.reshape(
+                L.fill_constant_batch_size_like(
+                    ref, shape=[1, nb], dtype="float32", value=0.0),
+                [-1, 1])
+        else:
+            template = L.fill_constant([nb, 1], "float32", 0.0)
+        one = L.fill_constant([1], "float32", 1.0)
+        # arange over the flat beams; sample and in-sample beam index
+        arange = L.elementwise_sub(
+            L.cumsum(L.elementwise_add(template, one), axis=0), one)
+        sample_f = L.floor(L.scale(arange, scale=1.0 / nb, bias=1e-4))
+        sample_idx = L.reshape(L.cast(sample_f, "int32"), [-1])
+        beam_pos = L.elementwise_sub(arange,
+                                     L.scale(sample_f, scale=float(nb)))
+        gathered_statics = [L.gather(v, sample_idx)
+                            for v in static_vars]
+        for cell, v in zip(static_cells, gathered_statics):
+            cell[:] = [v]
+        # boot values for memories, gathered to the flat beams (built
+        # in the PARENT block; memoized so the in-loop re-trace below
+        # must not clear them)
+        boot_flat = {}
+        keep_ids = set()
+        for m in mem_nodes:
+            if m.inputs:
+                bv = ctx._build(m.inputs[0])
+                boot_flat[id(m)] = L.gather(bv, sample_idx)
+                keep_ids.update(id(a) for a in m.inputs[0].ancestors())
+
+        counter = L.fill_constant([1], "int64", 0)
+        limit = L.fill_constant([1], "int64", max_length)
+        cap = max_length + 1
+        start_ids = L.cast(
+            L.elementwise_add(
+                template,
+                L.fill_constant([1], "float32", float(bos_id))),
+            "int64")
+        # only beam 0 of each sample is live at t=0, or every beam
+        # would grow the same token B times
+        init_scores = L.scale(L.clip(beam_pos, 0.0, 1.0), scale=-1e9)
+        ids_arr = L.array_write(start_ids, i=counter, capacity=cap)
+        sc_arr = L.array_write(init_scores, i=counter, capacity=cap)
+        par_arr = L.array_write(
+            L.cast(L.reshape(template, [-1]), "int32"), i=counter,
+            capacity=cap)
+        mem_arrs = {}
+        for m in mem_nodes:
+            init = boot_flat.get(id(m))
+            if init is None:
+                if m.size is None:
+                    raise ValueError(
+                        "beam_search memory %r needs size= or "
+                        "boot_layer=" % m.name)
+                # [NB, size] zeros via a zero matmul off the template
+                init = L.matmul(
+                    template,
+                    L.fill_constant([1, m.size], "float32", 0.0))
+            mem_arrs[id(m)] = L.array_write(init, i=counter,
+                                            capacity=cap)
+
+        cond = L.less_than(x=counter, y=limit)
+        w = L.While(cond=cond)
+        with w.block():
+            pre_ids = L.array_read(ids_arr, i=counter)
+            pre_scores = L.array_read(sc_arr, i=counter)
+            emb = L.embedding(
+                pre_ids, size=[gen.size, gen.embedding_size],
+                param_attr=ParamAttr(name=gen.embedding_name))
+            emb = L.reshape(emb, [-1, gen.embedding_size])
+            cells["gen"][:] = [emb]
+            mem_reads = [L.array_read(mem_arrs[id(m)], i=counter)
+                         for m in mem_nodes]
+            host = _BeamHost(mem_reads)
+            stack = getattr(ctx, "_drnn_stack", [])
+            ctx._drnn_stack = stack + [(host, host.records)]
+            saved = dict(ctx._memo)
+            try:
+                # re-trace the step DAG against THIS iteration's reads
+                # (boot/static/parent-block nodes keep their memo)
+                for n in out.ancestors():
+                    if id(n) not in keep_ids:
+                        ctx._memo.pop(id(n), None)
+                probs = ctx._build(out)
+                logp = L.log(L.clip(probs, 1e-20, 1.0))
+                accu = L.elementwise_add(logp, pre_scores)
+                k = min(gen.size, max(beam_size * 2, beam_size + 1))
+                cand_scores, cand_ids = L.topk(accu, k=k)
+                sel_ids, sel_scores, parent = L.beam_search(
+                    pre_ids, pre_scores, cand_ids, cand_scores,
+                    beam_size=beam_size, end_id=eos_id)
+                L.increment(x=counter, value=1, in_place=True)
+                # ALL next-iteration state goes to the INCREMENTED
+                # index — the next loop body reads there (a write at
+                # the old index would reset memories to zero each step)
+                for m_node, _mem_var, target in host.records:
+                    cand = next(
+                        (a for a in out.ancestors()
+                         if a.name == target and a is not m_node), None)
+                    if cand is None or id(cand) not in ctx._memo:
+                        raise ValueError(
+                            "beam_search memory(%r): no step layer "
+                            "with that name" % target)
+                    L.array_write(
+                        L.gather(ctx._memo[id(cand)], parent),
+                        i=counter, array=mem_arrs[id(m_node)])
+                L.array_write(sel_ids, i=counter, array=ids_arr)
+                L.array_write(sel_scores, i=counter, array=sc_arr)
+                L.array_write(parent, i=counter, array=par_arr)
+                L.less_than(x=counter, y=limit, cond=cond)
+            finally:
+                # loop-block vars must never leak into the topology's
+                # memo, even when the re-trace fails
+                ctx._drnn_stack = stack
+                ctx._memo.clear()
+                ctx._memo.update(saved)
+        sent_ids, sent_scores = L.beam_search_decode(
+            ids_arr, sc_arr, par_arr, beam_size, eos_id)
+        if num_results_per_sample is not None and \
+                num_results_per_sample < beam_size:
+            sent_ids = L.slice_op(sent_ids, axes=[1], starts=[0],
+                                  ends=[num_results_per_sample])
+        return sent_ids
+
+    return Layer(name, build, inputs=dag_inputs, size=None)
+
+
 # --------------------------------------------------------------- costs
 def _attach_classification_error(ctx, metric_name, pred, lab, k=1):
     """error = 1 - top-k accuracy, registered as a topology metric
@@ -733,7 +964,6 @@ def ctc(input, label, size=None, name=None, norm_by_times=False):
 
 
 _FLUID_POINTERS = {
-    "beam_search": "fluid.layers.beam_search",
     "conv_projection": "fluid.layers.conv2d",
 }
 

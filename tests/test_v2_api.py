@@ -435,8 +435,9 @@ def test_v2_optimizer_strictness_and_clip():
 def test_v2_unported_layer_names_fail_loudly():
     with pytest.raises(AttributeError, match="ported v2 subset"):
         paddle.layer.conv_projection
-    with pytest.raises(AttributeError, match="beam_search"):
-        paddle.layer.beam_search
+    # a name with no curated pointer gets the generic fluid hint
+    with pytest.raises(AttributeError, match="fluid.layers equivalent"):
+        paddle.layer.hsigmoid_layer_from_v1
 
 
 def test_v2_sentiment_lstm_via_networks():
@@ -814,6 +815,137 @@ def test_v2_mixed_projections_train():
             paddle.layer.full_matrix_projection(input=x, size=4)])
     with pytest.raises(NotImplementedError, match="offset"):
         paddle.layer.identity_projection(input=z, offset=2)
+
+
+def test_v2_beam_search_beats_greedy():
+    """v2 beam_search (reference trainer_config_helpers beam_search):
+    generation over a garden-path transition table — greedy takes the
+    trap, beam 2 recovers the delayed-reward path."""
+    END, BOS, V = 0, 1, 5
+    gen = paddle.layer.GeneratedInput(size=V, embedding_name="gp_T",
+                                      embedding_size=V)
+
+    def step(prev):
+        return paddle.layer.mixed(
+            size=V,
+            input=[paddle.layer.identity_projection(input=prev)],
+            act=paddle.activation.Softmax())
+
+    def run(beam):
+        out = paddle.layer.beam_search(
+            step=step, input=[gen], bos_id=BOS, eos_id=END,
+            beam_size=beam, max_length=4)
+        params = paddle.parameters.create(out)
+        t = np.full((V, V), -1e9, np.float32)
+        t[1, 2] = np.log(.6)
+        t[1, 3] = np.log(.4)
+        t[2, 4] = np.log(.55)
+        t[2, END] = np.log(.45)
+        t[4, END] = t[3, END] = t[END, END] = 0.0
+        params.set("gp_T", t)
+        return np.asarray(paddle.infer(output_layer=out,
+                                       parameters=params, input=[()]))
+
+    g = run(1)
+    assert g[0, 0].tolist()[:4] == [1, 2, 4, END]  # greedy trap
+    b = run(2)
+    assert b[0, 0].tolist()[:3] == [1, 3, END]     # beam recovers
+    assert b[0, 1].tolist()[:4] == [1, 2, 4, END]  # runner-up = greedy
+
+
+def test_v2_beam_search_with_decoder_state():
+    """beam_search + layer.memory: decoder state accumulates embedded
+    tokens and is parent-gathered between steps; weights are designed
+    so the forced sequence depends on the WHOLE history (wrong state
+    carrying would derail it)."""
+    END, BOS, V = 0, 1, 4
+    gen = paddle.layer.GeneratedInput(size=V, embedding_name="bs_E",
+                                      embedding_size=V)
+
+    def step(prev):
+        h_prev = paddle.layer.memory(name="bs_h", size=V)
+        h = paddle.layer.fc(input=[prev, h_prev], size=V,
+                            act=paddle.activation.Linear(),
+                            name="bs_h", bias_attr=False)
+        return paddle.layer.mixed(
+            size=V,
+            input=[paddle.layer.full_matrix_projection(input=h)],
+            act=paddle.activation.Softmax(), name="bs_p")
+
+    def run(beam):
+        out = paddle.layer.beam_search(
+            step=step, input=[gen], bos_id=BOS, eos_id=END,
+            beam_size=beam, max_length=5)
+        params = paddle.parameters.create(out)
+        eye = np.eye(V, dtype=np.float32)
+        params.set("bs_E", eye)
+        params.set("_bs_h.w0", eye)
+        params.set("_bs_h.w1", eye)
+        # h = sum of one-hots seen; rows pick: {1}->2, {1,2}->3,
+        # {1,2,3}->END
+        M = np.array([[0, -99, 0, 0], [1, -99, 5, 3],
+                      [1, -99, -9, 2], [1, -99, 0, -9]],
+                     np.float32) * 4.0
+        params.set("_bs_p.w0", M)
+        return np.asarray(paddle.infer(output_layer=out,
+                                       parameters=params, input=[()]))
+
+    assert run(1)[0, 0].tolist()[:4] == [1, 2, 3, END]
+    assert run(2)[0, 0].tolist()[:4] == [1, 2, 3, END]
+
+
+def test_v2_beam_search_two_memories_not_crossed():
+    """Two sibling memories (h accumulates token one-hots, c counts
+    steps) must each carry THEIR OWN state — cross-wiring them swaps
+    the roles and derails the forced sequence [1, 2, 2, END]."""
+    END, BOS, V = 0, 1, 4
+    gen = paddle.layer.GeneratedInput(size=V, embedding_name="tm_E",
+                                      embedding_size=V)
+
+    def step(prev):
+        c_prev = paddle.layer.memory(name="tm_c", size=V)
+        h_prev = paddle.layer.memory(name="tm_h", size=V)
+        h = paddle.layer.fc(input=[prev, h_prev], size=V,
+                            act=paddle.activation.Linear(),
+                            name="tm_h", bias_attr=False)
+        c = paddle.layer.fc(input=[prev, c_prev], size=V,
+                            act=paddle.activation.Linear(),
+                            name="tm_c")
+        return paddle.layer.mixed(
+            size=V,
+            input=[paddle.layer.full_matrix_projection(input=h),
+                   paddle.layer.full_matrix_projection(input=c)],
+            act=paddle.activation.Softmax(), bias_attr=True,
+            name="tm_p")
+
+    out = paddle.layer.beam_search(step=step, input=[gen], bos_id=BOS,
+                                   eos_id=END, beam_size=1,
+                                   max_length=6)
+    params = paddle.parameters.create(out)
+    eye = np.eye(V, dtype=np.float32)
+    zero = np.zeros((V, V), np.float32)
+    params.set("tm_E", eye)
+    params.set("_tm_h.w0", eye)      # h += one-hot(prev)
+    params.set("_tm_h.w1", eye)
+    params.set("_tm_c.w0", zero)     # c += 1 (bias), prev ignored
+    params.set("_tm_c.w1", eye)
+    params.set("_tm_c.wbias", np.ones(V, np.float32))
+    Mh = np.zeros((V, V), np.float32)
+    Mh[:, 1] = -99.0
+    Mh[1, 2] = 3.0                   # h[1] (BOS seen) favors token 2
+    Mc = np.zeros((V, V), np.float32)
+    Mc[:, 1] = -99.0
+    Mc[0, 0] = 10.0                  # s_END = 10 * step_count - 25
+    params.set("_tm_p.w0", Mh)
+    params.set("_tm_p.w1", Mc)
+    params.set("_tm_p.wbias",
+               np.asarray([-25, 0, 0, 0], np.float32))
+    ids = np.asarray(paddle.infer(output_layer=out, parameters=params,
+                                  input=[()]))
+    # t=1,2: s_END = -15,-5 < s_2 = 3; t=3: s_END = +5 -> END.
+    # crossed memories would make s_2 grow with t and s_END stay
+    # negative: the sequence would never terminate at step 3
+    assert ids[0, 0].tolist()[:4] == [1, 2, 2, END], ids[0, 0]
 
 
 def test_v2_sparse_binary_input_densified():
