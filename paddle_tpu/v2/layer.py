@@ -872,9 +872,16 @@ def beam_search(step, input, bos_id, eos_id, beam_size,
 def _attach_classification_error(ctx, metric_name, pred, lab, k=1):
     """error = 1 - top-k accuracy, registered as a topology metric
     (shared by classification_cost's implicit evaluator and
-    v2.evaluator.classification_error)."""
-    acc = ctx.fluid.layers.accuracy(input=pred, label=lab, k=k)
-    err = ctx.fluid.layers.scale(acc, scale=-1.0, bias=1.0)
+    v2.evaluator.classification_error).  Sequence outputs [N, T, C]
+    flatten to per-token rows first (padding counts as matched rows;
+    for ragged data this makes the metric an approximation, the cost
+    itself is properly masked)."""
+    L = ctx.fluid.layers
+    if len(pred.shape) > 2:
+        pred = L.reshape(pred, [-1, pred.shape[-1]])
+        lab = L.reshape(lab, [-1, 1])
+    acc = L.accuracy(input=pred, label=lab, k=k)
+    err = L.scale(acc, scale=-1.0, bias=1.0)
     ctx.add_metric(metric_name, err)
     return err
 

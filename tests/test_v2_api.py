@@ -948,6 +948,63 @@ def test_v2_beam_search_two_memories_not_crossed():
     assert ids[0, 0].tolist()[:4] == [1, 2, 2, END], ids[0, 0]
 
 
+def test_v2_train_then_generate_shared_parameters():
+    """The canonical v2 generation workflow: TRAIN a next-token RNN LM
+    with recurrent_group, then build a separate GENERATION topology
+    (beam_search) over the same layer/param names and decode with the
+    TRAINED Parameters — weights transfer by name through infer()."""
+    paddle.init(trainer_count=1)
+    V, BOS, END = 6, 1, 0
+    EMB, H = 8, 8
+
+    def rnn_cell(x):
+        h_prev = paddle.layer.memory(name="g_h", size=H)
+        h = paddle.layer.fc(input=[x, h_prev], size=H,
+                            act=paddle.activation.Tanh(), name="g_h")
+        return paddle.layer.fc(input=h, size=V,
+                               act=paddle.activation.Softmax(),
+                               name="g_p")
+
+    # ---- training topology: teacher-forced next-token prediction
+    words = paddle.layer.data(
+        name="g_w", type=paddle.data_type.integer_value_sequence(V))
+    nxt = paddle.layer.data(
+        name="g_n", type=paddle.data_type.integer_value_sequence(V))
+    emb = paddle.layer.embedding(input=words, size=EMB,
+                                 param_attr=paddle.attr.Param(
+                                     name="g_emb"))
+    probs = paddle.layer.recurrent_group(step=rnn_cell, input=emb)
+    cost = paddle.layer.classification_cost(input=probs, label=nxt)
+    params = paddle.parameters.create(cost)
+    tr = paddle.trainer.SGD(
+        cost=cost, parameters=params,
+        update_equation=paddle.optimizer.Adam(learning_rate=0.05))
+
+    seq = [BOS, 2, 3, 4]
+    labels = [2, 3, 4, END]
+
+    def reader():
+        for _ in range(20):
+            yield [(seq, labels)] * 8
+
+    costs = []
+    tr.train(reader=reader, num_passes=4, event_handler=lambda e:
+             costs.append(e.cost)
+             if isinstance(e, paddle.event.EndIteration) else None)
+    assert costs[-1] < 0.2, (costs[0], costs[-1])
+
+    # ---- generation topology: SAME layer names, trained weights flow
+    # in by name via paddle.infer(parameters=params)
+    gen_in = paddle.layer.GeneratedInput(size=V, embedding_name="g_emb",
+                                         embedding_size=EMB)
+    gen = paddle.layer.beam_search(step=rnn_cell, input=[gen_in],
+                                   bos_id=BOS, eos_id=END, beam_size=2,
+                                   max_length=6)
+    ids = np.asarray(paddle.infer(output_layer=gen, parameters=params,
+                                  input=[()]))
+    assert ids[0, 0].tolist()[:5] == [BOS, 2, 3, 4, END], ids[0, 0]
+
+
 def test_v2_sparse_binary_input_densified():
     paddle.init(trainer_count=1)
     t = paddle.data_type.sparse_binary_vector(10)
