@@ -1005,6 +1005,46 @@ def test_v2_train_then_generate_shared_parameters():
     assert ids[0, 0].tolist()[:5] == [BOS, 2, 3, 4, END], ids[0, 0]
 
 
+def test_v2_beam_search_multi_sample_static_input():
+    """N=2 samples decode in ONE beam_search program: each sample's
+    StaticInput steers ITS OWN beams (flat [N*B] layout, per-sample
+    gather) — sample 0 suppresses token 3 and must take the garden
+    path, sample 1 boosts it and must finish [1, 3, END]."""
+    END, BOS, V = 0, 1, 5
+    gen = paddle.layer.GeneratedInput(size=V, embedding_name="ms_T",
+                                      embedding_size=V)
+    bias = paddle.layer.data(name="ms_bias",
+                             type=paddle.data_type.dense_vector(V))
+
+    def step(prev, b):
+        return paddle.layer.mixed(
+            size=V,
+            input=[paddle.layer.identity_projection(input=prev),
+                   paddle.layer.identity_projection(input=b)],
+            act=paddle.activation.Softmax())
+
+    out = paddle.layer.beam_search(
+        step=step, input=[gen, paddle.layer.StaticInput(bias)],
+        bos_id=BOS, eos_id=END, beam_size=2, max_length=4)
+    params = paddle.parameters.create(out)
+    t = np.full((V, V), -30.0, np.float32)
+    t[1, 2] = np.log(.6)
+    t[1, 3] = np.log(.4)
+    t[2, 4] = np.log(.55)
+    t[2, END] = np.log(.45)
+    t[4, END] = t[3, END] = t[END, END] = 0.0
+    params.set("ms_T", t)
+    b0 = np.zeros(V, np.float32)
+    b0[3] = -5.0                     # sample 0: token 3 suppressed
+    b1 = np.zeros(V, np.float32)
+    b1[3] = +5.0                     # sample 1: token 3 boosted
+    ids = np.asarray(paddle.infer(output_layer=out, parameters=params,
+                                  input=[(b0,), (b1,)]))
+    assert ids.shape[0] == 2
+    assert ids[0, 0].tolist()[:4] == [1, 2, 4, END], ids[0, 0]
+    assert ids[1, 0].tolist()[:3] == [1, 3, END], ids[1, 0]
+
+
 def test_v2_sparse_binary_input_densified():
     paddle.init(trainer_count=1)
     t = paddle.data_type.sparse_binary_vector(10)
