@@ -271,3 +271,44 @@ def test_rnn_encoder_decoder_book_model(prog_scope, exe):
         l, = exe.run(main, feed=feeder.feed(batch), fetch_list=[loss])
         ls.append(float(np.ravel(l)[0]))
     assert ls[-1] < ls[0] - 1.0, (ls[0], ls[-1])
+
+
+def test_array_read_propagates_element_shape():
+    """fc on a value read from a TensorArray inside a While body must
+    size its parameter from the element shape — array_write/create_array
+    record it on the array var and array_read copies it (shape
+    inference cannot evaluate the runtime TensorArray)."""
+    import numpy as np
+
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.core.scope import Scope
+
+    L = fluid.layers
+    main, startup = fluid.Program(), fluid.Program()
+    scope = Scope()
+    with fluid.scope_guard(scope):
+        with fluid.program_guard(main, startup):
+            with fluid.unique_name.guard():
+                counter = L.fill_constant([1], "int64", 0)
+                limit = L.fill_constant([1], "int64", 3)
+                x0 = L.fill_constant([2, 6], "float32", 1.0)
+                arr = L.array_write(x0, i=counter, capacity=5)
+                cond = L.less_than(x=counter, y=limit)
+                w = L.While(cond=cond)
+                with w.block():
+                    cur = L.array_read(arr, i=counter)
+                    assert tuple(cur.shape) == (2, 6)
+                    h = L.fc(cur, size=3, bias_attr=False,
+                             param_attr=fluid.ParamAttr(name="aw"))
+                    L.increment(counter)
+                    L.array_write(h, i=counter, array=arr)
+                    L.less_than(x=counter, y=limit, cond=cond)
+        # parameter sized from the ELEMENT shape, not a scalar
+        assert tuple(main.global_block().var("aw").shape) == (6, 3)
+        # created-with-element_shape arrays propagate too
+        with fluid.program_guard(fluid.Program(), fluid.Program()):
+            with fluid.unique_name.guard():
+                a2 = L.create_array("float32", element_shape=[4, 8])
+                i0 = L.fill_constant([1], "int64", 0)
+                r = L.array_read(a2, i=i0)
+                assert tuple(r.shape) == (4, 8)
