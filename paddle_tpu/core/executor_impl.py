@@ -32,6 +32,18 @@ from .types import proto_to_np_dtype, VarKind
 
 from .flags import FLAGS
 
+
+def _matmul_precision_ctx():
+    """jax.default_matmul_precision(FLAGS.matmul_precision) when set —
+    must wrap jit CALLS (the config participates in jax's jit cache and
+    applies at (re)lowering time)."""
+    import contextlib
+
+    p = FLAGS.matmul_precision
+    if p:
+        return jax.default_matmul_precision(str(p))
+    return contextlib.nullcontext()
+
 class EOFException(Exception):
     """A program-level reader has no next batch (parity: the enforce
     the reference's read op raises at end-of-data — callers catch it
@@ -236,7 +248,8 @@ class ExecutorCore:
                bool(FLAGS.auto_layout),
                # read at trace time (_amp_cast_ins / conv2d lowering):
                # toggling either must not hit a stale executable
-               bool(FLAGS.bn_bf16), bool(FLAGS.conv_nhwc))
+               bool(FLAGS.bn_bf16), bool(FLAGS.conv_nhwc),
+               str(FLAGS.matmul_precision))
         entry = self._cache.get(key)
         if entry is None:
             entry = self._build(program, block_id, core_ops, scope, feed,
@@ -403,10 +416,11 @@ class ExecutorCore:
         jflat = jax.jit(fn_flat, **jit_kwargs)
 
         def jfn(inputs, seed, counter):
-            if pin is None:
-                return jflat(*inputs, seed, counter)
-            with jax.default_device(pin):
-                return jflat(*inputs, seed, counter)
+            with _matmul_precision_ctx():
+                if pin is None:
+                    return jflat(*inputs, seed, counter)
+                with jax.default_device(pin):
+                    return jflat(*inputs, seed, counter)
 
         return _CacheEntry(jfn, input_names, persist_outs, tuple(fetch_list),
                            input_shardings, jit_fn=jflat)
@@ -456,7 +470,7 @@ class ExecutorCore:
             # layout being donated was AUTO while output layout was
             # None"); host reads convert on transfer regardless
             kw["out_shardings"] = (fmt, fmt)  # (fetches, persists)
-            with jax.default_device(dev):
+            with _matmul_precision_ctx(), jax.default_device(dev):
                 compiled = jax.jit(fn_flat, **kw).lower(*specs).compile()
             in_fmts = compiled.input_formats[0]
             input_shardings = [

@@ -72,6 +72,13 @@ define_flag("bn_bf16", False,
             "stay f32 internally, like layer_norm) instead of casting "
             "its inputs to f32; halves BN-chain activation bytes on "
             "HBM-bound conv nets")
+define_flag("matmul_precision", "",
+            "XLA dot/conv precision for f32 operands: '' (backend "
+            "default: TPU multiplies f32 in bf16 passes, the fast "
+            "mode), 'float32'/'highest' (exact f32, ~3-6x slower "
+            "matmuls on TPU).  The TPU analog of the reference's "
+            "cuDNN math-mode control; see MIGRATION.md 'float32 "
+            "matmul precision on TPU'")
 define_flag("auto_layout", False,
             "single-device accelerator path: AOT-compile with XLA-chosen "
             "(AUTO) parameter layouts and keep persistable buffers in "

@@ -4,18 +4,37 @@ Parity: reference python/paddle/fluid/tests/unittests/op_test.py:113 — a test
 declares op_type, numpy inputs/attrs and expected outputs; the harness builds
 a one-op program, checks outputs, and checks the emitted grad ops against
 numeric finite differences of the forward program (get_numeric_gradient:40).
+
+Place sweep parity (reference op_test.py:261 check_output_with_place, :320
+check_output iterating CPUPlace + CUDAPlace): ``check_output`` always checks
+on CPUPlace; when the env var ``TPU_OPTEST=1`` is set it additionally runs
+the same program on ``fluid.TPUPlace()`` (the real chip on this rig) and
+holds it to the same tolerances.  ``tools/tpu_optest.py`` drives the full
+registry sweep on top of the same harness (CPU result as the oracle).
 """
 from __future__ import annotations
+
+import os
 
 import numpy as np
 
 import paddle_tpu.fluid as fluid
+from paddle_tpu.core.lod import LoDTensor
 from paddle_tpu.core.scope import Scope
+
+
+def places_to_check():
+    """CPUPlace always; TPUPlace too when the sweep is enabled via env."""
+    places = [fluid.CPUPlace()]
+    if os.environ.get("TPU_OPTEST") == "1":
+        places.append(fluid.TPUPlace())
+    return places
 
 
 class OpTest:
     """Subclass sets: op_type, inputs {slot: array or [(name, array), ...]},
-    attrs, outputs {slot: expected or [(name, expected), ...]}."""
+    attrs, outputs {slot: expected or [(name, expected), ...]}.
+    Inputs may be LoDTensor (fed with lod preserved, var gets lod_level)."""
 
     op_type = None
     inputs = {}
@@ -35,10 +54,17 @@ class OpTest:
                 entries = val if isinstance(val, list) else [(slot, val)]
                 names = []
                 for name, arr in entries:
-                    arr = np.asarray(arr)
-                    block.create_var(name=name, shape=arr.shape,
-                                     dtype=arr.dtype, stop_gradient=False)
-                    feed[name] = arr
+                    if isinstance(arr, LoDTensor):
+                        block.create_var(name=name, shape=arr.shape,
+                                         dtype=arr.dtype,
+                                         lod_level=arr.lod_level(),
+                                         stop_gradient=False)
+                        feed[name] = arr
+                    else:
+                        arr = np.asarray(arr)
+                        block.create_var(name=name, shape=arr.shape,
+                                         dtype=arr.dtype, stop_gradient=False)
+                        feed[name] = arr
                     names.append(name)
                 in_map[slot] = names
             out_map = {}
@@ -58,27 +84,41 @@ class OpTest:
                             infer_shape=False)
         return main, startup, feed
 
-    def check_output(self, atol=1e-5, rtol=1e-5):
+    def run_outputs(self, place, fetch_names=None):
+        """Run the one-op program on `place`; returns {name: np.ndarray}."""
         main, startup, feed = self._build()
-        exe = fluid.Executor(fluid.CPUPlace())
+        exe = fluid.Executor(place)
         scope = Scope()
         with fluid.scope_guard(scope):
-            fetch_names = list(self._expected.keys())
+            fetch_names = list(fetch_names or self._expected.keys())
             outs = exe.run(main, feed=feed, fetch_list=fetch_names)
-        for name, got in zip(self._expected.keys(), outs):
+        return {n: np.asarray(v) for n, v in zip(fetch_names, outs)}
+
+    def check_output_with_place(self, place, atol=1e-5, rtol=1e-5):
+        """Reference op_test.py:261 — check outputs on one specific place."""
+        got_map = self.run_outputs(place)
+        for name, got in got_map.items():
             want = self._expected[name]
             np.testing.assert_allclose(
                 np.asarray(got, dtype=np.float64),
                 np.asarray(want, dtype=np.float64),
                 atol=atol, rtol=rtol,
-                err_msg="op %s output %s mismatch" % (self.op_type, name))
+                err_msg="op %s output %s mismatch on %r" % (
+                    self.op_type, name, place))
+
+    def check_output(self, atol=1e-5, rtol=1e-5):
+        """Reference op_test.py:320 — sweep all available places."""
+        for place in places_to_check():
+            self.check_output_with_place(place, atol=atol, rtol=rtol)
 
     # --- gradient check ---
     def check_grad(self, inputs_to_check, output_names=None,
                    max_relative_error=0.005, delta=1e-3):
         """Analytic grads (append_backward over the one-op program) vs
         numeric finite differences of a scalar head: sum(out * W) with fixed
-        random W per output."""
+        random W per output.  With TPU_OPTEST=1, additionally holds the
+        TPU-place analytic grads to the CPU-place analytic grads (the CPU
+        grads being the finite-difference-validated oracle)."""
         output_names = output_names or [
             n for n in self._first_float_outputs()]
         main, startup, feed = self._build()
@@ -101,12 +141,16 @@ class OpTest:
             loss = fluid.layers.reduce_sum(head)
             grads = fluid.backward.calc_gradient(
                 loss, [block.var(n) for n in inputs_to_check])
-        exe = fluid.Executor(fluid.CPUPlace())
+        executors = {}   # one Executor per place: its jit cache is
+                         # per-instance, and the FD loop re-runs the
+                         # same program hundreds of times
 
-        def run_fetch(names, feed_over=None):
+        def run_fetch(names, feed_over=None, place=None):
             f = dict(feed)
             if feed_over:
                 f.update(feed_over)
+            place = place or fluid.CPUPlace()
+            exe = executors.setdefault(place, fluid.Executor(place))
             scope = Scope()
             with fluid.scope_guard(scope):
                 return exe.run(main, feed=f, fetch_list=names)
@@ -142,6 +186,19 @@ class OpTest:
                 "op %s grad wrt %s: max rel err %.5f (analytic %s vs "
                 "numeric %s)" % (self.op_type, iname, rel.max(),
                                  a.reshape(-1)[:5], num.reshape(-1)[:5]))
+
+        # Cross-place grad check: device analytic grads vs the CPU analytic
+        # grads just validated above (reference check_grad_with_place role).
+        for place in places_to_check()[1:]:
+            dev = run_fetch(grad_names, place=place)
+            for iname, a_grad, d_grad in zip(inputs_to_check, analytic, dev):
+                a = np.asarray(a_grad, dtype=np.float64)
+                d = np.asarray(d_grad, dtype=np.float64)
+                scale_ = max(np.abs(a).max(), 1e-3)
+                rel = np.abs(a - d) / scale_
+                assert rel.max() <= max_relative_error, (
+                    "op %s grad wrt %s: CPU vs %r max rel err %.5f" %
+                    (self.op_type, iname, place, rel.max()))
 
     def _first_float_outputs(self):
         names = []

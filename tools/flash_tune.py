@@ -1,0 +1,92 @@
+"""Flash-attention kernel tuner at the secondary-bench shape.
+
+Measures fwd+bwd wall time of the Pallas flash kernels on the real chip
+at the transformer-LM bench shape (B=16, H=16, T=2048, D=64, causal) for
+a grid of (block_q, block_k) and input dtypes, with the microbench traps
+handled (varying inputs chained on device via lax.scan, one final d2h
+drain — see .claude/skills/verify/SKILL.md).
+
+Usage: python tools/flash_tune.py [steps]
+"""
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+from paddle_tpu.kernels.flash_attention import flash_attention  # noqa: E402
+
+B, H, T, D = 16, 16, 2048, 64
+STEPS = int(sys.argv[1]) if len(sys.argv) > 1 else 12
+
+# causal fwd+bwd analytic useful FLOPs (fwd 4*BHT^2*D, bwd 2.5x, /2 causal)
+FLOPS = 0.5 * (4 + 10) * B * H * T * T * D
+
+
+def bench(dtype, block_q, block_k, force_xla=False):
+    rng = np.random.RandomState(0)
+    q = jnp.asarray(rng.randn(B, H, T, D), dtype)
+    k = jnp.asarray(rng.randn(B, H, T, D), dtype)
+    v = jnp.asarray(rng.randn(B, H, T, D), dtype)
+
+    def loss(q, k, v):
+        o = flash_attention(q, k, v, causal=True, block_q=block_q,
+                            block_k=block_k, force_xla=force_xla)
+        return (o.astype(jnp.float32) ** 2).sum()
+
+    grad = jax.grad(loss, argnums=(0, 1, 2))
+
+    def step(carry, _):
+        q, k, v = carry
+        dq, dk, dv = grad(q, k, v)
+        # vary the operands every iteration so nothing memoizes
+        return (q + 1e-3 * dq.astype(q.dtype),
+                k + 1e-3 * dk.astype(k.dtype),
+                v + 1e-3 * dv.astype(v.dtype)), dq[0, 0, 0, 0]
+
+    @jax.jit
+    def run(q, k, v):
+        (q, k, v), outs = jax.lax.scan(step, (q, k, v), None, length=STEPS)
+        return outs.sum() + q.sum()
+
+    r = run(q, k, v)
+    float(np.asarray(r))              # warm-up + compile, full drain
+    t0 = time.time()
+    r = run(q, k, v)
+    float(np.asarray(r))              # d2h drain is the only true sync
+    dt = (time.time() - t0) / STEPS
+    return dt
+
+
+def main():
+    print("shape B=%d H=%d T=%d D=%d causal, %d chained steps" %
+          (B, H, T, D, STEPS))
+    print("%-10s %6s %6s %9s %9s" % ("dtype", "bq", "bk", "ms/step",
+                                     "TFLOP/s"))
+    configs = []
+    for dt in ("bfloat16", "float32"):
+        for bq, bk in ((1024, 1024), (512, 1024), (512, 512), (256, 1024),
+                       (1024, 512), (2048, 1024), (256, 512), (128, 1024)):
+            configs.append((dt, bq, bk, False))
+    configs.append(("bfloat16", 0, 0, True))   # XLA reference path
+    for dt, bq, bk, force in configs:
+        try:
+            sec = bench(jnp.dtype(dt), bq, bk, force)
+            print("%-10s %6d %6d %9.2f %9.1f%s" %
+                  (dt, bq, bk, sec * 1e3, FLOPS / sec / 1e12,
+                   "  (XLA)" if force else ""))
+        except Exception as exc:  # noqa: BLE001 — tuning survey
+            print("%-10s %6d %6d  FAILED: %s" % (dt, bq, bk,
+                                                 str(exc)[:90]))
+
+
+if __name__ == "__main__":
+    main()
