@@ -25,6 +25,7 @@ __all__ = [
     "elementwise_pow", "expand", "squeeze", "unsqueeze", "gather", "scatter",
     "sigmoid_cross_entropy_with_logits", "hinge_loss", "huber_loss",
     "log_loss", "rank_loss", "margin_rank_loss", "maxout", "relu", "log",
+    "conv_shift", "modified_huber_loss", "roi_pool", "unpool",
     "crop", "slice_op", "shape_op", "hsigmoid", "cos_sim", "scale",
     "dot_product_attention", "warpctc", "bilinear_tensor_product",
     "sampling_id", "gaussian_random", "uniform_random",
@@ -1090,3 +1091,57 @@ def chunk_eval(input, label, chunk_scheme, num_chunk_types,
                "excluded_chunk_types": list(excluded_chunk_types or [])})
     return (precision, recall, f1_score, num_infer, num_label,
             num_correct)
+
+
+def conv_shift(x, y):
+    """Circular correlation (reference nn.py conv_shift /
+    conv_shift_op.cc): X [N, M], Y [N, K] with K odd."""
+    helper = LayerHelper("conv_shift", **locals())
+    out = helper.create_tmp_variable(dtype=x.dtype)
+    helper.append_op(type="conv_shift", inputs={"X": [x], "Y": [y]},
+                     outputs={"Out": [out]})
+    return out
+
+
+def modified_huber_loss(input, label):
+    """Modified Huber loss for binary classification (reference
+    modified_huber_loss_op.cc): label in {0, 1}."""
+    helper = LayerHelper("modified_huber_loss", **locals())
+    out = helper.create_tmp_variable(dtype=input.dtype)
+    inter = helper.create_tmp_variable(dtype=input.dtype)
+    helper.append_op(type="modified_huber_loss",
+                     inputs={"X": [input], "Y": [label]},
+                     outputs={"Out": [out], "IntermediateVal": [inter]})
+    return out
+
+
+def roi_pool(input, rois, pooled_height, pooled_width, spatial_scale=1.0):
+    """ROI max pooling (reference roi_pool_op.cc): rois [R, 5] rows
+    [batch_idx, x1, y1, x2, y2]."""
+    helper = LayerHelper("roi_pool", **locals())
+    out = helper.create_tmp_variable(dtype=input.dtype)
+    argmax = helper.create_tmp_variable(dtype="int64")
+    helper.append_op(type="roi_pool",
+                     inputs={"X": [input], "ROIs": [rois]},
+                     outputs={"Out": [out], "Argmax": [argmax]},
+                     attrs={"pooled_height": int(pooled_height),
+                            "pooled_width": int(pooled_width),
+                            "spatial_scale": float(spatial_scale)})
+    return out
+
+
+def unpool(input, indices, unpool_size, unpool_stride=None,
+           unpool_padding=0):
+    """Max unpooling (reference unpool_op.cc): scatter input back to
+    the argmax positions recorded by max_pool2d_with_index."""
+    helper = LayerHelper("unpool", **locals())
+    out = helper.create_tmp_variable(dtype=input.dtype)
+    ksize = _pair(unpool_size)
+    helper.append_op(
+        type="unpool", inputs={"X": [input], "Indices": [indices]},
+        outputs={"Out": [out]},
+        attrs={"ksize": ksize,
+               "strides": _pair(unpool_stride) if unpool_stride
+               else ksize,
+               "paddings": _pair(unpool_padding)})
+    return out

@@ -392,16 +392,22 @@ def full_matrix_projection(input, size=0, param_attr=None):
 
 
 def identity_projection(input, offset=None, size=None):
-    """Pass-through (reference identity_projection); offset slices a
-    feature window."""
-    if offset is not None:
-        raise NotImplementedError(
-            "identity_projection(offset=...) is not ported")
+    """Pass-through (reference identity_projection); offset slices the
+    feature window [offset, offset+size)."""
+    if offset is None:
+        def build(ctx, x, owner_name, j, width):
+            return x
+
+        return _Projection(input, build, size=input.size)
 
     def build(ctx, x, owner_name, j, width):
-        return x
+        end = offset + (size or width)
+        return ctx.fluid.layers.slice_op(x, axes=[1], starts=[offset],
+                                         ends=[end])
 
-    return _Projection(input, build, size=input.size)
+    return _Projection(input, build,
+                       size=size or (input.size - offset
+                                     if input.size else None))
 
 
 def mixed(size=0, name=None, input=None, act=None, bias_attr=False,
@@ -428,9 +434,16 @@ def mixed(size=0, name=None, input=None, act=None, bias_attr=False,
                 "projection width %r != mixed size %r" % (p.size, width))
     fluid_act = v2_act.to_fluid_act(act)
 
+    proj_ins = [list(getattr(p, "inputs", None) or [p.input])
+                for p in projs]
+
     def build(ctx, *xs):
-        parts = [p.builder(ctx, x, name, j, width)
-                 for j, (p, x) in enumerate(zip(projs, xs))]
+        parts = []
+        k = 0
+        for j, (p, pins) in enumerate(zip(projs, proj_ins)):
+            vals = xs[k:k + len(pins)]
+            k += len(pins)
+            parts.append(p.builder(ctx, *vals, name, j, width))
         out = parts[0] if len(parts) == 1 else \
             ctx.fluid.layers.sums(parts)
         if bias_attr is not False:
@@ -442,7 +455,8 @@ def mixed(size=0, name=None, input=None, act=None, bias_attr=False,
             out = getattr(ctx.fluid.layers, fluid_act)(out)
         return out
 
-    return Layer(name, build, inputs=[p.input for p in projs],
+    return Layer(name, build,
+                 inputs=[i for pins in proj_ins for i in pins],
                  size=width)
 
 
@@ -638,7 +652,12 @@ def recurrent_group(step, input, reverse=False, name=None, **kwargs):
 
 
 # ----------------------------------------------------- beam generation
-class GeneratedInput:
+class BaseGeneratedInput:
+    """Base marker (reference trainer_config_helpers
+    BaseGeneratedInput:4282)."""
+
+
+class GeneratedInput(BaseGeneratedInput):
     """The decoding-time input of a beam_search step: the previous
     step's SELECTED token, embedded through ``embedding_name``
     (reference trainer_config_helpers GeneratedInput)."""
@@ -970,9 +989,7 @@ def ctc(input, label, size=None, name=None, norm_by_times=False):
     return Layer(name, build, inputs=[input, label], size=1)
 
 
-_FLUID_POINTERS = {
-    "conv_projection": "fluid.layers.conv2d",
-}
+_FLUID_POINTERS = {}
 
 
 def __getattr__(name):
@@ -984,6 +1001,18 @@ def __getattr__(name):
         "paddle_tpu.v2.layer.%s is not in the ported v2 subset "
         "(see paddle_tpu/v2/layer.py __all__); use %s"
         % (name, hint or "the fluid.layers equivalent"))
+
+
+# ----------------------------------------------------- tail + aliases
+# (import at the bottom: layers_ext pulls helpers from this module)
+from .layers_ext import *  # noqa: E402,F401,F403
+from . import layers_ext as _ext  # noqa: E402
+
+grumemory = gru_memory        # reference name (ends with 'memory')
+LayerOutput = Layer           # reference LayerOutput == a built layer node
+
+__all__ = __all__ + list(_ext.__all__) + [
+    "grumemory", "LayerOutput", "BaseGeneratedInput"]
 
 
 # ------------------------------------------------------------- utility

@@ -1,0 +1,1493 @@
+"""v2 layer tail: the remaining trainer_config_helpers surface.
+
+Parity: reference python/paddle/trainer_config_helpers/layers.py
+``__all__`` (118 names), exposed under the v2 naming convention of
+reference python/paddle/v2/layer.py:56 ``__convert_name__`` (strip
+``_layer``, ``maxid_layer``->``max_id``, bare ``cross_entropy*`` gain
+``_cost``, ``*memory``/``*_seq``/``*_sim``/``hsigmoid``/``*_cost``
+keep their names).
+
+Every adapter here is a thin deferred-DAG builder over the fluid op
+set (the same architecture as v2/layer.py — NOT the reference's
+reflection over v1 config functions).  Names whose reference semantics
+have no fluid carrier are explicit refusals: importable callables that
+raise ``NotImplementedError`` naming the closest fluid path
+(documented in MIGRATION.md "v2 layer coverage").
+
+tests/test_v2_layer_parity.py walks the full reference name list and
+asserts each converted name either builds a topology or raises the
+documented pointer.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from paddle_tpu.fluid.layer_helper import LayerHelper
+
+from . import activation as v2_act
+from . import pooling as v2_pool
+from .config_base import Layer
+from .layer import (_Projection, _auto_name, _bias_attr, _img_hw, _inputs,
+                    _layer_param_attr, full_matrix_projection, memory,
+                    recurrent_group)
+
+__all__ = [
+    # projections / operators into mixed()
+    "dotmul_projection", "scaling_projection", "trans_full_matrix_projection",
+    "context_projection", "slice_projection", "conv_projection",
+    "dotmul_operator", "conv_operator",
+    # elementwise / shape / norm layers
+    "repeat", "seq_reshape", "scaling", "power", "interpolation",
+    "slope_intercept", "sum_to_one_norm", "row_l2_norm", "trans", "rotate",
+    "switch_order", "resize", "scale_shift", "clip", "l2_distance",
+    "dot_prod", "out_prod", "linear_comb", "convex_comb", "tensor",
+    "multiplex", "sampling_id", "factorization_machine", "gated_unit",
+    "selective_fc",
+    # image layers
+    "bilinear_interp", "img_cmrnorm", "pad", "crop", "maxout",
+    "block_expand", "spp", "upsample", "img_conv3d", "img_pool3d",
+    "conv_shift", "row_conv", "prelu",
+    # sequence layers
+    "seq_slice", "sub_seq",
+    # recurrent steps
+    "lstm_step", "gru_step", "gru_step_naive", "recurrent",
+    # detection
+    "priorbox", "cross_channel_norm", "multibox_loss", "detection_output",
+    "roi_pool",
+    # costs
+    "nce", "hsigmoid", "warp_ctc", "rank_cost", "sum_cost",
+    "huber_regression_cost", "huber_classification_cost",
+    "smooth_l1_cost", "multi_binary_label_cross_entropy_cost",
+    "cross_entropy_with_selfnorm_cost",
+    # utilities / markers
+    "printer", "print", "LayerType", "layer_support", "BeamInput",
+    "SubsequenceInput",
+    # documented refusals (raise with a pointer)
+    "get_output", "sub_nested_seq", "cross_entropy_over_beam", "eos",
+    "kmax_seq_score", "lambda_cost", "scale_sub_region",
+]
+
+
+def _act_apply(ctx, out, act):
+    fa = v2_act.to_fluid_act(act)
+    if fa:
+        out = getattr(ctx.fluid.layers, fa)(out)
+    return out
+
+
+def _as_image(ctx, layer, x, num_channels=None):
+    """Recover [N, C, H, W] from a flat dense-vector value (the v1
+    convention: data layers are flat; image geometry is re-derived)."""
+    if len(x.shape) >= 4:
+        return x, x.shape[1]
+    nc = num_channels or getattr(layer, "num_channels", None) or 1
+    h, w = _img_hw(layer, nc)
+    return ctx.fluid.layers.reshape(x, [-1, nc, h, w]), nc
+
+
+# ---------------------------------------------------------------------------
+# Projections / operators into mixed()
+# ---------------------------------------------------------------------------
+
+def dotmul_projection(input, param_attr=None):
+    """out = x .* w with a learned [1, d] weight row (reference
+    layers.py:668)."""
+    def build(ctx, x, owner_name, j, width):
+        w = ctx.fluid.layers.create_parameter(
+            shape=[width], dtype="float32",
+            attr=_layer_param_attr(owner_name, param_attr, "w%d" % j))
+        return ctx.fluid.layers.elementwise_mul(x, w, axis=-1)
+
+    return _Projection(input, build, size=input.size)
+
+
+def scaling_projection(input, param_attr=None):
+    """out = w * x with ONE learned scalar (reference layers.py:642)."""
+    def build(ctx, x, owner_name, j, width):
+        w = ctx.fluid.layers.create_parameter(
+            shape=[1], dtype="float32",
+            attr=_layer_param_attr(owner_name, param_attr, "w%d" % j))
+        return ctx.fluid.layers.elementwise_mul(
+            x, ctx.fluid.layers.reshape(w, [1, 1]))
+
+    return _Projection(input, build, size=input.size)
+
+
+def trans_full_matrix_projection(input, size=0, param_attr=None):
+    """x @ W^T with W stored [size, in] (reference layers.py:470)."""
+    def build(ctx, x, owner_name, j, width):
+        in_size = input.size
+        w = ctx.fluid.layers.create_parameter(
+            shape=[width, in_size], dtype="float32",
+            attr=_layer_param_attr(owner_name, param_attr, "w%d" % j))
+        return ctx.fluid.layers.matmul(x, w, transpose_y=True)
+
+    return _Projection(input, build, size=size or None)
+
+
+def slice_projection(input, slices):
+    """Concatenation of [start, end) feature slices (reference
+    layers.py:604)."""
+    for s, e in slices:
+        if not 0 <= s < e:
+            raise ValueError("invalid slice (%d, %d)" % (s, e))
+    width = sum(e - s for s, e in slices)
+
+    def build(ctx, x, owner_name, j, _width):
+        parts = [ctx.fluid.layers.slice_op(x, axes=[1], starts=[s],
+                                           ends=[e]) for s, e in slices]
+        return parts[0] if len(parts) == 1 else \
+            ctx.fluid.layers.concat(parts, axis=1)
+
+    return _Projection(input, build, size=width)
+
+
+def context_projection(input, context_len, context_start=None,
+                      padding_attr=False):
+    """Concat of the +-context window rows per timestep (reference
+    layers.py:738 -> ContextProjection).  Lowered through the
+    sequence_conv op with a CONSTANT identity filter — the op's
+    masked window machinery does the ragged-boundary handling; the
+    identity matmul folds away in XLA."""
+    if padding_attr is not False:
+        raise NotImplementedError(
+            "context_projection(padding_attr=...): trainable context "
+            "padding is not ported; zero padding (False) is")
+    d = input.size
+    width = context_len * d
+    start = (-(context_len // 2) if context_start is None
+             else context_start)
+
+    def build(ctx, x, owner_name, j, _width):
+        ident = ctx.fluid.layers.assign(
+            np.eye(width, dtype=np.float32))
+        ident.stop_gradient = True
+        helper = LayerHelper("context_projection")
+        out = helper.create_tmp_variable(dtype=x.dtype)
+        helper.append_op(
+            type="sequence_conv",
+            inputs={"X": [x], "Filter": [ident]},
+            outputs={"Out": [out]},
+            attrs={"contextLength": int(context_len),
+                   "contextStart": int(start)})
+        return out
+
+    return _Projection(input, build, size=width)
+
+
+def conv_projection(input, filter_size, num_filters, num_channels=None,
+                    stride=1, padding=0, filter_size_y=None, stride_y=None,
+                    padding_y=None, groups=1, param_attr=None, trans=False):
+    """Convolution as a mixed() contribution (reference layers.py:4838):
+    the conv output flattens to the mixed width and SUMS with the other
+    projections."""
+    def build(ctx, x, owner_name, j, width):
+        img, _nc = _as_image(ctx, input, x, num_channels)
+        conv_fn = ctx.fluid.layers.conv2d_transpose if trans \
+            else ctx.fluid.layers.conv2d
+        out = conv_fn(
+            img, num_filters=num_filters,
+            filter_size=[filter_size, filter_size_y or filter_size],
+            stride=[stride, stride_y or stride],
+            padding=[padding,
+                     padding_y if padding_y is not None else padding],
+            groups=groups, bias_attr=False,
+            param_attr=_layer_param_attr(owner_name, param_attr,
+                                         "w%d" % j))
+        return ctx.fluid.layers.reshape(out, [-1, width])
+
+    return _Projection(input, build, size=None)
+
+
+def dotmul_operator(a=None, b=None, scale=1, **kwargs):
+    """out = scale * (a .* b) (reference layers.py:697) — an operator:
+    two layer inputs, no parameters."""
+    x = kwargs.get("x", a)
+    y = kwargs.get("y", b)
+    if x is None or y is None:
+        raise ValueError("dotmul_operator needs a= and b=")
+
+    def build(ctx, xa, xb, owner_name, j, width):
+        out = ctx.fluid.layers.elementwise_mul(xa, xb)
+        if scale != 1:
+            out = ctx.fluid.layers.scale(out, scale=float(scale))
+        return out
+
+    p = _Projection(x, build, size=x.size)
+    p.inputs = [x, y]
+    return p
+
+
+def conv_operator(img, filter, filter_size, num_filters, num_channels=None,
+                  stride=1, padding=0, filter_size_y=None, stride_y=None,
+                  padding_y=None, trans=False):
+    """Convolve ``img`` with filter VALUES produced by the ``filter``
+    layer (reference layers.py:4749) — no own parameters; the conv2d
+    op's Filter input slot carries the dynamic filter."""
+    if trans:
+        raise NotImplementedError(
+            "conv_operator(trans=True) is not ported; use "
+            "conv_projection(trans=True)")
+    fy = filter_size_y or filter_size
+    sy = stride_y or stride
+    py = padding_y if padding_y is not None else padding
+
+    def build(ctx, ximg, xfil, owner_name, j, width):
+        img4, nc = _as_image(ctx, img, ximg, num_channels)
+        fil = ctx.fluid.layers.reshape(
+            xfil, [num_filters, nc, filter_size, fy])
+        helper = LayerHelper("conv_operator")
+        out = helper.create_tmp_variable(dtype=img4.dtype)
+        helper.append_op(
+            type="conv2d", inputs={"Input": [img4], "Filter": [fil]},
+            outputs={"Output": [out]},
+            attrs={"strides": [stride, sy], "paddings": [padding, py],
+                   "dilations": [1, 1], "groups": 1})
+        return ctx.fluid.layers.reshape(out, [-1, width])
+
+    p = _Projection(img, build, size=None)
+    p.inputs = [img, filter]
+    return p
+
+
+# ---------------------------------------------------------------------------
+# Elementwise / shape / norm layers
+# ---------------------------------------------------------------------------
+
+def repeat(input, num_repeats, as_row_vector=True, act=None, name=None,
+           layer_attr=None):
+    """Tile features ``num_repeats`` times (reference repeat_layer:1916):
+    as_row_vector=True -> [a b, a b]; False -> [a a, b b]."""
+    name = _auto_name("repeat", name)
+    ins = _inputs(input)
+
+    def build(ctx, x):
+        L = ctx.fluid.layers
+        if as_row_vector:
+            out = L.expand(x, expand_times=[1, num_repeats])
+        else:
+            out = L.reshape(
+                L.expand(L.unsqueeze(x, axes=[2]),
+                         expand_times=[1, 1, num_repeats]),
+                [-1, int(x.shape[1]) * num_repeats])
+        return _act_apply(ctx, out, act)
+
+    size = ins[0].size * num_repeats if ins[0].size else None
+    return Layer(name, build, inputs=ins, size=size)
+
+
+def seq_reshape(input, reshape_size, act=None, name=None, layer_attr=None,
+                bias_attr=None):
+    """Re-chop token width across each sequence (reference
+    seq_reshape_layer:1982 -> sequence_reshape op)."""
+    if bias_attr not in (None, False):
+        raise NotImplementedError("seq_reshape bias is not ported")
+    name = _auto_name("seqreshape", name)
+    ins = _inputs(input)
+
+    def build(ctx, x):
+        return _act_apply(
+            ctx, ctx.fluid.layers.sequence_reshape(x, reshape_size), act)
+
+    return Layer(name, build, inputs=ins, size=reshape_size)
+
+
+def scaling(input, weight, name=None, layer_attr=None):
+    """Row-scale: out_i = w_i * x_i, weight [N, 1] (reference
+    scaling_layer:2187)."""
+    name = _auto_name("scaling", name)
+
+    def build(ctx, x, w):
+        return ctx.fluid.layers.elementwise_mul(x, w, axis=0)
+
+    return Layer(name, build, inputs=[input, weight], size=input.size)
+
+
+def power(input, weight, name=None, layer_attr=None):
+    """out_i = x_i ^ w_i, weight [N, 1] (reference power_layer:2144)."""
+    name = _auto_name("power", name)
+
+    def build(ctx, x, w):
+        return ctx.fluid.layers.elementwise_pow(x, w, axis=0)
+
+    return Layer(name, build, inputs=[input, weight], size=input.size)
+
+
+def interpolation(input, weight, name=None, layer_attr=None):
+    """w*a + (1-w)*b over input=[a, b], weight [N,1] (reference
+    interpolation_layer:2036)."""
+    ins = _inputs(input)
+    if len(ins) != 2:
+        raise ValueError("interpolation needs input=[a, b]")
+    name = _auto_name("interpolation", name)
+
+    def build(ctx, xa, xb, w):
+        L = ctx.fluid.layers
+        one_minus = L.scale(w, scale=-1.0, bias=1.0)
+        return L.elementwise_add(L.elementwise_mul(xa, w, axis=0),
+                                 L.elementwise_mul(xb, one_minus, axis=0))
+
+    return Layer(name, build, inputs=[ins[0], ins[1], weight],
+                 size=ins[0].size)
+
+
+def slope_intercept(input, name=None, slope=1.0, intercept=0.0,
+                    layer_attr=None):
+    """out = slope * x + intercept (reference slope_intercept_layer:5323)."""
+    name = _auto_name("slope_intercept", name)
+    ins = _inputs(input)
+
+    def build(ctx, x):
+        return ctx.fluid.layers.scale(x, scale=float(slope),
+                                      bias=float(intercept))
+
+    return Layer(name, build, inputs=ins, size=ins[0].size)
+
+
+def sum_to_one_norm(input, name=None, layer_attr=None):
+    """Row-normalize to sum 1 (reference sum_to_one_norm_layer:3374)."""
+    name = _auto_name("sum_to_one_norm", name)
+    ins = _inputs(input)
+
+    def build(ctx, x):
+        L = ctx.fluid.layers
+        s = L.reduce_sum(x, dim=1, keep_dim=True)
+        return L.elementwise_div(x, s, axis=0)
+
+    return Layer(name, build, inputs=ins, size=ins[0].size)
+
+
+def row_l2_norm(input, name=None, layer_attr=None):
+    """Row-normalize to unit L2 (reference row_l2_norm_layer:3412)."""
+    name = _auto_name("row_l2_norm", name)
+    ins = _inputs(input)
+
+    def build(ctx, x):
+        return ctx.fluid.layers.l2_normalize(x, axis=1)
+
+    return Layer(name, build, inputs=ins, size=ins[0].size)
+
+
+def trans(input, name=None, layer_attr=None):
+    """Transpose the whole minibatch matrix [N,d]->[d,N] (reference
+    trans_layer:2232)."""
+    name = _auto_name("trans", name)
+    ins = _inputs(input)
+
+    def build(ctx, x):
+        return ctx.fluid.layers.transpose(x, perm=[1, 0])
+
+    return Layer(name, build, inputs=ins)
+
+
+def rotate(input, height, width, name=None, layer_attr=None):
+    """Rotate each [C,H,W] sample 90 degrees counter-clockwise
+    (reference rotate_layer:2268): out[c, W-1-w, h] = in[c, h, w]."""
+    name = _auto_name("rotate", name)
+    ins = _inputs(input)
+    src = ins[0]
+
+    def build(ctx, x):
+        L = ctx.fluid.layers
+        nc = (src.size // (height * width)) if src.size else 1
+        img = L.reshape(x, [-1, nc, height, width])
+        out = L.reverse(L.transpose(img, perm=[0, 1, 3, 2]), axis=[2])
+        return L.reshape(out, [-1, nc * height * width])
+
+    return Layer(name, build, inputs=ins, size=src.size)
+
+
+def switch_order(input, name=None, reshape_axis=None, act=None,
+                 layer_attr=None):
+    """NCHW -> NHWC re-order (reference switch_order_layer:6945)."""
+    name = _auto_name("switch_order", name)
+    ins = _inputs(input)
+
+    def build(ctx, x):
+        if len(x.shape) != 4:
+            raise ValueError("switch_order expects a 4-D [N,C,H,W] value")
+        return _act_apply(
+            ctx, ctx.fluid.layers.transpose(x, perm=[0, 2, 3, 1]), act)
+
+    return Layer(name, build, inputs=ins, size=ins[0].size)
+
+
+def resize(input, size, name=None):
+    """Re-chop the batch to rows of ``size`` values (reference
+    resize_layer:7419)."""
+    name = _auto_name("resize", name)
+    ins = _inputs(input)
+
+    def build(ctx, x):
+        return ctx.fluid.layers.reshape(x, [-1, size])
+
+    return Layer(name, build, inputs=ins, size=size)
+
+
+def scale_shift(input, name=None, param_attr=None, bias_attr=None):
+    """out = w * x + b with learned SCALAR w, b (reference
+    scale_shift_layer:7378)."""
+    name = _auto_name("scale_shift", name)
+    ins = _inputs(input)
+
+    def build(ctx, x):
+        L = ctx.fluid.layers
+        w = L.create_parameter(
+            shape=[1], dtype="float32",
+            attr=_layer_param_attr(name, param_attr, "w0"))
+        out = L.elementwise_mul(x, L.reshape(w, [1, 1]))
+        ba = _bias_attr(name, bias_attr)
+        if ba is not False:
+            b = L.create_parameter(shape=[1], dtype="float32",
+                                   attr=ba, is_bias=True)
+            out = L.elementwise_add(out, L.reshape(b, [1, 1]))
+        return out
+
+    return Layer(name, build, inputs=ins, size=ins[0].size)
+
+
+def clip(input, min, max, name=None):
+    """Clamp to [min, max] (reference clip_layer:7091)."""
+    name = _auto_name("clip", name)
+    ins = _inputs(input)
+
+    def build(ctx, x):
+        return ctx.fluid.layers.clip(x, min=float(min), max=float(max))
+
+    return Layer(name, build, inputs=ins, size=ins[0].size)
+
+
+def l2_distance(x, y, name=None, layer_attr=None):
+    """Row-wise euclidean distance [N,1] (reference
+    l2_distance_layer:2376)."""
+    name = _auto_name("l2_distance", name)
+
+    def build(ctx, xa, xb):
+        L = ctx.fluid.layers
+        d = L.elementwise_sub(xa, xb)
+        return L.sqrt(L.reduce_sum(L.square(d), dim=1, keep_dim=True))
+
+    return Layer(name, build, inputs=[x, y], size=1)
+
+
+def dot_prod(input1, input2, name=None, layer_attr=None):
+    """Row-wise dot product [N,1] (reference dot_prod_layer:4367)."""
+    name = _auto_name("dot_prod", name)
+
+    def build(ctx, xa, xb):
+        L = ctx.fluid.layers
+        return L.reduce_sum(L.elementwise_mul(xa, xb), dim=1,
+                            keep_dim=True)
+
+    return Layer(name, build, inputs=[input1, input2], size=1)
+
+
+def out_prod(input1, input2, name=None, layer_attr=None):
+    """Row-wise outer product flattened to [N, d1*d2] (reference
+    out_prod_layer:4406)."""
+    name = _auto_name("out_prod", name)
+    sz = (input1.size * input2.size
+          if input1.size and input2.size else None)
+
+    def build(ctx, xa, xb):
+        L = ctx.fluid.layers
+        out = L.matmul(L.unsqueeze(xa, axes=[2]),
+                       L.unsqueeze(xb, axes=[1]))
+        return L.reshape(out, [-1, int(xa.shape[1]) * int(xb.shape[1])])
+
+    return Layer(name, build, inputs=[input1, input2], size=sz)
+
+
+def linear_comb(weights, vectors, size=None, name=None, layer_attr=None):
+    """z = w^T reshape(vectors, [s, size]) per row (reference
+    linear_comb_layer:5367): weights [N,s], vectors [N,s*size]."""
+    if size is None:
+        if weights.size and vectors.size:
+            size = vectors.size // weights.size
+        else:
+            raise ValueError("linear_comb needs size=")
+    name = _auto_name("linear_comb", name)
+
+    def build(ctx, w, v):
+        L = ctx.fluid.layers
+        s = int(w.shape[1])
+        out = L.matmul(L.unsqueeze(w, axes=[1]),
+                       L.reshape(v, [-1, s, size]))
+        return L.reshape(out, [-1, size])
+
+    return Layer(name, build, inputs=[weights, vectors], size=size)
+
+
+def convex_comb(weights, vectors, size=None, name=None, layer_attr=None):
+    """Alias of linear_comb (reference keeps both names)."""
+    return linear_comb(weights, vectors, size=size, name=name)
+
+
+def tensor(a, b, size, act=None, name=None, param_attr=None,
+           bias_attr=None, layer_attr=None):
+    """Bilinear tensor product a^T W_k b (reference tensor_layer:5118 ->
+    bilinear_tensor_product op)."""
+    name = _auto_name("tensor", name)
+
+    def build(ctx, xa, xb):
+        return _act_apply(ctx, ctx.fluid.layers.bilinear_tensor_product(
+            xa, xb, size=size,
+            param_attr=_layer_param_attr(name, param_attr, "w0"),
+            bias_attr=_bias_attr(name, bias_attr)), act)
+
+    return Layer(name, build, inputs=[a, b], size=size)
+
+
+def multiplex(input, name=None, layer_attr=None):
+    """Per-row select among candidate layers by an index layer
+    (reference multiplex_layer:6606): input[0] is the int index, the
+    rest are candidates."""
+    ins = _inputs(input)
+    if len(ins) < 3:
+        raise ValueError("multiplex needs [index, cand1, cand2, ...]")
+    name = _auto_name("multiplex", name)
+
+    def build(ctx, idx, *cands):
+        L = ctx.fluid.layers
+        return L.multiplex(list(cands), L.cast(idx, "int64"))
+
+    return Layer(name, build, inputs=ins, size=ins[1].size)
+
+
+def sampling_id(input, name=None, layer_attr=None):
+    """Sample one id per row from a probability row (reference
+    sampling_id_layer:5291)."""
+    name = _auto_name("sampling_id", name)
+    ins = _inputs(input)
+
+    def build(ctx, x):
+        return ctx.fluid.layers.sampling_id(x)
+
+    return Layer(name, build, inputs=ins, size=1)
+
+
+def factorization_machine(input, factor_size, act=None, name=None,
+                          param_attr=None, layer_attr=None):
+    """Second-order FM interactions (reference
+    factorization_machine:7547): 0.5 * sum_f[(xV)_f^2 - (x^2 V^2)_f]."""
+    name = _auto_name("factorization_machine", name)
+    ins = _inputs(input)
+    d = ins[0].size
+
+    def build(ctx, x):
+        L = ctx.fluid.layers
+        v = L.create_parameter(
+            shape=[d, factor_size], dtype="float32",
+            attr=_layer_param_attr(name, param_attr, "w0"))
+        xv = L.matmul(x, v)                       # [N, F]
+        x2v2 = L.matmul(L.square(x), L.square(v))
+        out = L.scale(L.reduce_sum(
+            L.elementwise_sub(L.square(xv), x2v2), dim=1,
+            keep_dim=True), scale=0.5)
+        return _act_apply(ctx, out, act)
+
+    return Layer(name, build, inputs=ins, size=1)
+
+
+def gated_unit(input, size, act=None, name=None, gate_attr=None,
+               gate_param_attr=None, gate_bias_attr=True,
+               inproj_attr=None, inproj_param_attr=None,
+               inproj_bias_attr=True, layer_attr=None):
+    """act(fc(x)) .* sigmoid(fc(x)) (reference gated_unit_layer:6852)."""
+    name = _auto_name("gated_unit", name)
+    ins = _inputs(input)
+
+    def build(ctx, x):
+        L = ctx.fluid.layers
+        proj = L.fc(x, size=size,
+                    act=v2_act.to_fluid_act(act),
+                    param_attr=_layer_param_attr(
+                        name, inproj_param_attr, "w0"),
+                    bias_attr=_bias_attr(
+                        name, None if inproj_bias_attr is True
+                        else inproj_bias_attr))
+        gate = L.fc(x, size=size, act="sigmoid",
+                    param_attr=_layer_param_attr(
+                        name, gate_param_attr, "w1"),
+                    bias_attr=_bias_attr(
+                        name, None if gate_bias_attr is True
+                        else gate_bias_attr))
+        return L.elementwise_mul(proj, gate)
+
+    return Layer(name, build, inputs=ins, size=size)
+
+
+def selective_fc(input, size, select=None, act=None, name=None,
+                 pass_generation=False, has_selected_colums=True,
+                 mul_ratio=0.02, param_attr=None, bias_attr=None,
+                 layer_attr=None):
+    """fc whose selected-column optimization is a gserver execution
+    detail (reference selective_fc_layer:5188): without ``select`` the
+    math is exactly fc, which XLA fuses; a selection input has no
+    carrier here."""
+    if select is not None:
+        raise NotImplementedError(
+            "selective_fc(select=...): column selection is a gserver "
+            "execution optimization; compute the full fc (select=None) "
+            "and mask, or use fluid.layers.fc + gather")
+    from .layer import fc as _fc
+    return _fc(input, size, act=act, name=name, param_attr=param_attr,
+               bias_attr=bias_attr)
+
+
+# ---------------------------------------------------------------------------
+# Image layers
+# ---------------------------------------------------------------------------
+
+def bilinear_interp(input, out_size_x=None, out_size_y=None, name=None,
+                    layer_attr=None):
+    """Bilinear resize (reference bilinear_interp_layer:2089)."""
+    if not out_size_x or not out_size_y:
+        raise ValueError("bilinear_interp needs out_size_x/out_size_y")
+    name = _auto_name("bilinear_interp", name)
+    ins = _inputs(input)
+    src = ins[0]
+    nc = getattr(src, "num_channels", None)
+
+    def build(ctx, x):
+        img, c = _as_image(ctx, src, x, nc)
+        return ctx.fluid.layers.resize_bilinear(
+            img, out_shape=[out_size_y, out_size_x])
+
+    out = Layer(name, build, inputs=ins)
+    out.num_channels = nc
+    return out
+
+
+def img_cmrnorm(input, size, scale=0.0128, power=0.75, num_channels=None,
+                name=None, layer_attr=None):
+    """Cross-channel local response normalization (reference
+    img_cmrnorm_layer:3199 -> the lrn op; v1 ``scale`` is the TOTAL
+    alpha over the window, lrn's ``alpha`` is per-element)."""
+    name = _auto_name("cmrnorm", name)
+    ins = _inputs(input)
+    src = ins[0]
+
+    def build(ctx, x):
+        img, c = _as_image(ctx, src, x, num_channels)
+        return ctx.fluid.layers.lrn(img, n=size, k=1.0,
+                                    alpha=float(scale) / size,
+                                    beta=float(power))
+
+    out = Layer(name, build, inputs=ins, size=src.size)
+    out.num_channels = num_channels or getattr(src, "num_channels", None)
+    return out
+
+
+def pad(input, pad_c=None, pad_h=None, pad_w=None, name=None,
+        layer_attr=None):
+    """Zero-pad C/H/W of image samples (reference pad_layer:4961)."""
+    name = _auto_name("pad", name)
+    ins = _inputs(input)
+    src = ins[0]
+    pc = pad_c or [0, 0]
+    ph = pad_h or [0, 0]
+    pw = pad_w or [0, 0]
+
+    def build(ctx, x):
+        img, c = _as_image(ctx, src, x)
+        return ctx.fluid.layers.pad(
+            img, paddings=[0, 0, pc[0], pc[1], ph[0], ph[1],
+                           pw[0], pw[1]])
+
+    out = Layer(name, build, inputs=ins)
+    nc = getattr(src, "num_channels", None)
+    out.num_channels = (nc + pc[0] + pc[1]) if nc else None
+    return out
+
+
+def crop(input, offset, axis=2, shape=None, name=None, layer_attr=None):
+    """Crop along the axes from ``axis`` on (reference crop_layer:6994).
+    ``input`` may be [x] or [x, reference_layer]; the cropped sizes come
+    from ``shape`` or from the reference layer's trailing dims.  Lowered
+    through the slice op so the batch dim is never touched."""
+    ins = _inputs(input)
+    if shape is None and len(ins) < 2:
+        raise ValueError("crop needs shape= or a reference layer")
+    name = _auto_name("crop", name)
+    src = ins[0]
+
+    def build(ctx, x, *rest):
+        L = ctx.fluid.layers
+        img, c = _as_image(ctx, src, x)
+        if shape is not None:
+            tgt = [int(s) for s in shape]
+        else:
+            tgt = [int(s) for s in rest[0].shape[axis:]]
+        offs = [int(o) for o in offset]
+        offs += [0] * (len(tgt) - len(offs))
+        return L.slice_op(img,
+                          axes=[axis + i for i in range(len(tgt))],
+                          starts=offs[:len(tgt)],
+                          ends=[offs[i] + tgt[i] for i in range(len(tgt))])
+
+    return Layer(name, build, inputs=ins)
+
+
+def maxout(input, groups, num_channels=None, name=None, layer_attr=None):
+    """Channel-group max (reference maxout_layer:5525)."""
+    name = _auto_name("maxout", name)
+    ins = _inputs(input)
+    src = ins[0]
+
+    def build(ctx, x):
+        img, c = _as_image(ctx, src, x, num_channels)
+        return ctx.fluid.layers.maxout(img, groups=groups)
+
+    out = Layer(name, build, inputs=ins)
+    nc = num_channels or getattr(src, "num_channels", None)
+    out.num_channels = nc // groups if nc else None
+    return out
+
+
+def block_expand(input, block_x=0, block_y=0, stride_x=0, stride_y=0,
+                 padding_x=0, padding_y=0, num_channels=None, name=None,
+                 layer_attr=None):
+    """Image patches to a token sequence (reference
+    block_expand_layer:5437 -> im2sequence op)."""
+    name = _auto_name("blockexpand", name)
+    ins = _inputs(input)
+    src = ins[0]
+
+    def build(ctx, x):
+        img, c = _as_image(ctx, src, x, num_channels)
+        return ctx.fluid.layers.im2sequence(
+            img, filter_size=[block_y, block_x],
+            stride=[stride_y or block_y, stride_x or block_x],
+            padding=[padding_y, padding_x])
+
+    nc = num_channels or getattr(src, "num_channels", 1)
+    return Layer(name, build, inputs=ins, size=nc * block_x * block_y)
+
+
+def spp(input, pyramid_height=None, num_channels=None, pool_type=None,
+        name=None, layer_attr=None):
+    """Spatial pyramid pooling (reference spp_layer:3098)."""
+    name = _auto_name("spp", name)
+    ins = _inputs(input)
+    src = ins[0]
+    ptype = v2_pool.to_fluid_pool(pool_type, default="max")
+
+    def build(ctx, x):
+        img, c = _as_image(ctx, src, x, num_channels)
+        return ctx.fluid.layers.spp(img, pyramid_height=pyramid_height,
+                                    pool_type=ptype)
+
+    return Layer(name, build, inputs=ins)
+
+
+def upsample(input, name=None, scale=None, scale_y=None,
+             upsample_size=None, upsample_size_y=None, pad_out_x=False,
+             pad_out_y=False, layer_attr=None):
+    """Max-unpooling upsample (reference upsample_layer:3021):
+    input=[x, mask] where mask is the argmax map recorded by the paired
+    max pool (fluid.layers.unpool)."""
+    ins = _inputs(input)
+    if len(ins) != 2:
+        raise NotImplementedError(
+            "upsample needs input=[x, mask] (the mask from the paired "
+            "max pool); mask-free interpolation is bilinear_interp")
+    if not scale:
+        raise ValueError("upsample needs scale=")
+    if upsample_size is not None or upsample_size_y is not None \
+            or pad_out_x or pad_out_y:
+        raise NotImplementedError(
+            "upsample(upsample_size=/pad_out_*=): explicit output "
+            "sizing is not ported; the output is scale * input "
+            "(fluid.layers.unpool)")
+    name = _auto_name("upsample", name)
+
+    def build(ctx, x, mask):
+        return ctx.fluid.layers.unpool(
+            x, ctx.fluid.layers.cast(mask, "int64"),
+            unpool_size=[scale, scale_y or scale])
+
+    return Layer(name, build, inputs=ins)
+
+
+def img_conv3d(input, filter_size, num_filters, name=None,
+               num_channels=None, act=None, groups=1, stride=1, padding=0,
+               bias_attr=None, param_attr=None, shared_biases=True,
+               layer_attr=None, trans=False, layer_type=None):
+    """3-D convolution (reference img_conv3d_layer:7232 -> conv3d op).
+    The input must already be 5-D [N,C,D,H,W] (produced by another 3-D
+    layer); flat dense-vector inputs have no D/H/W record here."""
+    if trans:
+        raise NotImplementedError("img_conv3d(trans=True) is not ported")
+    name = _auto_name("conv3d", name)
+    ins = _inputs(input)
+
+    def _triple(v):
+        return list(v) if isinstance(v, (list, tuple)) else [v, v, v]
+
+    def build(ctx, x):
+        if len(x.shape) != 5:
+            raise ValueError(
+                "img_conv3d expects a 5-D [N,C,D,H,W] input value; "
+                "reshape upstream (fluid.layers.reshape)")
+        return ctx.fluid.layers.conv3d(
+            x, num_filters=num_filters, filter_size=_triple(filter_size),
+            stride=_triple(stride), padding=_triple(padding),
+            groups=groups, act=v2_act.to_fluid_act(act),
+            param_attr=_layer_param_attr(name, param_attr, "w0"),
+            bias_attr=_bias_attr(name, bias_attr))
+
+    out = Layer(name, build, inputs=ins)
+    out.num_channels = num_filters
+    return out
+
+
+def img_pool3d(input, pool_size, name=None, num_channels=None,
+               pool_type=None, stride=1, padding=0, layer_attr=None,
+               pool_size_y=None, stride_y=None, padding_y=None,
+               pool_size_z=None, stride_z=None, padding_z=None,
+               ceil_mode=True):
+    """3-D pooling (reference img_pool3d_layer:2869), lowered as two
+    separable pool2d passes: reduce (H,W) per depth slice, then reduce
+    D — exact for max; for avg the edge windows of the two passes
+    compose approximately when padding splits a window."""
+    name = _auto_name("pool3d", name)
+    ins = _inputs(input)
+    ptype = v2_pool.to_fluid_pool(pool_type, default="max")
+    ky = pool_size_y or pool_size
+    kz = pool_size_z or pool_size
+    sy = stride_y or stride
+    sz = stride_z or stride
+    py = padding_y if padding_y is not None else padding
+    pz = padding_z if padding_z is not None else padding
+
+    def build(ctx, x):
+        L = ctx.fluid.layers
+        if len(x.shape) != 5:
+            raise ValueError("img_pool3d expects a 5-D [N,C,D,H,W] value")
+        n, c, d, h, w = [int(s) for s in x.shape]
+        hw = L.pool2d(L.reshape(x, [-1, c * d, h, w]),
+                      pool_size=[ky, pool_size], pool_type=ptype,
+                      pool_stride=[sy, stride],
+                      pool_padding=[py, padding], ceil_mode=ceil_mode)
+        h2, w2 = int(hw.shape[2]), int(hw.shape[3])
+        dd = L.pool2d(L.reshape(hw, [-1, c, d, h2 * w2]),
+                      pool_size=[kz, 1], pool_type=ptype,
+                      pool_stride=[sz, 1], pool_padding=[pz, 0],
+                      ceil_mode=ceil_mode)
+        d2 = int(dd.shape[2])
+        return L.reshape(dd, [-1, c, d2, h2, w2])
+
+    out = Layer(name, build, inputs=ins)
+    out.num_channels = num_channels or getattr(ins[0], "num_channels",
+                                               None)
+    return out
+
+
+def conv_shift(a, b, name=None, layer_attr=None):
+    """Circular correlation (reference conv_shift_layer:5066)."""
+    name = _auto_name("conv_shift", name)
+
+    def build(ctx, xa, xb):
+        return ctx.fluid.layers.conv_shift(xa, xb)
+
+    return Layer(name, build, inputs=[a, b], size=a.size)
+
+
+def row_conv(input, context_len, act=None, name=None, param_attr=None,
+             layer_attr=None):
+    """Lookahead row convolution (reference row_conv_layer:6690)."""
+    name = _auto_name("row_conv", name)
+    ins = _inputs(input)
+    d = ins[0].size
+
+    def build(ctx, x):
+        # v1 context_len counts the current step; fluid row_conv takes
+        # the FUTURE context size (filter rows = future + 1)
+        return _act_apply(ctx, ctx.fluid.layers.row_conv(
+            x, context_len - 1,
+            param_attr=_layer_param_attr(name, param_attr, "w0")), act)
+
+    return Layer(name, build, inputs=ins, size=d)
+
+
+def prelu(input, name=None, partial_sum=1, channel_shared=None,
+          num_channels=None, param_attr=None, layer_attr=None):
+    """Parametric ReLU (reference prelu_layer:6762)."""
+    name = _auto_name("prelu", name)
+    ins = _inputs(input)
+
+    def build(ctx, x):
+        return ctx.fluid.layers.prelu(
+            x, mode="all" if (channel_shared or partial_sum != 1)
+            else "channel" if len(x.shape) > 2 else "all",
+            param_attr=_layer_param_attr(name, param_attr, "w0"))
+
+    return Layer(name, build, inputs=ins, size=ins[0].size)
+
+
+# ---------------------------------------------------------------------------
+# Sequence slicing
+# ---------------------------------------------------------------------------
+
+def seq_slice(input, starts, ends, name=None):
+    """Per-sequence [start, end) slice from index LAYERS (reference
+    seq_slice_layer:7125 -> sequence_slice op)."""
+    name = _auto_name("seq_slice", name)
+
+    def build(ctx, x, s, e):
+        L = ctx.fluid.layers
+        s64 = L.cast(s, "int64")
+        length = L.elementwise_sub(L.cast(e, "int64"), s64)
+        return L.sequence_slice(x, s64, length)
+
+    return Layer(name, build, inputs=[input, starts, ends],
+                 size=input.size)
+
+
+def sub_seq(input, offsets, sizes, act=None, bias_attr=None, name=None):
+    """Per-sequence sub-window by offset/size layers (reference
+    sub_seq_layer:7440)."""
+    if bias_attr not in (None, False):
+        raise NotImplementedError("sub_seq bias is not ported")
+    name = _auto_name("sub_seq", name)
+
+    def build(ctx, x, off, size):
+        L = ctx.fluid.layers
+        return _act_apply(ctx, L.sequence_slice(
+            x, L.cast(off, "int64"), L.cast(size, "int64")), act)
+
+    return Layer(name, build, inputs=[input, offsets, sizes],
+                 size=input.size)
+
+
+# ---------------------------------------------------------------------------
+# Recurrent step layers
+# ---------------------------------------------------------------------------
+
+def lstm_step(input, state, size=None, act=None, name=None, gate_act=None,
+              state_act=None, bias_attr=None, layer_attr=None):
+    """One LSTM step from the 4x-projected input and the previous cell
+    (reference lstm_step_layer:3765 -> the lstm_unit op).  Returns the
+    hidden; ``.state`` on the result is the new cell (XLA dedupes the
+    recomputation)."""
+    for arg, label in ((act, "act"), (gate_act, "gate_act"),
+                       (state_act, "state_act")):
+        if arg is not None:
+            raise NotImplementedError(
+                "lstm_step(%s=...): the lstm_unit op fixes the standard "
+                "tanh/sigmoid gate math; non-default step activations "
+                "are not ported" % label)
+    name = _auto_name("lstm_step", name)
+    width = size or (input.size // 4 if input.size else None)
+
+    def _mk(which):
+        def build(ctx, x, c_prev):
+            helper = LayerHelper("lstm_step")
+            c = helper.create_tmp_variable(dtype=x.dtype)
+            h = helper.create_tmp_variable(dtype=x.dtype)
+            helper.append_op(type="lstm_unit",
+                             inputs={"X": [x], "C_prev": [c_prev]},
+                             outputs={"C": [c], "H": [h]},
+                             attrs={"forget_bias": 0.0})
+            return h if which == "h" else c
+
+        return build
+
+    out = Layer(name, _mk("h"), inputs=[input, state], size=width)
+    out.state = Layer(name + ".state", _mk("c"), inputs=[input, state],
+                      size=width)
+    return out
+
+
+def gru_step(input, output_mem, size=None, act=None, name=None,
+             gate_act=None, bias_attr=None, param_attr=None,
+             layer_attr=None):
+    """One GRU step (reference gru_step_layer:3863 -> gru_unit op):
+    input is the 3x-projected x, output_mem the previous hidden."""
+    name = _auto_name("gru_step", name)
+    width = size or (input.size // 3 if input.size else None)
+
+    def build(ctx, x, h_prev):
+        h, _g, _r = ctx.fluid.layers.gru_unit(
+            x, h_prev, width * 3,
+            activation=v2_act.to_fluid_act(act) or "tanh",
+            gate_activation=v2_act.to_fluid_act(gate_act) or "sigmoid",
+            param_attr=_layer_param_attr(name, param_attr, "w0"),
+            bias_attr=_bias_attr(name, bias_attr))
+        return h
+
+    return Layer(name, build, inputs=[input, output_mem], size=width)
+
+
+def gru_step_naive(input, output_mem, size=None, act=None, name=None,
+                   gate_act=None, bias_attr=None, param_attr=None,
+                   layer_attr=None):
+    """Same math as gru_step without the fused-kernel split (reference
+    gru_step_naive_layer:3933) — one lowering here either way."""
+    return gru_step(input, output_mem, size=size, act=act, name=name,
+                    gate_act=gate_act, bias_attr=bias_attr,
+                    param_attr=param_attr)
+
+
+def recurrent(input, act=None, bias_attr=None, param_attr=None, name=None,
+              reverse=False, layer_attr=None):
+    """Simple full-matrix recurrence out_t = act(x_t + W out_{t-1} + b)
+    (reference recurrent_layer:4067), lowered through recurrent_group's
+    single DynamicRNN scan."""
+    name = _auto_name("recurrent", name)
+    width = input.size
+    step_name = name + "_step"
+
+    def step(x):
+        from .layer import addto as _addto
+        from .layer import fc as _fc
+        mem = memory(name=step_name, size=width)
+        rec = _fc(mem, size=width, bias_attr=bias_attr,
+                  param_attr=param_attr, name=name + "_rec")
+        out = _addto([x, rec], act=act or v2_act.Tanh(),
+                     name=step_name)
+        return out
+
+    return recurrent_group(step, [input], reverse=reverse, name=name)
+
+
+# ---------------------------------------------------------------------------
+# Detection
+# ---------------------------------------------------------------------------
+
+def priorbox(input, image, aspect_ratio, variance, min_size, max_size=(),
+             name=None):
+    """SSD prior boxes (reference priorbox_layer:1129 -> prior_box op).
+    The layer value is [M, 8]: boxes|variances concatenated — the
+    format multibox_loss / detection_output re-split."""
+    name = _auto_name("priorbox", name)
+
+    def build(ctx, feat, img):
+        L = ctx.fluid.layers
+        feat, _ = _as_image(ctx, _inputs(input)[0], feat)
+        img, _ = _as_image(ctx, _inputs(image)[0], img)
+        boxes, vars_ = L.prior_box(
+            feat, img, min_sizes=list(min_size),
+            max_sizes=list(max_size) or None,
+            aspect_ratios=list(aspect_ratio), variance=list(variance),
+            flip=True, clip=True)
+        b = L.reshape(boxes, [-1, 4])
+        v = L.reshape(vars_, [-1, 4])
+        return L.concat([b, v], axis=1)
+
+    return Layer(name, build, inputs=[input, image])
+
+
+def cross_channel_norm(input, name=None, param_attr=None):
+    """L2-normalize across channels with a learned per-channel scale
+    (reference cross_channel_norm_layer:1377)."""
+    name = _auto_name("ccn", name)
+    ins = _inputs(input)
+    src = ins[0]
+
+    def build(ctx, x):
+        L = ctx.fluid.layers
+        img, c = _as_image(ctx, src, x)
+        normed = L.l2_normalize(img, axis=1)
+        w = L.create_parameter(
+            shape=[int(img.shape[1])], dtype="float32",
+            attr=_layer_param_attr(name, param_attr, "w0"))
+        return L.elementwise_mul(normed, w, axis=1)
+
+    out = Layer(name, build, inputs=ins, size=src.size)
+    out.num_channels = getattr(src, "num_channels", None)
+    return out
+
+
+def _split_priorbox(ctx, pb):
+    L = ctx.fluid.layers
+    boxes = L.slice_op(pb, axes=[1], starts=[0], ends=[4])
+    vars_ = L.slice_op(pb, axes=[1], starts=[4], ends=[8])
+    return boxes, vars_
+
+
+def multibox_loss(input_loc, input_conf, priorbox, label, num_classes,
+                  overlap_threshold=0.5, neg_pos_ratio=3.0,
+                  neg_overlap=0.5, background_id=0, name=None):
+    """SSD multibox loss (reference multibox_loss_layer:1176 ->
+    fluid.layers.ssd_loss).  ``label`` is ``(gt_box_layer,
+    gt_label_layer)`` — the reference's packed per-sample gt stream is
+    a gserver Argument format; here ground truth feeds as two ragged
+    tensors, matching fluid.layers.ssd_loss (MIGRATION.md)."""
+    if not isinstance(label, (list, tuple)) or len(label) != 2:
+        raise NotImplementedError(
+            "multibox_loss(label=...): pass (gt_box, gt_label) layers; "
+            "the v1 packed-label stream is not ported "
+            "(fluid.layers.ssd_loss)")
+    locs = _inputs(input_loc)
+    confs = _inputs(input_conf)
+    name = _auto_name("multibox_loss", name)
+    nl, nc_ = len(locs), len(confs)
+
+    def build(ctx, *xs):
+        L = ctx.fluid.layers
+        locv = [L.reshape(v, [0, -1, 4]) for v in xs[:nl]]
+        confv = [L.reshape(v, [0, -1, num_classes])
+                 for v in xs[nl:nl + nc_]]
+        pb = xs[nl + nc_]
+        gt_box, gt_label = xs[nl + nc_ + 1], xs[nl + nc_ + 2]
+        loc = locv[0] if len(locv) == 1 else L.concat(locv, axis=1)
+        conf = confv[0] if len(confv) == 1 else L.concat(confv, axis=1)
+        boxes, vars_ = _split_priorbox(ctx, pb)
+        loss = L.ssd_loss(loc, conf, gt_box, gt_label, boxes, vars_,
+                          background_label=background_id,
+                          overlap_threshold=overlap_threshold,
+                          neg_pos_ratio=neg_pos_ratio)
+        return L.mean(loss)
+
+    return Layer(name, build,
+                 inputs=locs + confs + [priorbox, label[0], label[1]],
+                 size=1)
+
+
+def detection_output(input_loc, input_conf, priorbox, num_classes,
+                     nms_threshold=0.45, nms_top_k=400, keep_top_k=200,
+                     confidence_threshold=0.01, background_id=0,
+                     name=None):
+    """Decode + NMS serving head (reference detection_output_layer:1251
+    -> fluid.layers.detection_output)."""
+    locs = _inputs(input_loc)
+    confs = _inputs(input_conf)
+    name = _auto_name("detection_output", name)
+    nl, nc_ = len(locs), len(confs)
+
+    def build(ctx, *xs):
+        L = ctx.fluid.layers
+        locv = [L.reshape(v, [0, -1, 4]) for v in xs[:nl]]
+        confv = [L.reshape(v, [0, -1, num_classes])
+                 for v in xs[nl:nl + nc_]]
+        pb = xs[nl + nc_]
+        loc = locv[0] if len(locv) == 1 else L.concat(locv, axis=1)
+        conf = confv[0] if len(confv) == 1 else L.concat(confv, axis=1)
+        boxes, vars_ = _split_priorbox(ctx, pb)
+        return L.detection_output(
+            loc, L.softmax(conf), boxes, vars_,
+            background_label=background_id, nms_threshold=nms_threshold,
+            nms_top_k=nms_top_k, keep_top_k=keep_top_k,
+            score_threshold=confidence_threshold)
+
+    return Layer(name, build, inputs=locs + confs + [priorbox])
+
+
+def roi_pool(input, rois, pooled_width, pooled_height, spatial_scale,
+             num_channels=None, name=None):
+    """ROI max pooling (reference roi_pool_layer:1332): ``rois`` rows
+    are [batch_idx, x1, y1, x2, y2]."""
+    name = _auto_name("roi_pool", name)
+    ins = _inputs(input)
+    src = ins[0]
+
+    def build(ctx, x, r):
+        img, c = _as_image(ctx, src, x, num_channels)
+        return ctx.fluid.layers.roi_pool(
+            img, r, pooled_height=pooled_height,
+            pooled_width=pooled_width, spatial_scale=spatial_scale)
+
+    nc = num_channels or getattr(src, "num_channels", 1)
+    return Layer(name, build, inputs=[src, rois],
+                 size=nc * pooled_width * pooled_height)
+
+
+# ---------------------------------------------------------------------------
+# Costs
+# ---------------------------------------------------------------------------
+
+def nce(input, label, num_classes=None, weight=None, num_neg_samples=10,
+        neg_distribution=None, name=None, bias_attr=None,
+        param_attr=None, layer_attr=None):
+    """Noise-contrastive estimation cost (reference nce_layer:5896 ->
+    the nce op's uniform sampler)."""
+    if neg_distribution is not None:
+        raise NotImplementedError(
+            "nce(neg_distribution=...): only the uniform sampler is "
+            "ported (fluid.layers.nce)")
+    name = _auto_name("nce", name)
+    ins = _inputs(input)
+    if len(ins) != 1:
+        raise NotImplementedError(
+            "nce with multiple inputs: concat them first")
+
+    def build(ctx, x, lab):
+        L = ctx.fluid.layers
+        cost = L.nce(x, lab, num_classes,
+                     num_neg_samples=num_neg_samples,
+                     param_attr=_layer_param_attr(name, param_attr, "w0"),
+                     bias_attr=_bias_attr(name, bias_attr))
+        return L.mean(cost)
+
+    return Layer(name, build, inputs=[ins[0], label], size=1)
+
+
+def hsigmoid(input, label, num_classes=None, name=None, bias_attr=None,
+             param_attr=None, layer_attr=None):
+    """Hierarchical-sigmoid cost (reference hsigmoid:2423), carried by
+    the fluid hsigmoid path."""
+    name = _auto_name("hsigmoid", name)
+    ins = _inputs(input)
+    if len(ins) != 1:
+        raise NotImplementedError(
+            "hsigmoid with multiple inputs: concat them first")
+
+    def build(ctx, x, lab):
+        L = ctx.fluid.layers
+        cost = L.hsigmoid(x, lab, num_classes,
+                          param_attr=_layer_param_attr(
+                              name, param_attr, "w0"),
+                          bias_attr=_bias_attr(name, bias_attr))
+        return L.mean(cost)
+
+    return Layer(name, build, inputs=[ins[0], label], size=1)
+
+
+def warp_ctc(input, label, size=None, name=None, blank=0,
+             norm_by_times=False, layer_attr=None):
+    """CTC via the warp-ctc math (reference warp_ctc_layer:5669 ->
+    warpctc op)."""
+    name = _auto_name("warp_ctc", name)
+
+    def build(ctx, x, lab):
+        L = ctx.fluid.layers
+        return L.mean(L.warpctc(x, lab, blank=blank,
+                                norm_by_times=norm_by_times))
+
+    return Layer(name, build, inputs=[input, label], size=1)
+
+
+def rank_cost(left, right, label, weight=None, name=None, coeff=1.0,
+              layer_attr=None):
+    """Pairwise ranking cost (reference rank_cost:6015 -> rank_loss
+    op)."""
+    name = _auto_name("rank_cost", name)
+    ins = [left, right, label] + ([weight] if weight is not None else [])
+
+    def build(ctx, l, r, lab, *rest):
+        L = ctx.fluid.layers
+        out = L.rank_loss(lab, l, r)
+        if rest:
+            out = L.elementwise_mul(out, rest[0])
+        out = L.mean(out)
+        if coeff != 1.0:
+            out = L.scale(out, scale=float(coeff))
+        return out
+
+    return Layer(name, build, inputs=ins, size=1)
+
+
+def sum_cost(input, name=None, layer_attr=None):
+    """Plain sum of the input as the loss (reference sum_cost:6250)."""
+    name = _auto_name("sum_cost", name)
+    ins = _inputs(input)
+
+    def build(ctx, x):
+        return ctx.fluid.layers.reduce_sum(x)
+
+    return Layer(name, build, inputs=ins, size=1)
+
+
+def huber_regression_cost(input, label, name=None, delta=1.0, coeff=1.0,
+                          layer_attr=None):
+    """Huber regression (reference huber_regression_cost:6282 ->
+    huber_loss op)."""
+    name = _auto_name("huber_regression", name)
+
+    def build(ctx, x, y):
+        L = ctx.fluid.layers
+        out = L.mean(L.huber_loss(x, y, delta))
+        if coeff != 1.0:
+            out = L.scale(out, scale=float(coeff))
+        return out
+
+    return Layer(name, build, inputs=[input, label], size=1)
+
+
+def huber_classification_cost(input, label, name=None, coeff=1.0,
+                              layer_attr=None):
+    """Modified Huber for binary classification (reference
+    huber_classification_cost:6337 -> modified_huber_loss op)."""
+    name = _auto_name("huber_classification", name)
+
+    def build(ctx, x, y):
+        L = ctx.fluid.layers
+        out = L.mean(L.modified_huber_loss(x, L.cast(y, "float32")))
+        if coeff != 1.0:
+            out = L.scale(out, scale=float(coeff))
+        return out
+
+    return Layer(name, build, inputs=[input, label], size=1)
+
+
+def smooth_l1_cost(input, label, name=None, coeff=1.0, layer_attr=None):
+    """Smooth-L1 (reference smooth_l1_cost:6550 -> smooth_l1 op)."""
+    name = _auto_name("smooth_l1", name)
+
+    def build(ctx, x, y):
+        L = ctx.fluid.layers
+        out = L.mean(L.smooth_l1(x, y))
+        if coeff != 1.0:
+            out = L.scale(out, scale=float(coeff))
+        return out
+
+    return Layer(name, build, inputs=[input, label], size=1)
+
+
+def multi_binary_label_cross_entropy_cost(input, label, name=None,
+                                          coeff=1.0, layer_attr=None):
+    """Per-label sigmoid cross entropy on probability inputs
+    (reference multi_binary_label_cross_entropy:6390)."""
+    name = _auto_name("multi_ce", name)
+
+    def build(ctx, p, y):
+        L = ctx.fluid.layers
+        p = L.clip(p, min=1e-7, max=1.0 - 1e-7)
+        yf = L.cast(y, "float32")
+        pos = L.elementwise_mul(yf, L.log(p))
+        neg = L.elementwise_mul(L.scale(yf, scale=-1.0, bias=1.0),
+                                L.log(L.scale(p, scale=-1.0, bias=1.0)))
+        per = L.scale(L.reduce_sum(L.elementwise_add(pos, neg), dim=1,
+                                   keep_dim=True), scale=-1.0)
+        out = L.mean(per)
+        if coeff != 1.0:
+            out = L.scale(out, scale=float(coeff))
+        return out
+
+    return Layer(name, build, inputs=[input, label], size=1)
+
+
+def cross_entropy_with_selfnorm_cost(input, label, name=None, coeff=1.0,
+                                     softmax_selfnorm_alpha=0.1,
+                                     layer_attr=None):
+    """CE plus an alpha * log(Z)^2 self-normalization penalty
+    (reference cross_entropy_with_selfnorm:6199)."""
+    name = _auto_name("ce_selfnorm", name)
+
+    def build(ctx, p, y):
+        L = ctx.fluid.layers
+        ce = L.cross_entropy(input=p, label=y)
+        z = L.reduce_sum(p, dim=1, keep_dim=True)
+        pen = L.scale(L.square(L.log(z)),
+                      scale=float(softmax_selfnorm_alpha))
+        out = L.mean(L.elementwise_add(ce, pen))
+        if coeff != 1.0:
+            out = L.scale(out, scale=float(coeff))
+        return out
+
+    return Layer(name, build, inputs=[input, label], size=1)
+
+
+# ---------------------------------------------------------------------------
+# Utilities / markers
+# ---------------------------------------------------------------------------
+
+def printer(input, format=None, name=None):
+    """Print layer values each step (reference printer_layer:1095 ->
+    the print host op)."""
+    name = _auto_name("printer", name)
+    ins = _inputs(input)
+
+    def build(ctx, *xs):
+        outs = [ctx.fluid.layers.Print(x, message=format or name)
+                for x in xs]
+        return outs[0]   # pass-through of the FIRST input (size below)
+
+    return Layer(name, build, inputs=ins, size=ins[0].size)
+
+
+globals()["print"] = printer   # reference __convert_name__: print_layer
+
+
+class LayerType:
+    """Layer-kind constants (reference layers.py:156).  Kept for
+    source compatibility; the deferred-DAG builders do not dispatch on
+    these."""
+
+    DATA = "data"
+    FC_LAYER = "fc"
+    COST = "cost"
+    MIXED_LAYER = "mixed"
+    LSTMEMORY = "lstmemory"
+    GRUMEMORY = "gated_recurrent"
+    SEQUENCE_LAST_INSTANCE = "seqlastins"
+    SEQUENCE_FIRST_INSTANCE = "seqfirstins"
+    POOLING_MAX = "max"
+    POOLING_AVG = "average"
+
+    @staticmethod
+    def is_layer_type(type_name):
+        return isinstance(type_name, str)
+
+
+def layer_support(*attrs):
+    """No-op decorator (reference layers.py:395 wires ExtraLayerAttr
+    checking; layer_attr is accepted-and-ignored across this API)."""
+    def decorator(fn):
+        return fn
+
+    return decorator
+
+
+class BeamInput:
+    """Marker for cross_entropy_over_beam inputs (reference
+    layers.py:6441).  Constructible for source compatibility; the cost
+    itself is not ported (see cross_entropy_over_beam)."""
+
+    def __init__(self, candidate_scores, selected_candidates,
+                 candidate_labels):
+        self.candidate_scores = candidate_scores
+        self.selected_candidates = selected_candidates
+        self.candidate_labels = candidate_labels
+
+
+def SubsequenceInput(input):
+    """Nested-sequence recurrent_group input (reference layers.py:4146).
+    Level-2 recurrent groups are not ported — level-k LoD data is, but
+    the scan-over-subsequences control form is not; fail loudly."""
+    raise NotImplementedError(
+        "SubsequenceInput (nested-sequence recurrent_group) is not "
+        "ported; process the inner level with sequence ops "
+        "(fluid.layers.sequence_* handle level-k LoD) or flatten with "
+        "seq_reshape")
+
+
+def _refusal(name_, reason, pointer):
+    def fn(*args, **kwargs):
+        raise NotImplementedError(
+            "paddle_tpu.v2.layer.%s is not ported: %s; use %s "
+            "(see MIGRATION.md 'v2 layer coverage')"
+            % (name_, reason, pointer))
+
+    fn.__name__ = name_
+    fn.__doc__ = ("Documented refusal (reference layers.py): %s; use %s."
+                  % (reason, pointer))
+    return fn
+
+
+get_output = _refusal(
+    "get_output", "layers here have exactly one output value (auxiliary "
+    "outputs like the LSTM cell ride as attributes, e.g. "
+    "lstm_step(...).state)", "the .state attribute or fluid.layers")
+sub_nested_seq = _refusal(
+    "sub_nested_seq", "nested-sequence row selection has no fluid "
+    "carrier", "fluid.layers.gather on the padded form")
+cross_entropy_over_beam = _refusal(
+    "cross_entropy_over_beam", "beam-training (CRF-over-beam) requires "
+    "the gserver beam expansion records", "layer.beam_search for "
+    "generation + per-step cross_entropy_cost for training")
+eos = _refusal(
+    "eos", "end-of-sequence truncation is built into beam_search here",
+    "layer.beam_search(eos_id=...)")
+kmax_seq_score = _refusal(
+    "kmax_seq_score", "ragged per-sequence top-k indices have no "
+    "masked carrier", "fluid.layers.topk on the padded scores")
+lambda_cost = _refusal(
+    "lambda_cost", "LambdaRank's NDCG-weighted pair loss needs "
+    "per-query sorting that has no fluid carrier", "rank_cost "
+    "(pairwise logistic) or a custom op")
+scale_sub_region = _refusal(
+    "scale_sub_region", "per-sample dynamic region writes have no "
+    "fluid carrier", "fluid.layers.crop + elementwise compositions")

@@ -433,8 +433,11 @@ def test_v2_optimizer_strictness_and_clip():
 
 
 def test_v2_unported_layer_names_fail_loudly():
+    # conv_projection is ported as of round 5 — unknown names still
+    # fail loudly with the fluid hint
+    assert callable(paddle.layer.conv_projection)
     with pytest.raises(AttributeError, match="ported v2 subset"):
-        paddle.layer.conv_projection
+        paddle.layer.definitely_not_a_layer  # noqa: B018
     # a name with no curated pointer gets the generic fluid hint
     with pytest.raises(AttributeError, match="fluid.layers equivalent"):
         paddle.layer.hsigmoid_layer_from_v1
@@ -813,8 +816,11 @@ def test_v2_mixed_projections_train():
     with pytest.raises(ValueError, match="width"):
         paddle.layer.mixed(size=8, input=[
             paddle.layer.full_matrix_projection(input=x, size=4)])
-    with pytest.raises(NotImplementedError, match="offset"):
-        paddle.layer.identity_projection(input=z, offset=2)
+    # identity_projection(offset=...) is now a real feature-window
+    # slice (round-5); pin the sliced width instead of the old refusal
+    off = paddle.layer.mixed(size=3, input=[
+        paddle.layer.identity_projection(input=x, offset=1, size=3)])
+    assert off.size == 3
 
 
 def test_v2_beam_search_beats_greedy():
