@@ -24,7 +24,8 @@ import jax.numpy as jnp
 import numpy as np
 from jax.experimental import pallas as pl
 
-__all__ = ["flash_attention"]
+__all__ = ["flash_attention", "flash_attention_fwd_lse",
+           "flash_attention_bwd"]
 
 NEG_INF = -1e30
 
@@ -111,7 +112,8 @@ def _fit_block(block, size):
 
 
 def flash_attention(q, k, v, scale=None, causal=False, block_q=1024,
-                    block_k=1024, force_xla=False, interpret=False):
+                    block_k=1024, force_xla=False, interpret=False,
+                    block_q_bwd=None, block_k_bwd=None):
     """softmax(QK^T scale) V, [B,H,T,D] in/out.
 
     Uses the Pallas kernel on TPU when T divides into the block sizes;
@@ -132,23 +134,26 @@ def flash_attention(q, k, v, scale=None, causal=False, block_q=1024,
     if force_xla or not usable or not (on_tpu or interpret):
         return _attention_xla(q, k, v, scale, causal)
     return _flash_diff(q, k, v, scale, causal, block_q, block_k,
-                       interpret)
+                       block_q_bwd, block_k_bwd, interpret)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
-def _flash_diff(q, k, v, scale, causal, block_q, block_k, interpret):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8, 9))
+def _flash_diff(q, k, v, scale, causal, block_q, block_k, block_q_bwd,
+                block_k_bwd, interpret):
     out, _ = _flash_pallas(q, k, v, scale, causal, block_q, block_k,
                            interpret)
     return out
 
 
-def _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret):
+def _flash_fwd(q, k, v, scale, causal, block_q, block_k, block_q_bwd,
+               block_k_bwd, interpret):
     out, lse = _flash_pallas(q, k, v, scale, causal, block_q, block_k,
                              interpret)
     return out, (q, k, v, out, lse)
 
 
-def _flash_bwd(scale, causal, block_q, block_k, interpret, res, g):
+def _flash_bwd(scale, causal, block_q, block_k, block_q_bwd, block_k_bwd,
+               interpret, res, g):
     """Flash backward (Dao et al. 2022, alg. 2): with the forward's
     per-row log-sum-exp saved, P rebuilds tile-by-tile as
     exp(scale*QK^T - lse), so the backward never materializes [T, T]
@@ -161,11 +166,13 @@ def _flash_bwd(scale, causal, block_q, block_k, interpret, res, g):
     # The backward kernels keep several [block_q, block_k] f32
     # intermediates (p, ds + operand tiles) live in VMEM per grid step —
     # at 1024x1024 that flirts with the ~16MB/core budget at d=128, so
-    # cap the backward tiles at 512 (power-of-two halving keeps
-    # divisibility) while the forward keeps the bigger tiles it profits
-    # from.
-    bq = _fit_block(min(block_q, 512), q.shape[2])
-    bk = _fit_block(min(block_k, 512), k.shape[2])
+    # cap the backward Q tile at 512 while K/V tiles follow the forward:
+    # xplane-measured at the secondary-bench shape (B16 H8 T2048 D128),
+    # (512, 1024) runs the dq+dkv pair 10% faster than the round-2
+    # (512, 512) caps; K-tile streaming amortizes better than square
+    # tiles (PROFILE_r05.md).
+    bq = _fit_block(block_q_bwd or min(block_q, 512), q.shape[2])
+    bk = _fit_block(block_k_bwd or block_k, k.shape[2])
     # _fit_block stops halving at 8 even when 8 doesn't divide (e.g.
     # T=1002): a non-dividing tile would silently drop the tail rows of
     # the grid, so fall back to the forward's blocks, which divide by
@@ -371,3 +378,84 @@ def _flash_pallas(q, k, v, scale, causal, block_q, block_k, interpret):
         interpret=interpret,
     )(qf, kf, vf)
     return out.reshape(b, h, t, d), lse.reshape(b, h, t)
+
+
+def flash_attention_fwd_lse(q, k, v, scale=None, causal=False,
+                            block_q=1024, block_k=1024, force_xla=False,
+                            interpret=False):
+    """Forward returning ``(out, lse)`` — the op-level residual form.
+
+    The fluid autodiff is op-granular: without the saved per-row
+    log-sum-exp, the ``ring_attention_grad`` op's generic vjp must
+    re-execute the forward kernel inside the backward (XLA cannot CSE
+    opaque custom-calls), measured at ~2.5 ms/layer on the secondary
+    bench.  Exposing lse as an op output turns the backward into the
+    two flash kernels alone (flash_attention_bwd)."""
+    b, h, t, d = q.shape
+    tk = k.shape[2]
+    if scale is None:
+        scale = 1.0 / np.sqrt(d)
+    block_q = _fit_block(block_q, t)
+    block_k = _fit_block(block_k, tk)
+    usable = (t % block_q == 0 and tk % block_k == 0)
+    on_tpu = target_platform() == "tpu"
+    if force_xla or not usable or not (on_tpu or interpret):
+        s = jnp.einsum("bhtd,bhsd->bhts", q.astype(jnp.float32),
+                       k.astype(jnp.float32)) * scale
+        if causal:
+            mask = jnp.tril(jnp.ones((t, tk), bool))
+            s = jnp.where(mask, s, NEG_INF)
+        lse = jax.nn.logsumexp(s, axis=-1)
+        p = jnp.exp(s - lse[..., None])
+        out = jnp.einsum("bhts,bhsd->bhtd", p,
+                         v.astype(jnp.float32)).astype(q.dtype)
+        return out, lse
+    return _flash_pallas(q, k, v, scale, causal, block_q, block_k,
+                         interpret)
+
+
+def flash_attention_bwd(q, k, v, out, lse, do, scale=None, causal=False,
+                        block_q=1024, block_k=1024, force_xla=False,
+                        interpret=False):
+    """Backward from op-level residuals: rebuilds P tile-by-tile from
+    the saved lse (Dao et al. 2022 alg. 2) — no forward re-execution,
+    no [T, T] materialization.  Returns (dq, dk, dv)."""
+    b, h, t, d = q.shape
+    tk = k.shape[2]
+    if scale is None:
+        scale = 1.0 / np.sqrt(d)
+    block_q = _fit_block(block_q, t)
+    block_k = _fit_block(block_k, tk)
+    usable = (t % block_q == 0 and tk % block_k == 0)
+    on_tpu = target_platform() == "tpu"
+    if force_xla or not usable or not (on_tpu or interpret):
+        qf = q.astype(jnp.float32)
+        kf = k.astype(jnp.float32)
+        vf = v.astype(jnp.float32)
+        dof = do.astype(jnp.float32)
+        s = jnp.einsum("bhtd,bhsd->bhts", qf, kf) * scale
+        if causal:
+            mask = jnp.tril(jnp.ones((t, tk), bool))
+            s = jnp.where(mask, s, NEG_INF)
+        p = jnp.exp(s - lse[..., None])
+        dv = jnp.einsum("bhts,bhtd->bhsd", p, dof)
+        dp = jnp.einsum("bhtd,bhsd->bhts", dof, vf)
+        delta = (dof * out.astype(jnp.float32)).sum(-1)
+        ds = p * (dp - delta[..., None]) * scale
+        dq = jnp.einsum("bhts,bhsd->bhtd", ds, kf)
+        dk = jnp.einsum("bhts,bhtd->bhsd", ds, qf)
+        return (dq.astype(q.dtype), dk.astype(k.dtype),
+                dv.astype(v.dtype))
+    do = do.astype(out.dtype)
+    delta = (do.astype(jnp.float32) * out.astype(jnp.float32)).sum(-1)
+    bq = _fit_block(min(block_q, 512), t)
+    bk = _fit_block(block_k, tk)     # K tile follows the forward (see
+    if t % bq:                       # the cap note in _flash_bwd)
+        bq = block_q
+    if tk % bk:
+        bk = block_k
+    dq = _flash_bwd_dq(q, k, v, do, lse, delta, scale, causal, bq, bk,
+                       interpret)
+    dk, dv = _flash_bwd_dkv(q, k, v, do, lse, delta, scale, causal,
+                            bq, bk, interpret)
+    return dq, dk, dv

@@ -39,9 +39,14 @@ def _attn_block(x, d_model, n_head, tp, sp, prefix):
 
     helper = fluid.layer_helper.LayerHelper(prefix + "_ring")
     att = helper.create_tmp_variable(x.dtype)
+    # LSE output = the flash residual: the backward runs the two flash
+    # kernels from it instead of re-executing the forward inside the
+    # grad op's vjp (~2.5 ms/layer on the secondary bench)
+    lse = helper.create_tmp_variable("float32")
+    lse.stop_gradient = True
     helper.append_op(
         type="ring_attention", inputs={"Q": [q], "K": [k], "V": [v]},
-        outputs={"Out": [att]},
+        outputs={"Out": [att], "LSE": [lse]},
         attrs={"causal": True, "sp_axis": "sp" if sp else "",
                "batch_axis": "dp", "head_axis": "tp" if tp else ""})
     att = fluid.layers.transpose(att, [0, 2, 1, 3])

@@ -31,7 +31,7 @@ def _axis_or_none(mesh, name):
                     and dict(mesh.shape)[name] > 1) else None
 
 
-@register_op("ring_attention")
+@register_op("ring_attention", no_vjp_outputs=("LSE",))
 def _ring_attention_lower(ctx, ins, attrs, op=None):
     """Scaled-dot-product attention, sequence-parallel when compiled under
     a mesh with the configured sp axis; dense otherwise.  Q/K/V: [B,H,S,D].
@@ -54,14 +54,28 @@ def _ring_attention_lower(ctx, ins, attrs, op=None):
             scale=scale,
             batch_axis=_axis_or_none(ctx.mesh, attrs.get("batch_axis", "dp")),
             head_axis=_axis_or_none(ctx.mesh, attrs.get("head_axis", "tp")))
+        if op is not None and op.outputs.get("LSE"):
+            # sequence-parallel residuals stay inside the ring primitive;
+            # the LSE output is a zeros placeholder and the grad op takes
+            # the generic-vjp path (it checks sp the same way)
+            return {"Out": out,
+                    "LSE": jnp.zeros(q.shape[:3], jnp.float32)}
         return {"Out": out}
     # dense (single-chip) path: the Pallas flash kernel on TPU (1.7x
     # XLA at T=8192, measured), same-math XLA fallback elsewhere.
     # Under a mesh the mesh's devices decide the platform (the default-
     # device pin is absent and devices()[0] may be an unrelated TPU).
     from paddle_tpu.kernels import flash_attention
+    from paddle_tpu.kernels.flash_attention import flash_attention_fwd_lse
     not_tpu = (ctx.mesh is not None and
                ctx.mesh.devices.flat[0].platform != "tpu")
+    if op is not None and op.outputs.get("LSE"):
+        # residual form: lse rides as an op output so the grad op runs
+        # the flash backward directly instead of re-executing the
+        # forward inside its vjp (see ring_attention_grad)
+        out, lse = flash_attention_fwd_lse(
+            q, k, v, scale=scale, causal=causal, force_xla=not_tpu)
+        return {"Out": out, "LSE": lse}
     return {"Out": flash_attention(q, k, v, scale=scale, causal=causal,
                                    force_xla=not_tpu)}
 
@@ -93,3 +107,26 @@ def _moe_ffn_lower(ctx, ins, attrs, op=None):
         y = jnp.einsum("tef,efd->ted", h, w2)
         out = y[jnp.arange(x2.shape[0]), expert] * gate[:, None]
     return {"Out": out.reshape(shape)}
+
+
+@register_op("ring_attention_grad", grad_maker=None)
+def _ring_attention_grad_lower(ctx, ins, attrs, op=None):
+    """Flash backward from the forward's saved lse (no forward
+    re-execution).  Falls back to the generic vjp — which re-runs the
+    forward — when the residual is absent (ops built without the LSE
+    output, e.g. the inference transpiler's fused chains) or when the
+    sequence-parallel ring owns the residuals."""
+    from paddle_tpu.core import lowering as core_lowering
+    from paddle_tpu.kernels.flash_attention import flash_attention_bwd
+
+    sp_axis = _axis_or_none(ctx.mesh, attrs.get("sp_axis", "sp"))
+    lse = ins.get("LSE")
+    if sp_axis is not None or lse is None:
+        return core_lowering.generic_grad_lower(ctx, ins, attrs, op)
+    not_tpu = (ctx.mesh is not None and
+               ctx.mesh.devices.flat[0].platform != "tpu")
+    dq, dk, dv = flash_attention_bwd(
+        ins["Q"], ins["K"], ins["V"], ins["Out"], lse, ins["Out@GRAD"],
+        scale=attrs["scale"] if "scale" in attrs else None,
+        causal=bool(attrs.get("causal", True)), force_xla=not_tpu)
+    return {"Q@GRAD": dq, "K@GRAD": dk, "V@GRAD": dv}

@@ -24,7 +24,8 @@ import os
 import re
 
 __all__ = ["read_xspace", "op_totals", "print_op_profile",
-           "op_profile", "category_profile", "print_category_profile"]
+           "op_profile", "category_profile", "print_category_profile",
+           "kernel_profile", "print_kernel_profile"]
 
 
 def _varint(buf, i):
@@ -318,3 +319,47 @@ def print_category_profile(path, top=12, **kwargs):
             100.0 * c["time_ps"] / total, c["tflops_per_s"],
             100.0 * c["mxu_util"], c["gbps"], 100.0 * c["hbm_util"]))
     return cats
+
+
+def kernel_profile(path, name_re=r".", plane_re=r"/device:",
+                   line_name="XLA Ops"):
+    """Per-KERNEL rows (not categories) for ops matching ``name_re`` —
+    the attribution ``category_profile`` cannot give for custom-calls:
+    XLA's flop counter is blank inside them (Pallas kernels), so their
+    achieved TFLOP/s must come from caller-supplied analytic FLOPs.
+    Returns [{name, time_ps, count, ms_per_exec}] sorted by total time;
+    pair with analytic per-exec FLOPs to get MXU utilization."""
+    all_rows = _all_rows if _all_rows is not None else op_profile(
+        path, plane_re=plane_re, line_name=line_name)
+    rows = [r for r in all_rows if re.search(name_re, r["name"])]
+    for r in rows:
+        r["ms_per_exec"] = r["time_ps"] / 1e9 / max(r["count"], 1)
+    return rows
+
+
+def print_kernel_profile(path, name_re=r".", top=15, flops_per_exec=None,
+                         peak_tflops=197.0, **kwargs):
+    """Print per-kernel rows; ``flops_per_exec`` maps a regex to the
+    analytic FLOPs of ONE execution (e.g. flash-attention tile math) to
+    report achieved TFLOP/s / MXU fraction for custom-calls."""
+    all_rows = op_profile(path, **kwargs)   # parse the capture ONCE
+    rows = kernel_profile(path, name_re=name_re, _all_rows=all_rows,
+                          **kwargs)
+    total = sum(r["time_ps"] for r in all_rows) or 1
+    print("%-46s %9s %6s %7s %9s %7s" % (
+        "kernel", "ms", "count", "share", "TFLOP/s", "mxu"))
+    for r in rows[:top]:
+        tf = mxu = None
+        if flops_per_exec:
+            for pat, fl in flops_per_exec.items():
+                if re.search(pat, r["name"]):
+                    secs = r["time_ps"] / 1e12 or 1e-12
+                    tf = fl * r["count"] / secs / 1e12
+                    mxu = tf / peak_tflops
+                    break
+        print("%-46s %9.2f %6d %6.2f%% %9s %7s" % (
+            r["name"][:46], r["time_ps"] / 1e9, r["count"],
+            100.0 * r["time_ps"] / total,
+            "%.1f" % tf if tf is not None else "-",
+            "%.1f%%" % (100 * mxu) if mxu is not None else "-"))
+    return rows

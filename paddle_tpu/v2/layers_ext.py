@@ -233,17 +233,30 @@ def conv_operator(img, filter, filter_size, num_filters, num_channels=None,
     py = padding_y if padding_y is not None else padding
 
     def build(ctx, ximg, xfil, owner_name, j, width):
+        L = ctx.fluid.layers
         img4, nc = _as_image(ctx, img, ximg, num_channels)
-        fil = ctx.fluid.layers.reshape(
-            xfil, [num_filters, nc, filter_size, fy])
+        n = int(img4.shape[0])
+        if n < 0:
+            raise NotImplementedError(
+                "conv_operator: the filter layer supplies PER-SAMPLE "
+                "kernels (reference ConvOperator), which lowers through "
+                "grouped conv and needs a static batch dim; feed a "
+                "fixed batch or use conv_projection for learned shared "
+                "filters")
+        # per-sample conv == grouped conv with batch folded into
+        # channels: img [1, N*C, H, W] * filter [N*F, C, fh, fw],
+        # groups=N
+        imgf = L.reshape(img4, [1, n * nc] + [int(d) for d in
+                                              img4.shape[2:]])
+        fil = L.reshape(xfil, [n * num_filters, nc, filter_size, fy])
         helper = LayerHelper("conv_operator")
         out = helper.create_tmp_variable(dtype=img4.dtype)
         helper.append_op(
-            type="conv2d", inputs={"Input": [img4], "Filter": [fil]},
+            type="conv2d", inputs={"Input": [imgf], "Filter": [fil]},
             outputs={"Output": [out]},
             attrs={"strides": [stride, sy], "paddings": [padding, py],
-                   "dilations": [1, 1], "groups": 1})
-        return ctx.fluid.layers.reshape(out, [-1, width])
+                   "dilations": [1, 1], "groups": n})
+        return L.reshape(out, [n, width])
 
     p = _Projection(img, build, size=None)
     p.inputs = [img, filter]
@@ -913,14 +926,20 @@ def row_conv(input, context_len, act=None, name=None, param_attr=None,
 
 def prelu(input, name=None, partial_sum=1, channel_shared=None,
           num_channels=None, param_attr=None, layer_attr=None):
-    """Parametric ReLU (reference prelu_layer:6762)."""
+    """Parametric ReLU (reference prelu_layer:6762): channel_shared ->
+    one alpha; partial_sum=1 -> per-element alphas; other group sizes
+    have no carrier and refuse."""
+    if not channel_shared and partial_sum != 1:
+        raise NotImplementedError(
+            "prelu(partial_sum=%r): per-group alpha sharing is not "
+            "ported; use partial_sum=1 (per-element) or "
+            "channel_shared=True (fluid.layers.prelu)" % partial_sum)
     name = _auto_name("prelu", name)
     ins = _inputs(input)
 
     def build(ctx, x):
         return ctx.fluid.layers.prelu(
-            x, mode="all" if (channel_shared or partial_sum != 1)
-            else "channel" if len(x.shape) > 2 else "all",
+            x, mode="all" if channel_shared else "element",
             param_attr=_layer_param_attr(name, param_attr, "w0"))
 
     return Layer(name, build, inputs=ins, size=ins[0].size)
@@ -1120,6 +1139,11 @@ def multibox_loss(input_loc, input_conf, priorbox, label, num_classes,
             "multibox_loss(label=...): pass (gt_box, gt_label) layers; "
             "the v1 packed-label stream is not ported "
             "(fluid.layers.ssd_loss)")
+    if neg_overlap != 0.5:
+        raise NotImplementedError(
+            "multibox_loss(neg_overlap=...): the mining op has no "
+            "negative-overlap threshold; tune neg_pos_ratio instead "
+            "(fluid.layers.ssd_loss)")
     locs = _inputs(input_loc)
     confs = _inputs(input_conf)
     name = _auto_name("multibox_loss", name)
@@ -1207,6 +1231,10 @@ def nce(input, label, num_classes=None, weight=None, num_neg_samples=10,
         raise NotImplementedError(
             "nce(neg_distribution=...): only the uniform sampler is "
             "ported (fluid.layers.nce)")
+    if weight is not None:
+        raise NotImplementedError(
+            "nce(weight=...): per-example weighting is not ported; "
+            "scale the cost with layer.scaling instead")
     name = _auto_name("nce", name)
     ins = _inputs(input)
     if len(ins) != 1:

@@ -100,3 +100,53 @@ def test_ring_attention_op_dense_path_uses_flash_fallback():
             np.float32)
         l, = exe.run(main, feed={"x": xv}, fetch_list=[loss])
         assert np.isfinite(np.asarray(l)).all()
+
+
+def test_ring_attention_lse_residual_grads_match_generic():
+    """The op-level residual path (LSE wired as an output ->
+    ring_attention_grad runs flash_attention_bwd) must produce the same
+    gradients as the generic-vjp path (no LSE output, forward re-run
+    inside the grad op)."""
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.core.scope import Scope
+
+    B, H, T, D = 2, 2, 16, 8
+    rng = np.random.RandomState(3)
+    feed = {n: rng.randn(B, H, T, D).astype(np.float32) for n in "qkv"}
+
+    def run(with_lse):
+        main, startup = fluid.Program(), fluid.Program()
+        scope = Scope()
+        with fluid.scope_guard(scope), \
+                fluid.program_guard(main, startup), \
+                fluid.unique_name.guard():
+            qv = fluid.layers.data(name="q", shape=[H, T, D],
+                                   dtype="float32")
+            kv = fluid.layers.data(name="k", shape=[H, T, D],
+                                   dtype="float32")
+            vv = fluid.layers.data(name="v", shape=[H, T, D],
+                                   dtype="float32")
+            for var in (qv, kv, vv):
+                var.stop_gradient = False
+            helper = fluid.layer_helper.LayerHelper("ring")
+            att = helper.create_tmp_variable("float32")
+            outputs = {"Out": [att]}
+            if with_lse:
+                lse = helper.create_tmp_variable("float32")
+                lse.stop_gradient = True
+                outputs["LSE"] = [lse]
+            helper.append_op(type="ring_attention",
+                             inputs={"Q": [qv], "K": [kv], "V": [vv]},
+                             outputs=outputs, attrs={"causal": True})
+            loss = fluid.layers.reduce_sum(att)
+            grads = fluid.backward.calc_gradient(loss, [qv, kv, vv])
+            exe = fluid.Executor(fluid.CPUPlace())
+            return exe.run(main, feed=dict(feed),
+                           fetch_list=[g.name for g in grads])
+
+    a = run(with_lse=True)
+    b = run(with_lse=False)
+    for ga, gb, nm in zip(a, b, "qkv"):
+        np.testing.assert_allclose(np.asarray(ga), np.asarray(gb),
+                                   atol=1e-4, rtol=1e-4,
+                                   err_msg="d%s" % nm)

@@ -24,43 +24,48 @@ import jax.numpy as jnp  # noqa: E402
 
 from paddle_tpu.kernels.flash_attention import flash_attention  # noqa: E402
 
-B, H, T, D = 16, 16, 2048, 64
+B, H, T, D = 16, 8, 2048, 128   # the secondary-bench shape
 STEPS = int(sys.argv[1]) if len(sys.argv) > 1 else 12
 
 # causal fwd+bwd analytic useful FLOPs (fwd 4*BHT^2*D, bwd 2.5x, /2 causal)
 FLOPS = 0.5 * (4 + 10) * B * H * T * T * D
 
 
-def bench(dtype, block_q, block_k, force_xla=False):
+def bench(dtype, block_q, block_k, force_xla=False,
+          block_q_bwd=0, block_k_bwd=0):
+    # NO lax.scan: kernels inside a while loop measured ~2x slower than
+    # the identical kernels in the bench's straight-line step (see
+    # PROFILE_r05.md) — unroll over distinct pre-staged inputs instead,
+    # which matches how the model invokes them.
     rng = np.random.RandomState(0)
-    q = jnp.asarray(rng.randn(B, H, T, D), dtype)
-    k = jnp.asarray(rng.randn(B, H, T, D), dtype)
-    v = jnp.asarray(rng.randn(B, H, T, D), dtype)
+    base = [(jnp.asarray(rng.randn(B, H, T, D), dtype),
+             jnp.asarray(rng.randn(B, H, T, D), dtype),
+             jnp.asarray(rng.randn(B, H, T, D), dtype))
+            for _ in range(STEPS)]
+
+    bqb, bkb = (block_q_bwd or None), (block_k_bwd or None)
 
     def loss(q, k, v):
         o = flash_attention(q, k, v, causal=True, block_q=block_q,
-                            block_k=block_k, force_xla=force_xla)
+                            block_k=block_k, force_xla=force_xla,
+                            block_q_bwd=bqb, block_k_bwd=bkb)
         return (o.astype(jnp.float32) ** 2).sum()
 
     grad = jax.grad(loss, argnums=(0, 1, 2))
 
-    def step(carry, _):
-        q, k, v = carry
-        dq, dk, dv = grad(q, k, v)
-        # vary the operands every iteration so nothing memoizes
-        return (q + 1e-3 * dq.astype(q.dtype),
-                k + 1e-3 * dk.astype(k.dtype),
-                v + 1e-3 * dv.astype(v.dtype)), dq[0, 0, 0, 0]
-
     @jax.jit
-    def run(q, k, v):
-        (q, k, v), outs = jax.lax.scan(step, (q, k, v), None, length=STEPS)
-        return outs.sum() + q.sum()
+    def run(ops):
+        acc = 0.0
+        for q, k, v in ops:      # unrolled: STEPS independent fwd+bwd
+            dq, dk, dv = grad(q, k, v)
+            acc = acc + dq[0, 0, 0, 0].astype(jnp.float32) + \
+                dk[0, 0, 0, 0].astype(jnp.float32)
+        return acc
 
-    r = run(q, k, v)
+    r = run(base)
     float(np.asarray(r))              # warm-up + compile, full drain
     t0 = time.time()
-    r = run(q, k, v)
+    r = run(base)
     float(np.asarray(r))              # d2h drain is the only true sync
     dt = (time.time() - t0) / STEPS
     return dt
@@ -71,21 +76,28 @@ def main():
           (B, H, T, D, STEPS))
     print("%-10s %6s %6s %9s %9s" % ("dtype", "bq", "bk", "ms/step",
                                      "TFLOP/s"))
-    configs = []
-    for dt in ("bfloat16", "float32"):
-        for bq, bk in ((1024, 1024), (512, 1024), (512, 512), (256, 1024),
-                       (1024, 512), (2048, 1024), (256, 512), (128, 1024)):
-            configs.append((dt, bq, bk, False))
-    configs.append(("bfloat16", 0, 0, True))   # XLA reference path
-    for dt, bq, bk, force in configs:
+    # (fwd_bq, fwd_bk, bwd_bq, bwd_bk); 0 = the kernel's default cap
+    configs = [
+        (1024, 1024, 0, 0),      # current defaults (bwd capped 512)
+        (1024, 1024, 512, 1024),
+        (1024, 1024, 1024, 512),
+        (1024, 1024, 256, 512),
+        (1024, 1024, 512, 256),
+        (1024, 1024, 256, 1024),
+        (512, 1024, 0, 0),
+        (512, 512, 0, 0),
+        (1024, 2048, 0, 0),
+        (1024, 2048, 512, 2048),
+    ]
+    for bq, bk, bqb, bkb in configs:
         try:
-            sec = bench(jnp.dtype(dt), bq, bk, force)
-            print("%-10s %6d %6d %9.2f %9.1f%s" %
-                  (dt, bq, bk, sec * 1e3, FLOPS / sec / 1e12,
-                   "  (XLA)" if force else ""))
+            sec = bench(jnp.bfloat16, bq, bk, False, bqb, bkb)
+            print("bf16 fwd(%4d,%4d) bwd(%4s,%4s) %9.2f ms  %7.1f TF/s" %
+                  (bq, bk, bqb or "cap", bkb or "cap", sec * 1e3,
+                   FLOPS / sec / 1e12))
         except Exception as exc:  # noqa: BLE001 — tuning survey
-            print("%-10s %6d %6d  FAILED: %s" % (dt, bq, bk,
-                                                 str(exc)[:90]))
+            print("bf16 fwd(%4d,%4d) bwd(%4s,%4s)  FAILED: %s" %
+                  (bq, bk, bqb or "cap", bkb or "cap", str(exc)[:80]))
 
 
 if __name__ == "__main__":
