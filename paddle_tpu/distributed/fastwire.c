@@ -1,0 +1,103 @@
+/* fastwire: raw-socket bulk transfer for the pserver data plane.
+ *
+ * Role parity: reference paddle/pserver/LightNetwork.cpp — the C++
+ * ParameterServer2 moved parameter blocks over raw sockets precisely
+ * because a Python/RPC layer cannot feed large dense models.  This is
+ * the minimal native half: blocking full-length send/recv loops over
+ * TCP (TCP_NODELAY), called through ctypes so the GIL is released for
+ * the whole transfer and shard streams overlap across threads.
+ * Framing stays in Python (distributed/rpc.py _enc_tensor — the same
+ * dtype|shape|bytes frame the gRPC path speaks).
+ *
+ * Build: g++ -O2 -shared -fPIC (distributed/fastwire.py, the
+ * recordio.cc self-build pattern).
+ */
+#include <arpa/inet.h>
+#include <errno.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <string.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+extern "C" {
+
+/* Listen on 127.0.0.1:port (the pserver data plane is host-local or
+ * cluster-internal; binding wildcard is the caller's call via addr). */
+int fw_listen(const char *addr, int port, int backlog) {
+    int fd = socket(AF_INET, SOCK_STREAM, 0);
+    if (fd < 0) return -1;
+    int one = 1;
+    setsockopt(fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+    struct sockaddr_in sa;
+    memset(&sa, 0, sizeof(sa));
+    sa.sin_family = AF_INET;
+    sa.sin_port = htons((unsigned short)port);
+    if (inet_pton(AF_INET, addr, &sa.sin_addr) != 1) { close(fd); return -2; }
+    if (bind(fd, (struct sockaddr *)&sa, sizeof(sa)) != 0) { close(fd); return -3; }
+    if (listen(fd, backlog) != 0) { close(fd); return -4; }
+    return fd;
+}
+
+int fw_accept(int lfd) {
+    for (;;) {
+        int fd = accept(lfd, 0, 0);
+        if (fd >= 0) {
+            int one = 1;
+            setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+            return fd;
+        }
+        if (errno != EINTR) return -1;
+    }
+}
+
+int fw_connect(const char *addr, int port) {
+    int fd = socket(AF_INET, SOCK_STREAM, 0);
+    if (fd < 0) return -1;
+    struct sockaddr_in sa;
+    memset(&sa, 0, sizeof(sa));
+    sa.sin_family = AF_INET;
+    sa.sin_port = htons((unsigned short)port);
+    if (inet_pton(AF_INET, addr, &sa.sin_addr) != 1) { close(fd); return -2; }
+    if (connect(fd, (struct sockaddr *)&sa, sizeof(sa)) != 0) {
+        close(fd);
+        return -3;
+    }
+    int one = 1;
+    setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+    return fd;
+}
+
+/* Send exactly n bytes; returns n or <0 on error. */
+long long fw_send(int fd, const char *buf, long long n) {
+    long long done = 0;
+    while (done < n) {
+        ssize_t w = send(fd, buf + done, (size_t)(n - done), MSG_NOSIGNAL);
+        if (w < 0) {
+            if (errno == EINTR) continue;
+            return -1;
+        }
+        done += w;
+    }
+    return done;
+}
+
+/* Receive exactly n bytes; returns n, 0 on orderly close at a message
+ * boundary (done == 0), or <0 on error / mid-message close. */
+long long fw_recv(int fd, char *buf, long long n) {
+    long long done = 0;
+    while (done < n) {
+        ssize_t r = recv(fd, buf + done, (size_t)(n - done), 0);
+        if (r == 0) return done == 0 ? 0 : -2;
+        if (r < 0) {
+            if (errno == EINTR) continue;
+            return -1;
+        }
+        done += r;
+    }
+    return done;
+}
+
+void fw_close(int fd) { close(fd); }
+
+}  /* extern "C" */

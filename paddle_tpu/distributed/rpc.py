@@ -26,6 +26,11 @@ import numpy as np
 
 SERVICE = "paddle_tpu.PServer"
 
+# fastwire data plane: raw-socket port = grpc port + this offset
+# (0 disables).  Handshake magic keeps a mis-aimed connection safe.
+FASTWIRE_PORT_OFFSET = int(os.environ.get("FLAGS_fastwire_port_offset",
+                                          "2000"))
+
 # gRPC defaults cap messages at 4 MB; one fc shard of a real model is
 # routinely 10-100 MB (the reference moved such blocks over raw sockets,
 # ParameterServer2.h).  Unlimited on both directions.
@@ -130,7 +135,7 @@ def _enc_msg(name, extra=0):
 
 def _dec_msg(data):
     n = int.from_bytes(data[:4], "little")
-    name = data[4:4 + n].decode("utf-8")
+    name = bytes(data[4:4 + n]).decode("utf-8")
     extra = int.from_bytes(data[4 + n:12 + n], "little", signed=True)
     return name, extra
 
@@ -204,14 +209,32 @@ class VariableServer:
 
     # -- lifecycle --
     def start(self, endpoint):
-        """Bind + start; returns the bound port."""
+        """Bind + start; returns the bound port.  Also opens the
+        fastwire raw-socket DATA plane at port+FASTWIRE_PORT_OFFSET
+        (reference pserver/LightNetwork.cpp role): SendVariable /
+        GetVariable bulk frames bypass Python gRPC; control RPCs
+        (barriers, completion, profile) stay here.  Best-effort: no
+        native toolchain or a taken port just means gRPC carries
+        everything, as before."""
         port = self._server.add_insecure_port(endpoint)
         self._server.start()
+        self._fast = None
+        if FASTWIRE_PORT_OFFSET > 0:
+            try:
+                from . import fastwire
+                self._fast = fastwire.FastServer(
+                    port + FASTWIRE_PORT_OFFSET,
+                    {"SendVariable": self._send_variable,
+                     "GetVariable": self._get_variable})
+            except Exception:
+                self._fast = None
         return port
 
     def wait(self):
         """Block until every trainer sent SendComplete."""
         self._shutdown.wait()
+        if getattr(self, "_fast", None) is not None:
+            self._fast.stop()
         self._server.stop(grace=1).wait()
 
     # -- handlers --
@@ -342,16 +365,27 @@ class VariableServer:
         send_recv.proto:76 VariableMessage.profile: the trainer's
         profiler state rides the RPC envelope and switches the
         pserver's profiler).  extra=1 starts, extra=0 stops and writes
-        the table to the named path (default /tmp/pserver_profile)."""
+        the table to the named path.  Idempotent across trainers: with
+        fanin>1 every trainer's toggle reaches the server, so redundant
+        start/stop must be no-ops, and the default path is per-process
+        (a fixed /tmp name would be predictable and cross-server
+        clobbering)."""
         from paddle_tpu.fluid import profiler as prof
 
         path, on = _dec_msg(req)
+        with self._cv:
+            if bool(on) == getattr(self, "_profiling", False):
+                return b""       # redundant toggle from another trainer
+            self._profiling = bool(on)
         if on:
             prof.start_profiler(state="CPU")
         else:
-            prof.stop_profiler(sorted_key="total",
-                               profile_path=path or
-                               "/tmp/pserver_profile")
+            if not path:
+                import tempfile
+                path = os.path.join(
+                    tempfile.mkdtemp(prefix="pserver_prof_"),
+                    "profile")
+            prof.stop_profiler(sorted_key="total", profile_path=path)
         return b""
 
     def _send_complete(self, req):
@@ -441,12 +475,70 @@ class RPCClient:
     def send_var(self, ep, name, arr):
         self._call(ep, "SendVariable", _enc_tensor(name, arr, self.step))
 
+    def _fast_pool(self):
+        pool = getattr(self, "_fastwire_pool", None)
+        if pool is None and FASTWIRE_PORT_OFFSET > 0:
+            from . import fastwire
+            pool = fastwire.FastConnPool(FASTWIRE_PORT_OFFSET)
+            self._fastwire_pool = pool
+        return pool
+
+    def _fast_call(self, ep, method, payload):
+        """One fastwire round-trip, or None when the endpoint has no
+        data plane (gRPC fallback).  A STALE pooled connection (failure
+        before the payload went out) retries once on a fresh one; a
+        failure after the payload was sent must raise — the server may
+        already have applied the frame, and resending (fast or gRPC)
+        would double-apply a non-idempotent gradient."""
+        pool = self._fast_pool()
+        if pool is None:
+            return None
+        for _ in range(2):
+            conn = pool.checkout(ep)
+            if conn is None:
+                return None
+            try:
+                reply = conn.call(method, payload)
+                pool.checkin(ep, conn)
+                return reply
+            except ConnectionError as e:
+                pool.discard(conn)
+                if getattr(e, "sent_payload", True):
+                    raise RuntimeError(
+                        "fastwire connection to %s failed after the "
+                        "frame was sent; cannot safely resend a "
+                        "possibly-applied %s" % (ep, method)) from e
+        return None
+
     def send_vars(self, triples):
         """Overlapped sends: [(ep, name, arr)] in flight together
-        (reference grpc_client AsyncSendVar + Wait)."""
+        (reference grpc_client AsyncSendVar + Wait).  Bulk frames ride
+        the fastwire data plane when the server offers it; the C
+        send loop releases the GIL, so the per-shard threads genuinely
+        overlap."""
+        pool = self._fast_pool()
+        if pool is not None:
+            results = [None] * len(triples)
+
+            def one(i, ep, name, arr):
+                results[i] = self._fast_call(
+                    ep, "SendVariable", _enc_tensor(name, arr, self.step))
+
+            ts = [threading.Thread(target=one, args=(i, ep, nm, ar))
+                  for i, (ep, nm, ar) in enumerate(triples)]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join()
+            rest = [triples[i] for i, r in enumerate(results)
+                    if r is None]
+        else:
+            rest = list(triples)
+        if not rest:
+            return
         futs = [self._stub(ep, "SendVariable").future(
             _enc_tensor(name, arr, self.step), wait_for_ready=True)
-            for ep, name, arr in triples]
+            for ep, name, arr in rest]
         for f in futs:
             f.result()
 
@@ -458,12 +550,32 @@ class RPCClient:
 
     def get_vars(self, pairs, round_=None):
         """Overlapped gets: [(ep, name)] -> [arr], one joined wait
-        (reference AsyncGetVar + Wait)."""
+        (reference AsyncGetVar + Wait); fastwire data plane when
+        offered."""
         round_ = self.step if round_ is None else round_
-        futs = [self._stub(ep, "GetVariable").future(
-            _enc_msg(name, round_), wait_for_ready=True)
-            for ep, name in pairs]
-        return [_dec_tensor(f.result())[1] for f in futs]
+        pool = self._fast_pool()
+        results = [None] * len(pairs)
+        rest_idx = list(range(len(pairs)))
+        if pool is not None:
+            def one(i, ep, name):
+                r = self._fast_call(ep, "GetVariable",
+                                    _enc_msg(name, round_))
+                if r is not None:
+                    results[i] = _dec_tensor(r)[1]
+
+            ts = [threading.Thread(target=one, args=(i, ep, nm))
+                  for i, (ep, nm) in enumerate(pairs)]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join()
+            rest_idx = [i for i in rest_idx if results[i] is None]
+        futs = [(i, self._stub(pairs[i][0], "GetVariable").future(
+            _enc_msg(pairs[i][1], round_), wait_for_ready=True))
+            for i in rest_idx]
+        for i, f in futs:
+            results[i] = _dec_tensor(f.result())[1]
+        return results
 
     def prefetch_vars(self, triples, round_=None):
         """Overlapped row prefetches: [(ep, block_name, local_ids)] ->

@@ -40,6 +40,43 @@ def fetch_var(name, scope=None, return_numpy=True):
     return np.asarray(val) if return_numpy else val
 
 
+_INT32_MAX = 2 ** 31 - 1
+_INT32_MIN = -(2 ** 31)
+
+
+def _guard_int64(name, value):
+    """The int64 feed contract (MIGRATION.md "int64 ids and offsets"):
+    jax runs with 32-bit integers (x64 disabled), so int64 feeds —
+    reference LoD offsets (framework/lod_tensor.h:58) and lookup ids —
+    are narrowed to int32 AT THIS BOUNDARY, loudly when they don't fit.
+    Without the explicit check an out-of-range id would silently wrap.
+    """
+    from paddle_tpu.core.lod import LoDTensor
+
+    data = value.data if isinstance(value, LoDTensor) else value
+    arr = np.asarray(data) if not hasattr(data, "dtype") else data
+    # host arrays only: a device-resident feed (DeviceLoader path) was
+    # already admitted once, and np.max on it would force a d2h sync
+    # in the hot loop
+    if isinstance(arr, np.ndarray) and \
+            np.issubdtype(arr.dtype, np.integer) and \
+            arr.dtype.itemsize == 8 and arr.size:
+        amax = int(np.max(arr))
+        amin = int(np.min(arr))
+        if amax > _INT32_MAX or amin < _INT32_MIN:
+            raise ValueError(
+                "feed %r: int64 value out of the int32 range "
+                "([%d, %d] vs [-2^31, 2^31-1]); the TPU runtime "
+                "narrows integer feeds to 32 bits — re-index ids/"
+                "offsets below 2^31 (see MIGRATION.md 'int64 ids and "
+                "offsets')" % (name, amin, amax))
+        narrowed = np.asarray(arr, dtype=np.int32)
+        if isinstance(value, LoDTensor):
+            return LoDTensor(narrowed, value.lod)
+        return narrowed
+    return value
+
+
 class Executor:
     def __init__(self, place=None):
         self.place = place if place is not None else CPUPlace()
@@ -60,7 +97,7 @@ class Executor:
         for k, v in feed.items():
             if isinstance(v, Variable):
                 raise TypeError("feed values must be arrays, got Variable")
-            feed_np[k] = v
+            feed_np[k] = _guard_int64(k, v)
         mode = "test" if getattr(program, "_is_test", False) else "train"
         return self._core.run(program.desc, scope, 0, feed_np, names,
                               mode=mode, return_numpy=return_numpy)

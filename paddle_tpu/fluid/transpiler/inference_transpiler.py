@@ -1,7 +1,9 @@
 """Inference transpiler: program-rewriting analysis passes for LOADED
-inference programs.
+inference programs, expressed on the shared pass framework
+(``pass_framework.py`` — the reference's DataFlowGraph/subgraph-splitter
+role, `inference/analysis/data_flow_graph.cc`).
 
-1. ``fuse_batch_norm`` (reference
+1. ``BatchNormFoldPass`` (reference
    python/paddle/fluid/transpiler/inference_transpiler.py): a conv2d
    (+ optional elementwise_add bias) followed by a test-mode batch_norm
    is an affine function of the conv output — fold into the conv's
@@ -11,15 +13,17 @@ inference programs.
        W' = W * scale_f (per output channel)
        b' = (b - mean) * scale_f + bias
 
-2. ``fuse_attention``: pattern-match a plain
+2. ``AttentionFusePass``: pattern-match a plain
    matmul(transpose_y) -> [scale] -> softmax -> matmul chain and
    rewrite it to ONE ``ring_attention`` op, so models saved from the
    plain front-end get the Pallas flash-attention kernel (and the
-   sequence-parallel ring under a mesh) when served.  This is the
-   subgraph->engine role of the reference's inference analysis
-   framework (inference/analysis/subgraph_splitter.cc feeding
-   tensorrt/convert): detect a fusable subgraph in a LOADED program,
-   replace it with the engine op.
+   sequence-parallel ring under a mesh) when served.
+
+3. ``LayerNormFusePass``: the canonical composed layer-norm chain
+   (reduce_mean -> sub -> square -> reduce_mean -> +eps -> sqrt ->
+   div) collapses to ONE ``layer_norm`` op — the third pass, written
+   to prove a new pass is a pattern matcher on the shared DefUse
+   graph, not another copy of the indexing.
 
 On TPU XLA already fuses the bn arithmetic into adjacent kernels, so
 pass 1's throughput win is smaller than the reference's cudnn case —
@@ -30,145 +34,22 @@ from __future__ import annotations
 
 import numpy as np
 
-__all__ = ["InferenceTranspiler"]
+from .pass_framework import DefUse, PassManager, ProgramPass
+
+__all__ = ["InferenceTranspiler", "BatchNormFoldPass",
+           "AttentionFusePass", "LayerNormFusePass"]
 
 
-class InferenceTranspiler:
-    def transpile(self, program, place=None, scope=None):
-        """Run every analysis pass in-place: BN fold, then attention
-        fusion.  ``scope`` holds the parameters to rewrite (defaults to
-        the global scope)."""
-        self.fuse_batch_norm(program, place, scope)
-        self.fuse_attention(program)
-        return program
+class BatchNormFoldPass(ProgramPass):
+    name = "bn_fold"
 
-    def fuse_attention(self, program):
-        """matmul(QK^T) -> [scale] -> softmax -> matmul(.V)  =>  one
-        ring_attention op (flash kernel / ring under a mesh).
-
-        Match conditions (semantics-preserving only):
-        - first matmul: transpose_Y, 4-D [B,H,T,D] operands;
-        - optional scale op (bias 0) or matmul alpha != 1 between the
-          matmuls: folded into the ring_attention ``scale`` attr;
-        - softmax directly on the (scaled) scores — an arbitrary mask
-          add is NOT fused (the flash kernel only knows causal);
-        - every intermediate is consumed exactly once (else the scores
-          are observed elsewhere and must stay materialized).
-        """
+    def run(self, program, scope, du):
         from paddle_tpu.core.desc import OpDesc
 
-        block = program.desc.blocks[0]
-        ops = block.ops
-
-        def build_index():
-            """name -> [(block_idx, op_idx)] over EVERY block: a chain
-            intermediate read by a while/cond sub-block must count as an
-            extra consumer (fusing would delete its producer)."""
-            idx = {}
-            for bi, b in enumerate(program.desc.blocks):
-                for oi, o in enumerate(b.ops):
-                    for n in o.input_arg_names():
-                        if n:
-                            idx.setdefault(n, []).append((bi, oi))
-            return idx
-
-        index = build_index()
-
-        def consumers(name, start):
-            """Block-0 consumers of ``name`` at index >= start, or None
-            when a sub-block also reads it (never fusable — deleting
-            the producer would strand the sub-block reader)."""
-            locs = index.get(name, [])
-            if any(bi != 0 for bi, _ in locs):
-                return None
-            return [(oi, ops[oi]) for _, oi in locs if oi >= start]
-
-        def rank(name):
-            vd = block.vars.get(name)
-            return len(vd.shape) if vd is not None and vd.shape else 0
-
-        i = 0
-        fused = 0
-        while i < len(ops):
-            m1 = ops[i]
-            if m1.type != "matmul" or \
-                    not m1.attr("transpose_Y", False) or \
-                    m1.attr("transpose_X", False):
-                i += 1
-                continue
-            q_name, k_name = m1.input("X")[0], m1.input("Y")[0]
-            if rank(q_name) != 4 or rank(k_name) != 4:
-                i += 1
-                continue
-            scale = float(m1.attr("alpha", 1.0))
-            cur = m1.output("Out")[0]
-            chain = [i]
-            chain_outs = {cur}
-            cons = consumers(cur, i + 1)
-            if cons is not None and len(cons) == 1 \
-                    and cons[0][1].type == "scale":
-                j, s_op = cons[0]
-                if float(s_op.attr("bias", 0.0)) != 0.0:
-                    i += 1
-                    continue
-                scale *= float(s_op.attr("scale", 1.0))
-                cur = s_op.output("Out")[0]
-                chain.append(j)
-                chain_outs.add(cur)
-                cons = consumers(cur, j + 1)
-            if cons is None or len(cons) != 1 \
-                    or cons[0][1].type != "softmax":
-                i += 1
-                continue
-            j, sm = cons[0]
-            cur = sm.output("Out")[0]
-            chain.append(j)
-            chain_outs.add(cur)
-            cons = consumers(cur, j + 1)
-            if cons is None or len(cons) != 1 \
-                    or cons[0][1].type != "matmul":
-                i += 1
-                continue
-            j, m2 = cons[0]
-            if m2.input("X")[0] != cur or \
-                    m2.attr("transpose_X", False) or \
-                    m2.attr("transpose_Y", False) or \
-                    float(m2.attr("alpha", 1.0)) != 1.0:
-                i += 1
-                continue
-            v_name = m2.input("Y")[0]
-            # V must come from OUTSIDE the chain: matmul(attn, attn)
-            # would fuse away its own V producer
-            if rank(v_name) != 4 or v_name in chain_outs:
-                i += 1
-                continue
-            chain.append(j)
-            ring = OpDesc(
-                "ring_attention",
-                inputs={"Q": [q_name], "K": [k_name], "V": [v_name]},
-                outputs={"Out": [m2.output("Out")[0]]},
-                attrs={"causal": False, "scale": float(scale)})
-            # replace the first op of the chain, delete the rest
-            ops[chain[0]] = ring
-            for j in sorted(chain[1:], reverse=True):
-                del ops[j]
-            fused += 1
-            index = build_index()  # op indices shifted
-            i = chain[0] + 1
-        if fused:
-            program.desc.bump_version()
-        return fused
-
-    def fuse_batch_norm(self, program, place=None, scope=None):
-        """Fold conv2d -> (elementwise_add) -> batch_norm(is_test) chains
-        in-place.  ``scope`` holds the parameters to rewrite (defaults to
-        the global scope)."""
-        from ..executor import global_scope
-
-        scope = scope or global_scope()
-        block = program.desc.blocks[0]
+        block = du.block(0)
         ops = block.ops
         i = 0
+        folded = 0
         while i < len(ops):
             op = ops[i]
             if op.type != "conv2d":
@@ -236,12 +117,260 @@ class InferenceTranspiler:
                 b_name = bn.inputs["Bias"][0]
                 scope.set(b_name, ((-mean) * factor + bias).astype(
                     np.float32).reshape(1, -1, 1, 1))
-                from paddle_tpu.core.desc import OpDesc
                 # bias value reshaped to [1,C,1,1] -> plain broadcast add
                 ops[j] = OpDesc(
                     "elementwise_add",
                     inputs={"X": [conv_out], "Y": [b_name]},
                     outputs={"Out": [bn.outputs["Y"][0]]})
-            program.desc.bump_version()
+            folded += 1
+            du.rebuild()
             i = j
+        return folded
+
+
+class AttentionFusePass(ProgramPass):
+    """matmul(QK^T) -> [scale] -> softmax -> matmul(.V)  =>  one
+    ring_attention op (flash kernel / ring under a mesh).
+
+    Match conditions (semantics-preserving only):
+    - first matmul: transpose_Y, 4-D [B,H,T,D] operands;
+    - optional scale op (bias 0) or matmul alpha != 1 between the
+      matmuls: folded into the ring_attention ``scale`` attr;
+    - softmax directly on the (scaled) scores — an arbitrary mask
+      add is NOT fused (the flash kernel only knows causal);
+    - every intermediate is consumed exactly once (else the scores
+      are observed elsewhere and must stay materialized), is not
+      persistable, and is not read by any sub-block.
+    """
+
+    name = "attention_fuse"
+
+    def run(self, program, scope, du):
+        from paddle_tpu.core.desc import OpDesc
+
+        block = du.block(0)
+        ops = block.ops
+        i = 0
+        fused = 0
+        while i < len(ops):
+            m1 = ops[i]
+            if m1.type != "matmul" or \
+                    not m1.attr("transpose_Y", False) or \
+                    m1.attr("transpose_X", False):
+                i += 1
+                continue
+            q_name, k_name = m1.input("X")[0], m1.input("Y")[0]
+            if du.rank(q_name) != 4 or du.rank(k_name) != 4:
+                i += 1
+                continue
+            scale = float(m1.attr("alpha", 1.0))
+            cur = m1.output("Out")[0]
+            chain = [i]
+            chain_outs = {cur}
+            nxt = du.sole_consumer(cur, start=i + 1)
+            if nxt is not None and nxt[1].type == "scale":
+                j, s_op = nxt
+                if float(s_op.attr("bias", 0.0)) != 0.0:
+                    i += 1
+                    continue
+                scale *= float(s_op.attr("scale", 1.0))
+                cur = s_op.output("Out")[0]
+                chain.append(j)
+                chain_outs.add(cur)
+                nxt = du.sole_consumer(cur, start=j + 1)
+            if nxt is None or nxt[1].type != "softmax":
+                i += 1
+                continue
+            j, sm = nxt
+            cur = sm.output("Out")[0]
+            chain.append(j)
+            chain_outs.add(cur)
+            nxt = du.sole_consumer(cur, start=j + 1, op_type="matmul")
+            if nxt is None:
+                i += 1
+                continue
+            j, m2 = nxt
+            if m2.input("X")[0] != cur or \
+                    m2.attr("transpose_X", False) or \
+                    m2.attr("transpose_Y", False) or \
+                    float(m2.attr("alpha", 1.0)) != 1.0:
+                i += 1
+                continue
+            v_name = m2.input("Y")[0]
+            # V must come from OUTSIDE the chain: matmul(attn, attn)
+            # would fuse away its own V producer
+            if du.rank(v_name) != 4 or v_name in chain_outs:
+                i += 1
+                continue
+            chain.append(j)
+            # a persistable intermediate (or one a caller may fetch by
+            # name) must survive: fusing would pass program validation
+            # but never compute it — skip the chain instead
+            if any(du.persistable(n) for n in chain_outs):
+                i += 1
+                continue
+            ring = OpDesc(
+                "ring_attention",
+                inputs={"Q": [q_name], "K": [k_name], "V": [v_name]},
+                outputs={"Out": [m2.output("Out")[0]]},
+                attrs={"causal": False, "scale": float(scale)})
+            # replace the first op of the chain, delete the rest
+            ops[chain[0]] = ring
+            for j in sorted(chain[1:], reverse=True):
+                del ops[j]
+            du.drop_dead_vars(chain_outs, keep=[m2.output("Out")[0]])
+            fused += 1
+            du.rebuild()   # op indices shifted
+            i = chain[0] + 1
+        return fused
+
+
+class LayerNormFusePass(ProgramPass):
+    """Composed layer norm -> one ``layer_norm`` op.
+
+    Canonical chain over the LAST axis, as written with fluid
+    primitives (each intermediate single-consumer, non-persistable):
+
+        m   = reduce_mean(x, dim=[-1], keep_dim=True)
+        d   = elementwise_sub(x, m)
+        sq  = square(d) | elementwise_mul(d, d)
+        v   = reduce_mean(sq, dim=[-1], keep_dim=True)
+        ve  = scale(v, scale=1.0, bias=eps)
+        std = sqrt(ve)
+        y   = elementwise_div(d, std)
+
+    Rewrites to layer_norm(begin_norm_axis=ndim-1, epsilon=eps); the
+    op's Mean/Variance aux outputs get fresh var descs.
+    """
+
+    name = "layer_norm_fuse"
+
+    def _last_axis_mean(self, op, du, x_name):
+        dims = op.attr("dim", None) or []
+        nd = du.rank(x_name)
+        return (op.attr("keep_dim", False) and len(dims) == 1
+                and int(dims[0]) in (nd - 1, -1))
+
+    def run(self, program, scope, du):
+        from paddle_tpu.core.desc import OpDesc
+        from paddle_tpu.core.types import np_dtype_to_proto
+
+        block = du.block(0)
+        ops = block.ops
+        i = 0
+        fused = 0
+        while i < len(ops):
+            mean_op = ops[i]
+            if mean_op.type != "reduce_mean":
+                i += 1
+                continue
+            x_name = mean_op.input("X")[0]
+            if not self._last_axis_mean(mean_op, du, x_name):
+                i += 1
+                continue
+            m_out = mean_op.output("Out")[0]
+            sub_loc = du.sole_consumer(m_out, start=i + 1,
+                                       op_type="elementwise_sub")
+            if sub_loc is None or sub_loc[1].input("X")[0] != x_name:
+                i += 1
+                continue
+            j_sub, sub = sub_loc
+            d_out = sub.output("Out")[0]
+            # d feeds the square AND the final div: exactly two reads
+            d_cons = du.consumers(d_out, start=j_sub + 1)
+            if d_cons is None or len(d_cons) != 2:
+                i += 1
+                continue
+            sq_loc = next(((j, o) for j, o in d_cons
+                           if o.type == "square"
+                           or (o.type == "elementwise_mul"
+                               and o.input("X")[0] == d_out
+                               and o.input("Y")[0] == d_out)), None)
+            div_loc = next(((j, o) for j, o in d_cons
+                            if o.type == "elementwise_div"
+                            and o.input("X")[0] == d_out), None)
+            if sq_loc is None or div_loc is None:
+                i += 1
+                continue
+            j_sq, sq = sq_loc
+            j_div, div = div_loc
+            var_loc = du.sole_consumer(sq.output("Out")[0],
+                                       start=j_sq + 1,
+                                       op_type="reduce_mean")
+            if var_loc is None or not self._last_axis_mean(
+                    var_loc[1], du, sq.output("Out")[0]):
+                i += 1
+                continue
+            j_var, var_op = var_loc
+            eps_loc = du.sole_consumer(var_op.output("Out")[0],
+                                       start=j_var + 1, op_type="scale")
+            if eps_loc is None or \
+                    float(eps_loc[1].attr("scale", 1.0)) != 1.0:
+                i += 1
+                continue
+            j_eps, eps_op = eps_loc
+            eps = float(eps_op.attr("bias", 0.0))
+            sqrt_loc = du.sole_consumer(eps_op.output("Out")[0],
+                                        start=j_eps + 1, op_type="sqrt")
+            if sqrt_loc is None:
+                i += 1
+                continue
+            j_sqrt, sqrt_op = sqrt_loc
+            if div.input("Y")[0] != sqrt_op.output("Out")[0]:
+                i += 1
+                continue
+            chain = [i, j_sub, j_sq, j_var, j_eps, j_sqrt, j_div]
+            y_name = div.output("Out")[0]
+            inter = {ops[j].output("Out")[0] for j in chain[:-1]}
+            if any(du.persistable(n) for n in inter):
+                i += 1
+                continue
+            nd = du.rank(x_name)
+            xshape = du.shape(x_name)
+            dtype = block.vars[x_name].dtype if x_name in block.vars \
+                else np_dtype_to_proto("float32")
+            aux_shape = tuple(xshape[:-1]) + (1,)
+            mean_v = y_name + "@ln_mean"
+            var_v = y_name + "@ln_var"
+            for nm in (mean_v, var_v):
+                if nm not in block.vars:
+                    vd0 = block.vars[y_name]
+                    block.vars[nm] = type(vd0)(
+                        nm, vd0.kind, dtype, aux_shape)
+                    block.vars[nm].stop_gradient = True
+            ln = OpDesc(
+                "layer_norm", inputs={"X": [x_name]},
+                outputs={"Y": [y_name], "Mean": [mean_v],
+                         "Variance": [var_v]},
+                attrs={"begin_norm_axis": nd - 1, "epsilon": eps})
+            ops[chain[0]] = ln
+            for j in sorted(chain[1:], reverse=True):
+                del ops[j]
+            du.drop_dead_vars(inter, keep=[y_name])
+            fused += 1
+            du.rebuild()
+            i = chain[0] + 1
+        return fused
+
+
+class InferenceTranspiler:
+    """Public API (source-compatible with rounds 2-4): runs the pass
+    list through the PassManager."""
+
+    def transpile(self, program, place=None, scope=None):
+        """Run every analysis pass in-place to fixpoint."""
+        PassManager([BatchNormFoldPass(), AttentionFusePass(),
+                     LayerNormFusePass()]).run(program, scope)
         return program
+
+    def fuse_batch_norm(self, program, place=None, scope=None):
+        PassManager([BatchNormFoldPass()]).run(program, scope)
+        return program
+
+    def fuse_attention(self, program, scope=None):
+        counts = PassManager([AttentionFusePass()]).run(program, scope)
+        return counts.get("attention_fuse", 0)
+
+    def fuse_layer_norm(self, program, scope=None):
+        counts = PassManager([LayerNormFusePass()]).run(program, scope)
+        return counts.get("layer_norm_fuse", 0)

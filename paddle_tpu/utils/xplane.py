@@ -322,7 +322,7 @@ def print_category_profile(path, top=12, **kwargs):
 
 
 def kernel_profile(path, name_re=r".", plane_re=r"/device:",
-                   line_name="XLA Ops"):
+                   line_name="XLA Ops", _all_rows=None):
     """Per-KERNEL rows (not categories) for ops matching ``name_re`` —
     the attribution ``category_profile`` cannot give for custom-calls:
     XLA's flop counter is blank inside them (Pallas kernels), so their

@@ -9,11 +9,12 @@ dense_vector(d)             float32 [d]
 integer_value(r)            int64   [1]          (class id in [0, r))
 dense_vector_sequence(d)    float32 [d], lod 1   (ragged over time)
 integer_value_sequence(r)   int64   [1], lod 1
-sparse_binary_vector(d)     float32 [d]  (fed as index list, densified
-                            host-side — SelectedRows covers the sparse
-                            *parameter* path, the input stays dense for
-                            the MXU)
-sparse_float_vector(d)      float32 [d]  ((index, value) pairs)
+sparse_binary_vector(d)     int64 [1], lod 1  (ragged nonzero-index
+                            list; layer.fc consumes it through the
+                            lookup_table/sequence_pool path — the
+                            dense [d] vector never materializes)
+sparse_float_vector(d)      float32 [2], lod 1  ((index, value) pairs,
+                            same lookup path with value weighting)
 ==========================  ==========================================
 """
 from __future__ import annotations
@@ -50,43 +51,63 @@ class InputType:
 
     # -- topology-facing ---------------------------------------------
     @property
+    def is_sparse(self):
+        return self.type in (DataType.SparseNonValue, DataType.SparseValue)
+
+    @property
     def lod_level(self):
-        return {SequenceType.NO_SEQUENCE: 0,
+        base = {SequenceType.NO_SEQUENCE: 0,
                 SequenceType.SEQUENCE: 1,
                 SequenceType.SUB_SEQUENCE: 2}[self.seq_type]
+        if self.is_sparse:
+            # sparse columns travel as a ragged index (or index,value)
+            # LIST per sample — one LoD level over the nonzeros; the
+            # dense [dim] vector is never materialized
+            # (reference parameter/Argument.h sparse rows)
+            if base:
+                raise NotImplementedError(
+                    "sparse_*_vector_sequence needs a level-2 sparse "
+                    "feed; flatten to one level or use the fluid "
+                    "lookup_table path directly")
+            return 1
+        return base
 
     @property
     def dtype(self):
-        return "int64" if self.type == DataType.Index else "float32"
+        if self.type == DataType.Index:
+            return "int64"
+        if self.type == DataType.SparseNonValue:
+            return "int64"
+        return "float32"
 
     @property
     def shape(self):
-        return [1] if self.type == DataType.Index else [self.dim]
+        if self.type == DataType.Index:
+            return [1]
+        if self.type == DataType.SparseNonValue:
+            return [1]          # index per nonzero
+        if self.type == DataType.SparseValue:
+            return [2]          # (index, value) per nonzero
+        return [self.dim]
 
     # -- feeder-facing -----------------------------------------------
     def convert_column(self, value):
         """One sample's column -> the array the fluid DataFeeder
         expects (sequences stay nested lists; the feeder builds LoD)."""
+        if self.type == DataType.SparseNonValue:
+            # ragged index list, never densified
+            return [[int(v)] for v in value]
+        if self.type == DataType.SparseValue:
+            return [[float(i), float(v)] for i, v in value]
         if self.seq_type != SequenceType.NO_SEQUENCE:
             if self.type == DataType.Index:
                 return [[int(v)] for v in value]
-            if self.type == DataType.Dense:
-                return [np.asarray(v, np.float32) for v in value]
-            return [self._densify(v) for v in value]
+            return [np.asarray(v, np.float32) for v in value]
         if self.type == DataType.Index:
             return [int(value)]
-        if self.type == DataType.Dense:
-            return np.asarray(value, np.float32)
-        return self._densify(value)
+        return np.asarray(value, np.float32)
 
-    def _densify(self, value):
-        out = np.zeros(self.dim, np.float32)
-        if self.type == DataType.SparseNonValue:
-            out[np.asarray(list(value), np.int64)] = 1.0
-        else:  # SparseValue: iterable of (index, value)
-            for i, v in value:
-                out[int(i)] = float(v)
-        return out
+
 
 
 def dense_vector(dim, seq_type=SequenceType.NO_SEQUENCE):

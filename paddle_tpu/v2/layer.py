@@ -91,7 +91,15 @@ def data(name, type, height=None, width=None, **kwargs):
             name=name, shape=type.shape, dtype=type.dtype,
             lod_level=type.lod_level)
 
-    return Layer(name, build, inputs=(), data_type=type, size=type.dim)
+    out = Layer(name, build, inputs=(), data_type=type, size=type.dim)
+    # sparse columns feed as ragged index lists; consumers (fc) route
+    # them through lookup_table + sequence_pool instead of a dense
+    # matmul (reference Argument.h sparse rows; SelectedRows carries
+    # the parameter side)
+    out.is_sparse_input = getattr(type, "is_sparse", False)
+    out.sparse_has_values = (out.is_sparse_input
+                             and type.shape == [2])
+    return out
 
 
 # ------------------------------------------------------------------ fc
@@ -100,14 +108,67 @@ def fc(input, size, act=None, name=None, param_attr=None, bias_attr=None,
     name = _auto_name("fc_layer", name)
     ins = _inputs(input)
     fluid_act = v2_act.to_fluid_act(act)
+    # multiple inputs need one weight EACH: accept a per-input attr
+    # list (the reference contract); a single NAMED attr would alias
+    # differently-sized weights, so it must fail loudly
+    if isinstance(param_attr, (list, tuple)):
+        if len(param_attr) != len(ins):
+            raise ValueError(
+                "fc %r: param_attr list of %d for %d inputs"
+                % (name, len(param_attr), len(ins)))
+        per_input = list(param_attr)
+    else:
+        single = to_param_attr(param_attr)
+        if len(ins) > 1 and single is not None and single.name:
+            raise ValueError(
+                "fc %r: a NAMED param_attr with %d inputs would alias "
+                "every input's weight; pass a list of param_attr (one "
+                "per input)" % (name, len(ins)))
+        per_input = [param_attr] * len(ins)
+
+    def _sparse_part(ctx, layer_in, x, pa):
+        """fc over a sparse input == sum over the sample's nonzeros of
+        the weight rows (times the value): lookup_table into the SAME
+        [in_dim, size] weight the dense path would train, then a
+        sequence SUM — the dense [N, in_dim] matrix never exists."""
+        L = ctx.fluid.layers
+        if getattr(layer_in, "sparse_has_values", False):
+            ids = L.cast(L.slice_op(x, axes=[2], starts=[0], ends=[1]),
+                         "int64")
+            vals = L.slice_op(x, axes=[2], starts=[1], ends=[2])
+        else:
+            ids, vals = x, None
+        rows = L.embedding(ids, size=[layer_in.size, size],
+                           param_attr=pa)
+        if vals is not None:
+            rows = L.elementwise_mul(rows, vals)
+        return L.sequence_pool(rows, pool_type="SUM")
 
     def build(ctx, *xs):
-        pas = [_layer_param_attr(name, param_attr, "w%d" % i)
-               for i in range(len(xs))]
-        return ctx.fluid.layers.fc(
-            list(xs), size=size, act=fluid_act,
-            param_attr=pas if len(pas) > 1 else pas[0],
-            bias_attr=_bias_attr(name, bias_attr), name=name)
+        pas = [_layer_param_attr(name, pa, "w%d" % i)
+               for i, pa in enumerate(per_input)]
+        if not any(getattr(li, "is_sparse_input", False) for li in ins):
+            return ctx.fluid.layers.fc(
+                list(xs), size=size, act=fluid_act,
+                param_attr=pas if len(pas) > 1 else pas[0],
+                bias_attr=_bias_attr(name, bias_attr), name=name)
+        L = ctx.fluid.layers
+        parts = []
+        for li, x, pa in zip(ins, xs, pas):
+            if getattr(li, "is_sparse_input", False):
+                parts.append(_sparse_part(ctx, li, x, pa))
+            else:
+                parts.append(L.fc(x, size=size, bias_attr=False,
+                                  param_attr=pa))
+        out = parts[0] if len(parts) == 1 else L.sums(parts)
+        ba = _bias_attr(name, bias_attr)
+        if ba is not False:
+            b = L.create_parameter(shape=[size], dtype="float32",
+                                   is_bias=True, attr=ba)
+            out = L.elementwise_add(out, b)
+        if fluid_act:
+            out = getattr(L, fluid_act)(out)
+        return out
 
     return Layer(name, build, inputs=ins, size=size)
 
@@ -153,7 +214,11 @@ def img_conv(input, filter_size, num_filters, num_channels=None, stride=1,
     name = _auto_name("conv", name)
     ins = _inputs(input)
     src = ins[0]
-    nc = num_channels if num_channels is not None else 1
+    # inherit the channel count from the producing layer (img_pool
+    # does the same); a 2-D value downstream of a multi-channel layer
+    # must not silently reshape with C=1
+    nc = (num_channels if num_channels is not None
+          else getattr(src, "num_channels", None) or 1)
     # reference img_conv_layer defaults padding=0 — keep output shapes
     # (and parameter tars) compatible with migrated scripts
     pad = padding if padding is not None else 0

@@ -1,0 +1,73 @@
+"""fastwire data plane (reference pserver/LightNetwork.cpp role).
+
+The dist-train suite exercises it end-to-end through real transpiled
+programs; these tests pin the transport contract in isolation:
+frame round-trip, handshake rejection of foreign listeners (the gRPC
+fallback trigger), and connection-pool reuse.
+"""
+import socket
+import threading
+
+import numpy as np
+import pytest
+
+from paddle_tpu.distributed import fastwire
+from paddle_tpu.distributed.rpc import _dec_tensor, _enc_tensor
+
+
+@pytest.mark.skipif(not fastwire.native_available(),
+                    reason="no native toolchain")
+def test_fastwire_echo_roundtrip_and_pool_reuse():
+    arr = np.random.RandomState(0).randn(64, 33).astype(np.float32)
+
+    def echo(req):
+        name, a, extra = _dec_tensor(req)
+        return _enc_tensor(name, np.asarray(a) * 2.0, extra)
+
+    srv = fastwire.FastServer(39251, {"SendVariable": echo,
+                                      "GetVariable": echo})
+    try:
+        pool = fastwire.FastConnPool(0)
+        conn = pool.checkout("127.0.0.1:39251")
+        assert conn is not None
+        for _ in range(3):
+            reply = conn.call("SendVariable", _enc_tensor("w", arr, 7))
+            name, back, extra = _dec_tensor(reply)
+            assert name == "w" and extra == 7
+            np.testing.assert_allclose(np.asarray(back), arr * 2.0)
+        pool.checkin("127.0.0.1:39251", conn)
+        # reuse: the same connection comes back
+        again = pool.checkout("127.0.0.1:39251")
+        assert again is conn
+        pool.discard(again)
+    finally:
+        srv.stop()
+
+
+@pytest.mark.skipif(not fastwire.native_available(),
+                    reason="no native toolchain")
+def test_fastwire_foreign_listener_marks_endpoint_dead():
+    """A non-fastwire listener (e.g. another pserver's gRPC port) must
+    fail the magic handshake -> checkout returns None and the endpoint
+    is never retried (the caller stays on gRPC)."""
+    lsock = socket.socket()
+    lsock.bind(("127.0.0.1", 39261))
+    lsock.listen(1)
+    got = []
+
+    def accept_once():
+        c, _ = lsock.accept()
+        got.append(c.recv(16))   # swallow the magic, answer garbage
+        c.sendall(b"HTTP/1.1 400\r\n\r\n")
+        c.close()
+
+    t = threading.Thread(target=accept_once, daemon=True)
+    t.start()
+    try:
+        pool = fastwire.FastConnPool(0)
+        assert pool.checkout("127.0.0.1:39261") is None
+        # dead-marked: no second connection attempt
+        assert pool.checkout("127.0.0.1:39261") is None
+        assert "127.0.0.1:39261" in pool._dead
+    finally:
+        lsock.close()

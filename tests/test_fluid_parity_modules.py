@@ -195,3 +195,34 @@ def test_long_tail_datasets():
     # image utils: full transform pipeline
     chw = dataset.image.simple_transform(img, 64, 48, is_train=True)
     assert chw.shape == (3, 48, 48) and chw.dtype == np.float32
+
+
+def test_generated_layers_track_registry():
+    """fluid.layers.ops generates a front-end name for EVERY registered
+    pure X->Out op (reference layer_function_generator.py role): no op
+    with that signature may lack a layer function."""
+    import numpy as np
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.fluid.layers import ops as lops
+
+    for op in lops.unary_op_types():
+        assert hasattr(fluid.layers, op), op
+    # spot-check newly generated names end-to-end
+    from paddle_tpu.core.scope import Scope
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.scope_guard(Scope()), \
+            fluid.program_guard(main, startup), \
+            fluid.unique_name.guard():
+        x = fluid.layers.data(name="gx", shape=[4], dtype="float32")
+        outs = [fluid.layers.l1_norm(x),
+                fluid.layers.squared_l2_norm(x),
+                fluid.layers.fill_zeros_like(x),
+                fluid.layers.log_softmax(x),
+                fluid.layers.arg_max(x, axis=1)]
+        exe = fluid.Executor(fluid.CPUPlace())
+        xv = np.asarray([[1.0, -2.0, 3.0, -4.0]], np.float32)
+        rs = exe.run(main, feed={"gx": xv}, fetch_list=outs)
+    np.testing.assert_allclose(float(np.ravel(rs[0])[0]), 10.0, atol=1e-5)
+    np.testing.assert_allclose(float(np.ravel(rs[1])[0]), 30.0, atol=1e-4)
+    np.testing.assert_allclose(np.asarray(rs[2]), np.zeros((1, 4)))
+    assert int(np.ravel(rs[4])[0]) == 2

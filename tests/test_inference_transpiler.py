@@ -215,3 +215,77 @@ def test_attention_fuse_rejects_self_attention_v():
         n = fluid.transpiler.InferenceTranspiler().fuse_attention(main)
         assert n == 0
         assert _count_ops(main, "softmax") == 1
+
+
+def test_layer_norm_fuse_pass_output_equality(prog_scope, exe):
+    """Third pass on the shared framework: the composed LN chain
+    collapses to one layer_norm op with identical outputs."""
+    main, startup, scope = prog_scope
+    x = layers.data(name="ln_x", shape=[6], dtype="float32")
+    m = layers.reduce_mean(x, dim=[1], keep_dim=True)
+    d = layers.elementwise_sub(x, m)
+    sq = layers.square(d)
+    v = layers.reduce_mean(sq, dim=[1], keep_dim=True)
+    ve = layers.scale(v, scale=1.0, bias=1e-5)
+    std = layers.sqrt(ve)
+    y = layers.elementwise_div(d, std)
+    exe.run(startup)
+    rng = np.random.RandomState(0)
+    xv = rng.randn(4, 6).astype(np.float32)
+    ref, = exe.run(main, feed={"ln_x": xv}, fetch_list=[y])
+
+    infer = main.clone(for_test=True)
+    t = fluid.transpiler.InferenceTranspiler()
+    n = t.fuse_layer_norm(infer, scope=scope)
+    assert n == 1
+    types = [op.type for op in infer.desc.blocks[0].ops]
+    assert "layer_norm" in types
+    assert "elementwise_div" not in types
+    got, = exe.run(infer, feed={"ln_x": xv}, fetch_list=[y.name])
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               atol=1e-5, rtol=1e-5)
+
+
+def test_attention_fuse_skips_persistable_intermediate(prog_scope, exe):
+    """ADVICE r4: a persistable chain intermediate must block the
+    fusion (a serving caller may fetch it by name)."""
+    import paddle_tpu.fluid as fl
+    main, startup, scope = prog_scope
+    q = layers.data(name="pq", shape=[2, 4, 3], dtype="float32")
+    k = layers.data(name="pk", shape=[2, 4, 3], dtype="float32")
+    v = layers.data(name="pv", shape=[2, 4, 3], dtype="float32")
+    s = layers.matmul(q, k, transpose_y=True, alpha=0.5)
+    p = layers.softmax(s)
+    out = layers.matmul(p, v)
+    # mark the attention probabilities as persistable (observable)
+    main.global_block().var(p.name).persistable = True
+    infer = main.clone(for_test=True)
+    t = fl.transpiler.InferenceTranspiler()
+    assert t.fuse_attention(infer) == 0
+    # non-persistable chain fuses, and the dead score var desc is gone
+    infer2 = main.clone(for_test=True)
+    infer2.global_block().var(p.name).persistable = False
+    assert t.fuse_attention(infer2) == 1
+    assert not infer2.desc.blocks[0].has_var(s.name)
+
+
+def test_layer_norm_fuse_mul_spelling(prog_scope, exe):
+    """The elementwise_mul(d, d) square spelling must fuse too (an op
+    reading one var through two slots is ONE consumer in DefUse)."""
+    main, startup, scope = prog_scope
+    x = layers.data(name="lnm_x", shape=[5], dtype="float32")
+    m = layers.reduce_mean(x, dim=[1], keep_dim=True)
+    d = layers.elementwise_sub(x, m)
+    sq = layers.elementwise_mul(d, d)
+    v = layers.reduce_mean(sq, dim=[1], keep_dim=True)
+    std = layers.sqrt(layers.scale(v, scale=1.0, bias=1e-5))
+    y = layers.elementwise_div(d, std)
+    exe.run(startup)
+    xv = np.random.RandomState(1).randn(3, 5).astype(np.float32)
+    ref, = exe.run(main, feed={"lnm_x": xv}, fetch_list=[y])
+    infer = main.clone(for_test=True)
+    assert fluid.transpiler.InferenceTranspiler().fuse_layer_norm(
+        infer, scope=scope) == 1
+    got, = exe.run(infer, feed={"lnm_x": xv}, fetch_list=[y.name])
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               atol=1e-5, rtol=1e-5)

@@ -149,3 +149,20 @@ def test_roi_pool_argmax():
     # max of the top-left 2x2 is 5 at flat index 5
     np.testing.assert_allclose(np.asarray(outs["Out"])[0, 0, 0, 0], 5.0)
     assert int(np.asarray(outs["Argmax"])[0, 0, 0, 0]) == 5
+
+
+def test_int64_feed_overflow_fails_loudly(prog_scope, exe):
+    """MIGRATION.md 'int64 ids and offsets': an id beyond 2^31 must
+    raise at the feed boundary, never silently wrap (reference keeps
+    true int64 ids, framework/lod_tensor.h:58)."""
+    import pytest
+    layers = fluid.layers
+    main, startup, scope = prog_scope
+    ids = layers.data(name="big_ids", shape=[1], dtype="int64")
+    emb = layers.embedding(ids, size=[8, 4])
+    exe.run(startup)
+    ok = np.asarray([[1], [7]], np.int64)
+    exe.run(main, feed={"big_ids": ok}, fetch_list=[emb])
+    bad = np.asarray([[1], [2 ** 31 + 5]], np.int64)
+    with pytest.raises(ValueError, match="int32 range"):
+        exe.run(main, feed={"big_ids": bad}, fetch_list=[emb])

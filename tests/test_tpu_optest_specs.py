@@ -49,11 +49,17 @@ def test_every_registered_op_is_classified():
         "lod_tensor_to_array", "array_to_lod_tensor", "max_sequence_len",
         "shrink_rnn_memory", "reorder_lod_tensor_by_rank",
     }
+    grad_covered_by_fwd_check = {
+        # explicit grad lowerings exercised by their forward op's
+        # cross-place grad check (spec has grad=[...])
+        "ring_attention_grad",
+    }
     unclassified = []
     for op in registry.registered_ops():
         info = registry._registry[op]
         if info.host_op or op in mod.SPECS or op in mod.SKIPS \
-                or op in covered_by_composite:
+                or op in covered_by_composite \
+                or op in grad_covered_by_fwd_check:
             continue
         unclassified.append(op)
     assert not unclassified, (

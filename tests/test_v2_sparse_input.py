@@ -1,0 +1,72 @@
+"""True sparse v2 inputs (round-5 VERDICT #7).
+
+Reference parameter/Argument.h keeps sparse input slots as row
+indices end-to-end; rounds 2-4 densified them at the feeder.  Now a
+``sparse_binary_vector(d)`` / ``sparse_float_vector(d)`` column feeds
+as a ragged index (or (index, value)) list and ``layer.fc`` consumes
+it through lookup_table + sequence_pool — the dense [N, d] matrix
+never materializes, so d = 1,000,000 trains on a laptop-sized host.
+"""
+import numpy as np
+
+import paddle_tpu.v2 as paddle
+
+DIM = 1_000_000
+
+
+def test_v2_million_dim_sparse_binary_trains():
+    paddle.init(trainer_count=1)
+    x = paddle.layer.data(
+        name="ctr_x", type=paddle.data_type.sparse_binary_vector(DIM))
+    y = paddle.layer.data(name="ctr_y",
+                          type=paddle.data_type.dense_vector(1))
+    pred = paddle.layer.fc(input=x, size=1)
+    cost = paddle.layer.mse_cost(pred, y)
+    params = paddle.parameters.create(cost)
+    # the fc weight is the full [DIM, 1] table — created once, sparse
+    # UPDATES would come from the distributed table path; what must
+    # never exist is a dense [batch, DIM] activation
+    trainer = paddle.trainer.SGD(
+        cost=cost, parameters=params,
+        update_equation=paddle.optimizer.Adam(learning_rate=0.1))
+    rng = np.random.RandomState(0)
+    # the label depends only on whether feature 123 is present —
+    # learnable from ~6 hot indices per sample out of 1M
+    def make_sample():
+        ids = rng.randint(0, DIM, size=5).tolist()
+        hot = rng.randint(2)
+        if hot:
+            ids.append(123)
+        return (sorted(set(ids)), [float(hot)])
+
+    data = [make_sample() for _ in range(256)]
+
+    def reader():
+        for _ in range(15):
+            yield data
+
+    costs = []
+    trainer.train(reader, num_passes=1,
+                  event_handler=lambda e: costs.append(e.cost)
+                  if isinstance(e, paddle.event.EndIteration) else None)
+    assert costs[-1] < costs[0] * 0.6, (costs[0], costs[-1])
+
+
+def test_v2_sparse_float_vector_value_weighting():
+    """sparse_float_vector: looked-up rows scale by the fed values —
+    pinned against the dense oracle on a small dim."""
+    paddle.init(trainer_count=1)
+    dim = 32
+    x = paddle.layer.data(
+        name="sfv_x", type=paddle.data_type.sparse_float_vector(dim))
+    pred = paddle.layer.fc(input=x, size=3, bias_attr=False,
+                           name="sfv_fc")
+    params = paddle.parameters.create(pred)
+    w = np.random.RandomState(1).randn(dim, 3).astype(np.float32)
+    params.set("_sfv_fc.w0", w)
+    rows = [([(2, 0.5), (7, -1.5)],), ([(0, 2.0)],)]
+    out = paddle.infer(output_layer=pred, parameters=params, input=rows)
+    dense = np.zeros((2, dim), np.float32)
+    dense[0, 2], dense[0, 7], dense[1, 0] = 0.5, -1.5, 2.0
+    np.testing.assert_allclose(np.asarray(out), dense @ w, atol=1e-4,
+                               rtol=1e-4)
