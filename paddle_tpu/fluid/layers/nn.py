@@ -26,6 +26,7 @@ __all__ = [
     "sigmoid_cross_entropy_with_logits", "hinge_loss", "huber_loss",
     "log_loss", "rank_loss", "margin_rank_loss", "maxout", "relu", "log",
     "conv_shift", "modified_huber_loss", "roi_pool", "unpool",
+    "lambda_rank", "scale_sub_region",
     "crop", "slice_op", "shape_op", "hsigmoid", "cos_sim", "scale",
     "dot_product_attention", "warpctc", "bilinear_tensor_product",
     "sampling_id", "gaussian_random", "uniform_random",
@@ -1144,4 +1145,34 @@ def unpool(input, indices, unpool_size, unpool_stride=None,
                "strides": _pair(unpool_stride) if unpool_stride
                else ksize,
                "paddings": _pair(unpool_padding)})
+    return out
+
+
+def lambda_rank(score, label, ndcg_num=5, return_ndcg=False):
+    """LambdaRank cost per query (reference LambdaCost ->
+    lambda_rank op); ``score`` = model outputs, ``label`` = gold
+    relevance, ragged sequences over each query's candidates.  With
+    return_ndcg, also returns the reference forward's reported
+    NDCG@k."""
+    helper = LayerHelper("lambda_rank", **locals())
+    out = helper.create_tmp_variable(dtype="float32")
+    ndcg = helper.create_tmp_variable(dtype="float32",
+                                      stop_gradient=True)
+    helper.append_op(type="lambda_rank",
+                     inputs={"Score": [score], "Label": [label]},
+                     outputs={"Out": [out], "NDCG": [ndcg]},
+                     attrs={"NDCG_num": int(ndcg_num)})
+    return (out, ndcg) if return_ndcg else out
+
+
+def scale_sub_region(x, indices, value):
+    """Scale the per-sample [C,H,W] sub-box named by ``indices``
+    ([N, 6] 1-based inclusive c0,c1,h0,h1,w0,w1) by ``value``
+    (reference scale_sub_region_layer -> scale_sub_region op)."""
+    helper = LayerHelper("scale_sub_region", **locals())
+    out = helper.create_tmp_variable(dtype=x.dtype)
+    helper.append_op(type="scale_sub_region",
+                     inputs={"X": [x], "Indices": [indices]},
+                     outputs={"Out": [out]},
+                     attrs={"value": float(value)})
     return out

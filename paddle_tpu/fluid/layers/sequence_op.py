@@ -9,6 +9,7 @@ from __future__ import annotations
 from ..layer_helper import LayerHelper
 
 __all__ = [
+    "kmax_seq_score",
     "dynamic_lstm", "dynamic_gru", "sequence_pool", "sequence_softmax",
     "sequence_expand", "sequence_conv", "sequence_first_step",
     "sequence_last_step", "sequence_erase", "lod_reset", "edit_distance",
@@ -277,4 +278,17 @@ def sequence_slice(input, offset, length, name=None):
                      outputs={"Out": [out]})
     for v in (offset, length):
         v.stop_gradient = True
+    return out
+
+
+def kmax_seq_score(input, beam_size=1):
+    """Top-k score positions per sequence (reference
+    kmax_seq_score_layer -> kmax_seq_score op); returns [N, beam_size]
+    int32 indices, -1 padded for short sequences."""
+    helper = LayerHelper("kmax_seq_score", **locals())
+    out = helper.create_tmp_variable(dtype="int32")
+    helper.append_op(type="kmax_seq_score", inputs={"X": [input]},
+                     outputs={"Out": [out]},
+                     attrs={"beam_size": int(beam_size)})
+    out.stop_gradient = True
     return out

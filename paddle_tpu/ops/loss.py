@@ -182,3 +182,74 @@ def _nce(ctx, ins, attrs, op):
     cost = jnp.sum(per, axis=1, keepdims=True)
     return {"Cost": cost, "SampleLogits": logits,
             "SampleLabels": samples}
+
+
+@register_op("lambda_rank", seq_aware=True, no_vjp_outputs=("NDCG",))
+def _lambda_rank(ctx, ins, attrs, op=None):
+    """LambdaRank cost (reference gserver/layers/CostLayer.cpp:363-528
+    LambdaCost, via trainer_config_helpers lambda_cost:6094).
+
+    Score = model outputs, Label = gold relevance, one ragged sequence
+    per query.  The reference hand-writes the lambda gradient
+    (calcGrad:423): for each pair (i, j) by GOLD-sorted position i<j,
+
+        dcgDif = (2^{l_i} - 2^{l_j}) (1/ln(i+2) - 1/ln(j+2))
+        grad_i += -|dcgDif| / (1 + e^{s_i - s_j}) / maxDCG      (+/- j)
+
+    with maxDCG = sum of the NDCG_num best gold gains (2^l - 1)/ln(p+2)
+    — note NATURAL logs, pair discounts NOT truncated, positions from
+    the gold sort.  ``Out`` here is the surrogate
+    sum |dcgDif|/maxDCG * log(1 + e^{-(s_i - s_j)}) whose autodiff
+    gradient is EXACTLY that lambda; ``NDCG`` is the reference
+    forward's reported value (calcNDCG:484 — gold gains at the top-k
+    positions of the OUTPUT order, over maxDCG)."""
+    from paddle_tpu.ops.sequence import _lens_of, _mask
+
+    score = ins["Score"]
+    label = ins["Label"]
+    k = int(attrs.get("NDCG_num", 5))
+    if score.ndim == 3:
+        score = score[..., 0]
+    if label.ndim == 3:
+        label = label[..., 0]
+    label = label.astype(jnp.float32)
+    sf = score.astype(jnp.float32)
+    n, t = sf.shape
+    lens = _lens_of(ctx, op, "Score")
+    if lens is None:
+        lens = _lens_of(ctx, op, "Label")
+    valid = (_mask(lens, n, t, jnp.bool_) if lens is not None
+             else jnp.ones((n, t), bool))
+
+    neg_inf = jnp.float32(-1e30)
+    # 0-based position of each item in the DESCENDING GOLD order
+    gold_key = jnp.where(valid, label, neg_inf)
+    order = jnp.argsort(-gold_key, axis=1, stable=True)
+    pos = jnp.argsort(order, axis=1).astype(jnp.float32)     # [N, T]
+    disc = 1.0 / jnp.log(pos + 2.0)                # natural log, no cut
+    gain = jnp.exp2(jnp.where(valid, label, 0.0))            # 2^l
+
+    # maxDCG over the NDCG_num best gold gains
+    sg = -jnp.sort(-jnp.where(valid, gain - 1.0, 0.0), axis=1)
+    top_disc = jnp.where(jnp.arange(t) < k,
+                         1.0 / jnp.log(jnp.arange(t, dtype=jnp.float32)
+                                       + 2.0), 0.0)
+    maxdcg = jnp.maximum((sg * top_disc[None, :]).sum(axis=1), 1e-6)
+
+    d_gain = gain[:, :, None] - gain[:, None, :]             # [N, T, T]
+    d_disc = disc[:, :, None] - disc[:, None, :]
+    weight = jnp.abs(d_gain * d_disc) / maxdcg[:, None, None]
+    # each unordered pair once: l_i > l_j (equal-gold pairs weigh 0)
+    pair = (valid[:, :, None] & valid[:, None, :] &
+            (label[:, :, None] > label[:, None, :]))
+    d_s = sf[:, :, None] - sf[:, None, :]
+    logistic = jnp.log1p(jnp.exp(-jnp.abs(d_s))) + jnp.maximum(-d_s, 0.0)
+    cost = jnp.where(pair, weight * logistic, 0.0).sum(axis=(1, 2))
+
+    # reported NDCG: gold gains at the top-k OUTPUT-order positions
+    out_key = jnp.where(valid, sf, neg_inf)
+    by_out = jnp.take_along_axis(jnp.where(valid, gain - 1.0, 0.0),
+                                 jnp.argsort(-out_key, axis=1), axis=1)
+    dcg = (by_out * top_disc[None, :]).sum(axis=1)
+    ndcg = dcg / maxdcg
+    return {"Out": cost[:, None], "NDCG": ndcg[:, None]}

@@ -224,3 +224,30 @@ def _positive_negative_pair(executor, op, scope, feed, env=None):
             if env is not None:
                 env[names[0]] = arr
             (scope.find_scope_of(names[0]) or scope).set(names[0], arr)
+
+
+@register_op("scale_sub_region")
+def _scale_sub_region(ctx, ins, attrs, op=None):
+    """Scale a per-sample [C,H,W] sub-box by ``value`` (reference
+    gserver/layers/ScaleSubRegionLayer.cpp via scale_sub_region_layer:
+    7493).  Indices [N, 6] rows are 1-based inclusive
+    (c0, c1, h0, h1, w0, w1), the reference convention.  Lowered as a
+    broadcast mask select — per-sample dynamic bounds compare against
+    iotas, no dynamic slicing."""
+    x = ins["X"]
+    idx = ins["Indices"].astype(jnp.int32)          # [N, 6], 1-based
+    value = float(attrs.get("value", 1.0))
+    n, c, h, w = x.shape
+
+    def bounds(lo, hi, size, axis_pos):
+        pos = jnp.arange(size).reshape(
+            (1,) + (1,) * axis_pos + (size,) +
+            (1,) * (2 - axis_pos))                   # [1,...,size,...,1]
+        lo = (lo - 1).reshape(n, 1, 1, 1)
+        hi = (hi - 1).reshape(n, 1, 1, 1)
+        return (pos >= lo) & (pos <= hi)
+
+    mask = (bounds(idx[:, 0], idx[:, 1], c, 0) &
+            bounds(idx[:, 2], idx[:, 3], h, 1) &
+            bounds(idx[:, 4], idx[:, 5], w, 2))
+    return {"Out": jnp.where(mask, x * value, x)}

@@ -655,3 +655,28 @@ def _lstmp(ctx, ins, attrs, op=None):
         w_proj=ins["ProjWeight"],
         proj_act=_act(attrs.get("proj_activation", "tanh")))
     return {"Projection": proj, "Cell": cell}
+
+
+@register_op("kmax_seq_score", grad_maker=None, seq_aware=True)
+def _kmax_seq_score(ctx, ins, attrs, op=None):
+    """Top-k score POSITIONS within each sequence (reference
+    gserver/layers/KmaxSeqScoreLayer.cpp via kmax_seq_score_layer:7191):
+    X [N, T, 1] ragged scores; Out [N, k] int32 indices into the
+    sequence (slots past a short sequence's k are -1)."""
+    x = ins["X"]
+    if x.ndim == 3:
+        x = x[..., 0]
+    k = int(attrs.get("beam_size", 1))
+    n, t = x.shape
+    lens = _lens_of(ctx, op, "X")
+    if lens is None:
+        lens = jnp.full((n,), t, jnp.int32)
+    valid = jnp.arange(t)[None, :] < lens[:, None]
+    masked = jnp.where(valid, x.astype(jnp.float32), -jnp.inf)
+    kk = min(k, t)
+    _, idx = jax.lax.top_k(masked, kk)                   # [N, kk]
+    in_range = jnp.arange(kk)[None, :] < jnp.minimum(lens, kk)[:, None]
+    idx = jnp.where(in_range, idx, -1).astype(jnp.int32)
+    if kk < k:
+        idx = jnp.pad(idx, ((0, 0), (0, k - kk)), constant_values=-1)
+    return {"Out": idx}

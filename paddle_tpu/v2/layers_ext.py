@@ -62,9 +62,9 @@ __all__ = [
     # utilities / markers
     "printer", "print", "LayerType", "layer_support", "BeamInput",
     "SubsequenceInput",
+    "lambda_cost", "kmax_seq_score", "scale_sub_region",
     # documented refusals (raise with a pointer)
     "get_output", "sub_nested_seq", "cross_entropy_over_beam", "eos",
-    "kmax_seq_score", "lambda_cost", "scale_sub_region",
 ]
 
 
@@ -1307,6 +1307,59 @@ def rank_cost(left, right, label, weight=None, name=None, coeff=1.0,
     return Layer(name, build, inputs=ins, size=1)
 
 
+def scale_sub_region(input, indices, value, name=None):
+    """Scale a per-sample image sub-box (reference
+    scale_sub_region_layer:7493): ``indices`` is a [6]-wide data layer
+    of 1-based inclusive (c0, c1, h0, h1, w0, w1)."""
+    name = _auto_name("scale_sub_region", name)
+    ins = _inputs(input)
+    src = ins[0]
+
+    def build(ctx, x, ind):
+        L = ctx.fluid.layers
+        img, _c = _as_image(ctx, src, x)
+        return L.scale_sub_region(img, L.cast(ind, "int32"),
+                                  float(value))
+
+    out = Layer(name, build, inputs=[src, indices], size=src.size)
+    out.num_channels = getattr(src, "num_channels", None)
+    return out
+
+
+def kmax_seq_score(input, name=None, beam_size=1):
+    """Top-k score positions per sequence (reference
+    kmax_seq_score_layer:7191 -> kmax_seq_score op)."""
+    name = _auto_name("kmax_seq_score", name)
+    ins = _inputs(input)
+
+    def build(ctx, x):
+        return ctx.fluid.layers.kmax_seq_score(x, beam_size=beam_size)
+
+    return Layer(name, build, inputs=ins, size=beam_size)
+
+
+def lambda_cost(input, score, name=None, NDCG_num=5, max_sort_size=-1,
+                layer_attr=None):
+    """LambdaRank cost (reference lambda_cost:6094 -> the lambda_rank
+    op).  REFERENCE ARGUMENT ROLES (CostLayer.h getOutputLayer/
+    getScoreLayer): ``input`` is the MODEL OUTPUT sequence, ``score``
+    the gold relevance sequence, one per query.  max_sort_size=-1
+    (sort the whole list) is the only ported mode; the surrogate's
+    autodiff gradient is the reference's hand-written lambda
+    (calcGrad parity pinned in tests/test_loss_norm_ops.py)."""
+    if max_sort_size != -1:
+        raise NotImplementedError(
+            "lambda_cost(max_sort_size=...): partial-sort truncation "
+            "is not ported; the whole candidate list is ranked")
+    name = _auto_name("lambda_cost", name)
+
+    def build(ctx, out_v, gold_v):
+        L = ctx.fluid.layers
+        return L.mean(L.lambda_rank(out_v, gold_v, ndcg_num=NDCG_num))
+
+    return Layer(name, build, inputs=[input, score], size=1)
+
+
 def sum_cost(input, name=None, layer_attr=None):
     """Plain sum of the input as the loss (reference sum_cost:6250)."""
     name = _auto_name("sum_cost", name)
@@ -1509,13 +1562,3 @@ cross_entropy_over_beam = _refusal(
 eos = _refusal(
     "eos", "end-of-sequence truncation is built into beam_search here",
     "layer.beam_search(eos_id=...)")
-kmax_seq_score = _refusal(
-    "kmax_seq_score", "ragged per-sequence top-k indices have no "
-    "masked carrier", "fluid.layers.topk on the padded scores")
-lambda_cost = _refusal(
-    "lambda_cost", "LambdaRank's NDCG-weighted pair loss needs "
-    "per-query sorting that has no fluid carrier", "rank_cost "
-    "(pairwise logistic) or a custom op")
-scale_sub_region = _refusal(
-    "scale_sub_region", "per-sample dynamic region writes have no "
-    "fluid carrier", "fluid.layers.crop + elementwise compositions")

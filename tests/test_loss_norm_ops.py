@@ -3,6 +3,7 @@ test_softmax_with_cross_entropy_op.py, test_batch_norm_op.py,
 test_layer_norm_op.py)."""
 import numpy as np
 
+import paddle_tpu.fluid as fluid
 from op_test import OpTest
 
 rng = np.random.RandomState(11)
@@ -166,3 +167,96 @@ def test_huber_loss():
         outputs = {"Out": expected.astype(np.float32), "Residual": r}
 
     T().check_output()
+
+
+def test_lambda_rank_vs_numpy_oracle(prog_scope, exe):
+    """LambdaRank surrogate vs a direct numpy computation on ragged
+    queries (reference gserver LambdaCost semantics: NDCG-truncated
+    pairwise weighting, ranks by current score)."""
+    from paddle_tpu.core.lod import LoDTensor
+    main, startup, scope = prog_scope
+    score = fluid.layers.data(name="lr_s", shape=[1], lod_level=1,
+                              dtype="float32")
+    label = fluid.layers.data(name="lr_l", shape=[1], lod_level=1,
+                              dtype="float32")
+    out, ndcg = fluid.layers.lambda_rank(score, label, ndcg_num=3,
+                                         return_ndcg=True)
+    exe.run(startup)
+
+    rng = np.random.RandomState(0)
+    lens = [5, 3]
+    svals = [rng.randn(l).astype(np.float32) for l in lens]
+    lvals = [rng.randint(0, 3, l).astype(np.float32) for l in lens]
+
+    def lodt(parts):
+        flat = np.concatenate(parts)[:, None]
+        offs = np.concatenate([[0], np.cumsum(lens)]).tolist()
+        return LoDTensor(flat, [offs])
+
+    got, nv = exe.run(main, feed={"lr_s": lodt(svals),
+                                  "lr_l": lodt(lvals)},
+                      fetch_list=[out, ndcg])
+    got = np.ravel(np.asarray(got))
+    nv = np.ravel(np.asarray(nv))
+
+    def oracle(s, l, k=3):
+        """Reference CostLayer.cpp calcGrad semantics: positions by
+        GOLD sort (stable desc), natural-log discounts untruncated
+        for pairs, maxDCG truncated at k."""
+        t = len(s)
+        pos = np.argsort(np.argsort(-l, kind="stable"))
+        disc = 1.0 / np.log(pos + 2.0)
+        gain = 2.0 ** l
+        maxdcg = max(((np.sort(2.0 ** l - 1.0)[::-1][:k]) /
+                      np.log(2.0 + np.arange(min(k, t)))).sum(), 1e-6)
+        c = 0.0
+        for i in range(t):
+            for j in range(t):
+                if l[i] > l[j]:
+                    w = abs((gain[i] - gain[j]) * (disc[i] - disc[j])) \
+                        / maxdcg
+                    c += w * np.log1p(np.exp(-(s[i] - s[j])))
+        return c
+
+    def ndcg_oracle(s, l, k=3):
+        top = np.argsort(-s, kind="stable")[:k]
+        dcg = ((2.0 ** l[top] - 1.0) /
+               np.log(2.0 + np.arange(len(top)))).sum()
+        maxdcg = max(((np.sort(2.0 ** l - 1.0)[::-1][:k]) /
+                      np.log(2.0 + np.arange(min(k, len(l))))).sum(),
+                     1e-6)
+        return dcg / maxdcg
+
+    for q in range(2):
+        np.testing.assert_allclose(got[q], oracle(svals[q], lvals[q]),
+                                   rtol=1e-4, atol=1e-4)
+        np.testing.assert_allclose(nv[q], ndcg_oracle(svals[q],
+                                                      lvals[q]),
+                                   rtol=1e-4, atol=1e-4)
+
+
+def test_lambda_rank_trains(prog_scope, exe):
+    """Gradient flows: scores move toward the label ordering."""
+    from paddle_tpu.core.lod import LoDTensor
+    main, startup, scope = prog_scope
+    x = fluid.layers.data(name="lt_x", shape=[4], lod_level=1,
+                          dtype="float32")
+    label = fluid.layers.data(name="lt_l", shape=[1], lod_level=1,
+                              dtype="float32")
+    score = fluid.layers.fc(x, size=1)
+    cost = fluid.layers.mean(fluid.layers.lambda_rank(score, label))
+    fluid.optimizer.Adam(learning_rate=0.05).minimize(cost)
+    exe.run(startup)
+    rng = np.random.RandomState(1)
+    lens = [6, 6]
+    feats = np.concatenate([rng.randn(6, 4), rng.randn(6, 4)]).astype(
+        np.float32)
+    rel = (feats[:, 0] > 0).astype(np.float32)[:, None]  # learnable
+    offs = [0, 6, 12]
+    feed = {"lt_x": LoDTensor(feats, [offs]),
+            "lt_l": LoDTensor(rel, [offs])}
+    ls = []
+    for _ in range(60):
+        l, = exe.run(main, feed=feed, fetch_list=[cost])
+        ls.append(float(np.ravel(l)[0]))
+    assert ls[-1] < ls[0] * 0.3, (ls[0], ls[-1])

@@ -166,3 +166,24 @@ def test_int64_feed_overflow_fails_loudly(prog_scope, exe):
     bad = np.asarray([[1], [2 ** 31 + 5]], np.int64)
     with pytest.raises(ValueError, match="int32 range"):
         exe.run(main, feed={"big_ids": bad}, fetch_list=[emb])
+
+
+def test_scale_sub_region_vs_numpy(prog_scope, exe):
+    """Per-sample sub-box scaling with 1-based inclusive bounds
+    (reference ScaleSubRegionLayer)."""
+    layers = fluid.layers
+    main, startup, scope = prog_scope
+    x = layers.data(name="ssr_x", shape=[3, 4, 4], dtype="float32")
+    ind = layers.data(name="ssr_i", shape=[6], dtype="int64")
+    out = layers.scale_sub_region(x, layers.cast(ind, "int32"), 2.0)
+    exe.run(startup)
+    rng = np.random.RandomState(0)
+    xv = rng.randn(2, 3, 4, 4).astype(np.float32)
+    iv = np.asarray([[1, 2, 1, 3, 2, 4], [2, 3, 2, 2, 1, 1]], np.int64)
+    got, = exe.run(main, feed={"ssr_x": xv, "ssr_i": iv},
+                   fetch_list=[out])
+    want = xv.copy()
+    for s in range(2):
+        c0, c1, h0, h1, w0, w1 = iv[s] - 1
+        want[s, c0:c1 + 1, h0:h1 + 1, w0:w1 + 1] *= 2.0
+    np.testing.assert_allclose(np.asarray(got), want, atol=1e-6)

@@ -483,3 +483,21 @@ def test_klevel_pad_roundtrip():
         np.zeros_like(padded), [np.zeros_like(l) for l in lens])
     assert empty.data.shape == (0, 3)
     assert empty.lod[0] == [0, 0, 0]  # N=2 empty docs
+
+
+def test_kmax_seq_score_positions(prog_scope, exe):
+    """Top-k positions per ragged sequence, -1 padded (reference
+    kmax_seq_score_layer)."""
+    main, startup, scope = prog_scope
+    x = fluid.layers.data(name="km_x", shape=[1], lod_level=1,
+                          dtype="float32")
+    out = fluid.layers.kmax_seq_score(x, beam_size=3)
+    exe.run(startup)
+    rows = np.zeros((2, 5, 1), np.float32)
+    rows[0, :5, 0] = [0.1, 0.9, 0.3, 0.8, 0.2]
+    rows[1, :2, 0] = [0.5, 0.7]
+    got, = exe.run(main, feed={"km_x": _lod(rows, [5, 2])},
+                   fetch_list=[out])
+    got = np.asarray(got)
+    assert got[0].tolist() == [1, 3, 2]
+    assert got[1].tolist() == [1, 0, -1]

@@ -80,7 +80,6 @@ def convert_name(inname):
 # MIGRATION.md "v2 layer coverage" contract).
 REFUSALS = {
     "get_output", "sub_nested_seq", "cross_entropy_over_beam", "eos",
-    "kmax_seq_score", "lambda_cost", "scale_sub_region",
     "SubsequenceInput",
 }
 

@@ -483,6 +483,20 @@ spec("seq_cross_attention",
       "V": lodt(F(2, 5, 6), [5, 2])}, {},
      grad=["Q", "K", "V"], tol=TOL_MM)
 
+spec("scale_sub_region",
+     {"X": F(2, 3, 4, 4),
+      "Indices": np.asarray([[1, 2, 1, 3, 2, 4], [2, 3, 2, 2, 1, 1]],
+                            np.int64)},
+     {"value": 2.0})
+
+spec("kmax_seq_score", {"X": lodt(F(2, 6, 1), [6, 3])},
+     {"beam_size": 2})
+
+spec("lambda_rank",
+     {"Score": lodt(F(2, 5, 1), [5, 3]),
+      "Label": lodt(I((2, 5, 1), hi=3).astype(np.float32), [5, 3])},
+     {"NDCG_num": 3}, grad=["Score"], tol=TOL_EXP)
+
 # --- CRF / CTC ---
 spec("linear_chain_crf",
      {"Emission": lodt(F(2, 5, 4), [5, 3]),
