@@ -9,7 +9,7 @@ from __future__ import annotations
 from ..layer_helper import LayerHelper
 
 __all__ = [
-    "kmax_seq_score",
+    "kmax_seq_score", "sub_nested_seq",
     "dynamic_lstm", "dynamic_gru", "sequence_pool", "sequence_softmax",
     "sequence_expand", "sequence_conv", "sequence_first_step",
     "sequence_last_step", "sequence_erase", "lod_reset", "edit_distance",
@@ -291,4 +291,17 @@ def kmax_seq_score(input, beam_size=1):
                      outputs={"Out": [out]},
                      attrs={"beam_size": int(beam_size)})
     out.stop_gradient = True
+    return out
+
+
+def sub_nested_seq(input, selected_indices):
+    """Keep the selected inner sub-sequences of a level-2 input
+    (reference sub_nested_seq_layer -> sub_nested_seq op)."""
+    helper = LayerHelper("sub_nested_seq", **locals())
+    out = helper.create_tmp_variable(dtype=input.dtype)
+    out.desc.lod_level = 2
+    helper.append_op(
+        type="sub_nested_seq",
+        inputs={"X": [input], "SelectedIndices": [selected_indices]},
+        outputs={"Out": [out]})
     return out

@@ -680,3 +680,46 @@ def _kmax_seq_score(ctx, ins, attrs, op=None):
     if kk < k:
         idx = jnp.pad(idx, ((0, 0), (0, k - kk)), constant_values=-1)
     return {"Out": idx}
+
+
+@register_op("sub_nested_seq", seq_aware=True)
+def _sub_nested_seq(ctx, ins, attrs, op=None):
+    """Select inner sub-sequences of a level-2 input by per-sample
+    index lists (reference gserver/layers/SubNestedSequenceLayer.cpp
+    via sub_nested_seq_layer:7045): X level-2 [N, S, W, ...] with
+    inner lens, SelectedIndices a ragged int list per sample; Out is
+    the level-2 sequence keeping only the selected inner rows, in the
+    given order."""
+    x = ins["X"]
+    sel = ins["SelectedIndices"]
+    if sel.ndim == 3:
+        sel = sel[..., 0]
+    sel = sel.astype(jnp.int32)
+    nested = _inner_lens_of(ctx, op, "X")
+    if nested is None:
+        raise ValueError(
+            "sub_nested_seq requires a level-2 X (a level-1 input has "
+            "no sub-sequences to select; use sequence_slice)")
+    inner, depth = nested
+    if depth != 1:
+        raise NotImplementedError(
+            "sub_nested_seq: only level-2 inputs are supported "
+            "(level-%d given)" % (depth + 1))
+    n, s = x.shape[:2]
+    k = sel.shape[1]
+    sel_lens = _lens_of(ctx, op, "SelectedIndices")
+    if sel_lens is None:
+        sel_lens = jnp.full((n,), k, jnp.int32)
+    kvalid = jnp.arange(k)[None, :] < sel_lens[:, None]
+    idx = jnp.where(kvalid, jnp.clip(sel, 0, s - 1), 0)
+    gidx = idx.reshape((n, k) + (1,) * (x.ndim - 2))
+    out = jnp.take_along_axis(x, gidx, axis=1)
+    out = jnp.where(kvalid.reshape((n, k) + (1,) * (x.ndim - 2)),
+                    out, jnp.zeros((), x.dtype))
+    new_inner = jnp.where(kvalid,
+                          jnp.take_along_axis(inner, idx, axis=1), 0)
+    if op is not None and op.outputs.get("Out"):
+        oname = op.outputs["Out"][0]
+        ctx.set_seq_len(oname, sel_lens)
+        ctx.env[oname + "@LEN@1"] = new_inner
+    return {"Out": out}

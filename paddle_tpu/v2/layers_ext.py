@@ -63,8 +63,9 @@ __all__ = [
     "printer", "print", "LayerType", "layer_support", "BeamInput",
     "SubsequenceInput",
     "lambda_cost", "kmax_seq_score", "scale_sub_region",
+    "sub_nested_seq",
     # documented refusals (raise with a pointer)
-    "get_output", "sub_nested_seq", "cross_entropy_over_beam", "eos",
+    "get_output", "cross_entropy_over_beam", "eos",
 ]
 
 
@@ -1307,6 +1308,20 @@ def rank_cost(left, right, label, weight=None, name=None, coeff=1.0,
     return Layer(name, build, inputs=ins, size=1)
 
 
+def sub_nested_seq(input, selected_indices, name=None):
+    """Select inner sub-sequences of a nested (level-2) sequence by a
+    per-sample index list (reference sub_nested_seq_layer:7045 ->
+    sub_nested_seq op)."""
+    name = _auto_name("sub_nested_seq", name)
+
+    def build(ctx, x, sel):
+        # no cast: the op lowering int32-ifies any integer indices
+        return ctx.fluid.layers.sub_nested_seq(x, sel)
+
+    return Layer(name, build, inputs=[input, selected_indices],
+                 size=input.size)
+
+
 def scale_sub_region(input, indices, value, name=None):
     """Scale a per-sample image sub-box (reference
     scale_sub_region_layer:7493): ``indices`` is a [6]-wide data layer
@@ -1552,9 +1567,6 @@ get_output = _refusal(
     "get_output", "layers here have exactly one output value (auxiliary "
     "outputs like the LSTM cell ride as attributes, e.g. "
     "lstm_step(...).state)", "the .state attribute or fluid.layers")
-sub_nested_seq = _refusal(
-    "sub_nested_seq", "nested-sequence row selection has no fluid "
-    "carrier", "fluid.layers.gather on the padded form")
 cross_entropy_over_beam = _refusal(
     "cross_entropy_over_beam", "beam-training (CRF-over-beam) requires "
     "the gserver beam expansion records", "layer.beam_search for "

@@ -501,3 +501,35 @@ def test_kmax_seq_score_positions(prog_scope, exe):
     got = np.asarray(got)
     assert got[0].tolist() == [1, 3, 2]
     assert got[1].tolist() == [1, 0, -1]
+
+
+def test_sub_nested_seq_selects_inner_rows(prog_scope, exe):
+    """Level-2 selection by per-sample index lists (reference
+    SubNestedSequenceLayer): output keeps the chosen inner
+    sub-sequences, pooling over it sees only those rows."""
+    main, startup, scope = prog_scope
+    x = fluid.layers.data(name="sn_x", shape=[2], lod_level=2,
+                          dtype="float32")
+    sel = fluid.layers.data(name="sn_i", shape=[1], lod_level=1,
+                            dtype="int64")
+    sub = fluid.layers.sub_nested_seq(
+        x, fluid.layers.cast(sel, "int32"))
+    pooled = fluid.layers.sequence_pool(sub, pool_type="SUM")
+    exe.run(startup)
+    from paddle_tpu.core.lod import LoDTensor
+    # sample 0: inner seqs A=[[1,1],[2,2]], B=[[10,10]];
+    # sample 1: C=[[3,3],[4,4]], D=[[5,5]], E=[[6,6]]
+    data = np.asarray([[1, 1], [2, 2], [10, 10], [3, 3], [4, 4],
+                       [5, 5], [6, 6]], np.float32)
+    xfeed = LoDTensor(data, [[0, 2, 5], [0, 2, 3, 5, 6, 7]])
+    # sample 0 selects inner seq 1 then 0; sample 1 selects inner 2
+    sfeed = LoDTensor(np.asarray([[1], [0], [2]], np.int64),
+                      [[0, 2, 3]])
+    got, = exe.run(main, feed={"sn_x": xfeed, "sn_i": sfeed},
+                   fetch_list=[pooled])
+    got = np.asarray(got)
+    # sample 0 selected: inner1 = [10,10] (len 1), inner0 = rows
+    # [1,1]+[2,2] summed = [3,3]; sample 1 selected inner2 = [6,6]
+    np.testing.assert_allclose(got[0, 0], [10, 10], atol=1e-5)
+    np.testing.assert_allclose(got[0, 1], [3, 3], atol=1e-5)
+    np.testing.assert_allclose(got[1, 0], [6, 6], atol=1e-5)

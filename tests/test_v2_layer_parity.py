@@ -79,7 +79,7 @@ def convert_name(inname):
 # raises NotImplementedError pointing at the fluid carrier (the
 # MIGRATION.md "v2 layer coverage" contract).
 REFUSALS = {
-    "get_output", "sub_nested_seq", "cross_entropy_over_beam", "eos",
+    "get_output", "cross_entropy_over_beam", "eos",
     "SubsequenceInput",
 }
 

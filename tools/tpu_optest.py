@@ -483,6 +483,22 @@ spec("seq_cross_attention",
       "V": lodt(F(2, 5, 6), [5, 2])}, {},
      grad=["Q", "K", "V"], tol=TOL_MM)
 
+def lodt2(n_inner, width, dim):
+    """Level-2 LoDTensor: outer offsets over inner seqs, inner over
+    tokens."""
+    rng2 = np.random.RandomState(3)
+    inner_lens = [rng2.randint(1, width + 1) for _ in range(sum(n_inner))]
+    total = sum(inner_lens)
+    data = rng2.randn(total, dim).astype(np.float32)
+    inner_offs = np.concatenate([[0], np.cumsum(inner_lens)]).tolist()
+    outer_offs = np.concatenate([[0], np.cumsum(n_inner)]).tolist()
+    return LoDTensor(data, [outer_offs, inner_offs])
+
+
+spec("sub_nested_seq",
+     {"X": lodt2([2, 3], 4, 3),
+      "SelectedIndices": lodt(I((2, 2, 1), hi=2), [1, 2])})
+
 spec("scale_sub_region",
      {"X": F(2, 3, 4, 4),
       "Indices": np.asarray([[1, 2, 1, 3, 2, 4], [2, 3, 2, 2, 1, 1]],
