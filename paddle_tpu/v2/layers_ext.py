@@ -63,9 +63,9 @@ __all__ = [
     "printer", "print", "LayerType", "layer_support", "BeamInput",
     "SubsequenceInput",
     "lambda_cost", "kmax_seq_score", "scale_sub_region",
-    "sub_nested_seq",
+    "sub_nested_seq", "eos",
     # documented refusals (raise with a pointer)
-    "get_output", "cross_entropy_over_beam", "eos",
+    "get_output", "cross_entropy_over_beam",
 ]
 
 
@@ -1308,6 +1308,23 @@ def rank_cost(left, right, label, weight=None, name=None, coeff=1.0,
     return Layer(name, build, inputs=ins, size=1)
 
 
+def eos(input, eos_id, name=None, layer_attr=None):
+    """Per-sample EOS-id indicator: output = (input_id == eos_id)
+    (reference EosIdCheckLayer via eos_layer:4445).  Note the
+    GENERATION-side EOS handling lives inside layer.beam_search; this
+    is the standalone id-check form recurrent groups compose with."""
+    name = _auto_name("eos", name)
+    ins = _inputs(input)
+
+    def build(ctx, x):
+        L = ctx.fluid.layers
+        ref = L.fill_constant(shape=[1], dtype=x.dtype,
+                              value=float(eos_id))
+        return L.cast(L.equal(x, ref), "float32")
+
+    return Layer(name, build, inputs=ins, size=1)
+
+
 def sub_nested_seq(input, selected_indices, name=None):
     """Select inner sub-sequences of a nested (level-2) sequence by a
     per-sample index list (reference sub_nested_seq_layer:7045 ->
@@ -1571,6 +1588,3 @@ cross_entropy_over_beam = _refusal(
     "cross_entropy_over_beam", "beam-training (CRF-over-beam) requires "
     "the gserver beam expansion records", "layer.beam_search for "
     "generation + per-step cross_entropy_cost for training")
-eos = _refusal(
-    "eos", "end-of-sequence truncation is built into beam_search here",
-    "layer.beam_search(eos_id=...)")

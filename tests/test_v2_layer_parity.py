@@ -79,7 +79,7 @@ def convert_name(inname):
 # raises NotImplementedError pointing at the fluid carrier (the
 # MIGRATION.md "v2 layer coverage" contract).
 REFUSALS = {
-    "get_output", "cross_entropy_over_beam", "eos",
+    "get_output", "cross_entropy_over_beam",
     "SubsequenceInput",
 }
 
@@ -384,12 +384,17 @@ def test_seq_and_misc_layers():
                      rng.randn(3).astype(np.float32),
                      rng.randn(8).astype(np.float32),
                      rng.randn(3).astype(np.float32)))
-    got, _ = _infer([rs, mx, sid, cs, rc, pr],
+    eo = L.eos(idx, eos_id=1)
+    got, _ = _infer([rs, mx, sid, cs, rc, pr, eo],
                     ["s_x", "s_i", "s_c1", "s_c2", "s_a8", "s_b3"],
                     rows)
     for i, gv in enumerate(got):
         assert np.isfinite(np.asarray(gv, np.float64)).all(), i
     assert np.asarray(got[3]).shape == (2, 8)
+    # eos: indicator of idx == 1 per sample
+    idx_col = np.asarray([r[1] for r in rows], np.float64)[:, None]
+    np.testing.assert_allclose(np.asarray(got[6], np.float64),
+                               (idx_col == 1).astype(np.float64))
 
 
 def test_detection_layers_smoke():
