@@ -78,6 +78,13 @@ class EndpointRegistry:
 
     def list(self, kind):
         """Endpoints with a fresh heartbeat, sorted."""
+        return sorted(ep for ep, _ in self.list_meta(kind))
+
+    def list_meta(self, kind):
+        """[(endpoint, meta)] for endpoints with a fresh heartbeat,
+        sorted by endpoint.  ``meta`` is whatever register() published —
+        e.g. a pserver's stable shard id, which lets a trainer re-map a
+        restarted server that came back on a new port."""
         d = os.path.join(self.root, kind)
         out = []
         now = time.time()
@@ -91,7 +98,8 @@ class EndpointRegistry:
                 if now - os.stat(p).st_mtime > self.ttl:
                     continue
                 with open(p) as f:
-                    out.append(json.load(f)["endpoint"])
+                    payload = json.load(f)
+                out.append((payload["endpoint"], payload.get("meta") or {}))
             except (OSError, ValueError, KeyError):
                 continue  # torn write / removed underneath us
         return sorted(out)
@@ -294,11 +302,19 @@ class HAMasterClient:
 
     def _ensure(self):
         from .master import MasterClient
+        from .resilience import RetryPolicy
 
         if self._client is None:
             self._endpoint = resolve_master(self.root, self.timeout,
                                             self.ttl)
-            self._client = MasterClient(self._endpoint)
+            # fail-fast inner client: re-resolution of a NEW master
+            # lives in THIS retry loop, so the per-endpoint client must
+            # surface the first transient error instead of retrying the
+            # dead endpoint until its own deadline
+            self._client = MasterClient(
+                self._endpoint,
+                retry=RetryPolicy(max_attempts=1,
+                                  call_timeout=min(5.0, self.timeout)))
         return self._client
 
     def _retry(self, fn, *args, **kwargs):

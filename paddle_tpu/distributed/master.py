@@ -161,14 +161,34 @@ class Master:
             "failed": [t.to_dict() for t in self._failed],
             "epoch": self._epoch,
         }
-        tmp = self._snapshot_path + ".tmp"
-        with open(tmp, "w") as f:
-            json.dump(state, f)
-        os.replace(tmp, self._snapshot_path)
+        # atomic commit: a crash mid-write must never leave a truncated
+        # JSON at the live path (it would poison _recover); the previous
+        # good snapshot rotates to .bak so a crash landing between the
+        # two renames still leaves one loadable state
+        from paddle_tpu.core.fsutil import atomic_write
+
+        atomic_write(self._snapshot_path, json.dumps(state),
+                     backup_suffix=".bak")
 
     def _recover(self):
-        with open(self._snapshot_path) as f:
-            state = json.load(f)
+        """Load the snapshot; a corrupt/truncated main file falls back
+        to the .bak rotated by _snapshot.  With neither loadable the
+        master starts empty — task dispatch is at-least-once, so a
+        re-run of the dataset is safe, while refusing to start is not."""
+        state = None
+        for cand in (self._snapshot_path, self._snapshot_path + ".bak"):
+            try:
+                with open(cand) as f:
+                    state = json.load(f)
+                break
+            except (OSError, ValueError):
+                continue
+        if state is None:
+            import warnings
+            warnings.warn("master snapshot %r unreadable (and no .bak); "
+                          "starting with an empty queue"
+                          % self._snapshot_path)
+            return
         self._todo = [Task.from_dict(d)
                       for d in state["todo"] + state["pending"]]
         self._done = [Task.from_dict(d) for d in state["done"]]
@@ -238,15 +258,35 @@ class MasterServer:
 
 
 class MasterClient:
-    def __init__(self, endpoint):
+    """Client with per-call deadlines + retry (resilience.RetryPolicy):
+    an RPC to a dead/restarting master fails fast and retries with
+    backoff instead of hanging forever.  Every master op is idempotent
+    or lease-guarded server-side (a stale TaskFinished after the lease
+    was re-dispatched returns ok=False), so retry is safe."""
+
+    def __init__(self, endpoint, retry=None):
         import grpc
 
+        from .resilience import RetryPolicy
+
+        self._endpoint = endpoint
         self._ch = grpc.insecure_channel(endpoint)
+        self.retry = retry if retry is not None else RetryPolicy.from_env()
 
     def _call(self, method, payload):
-        fn = self._ch.unary_unary(
-            "/%s/%s" % (MASTER_SERVICE, method))
-        return json.loads(fn(json.dumps(payload).encode()).decode())
+        from .resilience import fault_point
+
+        def attempt():
+            fault_point("master_rpc")
+            fn = self._ch.unary_unary(
+                "/%s/%s" % (MASTER_SERVICE, method))
+            return json.loads(
+                fn(json.dumps(payload).encode(), wait_for_ready=True,
+                   timeout=self.retry.call_timeout).decode())
+
+        return self.retry.run(
+            attempt,
+            describe="Master.%s(%s)" % (method, self._endpoint))
 
     def set_dataset(self, payloads):
         self._call("SetDataset", list(payloads))

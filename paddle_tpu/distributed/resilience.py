@@ -1,0 +1,355 @@
+"""Failure-path machinery for the distributed stack.
+
+Parity: the reference pairs Fluid with a Go fault-tolerance stack —
+go/pserver/client (retry + etcd re-resolution), go/master/service.go
+:368 checkTimeout (lease expiry re-queues a dead trainer's work), and
+etcd-backed recovery for both daemons.  This module is the Python-side
+analog used by distributed/rpc.py and distributed/master.py:
+
+  RetryPolicy       per-call deadlines + capped exponential backoff with
+                    jitter, classifying retryable vs fatal gRPC errors
+  FaultInjector     env-driven fault hooks (``FLAGS_fault_spec``) that
+                    probabilistically drop, delay, or hard-error calls
+                    at named injection points — the testable crash lab
+  EndpointResolver  re-resolve a restarted pserver's endpoint through
+                    discovery.EndpointRegistry (same shard id, possibly
+                    a new port)
+  watchdog_error    turn an exhausted deadline into an error naming the
+                    peers a barrier is still waiting on, instead of an
+                    indefinite hang
+
+Env knobs (all optional; see README "Fault tolerance"):
+  FLAGS_fault_spec        e.g. "send_grad:drop:0.1,get_param:delay:2.0"
+  FLAGS_fault_seed        deterministic injection RNG seed
+  FLAGS_rpc_deadline      total per-operation deadline, seconds
+  FLAGS_rpc_call_timeout  per-attempt gRPC timeout, seconds
+  FLAGS_rpc_retry_backoff / FLAGS_rpc_max_backoff / FLAGS_rpc_max_attempts
+  FLAGS_trainer_lease     pserver-side lease: a mid-round trainer silent
+                          this long is expired from the sync fanin
+"""
+from __future__ import annotations
+
+import random
+import threading
+import time
+
+from paddle_tpu.core.flags import FLAGS, define_flag
+
+__all__ = [
+    "RetryPolicy", "FaultInjector", "InjectedFault", "DeadlineExceeded",
+    "WatchdogTimeout", "EndpointResolver", "fault_point", "get_injector",
+    "install_faults", "watchdog_error",
+]
+
+define_flag("fault_spec", "",
+            "fault injection spec: point:action:value[:limit],...")
+define_flag("fault_seed", 0, "fault injection RNG seed (0 = OS entropy)")
+define_flag("rpc_deadline", 600.0,
+            "total deadline for one distributed operation, seconds")
+define_flag("rpc_call_timeout", 30.0,
+            "per-attempt timeout of one RPC, seconds")
+define_flag("rpc_retry_backoff", 0.05, "initial retry backoff, seconds")
+define_flag("rpc_max_backoff", 2.0, "backoff cap, seconds")
+define_flag("rpc_max_attempts", 0, "attempt cap per operation (0 = none)")
+define_flag("trainer_lease", 0.0,
+            "pserver sync fanin lease: expire a trainer silent this "
+            "long mid-round (0 disables)")
+define_flag("pserver_checkpoint_root", "",
+            "root dir for per-endpoint pserver shard checkpoints")
+define_flag("pserver_checkpoint_every_n", 0,
+            "checkpoint the pserver shard every N applied rounds")
+
+
+class InjectedFault(ConnectionError):
+    """A fault fired by FaultInjector.  ``retryable`` mirrors how a real
+    failure of that kind would classify (drop = transient network loss;
+    error = a poisoned/fatal reply)."""
+
+    def __init__(self, point, action, retryable=True):
+        super().__init__("injected fault at %r: %s" % (point, action))
+        self.point = point
+        self.action = action
+        self.retryable = retryable
+
+
+class DeadlineExceeded(TimeoutError):
+    """An operation ran out of retry budget (time or attempts)."""
+
+    def __init__(self, message, last_error=None, attempts=0, elapsed=0.0):
+        super().__init__(message)
+        self.last_error = last_error
+        self.attempts = attempts
+        self.elapsed = elapsed
+
+
+class WatchdogTimeout(TimeoutError):
+    """A collective hang converted into an error naming the stragglers."""
+
+
+class RetryPolicy:
+    """Deadline + capped exponential backoff + jitter.
+
+    ``call_timeout`` bounds ONE attempt (passed to gRPC as the call
+    deadline); ``deadline`` bounds the whole operation across retries.
+    Retryable: transient transport states (UNAVAILABLE, DEADLINE_EXCEEDED,
+    ABORTED, RESOURCE_EXHAUSTED, CANCELLED), socket-level OSErrors, and
+    retryable InjectedFaults.  Everything else — INVALID_ARGUMENT, a
+    server-side crash surfacing as UNKNOWN/INTERNAL, programming errors —
+    is fatal and surfaces immediately.
+    """
+
+    def __init__(self, deadline=None, call_timeout=None, base_backoff=None,
+                 max_backoff=None, multiplier=2.0, jitter=0.5,
+                 max_attempts=None, rng=None):
+        self.deadline = float(FLAGS.rpc_deadline if deadline is None
+                              else deadline)
+        self.call_timeout = float(FLAGS.rpc_call_timeout
+                                  if call_timeout is None else call_timeout)
+        self.base_backoff = float(FLAGS.rpc_retry_backoff
+                                  if base_backoff is None else base_backoff)
+        self.max_backoff = float(FLAGS.rpc_max_backoff
+                                 if max_backoff is None else max_backoff)
+        self.multiplier = float(multiplier)
+        self.jitter = float(jitter)
+        self.max_attempts = int(FLAGS.rpc_max_attempts
+                                if max_attempts is None else max_attempts)
+        self._rng = rng or random.Random()
+
+    @classmethod
+    def from_env(cls):
+        return cls()
+
+    # -- classification --
+    @staticmethod
+    def is_retryable(exc):
+        if isinstance(exc, InjectedFault):
+            return exc.retryable
+        if isinstance(exc, DeadlineExceeded):
+            return False
+        try:
+            import grpc
+            if isinstance(exc, grpc.RpcError):
+                code = exc.code() if callable(getattr(exc, "code", None)) \
+                    else None
+                return code in (grpc.StatusCode.UNAVAILABLE,
+                                grpc.StatusCode.DEADLINE_EXCEEDED,
+                                grpc.StatusCode.ABORTED,
+                                grpc.StatusCode.RESOURCE_EXHAUSTED,
+                                grpc.StatusCode.CANCELLED)
+        except ImportError:
+            pass
+        return isinstance(exc, (ConnectionError, TimeoutError, OSError))
+
+    def backoff(self, attempt):
+        """Capped exponential with +-jitter (attempt counts from 1)."""
+        raw = min(self.max_backoff,
+                  self.base_backoff * (self.multiplier ** (attempt - 1)))
+        lo = max(0.0, 1.0 - self.jitter)
+        return raw * self._rng.uniform(lo, 1.0 + self.jitter)
+
+    def run(self, fn, describe="", on_retry=None):
+        """Call ``fn`` until it succeeds, a fatal error surfaces, or the
+        deadline/attempt budget runs out (-> DeadlineExceeded).
+        ``on_retry(exc, attempt)`` runs before each retry — reconnects,
+        round replays; its own retryable failures feed back into the
+        loop instead of aborting it."""
+        start = time.monotonic()
+        attempt = 0
+        last = None
+        while True:
+            try:
+                return fn()
+            except Exception as e:
+                if not self.is_retryable(e):
+                    raise
+                last = e
+            attempt += 1
+            elapsed = time.monotonic() - start
+            delay = self.backoff(attempt)
+            if (self.max_attempts and attempt >= self.max_attempts) or \
+                    elapsed + delay > self.deadline:
+                raise DeadlineExceeded(
+                    "%s failed after %d attempt(s) in %.1fs "
+                    "(deadline %.1fs): %s"
+                    % (describe or "rpc", attempt, elapsed, self.deadline,
+                       last),
+                    last_error=last, attempts=attempt,
+                    elapsed=elapsed) from last
+            time.sleep(delay)
+            if on_retry is not None:
+                try:
+                    on_retry(last, attempt)
+                except Exception as e:
+                    if not self.is_retryable(e):
+                        raise
+                    last = e
+
+
+class _Rule:
+    __slots__ = ("point", "action", "value", "limit", "fired")
+
+    def __init__(self, point, action, value, limit=0):
+        self.point = point
+        self.action = action
+        self.value = value
+        self.limit = int(limit)
+        self.fired = 0
+
+
+class FaultInjector:
+    """Probabilistic fault hooks at named injection points.
+
+    Spec grammar (comma-separated entries, colon-separated fields):
+      <point>:drop:<prob>[:<limit>]    raise a RETRYABLE InjectedFault
+                                       with probability <prob>
+      <point>:delay:<secs>[:<limit>]   sleep <secs> before the call
+      <point>:error:<prob>[:<limit>]   raise a FATAL InjectedFault
+    ``limit`` caps total firings of that rule (0 / omitted = unlimited).
+    Known points: send_grad, get_param, prefetch, send_barrier,
+    fetch_barrier, master_rpc (a rule may also name any custom point).
+    """
+
+    ACTIONS = ("drop", "delay", "error")
+
+    def __init__(self, spec="", seed=None):
+        self.rules = self._parse(spec)
+        self._rng = random.Random(seed or None)
+        self._lock = threading.Lock()
+        self.stats = {}
+
+    @classmethod
+    def from_env(cls):
+        return cls(FLAGS.fault_spec, seed=FLAGS.fault_seed or None)
+
+    @staticmethod
+    def _parse(spec):
+        rules = []
+        for entry in (spec or "").split(","):
+            entry = entry.strip()
+            if not entry:
+                continue
+            fields = entry.split(":")
+            if len(fields) not in (3, 4):
+                raise ValueError(
+                    "bad fault spec entry %r: want "
+                    "point:action:value[:limit]" % entry)
+            point, action, value = fields[0], fields[1], fields[2]
+            if action not in FaultInjector.ACTIONS:
+                raise ValueError("bad fault action %r in %r (want one of "
+                                 "%s)" % (action, entry,
+                                          "/".join(FaultInjector.ACTIONS)))
+            limit = int(fields[3]) if len(fields) == 4 else 0
+            rules.append(_Rule(point, action, float(value), limit))
+        return rules
+
+    def fire(self, point):
+        """Run every rule registered for ``point`` — may sleep or raise."""
+        for rule in self.rules:
+            if rule.point != point:
+                continue
+            with self._lock:
+                if rule.limit and rule.fired >= rule.limit:
+                    continue
+                if rule.action == "delay":
+                    hit = True
+                else:
+                    hit = self._rng.random() < rule.value
+                if not hit:
+                    continue
+                rule.fired += 1
+                self.stats[point] = self.stats.get(point, 0) + 1
+            if rule.action == "delay":
+                time.sleep(rule.value)
+            elif rule.action == "drop":
+                raise InjectedFault(point, "drop", retryable=True)
+            else:
+                raise InjectedFault(point, "error", retryable=False)
+
+
+_injector = None
+_injector_lock = threading.Lock()
+
+
+def get_injector():
+    global _injector
+    if _injector is None:
+        with _injector_lock:
+            if _injector is None:
+                _injector = FaultInjector.from_env()
+    return _injector
+
+
+def install_faults(spec, seed=None):
+    """Replace the process-wide injector (tests).  Returns it."""
+    global _injector
+    with _injector_lock:
+        _injector = FaultInjector(spec, seed=seed)
+    return _injector
+
+
+def fault_point(name):
+    """Injection hook — a no-op unless FLAGS_fault_spec names ``name``."""
+    inj = get_injector()
+    if inj.rules:
+        inj.fire(name)
+
+
+class EndpointResolver:
+    """Map a logical pserver endpoint to its current physical endpoint.
+
+    A restarted pserver re-registers in discovery.EndpointRegistry under
+    the same shard id (PADDLE_PSERVER_ID, default: its endpoint string),
+    possibly on a new port; the stale entry ages out by TTL.  The
+    resolver snapshots logical-endpoint -> shard at construction and
+    re-reads the registry per resolve."""
+
+    def __init__(self, registry, kind="pserver", logical_eps=None):
+        self.registry = registry
+        self.kind = kind
+        self._shard_of = {}
+        for ep, meta in registry.list_meta(kind):
+            self._shard_of[ep] = (meta or {}).get("shard", ep)
+        for ep in logical_eps or []:
+            self._shard_of.setdefault(ep, ep)
+
+    def resolve(self, logical_ep):
+        """Current endpoint serving logical_ep's shard, or None when the
+        shard has no live registration right now."""
+        shard = self._shard_of.get(logical_ep, logical_ep)
+        for ep, meta in self.registry.list_meta(self.kind):
+            if (meta or {}).get("shard", ep) == shard:
+                return ep
+        return None
+
+
+def watchdog_error(op_name, endpoints, status_fn, cause=None):
+    """Build a WatchdogTimeout naming what each pserver is waiting on.
+
+    ``status_fn(ep)`` -> the server's BarrierStatus dict (best-effort;
+    an unreachable server is reported as such rather than masking the
+    timeout)."""
+    details = []
+    for ep in endpoints:
+        try:
+            st = status_fn(ep)
+            missing = st.get("waiting_for") or []
+            unseen = st["alive"] - len(st.get("known", [])) \
+                if "alive" in st else 0
+            part = ("%s: round=%s barriers=%s/%s"
+                    % (ep, st.get("applied_round"), st.get("barriers"),
+                       st.get("alive")))
+            if missing:
+                part += " waiting on %s" % missing
+            if unseen > 0:
+                part += " (+%d trainer(s) never connected)" % unseen
+            details.append(part)
+        except Exception as e:
+            details.append("%s: unreachable (%s)" % (ep, e))
+    msg = ("%s watchdog: distributed %s exceeded its deadline instead of "
+           "hanging; per-pserver barrier state: %s"
+           % (op_name, op_name, "; ".join(details) or "<none>"))
+    if cause is not None:
+        msg += " | cause: %s" % cause
+    err = WatchdogTimeout(msg)
+    err.details = details
+    return err

@@ -15,14 +15,33 @@ Implementation notes (TPU-host path):
   bumps ``applied_round``; GetVariable(round) blocks until
   ``applied_round >= round``.  SendComplete decrements fanin (reference
   framework/executor.cc:50 SendComplete) and stops the server at zero.
+
+Failure-path design (distributed/resilience.py is the policy home):
+- Every SendVariable/SendBarrier carries a (round, sender) identity
+  packed into the message's extra field, so the server DEDUPS by sender:
+  replaying a round after a reconnect is idempotent, which is what makes
+  client-side retry safe for non-idempotent gradient traffic.
+- SendBarrier ACKS ONLY AFTER the round is applied — and, on checkpoint
+  rounds, durably snapshotted — so a SIGKILL at any point either loses
+  an un-acked round (every trainer still holds it in its replay cache
+  and resends) or nothing (the round is already on disk).
+- The client keeps a per-endpoint replay cache of the current round's
+  grads; any retryable failure reconnects (re-resolving the endpoint via
+  discovery when a resolver is installed) and replays the round first.
+- A server-side trainer lease (reference go/master/service.go:368
+  checkTimeout) expires a trainer that dies mid-round: the sync fanin
+  decrements and the surviving trainers' round completes.
 """
 from __future__ import annotations
 
 import os
 import threading
+import time
 from concurrent import futures
 
 import numpy as np
+
+from .resilience import FLAGS, InjectedFault, RetryPolicy, fault_point
 
 SERVICE = "paddle_tpu.PServer"
 
@@ -140,17 +159,46 @@ def _dec_msg(data):
     return name, extra
 
 
+# -- (round, sender, seq) identity packed into the 8-byte extra field -----
+# Bit 62 flags the packed form so a legacy plain-round extra (always a
+# small non-negative step count) decodes as an anonymous send; then 14
+# bits of per-sender send sequence (async dedup), 24 bits of round, and
+# 24 bits of per-process sender token.
+_WIRE_SENDER_FLAG = 1 << 62
+_SEQ_MASK = (1 << 14) - 1
+_ROUND_MASK = (1 << 24) - 1
+_SENDER_MASK = (1 << 24) - 1
+
+
+def _pack_round_sender(round_, sender, seq=0):
+    return (_WIRE_SENDER_FLAG | ((int(seq) & _SEQ_MASK) << 48)
+            | ((int(round_) & _ROUND_MASK) << 24)
+            | (int(sender) & _SENDER_MASK))
+
+
+def _unpack_round_sender(extra):
+    """-> (round, sender, seq) — sender is None (and seq 0) for
+    legacy/anonymous extras."""
+    if extra > 0 and (extra & _WIRE_SENDER_FLAG):
+        return ((extra >> 24) & _ROUND_MASK, extra & _SENDER_MASK,
+                (extra >> 48) & _SEQ_MASK)
+    return extra, None, 0
+
+
 class VariableServer:
     """Parameter-server side: owns the scope, applies optimize blocks.
 
     ``grad_to_block``: grad(-block) var name -> pserver sub-block index.
     ``apply_block``: callable(block_idx) running one optimize sub-block
     against the server scope (wired to the executor by listen_and_serv).
+    ``trainer_lease``: seconds of mid-round silence after which a known
+    trainer is expired from the sync fanin (0 disables; reference
+    go/master/service.go:368 checkTimeout).
     """
 
     def __init__(self, scope, grad_to_block, apply_block, fanin,
                  sync_mode=True, checkpoint_dir=None,
-                 checkpoint_every_n=0):
+                 checkpoint_every_n=0, trainer_lease=None):
         import grpc
 
         self.scope = scope
@@ -163,11 +211,22 @@ class VariableServer:
         # server resumes instead of reinitializing)
         self.checkpoint_dir = checkpoint_dir
         self.checkpoint_every_n = int(checkpoint_every_n or 0)
+        self.trainer_lease = float(
+            FLAGS.trainer_lease if trainer_lease is None else trainer_lease)
 
         self._cv = threading.Condition()
-        self._pending = {g: [] for g in self.grad_to_block}
+        # grad name -> {sender key: array}; sender-keyed so a replayed
+        # round overwrites instead of double-counting in the sync mean
+        self._pending = {g: {} for g in self.grad_to_block}
         self._applied_round = 0
-        self._barriers = 0
+        self._barrier_senders = set()   # senders barriered this round
+        self._barrier_round = -1        # highest round those barriers name
+        self._legacy_barriers = 0       # anonymous (empty-payload) barriers
+        self._anon_seq = 0
+        self._senders = {}              # sender -> {"label", "last_seen"}
+        self._expired = set()           # senders removed by lease expiry
+        self._completed = set()         # senders that sent SendComplete
+        self._async_applied = {}        # (sender, name) -> last applied seq
         self._alive = self.fanin_total
         self._shutdown = threading.Event()
         self._ckpt_lock = threading.Lock()  # one save at a time
@@ -181,6 +240,9 @@ class VariableServer:
                         os.path.join(cand, "_SUCCESS")):
                     self.load_shard(cand)
                     break
+        # rounds that are visible AND safe against a crash: equal to
+        # _applied_round except inside a checkpoint-write window
+        self._durable_round = self._applied_round
 
         handlers = {
             "SendVariable": self._h(self._send_variable),
@@ -188,14 +250,17 @@ class VariableServer:
             "PrefetchVariable": self._h(self._prefetch_variable),
             "SendBarrier": self._h(self._send_barrier),
             "FetchBarrier": self._h(self._fetch_barrier),
+            "BarrierStatus": self._h(self._barrier_status),
             "ToggleProfile": self._h(self._toggle_profile),
             "SendComplete": self._h(self._send_complete),
         }
-        # enough workers that fanin-1 blocked GetVariable waiters can never
-        # starve the SendBarrier that would wake them
+        # enough workers that fanin-1 blocked GetVariable waiters (plus
+        # retried barrier handlers that linger until their client's
+        # cancellation is noticed) can never starve the SendBarrier that
+        # would wake them
         self._server = grpc.server(
             futures.ThreadPoolExecutor(
-                max_workers=max(16, 4 * self.fanin_total + 4)),
+                max_workers=max(32, 8 * self.fanin_total + 8)),
             options=GRPC_OPTIONS)
         self._server.add_generic_rpc_handlers((
             grpc.method_handlers_generic_handler(SERVICE, handlers),))
@@ -205,7 +270,7 @@ class VariableServer:
         import grpc
 
         return grpc.unary_unary_rpc_method_handler(
-            lambda req, ctx: fn(req))
+            lambda req, ctx: fn(req, ctx))
 
     # -- lifecycle --
     def start(self, endpoint):
@@ -228,6 +293,8 @@ class VariableServer:
                      "GetVariable": self._get_variable})
             except Exception:
                 self._fast = None
+        if self.sync_mode and self.trainer_lease > 0:
+            threading.Thread(target=self._lease_loop, daemon=True).start()
         return port
 
     def wait(self):
@@ -237,34 +304,146 @@ class VariableServer:
             self._fast.stop()
         self._server.stop(grace=1).wait()
 
-    # -- handlers --
-    def _send_variable(self, req):
-        name, arr, _round = _dec_tensor(req)
+    # -- condition helpers --
+    def _wait_cv(self, pred, ctx):
+        """Wait (lock held) until ``pred`` or shutdown; polls so a
+        handler whose client cancelled/died exits instead of pinning a
+        pool thread forever.  Returns False when the client vanished."""
+        while not pred() and not self._shutdown.is_set():
+            if ctx is not None and not ctx.is_active():
+                return False
+            self._cv.wait(timeout=0.25)
+        return True
+
+    def _touch(self, sender, label=None):
+        """Record contact from ``sender`` (lock held).  An expired
+        trainer that turns out to be alive rejoins the fanin."""
+        ent = self._senders.get(sender)
+        if ent is None:
+            ent = {"label": "sender-%06x" % sender, "last_seen": 0.0}
+            self._senders[sender] = ent
+        if label:
+            ent["label"] = label
+        ent["last_seen"] = time.time()
+        if sender in self._expired:
+            self._expired.discard(sender)
+            self._alive = min(self._alive + 1, self.fanin_total)
+
+    def _barrier_count(self):
+        return len(self._barrier_senders) + self._legacy_barriers
+
+    def _maybe_apply_locked(self):
+        """Apply the round if every live trainer barriered (lock held).
+        Returns a state snapshot the CALLER must persist (outside the
+        lock) before bumping _durable_round, or None."""
+        if not (0 < self._alive <= self._barrier_count()):
+            return None
+        self._apply_round()
+        if (self.checkpoint_every_n and self.checkpoint_dir and
+                self._applied_round % self.checkpoint_every_n == 0):
+            # collect under the lock, WRITE outside it — disk I/O must
+            # not stall every other RPC handler
+            return self._collect_state()
+        self._durable_round = self._applied_round
+        return None
+
+    def _persist_and_ack(self, snapshot):
+        """Write the snapshot, then publish durability (barrier acks for
+        this round are blocked until _durable_round catches up)."""
+        if snapshot is None:
+            return
+        self.save_shard(self.checkpoint_dir, snapshot)
         with self._cv:
+            self._durable_round = self._applied_round
+            self._cv.notify_all()
+
+    def _lease_loop(self):
+        """Expire trainers that die mid-round: when barriers are stalled
+        and a KNOWN sender that has not barriered this round has been
+        silent past the lease, drop it from the fanin and complete the
+        round with the survivors (mirrors Master._check_timeouts)."""
+        interval = max(0.05, self.trainer_lease / 3.0)
+        while not self._shutdown.wait(interval):
+            snapshot = None
+            with self._cv:
+                if self._barrier_count() == 0:
+                    continue    # nobody is waiting on a round
+                now = time.time()
+                for sender, ent in list(self._senders.items()):
+                    if sender in self._barrier_senders or \
+                            sender in self._expired or \
+                            sender in self._completed:
+                        continue   # contributed, gone, or cleanly done
+                    if now - ent["last_seen"] > self.trainer_lease:
+                        self._expired.add(sender)
+                        self._alive -= 1
+                snapshot = self._maybe_apply_locked()
+            self._persist_and_ack(snapshot)
+
+    # -- handlers --
+    def _send_variable(self, req, ctx=None):
+        name, arr, extra = _dec_tensor(req)
+        round_, sender, seq = _unpack_round_sender(extra)
+        with self._cv:
+            if sender is not None:
+                self._touch(sender)
             if name not in self._pending:
                 # direct write (e.g. init push or non-optimized var)
                 self.scope.set(name, arr)
                 return b""
-            self._pending[name].append(arr)
+            if sender is None:
+                key = ("anon", self._anon_seq)
+                self._anon_seq += 1
+            else:
+                if self.sync_mode and round_ < self._applied_round:
+                    return b""   # stale replay of an applied round
+                if not self.sync_mode and seq and \
+                        self._async_applied.get((sender, name)) == seq:
+                    # async applies on arrival and clears pending, so
+                    # the round-replay dedup can't help a retried send:
+                    # the per-sender send sequence is what makes a
+                    # resend of an already-applied grad a no-op
+                    return b""
+                key = sender
+            self._pending[name][key] = arr
             if not self.sync_mode:
                 self._apply_one(name)
+                if sender is not None and seq:
+                    self._async_applied[(sender, name)] = seq
                 self._cv.notify_all()
         return b""
 
-    def _send_barrier(self, req):
+    def _send_barrier(self, req, ctx=None):
         snapshot = None
         with self._cv:
-            self._barriers += 1
-            if self._barriers >= self._alive:
-                self._apply_round()
-                if (self.checkpoint_every_n and self.checkpoint_dir and
-                        self._applied_round %
-                        self.checkpoint_every_n == 0):
-                    # collect under the lock, WRITE outside it — disk
-                    # I/O must not stall every other RPC handler
-                    snapshot = self._collect_state()
-        if snapshot is not None:
-            self.save_shard(self.checkpoint_dir, snapshot)
+            if req:
+                label, extra = _dec_msg(req)
+                round_, sender, _ = _unpack_round_sender(extra)
+            else:
+                label, round_, sender = None, None, None
+            if sender is not None:
+                self._touch(sender, label)
+                if round_ >= self._applied_round:
+                    self._barrier_senders.add(sender)
+                    self._barrier_round = max(self._barrier_round, round_)
+                    snapshot = self._maybe_apply_locked()
+                # else: replay of an applied round — do NOT join the
+                # current round's barrier set, but do NOT ack early
+                # either: the round may still be mid-checkpoint-write,
+                # and the ack must imply durability (the wait below is
+                # instant once _durable_round caught up)
+            else:
+                round_ = None    # legacy wire: count it, ack immediately
+                self._legacy_barriers += 1
+                snapshot = self._maybe_apply_locked()
+        self._persist_and_ack(snapshot)
+        if round_ is None:
+            return b""  # legacy anonymous barrier: ack immediately
+        # ack only once the round is applied AND (on checkpoint rounds)
+        # durably on disk — a crash before this point leaves every
+        # trainer un-acked and replaying the round, so nothing is lost
+        with self._cv:
+            self._wait_cv(lambda: self._durable_round > round_, ctx)
         return b""
 
     # -- shard checkpointing ------------------------------------------
@@ -305,8 +484,8 @@ class VariableServer:
                 with open(os.path.join(tmp, quote(name, safe="")),
                           "wb") as f:
                     np.save(f, arr)
-            with open(os.path.join(tmp, "_SUCCESS"), "w") as f:
-                f.write(str(round_))
+            from paddle_tpu.core.fsutil import atomic_write
+            atomic_write(os.path.join(tmp, "_SUCCESS"), str(round_))
             old = dirname + ".old"
             shutil.rmtree(old, ignore_errors=True)
             if os.path.isdir(dirname):
@@ -328,19 +507,19 @@ class VariableServer:
             with open(os.path.join(dirname, fn), "rb") as f:
                 self.scope.set(unquote(fn), np.load(f))
 
-    def _get_variable(self, req):
+    def _get_variable(self, req, ctx=None):
         name, round_ = _dec_msg(req)
         with self._cv:
             if self.sync_mode:
-                self._cv.wait_for(
-                    lambda: self._applied_round >= round_
-                    or self._shutdown.is_set())
+                if not self._wait_cv(
+                        lambda: self._applied_round >= round_, ctx):
+                    return b""  # client gone: response is discarded
             # materialize to host INSIDE the lock: a concurrent async-mode
             # apply donates the param's device buffer, invalidating it
             val = np.asarray(self.scope.find_var(name))
         return _enc_tensor(name, val)
 
-    def _prefetch_variable(self, req):
+    def _prefetch_variable(self, req, ctx=None):
         """Row-subset read of a sharded table (reference
         send_recv.proto:27 PrefetchVariable + grpc_server.cc prefetch
         path): request carries LOCAL row ids of this server's block;
@@ -350,17 +529,40 @@ class VariableServer:
         name, ids, round_ = _dec_tensor(req)
         with self._cv:
             if self.sync_mode:
-                self._cv.wait_for(
-                    lambda: self._applied_round >= round_
-                    or self._shutdown.is_set())
+                if not self._wait_cv(
+                        lambda: self._applied_round >= round_, ctx):
+                    return b""
             table = np.asarray(self.scope.find_var(name))
         rows = table[np.asarray(ids, np.int64)]
         return _enc_tensor(name, rows)
 
-    def _fetch_barrier(self, req):
+    def _fetch_barrier(self, req, ctx=None):
         return b""
 
-    def _toggle_profile(self, req):
+    def _barrier_status(self, req, ctx=None):
+        """Introspection for the trainer-side watchdog: who barriered
+        the current round, and who the server is still waiting on."""
+        import json
+
+        with self._cv:
+            arrived = sorted(
+                self._senders[s]["label"] for s in self._barrier_senders
+                if s in self._senders)
+            known = sorted(
+                ent["label"] for s, ent in self._senders.items()
+                if s not in self._expired)
+            return json.dumps({
+                "applied_round": self._applied_round,
+                "durable_round": self._durable_round,
+                "alive": self._alive,
+                "fanin": self.fanin_total,
+                "barriers": self._barrier_count(),
+                "arrived": arrived,
+                "known": known,
+                "waiting_for": sorted(set(known) - set(arrived)),
+            }).encode()
+
+    def _toggle_profile(self, req, ctx=None):
         """Trainer-driven server profiling (reference
         send_recv.proto:76 VariableMessage.profile: the trainer's
         profiler state rides the RPC envelope and switches the
@@ -388,22 +590,40 @@ class VariableServer:
             prof.stop_profiler(sorted_key="total", profile_path=path)
         return b""
 
-    def _send_complete(self, req):
+    def _send_complete(self, req, ctx=None):
+        snapshot = None
         with self._cv:
-            self._alive -= 1
+            sender = None
+            if req:
+                _, extra = _dec_msg(req)
+                _, sender, _ = _unpack_round_sender(extra)
+            if sender is None:
+                self._alive -= 1        # legacy anonymous complete
+            elif sender in self._completed:
+                pass                    # duplicate/retried complete
+            else:
+                self._completed.add(sender)
+                if sender in self._expired:
+                    # the lease already decremented for this trainer —
+                    # a second decrement would shut the server down
+                    # under trainers still mid-round
+                    self._expired.discard(sender)
+                else:
+                    self._alive -= 1
             if self._alive <= 0:
                 self._shutdown.set()
-            elif self._barriers >= self._alive > 0:
+            else:
                 # stragglers of a half-round: apply what arrived
-                self._apply_round()
+                snapshot = self._maybe_apply_locked()
             self._cv.notify_all()
+        self._persist_and_ack(snapshot)
         return b""
 
     # -- application (lock held) --
     def _apply_one(self, gname):
         from paddle_tpu.core.selected_rows import SelectedRows
 
-        vals = self._pending[gname]
+        vals = list(self._pending[gname].values())
         if not vals:
             return
         if any(isinstance(v, SelectedRows) for v in vals):
@@ -417,29 +637,58 @@ class VariableServer:
         elif len(vals) == 1:
             agg = np.asarray(vals[0])
         else:
-            agg = np.sum(vals, axis=0) / len(vals)
+            # wire-decoded arrays are READ-ONLY views over the gRPC
+            # message buffer: copy once, then accumulate in place
+            agg = np.array(vals[0], copy=True)
+            for v in vals[1:]:
+                agg += v
+            agg /= len(vals)
         self.scope.set(gname, agg)
-        self._pending[gname] = []
+        self._pending[gname] = {}
         self.apply_block(self.grad_to_block[gname])
 
     def _apply_round(self):
         for g in self._pending:
             self._apply_one(g)
+        if self._barrier_round > self._applied_round:
+            # restarted from a checkpoint OLDER than the trainers'
+            # round (checkpoint_every_n > 1): the skipped rounds' grads
+            # are unrecoverable, so jump to the trainers' round and
+            # count the replayed grads ONCE — bounded staleness instead
+            # of re-applying the same gradients once per missing round
+            self._applied_round = self._barrier_round
         self._applied_round += 1
-        self._barriers = 0
+        self._barrier_senders = set()
+        self._barrier_round = -1
+        self._legacy_barriers = 0
         self._cv.notify_all()
 
 
 class RPCClient:
     """Trainer side (reference grpc_client.h:168).  Process-wide singleton:
-    send/recv ops share channels and the sync round counter."""
+    send/recv ops share channels, the sync round counter, the (round,
+    sender) replay cache, and the retry policy."""
 
     _instance = None
 
     def __init__(self):
+        import socket as _socket
+        import uuid
+
         self._channels = {}
         self._lock = threading.Lock()
         self.step = 0
+        # per-process identity: the server dedups (round, sender) so
+        # replaying a round after a reconnect cannot double-count
+        self.sender = uuid.uuid4().int & _SENDER_MASK
+        self._seq = 0   # per-send sequence: async-mode resend dedup
+        self.label = "trainer%s@%s:%d" % (
+            os.getenv("PADDLE_TRAINER_ID", "?"),
+            _socket.gethostname(), os.getpid())
+        self.retry = RetryPolicy.from_env()
+        self._resolver = None     # logical ep -> current physical ep
+        self._redirects = {}      # logical ep -> physical ep overrides
+        self._round_cache = {}    # ep -> {"round", "grads", "barriered"}
 
     @classmethod
     def instance(cls):
@@ -451,29 +700,125 @@ class RPCClient:
     def reset(cls):
         cls._instance = None
 
-    def _call(self, ep, method, payload):
+    def set_resolver(self, fn):
+        """Install an endpoint re-resolver (resilience.EndpointResolver
+        .resolve): consulted on reconnect so a pserver restarted on a
+        new port is found through the discovery registry."""
+        self._resolver = fn
+
+    # -- transport ----------------------------------------------------
+    def _phys(self, ep):
+        return self._redirects.get(ep, ep)
+
+    def _channel(self, phys):
         import grpc
 
         with self._lock:
-            ch = self._channels.get(ep)
+            ch = self._channels.get(phys)
             if ch is None:
-                ch = grpc.insecure_channel(ep, options=GRPC_OPTIONS)
-                self._channels[ep] = ch
-        fn = ch.unary_unary("/%s/%s" % (SERVICE, method))
-        return fn(payload, wait_for_ready=True)
+                ch = grpc.insecure_channel(phys, options=GRPC_OPTIONS)
+                self._channels[phys] = ch
+        return ch
+
+    def _call(self, ep, method, payload, timeout=None):
+        fn = self._channel(self._phys(ep)).unary_unary(
+            "/%s/%s" % (SERVICE, method))
+        return fn(payload, wait_for_ready=True, timeout=timeout)
 
     def _stub(self, ep, method):
-        import grpc
+        return self._channel(self._phys(ep)).unary_unary(
+            "/%s/%s" % (SERVICE, method))
 
+    def _reconnect(self, ep):
+        """Drop the (possibly dead) channel and re-resolve the endpoint
+        through discovery when a resolver is installed."""
         with self._lock:
-            ch = self._channels.get(ep)
-            if ch is None:
-                ch = grpc.insecure_channel(ep, options=GRPC_OPTIONS)
-                self._channels[ep] = ch
-        return ch.unary_unary("/%s/%s" % (SERVICE, method))
+            ch = self._channels.pop(self._phys(ep), None)
+        if ch is not None:
+            try:
+                ch.close()
+            except Exception:
+                pass
+        if self._resolver is not None:
+            try:
+                new = self._resolver(ep)
+            except Exception:
+                new = None
+            if new and new != ep:
+                self._redirects[ep] = new
+            elif new == ep:
+                self._redirects.pop(ep, None)
 
+    # -- replay cache -------------------------------------------------
+    def _next_seq(self):
+        """Per-send sequence, 1..16383 wrapping (0 = 'no seq').  An
+        async-mode server drops a resend whose (sender, name, seq)
+        already applied; a replay reuses the ORIGINAL seq."""
+        self._seq = (self._seq % _SEQ_MASK) + 1
+        return self._seq
+
+    def _record_send(self, ep, name, arr):
+        """Cache this round's send for replay; returns its seq."""
+        c = self._round_cache.get(ep)
+        if c is None or c["round"] != self.step:
+            c = {"round": self.step, "grads": {}, "barriered": False}
+            self._round_cache[ep] = c
+        # latest value per name: a round resend replaces, never appends
+        seq = self._next_seq()
+        c["grads"][name] = (arr, seq)
+        return seq
+
+    def _barrier_payload(self, round_):
+        return _enc_msg(self.label, _pack_round_sender(round_, self.sender))
+
+    def _replay_round(self, ep):
+        """After a reconnect the server may have restarted and lost this
+        round's un-applied state: resend the cached grads (the server
+        dedups by sender+seq, so this is idempotent) and, if this
+        trainer already barriered the round, the barrier too."""
+        c = self._round_cache.get(ep)
+        if not c:
+            return
+        to = self.retry.call_timeout
+        for name, (arr, seq) in c["grads"].items():
+            self._call(
+                ep, "SendVariable",
+                _enc_tensor(name, arr,
+                            _pack_round_sender(c["round"], self.sender,
+                                               seq)),
+                timeout=to)
+        if c["barriered"]:
+            self._call(ep, "SendBarrier", self._barrier_payload(c["round"]),
+                       timeout=to)
+
+    def _retry_op(self, ep, method, payload, point=None, replay=False,
+                  decode=False):
+        """One RPC under the retry policy: per-attempt timeout, capped
+        backoff, reconnect (+ optional round replay) between attempts,
+        DeadlineExceeded when the budget runs out."""
+        def attempt():
+            if point:
+                fault_point(point)
+            return self._call(ep, method, payload,
+                              timeout=self.retry.call_timeout)
+
+        def on_retry(exc, attempt_no):
+            self._reconnect(ep)
+            if replay:
+                self._replay_round(ep)
+
+        reply = self.retry.run(
+            attempt, describe="%s(%s)" % (method, ep), on_retry=on_retry)
+        return _dec_tensor(reply)[1] if decode else reply
+
+    # -- data plane ---------------------------------------------------
     def send_var(self, ep, name, arr):
-        self._call(ep, "SendVariable", _enc_tensor(name, arr, self.step))
+        seq = self._record_send(ep, name, arr)
+        self._retry_op(
+            ep, "SendVariable",
+            _enc_tensor(name, arr, _pack_round_sender(self.step,
+                                                      self.sender, seq)),
+            point="send_grad", replay=True)
 
     def _fast_pool(self):
         pool = getattr(self, "_fastwire_pool", None)
@@ -487,28 +832,81 @@ class RPCClient:
         """One fastwire round-trip, or None when the endpoint has no
         data plane (gRPC fallback).  A STALE pooled connection (failure
         before the payload went out) retries once on a fresh one; a
-        failure after the payload was sent must raise — the server may
-        already have applied the frame, and resending (fast or gRPC)
-        would double-apply a non-idempotent gradient."""
+        failure after the payload was sent raises a retryable
+        ConnectionError — the wire protocol dedups (round, sender), so
+        the caller's retry path can safely replay the frame."""
         pool = self._fast_pool()
         if pool is None:
             return None
         for _ in range(2):
-            conn = pool.checkout(ep)
+            conn = pool.checkout(self._phys(ep))
             if conn is None:
                 return None
             try:
                 reply = conn.call(method, payload)
-                pool.checkin(ep, conn)
+                pool.checkin(self._phys(ep), conn)
                 return reply
             except ConnectionError as e:
                 pool.discard(conn)
                 if getattr(e, "sent_payload", True):
-                    raise RuntimeError(
-                        "fastwire connection to %s failed after the "
-                        "frame was sent; cannot safely resend a "
-                        "possibly-applied %s" % (ep, method)) from e
+                    raise
         return None
+
+    def _overlapped(self, method, point, eps, payloads, replay,
+                    use_fast=True):
+        """Shared fan-out: first attempt everything in flight together —
+        fastwire threads where the endpoint offers a data plane, then
+        gRPC futures — and push any retryable failure through the
+        sequential retry path (reconnect + optional round replay).
+        Fatal errors surface immediately.  Returns raw replies."""
+        n = len(eps)
+        results = [None] * n
+        pending = list(range(n))
+        pool = self._fast_pool() if use_fast else None
+        if pool is not None:
+            fatal = []
+
+            def one(i):
+                try:
+                    fault_point(point)
+                    results[i] = self._fast_call(eps[i], method,
+                                                 payloads[i])
+                except Exception as e:
+                    if not RetryPolicy.is_retryable(e):
+                        fatal.append(e)   # re-raised on the main thread
+                    results[i] = None     # -> retried on the gRPC path
+
+            ts = [threading.Thread(target=one, args=(i,))
+                  for i in pending]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join()
+            if fatal:
+                raise fatal[0]
+            pending = [i for i in pending if results[i] is None]
+        futs, need_retry = [], []
+        for i in pending:
+            try:
+                fault_point(point)
+                futs.append((i, self._stub(eps[i], method)
+                             .future(payloads[i], wait_for_ready=True,
+                                     timeout=self.retry.call_timeout)))
+            except InjectedFault as e:
+                if not e.retryable:
+                    raise
+                need_retry.append(i)
+        for i, f in futs:
+            try:
+                results[i] = f.result()
+            except Exception as e:
+                if not RetryPolicy.is_retryable(e):
+                    raise
+                need_retry.append(i)
+        for i in need_retry:
+            results[i] = self._retry_op(eps[i], method, payloads[i],
+                                        point=point, replay=replay)
+        return results
 
     def send_vars(self, triples):
         """Overlapped sends: [(ep, name, arr)] in flight together
@@ -516,85 +914,78 @@ class RPCClient:
         the fastwire data plane when the server offers it; the C
         send loop releases the GIL, so the per-shard threads genuinely
         overlap."""
-        pool = self._fast_pool()
-        if pool is not None:
-            results = [None] * len(triples)
-
-            def one(i, ep, name, arr):
-                results[i] = self._fast_call(
-                    ep, "SendVariable", _enc_tensor(name, arr, self.step))
-
-            ts = [threading.Thread(target=one, args=(i, ep, nm, ar))
-                  for i, (ep, nm, ar) in enumerate(triples)]
-            for t in ts:
-                t.start()
-            for t in ts:
-                t.join()
-            rest = [triples[i] for i, r in enumerate(results)
-                    if r is None]
-        else:
-            rest = list(triples)
-        if not rest:
-            return
-        futs = [self._stub(ep, "SendVariable").future(
-            _enc_tensor(name, arr, self.step), wait_for_ready=True)
-            for ep, name, arr in rest]
-        for f in futs:
-            f.result()
+        payloads = []
+        for ep, name, arr in triples:
+            seq = self._record_send(ep, name, arr)
+            payloads.append(_enc_tensor(
+                name, arr,
+                _pack_round_sender(self.step, self.sender, seq)))
+        self._overlapped("SendVariable", "send_grad",
+                         [t[0] for t in triples], payloads, replay=True)
 
     def get_var(self, ep, name, round_=None):
         round_ = self.step if round_ is None else round_
-        _, arr, _ = _dec_tensor(
-            self._call(ep, "GetVariable", _enc_msg(name, round_)))
-        return arr
+        return self._retry_op(ep, "GetVariable", _enc_msg(name, round_),
+                              point="get_param", replay=True, decode=True)
 
     def get_vars(self, pairs, round_=None):
         """Overlapped gets: [(ep, name)] -> [arr], one joined wait
         (reference AsyncGetVar + Wait); fastwire data plane when
         offered."""
         round_ = self.step if round_ is None else round_
-        pool = self._fast_pool()
-        results = [None] * len(pairs)
-        rest_idx = list(range(len(pairs)))
-        if pool is not None:
-            def one(i, ep, name):
-                r = self._fast_call(ep, "GetVariable",
-                                    _enc_msg(name, round_))
-                if r is not None:
-                    results[i] = _dec_tensor(r)[1]
-
-            ts = [threading.Thread(target=one, args=(i, ep, nm))
-                  for i, (ep, nm) in enumerate(pairs)]
-            for t in ts:
-                t.start()
-            for t in ts:
-                t.join()
-            rest_idx = [i for i in rest_idx if results[i] is None]
-        futs = [(i, self._stub(pairs[i][0], "GetVariable").future(
-            _enc_msg(pairs[i][1], round_), wait_for_ready=True))
-            for i in rest_idx]
-        for i, f in futs:
-            results[i] = _dec_tensor(f.result())[1]
-        return results
+        replies = self._overlapped(
+            "GetVariable", "get_param", [ep for ep, _ in pairs],
+            [_enc_msg(name, round_) for _, name in pairs], replay=True)
+        return [_dec_tensor(r)[1] for r in replies]
 
     def prefetch_vars(self, triples, round_=None):
         """Overlapped row prefetches: [(ep, block_name, local_ids)] ->
         [rows] (reference AsyncPrefetchVar + Wait)."""
         round_ = self.step if round_ is None else round_
-        futs = [self._stub(ep, "PrefetchVariable").future(
-            _enc_tensor(name, np.asarray(ids, np.int64), round_),
-            wait_for_ready=True)
-            for ep, name, ids in triples]
-        return [_dec_tensor(f.result())[1] for f in futs]
+        replies = self._overlapped(
+            "PrefetchVariable", "prefetch", [t[0] for t in triples],
+            [_enc_tensor(name, np.asarray(ids, np.int64), round_)
+             for _, name, ids in triples],
+            replay=False, use_fast=False)
+        return [_dec_tensor(r)[1] for r in replies]
 
     def send_barrier(self, eps):
-        for ep in eps:
-            self._call(ep, "SendBarrier", b"")
+        """Barrier every pserver CONCURRENTLY: the server-side barrier
+        now blocks until the round is applied (and durably checkpointed
+        on checkpoint rounds), so sequential calls across endpoints
+        could deadlock if trainers ordered them differently."""
+        payload = self._barrier_payload(self.step)
+        errs = []
+
+        def one(ep):
+            try:
+                self._retry_op(ep, "SendBarrier", payload,
+                               point="send_barrier", replay=True)
+                c = self._round_cache.get(ep)
+                if c is not None and c["round"] == self.step:
+                    c["barriered"] = True
+            except Exception as e:
+                errs.append(e)
+
+        ts = [threading.Thread(target=one, args=(ep,)) for ep in eps]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        if errs:
+            raise errs[0]
         self.step += 1
 
     def fetch_barrier(self, eps):
         for ep in eps:
-            self._call(ep, "FetchBarrier", b"")
+            self._retry_op(ep, "FetchBarrier", b"", point="fetch_barrier")
+
+    def barrier_status(self, ep, timeout=5.0):
+        """The server's sync-barrier introspection (watchdog support)."""
+        import json
+
+        return json.loads(
+            self._call(ep, "BarrierStatus", b"", timeout=timeout).decode())
 
     def toggle_profile(self, eps, on, profile_path=""):
         """Switch profiling on every pserver from the trainer side
@@ -604,8 +995,13 @@ class RPCClient:
                        _enc_msg(profile_path, 1 if on else 0))
 
     def send_complete(self, eps):
+        # identity payload: the server must not double-decrement its
+        # fanin for a trainer the lease already expired, and must drop
+        # a duplicate complete from the same process
+        payload = _enc_msg(self.label,
+                           _pack_round_sender(self.step, self.sender))
         for ep in eps:
             try:
-                self._call(ep, "SendComplete", b"")
+                self._call(ep, "SendComplete", payload, timeout=10.0)
             except Exception:
                 pass  # server may already be down

@@ -217,13 +217,19 @@ def get_latest_checkpoint_serial(checkpoint_dir):
 
 
 def _scroll_delete(checkpoint_dir, max_num_checkpoints=3):
+    """Keep the newest ``max_num_checkpoints`` serial DIRS (serials may
+    be non-contiguous after crashes/manual cleanup — ranking is by
+    serial number, not by directory count)."""
     serials = []
     for d in os.listdir(checkpoint_dir):
-        if d.startswith(CHECKPOINT_PREFIX + "_"):
-            try:
-                serials.append(int(d.split("_")[-1]))
-            except ValueError:
-                pass
+        if not d.startswith(CHECKPOINT_PREFIX + "_"):
+            continue
+        if not os.path.isdir(os.path.join(checkpoint_dir, d)):
+            continue  # stray file (e.g. a torn tmp) is not a checkpoint
+        try:
+            serials.append(int(d.split("_")[-1]))
+        except ValueError:
+            pass
     serials.sort(reverse=True)
     for serial in serials[max_num_checkpoints:]:
         shutil.rmtree(_checkpoint_dir(checkpoint_dir, serial),
@@ -246,8 +252,13 @@ def save_checkpoint(executor, checkpoint_dir, trainer_id=0,
                                                    trainer_id)), "w") as f:
             json.dump(trainer_args, f)
     save_persistables(executor, model_dir, main_program)
-    with open(os.path.join(model_dir, SUCCESS_MARK_FILENAME), "w") as f:
-        f.write(str(time.time()))
+    # the marker commits the checkpoint: an atomic write so a crash
+    # mid-save can never leave a present-but-torn _SUCCESS (readers
+    # treat its presence as "this serial is complete")
+    from paddle_tpu.core.fsutil import atomic_write
+
+    atomic_write(os.path.join(model_dir, SUCCESS_MARK_FILENAME),
+                 str(time.time()))
     _scroll_delete(checkpoint_dir, max_num_checkpoints)
     return serial
 

@@ -177,13 +177,26 @@ class Trainer:
 
             registry = EndpointRegistry(disc_root)
             if role == "PSERVER":
+                ps_ep = os.getenv("PADDLE_CURRENT_IP", "") + ":" + port
+                # stable shard id (PADDLE_PSERVER_ID): a pserver that
+                # restarts on a NEW port re-registers under the same id,
+                # and trainers re-map through EndpointResolver instead
+                # of retrying the dead endpoint forever
                 registry.register(
-                    "pserver",
-                    os.getenv("PADDLE_CURRENT_IP", "") + ":" + port)
+                    "pserver", ps_ep,
+                    meta={"shard": os.getenv("PADDLE_PSERVER_ID", ps_ep)})
             eps = registry.wait_for(
                 "pserver", expected,
                 timeout=float(os.getenv("PADDLE_DISCOVERY_TIMEOUT",
                                         "60")))
+            if role == "TRAINER":
+                from paddle_tpu.distributed.resilience import \
+                    EndpointResolver
+                from paddle_tpu.distributed.rpc import RPCClient
+
+                RPCClient.instance().set_resolver(
+                    EndpointResolver(registry, "pserver",
+                                     logical_eps=eps).resolve)
         pserver_endpoints = ",".join(eps)
         trainers = int(os.getenv("PADDLE_TRAINERS", "1"))
         current_endpoint = os.getenv("PADDLE_CURRENT_IP", "") + ":" + port

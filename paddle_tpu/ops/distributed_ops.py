@@ -19,6 +19,8 @@ Wire layout used by the transpiler (fluid/transpiler/distribute_transpiler.py):
 """
 from __future__ import annotations
 
+import os
+
 import numpy as np
 
 from paddle_tpu.core.registry import register_op
@@ -55,6 +57,16 @@ def _sections_starts(sections):
     for s in sections:
         starts.append(starts[-1] + s)
     return starts
+
+
+def _watchdog(op_name, eps, client, exc):
+    """Convert an exhausted RPC deadline into a WatchdogTimeout naming
+    the peers every pserver is still waiting on — an indefinite
+    collective hang must die loudly, not silently (reference: trainers
+    blocked in a sync barrier when a peer crashed)."""
+    from paddle_tpu.distributed.resilience import watchdog_error
+
+    return watchdog_error(op_name, eps, client.barrier_status, exc)
 
 
 @_host("send")
@@ -96,29 +108,45 @@ def _send(executor, op, scope, feed, env=None):
 
 @_host("recv")
 def _recv(executor, op, scope, feed, env=None):
+    from paddle_tpu.distributed.resilience import DeadlineExceeded
     from paddle_tpu.distributed.rpc import RPCClient
 
     client = RPCClient.instance()
     out = op.output("Out")[0]
     eps = op.attr("epmap")
     names = op.attr("block_names")
-    parts = client.get_vars(list(zip(eps, names)))
+    try:
+        parts = client.get_vars(list(zip(eps, names)))
+    except DeadlineExceeded as e:
+        raise _watchdog("recv", sorted(set(eps)), client, e) from e
     val = parts[0] if len(parts) == 1 else np.concatenate(parts, axis=0)
     _write(out, val, scope, env)
 
 
 @_host("send_barrier")
 def _send_barrier(executor, op, scope, feed, env=None):
+    from paddle_tpu.distributed.resilience import DeadlineExceeded
     from paddle_tpu.distributed.rpc import RPCClient
 
-    RPCClient.instance().send_barrier(op.attr("endpoints"))
+    client = RPCClient.instance()
+    eps = op.attr("endpoints")
+    try:
+        client.send_barrier(eps)
+    except DeadlineExceeded as e:
+        raise _watchdog("send_barrier", eps, client, e) from e
 
 
 @_host("fetch_barrier")
 def _fetch_barrier(executor, op, scope, feed, env=None):
+    from paddle_tpu.distributed.resilience import DeadlineExceeded
     from paddle_tpu.distributed.rpc import RPCClient
 
-    RPCClient.instance().fetch_barrier(op.attr("endpoints"))
+    client = RPCClient.instance()
+    eps = op.attr("endpoints")
+    try:
+        client.fetch_barrier(eps)
+    except DeadlineExceeded as e:
+        raise _watchdog("fetch_barrier", eps, client, e) from e
 
 
 @_host("listen_and_serv")
@@ -128,6 +156,7 @@ def _listen_and_serv(executor, op, scope, feed, env=None):
     sub-blocks run through a nested ExecutorCore against the server
     scope."""
     from paddle_tpu.core.executor_impl import ExecutorCore
+    from paddle_tpu.distributed.resilience import FLAGS
     from paddle_tpu.distributed.rpc import VariableServer
 
     program = executor._current_program
@@ -144,12 +173,22 @@ def _listen_and_serv(executor, op, scope, feed, env=None):
     def apply_block(block_id):
         sub_exec.run(program, scope, block_id=block_id)
 
+    # shard checkpointing (reference go/pserver/service.go:346): restart
+    # resumes from the last snapshot instead of fresh init.  The op attr
+    # wins; FLAGS_pserver_checkpoint_root is the env path for spawned
+    # pserver processes — each endpoint gets its own subdir.
+    ckpt_dir = op.attr("checkpoint_dir", "") or None
+    if not ckpt_dir and FLAGS.pserver_checkpoint_root:
+        ckpt_dir = os.path.join(
+            FLAGS.pserver_checkpoint_root,
+            endpoint.replace(":", "_").replace("/", "_"))
+    ckpt_n = int(op.attr("checkpoint_every_n", 0) or 0) \
+        or int(FLAGS.pserver_checkpoint_every_n)
+
     server = VariableServer(
         scope, grad_to_block, apply_block, fanin, sync_mode,
-        # shard checkpointing (reference go/pserver/service.go:346):
-        # restart resumes from the last snapshot instead of fresh init
-        checkpoint_dir=op.attr("checkpoint_dir", "") or None,
-        checkpoint_every_n=int(op.attr("checkpoint_every_n", 0) or 0))
+        checkpoint_dir=ckpt_dir, checkpoint_every_n=ckpt_n,
+        trainer_lease=op.attr("trainer_lease", None))
     port = server.start(endpoint)
     port_file = op.attr("port_file", "")
     if port_file:

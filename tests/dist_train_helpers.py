@@ -115,8 +115,17 @@ def _transpile(trainer_id, pservers, trainers, kind="softmax",
     return t, main, startup, scope, loss
 
 
+def _apply_env(env):
+    """Install per-worker env BEFORE the first paddle/flag import reads
+    it (spawn children import this module fresh): fault specs, retry
+    knobs, and checkpoint roots all ride environment variables."""
+    if env:
+        os.environ.update(env)
+
+
 def run_pserver(endpoint, pservers, trainers, kind="softmax",
-                sync_mode=True):
+                sync_mode=True, env=None):
+    _apply_env(env)
     import paddle_tpu.fluid as fluid
 
     t, main, startup, scope, loss = _transpile(0, pservers, trainers,
@@ -130,7 +139,8 @@ def run_pserver(endpoint, pservers, trainers, kind="softmax",
 
 
 def run_trainer(trainer_id, pservers, trainers, steps, queue,
-                kind="softmax", sync_mode=True):
+                kind="softmax", sync_mode=True, env=None):
+    _apply_env(env)
     import paddle_tpu.fluid as fluid
     from paddle_tpu.distributed.rpc import RPCClient
 

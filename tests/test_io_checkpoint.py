@@ -97,3 +97,46 @@ def test_checkpoint_serial_dirs(tmp_path):
         np.testing.assert_allclose(np.asarray(scope.find_var("fc_w")), w)
         args = fluid.io.load_trainer_args(ckpt, 4, 0)
         assert args["step"] == 4
+
+
+def test_checkpoint_missing_success_marker_skipped(tmp_path):
+    """A serial dir without _SUCCESS is an interrupted save: it must be
+    invisible to get_latest_checkpoint_serial (a crash mid-save_checkpoint
+    leaves exactly this shape behind)."""
+    ckpt = str(tmp_path / "ckpt")
+    for serial, complete in [(0, True), (1, True), (2, False)]:
+        model = os.path.join(ckpt, "checkpoint_%d" % serial, "__model__")
+        os.makedirs(model)
+        if complete:
+            with open(os.path.join(model, "_SUCCESS"), "w") as f:
+                f.write("0")
+    assert fluid.io.get_latest_checkpoint_serial(ckpt) == 1
+    # no completed checkpoint at all -> -1 (fresh start)
+    empty = str(tmp_path / "empty")
+    os.makedirs(os.path.join(empty, "checkpoint_7", "__model__"))
+    assert fluid.io.get_latest_checkpoint_serial(empty) == -1
+    assert fluid.io.get_latest_checkpoint_serial(str(tmp_path / "no")) \
+        == -1
+
+
+def test_scroll_delete_keep_last_3_non_contiguous(tmp_path):
+    """Keep-last-3 ranks by SERIAL NUMBER even when serials are sparse
+    (crashes / manual cleanup leave holes), and ignores stray non-dir
+    entries that happen to match the prefix."""
+    from paddle_tpu.fluid.io import _scroll_delete
+
+    ckpt = str(tmp_path / "ckpt")
+    for serial in (1, 4, 9, 12):
+        model = os.path.join(ckpt, "checkpoint_%d" % serial, "__model__")
+        os.makedirs(model)
+        with open(os.path.join(model, "_SUCCESS"), "w") as f:
+            f.write("0")
+    stray = os.path.join(ckpt, "checkpoint_7")   # a FILE, not a dir
+    with open(stray, "w") as f:
+        f.write("torn tmp junk")
+    _scroll_delete(ckpt, max_num_checkpoints=3)
+    kept = sorted(d for d in os.listdir(ckpt)
+                  if os.path.isdir(os.path.join(ckpt, d)))
+    assert kept == ["checkpoint_12", "checkpoint_4", "checkpoint_9"]
+    assert os.path.exists(stray)   # never rm -rf something we don't own
+    assert fluid.io.get_latest_checkpoint_serial(ckpt) == 12

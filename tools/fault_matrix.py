@@ -1,0 +1,113 @@
+#!/usr/bin/env python
+"""Run the fault-injection resilience suite standalone, across a matrix
+of FLAGS_fault_spec presets.
+
+The tier-1 run excludes the process-killing tests (pytest -m 'not
+slow'); this driver is the standalone harness: for each preset it runs
+``tests/test_resilience.py`` (slow tests included) with the preset
+exported as FLAGS_fault_spec, and prints a pass/fail table.
+
+Usage:
+    python tools/fault_matrix.py                  # full preset matrix
+    python tools/fault_matrix.py drop_heavy mixed # chosen presets
+    python tools/fault_matrix.py --list
+    python tools/fault_matrix.py --spec "send_grad:drop:0.5:10"  # ad hoc
+
+Notes:
+  - The spawned trainer/pserver workers of the slow tests set their own
+    fault env per-test; the preset here ADDITIONALLY applies to every
+    in-process injection point, so heavier presets genuinely stress the
+    retry/replay machinery harder.
+  - FLAGS_fault_seed is pinned per run for reproducibility; pass
+    --seed 0 for OS entropy.
+"""
+import argparse
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+PRESETS = {
+    "none": "",
+    "drop_light": "send_grad:drop:0.05,get_param:drop:0.05",
+    "drop_heavy": ("send_grad:drop:0.3:20,get_param:drop:0.3:20,"
+                   "send_barrier:drop:0.3:10"),
+    "delay": "get_param:delay:0.1,send_grad:delay:0.05",
+    "master_flaky": "master_rpc:drop:0.2:20",
+    "mixed": ("send_grad:drop:0.15:15,get_param:delay:0.05:10,"
+              "get_param:drop:0.15:15,send_barrier:drop:0.25:6,"
+              "master_rpc:drop:0.1:10"),
+}
+
+
+def run_preset(name, spec, seed, pytest_args):
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["FLAGS_fault_spec"] = spec
+    if seed:
+        env["FLAGS_fault_seed"] = str(seed)
+    # generous budgets: heavy drop presets legitimately retry a lot
+    env.setdefault("FLAGS_rpc_deadline", "300")
+    env.setdefault("FLAGS_rpc_call_timeout", "15")
+    # -o addopts= clears the repo default `-m "not slow"`: this runner
+    # exists precisely to exercise the slow process-killing tests
+    cmd = [sys.executable, "-m", "pytest", "tests/test_resilience.py",
+           "-q", "-p", "no:cacheprovider", "-o", "addopts="] + pytest_args
+    t0 = time.time()
+    proc = subprocess.run(cmd, cwd=REPO, env=env)
+    return proc.returncode, time.time() - t0
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="fault-injection suite matrix runner")
+    ap.add_argument("presets", nargs="*",
+                    help="preset names (default: the whole matrix)")
+    ap.add_argument("--list", action="store_true",
+                    help="list presets and exit")
+    ap.add_argument("--spec", default=None,
+                    help="ad-hoc FLAGS_fault_spec instead of presets")
+    ap.add_argument("--seed", type=int, default=1234,
+                    help="FLAGS_fault_seed (0 = OS entropy)")
+    ap.add_argument("--fast-only", action="store_true",
+                    help="skip the process-spawning slow tests")
+    args, extra = ap.parse_known_args(argv)
+
+    if args.list:
+        for name, spec in PRESETS.items():
+            print("%-14s %s" % (name, spec or "<no faults>"))
+        return 0
+
+    pytest_args = list(extra)
+    if args.fast_only:
+        pytest_args += ["-m", "not slow"]
+
+    if args.spec is not None:
+        matrix = [("adhoc", args.spec)]
+    else:
+        names = args.presets or list(PRESETS)
+        unknown = [n for n in names if n not in PRESETS]
+        if unknown:
+            ap.error("unknown preset(s) %s; --list shows the matrix"
+                     % unknown)
+        matrix = [(n, PRESETS[n]) for n in names]
+
+    rows = []
+    for name, spec in matrix:
+        print("=== preset %r: FLAGS_fault_spec=%r" % (name, spec))
+        rc, secs = run_preset(name, spec, args.seed, pytest_args)
+        rows.append((name, rc, secs))
+
+    print("\n%-14s %-6s %s" % ("preset", "result", "seconds"))
+    worst = 0
+    for name, rc, secs in rows:
+        print("%-14s %-6s %.1f" % (name, "PASS" if rc == 0 else "FAIL",
+                                   secs))
+        worst = max(worst, rc)
+    return worst
+
+
+if __name__ == "__main__":
+    sys.exit(main())
