@@ -25,6 +25,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from . import lowering
+from .lod import LoDTensor
 from .lowering import LoweringContext, run_ops, run_op
 from .registry import get_op_info
 from .scope import Scope
@@ -64,10 +65,16 @@ def _prepare_lod_feeds(feed):
     lengths and '@LEN@1' = [N, S] inner sub-sequence lengths; deeper
     LoD generalizes recursively — one padded dim and one '@LEN@j'
     array per level (reference lod_tensor.h:58 depth-unbounded LoD)."""
-    from .lod import LoDTensor
+    # hot-path fast exit: dense-only feeds (the overwhelmingly common
+    # case in a training loop) skip the per-item padding scan entirely
+    for v in feed.values():
+        if isinstance(v, LoDTensor) and v.lod:
+            break
+    else:
+        return feed
 
     for name, v in list(feed.items()):
-        if not (isinstance(v, LoDTensor) and v.lod):
+        if not (isinstance(v, LoDTensor) and v.lod):  # dense rides along
             continue
         if len(v.lod) > 2:
             # level-k (k>=3): general recursive pad — outer ragged dims
@@ -119,6 +126,20 @@ def _prepare_lod_feeds(feed):
     return feed
 
 
+def _cache_key(program, block_id, feed_spec, fetch_list, mode):
+    """The ONE compiled-entry cache key — shared by run()'s per-feed
+    path and prepare(), so a prepared program and run() with the same
+    signature reuse a single executable.  Trace-time flag reads are part
+    of the key: toggling them must not hit a stale executable."""
+    return (program.uid, program.version, block_id, feed_spec,
+            tuple(fetch_list), mode,
+            bool(getattr(program, "amp_bf16", False)),
+            bool(FLAGS.auto_layout),
+            # read at trace time (_amp_cast_ins / conv2d lowering)
+            bool(FLAGS.bn_bf16), bool(FLAGS.conv_nhwc),
+            str(FLAGS.matmul_precision))
+
+
 class _CacheEntry:
     __slots__ = ("fn", "input_names", "persist_outs", "fetch_names",
                  "input_shardings", "jit_fn")
@@ -131,6 +152,304 @@ class _CacheEntry:
         self.fetch_names = fetch_names
         self.input_shardings = input_shardings
         self.jit_fn = jit_fn  # the raw jax.jit object (AOT lower/compile)
+
+
+def flush_prepared(scope, exclude=None):
+    """sync_scope() every dirty prepared program registered on ``scope``
+    or any ancestor (parity role: reference RunPreparedContext keeps
+    scope authoritative between prepared runs; here state lives on
+    device and this is the on-demand write-back)."""
+    s = scope
+    while s is not None:
+        if getattr(s, "_prepared_registry", None):
+            s.flush_prepared(exclude)
+        s = s._parent
+
+
+def seen_entry(scope, name):
+    """(owning scope, write version) snapshot of ``name`` — the shared
+    primitive of the external-write-wins protocol (PreparedProgram and
+    PipelineProgram): record it when you read or install a value,
+    compare later to tell your own writes apart from someone else's."""
+    s = scope.find_scope_of(name)
+    return (s, s._write_versions.get(name) if s is not None else None)
+
+
+def seen_changed(scope, name, seen):
+    """True when ``name`` was written since ``seen`` was recorded (or
+    was never recorded): the scope's value wins over device state."""
+    if seen is None:
+        return True
+    cur = seen_entry(scope, name)
+    return cur[0] is not seen[0] or cur[1] != seen[1]
+
+
+class PreparedShapeMismatch(ValueError):
+    """A feed's shape drifted from an AOT (auto-layout) prepared
+    signature — the caller should run() this batch or re-prepare."""
+
+
+class PreparedProgram:
+    """Reference Executor::Prepare + RunPreparedContext
+    (framework/executor.cc:127): the per-step cost is dispatch, not
+    re-analysis.  Owns the compiled entry plus a device-resident state
+    map of every non-feed input and written persistable; the state is
+    threaded step-to-step so donated parameter/optimizer buffers never
+    round-trip through the Scope.  ``run_prepared`` does feed staging +
+    one dispatch and returns fetches as UN-CONVERTED device arrays;
+    ``sync_scope`` flushes the written persistables back on demand
+    (called automatically by every run()/io-save path via
+    ``flush_prepared`` and on context exit).
+
+    Interleaving contract: every read path on the same scope — run(),
+    the io save programs, and plain ``Scope.find_var`` — flushes this
+    state first (Scope.flush_prepared), so readers never observe a
+    stale value or a donated (invalidated) buffer; and any scope write
+    bumps the scope's version counter, which makes the next
+    ``run_prepared`` re-stage its state from the scope.  Per-name write
+    versions tell our own sync-backs apart from external writes: a name
+    someone else wrote always wins over our device copy.
+    """
+
+    def __init__(self, core, program, block_id, entry, scope, mode,
+                 feed_specs):
+        self._core = core
+        self._program = program
+        self._block_id = block_id
+        self._entry = entry
+        self._scope = scope
+        self._mode = mode
+        self._feed_names = frozenset(feed_specs)
+        self._program_version = program.version
+        # AOT entries (auto-layout) executed for FIXED argument shapes:
+        # a shape drift (final partial batch) must fail with guidance,
+        # not a deep XLA mismatch.  jit entries are shape-polymorphic
+        # (retrace per new shape) so no per-step check is paid there.
+        self._fixed_shapes = None
+        if entry.jit_fn is None and hasattr(feed_specs, "items"):
+            self._fixed_shapes = {
+                name: tuple(v.shape)
+                for name, v in feed_specs.items() if v is not None}
+        block = program.blocks[block_id]
+        dev = core.place.jax_device()
+        self._targets = []      # per input index: sharding/Format/device
+        self._feed_dtypes = {}  # feed name -> np dtype for coercion
+        self._state_targets = {}
+        for i, name in enumerate(entry.input_names):
+            target = (entry.input_shardings[i]
+                      if entry.input_shardings is not None else dev)
+            if target is None:
+                target = dev
+            self._targets.append(target)
+            if name in self._feed_names:
+                vd = block.find_var_recursive(name)
+                self._feed_dtypes[name] = (proto_to_np_dtype(vd.dtype)
+                                           if vd is not None else None)
+            else:
+                self._state_targets[name] = target
+        self._state = {}
+        self._seen = {}  # name -> (owning scope, write version) we read
+        self._read_only = [n for n in self._state_targets
+                           if n not in set(entry.persist_outs)]
+        # another prepared program/pipeline may hold newer values for
+        # the persistables we are about to stage
+        flush_prepared(scope)
+        self._refresh_from_scope()
+        self._dirty = False
+        self._scope_epoch = scope.chain_version()
+        # register on every scope that OWNS one of our resident names
+        # (plus the lookup root): a reader rooted at an ancestor that
+        # holds the persistables must hit the registry even though it
+        # never walks down to the training scope
+        owners = {id(scope): scope}
+        for name in list(self._state_targets) + list(entry.persist_outs):
+            s = scope.find_scope_of(name)
+            if s is not None:
+                owners.setdefault(id(s), s)
+        for s in owners.values():
+            s.attach_prepared(self)
+
+    @property
+    def fetch_names(self):
+        return self._entry.fetch_names
+
+    @property
+    def is_stale(self):
+        """True once the program mutated after prepare() (its version
+        bumped): the compiled entry no longer matches — sync_scope and
+        re-prepare.  run_prepared refuses stale entries loudly."""
+        return self._program.version != self._program_version
+
+    def _refresh_from_scope(self):
+        """Re-stage resident inputs from the scope (after a run()/load
+        wrote new values).  device_put is a no-op for arrays already
+        committed to their target.  Values are read via the owning
+        scope's raw storage — callers flushed other prepared programs
+        already, and the per-name write versions recorded here let
+        sync_scope detect external writes later."""
+        scope = self._scope
+        local = getattr(scope, "_reader_batch_vars", ())
+        for name, target in self._state_targets.items():
+            s = scope.find_scope_of(name)
+            if s is None:
+                raise KeyError(name)
+            v = s._vars[name]
+            if callable(getattr(v, "is_deleted", None)) and \
+                    v.is_deleted():
+                # the buffer was donated and consumed — by a failed
+                # step, or by training that never synced back before
+                # this program was dropped: the VALUE is gone
+                raise RuntimeError(
+                    "persistable %r in the scope is a donated buffer "
+                    "whose value was consumed (a failed prepared step, "
+                    "or a PreparedProgram dropped without sync_scope); "
+                    "restore it (io.load_persistables / a checkpoint) "
+                    "before continuing" % name)
+            self._state[name] = _put(v, target, local_rows=name in local)
+            self._seen[name] = (s, s._write_versions.get(name))
+        # write-only persistables are rebuilt by the next step; drop
+        # stale copies so sync_scope can't resurrect them, but KEEP a
+        # write-version baseline so an external write to them between
+        # now and the next sync is still detected (scope wins)
+        for name in self._entry.persist_outs:
+            if name not in self._state_targets:
+                self._state.pop(name, None)
+                self._seen[name] = seen_entry(scope, name)
+
+    def run_prepared(self, feed=None):
+        """Feed staging + one dispatch.  Returns the fetch list as
+        device arrays — host conversion is the CALLER's choice (defer
+        np.asarray until the value is actually consumed)."""
+        if self.is_stale:
+            raise RuntimeError(
+                "program mutated since prepare() (version %d -> %d): the "
+                "compiled entry is stale — re-prepare" %
+                (self._program_version, self._program.version))
+        scope = self._scope
+        # another prepared program (or pipeline) may hold newer values
+        flush_prepared(scope, exclude=self)
+        if scope.chain_version() != self._scope_epoch:
+            # someone wrote the scope since our last sync.  Flush OUR
+            # updates first: our written persistables in the scope are
+            # older than the state (and may be donated husks) — syncing
+            # makes the scope whole before we re-stage from it.
+            if self._dirty:
+                self.sync_scope()
+            self._refresh_from_scope()
+            self._scope_epoch = scope.chain_version()
+        feed = _prepare_lod_feeds(dict(feed or {}))
+        if feed.keys() != self._feed_names:
+            self._check_feed_names(feed)
+        entry = self._entry
+        state = self._state
+        fixed = self._fixed_shapes
+        args = []
+        for i, name in enumerate(entry.input_names):
+            # feed precedence for names both fed AND written by the
+            # block, exactly like run(): the device copy of such a name
+            # exists only for sync_scope, never shadows the feed
+            if name in state and name not in self._feed_names:
+                args.append(state[name])
+                continue
+            val = feed[name]
+            if fixed is not None:
+                exp = fixed.get(name)
+                if exp is not None and tuple(np.shape(val)) != exp:
+                    raise PreparedShapeMismatch(
+                        "feed %r shape %s != prepared signature %s: "
+                        "this entry was AOT-compiled for fixed shapes "
+                        "(FLAGS.auto_layout) — re-prepare for the new "
+                        "batch shape or use run()" %
+                        (name, tuple(np.shape(val)), exp))
+            dtype = self._feed_dtypes.get(name)
+            if dtype is not None and not hasattr(val, "dtype"):
+                val = np.asarray(val, dtype=dtype)
+            args.append(_put(val, self._targets[i], local_rows=True))
+        seed, counter = self._core._rng_counter(self._program, scope)
+        try:
+            fetches, persists = entry.fn(tuple(args), seed, counter)
+        except Exception:
+            # an execute-time failure may have consumed the donated
+            # inputs: drop exactly the deleted buffers so a finally/
+            # context-exit sync installs only values that survived
+            # (trace-time failures consume nothing and lose nothing)
+            dead = False
+            for name in list(state):
+                v = state[name]
+                if callable(getattr(v, "is_deleted", None)) \
+                        and v.is_deleted():
+                    del state[name]
+                    self._seen.pop(name, None)
+                    dead = True
+            if dead:
+                self._scope_epoch = None  # re-stage dropped names
+            raise
+        for name, val in zip(entry.persist_outs, persists):
+            state[name] = val
+        self._dirty = True
+        return list(fetches)
+
+    def _check_feed_names(self, feed):
+        missing = self._feed_names - feed.keys()
+        if missing:
+            raise KeyError(
+                "prepared program expects feed(s) %s (prepared "
+                "signature: %s)" % (sorted(missing),
+                                    sorted(self._feed_names)))
+        resident = feed.keys() & self._state_targets.keys()
+        if resident:
+            raise ValueError(
+                "feed(s) %s are device-resident state of this prepared "
+                "program; sync_scope() + run(), or re-prepare with them "
+                "in feed_specs" % sorted(resident))
+        # extra never-read feeds are ignored, like run()
+
+    def sync_scope(self):
+        """Flush written persistables back to the scope.  The scope then
+        holds the CURRENT device arrays; a later step donates them
+        again, which re-marks this program dirty so the next flush
+        rewrites fresh buffers.  A name written EXTERNALLY since we last
+        read/installed it (scope.set by user code, a load, another
+        executor) wins: the device copy is dropped and re-staged from
+        the scope instead of clobbering the newer value."""
+        scope = self._scope
+        stale = False
+        for name in self._entry.persist_outs:
+            val = self._state.get(name)
+            if val is None:
+                continue
+            if seen_changed(scope, name, self._seen.get(name)):
+                # external write since our last read/install: scope wins
+                self._state.pop(name, None)
+                self._seen.pop(name, None)
+                stale = True
+                continue
+            s = scope.find_scope_of(name) or scope
+            s.set(name, val)
+            self._seen[name] = (s, s._write_versions[name])
+        # READ-ONLY resident state (e.g. a learning-rate var) can also
+        # have been written externally; installing our persist_outs
+        # fast-forwards the epoch past that write, so it must be
+        # detected HERE or the next step would silently keep the stale
+        # device copy
+        if not stale:
+            for name in self._read_only:
+                if seen_changed(scope, name, self._seen.get(name)):
+                    stale = True
+                    break
+        self._dirty = False
+        # anything stale must be re-staged before the next step even if
+        # nothing else touches the scope: poison the epoch
+        self._scope_epoch = None if stale else scope.chain_version()
+
+    # context manager: `with core.prepare(...) as prep:` syncs on exit
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        if self._dirty:
+            self.sync_scope()
+        return False
 
 
 class ExecutorCore:
@@ -149,6 +468,9 @@ class ExecutorCore:
     # ------------------------------------------------------------------
     def run(self, program, scope, block_id=0, feed=None, fetch_list=None,
             mode="train", return_numpy=True):
+        # device-resident prepared state (run_prepared) must land in the
+        # scope before this unprepared path reads or overwrites it
+        flush_prepared(scope)
         feed = _prepare_lod_feeds(dict(feed or {}))
         fetch_list = list(fetch_list or [])
         block = program.blocks[block_id]
@@ -213,10 +535,69 @@ class ExecutorCore:
                   file=sys.stderr)
 
         if return_numpy:
-            fetches = [_to_host_numpy(v) if v is not None and
-                       not isinstance(v, (list, tuple)) else v
-                       for v in fetches]
+            fetches = fetches_to_host(fetches)
         return fetches
+
+    # ------------------------------------------------------------------
+    def prepare(self, program, feed_specs, fetch_list, mode="train",
+                scope=None, block_id=0):
+        """Reference Executor::Prepare (executor.cc:127): pay program
+        analysis once, get a PreparedProgram whose per-step cost is feed
+        staging + one dispatch (RunPreparedContext).
+
+        ``feed_specs`` is either a sample feed dict ({name: array-like /
+        LoDTensor}, e.g. the first minibatch — its shapes/dtypes let the
+        compiled entry share the run() cache) or a bare iterable of feed
+        names.  Raises ValueError for blocks the compiled path cannot
+        own whole (host ops, FLAGS.check_nan_inf) — callers fall back to
+        run()."""
+        if scope is None:
+            raise ValueError(
+                "prepare() requires the scope holding the program's "
+                "persistables (run the startup program into it first)")
+        if feed_specs is None:  # zero-feed program (scope-resident data)
+            feed_specs = {}
+        fetch_list = list(fetch_list or [])
+        block = program.blocks[block_id]
+        prelude, core_ops, postlude, mixed = _segment(block)
+        if mixed or prelude or postlude:
+            host = sorted({op.type for op in block.ops
+                           if get_op_info(op.type).host_op})
+            raise ValueError(
+                "block %d has host op(s) %s; the prepared hot path "
+                "compiles the whole block — use run()" % (block_id, host))
+        if FLAGS.check_nan_inf:
+            raise ValueError("FLAGS.check_nan_inf runs op-by-op; the "
+                             "prepared path is whole-block — use run()")
+        if hasattr(feed_specs, "keys"):
+            sample = _prepare_lod_feeds(dict(feed_specs))
+            # the SAME cache key _run_compiled builds from a real feed,
+            # so prepare() and run() share one compiled executable
+            key_spec = tuple(sorted(
+                (name, tuple(np.shape(v)),
+                 str(v.dtype) if hasattr(v, "dtype") else
+                 str(np.asarray(v).dtype))
+                for name, v in sample.items()))
+            stub = {
+                name: jax.ShapeDtypeStruct(
+                    np.shape(v), v.dtype if hasattr(v, "dtype")
+                    else np.asarray(v).dtype)
+                for name, v in sample.items()}
+        else:
+            # names-only signature: membership is enough to build; the
+            # entry cannot alias run()'s per-shape keys, but repeated
+            # prepare() calls (re-prepare after staleness, sibling
+            # PreparedPrograms) must not re-trace
+            stub = {name: None for name in feed_specs}
+            key_spec = ("names-only",) + tuple(sorted(stub))
+        key = _cache_key(program, block_id, key_spec, fetch_list, mode)
+        entry = self._cache.get(key)
+        if entry is None:
+            entry = self._build(program, block_id, core_ops, scope,
+                                stub, fetch_list, mode)
+            self._cache[key] = entry
+        return PreparedProgram(self, program, block_id, entry, scope,
+                               mode, stub)
 
     # ------------------------------------------------------------------
     def _rng_key(self, program, scope):
@@ -242,14 +623,7 @@ class ExecutorCore:
              str(v.dtype) if hasattr(v, "dtype") else
              str(np.asarray(v).dtype))
             for name, v in feed.items()))
-        key = (program.uid, program.version, block_id, feed_spec,
-               tuple(fetch_list), mode,
-               bool(getattr(program, "amp_bf16", False)),
-               bool(FLAGS.auto_layout),
-               # read at trace time (_amp_cast_ins / conv2d lowering):
-               # toggling either must not hit a stale executable
-               bool(FLAGS.bn_bf16), bool(FLAGS.conv_nhwc),
-               str(FLAGS.matmul_precision))
+        key = _cache_key(program, block_id, feed_spec, fetch_list, mode)
         entry = self._cache.get(key)
         if entry is None:
             entry = self._build(program, block_id, core_ops, scope, feed,
@@ -564,6 +938,13 @@ def _check_op_outputs(op, env):
 
 def _in_feed_only(name, feed, scope):
     return name in feed and not scope.has_var(name)
+
+
+def fetches_to_host(outs):
+    """Fetch-list values -> host numpy (None and list/tuple fetches —
+    absent vars, LoD pairs — pass through untouched)."""
+    return [_to_host_numpy(v) if v is not None and
+            not isinstance(v, (list, tuple)) else v for v in outs]
 
 
 def _to_host_numpy(v):

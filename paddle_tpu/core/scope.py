@@ -14,6 +14,19 @@ class Scope:
         self._parent = parent
         self._vars = {}
         self._kids = []
+        # bumped on every write/erase; PreparedProgram (executor_impl)
+        # watches the chain sum to know when its device-resident state
+        # must be refreshed from the scope, and the per-name write
+        # version to tell its OWN sync-backs apart from external writes
+        # (an external write to a name always wins over device state)
+        self._version = 0
+        self._write_versions = {}
+        # prepared-execution attachments (weakrefs to objects with
+        # ``._dirty`` + ``.sync_scope()``): their device-resident train
+        # state is flushed back before any value is read through this
+        # scope, so readers never observe stale/donated buffers
+        self._prepared_registry = None
+        self._in_flush = False
 
     # --- tree ---
     @property
@@ -37,16 +50,53 @@ class Scope:
 
     def set(self, name, value):
         self._vars[name] = value
+        self._version += 1
+        self._write_versions[name] = self._version
 
     def find_var(self, name):
         """Recursive lookup (reference Scope::FindVar). Returns value or
-        raises KeyError if the name exists nowhere."""
+        raises KeyError if the name exists nowhere.  Flushes attached
+        prepared-execution state first so a direct read never observes a
+        value the device has moved past (or a donated buffer)."""
         s = self
         while s is not None:
+            if s._prepared_registry is not None:
+                s.flush_prepared()
             if name in s._vars:
                 return s._vars[name]
             s = s._parent
         raise KeyError(name)
+
+    def flush_prepared(self, exclude=None):
+        """sync_scope() every dirty prepared attachment of THIS scope
+        (see core/executor_impl.PreparedProgram; pipeline joins too).
+        Dead weakrefs are pruned; re-entry is a no-op."""
+        reg = self._prepared_registry
+        if not reg or self._in_flush:
+            return
+        self._in_flush = True
+        try:
+            live = []
+            for ref in reg:
+                p = ref()
+                if p is None:
+                    continue
+                live.append(ref)
+                if p is not exclude and getattr(p, "_dirty", False):
+                    p.sync_scope()
+            if len(live) != len(reg):
+                reg[:] = live
+        finally:
+            self._in_flush = False
+
+    def attach_prepared(self, prep):
+        """Register ``prep`` (has ``._dirty`` + ``.sync_scope()``) for
+        read-time flushing on this scope."""
+        import weakref
+
+        if self._prepared_registry is None:
+            self._prepared_registry = []
+        self._prepared_registry.append(weakref.ref(prep))
 
     def has_var(self, name):
         s = self
@@ -65,8 +115,24 @@ class Scope:
         return None
 
     def erase(self, names):
+        removed = False
         for n in names:
-            self._vars.pop(n, None)
+            if n in self._vars:
+                del self._vars[n]
+                self._write_versions.pop(n, None)
+                removed = True
+        if removed:  # a no-op erase must not force prepared re-stages
+            self._version += 1
+
+    def chain_version(self):
+        """Sum of versions up the parent chain: any write visible to a
+        lookup from this scope changes the number."""
+        v = 0
+        s = self
+        while s is not None:
+            v += s._version
+            s = s._parent
+        return v
 
     def local_var_names(self):
         return list(self._vars.keys())

@@ -46,11 +46,20 @@ def _load():
     try:
         if (not os.path.exists(so) or
                 os.path.getmtime(so) < os.path.getmtime(src)):
-            subprocess.run(
-                ["g++", "-O2", "-shared", "-fPIC", "-o", so + ".tmp",
-                 src],
-                check=True, capture_output=True)
-            os.replace(so + ".tmp", so)
+            # per-pid tmp: a trainer and a pserver starting on one host
+            # both self-build — a shared tmp name could interleave the
+            # two compilers' writes and install a torn .so; distinct
+            # tmps + atomic os.replace means last-writer-wins with a
+            # whole file either way
+            tmp = "%s.tmp.%d" % (so, os.getpid())
+            try:
+                subprocess.run(
+                    ["g++", "-O2", "-shared", "-fPIC", "-o", tmp, src],
+                    check=True, capture_output=True)
+                os.replace(tmp, so)
+            finally:
+                if os.path.exists(tmp):
+                    os.unlink(tmp)
         lib = ctypes.CDLL(so)
         lib.fw_listen.argtypes = [ctypes.c_char_p, ctypes.c_int,
                                   ctypes.c_int]

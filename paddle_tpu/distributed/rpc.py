@@ -833,8 +833,11 @@ class RPCClient:
         data plane (gRPC fallback).  A STALE pooled connection (failure
         before the payload went out) retries once on a fresh one; a
         failure after the payload was sent raises a retryable
-        ConnectionError — the wire protocol dedups (round, sender), so
-        the caller's retry path can safely replay the frame."""
+        ConnectionError carrying ``sent_payload=True`` — reads replay it
+        freely, while _overlapped(idempotent=False) excludes it from the
+        resend and surfaces it to the caller (the frame may already be
+        applied; belt over the wire protocol's (round, sender, seq)
+        dedup suspenders)."""
         pool = self._fast_pool()
         if pool is None:
             return None
@@ -853,18 +856,26 @@ class RPCClient:
         return None
 
     def _overlapped(self, method, point, eps, payloads, replay,
-                    use_fast=True):
+                    use_fast=True, idempotent=True):
         """Shared fan-out: first attempt everything in flight together —
         fastwire threads where the endpoint offers a data plane, then
         gRPC futures — and push any retryable failure through the
         sequential retry path (reconnect + optional round replay).
-        Fatal errors surface immediately.  Returns raw replies."""
+        Fatal errors surface immediately.  Returns raw replies.
+
+        ``idempotent=False`` (state-mutating sends): a fastwire failure
+        AFTER the payload went out is excluded from the gRPC fallback —
+        the server may have consumed and applied the frame, and a
+        resend would double-apply — and re-raised after the join so the
+        caller learns the send may have landed.  Reads keep the
+        fallback: re-fetching is always safe."""
         n = len(eps)
         results = [None] * n
         pending = list(range(n))
+        post_send = None
         pool = self._fast_pool() if use_fast else None
         if pool is not None:
-            fatal = []
+            errs = {}  # thread index -> captured exception
 
             def one(i):
                 try:
@@ -872,9 +883,11 @@ class RPCClient:
                     results[i] = self._fast_call(eps[i], method,
                                                  payloads[i])
                 except Exception as e:
-                    if not RetryPolicy.is_retryable(e):
-                        fatal.append(e)   # re-raised on the main thread
-                    results[i] = None     # -> retried on the gRPC path
+                    # captured, classified AFTER join: a post-send
+                    # failure of a non-idempotent send must not
+                    # silently become a gRPC resend
+                    errs[i] = e
+                    results[i] = None
 
             ts = [threading.Thread(target=one, args=(i,))
                   for i in pending]
@@ -882,9 +895,27 @@ class RPCClient:
                 t.start()
             for t in ts:
                 t.join()
-            if fatal:
-                raise fatal[0]
-            pending = [i for i in pending if results[i] is None]
+            excluded = set()
+            fatal = None
+            for i, e in sorted(errs.items()):
+                if not RetryPolicy.is_retryable(e):
+                    fatal = fatal or e
+                elif not idempotent and getattr(e, "sent_payload",
+                                                False):
+                    # the server may have consumed and APPLIED the
+                    # frame: resending over gRPC would double-apply
+                    # (e.g. a SendVariable gradient skewing the sync
+                    # average) — exclude from the fallback; re-raised
+                    # AFTER the other endpoints' safe fallbacks finish
+                    # so the round is as complete as it can be
+                    excluded.add(i)
+                    post_send = post_send or e
+            if fatal is not None:
+                # chain the maybe-applied send so recovery logic sees
+                # both the fatal failure and the uncertain delivery
+                raise fatal from post_send
+            pending = [i for i in pending
+                       if results[i] is None and i not in excluded]
         futs, need_retry = [], []
         for i in pending:
             try:
@@ -906,6 +937,10 @@ class RPCClient:
         for i in need_retry:
             results[i] = self._retry_op(eps[i], method, payloads[i],
                                         point=point, replay=replay)
+        if post_send is not None:
+            # surfaced only after every safe item completed its
+            # fallback: the caller learns this send may have landed
+            raise post_send
         return results
 
     def send_vars(self, triples):
@@ -921,7 +956,8 @@ class RPCClient:
                 name, arr,
                 _pack_round_sender(self.step, self.sender, seq)))
         self._overlapped("SendVariable", "send_grad",
-                         [t[0] for t in triples], payloads, replay=True)
+                         [t[0] for t in triples], payloads, replay=True,
+                         idempotent=False)
 
     def get_var(self, ep, name, round_=None):
         round_ = self.step if round_ is None else round_

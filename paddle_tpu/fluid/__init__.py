@@ -29,7 +29,8 @@ from . import unique_name
 from . import nets
 from . import metrics
 from . import profiler
-from .executor import Executor, global_scope, scope_guard, fetch_var
+from .executor import (Executor, PreparedProgram, global_scope,
+                       scope_guard, fetch_var)
 from . import io
 from .io import (save_vars, save_params, save_persistables, load_vars,
                  load_params, load_persistables, save_inference_model,
@@ -79,7 +80,8 @@ __all__ = [
     "layers", "initializer", "ParamAttr", "LayerHelper",
     "append_backward", "calc_gradient", "optimizer", "regularizer", "clip",
     "unique_name", "nets", "metrics", "profiler",
-    "Executor", "global_scope", "scope_guard", "fetch_var",
+    "Executor", "PreparedProgram", "global_scope", "scope_guard",
+    "fetch_var",
     "io", "save_inference_model", "load_inference_model", "DataFeeder",
     "ParallelExecutor", "ExecutionStrategy", "BuildStrategy",
     "CPUPlace", "TPUPlace", "CUDAPlace", "Scope",

@@ -8,13 +8,14 @@ from __future__ import annotations
 
 import numpy as np
 
-from paddle_tpu.core.executor_impl import ExecutorCore
+from paddle_tpu.core.executor_impl import ExecutorCore, fetches_to_host
 from paddle_tpu.core.scope import Scope, global_scope
 from paddle_tpu.core.place import CPUPlace, TPUPlace
 
 from .framework import Variable, default_main_program
 
-__all__ = ["Executor", "global_scope", "scope_guard", "fetch_var"]
+__all__ = ["Executor", "PreparedProgram", "global_scope", "scope_guard",
+           "fetch_var"]
 
 import contextlib
 
@@ -77,6 +78,40 @@ def _guard_int64(name, value):
     return value
 
 
+class PreparedProgram:
+    """Fluid view over the core PreparedProgram: applies the int64 feed
+    guard, optional numpy conversion, and the sync-on-exit context
+    manager.  Obtain one via ``Executor.prepare``."""
+
+    def __init__(self, core_prep):
+        self._prep = core_prep
+
+    @property
+    def fetch_names(self):
+        return self._prep.fetch_names
+
+    @property
+    def is_stale(self):
+        return self._prep.is_stale
+
+    def run_prepared(self, feed=None, return_numpy=False):
+        """One prepared step.  With ``return_numpy=False`` (default) the
+        fetches come back as device arrays — defer np.asarray to when a
+        value is actually consumed, the dispatch stays async."""
+        feed = {k: _guard_int64(k, v) for k, v in (feed or {}).items()}
+        outs = self._prep.run_prepared(feed)
+        return fetches_to_host(outs) if return_numpy else outs
+
+    def sync_scope(self):
+        self._prep.sync_scope()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        return self._prep.__exit__(exc_type, exc, tb)
+
+
 class Executor:
     def __init__(self, place=None):
         self.place = place if place is not None else CPUPlace()
@@ -101,6 +136,27 @@ class Executor:
         mode = "test" if getattr(program, "_is_test", False) else "train"
         return self._core.run(program.desc, scope, 0, feed_np, names,
                               mode=mode, return_numpy=return_numpy)
+
+    def prepare(self, program=None, feed_specs=None, fetch_list=None,
+                scope=None):
+        """Executor::Prepare analog: returns a PreparedProgram whose
+        ``run_prepared(feed)`` skips the per-step scope round-trips (see
+        core/executor_impl.PreparedProgram).  ``feed_specs`` is a sample
+        feed dict (e.g. the first minibatch) or an iterable of feed
+        names.  Raises ValueError for programs with host ops — callers
+        fall back to run()."""
+        if program is None:
+            program = default_main_program()
+        if scope is None:
+            scope = _current_scope()
+        names = [f.name if isinstance(f, Variable) else f
+                 for f in (fetch_list or [])]
+        mode = "test" if getattr(program, "_is_test", False) else "train"
+        if hasattr(feed_specs, "keys"):
+            feed_specs = {k: _guard_int64(k, v)
+                          for k, v in feed_specs.items()}
+        return PreparedProgram(self._core.prepare(
+            program.desc, feed_specs, names, mode=mode, scope=scope))
 
     def close(self):
         pass

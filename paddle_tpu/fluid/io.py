@@ -77,6 +77,15 @@ def _save_load_vars(executor, dirname, main_program, predicate, op_type,
                                        os.path.join(dirname, filename)},
                                 infer_shape=False)
     os.makedirs(dirname, exist_ok=True)
+    # prepared-execution state (PreparedProgram / PipelineProgram) is
+    # device-resident between steps; flush it into the scope so save ops
+    # read CURRENT values (ExecutorCore.run also flushes — this makes
+    # the checkpoint contract explicit and covers custom executors).
+    # Loads need no special-casing: the load ops' scope writes bump the
+    # scope version, and prepared programs re-stage from the scope.
+    from paddle_tpu.core.executor_impl import flush_prepared
+    from .executor import _current_scope
+    flush_prepared(_current_scope())
     executor.run(prog)
 
 

@@ -28,7 +28,12 @@ __all__ = ["ParallelExecutor", "ExecutionStrategy", "BuildStrategy"]
 
 
 class ExecutionStrategy:
-    """Knob parity with pybind ExecutionStrategy (pybind.cc:506)."""
+    """Knob parity with pybind ExecutionStrategy (pybind.cc:506).
+
+    ``num_iteration_per_drop_scope`` is live: it is both the temp-var
+    drop cadence (reference ScopeBufferedSSAGraphExecutor) and the
+    cadence at which the prepared hot path's device-resident train
+    state is flushed back to the Scope (sync_scope)."""
 
     def __init__(self):
         self.num_threads = 0
@@ -117,6 +122,12 @@ class ParallelExecutor:
             self._scope = share_vars_from._scope
         self._core = ExecutorCore(place, mesh=self.mesh)
         self._runs_since_drop = 0
+        # prepared hot path per (fetch names, feed names) signature;
+        # signatures the compiled path can't own whole fall back to
+        # run() (remembered per program version: a mutation may change
+        # the answer)
+        self._prepared = {}
+        self._unpreparable = {}
 
     @property
     def device_count(self):
@@ -144,16 +155,57 @@ class ParallelExecutor:
                 raise ValueError(
                     "feed %r batch %d not divisible by %d local devices"
                     % (k, bs, n_local))
-        outs = self._core.run(self._program.desc, self._scope, 0, feed,
-                              names, mode="train",
-                              return_numpy=return_numpy)
+        outs = None
+        prep = self._prepared_for(names, feed)
+        if prep is not None:
+            from paddle_tpu.core.executor_impl import (
+                PreparedShapeMismatch, fetches_to_host)
+            try:
+                outs = prep.run_prepared(feed)
+                if return_numpy:
+                    outs = fetches_to_host(outs)
+            except PreparedShapeMismatch:
+                # AOT (auto-layout) entry, drifted batch shape (final
+                # partial batch): run() compiles per shape and flushes
+                # the prepared state first
+                outs = None
+        if outs is None:
+            outs = self._core.run(self._program.desc, self._scope, 0,
+                                  feed, names, mode="train",
+                                  return_numpy=return_numpy)
         self._maybe_drop_scope_temps()
         return outs
 
+    def _prepared_for(self, names, feed):
+        """PreparedProgram for this (fetch, feed) signature — built on
+        first use from the live feed's specs; None when the program
+        needs run() (host ops: readers, send/recv).  A mutated program
+        (version bump by a pass) flushes + re-prepares transparently."""
+        version = self._program.desc.version
+        key = (tuple(names), tuple(sorted(feed)))
+        prep = self._prepared.get(key)
+        if prep is not None and prep.is_stale:
+            if prep._dirty:
+                prep.sync_scope()
+            del self._prepared[key]
+            prep = None
+        if prep is None and self._unpreparable.get(key) != version:
+            try:
+                prep = self._core.prepare(self._program.desc, feed,
+                                          names, mode="train",
+                                          scope=self._scope)
+                self._prepared[key] = prep
+            except ValueError:
+                self._unpreparable[key] = version
+        return prep
+
     def _maybe_drop_scope_temps(self):
-        """Every ``num_iteration_per_drop_scope`` runs, erase
-        non-persistable program vars (and dead kid scopes) from the
-        scope — the reference's ScopeBufferedSSAGraphExecutor role
+        """Every ``num_iteration_per_drop_scope`` runs: flush the
+        prepared path's device-resident train state back to the scope
+        (the sync cadence — between flushes parameters/optimizer state
+        never round-trip the Scope), then erase non-persistable program
+        vars (and dead kid scopes) — the reference's
+        ScopeBufferedSSAGraphExecutor role
         (details/scope_buffered_ssa_graph_executor.cc): without it a
         long training accumulates host copies of activations written by
         host ops/fetches.  Parameters, optimizer state, reader states
@@ -166,6 +218,9 @@ class ParallelExecutor:
         if self._runs_since_drop < every:
             return
         self._runs_since_drop = 0
+        for prep in self._prepared.values():
+            if prep._dirty:
+                prep.sync_scope()
         block = self._program.desc.blocks[0]
         drop = [name for name in self._scope.local_var_names()
                 if name in block.vars

@@ -72,6 +72,59 @@ class PipelineProgram:
              for n in st.param_names}
             for st in self.stages]
         self._collect_optimizer_ops(program, scope)
+        # join the prepared-execution flush protocol: any read path on
+        # this scope — Executor.run, io save/checkpoint, Scope.find_var
+        # — flushes the stage-resident params/optimizer state back
+        # first.  Register on every scope that OWNS one of our names
+        # too: a reader rooted at the owning ancestor never walks down
+        # to the construction scope.
+        self.scope = scope
+        self._dirty = False
+        owners = {id(scope): scope}
+        names = [n for st in self.stages for n in st.param_names]
+        names += [n for st_state in self._opt_state for n in st_state]
+        for n in names:
+            s = scope.find_scope_of(n)
+            if s is not None:
+                owners.setdefault(id(s), s)
+        for s in owners.values():
+            s.attach_prepared(self)
+        # per-name write-version baselines: an EXTERNAL write (a
+        # checkpoint load, a user scope.set) always wins over the
+        # stage-resident copy — detected exactly like PreparedProgram
+        self._seen = {}
+        for n in names:
+            self._record_seen(n)
+
+    def _record_seen(self, name):
+        from paddle_tpu.core.executor_impl import seen_entry
+
+        self._seen[name] = seen_entry(self.scope, name)
+
+    def _external_writes(self):
+        """Names written in the scope since we last read/installed
+        them."""
+        from paddle_tpu.core.executor_impl import seen_changed
+
+        return {n for n, seen in self._seen.items()
+                if seen_changed(self.scope, n, seen)}
+
+    def _restage_external(self):
+        """Pull externally written params/optimizer state back onto the
+        stage devices (scope wins)."""
+        import jax
+
+        ext = self._external_writes()
+        if not ext:
+            return
+        for i, st in enumerate(self.stages):
+            for part in (self.params[i], self._opt_state[i]):
+                for n in part:
+                    if n in ext:
+                        part[n] = jax.device_put(
+                            np.asarray(self.scope.find_var(n)),
+                            st.device)
+                        self._record_seen(n)
 
     def _collect_optimizer_ops(self, program, scope):
         """Assign the program's optimizer ops (and their accumulator /
@@ -255,6 +308,9 @@ class PipelineProgram:
                 "program has no optimizer ops: pass lr= for the "
                 "manual-SGD update (or run optimizer.minimize on it)")
 
+        # external scope writes (load_persistables, user scope.set)
+        # since the last step/sync win over stage-resident copies
+        self._restage_external()
         mbs = self._split_feed(feed, n_microbatches)
         # forward: keep vjp closures per (stage, microbatch)
         vjps = [[None] * len(self.stages) for _ in mbs]
@@ -310,6 +366,7 @@ class PipelineProgram:
                     n: (self.params[i][n] if n in self._frozen
                         else self.params[i][n] - lr * grads[i][n])
                     for n in self.params[i]}
+        self._dirty = True
         return float(np.mean([np.asarray(l).ravel()[0]
                               for l in losses]))
 
@@ -332,3 +389,25 @@ class PipelineProgram:
         for st_state in self._opt_state:
             for n, v in st_state.items():
                 (scope.find_scope_of(n) or scope).set(n, np.asarray(v))
+        if scope is self.scope:
+            for part in self.params + self._opt_state:
+                for n in part:
+                    self._record_seen(n)
+            self._dirty = False
+
+    def sync_scope(self):
+        """flush_prepared protocol entry point (core/executor_impl):
+        write stage-resident params + optimizer state back to the
+        construction scope — except names written EXTERNALLY since we
+        last read them (a checkpoint load mid-training): those keep the
+        scope's newer value and are re-staged at the next train_step."""
+        ext = self._external_writes()
+        scope = self.scope
+        for part in self.params + self._opt_state:
+            for n, v in part.items():
+                if n in ext:
+                    continue
+                s = scope.find_scope_of(n) or scope
+                s.set(n, np.asarray(v))
+                self._seen[n] = (s, s._write_versions[n])
+        self._dirty = False

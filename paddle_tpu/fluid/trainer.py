@@ -9,6 +9,9 @@ from __future__ import annotations
 
 import os
 
+import numpy as np
+
+from paddle_tpu.core.executor_impl import PreparedShapeMismatch
 from paddle_tpu.core.place import CPUPlace, TPUPlace
 from paddle_tpu.core.scope import Scope
 
@@ -280,54 +283,94 @@ class Trainer:
 
     def _train_by_executor(self, num_epochs, event_handler, reader,
                            feed_order):
-        import numpy as np
-
         feeder = self._feeder(feed_order, self.train_program)
         exe = Executor(self.place)
         metrics = [v.name for v in self.train_func_outputs]
         start_epoch = (self.checkpoint_cfg.epoch_id
                        if self.checkpoint_cfg else 0)
+        # Prepared hot path (core PreparedProgram): the per-step cost is
+        # feed staging + one dispatch — parameters and optimizer state
+        # stay device-resident between steps instead of round-tripping
+        # the Scope, and metric fetches convert to host numpy only when
+        # the event handler asked for them.  Programs the compiled path
+        # can't own whole (host ops — e.g. a dist-transpiled trainer
+        # program with send/recv) fall back to run().
+        prepared = None  # None = not tried yet; False = unpreparable
         with scope_guard(self.scope):
-            for epoch_id in range(start_epoch, num_epochs):
-                event_handler(BeginEpochEvent(epoch_id))
-                for step_id, minibatch in enumerate(reader()):
-                    if self.__stop:
-                        if self.checkpoint_cfg:
-                            self._clean_checkpoint()
-                        return
-                    # resuming mid-epoch: skip already-trained steps
-                    if (self.checkpoint_cfg and
-                            epoch_id == start_epoch and
-                            step_id < self.checkpoint_cfg.step_id):
-                        continue
-                    begin = BeginStepEvent(epoch_id, step_id)
-                    event_handler(begin)
-                    feed = feeder.feed(minibatch)
-                    if begin.fetch_metrics:
-                        outs = exe.run(self.train_program, feed=feed,
-                                       fetch_list=metrics)
-                        vals = [np.asarray(o) for o in outs]
-                    else:
-                        exe.run(self.train_program, feed=feed,
-                                fetch_list=[])
-                        vals = []
-                    if (self.checkpoint_cfg and
-                            step_id % self.checkpoint_cfg.step_interval
-                            == 0 and
-                            epoch_id % self.checkpoint_cfg.epoch_interval
-                            == 0):
-                        # cursor = NEXT step to run: the params already
-                        # include this step's update, so resuming must
-                        # not re-apply it (the reference saves step_id
-                        # and double-runs the checkpointed step)
-                        self._save_checkpoint(epoch_id, step_id + 1)
-                    event_handler(EndStepEvent(epoch_id, step_id, vals))
+            try:
+                for epoch_id in range(start_epoch, num_epochs):
+                    event_handler(BeginEpochEvent(epoch_id))
+                    for step_id, minibatch in enumerate(reader()):
+                        if self.__stop:
+                            if self.checkpoint_cfg:
+                                self._clean_checkpoint()
+                            return
+                        # resuming mid-epoch: skip already-trained steps
+                        if (self.checkpoint_cfg and
+                                epoch_id == start_epoch and
+                                step_id < self.checkpoint_cfg.step_id):
+                            continue
+                        begin = BeginStepEvent(epoch_id, step_id)
+                        event_handler(begin)
+                        feed = feeder.feed(minibatch)
+                        if prepared and prepared.is_stale:
+                            # program mutated (a pass/transpiler ran):
+                            # flush and re-prepare against the new desc
+                            prepared.sync_scope()
+                            prepared = None
+                        if prepared is None:
+                            try:
+                                prepared = exe.prepare(
+                                    self.train_program, feed_specs=feed,
+                                    fetch_list=metrics)
+                            except ValueError:
+                                prepared = False
+                        vals = self._run_one_step(exe, prepared, feed,
+                                                  metrics,
+                                                  begin.fetch_metrics)
+                        if (self.checkpoint_cfg and
+                                step_id %
+                                self.checkpoint_cfg.step_interval == 0
+                                and epoch_id %
+                                self.checkpoint_cfg.epoch_interval == 0):
+                            # cursor = NEXT step to run: the params
+                            # already include this step's update, so
+                            # resuming must not re-apply it (the
+                            # reference saves step_id and double-runs
+                            # the checkpointed step).  The io save path
+                            # flushes prepared device state first.
+                            self._save_checkpoint(epoch_id, step_id + 1)
+                        event_handler(EndStepEvent(epoch_id, step_id,
+                                                   vals))
+                    if self.checkpoint_cfg:
+                        # epoch rolls over: next resume starts at step 0
+                        self._save_checkpoint(epoch_id + 1, 0)
+                    event_handler(EndEpochEvent(epoch_id))
                 if self.checkpoint_cfg:
-                    # epoch rolls over: next resume starts at step 0
-                    self._save_checkpoint(epoch_id + 1, 0)
-                event_handler(EndEpochEvent(epoch_id))
-            if self.checkpoint_cfg:
-                self._clean_checkpoint()
+                    self._clean_checkpoint()
+            finally:
+                # leave the scope authoritative for test()/save_params()
+                # and for a Trainer rebuilt over the same scope
+                if prepared:
+                    prepared.sync_scope()
+
+    def _run_one_step(self, exe, prepared, feed, metrics, fetch_metrics):
+        if prepared:
+            try:
+                outs = prepared.run_prepared(feed,
+                                             return_numpy=fetch_metrics)
+                return outs if fetch_metrics else []
+            except PreparedShapeMismatch:
+                # AOT (auto-layout) entry + a drifted batch shape (the
+                # final partial minibatch): run() this batch — it flushes
+                # the prepared state first and compiles per shape
+                pass
+        if fetch_metrics:
+            outs = exe.run(self.train_program, feed=feed,
+                           fetch_list=metrics)
+            return [np.asarray(o) for o in outs]
+        exe.run(self.train_program, feed=feed, fetch_list=[])
+        return []
 
     def _save_checkpoint(self, epoch_id, step_id):
         exe = Executor(self.place)

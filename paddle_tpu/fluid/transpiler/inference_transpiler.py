@@ -329,7 +329,11 @@ class LayerNormFusePass(ProgramPass):
             xshape = du.shape(x_name)
             dtype = block.vars[x_name].dtype if x_name in block.vars \
                 else np_dtype_to_proto("float32")
-            aux_shape = tuple(xshape[:-1]) + (1,)
+            # the layer_norm lowering emits Mean/Variance reshaped to
+            # x.shape[:begin_norm_axis] (ops/nn.py _layer_norm — no
+            # trailing 1); the declared var desc must agree or the
+            # fused program's shapes lie to downstream passes
+            aux_shape = tuple(xshape[:-1])
             mean_v = y_name + "@ln_mean"
             var_v = y_name + "@ln_var"
             for nm in (mean_v, var_v):

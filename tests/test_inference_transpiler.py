@@ -241,6 +241,12 @@ def test_layer_norm_fuse_pass_output_equality(prog_scope, exe):
     types = [op.type for op in infer.desc.blocks[0].ops]
     assert "layer_norm" in types
     assert "elementwise_div" not in types
+    # declared aux var descs agree with the lowering's runtime shapes
+    # (ADVICE low: _layer_norm emits Mean/Variance as x.shape[:begin],
+    # no trailing 1)
+    blk = infer.desc.blocks[0]
+    for nm in (y.name + "@ln_mean", y.name + "@ln_var"):
+        assert tuple(blk.vars[nm].shape) == (-1,)
     got, = exe.run(infer, feed={"ln_x": xv}, fetch_list=[y.name])
     np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
                                atol=1e-5, rtol=1e-5)

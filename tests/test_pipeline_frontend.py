@@ -162,3 +162,62 @@ def test_pipeline_without_optimizer_requires_lr():
     y = np.zeros((4, 1), np.float32)
     with pytest.raises(ValueError, match="pass lr"):
         pp.train_step({"x": x, "y": y}, n_microbatches=2)
+
+
+def test_pipeline_external_write_wins_and_restages():
+    """External scope writes between pipeline steps (a checkpoint load,
+    a user scope.set) win over the stage-resident copies: the flush
+    must not clobber them and the next step trains FROM them."""
+    import jax
+
+    devices = jax.devices("cpu")
+    if len(devices) < 3:
+        pytest.skip("needs 3 host devices")
+
+    rng = np.random.RandomState(4)
+    xv = rng.randn(8, 8).astype(np.float32)
+    yv = (xv.sum(1, keepdims=True) * 0.3).astype(np.float32)
+    lr, n_mb = 0.05, 4
+
+    scope_b = fluid.Scope()
+    main_b, startup_b, h1, h2, loss_b = _build(scope_b)
+    with fluid.scope_guard(scope_b):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup_b)
+
+    # baseline replays the same schedule single-device from the same
+    # init, including the mid-training external reset of w1
+    scope_c = fluid.Scope()
+    main_c, startup_c, _, _, loss_c = _build(scope_c)
+    with fluid.scope_guard(scope_c):
+        with fluid.program_guard(main_c, startup_c):
+            fluid.optimizer.SGD(learning_rate=lr).minimize(loss_c)
+        exe_c = fluid.Executor(fluid.CPUPlace())
+        exe_c.run(startup_c)
+        for n in ("w1", "b1", "w2", "b2", "w3", "b3"):
+            scope_c.set(n, np.asarray(scope_b.find_var(n)))
+
+    from paddle_tpu.fluid.pipeline import PipelineProgram
+
+    pp = PipelineProgram(main_b, loss_b, cut_vars=[h1, h2],
+                         devices=devices[:3], scope=scope_b,
+                         feed_names=["x", "y"])
+    marker = np.zeros((8, 16), np.float32)
+
+    pp.train_step({"x": xv, "y": yv}, n_microbatches=n_mb, lr=lr)
+    scope_b.set("w1", marker.copy())  # external write while dirty
+    # a flushing read must NOT clobber the external value
+    np.testing.assert_array_equal(
+        fluid.fetch_var("w1", scope=scope_b), marker)
+    pp.train_step({"x": xv, "y": yv}, n_microbatches=n_mb, lr=lr)
+    pp.sync_scope()
+
+    with fluid.scope_guard(scope_c):
+        exe_c.run(main_c, feed={"x": xv, "y": yv},
+                  fetch_list=[loss_c])
+        scope_c.set("w1", marker.copy())
+        exe_c.run(main_c, feed={"x": xv, "y": yv},
+                  fetch_list=[loss_c])
+    np.testing.assert_allclose(
+        np.asarray(scope_b.find_var("w1")),
+        np.asarray(scope_c.find_var("w1")), rtol=1e-4, atol=1e-6)
