@@ -1,0 +1,88 @@
+"""Ahead-of-time program verification.
+
+``verify_program(program)`` runs the registered checker pipeline over a
+core ``ProgramDesc`` (or a fluid ``Program``) and returns structured
+:class:`Diagnostic` records; ``enforce`` converts them to a warning or
+a :class:`ProgramVerificationError` per ``FLAGS_check_program``
+(off/warn/error, default warn).
+
+The executor calls this ONLY on a compile-cache miss (a program
+uid+version it has not verified before), so steady-state training pays
+nothing; ``DistributeTranspiler`` verifies its outputs, and
+``tools/lint_program.py`` lints a saved program/inference model from
+the command line.
+
+Role parity: reference runtime ``OperatorWithKernel::InferShape`` +
+the ``fluid/inference/analysis`` pass framework, moved to build time.
+"""
+from __future__ import annotations
+
+import warnings
+
+from .checkers import (CHECKERS, register_checker, run_checkers,
+                       verify_transpiled_pair)
+from .defuse import DefUse, sub_block_indices
+from .diagnostics import (Diagnostic, ProgramVerificationError, Severity,
+                          format_diagnostics, max_severity)
+
+__all__ = [
+    "CHECKERS", "DefUse", "Diagnostic", "ProgramLintWarning",
+    "ProgramVerificationError", "Severity", "enforce",
+    "format_diagnostics", "max_severity", "register_checker",
+    "sub_block_indices", "verify_and_enforce", "verify_program",
+    "verify_transpiled_pair",
+]
+
+
+class ProgramLintWarning(UserWarning):
+    """Category used at FLAGS_check_program=warn so callers/tests can
+    filter verifier output precisely."""
+
+
+def _desc_of(program):
+    return getattr(program, "desc", program)
+
+
+def verify_program(program, checkers=None):
+    """Run the checker pipeline; returns [Diagnostic] (possibly empty).
+    ``program`` is a core ProgramDesc or a fluid Program."""
+    return run_checkers(_desc_of(program), checkers)
+
+
+def enforce(diagnostics, level, source=None):
+    """Apply a check level to already-computed diagnostics: ``error``
+    raises ProgramVerificationError when any error-severity finding
+    exists; ``warn`` emits one ProgramLintWarning summarizing them;
+    ``off`` does nothing.  Warning/note findings never raise — they are
+    for the lint CLI and programmatic consumers."""
+    if level == "off" or not diagnostics:
+        return diagnostics
+    errors = [d for d in diagnostics if d.is_error]
+    if not errors:
+        return diagnostics
+    if level == "error":
+        raise ProgramVerificationError(diagnostics, source=source)
+    warnings.warn(
+        "program verification%s found %d error(s):\n%s"
+        % (" (%s)" % source if source else "", len(errors),
+           format_diagnostics(errors)),
+        ProgramLintWarning, stacklevel=3)
+    return diagnostics
+
+
+def verify_and_enforce(program, level=None, source=None, checkers=None):
+    """verify_program + enforce under one roof; ``level`` defaults to
+    FLAGS.check_program.  A full-pipeline verification that survives
+    enforce() stamps ``_verified_key`` on the desc, so the executor's
+    compile-cache-miss verification (ExecutorCore._maybe_verify) does
+    not repeat work a transpiler already did on the same version."""
+    if level is None:
+        from paddle_tpu.core.flags import FLAGS
+        level = FLAGS.check_program
+    if level == "off":
+        return []
+    desc = _desc_of(program)
+    diags = enforce(verify_program(desc, checkers), level, source=source)
+    if checkers is None:
+        desc._verified_key = (desc.version, level)
+    return diags

@@ -1,0 +1,518 @@
+"""The pluggable checker pipeline.
+
+Each checker is ``fn(du: DefUse) -> iterable[Diagnostic]`` registered
+under a stable name; ``verify_program`` (package __init__) runs them in
+registration order.  Role parity: the reference's per-op
+``OperatorWithKernel::InferShape`` enforcement plus the
+inference/analysis passes — moved ahead of time, so a malformed
+ProgramDesc is reported as a structured diagnostic before XLA traces
+anything.
+"""
+from __future__ import annotations
+
+import collections
+
+from paddle_tpu.core.registry import get_op_info, has_op
+
+from .defuse import CONCURRENT_LAUNCH_OPS, DefUse, sub_block_indices
+from .diagnostics import Diagnostic, Severity
+from .shapes import check_block_shapes
+
+__all__ = ["CHECKERS", "register_checker", "run_checkers",
+           "verify_transpiled_pair"]
+
+CHECKERS = collections.OrderedDict()
+
+
+def register_checker(name):
+    """Register a checker under ``name`` (decorator).  Checkers run in
+    registration order; later registrations may assume structural
+    soundness established by earlier ones (e.g. the shape checker skips
+    ops the def-use checker already reported as undeclared)."""
+
+    def deco(fn):
+        if name in CHECKERS:
+            raise ValueError("checker %r already registered" % name)
+        CHECKERS[name] = fn
+        return fn
+
+    return deco
+
+
+def run_checkers(program, checkers=None):
+    """Run ``checkers`` (names; default all) over one core ProgramDesc;
+    returns the concatenated diagnostics."""
+    du = DefUse(program)
+    names = list(checkers) if checkers is not None else list(CHECKERS)
+    diags = []
+    for name in names:
+        try:
+            fn = CHECKERS[name]
+        except KeyError:
+            raise KeyError("unknown checker %r (registered: %s)"
+                           % (name, ", ".join(CHECKERS)))
+        diags.extend(fn(du))
+    return diags
+
+
+def _is_host(op_type):
+    try:
+        return bool(get_op_info(op_type).host_op)
+    except KeyError:
+        return False
+
+
+# ---------------------------------------------------------------------------
+# def-use: undeclared vars and use-before-def orderings
+# ---------------------------------------------------------------------------
+
+@register_checker("def-use")
+def check_def_use(du):
+    diags = []
+    visited = set()
+
+    def walk(bi, defined):
+        if bi in visited:
+            return
+        visited.add(bi)
+        block = du.block(bi)
+        first_write = {}
+        for oi, op in enumerate(block.ops):
+            for n in op.output_arg_names():
+                if n and n not in first_write:
+                    first_write[n] = oi
+        for oi, op in enumerate(block.ops):
+            for n in set(op.input_arg_names()):
+                if not n:
+                    continue
+                vd = du.find_var(bi, n)
+                if vd is None:
+                    diags.append(Diagnostic(
+                        "def-use", Severity.ERROR,
+                        "reads a var with no reachable VarDesc",
+                        block_idx=bi, op_idx=oi, op_type=op.type, var=n,
+                        suggestion="declare the var in this block (or an "
+                                   "ancestor), or fix the op argument "
+                                   "name"))
+                    continue
+                if (n not in defined and not vd.persistable
+                        and n in block.vars
+                        and first_write.get(n, -1) > oi):
+                    diags.append(Diagnostic(
+                        "def-use", Severity.WARNING,
+                        "read before its first write (op %d); unless it "
+                        "is fed every step the op sees a stale or "
+                        "missing value" % first_write[n],
+                        block_idx=bi, op_idx=oi, op_type=op.type, var=n,
+                        suggestion="reorder the ops, or write the var "
+                                   "before its first reader"))
+            for n in op.output_arg_names():
+                if n:
+                    defined.add(n)
+            for sub in sub_block_indices(op):
+                if 0 <= sub < len(du.program.blocks) and sub != bi:
+                    walk(sub, set(defined))
+                    # writes a sub-block makes to outer vars are visible
+                    # to ops after the launching op (conservatively: any
+                    # control-flow op completes before the next op; a
+                    # go routine's writes may land late — the
+                    # concurrency checker owns that hazard)
+                    _, sub_writes = du.block_reads_writes(sub)
+                    defined.update(sub_writes)
+
+    for bi in range(len(du.program.blocks)):
+        if bi not in du.launch_site:
+            walk(bi, set())
+    # blocks only reachable through a launch site were walked there;
+    # anything still unvisited is dangling — walk it standalone so its
+    # internal ordering is still checked
+    for bi in range(len(du.program.blocks)):
+        walk(bi, set())
+    return diags
+
+
+# ---------------------------------------------------------------------------
+# block-refs: dangling sub-block references
+# ---------------------------------------------------------------------------
+
+@register_checker("block-refs")
+def check_block_refs(du):
+    diags = []
+    n_blocks = len(du.program.blocks)
+    for bi, block in enumerate(du.program.blocks):
+        if not (block.parent_idx == -1 or
+                (0 <= block.parent_idx < n_blocks
+                 and block.parent_idx != bi)):
+            diags.append(Diagnostic(
+                "block-refs", Severity.ERROR,
+                "parent_idx %d is not a valid block" % block.parent_idx,
+                block_idx=bi,
+                suggestion="rebuild the program; a pruning/transpile "
+                           "pass dropped a block without renumbering"))
+        for oi, op in enumerate(block.ops):
+            for sub in sub_block_indices(op):
+                if not (0 <= sub < n_blocks):
+                    diags.append(Diagnostic(
+                        "block-refs", Severity.ERROR,
+                        "references sub-block %d but the program has %d "
+                        "block(s)" % (sub, n_blocks),
+                        block_idx=bi, op_idx=oi, op_type=op.type,
+                        suggestion="a clone/prune dropped the sub-block; "
+                                   "re-run the transpile on the full "
+                                   "program"))
+                elif sub == bi:
+                    diags.append(Diagnostic(
+                        "block-refs", Severity.ERROR,
+                        "references its own block as a sub-block",
+                        block_idx=bi, op_idx=oi, op_type=op.type,
+                        suggestion="point the attr at the intended "
+                                   "child block"))
+    return diags
+
+
+# ---------------------------------------------------------------------------
+# shapes: abstract shape/dtype propagation
+# ---------------------------------------------------------------------------
+
+@register_checker("shapes")
+def check_shapes(du):
+    diags = []
+    for bi in sorted(du.reachable):
+        diags.extend(check_block_shapes(du, bi))
+    return diags
+
+
+# ---------------------------------------------------------------------------
+# grad-completeness: every op (notably *_grad) has a lowering
+# ---------------------------------------------------------------------------
+
+@register_checker("grad-completeness")
+def check_grad_completeness(du):
+    diags = []
+    for bi, block in enumerate(du.program.blocks):
+        for oi, op in enumerate(block.ops):
+            t = op.type
+            if has_op(t):
+                continue
+            if t.endswith("_grad"):
+                base = t[: -len("_grad")]
+                if has_op(base):
+                    continue  # synthesized from the forward vjp
+                diags.append(Diagnostic(
+                    "grad-completeness", Severity.ERROR,
+                    "backward op has no registered lowering and its "
+                    "forward %r is unregistered, so no vjp can be "
+                    "synthesized" % base,
+                    block_idx=bi, op_idx=oi, op_type=t,
+                    suggestion="register the forward op (the generic "
+                               "grad lowering then applies) or a custom "
+                               "grad lowering"))
+            else:
+                diags.append(Diagnostic(
+                    "grad-completeness", Severity.ERROR,
+                    "op type is not registered",
+                    block_idx=bi, op_idx=oi, op_type=t,
+                    suggestion="register the op (core/registry.py) or "
+                               "remove it from the program"))
+    return diags
+
+
+# ---------------------------------------------------------------------------
+# dist-pairing: send/recv/barrier structure of transpiled programs
+# ---------------------------------------------------------------------------
+
+_RPC_SLICED_OPS = ("send", "recv", "distributed_lookup")
+
+
+def _endpoints_of(op):
+    eps = op.attr("epmap", None)
+    if eps is None:
+        eps = op.attr("endpoints", [])
+    return list(eps or [])
+
+
+@register_checker("dist-pairing")
+def check_dist_pairing(du):
+    diags = []
+    for bi, block in enumerate(du.program.blocks):
+        sends, recvs, send_bars, fetch_bars = [], [], [], []
+        for oi, op in enumerate(block.ops):
+            if op.type in _RPC_SLICED_OPS:
+                epmap = op.attr("epmap", []) or []
+                sections = op.attr("sections", []) or []
+                names = op.attr("block_names", []) or []
+                if not (len(epmap) == len(sections) == len(names)) \
+                        or not epmap:
+                    diags.append(Diagnostic(
+                        "dist-pairing", Severity.ERROR,
+                        "epmap/sections/block_names lengths disagree "
+                        "(%d/%d/%d); slices cannot be routed"
+                        % (len(epmap), len(sections), len(names)),
+                        block_idx=bi, op_idx=oi, op_type=op.type,
+                        var=(op.input_arg_names()
+                             or op.output_arg_names() or [None])[0],
+                        suggestion="re-run the DistributeTranspiler; "
+                                   "hand-edited RPC attrs must keep the "
+                                   "three lists aligned"))
+            if op.type == "send":
+                sends.append((oi, op))
+            elif op.type == "recv":
+                recvs.append((oi, op))
+            elif op.type == "send_barrier":
+                send_bars.append((oi, op))
+            elif op.type == "fetch_barrier":
+                fetch_bars.append((oi, op))
+            elif op.type == "listen_and_serv":
+                fanin = op.attr("Fanin", 1)
+                if int(fanin or 0) < 1:
+                    diags.append(Diagnostic(
+                        "dist-pairing", Severity.ERROR,
+                        "Fanin %r < 1: the serve loop would complete "
+                        "rounds no trainer participates in" % fanin,
+                        block_idx=bi, op_idx=oi, op_type=op.type,
+                        suggestion="set Fanin to the trainer count"))
+        if sends and recvs and not send_bars:
+            diags.append(Diagnostic(
+                "dist-pairing", Severity.WARNING,
+                "block sends gradients and receives parameters with no "
+                "send_barrier between: receives may fetch pre-update "
+                "values (async mode is the only valid reading)",
+                block_idx=bi, op_idx=recvs[0][0], op_type="recv",
+                suggestion="transpile with sync_mode=True, or confirm "
+                           "async semantics are intended"))
+        if send_bars:
+            bar_idx = send_bars[0][0]
+            bar_eps = set(_endpoints_of(send_bars[0][1]))
+            for oi, op in sends:
+                if oi > bar_idx:
+                    diags.append(Diagnostic(
+                        "dist-pairing", Severity.ERROR,
+                        "send appears after the send_barrier: its "
+                        "gradient misses the aggregation round",
+                        block_idx=bi, op_idx=oi, op_type="send",
+                        suggestion="move every send before the "
+                                   "send_barrier"))
+                missing = set(_endpoints_of(op)) - bar_eps
+                if missing:
+                    diags.append(Diagnostic(
+                        "dist-pairing", Severity.ERROR,
+                        "send targets endpoint(s) %s not covered by the "
+                        "send_barrier: those pservers never see the "
+                        "round close and stall the fan-in"
+                        % sorted(missing),
+                        block_idx=bi, op_idx=oi, op_type="send",
+                        suggestion="include every send endpoint in the "
+                                   "barrier's endpoints attr"))
+            for oi, op in recvs:
+                if oi < bar_idx:
+                    diags.append(Diagnostic(
+                        "dist-pairing", Severity.ERROR,
+                        "recv appears before the send_barrier: it "
+                        "fetches parameters from before this step's "
+                        "update",
+                        block_idx=bi, op_idx=oi, op_type="recv",
+                        suggestion="move every recv after the "
+                                   "send_barrier"))
+        if fetch_bars and recvs:
+            fb_idx = fetch_bars[-1][0]
+            late = [oi for oi, _ in recvs if oi > fb_idx]
+            for oi in late:
+                diags.append(Diagnostic(
+                    "dist-pairing", Severity.ERROR,
+                    "recv appears after the fetch_barrier that should "
+                    "close the fetch round",
+                    block_idx=bi, op_idx=oi, op_type="recv",
+                    suggestion="move the recv before the fetch_barrier"))
+    return diags
+
+
+def verify_transpiled_pair(trainer_desc, pserver_descs):
+    """Cross-program pairing check: every gradient the trainer sends to
+    an endpoint must be served by that endpoint's listen_and_serv
+    (grad_to_block_id), and every param block the trainer receives must
+    be declared on the serving pserver.  ``pserver_descs`` maps endpoint
+    -> pserver core ProgramDesc.  Returns diagnostics.
+    """
+    diags = []
+    served = {}     # ep -> set of grad block names
+    declared = {}   # ep -> set of declared var names (all blocks)
+    for ep, desc in pserver_descs.items():
+        grads = set()
+        for block in desc.blocks:
+            for op in block.ops:
+                if op.type == "listen_and_serv":
+                    for s in op.attr("grad_to_block_id", []) or []:
+                        grads.add(str(s).rsplit(":", 1)[0])
+        served[ep] = grads
+        declared[ep] = {n for b in desc.blocks for n in b.vars}
+    for bi, block in enumerate(trainer_desc.blocks):
+        for oi, op in enumerate(block.ops):
+            if op.type not in ("send", "recv"):
+                continue
+            epmap = op.attr("epmap", []) or []
+            names = op.attr("block_names", []) or []
+            for ep, name in zip(epmap, names):
+                if ep not in pserver_descs:
+                    continue  # endpoint not under check
+                if op.type == "send" and name not in served[ep]:
+                    diags.append(Diagnostic(
+                        "dist-pairing", Severity.ERROR,
+                        "sends grad block %r to %s but that pserver's "
+                        "listen_and_serv has no matching "
+                        "grad_to_block_id entry: the gradient would be "
+                        "dropped" % (name, ep),
+                        block_idx=bi, op_idx=oi, op_type="send",
+                        var=name,
+                        suggestion="regenerate the pserver program from "
+                                   "the same transpile() call"))
+                elif op.type == "recv" and name not in declared[ep]:
+                    diags.append(Diagnostic(
+                        "dist-pairing", Severity.ERROR,
+                        "receives param block %r from %s but that "
+                        "pserver never declares it" % (name, ep),
+                        block_idx=bi, op_idx=oi, op_type="recv",
+                        var=name,
+                        suggestion="regenerate the pserver program from "
+                                   "the same transpile() call"))
+    return diags
+
+
+# ---------------------------------------------------------------------------
+# concurrency: unsynchronized writes from concurrent blocks + prepared
+# donation hazards
+# ---------------------------------------------------------------------------
+
+_SYNC_OPS = frozenset({"channel_recv", "channel_send"})
+
+
+def _outer_accesses(du, launch_bi, sub_bi):
+    """(reads, writes) of a sub-block restricted to vars visible in the
+    launching block's scope chain — writes to sub-local temps are
+    private and never race."""
+    reads, writes = du.block_reads_writes(sub_bi)
+    sub_local = set(du.block(sub_bi).vars) if \
+        0 <= sub_bi < len(du.program.blocks) else set()
+    outer = lambda n: (n not in sub_local
+                       and du.find_var(launch_bi, n) is not None)
+    return {n for n in reads if outer(n)}, {n for n in writes if outer(n)}
+
+
+def _synced_between(block, start, end):
+    """True when a channel op sits between two op indices — the only
+    in-program synchronization primitive; accesses ordered across one
+    are considered intentional."""
+    return any(block.ops[k].type in _SYNC_OPS
+               for k in range(start + 1, min(end, len(block.ops))))
+
+
+@register_checker("concurrency")
+def check_concurrency(du):
+    diags = []
+    for bi, block in enumerate(du.program.blocks):
+        launches = []  # (op_idx, sub_idx, outer_reads, outer_writes)
+        for oi, op in enumerate(block.ops):
+            if op.type in CONCURRENT_LAUNCH_OPS:
+                for sub in sub_block_indices(op):
+                    r, w = _outer_accesses(du, bi, sub)
+                    # union with the build-time declared write-set (see
+                    # fluid ProgramGo): a rewrite that redirected the
+                    # sub-block keeps its original hazards visible
+                    w = w | set(op.attr("outer_writes", []) or [])
+                    launches.append((oi, sub, r, w))
+        # concurrent block vs concurrent block: no program ordering at
+        # all between them — any write overlap is a race
+        for i in range(len(launches)):
+            for j in range(i + 1, len(launches)):
+                oi_a, sub_a, _, w_a = launches[i]
+                oi_b, sub_b, r_b, w_b = launches[j]
+                for n in sorted(w_a & w_b):
+                    diags.append(Diagnostic(
+                        "concurrency", Severity.ERROR,
+                        "written by concurrent blocks %d and %d with no "
+                        "ordering between them" % (sub_a, sub_b),
+                        block_idx=bi, op_idx=oi_b, op_type="go", var=n,
+                        suggestion="route the value through a channel, "
+                                   "or give each routine its own output "
+                                   "var"))
+                for n in sorted(w_a & r_b):
+                    diags.append(Diagnostic(
+                        "concurrency", Severity.WARNING,
+                        "read by concurrent block %d while concurrent "
+                        "block %d writes it" % (sub_b, sub_a),
+                        block_idx=bi, op_idx=oi_b, op_type="go", var=n,
+                        suggestion="synchronize through a channel"))
+        # concurrent block vs the launching block's continuation
+        for oi, sub, r_g, w_g in launches:
+            for oj in range(oi + 1, len(block.ops)):
+                later = block.ops[oj]
+                if later.type in CONCURRENT_LAUNCH_OPS:
+                    continue  # handled pairwise above
+                later_w = {n for n in later.output_arg_names() if n}
+                later_r = {n for n in later.input_arg_names() if n}
+                for n in sorted(w_g & later_w):
+                    if _synced_between(block, oi, oj):
+                        continue
+                    diags.append(Diagnostic(
+                        "concurrency", Severity.ERROR,
+                        "written both by concurrent block %d and by op "
+                        "%d with no channel synchronization between "
+                        "launch and write" % (sub, oj),
+                        block_idx=bi, op_idx=oj, op_type=later.type,
+                        var=n,
+                        suggestion="receive from a channel the routine "
+                                   "closes/sends on before overwriting "
+                                   "shared state"))
+                for n in sorted(w_g & later_r):
+                    if _synced_between(block, oi, oj):
+                        continue
+                    diags.append(Diagnostic(
+                        "concurrency", Severity.WARNING,
+                        "read at op %d while concurrent block %d may "
+                        "still be writing it" % (oj, sub),
+                        block_idx=bi, op_idx=oj, op_type=later.type,
+                        var=n,
+                        suggestion="receive from a channel fed by the "
+                                   "routine instead of reading the var "
+                                   "directly"))
+                for n in sorted(r_g & later_w):
+                    if _synced_between(block, oi, oj):
+                        continue
+                    diags.append(Diagnostic(
+                        "concurrency", Severity.WARNING,
+                        "overwritten at op %d while concurrent block %d "
+                        "may still be reading it" % (oj, sub),
+                        block_idx=bi, op_idx=oj, op_type=later.type,
+                        var=n,
+                        suggestion="send the routine its input over a "
+                                   "channel instead of sharing the var"))
+        # prepared-executor donation hazard: a host op reads a
+        # persistable BEFORE the device ops that overwrite it; the
+        # compiled step donates that buffer, so any by-reference host
+        # consumer (async save/send) can observe a consumed husk
+        first_dev_write = {}
+        for oi, op in enumerate(block.ops):
+            if _is_host(op.type):
+                continue
+            for n in op.output_arg_names():
+                if not n or n in first_dev_write:
+                    continue
+                vd = du.find_var(bi, n)
+                if vd is not None and vd.persistable:
+                    first_dev_write[n] = oi
+        for oi, op in enumerate(block.ops):
+            if not _is_host(op.type):
+                continue
+            for n in set(op.input_arg_names()):
+                wj = first_dev_write.get(n)
+                if wj is not None and wj > oi:
+                    diags.append(Diagnostic(
+                        "concurrency", Severity.WARNING,
+                        "host op reads persistable %r which the "
+                        "compiled step later overwrites in place "
+                        "(donated buffer): a by-reference consumer "
+                        "races the donation" % n,
+                        block_idx=bi, op_idx=oi, op_type=op.type, var=n,
+                        suggestion="move the host read after the device "
+                                   "write, or copy the value before the "
+                                   "step"))
+    return diags

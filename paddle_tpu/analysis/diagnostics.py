@@ -1,0 +1,99 @@
+"""Structured diagnostics emitted by the program verifier.
+
+Role parity: reference platform/enforce.h error payloads and the
+inference/analysis pass reports — but as data, not exceptions: a checker
+yields :class:`Diagnostic` records and the caller decides whether to
+warn, raise, or render them (tools/lint_program.py).
+"""
+from __future__ import annotations
+
+__all__ = ["Severity", "Diagnostic", "ProgramVerificationError",
+           "format_diagnostics", "max_severity"]
+
+
+class Severity:
+    """String severities, ordered.  ERROR means the program will fail or
+    silently corrupt at runtime; WARNING is a suspicious construct worth
+    a human look; NOTE is analysis telemetry (e.g. an op the abstract
+    evaluator could not model)."""
+
+    ERROR = "error"
+    WARNING = "warning"
+    NOTE = "note"
+
+    _rank = {ERROR: 2, WARNING: 1, NOTE: 0}
+
+    @classmethod
+    def rank(cls, severity):
+        return cls._rank.get(severity, 0)
+
+
+class Diagnostic:
+    """One finding: where (block/op), what (severity + message), which
+    var, and a suggested fix when the checker knows one."""
+
+    __slots__ = ("checker", "severity", "block_idx", "op_idx", "op_type",
+                 "var", "message", "suggestion")
+
+    def __init__(self, checker, severity, message, block_idx=None,
+                 op_idx=None, op_type=None, var=None, suggestion=None):
+        self.checker = checker
+        self.severity = severity
+        self.message = message
+        self.block_idx = block_idx
+        self.op_idx = op_idx
+        self.op_type = op_type
+        self.var = var
+        self.suggestion = suggestion
+
+    @property
+    def is_error(self):
+        return self.severity == Severity.ERROR
+
+    def format(self):
+        loc = []
+        if self.block_idx is not None:
+            loc.append("block %d" % self.block_idx)
+        if self.op_idx is not None:
+            loc.append("op %d" % self.op_idx)
+        if self.op_type:
+            loc.append("(%s)" % self.op_type)
+        if self.var:
+            loc.append("var %r" % self.var)
+        head = "%s[%s]" % (self.severity, self.checker)
+        body = " ".join(loc + [self.message]) if loc else self.message
+        if self.suggestion:
+            body += " — fix: %s" % self.suggestion
+        return "%s %s" % (head, body)
+
+    def to_dict(self):
+        return {k: getattr(self, k) for k in self.__slots__}
+
+    def __repr__(self):
+        return "<Diagnostic %s>" % self.format()
+
+
+def format_diagnostics(diags):
+    return "\n".join(d.format() for d in diags)
+
+
+def max_severity(diags):
+    """Highest severity present, or None for a clean program."""
+    best = None
+    for d in diags:
+        if best is None or Severity.rank(d.severity) > Severity.rank(best):
+            best = d.severity
+    return best
+
+
+class ProgramVerificationError(RuntimeError):
+    """Raised by enforce() at FLAGS_check_program=error when the
+    verifier finds error-severity diagnostics.  Carries the full list so
+    callers/tests can inspect structured findings."""
+
+    def __init__(self, diagnostics, source=None):
+        self.diagnostics = list(diagnostics)
+        errors = [d for d in self.diagnostics if d.is_error]
+        head = ("program verification failed%s: %d error(s)"
+                % (" (%s)" % source if source else "", len(errors)))
+        super().__init__(head + "\n" + format_diagnostics(errors))

@@ -1,0 +1,149 @@
+"""Abstract shape/dtype propagation over a block (no FLOPs).
+
+The shape checker walks each reachable block's device ops in program
+order, carrying an env of (shape, dtype) specs per var.  Each op is
+abstract-evaluated through ``core.lowering.infer_op_outputs`` — the
+registered ``infer_shape`` when the op has one, jax.eval_shape over the
+lowering otherwise — with the propagated env overriding declared
+VarDescs, so a rank/dtype mismatch introduced after build time (e.g. a
+transpiler rename) is caught before XLA ever traces the program.
+"""
+from __future__ import annotations
+
+import re
+
+import numpy as np
+
+from paddle_tpu.core.registry import get_op_info
+from paddle_tpu.core.types import proto_to_np_dtype, VarKind
+
+from .diagnostics import Diagnostic, Severity
+
+__all__ = ["canon_dtype", "check_block_shapes"]
+
+# exceptions whose message matches this are genuine shape/dtype faults
+# of the program (vs. ops abstract evaluation simply cannot model)
+_SHAPE_FAULT_RE = re.compile(
+    r"shape|dtype|dimension|rank|broadcast|incompat|dot_general|"
+    r"concatenat|mismatch|size", re.IGNORECASE)
+
+# var kinds carrying runtime state the dense spec machinery cannot
+# describe: ops touching them are skipped (channels/readers/arrays are
+# host- or carry-managed and validated by their own checkers)
+_OPAQUE_KINDS = frozenset({
+    VarKind.READER, VarKind.STEP_SCOPES, VarKind.RAW,
+    VarKind.LOD_TENSOR_ARRAY, VarKind.LOD_RANK_TABLE,
+    VarKind.FETCH_LIST, VarKind.FEED_MINIBATCH,
+})
+
+
+# the runtime runs jax with 64-bit disabled: 64-bit declared dtypes are
+# narrowed at the feed boundary by design (MIGRATION.md "int64 ids and
+# offsets"), so declared-vs-inferred comparison happens post-narrowing
+_CANON = {np.dtype(np.int64): np.dtype(np.int32),
+          np.dtype(np.uint64): np.dtype(np.uint32),
+          np.dtype(np.float64): np.dtype(np.float32)}
+
+
+def canon_dtype(dtype):
+    """Map a declared dtype to what the 32-bit runtime actually carries
+    — the ONE narrowing table shared by the shape checker and the
+    op_test abstract-parity property, so they cannot disagree."""
+    dt = np.dtype(dtype)
+    return _CANON.get(dt, dt)
+
+
+def _spec_of(vd):
+    return (tuple(vd.shape), proto_to_np_dtype(vd.dtype))
+
+
+def _touches_opaque(du, bi, op):
+    for n in op.input_arg_names() + op.output_arg_names():
+        if not n:
+            continue
+        vd = du.find_var(bi, n)
+        if vd is not None and vd.kind in _OPAQUE_KINDS:
+            return True
+    return False
+
+
+def _static_conflict(declared, inferred):
+    """True when two shapes disagree on rank or on a dim both state
+    statically (-1 matches anything)."""
+    if len(declared) != len(inferred):
+        return True
+    return any(d != -1 and i != -1 and d != i
+               for d, i in zip(declared, inferred))
+
+
+def check_block_shapes(du, bi, checker="shapes"):
+    """Diagnostics for one block's abstract shape/dtype walk."""
+    from paddle_tpu.core import lowering
+
+    diags = []
+    block = du.block(bi)
+    env = {}  # name -> (shape, np dtype), the propagated truth
+    for oi, op in enumerate(block.ops):
+        try:
+            info = get_op_info(op.type)
+        except KeyError:
+            continue  # grad-completeness reports unregistered types
+        if info.host_op or info.lower is None:
+            continue
+        if _touches_opaque(du, bi, op):
+            continue
+        try:
+            inferred = lowering.infer_op_outputs(
+                du.program, block, op, var_specs=env)
+        except KeyError:
+            continue  # undeclared input: the def-use checker owns this
+        except Exception as e:
+            msg = str(e)
+            severity = (Severity.ERROR if _SHAPE_FAULT_RE.search(msg)
+                        else Severity.NOTE)
+            first_line = msg.strip().splitlines()[0] if msg.strip() else msg
+            diags.append(Diagnostic(
+                checker, severity,
+                "abstract evaluation failed: %s" % first_line,
+                block_idx=bi, op_idx=oi, op_type=op.type,
+                var=(op.input_arg_names() or [None])[0],
+                suggestion="check the op's input shapes/dtypes against "
+                           "its contract" if severity == Severity.ERROR
+                           else None))
+            # outputs stay at their declared specs for downstream ops
+            for n in op.output_arg_names():
+                vd = du.find_var(bi, n) if n else None
+                if vd is not None and n not in env:
+                    env[n] = _spec_of(vd)
+            continue
+        amp = bool(getattr(du.program, "amp_bf16", False))
+        for name, (shape, dtype) in inferred.items():
+            env[name] = (tuple(shape), np.dtype(dtype))
+            vd = du.find_var(bi, name)
+            if vd is None:
+                continue
+            decl_shape, decl_dtype = _spec_of(vd)
+            # bf16 mixed precision: descs keep float32 master dtypes
+            # while activations flow in bfloat16 BY CONTRACT
+            dtype_ok = canon_dtype(decl_dtype) == canon_dtype(dtype) or (
+                amp and {str(np.dtype(decl_dtype)), str(np.dtype(dtype))}
+                <= {"float32", "bfloat16"})
+            if not dtype_ok:
+                diags.append(Diagnostic(
+                    checker, Severity.ERROR,
+                    "declared dtype %s but the op produces %s"
+                    % (np.dtype(decl_dtype).name, np.dtype(dtype).name),
+                    block_idx=bi, op_idx=oi, op_type=op.type, var=name,
+                    suggestion="fix the VarDesc dtype or the producing "
+                               "op; stale descs poison feed coercion "
+                               "and the compile cache"))
+            elif decl_shape and _static_conflict(decl_shape, shape):
+                diags.append(Diagnostic(
+                    checker,
+                    Severity.ERROR if vd.persistable else Severity.WARNING,
+                    "declared shape %s but the op produces %s"
+                    % (list(decl_shape), list(shape)),
+                    block_idx=bi, op_idx=oi, op_type=op.type, var=name,
+                    suggestion="re-run shape inference after mutating "
+                               "the program, or fix the declared shape"))
+    return diags

@@ -77,16 +77,35 @@ class BlockRef:
         return "BlockRef(%d)" % self.idx
 
 
+class OpSlotError(KeyError):
+    """Missing input/output slot, with the op context in the message
+    (a bare KeyError("X") tells the user nothing about WHICH op or what
+    slots it does have)."""
+
+    def __str__(self):
+        return self.args[0]
+
+
+_MISSING = object()
+
+
 class OpDesc:
     """One operator: type + named input/output slots + attrs.
 
     Slots map parameter name -> list of variable names, as in reference
     OpDesc (framework.proto:34).
+
+    Once attached to a block (append/prepend/insert), every mutator —
+    ``set_attr``, ``rename_input``, ``rename_output`` — bumps the owning
+    program's version, so the executor's prepared/compile caches (keyed
+    on uid+version) can never serve an executable for a program a
+    transpiler has since rewritten.
     """
 
-    __slots__ = ("type", "inputs", "outputs", "attrs", "role")
+    __slots__ = ("type", "inputs", "outputs", "attrs", "role", "_block")
 
     def __init__(self, type_, inputs=None, outputs=None, attrs=None, role=0):
+        self._block = None   # set when attached to a BlockDesc
         self.type = type_
         self.inputs = {k: list(v) for k, v in (inputs or {}).items()}
         self.outputs = {k: list(v) for k, v in (outputs or {}).items()}
@@ -95,12 +114,18 @@ class OpDesc:
             self.set_attr(k, v)
         self.role = role
 
+    def _mutated(self):
+        blk = self._block
+        if blk is not None:
+            blk.program.bump_version()
+
     # --- attrs ---
     def set_attr(self, name, value):
         if isinstance(value, Attr):
             self.attrs[name] = value
         else:
             self.attrs[name] = Attr.infer(name, value)
+        self._mutated()
 
     def attr(self, name, default=None):
         a = self.attrs.get(name)
@@ -110,11 +135,31 @@ class OpDesc:
         return name in self.attrs
 
     # --- io ---
-    def input(self, slot):
-        return self.inputs.get(slot, [])
+    def input(self, slot, default=_MISSING):
+        try:
+            return self.inputs[slot]
+        except KeyError:
+            if default is not _MISSING:
+                return default
+            raise OpSlotError(
+                "op %r has no input slot %r (available input slots: %s; "
+                "output slots: %s)" % (self.type, slot,
+                                       sorted(self.inputs) or "none",
+                                       sorted(self.outputs) or "none")) \
+                from None
 
-    def output(self, slot):
-        return self.outputs.get(slot, [])
+    def output(self, slot, default=_MISSING):
+        try:
+            return self.outputs[slot]
+        except KeyError:
+            if default is not _MISSING:
+                return default
+            raise OpSlotError(
+                "op %r has no output slot %r (available output slots: "
+                "%s; input slots: %s)" % (self.type, slot,
+                                          sorted(self.outputs) or "none",
+                                          sorted(self.inputs) or "none")) \
+                from None
 
     def input_arg_names(self):
         return [n for args in self.inputs.values() for n in args]
@@ -123,16 +168,24 @@ class OpDesc:
         return [n for args in self.outputs.values() for n in args]
 
     def rename_input(self, old, new):
+        changed = False
         for args in self.inputs.values():
             for i, n in enumerate(args):
                 if n == old:
                     args[i] = new
+                    changed = True
+        if changed:
+            self._mutated()
 
     def rename_output(self, old, new):
+        changed = False
         for args in self.outputs.values():
             for i, n in enumerate(args):
                 if n == old:
                     args[i] = new
+                    changed = True
+        if changed:
+            self._mutated()
 
     def __repr__(self):
         return "<op %s %s -> %s>" % (self.type, dict(self.inputs),
@@ -272,16 +325,19 @@ class BlockDesc:
     # --- ops ---
     def append_op(self, op_desc):
         self.ops.append(op_desc)
+        op_desc._block = self
         self.program.bump_version()
         return op_desc
 
     def prepend_op(self, op_desc):
         self.ops.insert(0, op_desc)
+        op_desc._block = self
         self.program.bump_version()
         return op_desc
 
     def insert_op(self, index, op_desc):
         self.ops.insert(index, op_desc)
+        op_desc._block = self
         self.program.bump_version()
         return op_desc
 
@@ -361,7 +417,9 @@ class ProgramDesc:
             for vp in bp.vars:
                 blk.vars[vp.name] = VarDesc.from_proto(vp)
             for op_p in bp.ops:
-                blk.ops.append(OpDesc.from_proto(op_p))
+                op = OpDesc.from_proto(op_p)
+                op._block = blk
+                blk.ops.append(op)
             prog.blocks.append(blk)
         if not prog.blocks:
             prog.blocks = [BlockDesc(prog, 0, -1)]

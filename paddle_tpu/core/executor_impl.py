@@ -466,8 +466,38 @@ class ExecutorCore:
         self._cache = {}
 
     # ------------------------------------------------------------------
+    def _maybe_verify(self, program):
+        """Ahead-of-time verification (paddle_tpu/analysis), paid ONLY
+        when this program version has never been verified — the same
+        cadence as a compile-cache miss, since the compiled-entry key
+        includes program.version.  The verified marker lives on the
+        program (not this executor) so nested executors (go routines,
+        pserver serve loops) and run()/prepare() share one verification
+        per mutation."""
+        level = FLAGS.check_program
+        if level == "off":
+            return
+        key = (program.version, level)
+        if getattr(program, "_verified_key", None) == key:
+            return
+        from paddle_tpu import analysis
+        try:
+            analysis.verify_and_enforce(program, level=level,
+                                        source="executor")
+        except analysis.ProgramVerificationError:
+            raise  # error mode: every run on the bad version re-raises
+        except Exception as e:
+            # a checker crash must never take down training: report it
+            # and keep running (the program may still be fine)
+            warnings.warn("program verification itself failed (%s: %s); "
+                          "continuing unverified" % (type(e).__name__, e),
+                          analysis.ProgramLintWarning)
+        program._verified_key = key
+
+    # ------------------------------------------------------------------
     def run(self, program, scope, block_id=0, feed=None, fetch_list=None,
             mode="train", return_numpy=True):
+        self._maybe_verify(program)
         # device-resident prepared state (run_prepared) must land in the
         # scope before this unprepared path reads or overwrites it
         flush_prepared(scope)
@@ -555,6 +585,7 @@ class ExecutorCore:
             raise ValueError(
                 "prepare() requires the scope holding the program's "
                 "persistables (run the startup program into it first)")
+        self._maybe_verify(program)
         if feed_specs is None:  # zero-feed program (scope-resident data)
             feed_specs = {}
         fetch_list = list(fetch_list or [])

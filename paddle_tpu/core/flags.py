@@ -64,6 +64,14 @@ define_flag("check_nan_inf", False,
             "nan/inf (reference FLAGS_check_nan_inf)")
 define_flag("benchmark", False,
             "print per-run wall time (reference FLAGS_benchmark)")
+define_flag("check_program", "warn",
+            "ahead-of-time program verification (paddle_tpu/analysis): "
+            "'off' never verifies; 'warn' (default) verifies each "
+            "program once per (uid, version) — i.e. only on a "
+            "compile-cache miss — and warns on error-severity "
+            "diagnostics; 'error' raises ProgramVerificationError "
+            "instead.  Zero per-step cost: steady-state training never "
+            "re-verifies")
 define_flag("conv_nhwc", False,
             "lower conv2d through NHWC (MXU-preferred layout); the "
             "boundary transposes cancel across conv chains in XLA")

@@ -416,12 +416,25 @@ def _is_float(x):
 _FAKE_BATCH = 97  # sentinel for dynamic (-1) dims during eval_shape
 
 
-def infer_op_outputs(program, block, op):
-    """Infer output (shape, dtype) per output var via jax.eval_shape.
+def infer_op_outputs(program, block, op, var_specs=None):
+    """Infer output (shape, dtype) per output var via the op's registered
+    ``infer_shape`` or, as the general fallback, jax.eval_shape over the
+    lowering.
 
     Replaces reference per-op InferShape (operator.cc:606): abstract
     evaluation of the lowering needs no hand-written shape functions.
     Dynamic dims (-1) are substituted with a sentinel and mapped back.
+
+    ``var_specs`` ({name: (shape, np dtype)}) overrides the declared
+    VarDesc of an input — the verifier's shape checker threads its own
+    propagated env through a block this way, so a mismatch introduced
+    AFTER build time (a transpiler rename) is still caught.
+
+    A registered ``infer_shape(ins, attrs, op) -> {slot: specs}`` takes
+    the same Ins view of jax.ShapeDtypeStruct specs the lowering would
+    see and returns output specs without tracing — for host-adjacent or
+    data-dependent ops where abstract evaluation is unavailable or wrong
+    (see core/registry.py).
     """
     info = get_op_info(op.type)
     specs = {}
@@ -431,31 +444,45 @@ def infer_op_outputs(program, block, op):
             if n == EMPTY_VAR:
                 lst.append(None)
                 continue
-            vd = _find_var(program, block, n)
-            if vd is None:
-                raise KeyError("var %s not found for shape inference" % n)
-            shape = tuple(_FAKE_BATCH if d == -1 else d for d in vd.shape)
-            lst.append(jax.ShapeDtypeStruct(shape, proto_to_np_dtype(vd.dtype)))
+            override = var_specs.get(n) if var_specs else None
+            if override is not None:
+                shape, dtype = override
+            else:
+                vd = _find_var(program, block, n)
+                if vd is None:
+                    raise KeyError("var %s not found for shape inference"
+                                   % n)
+                shape, dtype = vd.shape, proto_to_np_dtype(vd.dtype)
+            shape = tuple(_FAKE_BATCH if d == -1 else d for d in shape)
+            lst.append(jax.ShapeDtypeStruct(shape, dtype))
         specs[slot] = lst
     attrs = {k: a.value for k, a in op.attrs.items()}
 
-    def f(s):
-        env = {}
-        ctx = LoweringContext(program, block.idx, env,
-                              jax.random.PRNGKey(0), "train")
-        outs = info.lower(ctx, Ins(s), attrs, op)
-        norm = {}
-        for slot, v in (outs or {}).items():
-            norm[slot] = list(v) if isinstance(v, (list, tuple)) else [v]
-        return norm
+    if callable(info.infer_shape):
+        shaped = info.infer_shape(Ins(specs), attrs, op)
+        shaped = {slot: (list(v) if isinstance(v, (list, tuple)) else [v])
+                  for slot, v in (shaped or {}).items()}
+    else:
+        def f(s):
+            env = {}
+            ctx = LoweringContext(program, block.idx, env,
+                                  jax.random.PRNGKey(0), "train")
+            outs = info.lower(ctx, Ins(s), attrs, op)
+            norm = {}
+            for slot, v in (outs or {}).items():
+                norm[slot] = list(v) if isinstance(v, (list, tuple)) else [v]
+            return norm
 
-    shaped = jax.eval_shape(f, specs)
+        shaped = jax.eval_shape(f, specs)
     result = {}
     for slot, names in op.outputs.items():
         if slot not in shaped:
             continue
         for n, sd in zip(names, shaped[slot]):
-            if n == EMPTY_VAR or sd is None:
+            # non-dense outputs (SelectedRows grads, TensorArrays) have
+            # no single (shape, dtype); their consumers validate them
+            if n == EMPTY_VAR or sd is None or \
+                    not hasattr(sd, "shape") or not hasattr(sd, "dtype"):
                 continue
             shape = tuple(-1 if d == _FAKE_BATCH else d for d in sd.shape)
             result[n] = (shape, sd.dtype)

@@ -13,8 +13,15 @@ grad_op_desc_maker.h.  An op here is:
   maker emits ``<type>_grad`` consuming forward ins/outs + output grads; the
   default grad *lowering* evaluates jax.vjp of the forward lowering, so an op
   gets a correct gradient without hand-writing one (XLA fuses it anyway).
-- ``infer_shape``: optional; the engine falls back to jax.eval_shape over the
-  lowering (abstract evaluation — no FLOPs).
+- ``infer_shape``: optional ``fn(ins, attrs, op) -> {slot: specs}`` taking the
+  same ``Ins`` view the lowering would, holding jax.ShapeDtypeStruct specs
+  instead of traced values, and returning output specs per slot.  Register one
+  for ops whose output shape abstract evaluation cannot model (data-dependent
+  sizes, host-adjacent state); everything else falls back to jax.eval_shape
+  over the lowering (abstract evaluation — no FLOPs).  Consumed by build-time
+  shape inference (fluid Block.append_op) and the ahead-of-time program
+  verifier's shape checker (paddle_tpu/analysis) through
+  ``lowering.infer_op_outputs``.
 """
 from __future__ import annotations
 

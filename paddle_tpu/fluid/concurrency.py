@@ -359,11 +359,19 @@ class ProgramGo:
         # them at launch (ops/concurrency_ops._go)
         from .layers.control_flow import _collect_outer_io
 
-        reads, _writes = _collect_outer_io(self.sub_block)
+        reads, writes = _collect_outer_io(self.sub_block)
         parent = self.main_program.current_block()
+        # outer_writes records the routine's write-set into enclosing
+        # scopes at build time; the verifier's concurrency checker unions
+        # it with its own sub-block walk, so a rewrite that redirects the
+        # sub-block without refreshing the attr still gets its original
+        # hazards flagged
+        attrs = {"sub_block": self.sub_block.idx}
+        if writes:
+            attrs["outer_writes"] = list(writes)
         parent.append_op(type="go",
                          inputs={"X": reads} if reads else {},
                          outputs={},
-                         attrs={"sub_block": self.sub_block.idx},
+                         attrs=attrs,
                          infer_shape=False)
         return False

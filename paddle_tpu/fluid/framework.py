@@ -513,6 +513,15 @@ class Program:
 
     __str__ = __repr__
 
+    def verify(self, checkers=None):
+        """Run the ahead-of-time program verifier (paddle_tpu/analysis)
+        over this program; returns the [Diagnostic] list.  The executor
+        does this automatically on every compile-cache miss per
+        FLAGS_check_program — call it directly to lint while building."""
+        from paddle_tpu import analysis
+
+        return analysis.verify_program(self.desc, checkers)
+
     # --- clone / prune ---
     def clone(self, for_test=False):
         """Deep copy; for_test=True strips backward/optimize ops and flips
@@ -592,8 +601,11 @@ class Program:
                 needed.update(n for n in op.input_arg_names() if n)
         kept.reverse()
         p = self.clone()
-        p.desc.blocks[0].ops = [core_desc.OpDesc.from_proto(op.to_proto())
-                                for op in kept]
+        blk0 = p.desc.blocks[0]
+        blk0.ops = [core_desc.OpDesc.from_proto(op.to_proto())
+                    for op in kept]
+        for op in blk0.ops:
+            op._block = blk0  # mutations must keep bumping the version
         p.desc.bump_version()
         p._rebuild_from_desc(self)
         return p

@@ -258,6 +258,15 @@ class DistributeTranspiler:
                                infer_shape=False)
         self.startup_program.desc.bump_version()
 
+        # a transpile is the canonical post-build IR mutation: verify the
+        # rewritten trainer/startup programs NOW so a malformed rewrite
+        # is reported here, not as an XLA trace error at first run
+        from paddle_tpu import analysis
+        analysis.verify_and_enforce(self.origin_program.desc,
+                                    source="DistributeTranspiler(trainer)")
+        analysis.verify_and_enforce(self.startup_program.desc,
+                                    source="DistributeTranspiler(startup)")
+
     @staticmethod
     def _grad_block_name(gname, blk):
         if blk.block_id < 0:
@@ -315,6 +324,19 @@ class DistributeTranspiler:
                    "grad_to_block_id": grad_to_block_id},
             infer_shape=False)
         prog._pserver_var_origin = ep_var_origin
+        from paddle_tpu import analysis
+        from paddle_tpu.core.flags import FLAGS
+        if FLAGS.check_program != "off":
+            analysis.verify_and_enforce(
+                prog.desc,
+                source="DistributeTranspiler(pserver %s)" % endpoint)
+            # cross-program pairing: every grad the trainer sends here
+            # must be served, every param block it fetches declared
+            analysis.enforce(
+                analysis.verify_transpiled_pair(
+                    self.origin_program.desc, {endpoint: prog.desc}),
+                level=FLAGS.check_program,
+                source="DistributeTranspiler(pairing %s)" % endpoint)
         return prog
 
     def _retarget_map(self, opt_desc, p, g, blk, origin_block,

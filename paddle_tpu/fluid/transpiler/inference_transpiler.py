@@ -115,13 +115,21 @@ class BatchNormFoldPass(ProgramPass):
                 # no conv bias: inject the folded bias via the bn's
                 # Bias parameter and turn bn into an elementwise_add
                 b_name = bn.inputs["Bias"][0]
-                scope.set(b_name, ((-mean) * factor + bias).astype(
-                    np.float32).reshape(1, -1, 1, 1))
-                # bias value reshaped to [1,C,1,1] -> plain broadcast add
+                folded_b = ((-mean) * factor + bias).astype(
+                    np.float32).reshape(1, -1, 1, 1)
+                scope.set(b_name, folded_b)
+                # bias value reshaped to [1,C,1,1] -> plain broadcast
+                # add; the VarDesc must follow the value or the desc
+                # lies to every desc-driven consumer (the program
+                # verifier's shape checker, feed coercion)
+                bvd = block.vars.get(b_name)
+                if bvd is not None:
+                    bvd.shape = tuple(folded_b.shape)
                 ops[j] = OpDesc(
                     "elementwise_add",
                     inputs={"X": [conv_out], "Y": [b_name]},
                     outputs={"Out": [bn.outputs["Y"][0]]})
+                ops[j]._block = block  # spliced in: keep version bumps
             folded += 1
             du.rebuild()
             i = j
@@ -215,6 +223,7 @@ class AttentionFusePass(ProgramPass):
                 outputs={"Out": [m2.output("Out")[0]]},
                 attrs={"causal": False, "scale": float(scale)})
             # replace the first op of the chain, delete the rest
+            ring._block = block  # spliced in: keep version bumps
             ops[chain[0]] = ring
             for j in sorted(chain[1:], reverse=True):
                 del ops[j]
@@ -347,6 +356,7 @@ class LayerNormFusePass(ProgramPass):
                 outputs={"Y": [y_name], "Mean": [mean_v],
                          "Variance": [var_v]},
                 attrs={"begin_norm_axis": nd - 1, "epsilon": eps})
+            ln._block = block  # spliced in: keep version bumps
             ops[chain[0]] = ln
             for j in sorted(chain[1:], reverse=True):
                 del ops[j]

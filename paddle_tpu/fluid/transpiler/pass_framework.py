@@ -18,34 +18,23 @@ from __future__ import annotations
 
 import collections
 
+from paddle_tpu.analysis.defuse import DefUse as _CoreDefUse
+
 __all__ = ["DefUse", "ProgramPass", "PassManager"]
 
 
-class DefUse:
-    """Def-use graph over every block of a loaded ProgramDesc."""
+class DefUse(_CoreDefUse):
+    """Transpiler view over the shared core def-use graph
+    (paddle_tpu/analysis/defuse.py — the same index the program
+    verifier's checkers walk): adds the chain-matching queries the
+    inference rewrites pattern-match with.  Constructed from a fluid
+    ``Program``; the inherited index/attrs operate on its desc."""
 
     def __init__(self, program):
-        self.program = program
-        self.rebuild()
-
-    def rebuild(self):
-        self.consumers_idx = collections.defaultdict(list)
-        self.producers_idx = collections.defaultdict(list)
-        for bi, b in enumerate(self.program.desc.blocks):
-            for oi, o in enumerate(b.ops):
-                # set(): an op reading one var through several slots
-                # (elementwise_mul(X=d, Y=d)) is ONE consumer
-                for n in set(o.input_arg_names()):
-                    if n:
-                        self.consumers_idx[n].append((bi, oi))
-                for n in set(o.output_arg_names()):
-                    if n:
-                        self.producers_idx[n].append((bi, oi))
+        self.fluid_program = program
+        super().__init__(program.desc)
 
     # --- queries (block-0 focused: the serving rewrites run there) ---
-    def block(self, bi=0):
-        return self.program.desc.blocks[bi]
-
     def consumers(self, name, start=0, bi=0):
         """Block-``bi`` consumers of ``name`` at op index >= start, or
         None when another block also reads it (never fusable: deleting

@@ -59,6 +59,23 @@ def _sections_starts(sections):
     return starts
 
 
+def _check_rpc_route(op):
+    """Runtime guard on the same invariant the verifier's dist-pairing
+    checker enforces statically: epmap/sections/block_names must route
+    one slice each, or slices land on the wrong pserver.  (The static
+    check can be off; a misrouted slice must still die loudly.)"""
+    eps = op.attr("epmap") or []
+    sections = op.attr("sections") or []
+    names = op.attr("block_names") or []
+    if not eps or not (len(eps) == len(sections) == len(names)):
+        raise ValueError(
+            "%s op: epmap/sections/block_names lengths disagree "
+            "(%d/%d/%d) — re-run the DistributeTranspiler or lint the "
+            "program (tools/lint_program.py)"
+            % (op.type, len(eps), len(sections), len(names)))
+    return eps, sections, names
+
+
 def _watchdog(op_name, eps, client, exc):
     """Convert an exhausted RPC deadline into a WatchdogTimeout naming
     the peers every pserver is still waiting on — an indefinite
@@ -76,9 +93,7 @@ def _send(executor, op, scope, feed, env=None):
     client = RPCClient.instance()
     name = op.input("X")[0]
     val = _read(name, scope, env)
-    eps = op.attr("epmap")
-    names = op.attr("block_names")
-    sections = op.attr("sections")
+    eps, sections, names = _check_rpc_route(op)
     starts = _sections_starts(sections)
     from paddle_tpu.core.selected_rows import SelectedRows
 
@@ -113,8 +128,7 @@ def _recv(executor, op, scope, feed, env=None):
 
     client = RPCClient.instance()
     out = op.output("Out")[0]
-    eps = op.attr("epmap")
-    names = op.attr("block_names")
+    eps, _sections, names = _check_rpc_route(op)
     try:
         parts = client.get_vars(list(zip(eps, names)))
     except DeadlineExceeded as e:

@@ -100,7 +100,7 @@ def _print(executor, op, scope, feed, env=None):
     if op.attr("summarize", -1) != 0:
         parts.append("data=%s" % np.array2string(arr, threshold=20))
     print("\t".join(parts))
-    if env is not None and op.output("Out"):
+    if env is not None and op.output("Out", []):
         env[op.output("Out")[0]] = val
 
 

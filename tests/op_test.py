@@ -87,6 +87,9 @@ class OpTest:
     def run_outputs(self, place, fetch_names=None):
         """Run the one-op program on `place`; returns {name: np.ndarray}."""
         main, startup, feed = self._build()
+        # kept for the abstract-shape parity property (check_output)
+        self._main_for_parity = main
+        self._feed_for_parity = feed
         exe = fluid.Executor(place)
         scope = Scope()
         with fluid.scope_guard(scope):
@@ -105,11 +108,61 @@ class OpTest:
                 atol=atol, rtol=rtol,
                 err_msg="op %s output %s mismatch on %r" % (
                     self.op_type, name, place))
+        return got_map
+
+    # opt-out for specs whose outputs are legitimately data-dependent
+    check_abstract_parity = True
+
+    def check_abstract_parity_against(self, got_map):
+        """Property: the program verifier's abstract shape inference
+        (registered infer_shape or the jax.eval_shape fallback — the
+        same path paddle_tpu/analysis' shape checker walks) must agree
+        with the concrete output shapes/dtypes this spec just produced,
+        so checker and runtime cannot drift.  Specs abstract evaluation
+        cannot model are skipped (the checker downgrades those to notes,
+        never errors); LoD specs are skipped because the runtime pads
+        ragged feeds to bucketed shapes the declared desc does not
+        carry."""
+        if not self.check_abstract_parity:
+            return
+        for val in self._feed_for_parity.values():
+            if isinstance(val, LoDTensor) and val.lod:
+                return
+        from paddle_tpu.analysis.shapes import canon_dtype as canon
+        from paddle_tpu.core import lowering
+
+        main = self._main_for_parity
+        block = main.desc.blocks[0]
+        op = block.ops[0]
+        try:
+            inferred = lowering.infer_op_outputs(main.desc, block, op)
+        except Exception:
+            return  # unmodelable: the checker reports a note, not an error
+        for name, (shape, dtype) in inferred.items():
+            got = got_map.get(name)
+            if got is None:
+                continue
+            concrete = np.asarray(got)
+            assert len(shape) == concrete.ndim and all(
+                d == -1 or int(d) == int(c)
+                for d, c in zip(shape, concrete.shape)), (
+                "op %s output %s: abstract shape %s != concrete %s — "
+                "the verifier's shape checker has drifted from the "
+                "runtime" % (self.op_type, name, tuple(shape),
+                             concrete.shape))
+            assert canon(dtype) == canon(concrete.dtype), (
+                "op %s output %s: abstract dtype %s != concrete %s"
+                % (self.op_type, name, np.dtype(dtype), concrete.dtype))
 
     def check_output(self, atol=1e-5, rtol=1e-5):
-        """Reference op_test.py:320 — sweep all available places."""
+        """Reference op_test.py:320 — sweep all available places; on the
+        CPU place additionally hold abstract shape inference to the
+        concrete outputs (see check_abstract_parity_against)."""
         for place in places_to_check():
-            self.check_output_with_place(place, atol=atol, rtol=rtol)
+            got_map = self.check_output_with_place(place, atol=atol,
+                                                   rtol=rtol)
+            if isinstance(place, fluid.CPUPlace):
+                self.check_abstract_parity_against(got_map)
 
     # --- gradient check ---
     def check_grad(self, inputs_to_check, output_names=None,

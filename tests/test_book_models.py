@@ -1,21 +1,72 @@
 """Book-style end-to-end model tests (cf. reference tests/book/):
 fit_a_line, recognize_digits (mlp + conv), word2vec-style embeddings —
-each trained a few iterations with loss-decrease assertions."""
+each trained a few iterations with loss-decrease assertions.
+
+The ``build_*`` functions append the model to the CURRENT default
+main/startup programs and return the fetch targets; they are reused by
+tests/test_program_lint.py as the verifier's known-good corpus, so keep
+them pure builders (no running, no feeding)."""
 import numpy as np
 import pytest
 
 import paddle_tpu.fluid as fluid
 
 
-def test_fit_a_line(prog_scope, exe):
-    main, startup, scope = prog_scope
-    np.random.seed(0)
+def build_fit_a_line():
     x = fluid.layers.data(name="x", shape=[13], dtype="float32")
     y = fluid.layers.data(name="y", shape=[1], dtype="float32")
     y_predict = fluid.layers.fc(input=x, size=1, act=None)
     cost = fluid.layers.square_error_cost(input=y_predict, label=y)
     avg_cost = fluid.layers.mean(cost)
     fluid.optimizer.SGD(learning_rate=0.01).minimize(avg_cost)
+    return avg_cost
+
+
+def build_recognize_digits_mlp():
+    img = fluid.layers.data(name="img", shape=[784], dtype="float32")
+    label = fluid.layers.data(name="label", shape=[1], dtype="int64")
+    hidden = fluid.layers.fc(img, size=64, act="relu")
+    prediction = fluid.layers.fc(hidden, size=10, act="softmax")
+    cost = fluid.layers.cross_entropy(input=prediction, label=label)
+    avg_cost = fluid.layers.mean(cost)
+    acc = fluid.layers.accuracy(input=prediction, label=label)
+    fluid.optimizer.Adam(learning_rate=0.01).minimize(avg_cost)
+    return avg_cost, acc
+
+
+def build_recognize_digits_conv():
+    img = fluid.layers.data(name="img", shape=[1, 28, 28], dtype="float32")
+    label = fluid.layers.data(name="label", shape=[1], dtype="int64")
+    conv = fluid.nets.simple_img_conv_pool(img, 8, 5, 2, 2, act="relu")
+    prediction = fluid.layers.fc(conv, size=10, act="softmax")
+    avg_cost = fluid.layers.mean(
+        fluid.layers.cross_entropy(input=prediction, label=label))
+    fluid.optimizer.Adam(learning_rate=0.01).minimize(avg_cost)
+    return avg_cost
+
+
+def build_word2vec_embeddings(dict_size=50, emb_size=16):
+    """N-gram LM with shared embedding tables (reference book/word2vec)."""
+    embs = []
+    for i in range(3):
+        w = fluid.layers.data(name="w%d" % i, shape=[1], dtype="int64")
+        embs.append(fluid.layers.embedding(
+            w, size=[dict_size, emb_size],
+            param_attr=fluid.ParamAttr(name="shared_emb")))
+    concat = fluid.layers.concat(embs, axis=1)
+    hidden = fluid.layers.fc(concat, size=32, act="relu")
+    predict = fluid.layers.fc(hidden, size=dict_size, act="softmax")
+    next_w = fluid.layers.data(name="next_w", shape=[1], dtype="int64")
+    avg_cost = fluid.layers.mean(
+        fluid.layers.cross_entropy(input=predict, label=next_w))
+    fluid.optimizer.Adam(learning_rate=0.01).minimize(avg_cost)
+    return avg_cost
+
+
+def test_fit_a_line(prog_scope, exe):
+    main, startup, scope = prog_scope
+    np.random.seed(0)
+    avg_cost = build_fit_a_line()
     exe.run(startup)
     true_w = np.random.randn(13, 1).astype(np.float32)
     losses = []
@@ -31,14 +82,7 @@ def test_fit_a_line(prog_scope, exe):
 def test_recognize_digits_mlp(prog_scope, exe):
     main, startup, scope = prog_scope
     np.random.seed(1)
-    img = fluid.layers.data(name="img", shape=[784], dtype="float32")
-    label = fluid.layers.data(name="label", shape=[1], dtype="int64")
-    hidden = fluid.layers.fc(img, size=64, act="relu")
-    prediction = fluid.layers.fc(hidden, size=10, act="softmax")
-    cost = fluid.layers.cross_entropy(input=prediction, label=label)
-    avg_cost = fluid.layers.mean(cost)
-    acc = fluid.layers.accuracy(input=prediction, label=label)
-    fluid.optimizer.Adam(learning_rate=0.01).minimize(avg_cost)
+    avg_cost, acc = build_recognize_digits_mlp()
     exe.run(startup)
     losses = []
     for i in range(80):
@@ -55,13 +99,7 @@ def test_recognize_digits_mlp(prog_scope, exe):
 def test_recognize_digits_conv(prog_scope, exe):
     main, startup, scope = prog_scope
     np.random.seed(2)
-    img = fluid.layers.data(name="img", shape=[1, 28, 28], dtype="float32")
-    label = fluid.layers.data(name="label", shape=[1], dtype="int64")
-    conv = fluid.nets.simple_img_conv_pool(img, 8, 5, 2, 2, act="relu")
-    prediction = fluid.layers.fc(conv, size=10, act="softmax")
-    avg_cost = fluid.layers.mean(
-        fluid.layers.cross_entropy(input=prediction, label=label))
-    fluid.optimizer.Adam(learning_rate=0.01).minimize(avg_cost)
+    avg_cost = build_recognize_digits_conv()
     exe.run(startup)
     losses = []
     for i in range(25):
@@ -76,25 +114,10 @@ def test_recognize_digits_conv(prog_scope, exe):
 
 
 def test_word2vec_embeddings(prog_scope, exe):
-    """N-gram LM with shared embedding tables (reference book/word2vec)."""
     main, startup, scope = prog_scope
     np.random.seed(3)
-    dict_size, emb_size = 50, 16
-    words = []
-    embs = []
-    for i in range(3):
-        w = fluid.layers.data(name="w%d" % i, shape=[1], dtype="int64")
-        words.append(w)
-        embs.append(fluid.layers.embedding(
-            w, size=[dict_size, emb_size],
-            param_attr=fluid.ParamAttr(name="shared_emb")))
-    concat = fluid.layers.concat(embs, axis=1)
-    hidden = fluid.layers.fc(concat, size=32, act="relu")
-    predict = fluid.layers.fc(hidden, size=dict_size, act="softmax")
-    next_w = fluid.layers.data(name="next_w", shape=[1], dtype="int64")
-    avg_cost = fluid.layers.mean(
-        fluid.layers.cross_entropy(input=predict, label=next_w))
-    fluid.optimizer.Adam(learning_rate=0.01).minimize(avg_cost)
+    dict_size = 50
+    avg_cost = build_word2vec_embeddings(dict_size=dict_size)
     exe.run(startup)
     losses = []
     for _ in range(30):

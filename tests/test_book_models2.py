@@ -19,12 +19,38 @@ def _batches(reader, batch_size):
         yield b
 
 
-def test_understand_sentiment_conv(prog_scope, exe):
+# --- builders (reused by tests/test_program_lint.py as the verifier's
+# known-good corpus: build into the current default programs, no I/O) ---
+
+def build_understand_sentiment_conv(dict_dim=200):
     from paddle_tpu.models.understand_sentiment import get_model
+    return get_model(dict_dim=dict_dim, net="conv", learning_rate=0.05)
+
+
+def build_understand_sentiment_dyn_rnn(dict_dim=200):
+    from paddle_tpu.models.understand_sentiment import get_model
+    return get_model(dict_dim=dict_dim, net="dyn_rnn", emb_dim=16,
+                     hid_dim=32, learning_rate=0.05)
+
+
+def build_resnet_cifar(depth=20):
+    from paddle_tpu.models.resnet import resnet_cifar10
+    images = fluid.layers.data(name="pixel", shape=[3, 32, 32],
+                               dtype="float32")
+    label = fluid.layers.data(name="label", shape=[1], dtype="int64")
+    logits = resnet_cifar10(images, 10, depth=depth)
+    loss = fluid.layers.mean(
+        fluid.layers.cross_entropy(input=logits, label=label))
+    acc = fluid.layers.accuracy(input=logits, label=label)
+    fluid.optimizer.Adam(learning_rate=2e-3).minimize(loss)
+    return loss, acc
+
+
+def test_understand_sentiment_conv(prog_scope, exe):
     main, startup, scope = prog_scope
     word_dict = dataset.imdb.word_dict()
-    loss, feeds, (acc,) = get_model(dict_dim=len(word_dict), net="conv",
-                                    learning_rate=0.05)
+    loss, feeds, (acc,) = build_understand_sentiment_conv(
+        dict_dim=len(word_dict))
     exe.run(startup)
     feeder = fluid.DataFeeder(feeds, program=main)
     train = dataset.imdb.train(word_dict)
@@ -41,10 +67,8 @@ def test_understand_sentiment_conv(prog_scope, exe):
 
 
 def test_understand_sentiment_dyn_rnn(prog_scope, exe):
-    from paddle_tpu.models.understand_sentiment import get_model
     main, startup, scope = prog_scope
-    loss, feeds, _ = get_model(dict_dim=200, net="dyn_rnn", emb_dim=16,
-                               hid_dim=32, learning_rate=0.05)
+    loss, feeds, _ = build_understand_sentiment_dyn_rnn()
     exe.run(startup)
     feeder = fluid.DataFeeder(feeds, program=main)
     rng = np.random.RandomState(5)
@@ -110,16 +134,8 @@ def test_machine_translation_wmt14(prog_scope, exe):
 def test_image_classification_resnet_cifar(prog_scope, exe):
     """The image_classification book chapter: resnet_cifar10 trained on
     the cifar adapter (reference book test_image_classification)."""
-    from paddle_tpu.models.resnet import resnet_cifar10
     main, startup, scope = prog_scope
-    images = fluid.layers.data(name="pixel", shape=[3, 32, 32],
-                               dtype="float32")
-    label = fluid.layers.data(name="label", shape=[1], dtype="int64")
-    logits = resnet_cifar10(images, 10, depth=20)
-    loss = fluid.layers.mean(
-        fluid.layers.cross_entropy(input=logits, label=label))
-    acc = fluid.layers.accuracy(input=logits, label=label)
-    fluid.optimizer.Adam(learning_rate=2e-3).minimize(loss)
+    loss, acc = build_resnet_cifar(depth=20)
     exe.run(startup)
 
     samples = list(itertools.islice(dataset.cifar.train10()(), 64))

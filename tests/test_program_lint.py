@@ -1,0 +1,96 @@
+"""Self-lint gate: every book-example program this repo trains in its
+own tests (tests/test_book_models*.py builders) plus a transpiled
+distributed program must verify with ZERO error-severity diagnostics —
+the verifier's false-positive budget on known-good programs is zero.
+Also exercises tools/lint_program.py end to end on a saved inference
+model."""
+import os
+import sys
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu import analysis
+from paddle_tpu.analysis import Severity
+
+import test_book_models as book1
+import test_book_models2 as book2
+
+
+BUILDERS = [
+    ("fit_a_line", book1.build_fit_a_line),
+    ("recognize_digits_mlp", book1.build_recognize_digits_mlp),
+    ("recognize_digits_conv", book1.build_recognize_digits_conv),
+    ("word2vec_embeddings", book1.build_word2vec_embeddings),
+    ("understand_sentiment_conv", book2.build_understand_sentiment_conv),
+    ("understand_sentiment_dyn_rnn",
+     book2.build_understand_sentiment_dyn_rnn),
+    ("resnet_cifar", book2.build_resnet_cifar),
+]
+
+
+def _errors(diags):
+    return [d for d in diags if d.severity == Severity.ERROR]
+
+
+@pytest.mark.parametrize("name,builder", BUILDERS,
+                         ids=[n for n, _ in BUILDERS])
+def test_book_program_lints_clean(prog_scope, name, builder):
+    main, startup, scope = prog_scope
+    builder()
+    for label, prog in (("main", main), ("startup", startup)):
+        errs = _errors(analysis.verify_program(prog))
+        assert errs == [], "%s %s program: %s" % (
+            name, label, "\n".join(d.format() for d in errs))
+
+
+def test_transpiled_dist_programs_lint_clean(prog_scope):
+    main, startup, scope = prog_scope
+    book1.build_fit_a_line()
+    eps = "127.0.0.1:6281,127.0.0.1:6282"
+    t = fluid.DistributeTranspiler()
+    t.transpile(trainer_id=0, program=main, startup_program=startup,
+                pservers=eps, trainers=2)
+    assert _errors(analysis.verify_program(main)) == []
+    assert _errors(analysis.verify_program(startup)) == []
+    pserver_descs = {}
+    for ep in t.pserver_endpoints:
+        ps = t.get_pserver_program(ep)
+        assert _errors(analysis.verify_program(ps)) == []
+        su = t.get_startup_program(ep, ps)
+        assert _errors(analysis.verify_program(su)) == []
+        pserver_descs[ep] = ps.desc
+    assert analysis.verify_transpiled_pair(main.desc, pserver_descs) == []
+
+
+def test_lint_cli_on_saved_inference_model(prog_scope, exe, tmp_path):
+    main, startup, scope = prog_scope
+    x = fluid.layers.data(name="x", shape=[13], dtype="float32")
+    y_predict = fluid.layers.fc(input=x, size=1, act=None)
+    exe.run(startup)
+    model_dir = str(tmp_path / "model")
+    fluid.io.save_inference_model(model_dir, ["x"], [y_predict], exe,
+                                  main_program=main)
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), os.pardir,
+                                    "tools"))
+    try:
+        import lint_program
+    finally:
+        sys.path.pop(0)
+    assert lint_program.main([model_dir, "--quiet"]) == 0
+    # a seeded defect must flip the exit code
+    from paddle_tpu.core.desc import ProgramDesc
+    with open(os.path.join(model_dir, "__model__"), "rb") as f:
+        prog = ProgramDesc.parse_from_string(f.read())
+    for op in prog.blocks[0].ops:
+        op.rename_input("x", "ghost")  # orphan the fc's real input
+    bad = str(tmp_path / "bad_model")
+    with open(bad, "wb") as f:
+        f.write(prog.serialize_to_string())
+    assert lint_program.main([bad, "--quiet"]) == 1
+    # unparseable input is reported, not crashed on
+    junk = str(tmp_path / "junk")
+    with open(junk, "wb") as f:
+        f.write(b"\x00not a proto")
+    assert lint_program.main([junk, "--quiet"]) == 2

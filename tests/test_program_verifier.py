@@ -1,0 +1,395 @@
+"""Program verifier unit tests: for every checker one positive case (a
+deliberately seeded defect it must flag with the right diagnostic) and
+one negative case (a valid program passes clean), plus the executor /
+FLAGS_check_program wiring and the OpDesc mutation-bumps-version
+regression the verifier's cache-miss cadence depends on."""
+import warnings
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu import analysis
+from paddle_tpu.analysis import (ProgramLintWarning,
+                                 ProgramVerificationError, Severity)
+from paddle_tpu.core import desc as core_desc
+from paddle_tpu.core.flags import FLAGS
+from paddle_tpu.core.scope import Scope
+
+from test_book_models import build_fit_a_line
+
+
+def _diags(prog, checker=None):
+    out = analysis.verify_program(prog)
+    if checker is not None:
+        out = [d for d in out if d.checker == checker]
+    return out
+
+
+def _errors(diags):
+    return [d for d in diags if d.severity == Severity.ERROR]
+
+
+def _prog_with(ops, vars_=()):
+    prog = core_desc.ProgramDesc()
+    b = prog.blocks[0]
+    for vd in vars_:
+        b.add_var(vd)
+    for op in ops:
+        b.append_op(op)
+    return prog
+
+
+V = core_desc.VarDesc
+O = core_desc.OpDesc
+
+
+# ---------------------------------------------------------------------------
+# def-use
+# ---------------------------------------------------------------------------
+
+def test_def_use_flags_undeclared_var():
+    prog = _prog_with(
+        [O("relu", {"X": ["ghost"]}, {"Out": ["a"]})],
+        [V("a", shape=(2, 3))])
+    errs = _errors(_diags(prog, "def-use"))
+    assert len(errs) == 1
+    d = errs[0]
+    assert d.var == "ghost" and d.op_type == "relu" and d.block_idx == 0
+    assert "no reachable VarDesc" in d.message
+
+
+def test_def_use_flags_use_before_def():
+    prog = _prog_with(
+        [O("relu", {"X": ["t"]}, {"Out": ["o"]}),      # reads t first...
+         O("relu", {"X": ["x"]}, {"Out": ["t"]})],     # ...written later
+        [V("x", shape=(2,)), V("t", shape=(2,)), V("o", shape=(2,))])
+    diags = _diags(prog, "def-use")
+    assert any(d.var == "t" and d.severity == Severity.WARNING
+               and "read before its first write" in d.message
+               for d in diags)
+
+
+def test_def_use_clean_program(prog_scope):
+    main, startup, scope = prog_scope
+    build_fit_a_line()
+    assert _diags(main.desc, "def-use") == []
+    assert _diags(startup.desc, "def-use") == []
+
+
+# ---------------------------------------------------------------------------
+# block-refs
+# ---------------------------------------------------------------------------
+
+def test_block_refs_flags_dangling_sub_block():
+    prog = _prog_with([O("while", {}, {}, {"sub_block": 7})])
+    errs = _errors(_diags(prog, "block-refs"))
+    assert len(errs) == 1
+    assert "sub-block 7" in errs[0].message and errs[0].op_type == "while"
+
+
+def test_block_refs_accepts_valid_sub_block():
+    prog = core_desc.ProgramDesc()
+    sub = prog.append_block(parent_idx=0)
+    prog.blocks[0].append_op(O("go", {}, {}, {"sub_block": sub.idx}))
+    assert _diags(prog, "block-refs") == []
+
+
+# ---------------------------------------------------------------------------
+# shapes
+# ---------------------------------------------------------------------------
+
+def test_shapes_flags_contracting_dim_mismatch():
+    prog = _prog_with(
+        [O("mul", {"X": ["x"], "Y": ["w"]}, {"Out": ["o"]})],
+        [V("x", shape=(4, 3)), V("w", shape=(5, 6)), V("o", shape=(4, 6))])
+    errs = _errors(_diags(prog, "shapes"))
+    assert len(errs) == 1
+    assert errs[0].op_type == "mul"
+    assert "abstract evaluation failed" in errs[0].message
+
+
+def test_shapes_flags_declared_dtype_drift():
+    from paddle_tpu.core.types import DataType
+    prog = _prog_with(
+        [O("relu", {"X": ["x"]}, {"Out": ["o"]})],
+        [V("x", shape=(2, 3)),
+         V("o", shape=(2, 3), dtype=DataType.INT32)])
+    errs = _errors(_diags(prog, "shapes"))
+    assert any(d.var == "o" and "declared dtype" in d.message
+               for d in errs)
+
+
+def test_shapes_clean_program(prog_scope):
+    main, startup, scope = prog_scope
+    build_fit_a_line()
+    assert _errors(_diags(main.desc, "shapes")) == []
+
+
+# ---------------------------------------------------------------------------
+# grad-completeness
+# ---------------------------------------------------------------------------
+
+def test_grad_completeness_flags_orphan_grad_op():
+    prog = _prog_with(
+        [O("totally_bogus_grad", {"X": ["x"]}, {"Out": ["o"]})],
+        [V("x", shape=(2,)), V("o", shape=(2,))])
+    errs = _errors(_diags(prog, "grad-completeness"))
+    assert len(errs) == 1
+    assert "no registered lowering" in errs[0].message
+    assert errs[0].op_type == "totally_bogus_grad"
+
+
+def test_grad_completeness_accepts_synthesized_vjp():
+    # relu_grad is not explicitly registered; the forward IS, so the
+    # generic vjp lowering applies and the checker must stay silent
+    prog = _prog_with(
+        [O("relu_grad", {"X": ["x"], "Out": ["o"],
+                         "Out@GRAD": ["og"]}, {"X@GRAD": ["xg"]})],
+        [V(n, shape=(2,)) for n in ("x", "o", "og", "xg")])
+    assert _diags(prog, "grad-completeness") == []
+
+
+# ---------------------------------------------------------------------------
+# dist-pairing
+# ---------------------------------------------------------------------------
+
+def _send(eps, sections, names, var="g"):
+    return O("send", {"X": [var]}, {},
+             {"epmap": eps, "sections": sections, "block_names": names})
+
+
+def test_dist_pairing_flags_misrouted_slices():
+    prog = _prog_with(
+        [_send(["h:1", "h:2"], [4], ["g.block0", "g.block1"])],
+        [V("g", shape=(8, 2), persistable=True)])
+    errs = _errors(_diags(prog, "dist-pairing"))
+    assert any("lengths disagree" in d.message for d in errs)
+
+
+def test_dist_pairing_flags_recv_before_barrier():
+    prog = _prog_with(
+        [_send(["h:1"], [8], ["g.block0"]),
+         O("recv", {}, {"Out": ["p"]},
+           {"epmap": ["h:1"], "sections": [8],
+            "block_names": ["p.block0"]}),
+         O("send_barrier", {}, {}, {"endpoints": ["h:1"]})],
+        [V("g", shape=(8, 2), persistable=True),
+         V("p", shape=(8, 2), persistable=True)])
+    errs = _errors(_diags(prog, "dist-pairing"))
+    assert any("recv appears before the send_barrier" in d.message
+               for d in errs)
+
+
+def test_dist_pairing_clean_transpiled_program(prog_scope):
+    main, startup, scope = prog_scope
+    build_fit_a_line()
+    t = fluid.DistributeTranspiler()
+    t.transpile(trainer_id=0, program=main, startup_program=startup,
+                pservers="127.0.0.1:6184,127.0.0.1:6185", trainers=2)
+    assert _errors(_diags(main.desc)) == []
+    assert _errors(_diags(startup.desc)) == []
+
+
+def test_dist_pairing_cross_program(prog_scope):
+    main, startup, scope = prog_scope
+    build_fit_a_line()
+    t = fluid.DistributeTranspiler()
+    t.transpile(trainer_id=0, program=main, startup_program=startup,
+                pservers="127.0.0.1:6186", trainers=1)
+    ps = t.get_pserver_program("127.0.0.1:6186")
+    clean = analysis.verify_transpiled_pair(
+        main.desc, {"127.0.0.1:6186": ps.desc})
+    assert clean == []
+    # drop one served grad: the pairing check must name the orphan send
+    for op in ps.desc.blocks[0].ops:
+        if op.type == "listen_and_serv":
+            entries = op.attr("grad_to_block_id")
+            op.set_attr("grad_to_block_id", entries[1:])
+    broken = analysis.verify_transpiled_pair(
+        main.desc, {"127.0.0.1:6186": ps.desc})
+    assert any(d.op_type == "send" and "dropped" in d.message
+               for d in broken)
+
+
+# ---------------------------------------------------------------------------
+# concurrency
+# ---------------------------------------------------------------------------
+
+def test_concurrency_flags_two_concurrent_writers():
+    prog = core_desc.ProgramDesc()
+    b0 = prog.blocks[0]
+    b0.add_var(V("x", shape=(2,)))
+    b0.add_var(V("shared", shape=(2,)))
+    for _ in range(2):
+        sub = prog.append_block(parent_idx=0)
+        sub.append_op(O("scale", {"X": ["x"]}, {"Out": ["shared"]},
+                        {"scale": 2.0}))
+        b0.append_op(O("go", {"X": ["x"]}, {}, {"sub_block": sub.idx}))
+    errs = _errors(_diags(prog, "concurrency"))
+    assert any(d.var == "shared"
+               and "written by concurrent blocks" in d.message
+               for d in errs)
+
+
+def test_concurrency_flags_unsynced_parent_write():
+    prog = core_desc.ProgramDesc()
+    b0 = prog.blocks[0]
+    b0.add_var(V("x", shape=(2,)))
+    b0.add_var(V("shared", shape=(2,)))
+    sub = prog.append_block(parent_idx=0)
+    sub.append_op(O("scale", {"X": ["x"]}, {"Out": ["shared"]},
+                    {"scale": 2.0}))
+    b0.append_op(O("go", {"X": ["x"]}, {}, {"sub_block": sub.idx}))
+    b0.append_op(O("scale", {"X": ["x"]}, {"Out": ["shared"]},
+                   {"scale": 3.0}))
+    errs = _errors(_diags(prog, "concurrency"))
+    assert any(d.var == "shared" and d.op_type == "scale" for d in errs)
+
+
+def test_concurrency_channel_recv_synchronizes(prog_scope):
+    """The canonical CSP producer/consumer (go -> channel -> recv) must
+    pass clean: the recv between launch and the consuming ops IS the
+    synchronization."""
+    main, startup, scope = prog_scope
+    from paddle_tpu.fluid import concurrency as C
+    x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+    ch = C.program_make_channel(dtype="float32", capacity=2)
+    with C.ProgramGo():
+        doubled = fluid.layers.scale(x, scale=2.0)
+        C.program_channel_send(ch, doubled)
+    got = fluid.layers.data(name="got_buf", shape=[4], dtype="float32")
+    C.program_channel_recv(ch, got)
+    fluid.layers.scale(got, scale=10.0)
+    assert _errors(_diags(main.desc, "concurrency")) == []
+
+
+def test_concurrency_flags_donation_hazard():
+    prog = _prog_with(
+        [O("save", {"X": ["w"]}, {}, {"file_path": "/tmp/x"}),
+         O("scale", {"X": ["w"]}, {"Out": ["w"]}, {"scale": 0.9})],
+        [V("w", shape=(4,), persistable=True)])
+    diags = _diags(prog, "concurrency")
+    assert any(d.var == "w" and d.severity == Severity.WARNING
+               and "donated buffer" in d.message for d in diags)
+
+
+# ---------------------------------------------------------------------------
+# executor wiring: FLAGS_check_program gate, verify-on-cache-miss cadence
+# ---------------------------------------------------------------------------
+
+def _bad_shape_program():
+    main = fluid.Program()
+    b = main.desc.blocks[0]
+    b.add_var(V("x", shape=(4, 3)))
+    b.add_var(V("w", shape=(5, 6)))
+    b.add_var(V("o", shape=(4, 6)))
+    b.append_op(O("mul", {"X": ["x"], "Y": ["w"]}, {"Out": ["o"]}))
+    return main
+
+
+def test_executor_error_mode_raises_before_tracing():
+    main = _bad_shape_program()
+    exe = fluid.Executor(fluid.CPUPlace())
+    old = FLAGS.check_program
+    FLAGS.check_program = "error"
+    try:
+        with pytest.raises(ProgramVerificationError) as ei:
+            with fluid.scope_guard(Scope()):
+                exe.run(main, feed={"x": np.ones((4, 3), np.float32),
+                                    "w": np.ones((5, 6), np.float32)},
+                        fetch_list=["o"])
+        assert "shapes" in str(ei.value)
+    finally:
+        FLAGS.check_program = old
+
+
+def test_executor_warn_mode_warns_once_per_version():
+    main = _bad_shape_program()
+    exe = fluid.Executor(fluid.CPUPlace())
+    assert FLAGS.check_program == "warn"  # the documented default
+    feed = {"x": np.ones((4, 3), np.float32),
+            "w": np.ones((5, 6), np.float32)}
+    with pytest.warns(ProgramLintWarning):
+        with pytest.raises(Exception):
+            with fluid.scope_guard(Scope()):
+                exe.run(main, feed=feed, fetch_list=["o"])
+    # same version: verified marker short-circuits, no second warning
+    with warnings.catch_warnings():
+        warnings.simplefilter("error", ProgramLintWarning)
+        with pytest.raises(Exception):
+            with fluid.scope_guard(Scope()):
+                exe.run(main, feed=feed, fetch_list=["o"])
+
+
+# ---------------------------------------------------------------------------
+# OpDesc mutation bumps the program version (stale-cache regression)
+# ---------------------------------------------------------------------------
+
+def test_op_desc_mutators_bump_version(prog_scope):
+    main, startup, scope = prog_scope
+    build_fit_a_line()
+    desc = main.desc
+    op = desc.blocks[0].ops[0]
+    v0 = desc.version
+    op.set_attr("some_attr", 1)
+    assert desc.version > v0, "set_attr must invalidate compiled caches"
+    v1 = desc.version
+    old = op.input_arg_names()[0]
+    op.rename_input(old, old + "@renamed")
+    assert desc.version > v1
+    v2 = desc.version
+    op.rename_input("no_such_name", "whatever")  # no-op: no bump
+    assert desc.version == v2
+    out = op.output_arg_names()[0]
+    op.rename_output(out, out + "@renamed")
+    assert desc.version > v2
+
+
+def test_pruned_program_mutators_still_bump_version(prog_scope, exe):
+    """prune() rebuilds its op list outside BlockDesc.append_op; the
+    rebuilt ops must still carry the block backref or post-prune
+    mutations silently skip the version bump."""
+    main, startup, scope = prog_scope
+    x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+    p = fluid.layers.fc(input=x, size=2, act=None)
+    pruned = main.prune([p])
+    v0 = pruned.desc.version
+    pruned.desc.blocks[0].ops[0].set_attr("post_prune_attr", 1)
+    assert pruned.desc.version > v0
+
+
+def test_prepared_program_sees_post_rename_mutation(prog_scope, exe):
+    """PR 2 regression: prepared entries are keyed on program version;
+    an OpDesc rename after prepare() must mark the entry stale instead
+    of silently serving the pre-rename executable."""
+    main, startup, scope = prog_scope
+    avg_cost = build_fit_a_line()
+    exe.run(startup)
+    feed = {"x": np.ones((8, 13), np.float32),
+            "y": np.ones((8, 1), np.float32)}
+    prep = exe.prepare(main, feed_specs=feed, fetch_list=[avg_cost])
+    assert not prep.is_stale
+    op = main.desc.blocks[0].ops[0]
+    op.set_attr("mutated_after_prepare", True)
+    assert prep.is_stale, ("a transpiler-style mutation must invalidate "
+                           "the prepared entry")
+
+
+# ---------------------------------------------------------------------------
+# slot errors (OpDesc.input/output)
+# ---------------------------------------------------------------------------
+
+def test_op_slot_error_names_op_and_slots():
+    op = O("mul", {"X": ["x"], "Y": ["w"]}, {"Out": ["o"]})
+    with pytest.raises(KeyError) as ei:
+        op.input("Z")
+    msg = str(ei.value)
+    assert "mul" in msg and "'Z'" in msg and "X" in msg and "Y" in msg
+    with pytest.raises(KeyError) as ei:
+        op.output("Result")
+    msg = str(ei.value)
+    assert "mul" in msg and "Out" in msg
+    # probing with an explicit default stays non-raising
+    assert op.input("Z", []) == []
+    assert op.output("Result", []) == []

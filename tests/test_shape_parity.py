@@ -1,0 +1,141 @@
+"""Abstract-shape/runtime parity (verifier satellite).
+
+The enforcement surface is the hook in op_test.py: every OpTest spec in
+the suite asserts, on its CPU run, that the verifier's abstract shape
+inference (registered infer_shape or the jax.eval_shape fallback)
+matches its concrete output shapes/dtypes.  This file anchors the
+mechanics: a meta-test proving the hook actually trips on a drifted
+infer_shape, plus explicit parity anchors for representative op shapes
+that must keep inferring even if their specs move around."""
+import jax
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid  # registers all ops
+from paddle_tpu.core import desc as core_desc
+from paddle_tpu.core import lowering
+from paddle_tpu.core.registry import has_op, register_op
+from paddle_tpu.core.types import DataType
+
+from op_test import OpTest
+
+
+@pytest.fixture
+def probe_op():
+    """Register a throwaway op for one test and remove it afterwards —
+    the registry is process-global and other suites (tpu_optest spec
+    classification) sweep every registered op."""
+    from paddle_tpu.core import registry
+
+    names = []
+
+    def _register(name, **kwargs):
+        if not has_op(name):
+            register_op(name, **kwargs)
+            names.append(name)
+        return name
+
+    yield _register
+    for name in names:
+        registry._registry.pop(name, None)
+
+
+def test_parity_hook_trips_on_drifted_infer_shape(probe_op):
+    """Meta-test: a registered infer_shape that disagrees with the
+    lowering must be caught by the OpTest parity hook — this is the
+    drift the satellite exists to prevent."""
+    def lying_infer(ins, attrs, op=None):
+        sd = ins["X"]
+        return {"Out": jax.ShapeDtypeStruct(sd.shape + (1,), sd.dtype)}
+
+    probe_op("parity_probe_lying", grad_maker=None,
+             infer_shape=lying_infer,
+             lower=lambda ctx, ins, attrs, op=None: {"Out": ins["X"] * 2.0})
+
+    x = np.ones((3, 4), np.float32)
+
+    class T(OpTest):
+        op_type = "parity_probe_lying"
+        inputs = {"X": x}
+        outputs = {"Out": x * 2.0}
+
+    with pytest.raises(AssertionError, match="drifted|shape"):
+        T().check_output()
+
+
+def test_parity_hook_honors_correct_infer_shape(probe_op):
+    def honest_infer(ins, attrs, op=None):
+        sd = ins["X"]
+        return {"Out": jax.ShapeDtypeStruct(sd.shape, sd.dtype)}
+
+    probe_op("parity_probe_honest", grad_maker=None,
+             infer_shape=honest_infer,
+             lower=lambda ctx, ins, attrs, op=None: {"Out": ins["X"] * 3.0})
+
+    x = np.ones((2, 5), np.float32)
+
+    class T(OpTest):
+        op_type = "parity_probe_honest"
+        inputs = {"X": x}
+        outputs = {"Out": x * 3.0}
+
+    T().check_output()
+
+
+# --- explicit anchors: ops whose inferred output specs must stay exact ---
+
+ANCHORS = [
+    ("mul", {"X": [("x", (4, 3), "float32")], "Y": [("y", (3, 7),
+                                                     "float32")]},
+     {"Out": [("o", (4, 7), "float32")]}, {}),
+    ("softmax", {"X": [("x", (6, 10), "float32")]},
+     {"Out": [("o", (6, 10), "float32")]}, {}),
+    ("concat", {"X": [("a", (2, 3), "float32"), ("b", (2, 5),
+                                                 "float32")]},
+     {"Out": [("o", (2, 8), "float32")]}, {"axis": 1}),
+    ("reduce_sum", {"X": [("x", (3, 4, 5), "float32")]},
+     {"Out": [("o", (3, 5), "float32")]}, {"dim": [1], "keep_dim": False}),
+    ("cast", {"X": [("x", (3, 3), "float32")]},
+     {"Out": [("o", (3, 3), "int32")]},
+     {"in_dtype": int(DataType.FP32), "out_dtype": int(DataType.INT32)}),
+    ("lookup_table", {"W": [("w", (50, 8), "float32")],
+                      "Ids": [("ids", (4, 1), "int32")]},
+     {"Out": [("o", (4, 8), "float32")]}, {}),
+    ("conv2d", {"Input": [("x", (2, 3, 8, 8), "float32")],
+                "Filter": [("f", (4, 3, 3, 3), "float32")]},
+     {"Output": [("o", (2, 4, 6, 6), "float32")]},
+     {"strides": [1, 1], "paddings": [0, 0], "groups": 1,
+      "dilations": [1, 1]}),
+]
+
+
+@pytest.mark.parametrize("op_type,ins,outs,attrs", ANCHORS,
+                         ids=[a[0] for a in ANCHORS])
+def test_abstract_inference_anchor(op_type, ins, outs, attrs):
+    from paddle_tpu.core.types import np_dtype_to_proto
+
+    prog = core_desc.ProgramDesc()
+    block = prog.blocks[0]
+    in_map, out_map = {}, {}
+    for slot, entries in ins.items():
+        in_map[slot] = []
+        for name, shape, dtype in entries:
+            block.add_var(core_desc.VarDesc(
+                name, shape=shape,
+                dtype=np_dtype_to_proto(np.dtype(dtype))))
+            in_map[slot].append(name)
+    expected = {}
+    for slot, entries in outs.items():
+        out_map[slot] = []
+        for name, shape, dtype in entries:
+            block.add_var(core_desc.VarDesc(
+                name, shape=shape,
+                dtype=np_dtype_to_proto(np.dtype(dtype))))
+            out_map[slot].append(name)
+            expected[name] = (tuple(shape), np.dtype(dtype))
+    op = block.append_op(core_desc.OpDesc(op_type, in_map, out_map, attrs))
+    inferred = lowering.infer_op_outputs(prog, block, op)
+    for name, (shape, dtype) in expected.items():
+        got_shape, got_dtype = inferred[name]
+        assert tuple(got_shape) == shape, (op_type, name, got_shape)
+        assert np.dtype(got_dtype) == dtype, (op_type, name, got_dtype)

@@ -1,0 +1,101 @@
+#!/usr/bin/env python
+"""Lint a saved ProgramDesc / inference model ahead of any execution.
+
+Runs the paddle_tpu/analysis checker pipeline (the same one the
+executor runs on a compile-cache miss) over a serialized program and
+prints structured diagnostics — so a model exported on one machine can
+be gated in CI before it ever reaches a TPU.
+
+Usage:
+    python tools/lint_program.py MODEL            # dir or proto file
+    python tools/lint_program.py MODEL --json     # machine-readable
+    python tools/lint_program.py MODEL --checkers def-use,shapes
+    python tools/lint_program.py MODEL --max-level warning
+
+MODEL is either a file holding a serialized framework ProgramDesc proto
+(e.g. the ``__model__`` written by fluid.io.save_inference_model) or a
+directory containing one (``--model-filename`` overrides the name).
+
+Exit status: 0 clean (or findings below --max-level), 1 when findings
+at or above --max-level exist, 2 when the input cannot be parsed.
+"""
+import argparse
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def load_program(path, model_filename):
+    from paddle_tpu.core.desc import ProgramDesc
+
+    if os.path.isdir(path):
+        path = os.path.join(path, model_filename)
+    with open(path, "rb") as f:
+        data = f.read()
+    return ProgramDesc.parse_from_string(data), path
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="lint a saved ProgramDesc / inference model")
+    ap.add_argument("model", help="proto file or model directory")
+    ap.add_argument("--model-filename", default="__model__",
+                    help="proto name inside a model directory")
+    ap.add_argument("--checkers", default=None,
+                    help="comma-separated checker names (default: all)")
+    ap.add_argument("--max-level", default="error",
+                    choices=["error", "warning", "note"],
+                    help="exit non-zero when findings at or above this "
+                         "severity exist (default: error)")
+    ap.add_argument("--json", action="store_true",
+                    help="emit diagnostics as a JSON array")
+    ap.add_argument("--quiet", action="store_true",
+                    help="suppress the per-diagnostic lines; summary only")
+    args = ap.parse_args(argv)
+
+    # ops must be registered before checkers consult the registry
+    import paddle_tpu.fluid  # noqa: F401
+    from paddle_tpu import analysis
+    from paddle_tpu.analysis.diagnostics import Severity
+
+    try:
+        program, path = load_program(args.model, args.model_filename)
+    except Exception as e:
+        print("lint_program: cannot load %r: %s" % (args.model, e),
+              file=sys.stderr)
+        return 2
+
+    checkers = ([c.strip() for c in args.checkers.split(",") if c.strip()]
+                if args.checkers else None)
+    diags = analysis.verify_program(program, checkers)
+
+    if args.json:
+        print(json.dumps([d.to_dict() for d in diags], indent=2))
+    elif not args.quiet:
+        for d in diags:
+            print(d.format())
+
+    counts = {Severity.ERROR: 0, Severity.WARNING: 0, Severity.NOTE: 0}
+    for d in diags:
+        counts[d.severity] = counts.get(d.severity, 0) + 1
+    if not args.json:
+        print("%s: %d block(s), %d op(s): %d error(s), %d warning(s), "
+              "%d note(s)"
+              % (path, len(program.blocks),
+                 sum(len(b.ops) for b in program.blocks),
+                 counts[Severity.ERROR], counts[Severity.WARNING],
+                 counts[Severity.NOTE]))
+
+    threshold = Severity.rank(args.max_level)
+    failing = sum(1 for d in diags
+                  if Severity.rank(d.severity) >= threshold)
+    return 1 if failing else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
