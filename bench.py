@@ -30,9 +30,12 @@ MFU shows the stack's ceiling when the workload is not HBM-bound the way
 ResNet-50 is on v5e (see the roofline fields on the headline metric).
 BENCH_SECONDARY=0 skips it.
 """
+import contextlib
 import json
 import os
+import signal
 import sys
+import threading
 import time
 
 import numpy as np
@@ -40,6 +43,79 @@ import numpy as np
 TRAIN_FLOPS_PER_IMG_224 = 12.3e9
 TRAIN_FLOPS_PER_IMG_VGG16_224 = 46.5e9  # ~15.5 GF fwd x3
 DEFAULT_PEAK_TFLOPS = 197.0  # v5e bf16
+
+
+@contextlib.contextmanager
+def _wall_budget(seconds, what):
+    """SIGALRM wall-clock budget: a hung device call inside ``what``
+    degrades to a TimeoutError the caller turns into an ``*_error``
+    JSON field, instead of wedging the whole bench into the driver's
+    rc:124 with no artifact at all.  No-op off the main thread or with
+    a non-positive budget."""
+    if seconds <= 0 or \
+            threading.current_thread() is not threading.main_thread():
+        yield
+        return
+
+    def _handler(signum, frame):
+        raise TimeoutError("%s exceeded its %ds wall budget"
+                           % (what, int(seconds)))
+
+    prev = signal.signal(signal.SIGALRM, _handler)
+    # never truncate a sub-second budget to alarm(0) == "no alarm"
+    signal.alarm(max(1, int(seconds)))
+    try:
+        yield
+    finally:
+        signal.alarm(0)
+        signal.signal(signal.SIGALRM, prev)
+
+
+def _probe_backend(timeout):
+    """Up-front liveness probe: one tiny jit, watched from the OUTSIDE.
+    A dead accelerator tunnel fails HERE, in seconds and explicitly,
+    instead of hanging the first 100-layer compile until the driver
+    kills the run.  The probe runs in a daemon thread because a wedged
+    PJRT call never returns to the interpreter — a SIGALRM handler
+    could not interrupt it; the main thread just stops waiting.
+    BENCH_FAKE_DEAD=1 simulates the dead tunnel (test hook for the
+    error artifact path)."""
+    result = {}
+
+    def probe():
+        try:
+            if os.environ.get("BENCH_FAKE_DEAD") == "1":
+                time.sleep(timeout + 30)   # hang like a dead tunnel
+            import jax
+            import jax.numpy as jnp
+            jax.jit(lambda x: x + 1)(
+                jnp.zeros((8,), jnp.float32)).block_until_ready()
+            result["ok"] = True
+        except Exception as e:   # a fast, explicit failure also counts
+            result["error"] = str(e)[:200]
+
+    t = threading.Thread(target=probe, daemon=True)
+    t.start()
+    t.join(timeout)
+    if result.get("ok"):
+        return
+    raise TimeoutError(
+        result.get("error") or
+        "no response from the backend within %ds (liveness probe)"
+        % int(timeout))
+
+
+def _exit_with_error_artifact(metric, err, on_accel):
+    """Print the explicit JSON error line and LEAVE — os._exit, because
+    a wedged runtime thread would otherwise hang interpreter teardown
+    and turn this fast failure back into the driver's rc:124."""
+    print(json.dumps({
+        "metric": metric,
+        "error": "backend unreachable: %s" % str(err)[:200],
+        "on_accel": on_accel,
+    }), flush=True)
+    sys.stdout.flush()
+    os._exit(0)
 
 
 def _ensure_bench_recordio(img_shape, data_set, n=2048):
@@ -239,6 +315,13 @@ def main():
         on_accel = any(d.platform != "cpu" for d in jax.devices())
     except Exception:
         pass
+    # liveness first: a dead tunnel yields a fast, explicit JSON error
+    # artifact instead of an rc:124 with nothing on stdout
+    try:
+        _probe_backend(float(os.environ.get("BENCH_LIVENESS_TIMEOUT",
+                                            "90")))
+    except Exception as e:
+        _exit_with_error_artifact("%s_train" % model_name, e, on_accel)
     if model_name == "transformer":
         return transformer_bench(on_accel)
     if model_name == "lstm":
@@ -524,14 +607,6 @@ def main():
         stream_stats["stream_overlap_ratio"] = round(
             (t_compute + t_h2d) / max(t_step, 1e-9), 3)
 
-    if (not use_fake and on_accel
-            and os.environ.get("BENCH_STREAM_PROBE", "1") == "1"):
-        try:
-            _stream_probe()
-        except Exception as e:
-            # evidence fields must never sink the headline the driver
-            # records
-            stream_stats["stream_probe_error"] = str(e)[:200]
     if model_name == "vgg":
         # closest published number: legacy VGG-19 train, MKL-DNN CPU,
         # bs256 (IntelOptimizedPaddle.md:36) — vgg16 here, so the ratio
@@ -565,7 +640,6 @@ def main():
     }
     if not use_fake:
         out["device_cached"] = device_cached
-        out.update(stream_stats)
     # 224x224 only: that's what the analytic FLOP counts are for
     per_img = {"resnet50": TRAIN_FLOPS_PER_IMG_224,
                "vgg": TRAIN_FLOPS_PER_IMG_VGG16_224}.get(model_name)
@@ -594,10 +668,33 @@ def main():
                 out["hbm_bound"] = True
                 out["mfu_roofline_cap"] = 0.20
                 out["profile_evidence"] = "PROFILE_r04.md"
+    # the headline is UN-LOSABLE: emit it the moment it exists, BEFORE
+    # the stream probe / secondary bench — if either wedges past its
+    # budget or the process dies, the driver still has this line.  The
+    # enriched line at exit repeats it with the evidence fields.
+    print(json.dumps(dict(out, partial=True)), flush=True)
+
+    if (not use_fake and on_accel
+            and os.environ.get("BENCH_STREAM_PROBE", "1") == "1"):
+        try:
+            with _wall_budget(
+                    float(os.environ.get("BENCH_STREAM_BUDGET", "180")),
+                    "stream probe"):
+                _stream_probe()
+        except Exception as e:
+            # evidence fields must never sink the headline the driver
+            # records
+            stream_stats["stream_probe_error"] = str(e)[:200]
+    if not use_fake:
+        out.update(stream_stats)
     if on_accel and model_name == "resnet50" and \
             os.environ.get("BENCH_SECONDARY", "1") == "1":
         try:
-            out["secondary"] = transformer_bench(True, as_dict=True)
+            with _wall_budget(
+                    float(os.environ.get("BENCH_SECONDARY_BUDGET",
+                                         "420")),
+                    "secondary transformer bench"):
+                out["secondary"] = transformer_bench(True, as_dict=True)
         except Exception as e:  # secondary must never sink the headline
             out["secondary_error"] = str(e)[:200]
     print(json.dumps(out))

@@ -18,9 +18,21 @@
 #include <netinet/tcp.h>
 #include <string.h>
 #include <sys/socket.h>
+#include <sys/uio.h>
 #include <unistd.h>
 
 extern "C" {
+
+/* 4 MB socket buffers: the data plane moves ~50-100 MB frames; the
+ * kernel default (~200 KB) forces the sender into many small
+ * round-trips with the receiver's window. */
+static void fw_tune(int fd) {
+    int one = 1;
+    setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+    int buf = 4 << 20;
+    setsockopt(fd, SOL_SOCKET, SO_SNDBUF, &buf, sizeof(buf));
+    setsockopt(fd, SOL_SOCKET, SO_RCVBUF, &buf, sizeof(buf));
+}
 
 /* Listen on 127.0.0.1:port (the pserver data plane is host-local or
  * cluster-internal; binding wildcard is the caller's call via addr). */
@@ -43,8 +55,7 @@ int fw_accept(int lfd) {
     for (;;) {
         int fd = accept(lfd, 0, 0);
         if (fd >= 0) {
-            int one = 1;
-            setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+            fw_tune(fd);
             return fd;
         }
         if (errno != EINTR) return -1;
@@ -63,8 +74,7 @@ int fw_connect(const char *addr, int port) {
         close(fd);
         return -3;
     }
-    int one = 1;
-    setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+    fw_tune(fd);
     return fd;
 }
 
@@ -80,6 +90,53 @@ long long fw_send(int fd, const char *buf, long long n) {
         done += w;
     }
     return done;
+}
+
+/* Vectored send: exactly sum(lens) bytes from n buffers in one writev
+ * loop (the batched scatter path: per-tensor header+payload parts go
+ * out without a Python-level join copy).  Returns total or <0. */
+long long fw_sendv(int fd, const char **bufs, const long long *lens,
+                   int n) {
+    struct iovec iov[64];
+    long long total = 0;
+    int i = 0;
+    while (i < n) {
+        int k = 0;
+        long long want = 0;
+        for (; k < 64 && i + k < n; ++k) {
+            iov[k].iov_base = (void *)bufs[i + k];
+            iov[k].iov_len = (size_t)lens[i + k];
+            want += lens[i + k];
+        }
+        long long done = 0;
+        int cur = 0;
+        while (done < want) {
+            /* sendmsg, not writev: MSG_NOSIGNAL turns a dead peer into
+             * EPIPE instead of a process-killing SIGPIPE (fw_send). */
+            struct msghdr mh;
+            memset(&mh, 0, sizeof(mh));
+            mh.msg_iov = iov + cur;
+            mh.msg_iovlen = (size_t)(k - cur);
+            ssize_t w = sendmsg(fd, &mh, MSG_NOSIGNAL);
+            if (w < 0) {
+                if (errno == EINTR) continue;
+                return -1;
+            }
+            done += w;
+            total += w;
+            /* advance past fully-written iovecs, trim a partial one */
+            while (cur < k && (size_t)w >= iov[cur].iov_len) {
+                w -= iov[cur].iov_len;
+                ++cur;
+            }
+            if (cur < k && w > 0) {
+                iov[cur].iov_base = (char *)iov[cur].iov_base + w;
+                iov[cur].iov_len -= (size_t)w;
+            }
+        }
+        i += k;
+    }
+    return total;
 }
 
 /* Receive exactly n bytes; returns n, 0 on orderly close at a message

@@ -13,10 +13,21 @@ control-plane/data-plane split.
 
 Protocol per message (little-endian):
     magic 'FW1\\n' (once per connection, both directions)
-    u8 method  (1=SendVariable, 2=GetVariable)
+    u8 method  (1=SendVariable, 2=GetVariable, 3=SendVariables,
+                4=GetVariables)
     u64 payload length | payload   (the rpc.py _enc_tensor/_enc_msg frame)
     reply: u64 length | payload
 The server dispatches to the SAME ParameterServer handlers as gRPC.
+
+Batched extensions (the PSERVER_BENCH send->apply->get round):
+- Requests may be handed over as a PARTS LIST (bytes heads + numpy
+  payload arrays); they go out in one vectored fw_sendv without ever
+  being joined into a Python-level buffer (the reference's zero-copy
+  LightNetwork sends).
+- A STREAM-mode server handler (GetVariables) writes its reply as a
+  sequence of length-prefixed frames, each emitted the moment that
+  shard is ready, instead of one gated reply; the client consumes them
+  with ``call_stream``.
 """
 from __future__ import annotations
 
@@ -29,17 +40,32 @@ import threading
 __all__ = ["native_available", "FastServer", "FastConnPool"]
 
 MAGIC = b"FW1\n"
-METHODS = {"SendVariable": 1, "GetVariable": 2}
+METHODS = {"SendVariable": 1, "GetVariable": 2,
+           "SendVariables": 3, "GetVariables": 4}
 
 _lib = None
 _lib_tried = False
+_lib_lock = threading.Lock()
 
 
 def _load():
+    """Thread-safe load-or-build.  The lock matters: concurrent callers
+    (per-endpoint scatter/gather threads) racing the one-time g++
+    self-build used to observe ``_lib_tried=True, _lib=None`` and
+    conclude 'no native library' — permanently blacklisting their
+    endpoint's data plane and silently degrading it to gRPC."""
     global _lib, _lib_tried
     if _lib_tried:
         return _lib
-    _lib_tried = True
+    with _lib_lock:
+        if _lib_tried:
+            return _lib
+        _lib = _build_and_bind()
+        _lib_tried = True
+    return _lib
+
+
+def _build_and_bind():
     here = os.path.dirname(os.path.abspath(__file__))
     src = os.path.join(here, "fastwire.c")
     so = os.path.join(here, "libfastwire.so")
@@ -71,14 +97,18 @@ def _load():
         lib.fw_send.argtypes = [ctypes.c_int, ctypes.c_char_p,
                                 ctypes.c_longlong]
         lib.fw_send.restype = ctypes.c_longlong
+        lib.fw_sendv.argtypes = [ctypes.c_int,
+                                 ctypes.POINTER(ctypes.c_char_p),
+                                 ctypes.POINTER(ctypes.c_longlong),
+                                 ctypes.c_int]
+        lib.fw_sendv.restype = ctypes.c_longlong
         lib.fw_recv.argtypes = [ctypes.c_int, ctypes.c_void_p,
                                 ctypes.c_longlong]  # addr via addressof
         lib.fw_recv.restype = ctypes.c_longlong
         lib.fw_close.argtypes = [ctypes.c_int]
-        _lib = lib
+        return lib
     except Exception:
-        _lib = None
-    return _lib
+        return None
 
 
 def native_available():
@@ -95,30 +125,82 @@ def _send_bytes(lib, fd, parts):
             raise ConnectionError("fastwire send failed")
 
 
+def _parts_len(parts):
+    """Total byte length of a parts list (bytes heads + ndarray
+    payloads) without materializing anything."""
+    total = 0
+    for p in parts:
+        total += p.nbytes if hasattr(p, "nbytes") else len(p)
+    return total
+
+
+def _send_parts(lib, fd, parts):
+    """One vectored send of a parts list: bytes go in as-is, numpy
+    arrays by their buffer address — no join, no copy.  The caller owns
+    the parts' lifetimes for the duration of the call (ctypes arrays
+    hold raw pointers, not references)."""
+    import numpy as np
+
+    n = len(parts)
+    bufs = (ctypes.c_char_p * n)()
+    lens = (ctypes.c_longlong * n)()
+    keep = []   # pin converted buffers until fw_sendv returns
+    total = 0
+    for i, p in enumerate(parts):
+        if isinstance(p, (bytes, bytearray)):
+            b = bytes(p)
+            keep.append(b)
+            bufs[i] = b
+            lens[i] = len(b)
+        else:
+            arr = p if isinstance(p, np.ndarray) \
+                else np.frombuffer(p, dtype=np.uint8)
+            if not arr.flags.c_contiguous:
+                arr = np.ascontiguousarray(arr)
+            keep.append(arr)
+            bufs[i] = ctypes.cast(ctypes.c_void_p(arr.ctypes.data),
+                                  ctypes.c_char_p)
+            lens[i] = arr.nbytes
+        total += lens[i]
+    if lib.fw_sendv(fd, bufs, lens, n) != total:
+        raise ConnectionError("fastwire vectored send failed")
+    del keep
+
+
 def _recv_exact(lib, fd, n):
     """Receive exactly n bytes into a fresh buffer; returns a
     memoryview over it (no trailing copy — .raw would double the
-    payload memory traffic)."""
-    buf = bytearray(n)
-    c = (ctypes.c_char * n).from_buffer(buf)
-    got = lib.fw_recv(fd, ctypes.addressof(c), n)
-    del c
+    payload memory traffic).  np.empty, NOT bytearray: bytearray(n)
+    zeroes its memory, a full extra pass over every 50 MB payload."""
+    import numpy as np
+
+    buf = np.empty(n, np.uint8)
+    got = lib.fw_recv(fd, buf.ctypes.data, n)
     if got != n:
         raise ConnectionError("fastwire recv failed (%d of %d)" % (got, n))
+    # preserve the wire contract: decoded tensors are READ-ONLY views
+    # (a consumer that wants to mutate must .copy())
+    buf.flags.writeable = False
     return memoryview(buf)
 
 
 class FastServer:
     """Accept loop + per-connection dispatch threads.  ``handlers`` is
     {method_name: fn(payload_bytes) -> reply_bytes} — the pserver's
-    existing gRPC handler functions, unchanged."""
+    existing gRPC handler functions, unchanged.  A value may also be
+    ``(fn, "stream")``: fn(payload, write) writes its own reply as a
+    sequence of parts lists (each a length-prefixed frame) and the
+    serve loop sends no envelope — the per-shard streaming gather."""
 
     def __init__(self, port, handlers, addr="0.0.0.0"):
         lib = _load()
         if lib is None:
             raise RuntimeError("fastwire native library unavailable")
         self._lib = lib
-        self._handlers = {METHODS[k]: v for k, v in handlers.items()}
+        self._handlers = {}
+        for k, v in handlers.items():
+            fn, mode = v if isinstance(v, tuple) else (v, "unary")
+            self._handlers[METHODS[k]] = (fn, mode)
         self._lfd = lib.fw_listen(addr.encode(), int(port), 64)
         if self._lfd < 0:
             raise OSError("fastwire listen failed on %s:%d (%d)"
@@ -152,12 +234,19 @@ class FastServer:
                     return
                 method, ln = struct.unpack("<BQ", hdr.raw)
                 payload = _recv_exact(lib, fd, ln)
-                fn = self._handlers.get(method)
-                if fn is None:
+                ent = self._handlers.get(method)
+                if ent is None:
                     return
-                reply = fn(payload) or b""
-                _send_bytes(lib, fd,
-                            [struct.pack("<Q", len(reply)), reply])
+                fn, mode = ent
+                if mode == "stream":
+                    # the handler writes length-prefixed frames itself,
+                    # each the moment its shard is ready
+                    fn(payload,
+                       lambda parts: _send_parts(lib, fd, parts))
+                else:
+                    reply = fn(payload) or b""
+                    _send_bytes(lib, fd,
+                                [struct.pack("<Q", len(reply)), reply])
         except ConnectionError:
             pass
         finally:
@@ -173,24 +262,51 @@ class _Conn:
         self.lib = lib
         self.fd = fd
 
-    def call(self, method, payload):
-        """One round-trip.  A ConnectionError raised BEFORE the payload
-        went out carries .sent_payload=False (safe to retry on a fresh
-        connection — a stale pooled socket); after it, True: the server
-        may have consumed and APPLIED the frame, so the caller must NOT
-        resend (a duplicated SendVariable gradient would silently skew
-        the sync average)."""
-        head = struct.pack("<BQ", METHODS[method], len(payload))
+    def _send_request(self, method, payload):
+        """Header + payload; payload may be bytes or a PARTS list (one
+        vectored send, no join).  sent_payload annotation as in call."""
+        parts = payload if isinstance(payload, (list, tuple)) \
+            else [payload]
+        head = struct.pack("<BQ", METHODS[method], _parts_len(parts))
         try:
             _send_bytes(self.lib, self.fd, [head])
         except ConnectionError as e:
             e.sent_payload = False
             raise
         try:
-            _send_bytes(self.lib, self.fd, [payload])
+            _send_parts(self.lib, self.fd, list(parts))
+        except ConnectionError as e:
+            e.sent_payload = True
+            raise
+
+    def call(self, method, payload):
+        """One round-trip.  A ConnectionError raised BEFORE the payload
+        went out carries .sent_payload=False (safe to retry on a fresh
+        connection — a stale pooled socket); after it, True: the server
+        may have consumed and APPLIED the frame, so the caller must NOT
+        resend (a duplicated SendVariable gradient would silently skew
+        the sync average).  ``payload`` may be bytes or a parts list."""
+        self._send_request(method, payload)
+        try:
             (ln,) = struct.unpack("<Q",
                                   _recv_exact(self.lib, self.fd, 8))
             return _recv_exact(self.lib, self.fd, ln)
+        except ConnectionError as e:
+            e.sent_payload = True
+            raise
+
+    def call_stream(self, method, payload, n_frames, on_frame):
+        """Streamed gather round-trip: send the request, then consume
+        ``n_frames`` length-prefixed reply frames, invoking
+        ``on_frame(view)`` on each AS IT ARRIVES (the server emits a
+        frame the moment that shard is ready — the client overlaps its
+        own decode/copy with the still-applying shards)."""
+        self._send_request(method, payload)
+        try:
+            for _ in range(n_frames):
+                (ln,) = struct.unpack("<Q",
+                                      _recv_exact(self.lib, self.fd, 8))
+                on_frame(_recv_exact(self.lib, self.fd, ln))
         except ConnectionError as e:
             e.sent_payload = True
             raise

@@ -58,6 +58,16 @@ define_flag("pserver_checkpoint_root", "",
             "root dir for per-endpoint pserver shard checkpoints")
 define_flag("pserver_checkpoint_every_n", 0,
             "checkpoint the pserver shard every N applied rounds")
+define_flag("pserver_wire_batch", True,
+            "ship all of a trainer's shards for an endpoint as ONE "
+            "batched fastwire scatter frame (and gather the return leg "
+            "as one streamed call) instead of per-variable messages; "
+            "0 restores the unbatched wire")
+define_flag("pserver_overlap", True,
+            "full-duplex sync rounds: barrier acks overlap with param "
+            "gets (the server streams each shard as its apply commits) "
+            "and grad convert/encode overlaps in-flight sends; 0 "
+            "restores the serialized send->barrier->get round")
 
 
 class InjectedFault(ConnectionError):

@@ -146,6 +146,108 @@ def _dec_tensor(data):
     return name, arr, extra
 
 
+def _aligned_empty(shape, dtype):
+    """64-byte-aligned uninitialized array.  jax's CPU backend
+    ZERO-COPIES aligned numpy arrays into jit/device_put; np.empty's
+    16-byte malloc alignment forces a full copy of every 50-100 MB
+    parameter/gradient buffer at each staging (measured ~95 ms per
+    105 MB) — alignment alone turns that into ~0."""
+    dtype = np.dtype(dtype)
+    shape = tuple(int(d) for d in shape)
+    n = int(np.prod(shape)) if shape else 1
+    raw = np.empty(n * dtype.itemsize + 64, np.uint8)
+    off = (-raw.ctypes.data) % 64
+    return raw[off:off + n * dtype.itemsize].view(dtype).reshape(shape)
+
+
+# canonical byte-length of a parts list lives next to the vectored
+# send that must agree with it — one helper, one definition
+from .fastwire import _parts_len as _parts_nbytes  # noqa: E402
+
+
+def _coalesce_parts(parts):
+    """Merge adjacent small bytes heads so the vectored send stays a
+    handful of iovecs; numpy payloads pass through untouched."""
+    out = []
+    for p in parts:
+        if isinstance(p, bytes) and out and isinstance(out[-1], bytes) \
+                and len(out[-1]) + len(p) < (1 << 16):
+            out[-1] = out[-1] + p
+        else:
+            out.append(p)
+    return out
+
+
+def _enc_arr_parts(parts, arr):
+    """_enc_arr without the join: appends the dtype|shape head as bytes
+    and the array ITSELF — fastwire ships it by buffer address, so a
+    100 MB payload is never copied into a Python-level join."""
+    arr = np.asarray(arr)
+    if arr.dtype.hasobject:
+        raise TypeError("cannot send object-dtype array over the "
+                        "pserver wire (got dtype=%s)" % arr.dtype)
+    if not arr.flags.c_contiguous:
+        arr = np.ascontiguousarray(arr)
+    dt = arr.dtype.str.encode("ascii")
+    head = [len(dt).to_bytes(2, "little"), dt,
+            arr.ndim.to_bytes(1, "little")]
+    for d in arr.shape:
+        head.append(int(d).to_bytes(8, "little"))
+    parts.append(b"".join(head))
+    parts.append(arr)
+
+
+def _enc_tensor_parts(name, arr, extra=0):
+    """_enc_tensor as a parts list (bytes heads + ndarray payloads):
+    the same wire bytes, zero payload copies on the fastwire path."""
+    from paddle_tpu.core.selected_rows import SelectedRows
+
+    nb = name.encode("utf-8")
+    head = (len(nb).to_bytes(4, "little") + nb
+            + int(extra).to_bytes(8, "little", signed=True))
+    parts = []
+    if isinstance(arr, SelectedRows):
+        parts.append(head + b"\x01"
+                     + int(arr.height).to_bytes(8, "little"))
+        _enc_arr_parts(parts, np.asarray(arr.rows))
+        _enc_arr_parts(parts, np.asarray(arr.values))
+    else:
+        parts.append(head + b"\x00")
+        _enc_arr_parts(parts, np.asarray(arr))
+    return _coalesce_parts(parts)
+
+
+def _join_parts(parts):
+    """Materialize a parts list into one bytes payload (the gRPC
+    fallback — gRPC owns its own serialization anyway)."""
+    return b"".join(p if isinstance(p, (bytes, bytearray))
+                    else memoryview(p).cast("B") for p in parts)
+
+
+def _enc_batch_parts(frames):
+    """Batched wire frame: u32 count | count x (u64 len | frame), as a
+    parts list.  Each sub-frame is a complete _enc_tensor/_enc_msg
+    frame carrying its OWN (round, sender, seq) identity, so dedup and
+    replay semantics are identical to the unbatched wire."""
+    out = [len(frames).to_bytes(4, "little")]
+    for parts in frames:
+        out.append(_parts_nbytes(parts).to_bytes(8, "little"))
+        out.extend(parts)
+    return _coalesce_parts(out)
+
+
+def _iter_batch(view):
+    """Yield zero-copy sub-frame views of a batched payload."""
+    view = memoryview(view)
+    n = int.from_bytes(view[:4], "little")
+    off = 4
+    for _ in range(n):
+        ln = int.from_bytes(view[off:off + 8], "little")
+        off += 8
+        yield view[off:off + ln]
+        off += ln
+
+
 def _enc_msg(name, extra=0):
     nb = name.encode("utf-8")
     return (len(nb).to_bytes(4, "little") + nb
@@ -194,11 +296,16 @@ class VariableServer:
     ``trainer_lease``: seconds of mid-round silence after which a known
     trainer is expired from the sync fanin (0 disables; reference
     go/master/service.go:368 checkTimeout).
+    ``grad_params``: grad name -> tuple of vars its optimize block
+    writes.  When given, each shard's params raise a per-shard
+    completion event the moment ITS apply commits, so streamed gathers
+    return a shard without gating on the whole round.
     """
 
     def __init__(self, scope, grad_to_block, apply_block, fanin,
                  sync_mode=True, checkpoint_dir=None,
-                 checkpoint_every_n=0, trainer_lease=None):
+                 checkpoint_every_n=0, trainer_lease=None,
+                 grad_params=None):
         import grpc
 
         self.scope = scope
@@ -206,6 +313,8 @@ class VariableServer:
         self.apply_block = apply_block
         self.fanin_total = int(fanin)
         self.sync_mode = bool(sync_mode)
+        self.grad_params = {k: tuple(v) for k, v in grad_params.items()} \
+            if grad_params else {}
         # shard checkpointing (reference go/pserver/service.go:346:
         # each pserver persists ITS parameter shard so a restarted
         # server resumes instead of reinitializing)
@@ -219,6 +328,15 @@ class VariableServer:
         # round overwrites instead of double-counting in the sync mean
         self._pending = {g: {} for g in self.grad_to_block}
         self._applied_round = 0
+        # per-shard completion: param name -> rounds applied for ITS
+        # shard (bumped mid-round, before _applied_round), plus the
+        # in-flight apply guard for the lock-release windows
+        self._param_ready = {}
+        self._applying = False
+        self._apply_target = -1
+        # (name -> (ready-round, encoded parts)): both trainers fetch
+        # the same shard every round — materialize + encode it once
+        self._reply_cache = {}
         self._barrier_senders = set()   # senders barriered this round
         self._barrier_round = -1        # highest round those barriers name
         self._legacy_barriers = 0       # anonymous (empty-payload) barriers
@@ -246,7 +364,9 @@ class VariableServer:
 
         handlers = {
             "SendVariable": self._h(self._send_variable),
+            "SendVariables": self._h(self._send_variables),
             "GetVariable": self._h(self._get_variable),
+            "GetVariables": self._h(self._get_variables),
             "PrefetchVariable": self._h(self._prefetch_variable),
             "SendBarrier": self._h(self._send_barrier),
             "FetchBarrier": self._h(self._fetch_barrier),
@@ -290,7 +410,12 @@ class VariableServer:
                 self._fast = fastwire.FastServer(
                     port + FASTWIRE_PORT_OFFSET,
                     {"SendVariable": self._send_variable,
-                     "GetVariable": self._get_variable})
+                     "GetVariable": self._get_variable,
+                     "SendVariables": self._send_variables,
+                     # streamed batched gather: frames go out per-shard
+                     # the moment each apply commits
+                     "GetVariables": (self._get_variables_stream,
+                                      "stream")})
             except Exception:
                 self._fast = None
         if self.sync_mode and self.trainer_lease > 0:
@@ -335,7 +460,11 @@ class VariableServer:
     def _maybe_apply_locked(self):
         """Apply the round if every live trainer barriered (lock held).
         Returns a state snapshot the CALLER must persist (outside the
-        lock) before bumping _durable_round, or None."""
+        lock) before bumping _durable_round, or None.  ``_applying``
+        guards re-entry: _apply_round releases the lock around each
+        optimize block, so another handler can get here mid-round."""
+        if self._applying:
+            return None
         if not (0 < self._alive <= self._barrier_count()):
             return None
         self._apply_round()
@@ -381,36 +510,58 @@ class VariableServer:
             self._persist_and_ack(snapshot)
 
     # -- handlers --
+    def _store_grad_locked(self, name, arr, extra):
+        """One decoded tensor into the pending/apply machinery (lock
+        held) — shared by the unbatched and batched scatter handlers."""
+        round_, sender, seq = _unpack_round_sender(extra)
+        if sender is not None:
+            self._touch(sender)
+        if name not in self._pending:
+            # direct write (e.g. init push or non-optimized var)
+            self.scope.set(name, arr)
+            self._reply_cache.pop(name, None)
+            return
+        if sender is None:
+            key = ("anon", self._anon_seq)
+            self._anon_seq += 1
+        else:
+            if self.sync_mode and (
+                    round_ < self._applied_round
+                    or (self._applying and round_ < self._apply_target)):
+                # stale replay of an applied round — including one that
+                # slips through the apply loop's lock-release window
+                # (its grads are already counted in the in-flight round)
+                return
+            if not self.sync_mode and seq and \
+                    self._async_applied.get((sender, name)) == seq:
+                # async applies on arrival and clears pending, so
+                # the round-replay dedup can't help a retried send:
+                # the per-sender send sequence is what makes a
+                # resend of an already-applied grad a no-op
+                return
+            key = sender
+        self._pending[name][key] = arr
+        if not self.sync_mode:
+            self._apply_one(name)
+            if sender is not None and seq:
+                self._async_applied[(sender, name)] = seq
+            self._cv.notify_all()
+
     def _send_variable(self, req, ctx=None):
         name, arr, extra = _dec_tensor(req)
-        round_, sender, seq = _unpack_round_sender(extra)
         with self._cv:
-            if sender is not None:
-                self._touch(sender)
-            if name not in self._pending:
-                # direct write (e.g. init push or non-optimized var)
-                self.scope.set(name, arr)
-                return b""
-            if sender is None:
-                key = ("anon", self._anon_seq)
-                self._anon_seq += 1
-            else:
-                if self.sync_mode and round_ < self._applied_round:
-                    return b""   # stale replay of an applied round
-                if not self.sync_mode and seq and \
-                        self._async_applied.get((sender, name)) == seq:
-                    # async applies on arrival and clears pending, so
-                    # the round-replay dedup can't help a retried send:
-                    # the per-sender send sequence is what makes a
-                    # resend of an already-applied grad a no-op
-                    return b""
-                key = sender
-            self._pending[name][key] = arr
-            if not self.sync_mode:
-                self._apply_one(name)
-                if sender is not None and seq:
-                    self._async_applied[(sender, name)] = seq
-                self._cv.notify_all()
+            self._store_grad_locked(name, arr, extra)
+        return b""
+
+    def _send_variables(self, req, ctx=None):
+        """Batched scatter: every shard a trainer routes to this
+        endpoint in one frame, decoded zero-copy sub-frame by
+        sub-frame.  Each carries its own (round, sender, seq) identity,
+        so dedup/replay semantics match the unbatched wire exactly."""
+        with self._cv:
+            for frame in _iter_batch(req):
+                name, arr, extra = _dec_tensor(frame)
+                self._store_grad_locked(name, arr, extra)
         return b""
 
     def _send_barrier(self, req, ctx=None):
@@ -507,17 +658,100 @@ class VariableServer:
             with open(os.path.join(dirname, fn), "rb") as f:
                 self.scope.set(unquote(fn), np.load(f))
 
+    def _ready_locked(self, name, round_):
+        """True when ``name`` is safe to serve at ``round_``: the whole
+        round applied, or — mid-round — this shard's own apply already
+        committed (per-shard completion event via grad_params)."""
+        if self._applied_round >= round_:
+            return True
+        r = self._param_ready.get(name)
+        return r is not None and r >= round_
+
+    def _materialize_locked(self, name):
+        """Encoded parts for ``name``'s current value (lock held).
+        Cached per shard-round: with fanin trainers fetching the same
+        shard every round, the host materialization + encode happens
+        once, not fanin times."""
+        key = self._param_ready.get(name, self._applied_round)
+        ent = self._reply_cache.get(name)
+        if ent is not None and ent[0] == key:
+            return ent[1]
+        # materialize INSIDE the lock: a concurrent async-mode apply
+        # donates the param's device buffer, invalidating it
+        val = self.scope.find_var(name)
+        from paddle_tpu.core.selected_rows import SelectedRows
+        if not isinstance(val, SelectedRows):
+            val = np.asarray(val)
+        parts = _enc_tensor_parts(name, val)
+        self._reply_cache[name] = (key, parts)
+        return parts
+
+    def _invalidate_locked(self, gname):
+        """Drop cached replies a just-applied block may have rewritten
+        (lock held).  Without a grad->outputs map we cannot know what
+        the block wrote — drop everything."""
+        self._reply_cache.pop(gname, None)
+        outs = self.grad_params.get(gname)
+        if outs is None:
+            self._reply_cache.clear()
+        else:
+            for p in outs:
+                self._reply_cache.pop(p, None)
+
     def _get_variable(self, req, ctx=None):
         name, round_ = _dec_msg(req)
         with self._cv:
             if self.sync_mode:
                 if not self._wait_cv(
-                        lambda: self._applied_round >= round_, ctx):
+                        lambda: self._ready_locked(name, round_), ctx):
                     return b""  # client gone: response is discarded
-            # materialize to host INSIDE the lock: a concurrent async-mode
-            # apply donates the param's device buffer, invalidating it
-            val = np.asarray(self.scope.find_var(name))
-        return _enc_tensor(name, val)
+            parts = self._materialize_locked(name)
+        return _join_parts(parts)
+
+    def _get_variables(self, req, ctx=None):
+        """Batched gather, unary (gRPC fallback): waits until every
+        requested shard is ready, replies with the frames
+        length-prefixed back to back (count known to the caller)."""
+        items = [_dec_msg(f) for f in _iter_batch(req)]
+        with self._cv:
+            if self.sync_mode:
+                if not self._wait_cv(
+                        lambda: all(self._ready_locked(n, r)
+                                    for n, r in items), ctx):
+                    return b""
+            frames = [self._materialize_locked(n) for n, _ in items]
+        out = []
+        for parts in frames:
+            out.append(_parts_nbytes(parts).to_bytes(8, "little"))
+            out.extend(parts)
+        return _join_parts(out)
+
+    def _get_variables_stream(self, req, write):
+        """Batched gather over fastwire: each shard's frame is written
+        the MOMENT its apply commits (per-shard completion events from
+        the apply loop) instead of gating every get on the whole round
+        — the full-duplex half of send/apply/get overlap."""
+        remaining = {}
+        for f in _iter_batch(req):
+            name, round_ = _dec_msg(f)
+            remaining[name] = round_
+        while remaining:
+            with self._cv:
+                if self.sync_mode:
+                    self._wait_cv(
+                        lambda: any(self._ready_locked(n, r)
+                                    for n, r in remaining.items()), None)
+                    ready = [n for n, r in remaining.items()
+                             if self._ready_locked(n, r)]
+                    if not ready:   # shutdown mid-wait: serve current
+                        ready = list(remaining)
+                else:
+                    ready = list(remaining)
+                frames = [self._materialize_locked(n) for n in ready]
+            for name, parts in zip(ready, frames):
+                write([_parts_nbytes(parts).to_bytes(8, "little")]
+                      + list(parts))
+                del remaining[name]
 
     def _prefetch_variable(self, req, ctx=None):
         """Row-subset read of a sharded table (reference
@@ -620,36 +854,54 @@ class VariableServer:
         return b""
 
     # -- application (lock held) --
-    def _apply_one(self, gname):
+    def _aggregate_locked(self, gname):
+        """Mean the pending grads for ``gname`` and clear them (lock
+        held); None when nothing arrived this round."""
         from paddle_tpu.core.selected_rows import SelectedRows
 
         vals = list(self._pending[gname].values())
         if not vals:
-            return
+            return None
+        self._pending[gname] = {}
         if any(isinstance(v, SelectedRows) for v in vals):
             # mean of sparse grads = concatenated rows, values / N
             # (scatter-add makes concatenation a sum)
-            agg = SelectedRows(
+            return SelectedRows(
                 np.concatenate([np.asarray(v.rows) for v in vals]),
                 np.concatenate([np.asarray(v.values) for v in vals])
                 / len(vals),
                 vals[0].height)
-        elif len(vals) == 1:
-            agg = np.asarray(vals[0])
-        else:
-            # wire-decoded arrays are READ-ONLY views over the gRPC
-            # message buffer: copy once, then accumulate in place
-            agg = np.array(vals[0], copy=True)
-            for v in vals[1:]:
-                agg += v
-            agg /= len(vals)
+        if len(vals) == 1:
+            return np.asarray(vals[0])
+        v0 = np.asarray(vals[0])
+        # aggregate into an ALIGNED buffer (the optimize block stages
+        # it zero-copy) with the minimum of full-buffer passes: one
+        # allocating add + one in-place scale
+        agg = _aligned_empty(v0.shape, v0.dtype)
+        np.add(v0, vals[1], out=agg)
+        for v in vals[2:]:
+            agg += v
+        agg *= 1.0 / len(vals)
+        return agg
+
+    def _apply_one(self, gname):
+        """Aggregate + optimize one shard (lock held throughout — the
+        async-mode arrival path)."""
+        agg = self._aggregate_locked(gname)
+        if agg is None:
+            return
         self.scope.set(gname, agg)
-        self._pending[gname] = {}
+        self._invalidate_locked(gname)
         self.apply_block(self.grad_to_block[gname])
+        self._invalidate_locked(gname)
 
     def _apply_round(self):
-        for g in self._pending:
-            self._apply_one(g)
+        """Apply every shard of the round (lock held on entry/exit).
+        The lock is RELEASED around each shard's optimize block so
+        sends/gets keep flowing while it computes, and each shard's
+        params raise their per-shard completion event the moment its
+        apply commits — streamed gathers return them while later
+        shards (and the durability write) are still in flight."""
         if self._barrier_round > self._applied_round:
             # restarted from a checkpoint OLDER than the trainers'
             # round (checkpoint_every_n > 1): the skipped rounds' grads
@@ -657,7 +909,30 @@ class VariableServer:
             # count the replayed grads ONCE — bounded staleness instead
             # of re-applying the same gradients once per missing round
             self._applied_round = self._barrier_round
-        self._applied_round += 1
+        nxt = self._applied_round + 1
+        self._applying = True
+        self._apply_target = nxt
+        try:
+            for g in self.grad_to_block:
+                agg = self._aggregate_locked(g)
+                if agg is not None:
+                    self.scope.set(g, agg)
+                    self._invalidate_locked(g)
+                    self._cv.release()
+                    try:
+                        self.apply_block(self.grad_to_block[g])
+                    finally:
+                        self._cv.acquire()
+                    self._invalidate_locked(g)
+                # shard committed (or had nothing to do — its params
+                # already hold the round's values): publish per-shard
+                # readiness so a streamed gather can ship it now
+                for p in self.grad_params.get(g, ()):
+                    self._param_ready[p] = nxt
+                self._cv.notify_all()
+        finally:
+            self._applying = False
+        self._applied_round = nxt
         self._barrier_senders = set()
         self._barrier_round = -1
         self._legacy_barriers = 0
@@ -689,6 +964,10 @@ class RPCClient:
         self._resolver = None     # logical ep -> current physical ep
         self._redirects = {}      # logical ep -> physical ep overrides
         self._round_cache = {}    # ep -> {"round", "grads", "barriered"}
+        self._cache_lock = threading.Lock()  # seq + replay cache: the
+        #                           batched senders record from threads
+        self._barrier_pending = None  # (threads, errs) of in-flight
+        #                           overlapped barriers (launch/join)
 
     @classmethod
     def instance(cls):
@@ -754,18 +1033,23 @@ class RPCClient:
         """Per-send sequence, 1..16383 wrapping (0 = 'no seq').  An
         async-mode server drops a resend whose (sender, name, seq)
         already applied; a replay reuses the ORIGINAL seq."""
-        self._seq = (self._seq % _SEQ_MASK) + 1
-        return self._seq
+        with self._cache_lock:
+            self._seq = (self._seq % _SEQ_MASK) + 1
+            return self._seq
 
     def _record_send(self, ep, name, arr):
-        """Cache this round's send for replay; returns its seq."""
-        c = self._round_cache.get(ep)
-        if c is None or c["round"] != self.step:
-            c = {"round": self.step, "grads": {}, "barriered": False}
-            self._round_cache[ep] = c
-        # latest value per name: a round resend replaces, never appends
+        """Cache this round's send for replay; returns its seq.
+        Thread-safe: the batched scatter records from per-endpoint
+        sender threads."""
         seq = self._next_seq()
-        c["grads"][name] = (arr, seq)
+        with self._cache_lock:
+            c = self._round_cache.get(ep)
+            if c is None or c["round"] != self.step:
+                c = {"round": self.step, "grads": {}, "barriered": False}
+                self._round_cache[ep] = c
+            # latest value per name: a round resend replaces, never
+            # appends
+            c["grads"][name] = (arr, seq)
         return seq
 
     def _barrier_payload(self, round_):
@@ -943,14 +1227,112 @@ class RPCClient:
             raise post_send
         return results
 
+    @staticmethod
+    def _to_host(arr):
+        """Materialize a (possibly device-resident) value as numpy —
+        called INSIDE the per-endpoint sender threads, so the d2h
+        conversion of shard k+1 overlaps the in-flight wire send of
+        shard k instead of sitting on the round's critical path."""
+        from paddle_tpu.core.selected_rows import SelectedRows
+
+        if isinstance(arr, SelectedRows):
+            return SelectedRows(np.asarray(arr.rows),
+                                np.asarray(arr.values), arr.height)
+        return np.asarray(arr)
+
     def send_vars(self, triples):
-        """Overlapped sends: [(ep, name, arr)] in flight together
-        (reference grpc_client AsyncSendVar + Wait).  Bulk frames ride
-        the fastwire data plane when the server offers it; the C
-        send loop releases the GIL, so the per-shard threads genuinely
-        overlap."""
+        """Batched overlapped sends: [(ep, name, arr)].  All of a
+        trainer's shards for one endpoint travel as ONE fastwire
+        scatter frame (vectored send, no Python-level join), endpoints
+        in flight together; each sub-frame carries its own (round,
+        sender, seq) identity so replay dedup is unchanged.  Values may
+        still be device arrays — conversion happens in the sender
+        threads.  FLAGS_pserver_wire_batch=0 restores the per-variable
+        wire."""
+        if not FLAGS.pserver_wire_batch:
+            return self._send_vars_unbatched(triples)
+        by_ep = {}
+        for ep, name, arr in triples:
+            by_ep.setdefault(ep, []).append((name, arr))
+        errs = {}
+
+        def one(ep, items):
+            fault_point("send_grad")
+            frames = []
+            for name, arr in items:
+                arr = self._to_host(arr)
+                seq = self._record_send(ep, name, arr)
+                frames.append(_enc_tensor_parts(
+                    name, arr,
+                    _pack_round_sender(self.step, self.sender, seq)))
+            self._send_batch(ep, frames)
+
+        def wrapped(ep, items):
+            try:
+                one(ep, items)
+            except Exception as e:
+                errs[ep] = e
+
+        eps = list(by_ep)
+        if len(eps) == 1:
+            wrapped(eps[0], by_ep[eps[0]])
+        else:
+            ts = [threading.Thread(target=wrapped, args=(ep, by_ep[ep]))
+                  for ep in eps]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join()
+        # same classification as _overlapped(idempotent=False): fatal
+        # first; a fastwire failure AFTER the payload went out must not
+        # become a resend (the server may have applied the frame) and
+        # re-raises after the safe endpoints finished their fallbacks
+        post_send = None
+        fatal = None
+        retry = []
+        for ep, e in sorted(errs.items()):
+            if not RetryPolicy.is_retryable(e):
+                fatal = fatal or e
+            elif getattr(e, "sent_payload", False):
+                post_send = post_send or e
+            else:
+                retry.append(ep)
+        if fatal is not None:
+            raise fatal from post_send
+        for ep in retry:
+            # resend THIS CALL's items — the round cache also holds
+            # earlier send ops' grads (replayed separately via
+            # replay=True), so filtering by it would silently drop any
+            # tensor the failure preempted before recording.  Tensors
+            # that WERE recorded reuse their original (arr, seq), so a
+            # duplicate delivery stays dedup-able.
+            frames = []
+            with self._cache_lock:
+                c = self._round_cache.get(ep)
+                recorded = {} if c is None or c["round"] != self.step \
+                    else dict(c["grads"])
+            for name, arr in by_ep[ep]:
+                if name in recorded:
+                    arr, seq = recorded[name]
+                else:
+                    arr = self._to_host(arr)
+                    seq = self._record_send(ep, name, arr)
+                frames.append(_enc_tensor_parts(
+                    name, arr,
+                    _pack_round_sender(self.step, self.sender, seq)))
+            self._retry_op(ep, "SendVariables",
+                           _join_parts(_enc_batch_parts(frames)),
+                           point="send_grad", replay=True)
+        if post_send is not None:
+            raise post_send
+
+    def _send_vars_unbatched(self, triples):
+        """The per-variable wire (pre-batching behavior; reference
+        grpc_client AsyncSendVar + Wait) — kept for parity testing via
+        FLAGS_pserver_wire_batch=0."""
         payloads = []
         for ep, name, arr in triples:
+            arr = self._to_host(arr)
             seq = self._record_send(ep, name, arr)
             payloads.append(_enc_tensor(
                 name, arr,
@@ -959,20 +1341,144 @@ class RPCClient:
                          [t[0] for t in triples], payloads, replay=True,
                          idempotent=False)
 
+    def _send_batch(self, ep, frames):
+        """One endpoint's batched scatter: fastwire vectored send of
+        the parts (payloads shipped by buffer address), gRPC batched
+        message when the endpoint offers no data plane."""
+        pool = self._fast_pool()
+        if pool is not None:
+            parts = _enc_batch_parts(frames)
+            for _ in range(2):
+                conn = pool.checkout(self._phys(ep))
+                if conn is None:
+                    break
+                try:
+                    conn.call("SendVariables", parts)
+                    pool.checkin(self._phys(ep), conn)
+                    return
+                except ConnectionError as e:
+                    pool.discard(conn)
+                    if getattr(e, "sent_payload", True):
+                        raise
+        self._call(ep, "SendVariables",
+                   _join_parts(_enc_batch_parts(frames)),
+                   timeout=self.retry.call_timeout)
+
     def get_var(self, ep, name, round_=None):
         round_ = self.step if round_ is None else round_
         return self._retry_op(ep, "GetVariable", _enc_msg(name, round_),
                               point="get_param", replay=True, decode=True)
 
-    def get_vars(self, pairs, round_=None):
-        """Overlapped gets: [(ep, name)] -> [arr], one joined wait
-        (reference AsyncGetVar + Wait); fastwire data plane when
-        offered."""
+    def get_vars(self, pairs, round_=None, sinks=None):
+        """Overlapped gets: [(ep, name)] -> [arr] (reference
+        AsyncGetVar + Wait).  Batched per endpoint: one streamed
+        fastwire gather per ep, frames consumed AS THE SERVER COMMITS
+        each shard's apply.  ``sinks[i]``, when given, is called in the
+        receiving thread with the decoded array and its return value
+        replaces it in the result — the recv op uses this to copy
+        slices straight into the preassembled param (no concat pass)
+        while later shards are still on the wire.
+        FLAGS_pserver_wire_batch=0 restores per-variable gets."""
         round_ = self.step if round_ is None else round_
-        replies = self._overlapped(
-            "GetVariable", "get_param", [ep for ep, _ in pairs],
-            [_enc_msg(name, round_) for _, name in pairs], replay=True)
-        return [_dec_tensor(r)[1] for r in replies]
+        if not FLAGS.pserver_wire_batch:
+            replies = self._overlapped(
+                "GetVariable", "get_param", [ep for ep, _ in pairs],
+                [_enc_msg(name, round_) for _, name in pairs],
+                replay=True)
+            out = [_dec_tensor(r)[1] for r in replies]
+            if sinks is not None:
+                out = [s(a) if s is not None else a
+                       for s, a in zip(sinks, out)]
+            return out
+        results = [None] * len(pairs)
+        filled = [False] * len(pairs)
+        by_ep = {}
+        for i, (ep, name) in enumerate(pairs):
+            by_ep.setdefault(ep, []).append((i, name))
+        errs = {}
+
+        def consume(i, arr):
+            sink = sinks[i] if sinks is not None else None
+            results[i] = sink(arr) if sink is not None else arr
+            filled[i] = True
+
+        def one(ep, items):
+            fault_point("get_param")
+            idx_of = {name: i for i, name in items}
+
+            def on_frame(view):
+                name, arr, _ = _dec_tensor(view)
+                consume(idx_of[name], arr)
+
+            if not self._get_batch_fast(ep, [(n, round_) for _, n in
+                                             items], on_frame):
+                # no data plane: one batched gRPC gather
+                payload = _join_parts(_enc_batch_parts(
+                    [[_enc_msg(n, round_)] for _, n in items]))
+                reply = self._call(ep, "GetVariables", payload,
+                                   timeout=self.retry.call_timeout)
+                view = memoryview(reply)
+                off = 0
+                for _ in items:
+                    ln = int.from_bytes(view[off:off + 8], "little")
+                    off += 8
+                    name, arr, _ = _dec_tensor(view[off:off + ln])
+                    off += ln
+                    consume(idx_of[name], arr)
+
+        def wrapped(ep, items):
+            try:
+                one(ep, items)
+            except Exception as e:
+                errs[ep] = e
+
+        eps = list(by_ep)
+        if len(eps) == 1:
+            wrapped(eps[0], by_ep[eps[0]])
+        else:
+            ts = [threading.Thread(target=wrapped, args=(ep, by_ep[ep]))
+                  for ep in eps]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join()
+        for ep, e in sorted(errs.items()):
+            if not RetryPolicy.is_retryable(e):
+                raise e
+        # gets are idempotent: re-fetch whatever is missing through the
+        # sequential retry path (reconnect + round replay)
+        for i, (ep, name) in enumerate(pairs):
+            if not filled[i]:
+                arr = self._retry_op(ep, "GetVariable",
+                                     _enc_msg(name, round_),
+                                     point="get_param", replay=True,
+                                     decode=True)
+                consume(i, arr)
+        return results
+
+    def _get_batch_fast(self, ep, items, on_frame):
+        """Streamed batched gather over fastwire; False -> caller uses
+        gRPC.  A failure after the request went out simply leaves
+        frames unfilled — the caller re-fetches those (reads are always
+        safe to retry)."""
+        pool = self._fast_pool()
+        if pool is None:
+            return False
+        parts = _enc_batch_parts([[_enc_msg(n, r)] for n, r in items])
+        for attempt in range(2):
+            conn = pool.checkout(self._phys(ep))
+            if conn is None:
+                return False
+            try:
+                conn.call_stream("GetVariables", parts, len(items),
+                                 on_frame)
+                pool.checkin(self._phys(ep), conn)
+                return True
+            except ConnectionError as e:
+                pool.discard(conn)
+                if getattr(e, "sent_payload", True):
+                    raise
+        return False
 
     def prefetch_vars(self, triples, round_=None):
         """Overlapped row prefetches: [(ep, block_name, local_ids)] ->
@@ -1011,6 +1517,51 @@ class RPCClient:
         if errs:
             raise errs[0]
         self.step += 1
+
+    def launch_barriers(self, eps):
+        """Full-duplex round: START the SendBarrier RPCs in background
+        threads and advance the local round counter immediately.  The
+        param gets that follow (round step+1) then run concurrently
+        with the in-flight barriers — the server streams each shard as
+        its apply commits while the acks still wait on round
+        durability.  ``join_barriers`` (the trainer's fetch_barrier)
+        collects acks/errors before the next round's sends, preserving
+        the ack-implies-durable contract at the round boundary."""
+        self.join_barriers()   # defensive: never two rounds in flight
+        payload = self._barrier_payload(self.step)
+        round_ = self.step
+        errs = []
+
+        def one(ep):
+            try:
+                self._retry_op(ep, "SendBarrier", payload,
+                               point="send_barrier", replay=True)
+                with self._cache_lock:
+                    c = self._round_cache.get(ep)
+                    if c is not None and c["round"] == round_:
+                        c["barriered"] = True
+            except Exception as e:
+                errs.append(e)
+
+        ts = [threading.Thread(target=one, args=(ep,), daemon=True)
+              for ep in eps]
+        for t in ts:
+            t.start()
+        self._barrier_pending = (ts, errs)
+        self.step += 1
+
+    def join_barriers(self):
+        """Join the overlapped barriers launched by launch_barriers,
+        surfacing the first failure.  No-op when nothing is pending."""
+        pending = self._barrier_pending
+        if pending is None:
+            return
+        ts, errs = pending
+        for t in ts:
+            t.join()
+        self._barrier_pending = None
+        if errs:
+            raise errs[0]
 
     def fetch_barrier(self, eps):
         for ep in eps:

@@ -196,8 +196,13 @@ class DistributeTranspiler:
                                        for b in blocks]},
                 infer_shape=False)
         if sync_mode:
+            # overlap=True: the trainer program's recv ops follow this
+            # barrier, so the host op may LAUNCH the barriers and let
+            # the gets run full-duplex with them — fetch_barrier joins
+            # the acks.  Direct/standalone barriers stay blocking.
             block.append_op(type="send_barrier", inputs={}, outputs={},
-                            attrs={"endpoints": used_eps},
+                            attrs={"endpoints": used_eps,
+                                   "overlap": True},
                             infer_shape=False)
         for p, g in params_grads:
             if p in self.dist_tables:

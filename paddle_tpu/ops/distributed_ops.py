@@ -20,6 +20,7 @@ Wire layout used by the transpiler (fluid/transpiler/distribute_transpiler.py):
 from __future__ import annotations
 
 import os
+import threading
 
 import numpy as np
 
@@ -34,7 +35,11 @@ def _host(name):
     return deco
 
 
-def _read(name, scope, env):
+def _read(name, scope, env, raw=False):
+    """``raw=True`` (the send path) keeps dense values as whatever the
+    executor produced — possibly device arrays: np.asarray moves into
+    the RPC client's sender threads, off the round's critical path.
+    SelectedRows always materialize (the split math below is numpy)."""
     from paddle_tpu.core.selected_rows import SelectedRows
 
     val = (env[name] if env is not None and name in env
@@ -42,7 +47,7 @@ def _read(name, scope, env):
     if isinstance(val, SelectedRows):
         return SelectedRows(np.asarray(val.rows), np.asarray(val.values),
                             val.height)
-    return np.asarray(val)
+    return val if raw else np.asarray(val)
 
 
 def _write(name, val, scope, env):
@@ -92,11 +97,17 @@ def _send(executor, op, scope, feed, env=None):
 
     client = RPCClient.instance()
     name = op.input("X")[0]
-    val = _read(name, scope, env)
+    val = _read(name, scope, env, raw=True)
     eps, sections, names = _check_rpc_route(op)
     starts = _sections_starts(sections)
     from paddle_tpu.core.selected_rows import SelectedRows
 
+    if not isinstance(val, SelectedRows) and len(eps) > 1:
+        # materialize ONCE so the per-endpoint splits below are numpy
+        # VIEWS: slicing the device array instead would dispatch one
+        # device copy per shard (measured ~25 ms per 52 MB slice) on
+        # top of the per-slice d2h
+        val = np.asarray(val)
     triples = []
     for i, (ep, bname) in enumerate(zip(eps, names)):
         if isinstance(val, SelectedRows):
@@ -121,6 +132,56 @@ def _send(executor, op, scope, feed, env=None):
     client.send_vars(triples)
 
 
+class _SliceAssembler:
+    """Assemble a sharded param from its row-slices AS FRAMES ARRIVE:
+    each get-thread copies its slice straight into the preallocated
+    output (one pass, overlapped with the still-in-flight shards)
+    instead of a post-hoc np.concatenate over every part."""
+
+    def __init__(self, sections):
+        self._starts = _sections_starts(sections)
+        self._rows = sum(sections)
+        self._lock = threading.Lock()
+        self.out = None
+        self._fallback = {}
+
+    def sink(self, i):
+        def _sink(arr):
+            from paddle_tpu.distributed.rpc import _aligned_empty
+
+            arr = np.asarray(arr)
+            with self._lock:
+                if self.out is None and arr.ndim >= 1:
+                    # 64-byte aligned: the next step's compiled run
+                    # stages this param ZERO-COPY (jax CPU aliases
+                    # aligned numpy); np.empty would re-copy ~100 MB
+                    # every step
+                    self.out = _aligned_empty(
+                        (self._rows,) + arr.shape[1:], arr.dtype)
+            lo = self._starts[i]
+            if (self.out is not None and arr.ndim >= 1
+                    and arr.shape[0] == self._starts[i + 1] - lo
+                    and arr.shape[1:] == self.out.shape[1:]
+                    and arr.dtype == self.out.dtype):
+                self.out[lo:lo + arr.shape[0]] = arr
+            else:   # odd shard (shape drift): assemble by concat below
+                self._fallback[i] = np.asarray(arr)
+            return True
+        return _sink
+
+    def value(self, n):
+        if not self._fallback and self.out is not None:
+            return self.out
+        parts = []
+        for i in range(n):
+            if i in self._fallback:
+                parts.append(self._fallback[i])
+            else:
+                lo, hi = self._starts[i], self._starts[i + 1]
+                parts.append(self.out[lo:hi])
+        return np.concatenate(parts, axis=0)
+
+
 @_host("recv")
 def _recv(executor, op, scope, feed, env=None):
     from paddle_tpu.distributed.resilience import DeadlineExceeded
@@ -128,24 +189,40 @@ def _recv(executor, op, scope, feed, env=None):
 
     client = RPCClient.instance()
     out = op.output("Out")[0]
-    eps, _sections, names = _check_rpc_route(op)
+    eps, sections, names = _check_rpc_route(op)
     try:
-        parts = client.get_vars(list(zip(eps, names)))
+        if len(eps) == 1:
+            parts = client.get_vars(list(zip(eps, names)))
+            val = parts[0]
+        else:
+            asm = _SliceAssembler(sections)
+            client.get_vars(list(zip(eps, names)),
+                            sinks=[asm.sink(i) for i in range(len(eps))])
+            val = asm.value(len(eps))
     except DeadlineExceeded as e:
         raise _watchdog("recv", sorted(set(eps)), client, e) from e
-    val = parts[0] if len(parts) == 1 else np.concatenate(parts, axis=0)
     _write(out, val, scope, env)
 
 
 @_host("send_barrier")
 def _send_barrier(executor, op, scope, feed, env=None):
-    from paddle_tpu.distributed.resilience import DeadlineExceeded
+    """Sync-round barrier.  With the transpiler's ``overlap`` attr (and
+    FLAGS_pserver_overlap), the barriers are only LAUNCHED here — the
+    recv ops that follow run full-duplex with them, and the trainer's
+    fetch_barrier joins the acks (ack-implies-durable still gates the
+    round boundary).  Without it (direct callers, startup programs,
+    FLAGS off) the call blocks for the acks as before."""
+    from paddle_tpu.distributed.resilience import FLAGS, DeadlineExceeded
     from paddle_tpu.distributed.rpc import RPCClient
 
     client = RPCClient.instance()
     eps = op.attr("endpoints")
+    overlap = bool(op.attr("overlap", False)) and FLAGS.pserver_overlap
     try:
-        client.send_barrier(eps)
+        if overlap:
+            client.launch_barriers(eps)
+        else:
+            client.send_barrier(eps)
     except DeadlineExceeded as e:
         raise _watchdog("send_barrier", eps, client, e) from e
 
@@ -158,6 +235,10 @@ def _fetch_barrier(executor, op, scope, feed, env=None):
     client = RPCClient.instance()
     eps = op.attr("endpoints")
     try:
+        # join the round's overlapped barriers FIRST: their acks imply
+        # the round is applied and durable on every pserver, and any
+        # failure must surface before the next round's sends
+        client.join_barriers()
         client.fetch_barrier(eps)
     except DeadlineExceeded as e:
         raise _watchdog("fetch_barrier", eps, client, e) from e
@@ -182,6 +263,22 @@ def _listen_and_serv(executor, op, scope, feed, env=None):
         gname, bid = item.rsplit(":", 1)
         grad_to_block[gname] = int(bid)
 
+    # grad -> vars its optimize block writes: the server publishes a
+    # per-shard completion event the moment that block commits, so
+    # streamed gathers ship a shard without gating on the whole round
+    grad_params = {}
+    for gname, bid in grad_to_block.items():
+        try:
+            outs = set()
+            for opd in program.blocks[bid].ops:
+                outs.update(n for n in opd.output_arg_names() if n)
+            grad_params[gname] = tuple(sorted(outs))
+        except Exception:
+            # leave the grad UNMAPPED — () would mean "writes nothing"
+            # and defeat the server's unknown-means-invalidate-all
+            # reply-cache fallback
+            pass
+
     sub_exec = ExecutorCore(executor.place)
 
     def apply_block(block_id):
@@ -202,7 +299,8 @@ def _listen_and_serv(executor, op, scope, feed, env=None):
     server = VariableServer(
         scope, grad_to_block, apply_block, fanin, sync_mode,
         checkpoint_dir=ckpt_dir, checkpoint_every_n=ckpt_n,
-        trainer_lease=op.attr("trainer_lease", None))
+        trainer_lease=op.attr("trainer_lease", None),
+        grad_params=grad_params)
     port = server.start(endpoint)
     port_file = op.attr("port_file", "")
     if port_file:

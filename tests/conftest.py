@@ -1,11 +1,29 @@
 """Test env: 8 virtual CPU devices so multi-device SPMD paths are exercised
 without TPU hardware (SURVEY §4.3: reference simulates clusters with fake
-multi-place lists; here a forced host-device mesh plays that role)."""
+multi-place lists; here a forced host-device mesh plays that role).
+
+The platform is FORCED, not defaulted: a rig that exports
+JAX_PLATFORMS=axon (or any accelerator plugin) would otherwise win the
+setdefault and the "CPU-only" suite hangs inside backend init before its
+first test.  Same discipline as __graft_entry__._force_cpu_platform:
+set the env, then pin the already-imported config (and drop any live
+backend) so the selection takes effect regardless of import order."""
 import os
 
 os.environ.setdefault("XLA_FLAGS",
                       "--xla_force_host_platform_device_count=8")
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax
+
+try:
+    import jax.extend.backend
+    # no-op when nothing is initialized; otherwise drops a live
+    # accelerator client created before this conftest ran
+    jax.extend.backend.clear_backends()
+except Exception:
+    pass
+jax.config.update("jax_platforms", "cpu")
 
 import numpy as np
 import pytest

@@ -7,7 +7,9 @@ import sys
 
 os.environ.setdefault("XLA_FLAGS",
                       "--xla_force_host_platform_device_count=8")
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# FORCE cpu (not setdefault): a rig exporting JAX_PLATFORMS=axon would
+# otherwise drag every spawned worker into accelerator-plugin init
+os.environ["JAX_PLATFORMS"] = "cpu"
 
 # spawn children start with a fresh sys.path that lacks the repo root
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))

@@ -1,0 +1,281 @@
+"""Batched/overlapped pserver data plane (ISSUE 4).
+
+Pins the contracts the full-duplex round rests on, in-process (real
+VariableServer + RPCClient over real sockets, no spawned trainers):
+
+- bit-exact dense + sparse round parity between the batched fastwire
+  scatter/gather and the unbatched per-variable wire;
+- idempotence of dropped/duplicated BATCHED frames under the PR 1
+  (round, sender, seq) dedup — replays never skew the sync mean;
+- per-shard completion events: a streamed gather returns a shard the
+  moment ITS apply commits, not when the whole round does;
+- the multi-send-op retry regression: a faulted later send op must
+  resend ITS tensors, not just whatever the round cache already holds;
+- a tier-1 smoke of ``tools/pserver_bench.py --quick`` so data-plane
+  regressions surface in the normal suite.
+"""
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from paddle_tpu.core.scope import Scope
+from paddle_tpu.core.selected_rows import SelectedRows
+from paddle_tpu.distributed.resilience import FLAGS, install_faults
+from paddle_tpu.distributed.rpc import RPCClient, VariableServer
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    install_faults("")
+    prev_batch, prev_overlap = (FLAGS.pserver_wire_batch,
+                                FLAGS.pserver_overlap)
+    yield
+    install_faults("")
+    FLAGS.pserver_wire_batch = prev_batch
+    FLAGS.pserver_overlap = prev_overlap
+    RPCClient.reset()
+
+
+def _sgd_server(scope, grads_to_params, fanin, **kw):
+    """VariableServer whose block b applies SGD(lr=1) for grad b
+    (dense subtract, or scatter-subtract for SelectedRows grads)."""
+    items = list(grads_to_params.items())
+
+    def apply_block(bid):
+        g, p = items[bid]
+        gv = scope.find_var(g)
+        pv = np.array(np.asarray(scope.find_var(p)), copy=True)
+        if isinstance(gv, SelectedRows):
+            np.subtract.at(pv, np.asarray(gv.rows),
+                           np.asarray(gv.values))
+        else:
+            pv -= np.asarray(gv)
+        scope.set(p, pv)
+
+    srv = VariableServer(
+        scope, {g: i for i, (g, _) in enumerate(items)}, apply_block,
+        fanin=fanin, grad_params={g: (p,) for g, p in items}, **kw)
+    port = srv.start("127.0.0.1:0")
+    return srv, "127.0.0.1:%d" % port
+
+
+def _run_rounds(batched, rounds=3):
+    """One trainer pair x N sync rounds against a 2-shard server;
+    returns the fetched param values per round."""
+    FLAGS.pserver_wire_batch = bool(batched)
+    scope = Scope()
+    scope.set("p1", np.zeros((8, 4), np.float32))
+    scope.set("p2", np.zeros((50, 8), np.float32))
+    srv, ep = _sgd_server(scope, {"g1": "p1", "g2": "p2"}, fanin=2)
+    RPCClient.reset()
+    a, b = RPCClient.instance(), RPCClient()
+    fetched = []
+    rng = np.random.RandomState(7)
+    try:
+        for r in range(rounds):
+            for cli, k in ((a, 1.0), (b, 3.0)):
+                rows = np.arange(0, 10, 2, dtype=np.int64) + r
+                vals = (rng.rand(5, 8) * 0 + k).astype(np.float32)
+                cli.send_vars([
+                    (ep, "g1", np.full((8, 4), k * (r + 1), np.float32)),
+                    (ep, "g2", SelectedRows(rows, vals, 50)),
+                ])
+            ts = [threading.Thread(target=c.send_barrier, args=([ep],))
+                  for c in (a, b)]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join()
+            got = a.get_vars([(ep, "p1"), (ep, "p2")])
+            fetched.append([np.array(np.asarray(x), copy=True)
+                            for x in got])
+    finally:
+        a.send_complete([ep])
+        b.send_complete([ep])
+        srv.wait()
+    return fetched
+
+
+def test_batched_matches_unbatched_bit_exact():
+    """Dense + SelectedRows rounds over the batched scatter/gather must
+    be BIT-EXACT against the per-variable wire."""
+    batched = _run_rounds(batched=True)
+    legacy = _run_rounds(batched=False)
+    assert len(batched) == len(legacy)
+    for rb, rl in zip(batched, legacy):
+        for vb, vl in zip(rb, rl):
+            np.testing.assert_array_equal(vb, vl)
+
+
+def test_batched_replay_and_duplicates_are_idempotent():
+    """Duplicated batched frames (client replay after a reconnect) must
+    dedup by (round, sender, seq): the sync mean counts each trainer
+    once no matter how many times its batch lands."""
+    FLAGS.pserver_wire_batch = True
+    scope = Scope()
+    scope.set("p1", np.zeros(4, np.float32))
+    scope.set("p2", np.zeros(3, np.float32))
+    srv, ep = _sgd_server(scope, {"g1": "p1", "g2": "p2"}, fanin=2)
+    RPCClient.reset()
+    a, b = RPCClient.instance(), RPCClient()
+    try:
+        a.send_vars([(ep, "g1", np.full(4, 2.0, np.float32)),
+                     (ep, "g2", np.full(3, 4.0, np.float32))])
+        # duplicate batch + full round replay — what a retry does
+        a.send_vars([(ep, "g1", np.full(4, 2.0, np.float32)),
+                     (ep, "g2", np.full(3, 4.0, np.float32))])
+        a._replay_round(ep)
+        b.send_vars([(ep, "g1", np.full(4, 4.0, np.float32)),
+                     (ep, "g2", np.full(3, 8.0, np.float32))])
+        ts = [threading.Thread(target=c.send_barrier, args=([ep],))
+              for c in (a, b)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        p1, p2 = a.get_vars([(ep, "p1"), (ep, "p2")])
+        np.testing.assert_allclose(np.asarray(p1), np.full(4, -3.0))
+        np.testing.assert_allclose(np.asarray(p2), np.full(3, -6.0))
+    finally:
+        a.send_complete([ep])
+        b.send_complete([ep])
+        srv.wait()
+
+
+def test_faulted_send_op_resends_its_own_tensors():
+    """Regression: with an earlier send op's grads already in the round
+    cache, a FAULTED later send op must resend ITS tensors — filtering
+    the retry by the cache silently dropped them (the shard then missed
+    the round entirely and parity broke under fault injection)."""
+    FLAGS.pserver_wire_batch = True
+    scope = Scope()
+    scope.set("p1", np.zeros(4, np.float32))
+    scope.set("p2", np.zeros(3, np.float32))
+    srv, ep = _sgd_server(scope, {"g1": "p1", "g2": "p2"}, fanin=1)
+    RPCClient.reset()
+    cli = RPCClient.instance()
+    try:
+        # send op 1 lands; send op 2 is dropped once and must retry
+        cli.send_vars([(ep, "g1", np.full(4, 2.0, np.float32))])
+        install_faults("send_grad:drop:1.0:1")
+        cli.send_vars([(ep, "g2", np.full(3, 5.0, np.float32))])
+        cli.send_barrier([ep])
+        p1, p2 = cli.get_vars([(ep, "p1"), (ep, "p2")])
+        np.testing.assert_allclose(np.asarray(p1), np.full(4, -2.0))
+        np.testing.assert_allclose(np.asarray(p2), np.full(3, -5.0))
+    finally:
+        install_faults("")
+        cli.send_complete([ep])
+        srv.wait()
+
+
+def test_streamed_gather_returns_shard_before_round_completes():
+    """Per-shard completion events: with shard g2's optimize block
+    artificially slow, a batched get of (p1, p2) receives p1 while g2
+    is still applying — the gather no longer gates on the whole round."""
+    FLAGS.pserver_wire_batch = True
+    scope = Scope()
+    scope.set("p1", np.zeros(4, np.float32))
+    scope.set("p2", np.zeros(3, np.float32))
+    slow = threading.Event()
+    t_first = {}
+
+    def apply_block(bid):
+        if bid == 0:        # g1 -> p1: instant
+            scope.set("p1", np.asarray(scope.find_var("p1"))
+                      - np.asarray(scope.find_var("g1")))
+        else:               # g2 -> p2: slow
+            slow.set()
+            time.sleep(0.8)
+            scope.set("p2", np.asarray(scope.find_var("p2"))
+                      - np.asarray(scope.find_var("g2")))
+
+    srv = VariableServer(scope, {"g1": 0, "g2": 1}, apply_block,
+                         fanin=1, grad_params={"g1": ("p1",),
+                                               "g2": ("p2",)})
+    ep = "127.0.0.1:%d" % srv.start("127.0.0.1:0")
+    RPCClient.reset()
+    cli = RPCClient.instance()
+    try:
+        cli.send_vars([(ep, "g1", np.ones(4, np.float32)),
+                       (ep, "g2", np.ones(3, np.float32))])
+        bt = threading.Thread(target=cli.send_barrier, args=([ep],))
+        bt.start()
+
+        def sink(name):
+            def _s(arr):
+                t_first[name] = time.time()
+                return np.array(np.asarray(arr), copy=True)
+            return _s
+
+        t0 = time.time()
+        p1, p2 = cli.get_vars([(ep, "p1"), (ep, "p2")], round_=1,
+                              sinks=[sink("p1"), sink("p2")])
+        bt.join()
+        np.testing.assert_allclose(p1, np.full(4, -1.0))
+        np.testing.assert_allclose(p2, np.full(3, -1.0))
+        # p1 streamed while g2's apply was still sleeping
+        assert t_first["p1"] - t0 < 0.6
+        assert t_first["p2"] - t_first["p1"] > 0.3
+    finally:
+        cli.send_complete([ep])
+        srv.wait()
+
+
+def test_overlapped_barriers_join_surfaces_errors():
+    """launch_barriers + join_barriers: the ack (and any failure) of an
+    overlapped barrier lands at the join, and the round counter has
+    already advanced so the in-flight gets name the right round."""
+    FLAGS.pserver_wire_batch = True
+    scope = Scope()
+    scope.set("p1", np.zeros(2, np.float32))
+    srv, ep = _sgd_server(scope, {"g1": "p1"}, fanin=1)
+    RPCClient.reset()
+    cli = RPCClient.instance()
+    try:
+        cli.send_vars([(ep, "g1", np.ones(2, np.float32))])
+        step_before = cli.step
+        cli.launch_barriers([ep])
+        assert cli.step == step_before + 1
+        got, = cli.get_vars([(ep, "p1")])
+        cli.join_barriers()
+        np.testing.assert_allclose(np.asarray(got), np.full(2, -1.0))
+        # the ack implied durability: the server finished the round
+        assert srv._durable_round == cli.step
+    finally:
+        cli.send_complete([ep])
+        srv.wait()
+
+
+def test_quick_bench_smoke():
+    """tools/pserver_bench.py --quick completes in seconds and reports
+    sane round-throughput machinery fields (tier-1 guard: a data-plane
+    regression that stalls or crashes the round shows up here)."""
+    out = os.path.join(os.environ.get("TMPDIR", "/tmp"),
+                       "psb_quick_%d.json" % os.getpid())
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "pserver_bench.py"),
+         "--quick", "--json", out, "--no-floor"],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=240)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    with open(out) as f:
+        rec = json.loads(f.read())
+    os.unlink(out)
+    assert rec["metric"] == "pserver_bench"
+    assert rec["quick"] is True
+    assert rec["dense_rounds_per_sec"] > 0
+    assert rec["sparse_steps_per_sec"] > 0
+    assert rec["dense_round_ms"] > 0
+    assert rec["pservers"] == 2 and rec["trainers"] == 2
+    # the stdout artifact is the same single JSON line
+    line = [l for l in proc.stdout.splitlines() if l.strip()][-1]
+    assert json.loads(line)["metric"] == "pserver_bench"

@@ -28,7 +28,10 @@ import time
 
 os.environ.setdefault("XLA_FLAGS",
                       "--xla_force_host_platform_device_count=2")
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# FORCE cpu (not setdefault): the pserver bench is a host-path benchmark
+# by definition; a rig-exported JAX_PLATFORMS must not pull in a (maybe
+# dead) accelerator tunnel
+os.environ["JAX_PLATFORMS"] = "cpu"
 
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 if _REPO not in sys.path:
@@ -36,12 +39,17 @@ if _REPO not in sys.path:
 
 import numpy as np
 
-# dense: 4096 x 6400 f32 = 104.9 MB parameter
-DENSE_IN, DENSE_OUT = 4096, 6400
+# dense: 4096 x 6400 f32 = 104.9 MB parameter.  Env-overridable (not
+# argv): spawn children re-import this module fresh, so the quick-mode
+# dims must travel through the environment to reach them.
+DENSE_IN = int(os.environ.get("PSB_DENSE_IN", "4096"))
+DENSE_OUT = int(os.environ.get("PSB_DENSE_OUT", "6400"))
 DENSE_BATCH = 8
 # sparse: 1M x 64 embedding, 1024 samples x 4 ids per step
-VOCAB, EMB_DIM = 1_000_000, 64
-SPARSE_BATCH, IDS_PER_SAMPLE = 1024, 4
+VOCAB = int(os.environ.get("PSB_VOCAB", "1000000"))
+EMB_DIM = 64
+SPARSE_BATCH = int(os.environ.get("PSB_SPARSE_BATCH", "1024"))
+IDS_PER_SAMPLE = 4
 
 
 def build_model(kind):
@@ -186,7 +194,102 @@ def bench(kind, steps, n_pservers=2, n_trainers=2, base_port=19310):
     return steps / dt
 
 
-def main():
+def component_floor():
+    """Measure the round's component floors on THIS machine: the
+    fastwire echo (wire both ways), the batched frame encode+decode,
+    and the server's aggregate+SGD — so the headline number comes with
+    its decomposition instead of a guess."""
+    from paddle_tpu.distributed import fastwire
+    from paddle_tpu.distributed.rpc import (_dec_tensor,
+                                            _enc_tensor_parts,
+                                            _iter_batch, _enc_batch_parts,
+                                            _aligned_empty)
+
+    floor = {}
+    param = np.ones((DENSE_IN, DENSE_OUT), np.float32)
+    mb = param.nbytes / 1e6
+
+    # batched frame encode (parts, no join) + zero-copy decode over a
+    # received-style buffer.  The join below happens OUTSIDE the timer:
+    # the wire never pays it (vectored send / recv-into-one-buffer) —
+    # this floor is the actual per-round framing overhead
+    parts = _enc_batch_parts([_enc_tensor_parts("w", param)])
+    joined = b"".join(bytes(p) if isinstance(p, bytes) else p.tobytes()
+                      for p in parts)
+    view = memoryview(joined)
+    t0 = time.perf_counter()
+    _enc_batch_parts([_enc_tensor_parts("w", param)])
+    for frame in _iter_batch(view):
+        _dec_tensor(frame)
+    floor["enc_dec_%dmb_s" % round(mb)] = round(
+        time.perf_counter() - t0, 4)
+
+    if fastwire.native_available():
+        import socket as _s
+        s = _s.socket()
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+        s.close()
+        srv = fastwire.FastServer(port, {"SendVariable": lambda req: req},
+                                  addr="127.0.0.1")
+        pool = fastwire.FastConnPool(0)
+        conn = pool.checkout("127.0.0.1:%d" % port)
+        if conn is not None:
+            payload = _enc_tensor_parts("w", param)
+            conn.call("SendVariable", payload)      # warm
+            t0 = time.perf_counter()
+            conn.call("SendVariable", payload)
+            dt = time.perf_counter() - t0
+            floor["echo_roundtrip_%dmb_s" % round(mb)] = round(dt, 3)
+            floor["echo_mb_per_sec_both_ways"] = round(2 * mb / dt, 0)
+            pool.discard(conn)
+        srv.stop()
+
+    # server aggregate (2-trainer mean into an aligned buffer) + SGD
+    import jax
+    g0, g1 = param, param
+    w = jax.device_put(param).block_until_ready()
+    sgd = jax.jit(lambda w, g: w - 0.01 * g)
+    sgd(w, param).block_until_ready()               # warm/compile
+    t0 = time.perf_counter()
+    agg = _aligned_empty(param.shape, param.dtype)
+    np.add(g0, g1, out=agg)
+    agg *= 0.5
+    sgd(w, agg).block_until_ready()
+    floor["server_aggregate_plus_sgd_s"] = round(
+        time.perf_counter() - t0, 3)
+    return floor
+
+
+def main(argv=None):
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        description="pserver round-throughput benchmark "
+                    "(2x2 localhost, real transpiled programs)")
+    ap.add_argument("--quick", action="store_true",
+                    help="small param + few rounds: a seconds-scale "
+                    "smoke of the full data plane (CI tier-1)")
+    ap.add_argument("--json", metavar="PATH", default=None,
+                    help="also write the JSON line to PATH")
+    ap.add_argument("--no-floor", action="store_true",
+                    help="skip the component-floor measurements")
+    args = ap.parse_args(argv)
+
+    if args.quick:
+        # must be exported BEFORE bench() spawns: children re-import
+        # this module and re-derive the model dims from the env
+        os.environ.setdefault("PSB_DENSE_IN", "1024")
+        os.environ.setdefault("PSB_DENSE_OUT", "1600")
+        os.environ.setdefault("PSB_VOCAB", "50000")
+        os.environ.setdefault("PSB_SPARSE_BATCH", "256")
+        os.environ.setdefault("PSB_DENSE_STEPS", "3")
+        os.environ.setdefault("PSB_SPARSE_STEPS", "3")
+        global DENSE_IN, DENSE_OUT, VOCAB, SPARSE_BATCH
+        DENSE_IN = int(os.environ["PSB_DENSE_IN"])
+        DENSE_OUT = int(os.environ["PSB_DENSE_OUT"])
+        VOCAB = int(os.environ["PSB_VOCAB"])
+        SPARSE_BATCH = int(os.environ["PSB_SPARSE_BATCH"])
     dense_steps = int(os.environ.get("PSB_DENSE_STEPS", "20"))
     sparse_steps = int(os.environ.get("PSB_SPARSE_STEPS", "50"))
 
@@ -205,6 +308,7 @@ def main():
     round_ms = 1000.0 / dense_rps
     out = {
         "metric": "pserver_bench",
+        "quick": bool(args.quick),
         "dense_param_mb": round(dense_mb, 1),
         "dense_rounds_per_sec": round(dense_rps, 2),
         "dense_wire_mb_per_sec": round(wire_mb_s, 1),
@@ -217,7 +321,16 @@ def main():
         # step overlapped 1:1 with a sync round of this 100 MB model
         "fraction_of_chip_step": round(round_ms / 100.0, 2),
     }
-    print(json.dumps(out))
+    if not args.no_floor:
+        try:
+            out["component_floor"] = component_floor()
+        except Exception as e:   # floors are evidence, not the metric
+            out["component_floor_error"] = str(e)[:200]
+    line = json.dumps(out)
+    print(line)
+    if args.json:
+        with open(args.json, "w") as f:
+            f.write(line + "\n")
 
 
 if __name__ == "__main__":
