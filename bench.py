@@ -11,6 +11,17 @@ Accelerator runs default to bf16 mixed precision (Float16Transpiler —
 the TPU analog of reference paddle/contrib/float16/float16_transpiler.py)
 at batch 256; BENCH_AMP=0 / BENCH_BATCH override.
 
+Convnet layout/fusion knobs (ISSUE 5; see README "Convolution layout &
+fusion"): BENCH_LAYOUT=NHWC runs the LayoutTranspiler pipeline (NHWC
+end-to-end, HWIO-pinned weights, Pallas fused conv stages;
+BENCH_FUSED_STAGES=0 for the layout pass alone), BENCH_DEPTH overrides
+the ResNet depth, and FLAGS_xla_latency_hiding_scheduler=1 /
+FLAGS_xla_extra_flags="..." plumb XLA scheduler experiments — applied
+before backend init and recorded in the JSON (xla_flags) plus the
+executor compile-cache key.  The headline JSON carries data_format,
+fused_stages and (under BENCH_PROFILE) xplane-sourced per_category_ms
+so every BENCH_*.json row names the experiment that produced it.
+
 Prints ONE json line:
   {"metric": ..., "value": N, "unit": "images/sec", "vs_baseline": N,
    "tflops": N, "mfu": N, "amp": bool}
@@ -309,6 +320,13 @@ def main():
         raise SystemExit(
             "BENCH_MODEL must be resnet50|resnet32|vgg|transformer|"
             "lstm|alexnet|googlenet, got %r" % model_name)
+    # Scheduler-flag knobs (ISSUE 5 lever c) must hit XLA_FLAGS BEFORE
+    # the first backend touch (the liveness probe below initializes
+    # jax); FLAGS_xla_latency_hiding_scheduler=1 / FLAGS_xla_extra_flags
+    # env vars flow through the flag registry into apply_xla_flags, and
+    # the same values ride the executor compile-cache key.
+    from paddle_tpu.core.flags import FLAGS, apply_xla_flags
+    xla_tokens = apply_xla_flags()
     on_accel = False
     try:
         import jax
@@ -345,7 +363,6 @@ def main():
     uint8_input = not use_fake and model_name == "resnet50"
 
     import paddle_tpu.fluid as fluid
-    from paddle_tpu.core.flags import FLAGS
     from paddle_tpu.models import alexnet, googlenet, resnet, vgg
 
     # measured knobs (see PROFILE_r04.md for the numbers behind the
@@ -354,6 +371,18 @@ def main():
         FLAGS.bn_bf16 = True
     if os.environ.get("BENCH_NHWC", "0") == "1":
         FLAGS.conv_nhwc = True
+    # ISSUE 5 levers a/b: the layout-pinned NHWC pipeline + Pallas
+    # fused conv stages (models/resnet.py runs the LayoutTranspiler
+    # pre-minimize when the flag says NHWC).  BENCH_LAYOUT=NHWC /
+    # BENCH_FUSED_STAGES=0 control them; FLAGS_conv_layout env works
+    # too.  NCHW default — the bisection baseline.
+    data_format = os.environ.get("BENCH_LAYOUT", FLAGS.conv_layout or
+                                 "NCHW").upper()
+    FLAGS.conv_layout = data_format
+    if os.environ.get("BENCH_FUSED_STAGES") is not None:
+        FLAGS.conv_fused_stages = \
+            os.environ["BENCH_FUSED_STAGES"] == "1"
+    bench_depth = int(os.environ.get("BENCH_DEPTH", "0"))
 
     main_prog, startup = fluid.Program(), fluid.Program()
     with fluid.program_guard(main_prog, startup):
@@ -373,8 +402,9 @@ def main():
             avg_cost, (data, label), (acc,) = mod.get_model()
         else:
             avg_cost, (data, label), (acc,) = resnet.get_model(
-                data_set=data_set, depth=50 if model_name == "resnet50"
-                else 32,
+                data_set=data_set,
+                depth=bench_depth or (50 if model_name == "resnet50"
+                                      else 32),
                 input_dtype="uint8" if uint8_input else "float32")
     if amp:
         fluid.transpiler.Float16Transpiler().transpile(main_prog)
@@ -522,6 +552,7 @@ def main():
         elapsed = time.time() - t0
     if prepared is not None:
         prepared.sync_scope()
+    per_category_ms = None
     if profile_dir:
         import glob
 
@@ -533,7 +564,15 @@ def main():
             stdout, sys.stdout = sys.stdout, sys.stderr
             try:
                 print("category profile (%s):" % pbs[-1])
-                print_category_profile(pbs[-1])
+                cats = print_category_profile(pbs[-1])
+                # xplane-sourced per-category device ms for the headline
+                # JSON (ISSUE 5): where the step's bytes actually go —
+                # the "data formatting" row is lever (a)'s target
+                per_category_ms = {
+                    c["category"]: round(c["time_ps"] / 1e9, 1)
+                    for c in cats[:8]}
+            except Exception as e:  # profile parse never sinks the bench
+                per_category_ms = {"error": str(e)[:120]}
             finally:
                 sys.stdout = stdout
 
@@ -637,7 +676,24 @@ def main():
         "step_wall_ms": round(elapsed / iters * 1e3, 3),
         "step_host_ms": round(t_host / iters * 1e3, 3),
         "host_overhead_frac": round(t_host / max(elapsed, 1e-9), 4),
+        # ISSUE 5 lever evidence: layout, fused stage count and the
+        # scheduler flags the run compiled under — BENCH_*.json rows
+        # are self-describing experiments, not env archaeology.
+        # data_format reflects the PROGRAM (only models that honor
+        # FLAGS_conv_layout transpile; vgg/alexnet/googlenet stay NCHW)
+        "data_format": ("NHWC" if any(
+            op.attr("data_format", op.attr("data_layout", "NCHW"))
+            == "NHWC" for op in main_prog.desc.blocks[0].ops)
+            else "NCHW"),
+        "fused_stages": sum(
+            1 for op in main_prog.desc.blocks[0].ops
+            if op.type == "fused_conv2d_bn_act"),
+        "xla_flags": xla_tokens,
     }
+    if per_category_ms:
+        out["per_category_ms"] = per_category_ms
+    if bench_depth:
+        out["depth"] = bench_depth  # non-default model size: mark it
     if not use_fake:
         out["device_cached"] = device_cached
     # 224x224 only: that's what the analytic FLOP counts are for

@@ -137,7 +137,12 @@ def _cache_key(program, block_id, feed_spec, fetch_list, mode):
             bool(FLAGS.auto_layout),
             # read at trace time (_amp_cast_ins / conv2d lowering)
             bool(FLAGS.bn_bf16), bool(FLAGS.conv_nhwc),
-            str(FLAGS.matmul_precision))
+            str(FLAGS.matmul_precision),
+            # scheduler-flag experiments must recompile, never reuse a
+            # stale executable (ISSUE 5 lever c; see flags.py
+            # apply_xla_flags for the process-lifetime caveat)
+            bool(FLAGS.xla_latency_hiding_scheduler),
+            str(FLAGS.xla_extra_flags))
 
 
 class _CacheEntry:
@@ -804,6 +809,11 @@ class ExecutorCore:
             jit_kwargs["out_shardings"] = (
                 tuple(repl for _ in fetch_list),
                 tuple(shard_of(n) for n in persist_outs))
+        # Scheduler-flag knobs (FLAGS_xla_*): best-effort late application
+        # — a no-op once a backend exists; bench.py applies them before
+        # backend init, which is the supported path (MIGRATION.md).
+        from .flags import apply_xla_flags
+        apply_xla_flags()
         # Pin trace/compile/execute to the place's device: with zero inputs
         # (every startup program) nothing else commits the computation, and
         # jit would otherwise compile for the process-default backend — e.g.

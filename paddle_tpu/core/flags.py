@@ -58,6 +58,30 @@ def define_flag(name, default, help=""):
     FLAGS.define(name, default, help)
 
 
+def apply_xla_flags():
+    """Materialize the FLAGS_xla_* scheduler knobs into XLA_FLAGS.
+
+    XLA parses XLA_FLAGS exactly once, at first backend creation, so
+    call this BEFORE the first jax device touch (bench.py does; the
+    executor calls it defensively at first compile).  Returns the tokens
+    applied.  The same values ride the executor compile-cache key, so an
+    in-process flag flip can never serve a stale executable — but it
+    still needs a fresh process to reach XLA itself (MIGRATION.md)."""
+    tokens = []
+    if FLAGS.xla_latency_hiding_scheduler:
+        tokens.append("--xla_tpu_enable_latency_hiding_scheduler=true")
+    if FLAGS.xla_extra_flags:
+        tokens.extend(str(FLAGS.xla_extra_flags).split())
+    if not tokens:
+        return []
+    cur = os.environ.get("XLA_FLAGS", "")
+    have = set(cur.split())
+    missing = [t for t in tokens if t not in have]
+    if missing:
+        os.environ["XLA_FLAGS"] = (cur + " " + " ".join(missing)).strip()
+    return tokens
+
+
 # core runtime flags (reference analogs cited above)
 define_flag("check_nan_inf", False,
             "run blocks op-by-op and raise on the first op producing "
@@ -87,6 +111,34 @@ define_flag("matmul_precision", "",
             "matmuls on TPU).  The TPU analog of the reference's "
             "cuDNN math-mode control; see MIGRATION.md 'float32 "
             "matmul precision on TPU'")
+define_flag("conv_layout", "NCHW",
+            "convnet pipeline layout: 'NCHW' (reference contract; the "
+            "default) or 'NHWC' — models that honor the flag (e.g. "
+            "models/resnet.py get_model) run the LayoutTranspiler NHWC "
+            "pass: data_format propagated through conv/pool/bn/"
+            "elementwise chains, conv weights pinned HWIO at creation, "
+            "and conv+BN+act stages fused into the Pallas conv-stage "
+            "kernel.  Acts at PROGRAM BUILD time (get_model) — flip it "
+            "before building, not on a built program; the NCHW program "
+            "stays selectable for bisection")
+define_flag("conv_fused_stages", True,
+            "with conv_layout=NHWC, also run FuseConvBNActPass "
+            "(conv+BN(+residual)(+relu) -> fused_conv2d_bn_act backed "
+            "by kernels/conv_fused.py); off = layout pass alone, for "
+            "attributing wins between the two levers")
+define_flag("xla_latency_hiding_scheduler", False,
+            "enable XLA's latency-hiding scheduler "
+            "(--xla_tpu_enable_latency_hiding_scheduler): overlaps "
+            "async copies/collectives with compute when scheduling "
+            "fusions.  Applied to XLA_FLAGS by apply_xla_flags() "
+            "(bench.py calls it before backend init; flipping it in a "
+            "live process needs a restart — XLA parses XLA_FLAGS once) "
+            "and part of the executor compile-cache key")
+define_flag("xla_extra_flags", "",
+            "extra raw XLA_FLAGS tokens appended by apply_xla_flags() "
+            "(e.g. '--xla_tpu_enable_async_collective_fusion=true'); "
+            "reproducible-experiment plumbing for scheduler knobs — "
+            "part of the executor compile-cache key")
 define_flag("auto_layout", False,
             "single-device accelerator path: AOT-compile with XLA-chosen "
             "(AUTO) parameter layouts and keep persistable buffers in "

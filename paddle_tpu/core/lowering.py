@@ -71,6 +71,20 @@ def _amp_cast_ins(op_type, ins, role=0):
     if role & _OPTIMIZE_ROLE:
         # parameter updates / lr arithmetic stay fp32 (master weights)
         return ins
+    if op_type == "fused_conv2d_bn_act":
+        # MXU operands (Input/Filter/Residual) go bf16; the BN parameter
+        # slots (Scale/Bias/Mean/Variance) keep their stored dtype — the
+        # lowering computes statistics from f32 partials regardless
+        mxu_slots = ("Input", "Filter", "Residual")
+
+        def conv_slot(slot, x):
+            if slot in mxu_slots and x is not None and \
+                    getattr(x, "dtype", None) == jnp.float32:
+                return x.astype(jnp.bfloat16)
+            return x
+
+        return Ins({s: [conv_slot(s, v) for v in vs]
+                    for s, vs in ins._d.items()})
     if op_type in AMP_WHITE:
         if op_type == "elementwise_add":
             # only activation-shaped adds (bias/residual): scalar or [1]

@@ -2,10 +2,11 @@
 from .distribute_transpiler import DistributeTranspiler, slice_variable  # noqa: F401
 from .float16_transpiler import Float16Transpiler  # noqa: F401
 from .inference_transpiler import InferenceTranspiler  # noqa: F401
+from .layout_transpiler import LayoutTranspiler  # noqa: F401
 from .memory_optimization_transpiler import (  # noqa: F401
     memory_optimize, release_memory)
 from .ps_dispatcher import RoundRobin, HashName, PSDispatcher  # noqa: F401
 
 __all__ = ["DistributeTranspiler", "slice_variable", "Float16Transpiler",
-           "InferenceTranspiler", "memory_optimize", "release_memory",
-           "RoundRobin", "HashName", "PSDispatcher"]
+           "InferenceTranspiler", "LayoutTranspiler", "memory_optimize",
+           "release_memory", "RoundRobin", "HashName", "PSDispatcher"]

@@ -4,5 +4,7 @@ paddle/function rewrite targets).  Every kernel has an XLA fallback —
 ``interpret=True`` paths keep CPU tests exact."""
 from .flash_attention import flash_attention  # noqa: F401
 from .fused import fused_softmax_cross_entropy  # noqa: F401
+from .conv_fused import conv2d_nhwc  # noqa: F401
 
-__all__ = ["flash_attention", "fused_softmax_cross_entropy"]
+__all__ = ["flash_attention", "fused_softmax_cross_entropy",
+           "conv2d_nhwc"]

@@ -90,7 +90,8 @@ def resnet_cifar10(input, class_dim, depth=32, is_test=False):
 
 
 def get_model(data_set="flowers", depth=50, learning_rate=0.01,
-              is_test=False, input_dtype="float32"):
+              is_test=False, input_dtype="float32", data_format=None,
+              fused_stages=None):
     """Build train graph; (avg_cost, [input, label], [batch_acc]).
 
     data_set 'cifar10' → 32×32/10-way resnet_cifar10; 'flowers'/'imagenet'
@@ -101,7 +102,21 @@ def get_model(data_set="flowers", depth=50, learning_rate=0.01,
     (the reference normalizes on host CPU before the feed,
     image/image.py; over a narrow host link shipping uint8 and
     normalizing on device is the same math at a quarter the traffic).
+
+    data_format None → ``FLAGS.conv_layout``; 'NHWC' runs the
+    LayoutTranspiler on the built graph BEFORE backward generation: NHWC
+    pinned end-to-end, weights stored HWIO, and (fused_stages, default
+    ``FLAGS.conv_fused_stages``) conv+BN+act stages fused into the
+    Pallas conv-stage op.  The feed contract stays NCHW — one transpose
+    bridges the feed into the pinned domain.
     """
+    from paddle_tpu.core.flags import FLAGS
+
+    if data_format is None:
+        data_format = FLAGS.conv_layout or "NCHW"
+    if fused_stages is None:
+        fused_stages = bool(FLAGS.conv_fused_stages)
+
     if data_set == "cifar10":
         class_dim, dshape, model = 10, [3, 32, 32], resnet_cifar10
         kwargs = {"depth": 32 if depth == 50 else depth}
@@ -120,6 +135,14 @@ def get_model(data_set="flowers", depth=50, learning_rate=0.01,
     cost = fluid.layers.cross_entropy(input=predict, label=label)
     avg_cost = fluid.layers.mean(cost)
     batch_acc = fluid.layers.accuracy(input=predict, label=label)
+    if data_format == "NHWC":
+        # before minimize: backward then differentiates the pinned
+        # forward, so filter grads / optimizer state are HWIO too
+        from paddle_tpu.fluid.transpiler import LayoutTranspiler
+        LayoutTranspiler().transpile(
+            fluid.default_main_program(),
+            startup_program=fluid.default_startup_program(),
+            data_format="NHWC", fuse_stages=fused_stages)
     if not is_test:
         opt = fluid.optimizer.Momentum(learning_rate=learning_rate,
                                        momentum=0.9)

@@ -24,29 +24,37 @@ def _pair(v):
 def _conv_lower(ctx, ins, attrs, op):
     from paddle_tpu.core.flags import FLAGS
 
-    x = ins["Input"]        # NCHW (the fluid layout contract)
-    w = ins["Filter"]       # OIHW (I = C/groups)
+    x = ins["Input"]
+    w = ins["Filter"]
     strides = _pair(attrs.get("strides", [1, 1]))
     paddings = _pair(attrs.get("paddings", [0, 0]))
     dilations = _pair(attrs.get("dilations", [1, 1]))
     groups = attrs.get("groups", 1)
-    # conv_nhwc: compute in the MXU's preferred layout; the NCHW<->NHWC
-    # transposes at the op boundary cancel across adjacent conv/
-    # elementwise chains in XLA's layout pass
-    dn = ("NHWC", "HWIO", "NHWC") if FLAGS.conv_nhwc else \
-        ("NCHW", "OIHW", "NCHW")
-    if FLAGS.conv_nhwc:
+    # Layout-pinned path (layout_transpiler): input travels NHWC and the
+    # filter parameter is STORED in the kernel-preferred layout, so the
+    # conv consumes both as-is — no transposes at the op boundary and no
+    # re-layout traffic for XLA to re-insert per fusion.
+    data_format = attrs.get("data_format", "NCHW")
+    filter_format = attrs.get("filter_format",
+                              "HWIO" if data_format == "NHWC" else "OIHW")
+    if data_format == "NCHW" and FLAGS.conv_nhwc:
+        # legacy per-op experiment (PROFILE_r04.md): transpose at the op
+        # boundary and let XLA cancel adjacent pairs; kept for bisection
+        data_format, filter_format = "NHWC", "HWIO"
         x = jnp.transpose(x, (0, 2, 3, 1))
         w = jnp.transpose(w, (2, 3, 1, 0))
+        retranspose = True
+    else:
+        retranspose = False
     out = jax.lax.conv_general_dilated(
         x, w,
         window_strides=strides,
         padding=[(paddings[0], paddings[0]), (paddings[1], paddings[1])],
         rhs_dilation=dilations,
-        dimension_numbers=dn,
+        dimension_numbers=(data_format, filter_format, data_format),
         feature_group_count=groups,
         preferred_element_type=jnp.result_type(x, w))
-    if FLAGS.conv_nhwc:
+    if retranspose:
         out = jnp.transpose(out, (0, 3, 1, 2))
     return {"Output": out}
 
@@ -97,26 +105,40 @@ def _conv2d_transpose(ctx, ins, attrs, op):
 
 @register_op("pool2d")
 def _pool2d(ctx, ins, attrs, op):
-    x = ins["X"]  # NCHW
+    x = ins["X"]
     ptype = attrs.get("pooling_type", "max")
     ksize = _pair(attrs.get("ksize", [2, 2]))
     strides = _pair(attrs.get("strides", [1, 1]))
     paddings = _pair(attrs.get("paddings", [0, 0]))
+    # spatial dims by layout: NCHW (fluid default) or NHWC (pinned by
+    # the layout transpiler — pooling then never forces a re-layout
+    # between the surrounding NHWC conv fusions)
+    nhwc = attrs.get("data_format", "NCHW") == "NHWC"
+    hd, wd_ = (1, 2) if nhwc else (2, 3)
     if attrs.get("global_pooling", False):
-        ksize = [x.shape[2], x.shape[3]]
+        ksize = [x.shape[hd], x.shape[wd_]]
         paddings = [0, 0]
         strides = [1, 1]
     if attrs.get("adaptive", False):
         # adaptive pooling to ksize output bins
         oh, ow = ksize
+        red = jnp.max if ptype == "max" else jnp.mean
+        if nhwc:
+            n, h, w_, c = x.shape
+            x4 = x.reshape(n, oh, h // oh, ow, w_ // ow, c)
+            return {"Out": red(x4, axis=(2, 4))}
         n, c, h, w_ = x.shape
         x4 = x.reshape(n, c, oh, h // oh, ow, w_ // ow)
-        red = jnp.max if ptype == "max" else jnp.mean
         return {"Out": red(x4, axis=(3, 5))}
-    window = (1, 1, ksize[0], ksize[1])
-    strides4 = (1, 1, strides[0], strides[1])
-    pads4 = ((0, 0), (0, 0), (paddings[0], paddings[0]),
-             (paddings[1], paddings[1]))
+    window = [1, 1, 1, 1]
+    strides4 = [1, 1, 1, 1]
+    pads4 = [(0, 0), (0, 0), (0, 0), (0, 0)]
+    window[hd], window[wd_] = ksize[0], ksize[1]
+    strides4[hd], strides4[wd_] = strides[0], strides[1]
+    pads4[hd] = (paddings[0], paddings[0])
+    pads4[wd_] = (paddings[1], paddings[1])
+    window, strides4 = tuple(window), tuple(strides4)
+    pads4 = tuple(pads4)
     # NOTE: init values must be Python scalars so JAX recognizes the
     # max/add monoids and lowers to the differentiable reduce-window prims.
     if ptype == "max":
@@ -258,6 +280,172 @@ register_op("dropout", lower=_dropout_lower, stateful=True,
 @register_op("dropout_grad", grad_maker=None)
 def _dropout_grad(ctx, ins, attrs, op):
     return {"X@GRAD": ins["Out@GRAD"] * ins["Mask"]}
+
+
+# ---------------------------------------------------------------------------
+# Fused conv+BN(+residual)(+act) stage (NHWC/HWIO) — the Pallas conv-stage
+# op the layout transpiler's FuseConvBNActPass emits for the ResNet 7x7
+# stem and 3x3 residual stages.  Training forward fuses the BN statistics
+# into the conv epilogue (kernels/conv_fused.py); the backward is an
+# EXPLICIT grad lowering over the forward's saved residuals (ConvOut,
+# SavedMean, SavedInvStd, Y) — the dropout-Mask pattern: the grad op never
+# re-executes the forward, and its two grad convs run in the same pinned
+# NHWC/HWIO layout.
+# ---------------------------------------------------------------------------
+
+def _fused_conv_bn_lower(ctx, ins, attrs, op):
+    from paddle_tpu.kernels import conv_fused
+
+    x, w = ins["Input"], ins["Filter"]
+    scale, bias = ins["Scale"], ins["Bias"]
+    mean_in, var_in = ins["Mean"], ins["Variance"]
+    residual = ins.get("Residual")
+    strides = _pair(attrs.get("strides", [1, 1]))
+    paddings = _pair(attrs.get("paddings", [0, 0]))
+    eps = attrs.get("epsilon", 1e-5)
+    momentum = attrs.get("momentum", 0.9)
+    act = attrs.get("act", "")
+    is_test = attrs.get("is_test", False) or ctx.mode == "test"
+    interpret = bool(attrs.get("interpret", False))
+    force_xla = bool(attrs.get("force_xla", False))
+    co = w.shape[3]
+
+    if is_test:
+        inv = jax.lax.rsqrt(var_in.astype(jnp.float32) + eps)
+        a = scale.astype(jnp.float32) * inv
+        b = bias.astype(jnp.float32) - mean_in.astype(jnp.float32) * a
+        y = conv_fused.conv2d_nhwc(
+            x, w, strides, paddings, affine=(a, b), residual=residual,
+            act=act, interpret=interpret, force_xla=force_xla)
+        return {"Y": y, "MeanOut": mean_in, "VarianceOut": var_in,
+                "SavedMean": mean_in.astype(jnp.float32),
+                "SavedInvStd": inv,
+                # fully fused: the raw conv output never materializes.
+                # Test-mode programs carry no grad ops; a stray reader
+                # fails loudly at env resolution instead of silently.
+                "ConvOut": None}
+
+    conv_out, s, ss = conv_fused.conv2d_nhwc(
+        x, w, strides, paddings, stats=True, interpret=interpret,
+        force_xla=force_xla)
+    m = conv_out.size // co                       # N*Ho*Wo
+    mean = s / m
+    var = ss / m - jnp.square(mean)               # f32, from f32 partials
+    inv = jax.lax.rsqrt(var + eps)
+    a = scale.astype(jnp.float32) * inv
+    b = bias.astype(jnp.float32) - mean * a
+    yf = conv_out.astype(jnp.float32) * a + b
+    if residual is not None:
+        yf = yf + residual.astype(jnp.float32)
+    if act == "relu":
+        yf = jnp.maximum(yf, 0.0)
+    mean_out = mean_in * momentum + mean.astype(mean_in.dtype) * \
+        (1 - momentum)
+    var_out = var_in * momentum + var.astype(var_in.dtype) * \
+        (1 - momentum)
+    return {"Y": yf.astype(x.dtype), "ConvOut": conv_out,
+            "MeanOut": mean_out, "VarianceOut": var_out,
+            "SavedMean": mean, "SavedInvStd": inv}
+
+
+def _fused_conv_bn_infer(ins, attrs, op):
+    """Shapes without touching Pallas: conv shape arithmetic + [C]."""
+    x = ins["Input"]
+    w = ins["Filter"]
+    sh, sw = _pair(attrs.get("strides", [1, 1]))
+    ph, pw = _pair(attrs.get("paddings", [0, 0]))
+    n, h, wd, _ = x.shape
+    kh, kw, _, co = w.shape
+    ho = (h + 2 * ph - kh) // sh + 1
+    wo = (wd + 2 * pw - kw) // sw + 1
+    f32 = jnp.float32
+    sds = jax.ShapeDtypeStruct
+    return {"Y": sds((n, ho, wo, co), x.dtype),
+            "ConvOut": sds((n, ho, wo, co), x.dtype),
+            "MeanOut": sds((co,), ins["Mean"].dtype),
+            "VarianceOut": sds((co,), ins["Variance"].dtype),
+            "SavedMean": sds((co,), f32),
+            "SavedInvStd": sds((co,), f32)}
+
+
+register_op("fused_conv2d_bn_act", lower=_fused_conv_bn_lower,
+            infer_shape=_fused_conv_bn_infer)
+
+
+@register_op("fused_conv2d_bn_act_grad", grad_maker=None)
+def _fused_conv_bn_grad(ctx, ins, attrs, op):
+    """Backward from saved residuals only (no forward re-execution):
+    relu mask from the reconstructed pre-activation, batch-stats BN
+    gradient from (ConvOut, SavedMean, SavedInvStd), and the two conv
+    gradients as NHWC/HWIO transposed convs via jax.vjp of the conv."""
+    from paddle_tpu.kernels import conv_fused
+
+    x, w = ins["Input"], ins["Filter"]
+    scale = ins["Scale"]
+    conv_out = ins["ConvOut"]
+    mean, inv = ins["SavedMean"], ins["SavedInvStd"]
+    residual = ins.get("Residual")
+    dy = ins["Y@GRAD"]
+    strides = _pair(attrs.get("strides", [1, 1]))
+    paddings = _pair(attrs.get("paddings", [0, 0]))
+    act = attrs.get("act", "")
+    is_test = attrs.get("is_test", False)
+    co = w.shape[3]
+    red = (0, 1, 2)                                  # N, Ho, Wo
+
+    a = scale.astype(jnp.float32) * inv
+    b = ins["Bias"].astype(jnp.float32) - mean * a
+    xc = conv_out.astype(jnp.float32) - mean
+    xhat = xc * inv
+    dyf = dy.astype(jnp.float32)
+    if act == "relu":
+        pre = conv_out.astype(jnp.float32) * a + b
+        if residual is not None:
+            pre = pre + residual.astype(jnp.float32)
+        dyf = jnp.where(pre > 0, dyf, 0.0)
+    dresidual = dyf
+    dscale = (dyf * xhat).sum(axis=red)
+    dbias = dyf.sum(axis=red)
+    if is_test:
+        dconv = dyf * a
+    else:
+        m = conv_out.size // co
+        dconv = a * (dyf - dbias / m - xhat * dscale / m)
+
+    cdt = jnp.bfloat16 if ctx.amp else jnp.result_type(x, w)
+
+    def fwd_conv(xv, wv):
+        # plain-dtype conv (bf16 under AMP): the vjp's transposed convs
+        # then run in the same pinned NHWC/HWIO layout and dtype as the
+        # forward; bf16 convs still accumulate f32 in the MXU
+        return jax.lax.conv_general_dilated(
+            xv.astype(cdt), wv.astype(cdt),
+            window_strides=strides,
+            padding=[(paddings[0], paddings[0]),
+                     (paddings[1], paddings[1])],
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))
+
+    _, vjp = jax.vjp(fwd_conv, x, w)
+    dx, dw = vjp(dconv.astype(cdt))
+    out = {"Input@GRAD": dx.astype(x.dtype),
+           "Filter@GRAD": dw.astype(w.dtype),
+           "Scale@GRAD": dscale.astype(scale.dtype),
+           "Bias@GRAD": dbias.astype(ins["Bias"].dtype)}
+    if residual is not None:
+        out["Residual@GRAD"] = dresidual.astype(residual.dtype)
+    # Running stats are stop_gradient in real programs; when a harness
+    # declares their grads anyway (the op sweep feeds them as plain
+    # vars), the only dependency is the momentum blend into
+    # MeanOut/VarianceOut.
+    momentum = attrs.get("momentum", 0.9)
+    for slot, gslot in (("Mean", "MeanOut@GRAD"),
+                        ("Variance", "VarianceOut@GRAD")):
+        if slot + "@GRAD" in op.outputs:
+            src = ins.get(gslot) if gslot in ins.slots() else None
+            ref = ins[slot]
+            out[slot + "@GRAD"] = (src * momentum if src is not None
+                                   else jnp.zeros_like(ref))
+    return out
 
 
 @register_op("lrn")

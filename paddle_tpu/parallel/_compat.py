@@ -1,0 +1,47 @@
+"""jax version shims for the shard_map strategies.
+
+The repo meets several jax versions: newer ones expose
+``jax.shard_map`` with varying-manual-axes (vma) typing (``lax.pcast``,
+``check_vma``); older ones only have
+``jax.experimental.shard_map.shard_map`` with the replication-rule
+checker (``check_rep``).  Resolve once here so ring/pipeline/moe code
+stays version-agnostic.
+"""
+from __future__ import annotations
+
+import jax
+
+__all__ = ["shard_map", "pcast_varying"]
+
+
+def shard_map(fn, mesh, in_specs, out_specs, check=False):
+    """``check=False`` disables whichever replication/vma checker this
+    jax ships — the strategies' collectives (masked psum broadcasts,
+    reverse all_to_all reconstructions) are replication-correct by
+    construction but not inferable by either type system."""
+    sm = getattr(jax, "shard_map", None)
+    if sm is not None:
+        kw = {} if check else {"check_vma": False}
+        try:
+            return sm(fn, mesh=mesh, in_specs=in_specs,
+                      out_specs=out_specs, **kw)
+        except TypeError:
+            pass   # jax with jax.shard_map but no check_vma kwarg
+    from jax.experimental.shard_map import shard_map as esm
+
+    kw = {} if check else {"check_rep": False}
+    try:
+        return esm(fn, mesh=mesh, in_specs=in_specs,
+                   out_specs=out_specs, **kw)
+    except TypeError:
+        return esm(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs)
+
+
+def pcast_varying(x, axis_name):
+    """Mark a constant as device-varying for the vma type system; a
+    no-op on jax versions without lax.pcast (their shard_map has no vma
+    typing to satisfy)."""
+    pcast = getattr(jax.lax, "pcast", None)
+    if pcast is None:
+        return x
+    return pcast(x, (axis_name,), to="varying")

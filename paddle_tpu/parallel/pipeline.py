@@ -16,6 +16,8 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
+from ._compat import pcast_varying, shard_map
+
 __all__ = ["pipeline_apply"]
 
 
@@ -28,10 +30,8 @@ def _pipeline_shard(stage_params, x, axis_name, stage_fn):
     m = x.shape[0]
     ev = jax.eval_shape(stage_fn, params, x[0])
     # carries start as constants; mark them device-varying for the scan
-    state = lax.pcast(jnp.zeros(ev.shape, ev.dtype), (axis_name,),
-                      to="varying")
-    out = lax.pcast(jnp.zeros((m,) + ev.shape, ev.dtype), (axis_name,),
-                    to="varying")
+    state = pcast_varying(jnp.zeros(ev.shape, ev.dtype), axis_name)
+    out = pcast_varying(jnp.zeros((m,) + ev.shape, ev.dtype), axis_name)
     perm = [(s, (s + 1) % p) for s in range(p)]
 
     def tick(carry, t):
@@ -55,19 +55,26 @@ def _pipeline_shard(stage_params, x, axis_name, stage_fn):
 
 
 def pipeline_apply(stage_params, microbatches, mesh, stage_fn,
-                   axis_name="pp"):
+                   axis_name="pp", batch_axis=None):
     """Run ``stage_fn(params_of_stage, x) -> y`` as a P-stage pipeline.
 
     stage_params: pytree whose leaves have leading dim P (one slice per
     stage), sharded over ``axis_name``.  microbatches: [M, mb, ...]
     replicated.  Returns [M, mb, ...] outputs (replicated).  All stages
     must map activations to the same shape/dtype.
-    """
+
+    ``batch_axis``: optional second mesh axis carrying data parallelism
+    — the microbatch dim (dim 1) shards over it, each dp slice runs its
+    own pipeline over the shared (replicated-over-dp) stage weights,
+    and the weight-gradient psum over dp is inserted by the shard_map
+    transpose automatically.  The dp x pp composition the 8-device
+    dryrun exercises (MESH_PROFILE r6)."""
     def leaf_spec(a):
         return P(axis_name, *([None] * (a.ndim - 1)))
 
-    in_specs = (jax.tree.map(leaf_spec, stage_params), P())
+    data_spec = P(None, batch_axis) if batch_axis else P()
+    in_specs = (jax.tree.map(leaf_spec, stage_params), data_spec)
     fn = functools.partial(_pipeline_shard, axis_name=axis_name,
                            stage_fn=stage_fn)
-    return jax.shard_map(fn, mesh=mesh, in_specs=in_specs,
-                         out_specs=P())(stage_params, microbatches)
+    return shard_map(fn, mesh=mesh, in_specs=in_specs,
+                     out_specs=data_spec)(stage_params, microbatches)

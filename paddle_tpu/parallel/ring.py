@@ -71,5 +71,6 @@ def ring_attention(q, k, v, mesh, axis_name="sp", causal=True, scale=None,
     spec = P(batch_axis, head_axis, axis_name, None)
     fn = functools.partial(_ring_attention_shard, axis_name=axis_name,
                            causal=causal, scale=scale)
-    return jax.shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
-                         out_specs=spec)(q, k, v)
+    from ._compat import shard_map
+    return shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
+                     out_specs=spec)(q, k, v)

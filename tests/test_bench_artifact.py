@@ -31,6 +31,33 @@ def test_wall_budget_degrades_to_timeout():
     time.sleep(1.2)
 
 
+def test_layout_bench_artifact_fields():
+    """ISSUE 5: a BENCH_LAYOUT=NHWC run's headline JSON must be a
+    self-describing experiment — data_format, fused_stages and xla_flags
+    fields present — and the emit-immediately contract must hold (the
+    partial line carries them too).  Tiny depth-8 model keeps the CPU
+    compile fast."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu", BENCH_LAYOUT="NHWC",
+               BENCH_DEPTH="8", BENCH_BATCH="4", BENCH_ITERS="2",
+               BENCH_FAKE="1", BENCH_LIVENESS_TIMEOUT="30",
+               BENCH_SECONDARY="0", BENCH_STREAM_PROBE="0")
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py")],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=600)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    lines = [json.loads(l) for l in proc.stdout.splitlines()
+             if l.strip().startswith("{")]
+    assert len(lines) >= 2, proc.stdout
+    partial, final = lines[0], lines[-1]
+    assert partial.get("partial") is True
+    for rec in (partial, final):
+        assert rec["data_format"] == "NHWC", rec
+        assert rec["fused_stages"] > 0, rec
+        assert "xla_flags" in rec, rec
+        assert rec["depth"] == 8, rec
+    assert final["value"] > 0
+
+
 def test_dead_backend_yields_fast_json_error_line():
     """Simulated unreachable backend: bench.py exits in seconds with a
     valid JSON line carrying an explicit ``error`` field."""

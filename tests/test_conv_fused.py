@@ -1,0 +1,176 @@
+"""Pallas fused conv-stage kernel (kernels/conv_fused.py) and the
+fused_conv2d_bn_act op: interpret-mode kernel parity vs the XLA path,
+and op-level forward/grad parity vs the unfused conv2d+batch_norm+relu
+chain (the NCHW baseline the layout transpiler replaces)."""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.core.scope import Scope
+from paddle_tpu.kernels import conv_fused
+
+
+@pytest.mark.parametrize("shape", [
+    # (h, w, ci, co, k, stride, pad) — the ResNet stage shapes in
+    # miniature: 3x3 s1 residual stage, 3x3 s2 downsample, 7x7 s2 stem,
+    # 1x1 s1 and 1x1 s2 shortcut
+    (8, 8, 4, 8, 3, 1, 1),
+    (8, 8, 4, 8, 3, 2, 1),
+    (12, 12, 3, 8, 7, 2, 3),
+    (8, 8, 8, 16, 1, 1, 0),
+    (8, 8, 8, 16, 1, 2, 0),
+])
+def test_kernel_matches_xla_with_stats(shape):
+    h, w, ci, co, k, s, p = shape
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randn(2, h, w, ci), jnp.float32)
+    wt = jnp.asarray(rng.randn(k, k, ci, co), jnp.float32) * 0.2
+    y, su, ss = conv_fused.conv2d_nhwc(x, wt, (s, s), (p, p), stats=True,
+                                       interpret=True)
+    ref = np.asarray(conv_fused.conv_nhwc_xla(x, wt, (s, s), (p, p)))
+    np.testing.assert_allclose(np.asarray(y), ref, rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(np.asarray(su), ref.reshape(-1, co).sum(0),
+                               rtol=1e-3, atol=1e-3)
+    np.testing.assert_allclose(
+        np.asarray(ss), np.square(ref).reshape(-1, co).sum(0),
+        rtol=1e-3, atol=1e-3)
+
+
+def test_kernel_fused_epilogue_matches_reference():
+    """Test-mode full fusion: conv + BN affine + residual + relu in one
+    kernel vs the XLA reference."""
+    rng = np.random.RandomState(1)
+    h, ci, co, k, s, p = 8, 4, 8, 3, 1, 1
+    x = jnp.asarray(rng.randn(2, h, h, ci), jnp.float32)
+    wt = jnp.asarray(rng.randn(k, k, ci, co), jnp.float32) * 0.2
+    scale = jnp.asarray(rng.rand(co) + 0.5, jnp.float32)
+    bias = jnp.asarray(rng.randn(co), jnp.float32)
+    mean = jnp.asarray(rng.randn(co) * 0.1, jnp.float32)
+    var = jnp.asarray(rng.rand(co) + 0.5, jnp.float32)
+    res = jnp.asarray(rng.randn(2, h, h, co), jnp.float32)
+    inv = jax.lax.rsqrt(var + 1e-5)
+    a, b = scale * inv, bias - mean * scale * inv
+    got = conv_fused.conv2d_nhwc(x, wt, (s, s), (p, p), affine=(a, b),
+                                 residual=res, act="relu",
+                                 interpret=True)
+    want = conv_fused.fused_conv_bn_act_reference(
+        x, wt, scale, bias, mean, var, strides=(s, s), paddings=(p, p),
+        eps=1e-5, act="relu", residual=res)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-4, atol=1e-4)
+
+
+def _build_unfused(img, ci, co, k, s, p, act, with_residual):
+    """conv2d -> batch_norm (-> add residual) (-> relu), NCHW builder."""
+    data = fluid.layers.data(name="x", shape=[ci, img, img],
+                             dtype="float32")
+    conv = fluid.layers.conv2d(input=data, num_filters=co, filter_size=k,
+                               stride=s, padding=p, act=None,
+                               bias_attr=False)
+    out = fluid.layers.batch_norm(input=conv,
+                                  act=None if with_residual else act)
+    if with_residual:
+        sc = fluid.layers.data(name="r",
+                               shape=[co, conv.shape[2], conv.shape[3]],
+                               dtype="float32")
+        sc.stop_gradient = False
+        out = fluid.layers.elementwise_add(x=sc, y=out, act=act)
+    loss = fluid.layers.reduce_sum(out)
+    return data, loss
+
+
+@pytest.mark.parametrize("act,with_residual", [
+    (None, False), ("relu", False), ("relu", True)])
+def test_fused_op_training_parity(act, with_residual):
+    """The transpiled (NHWC + fused_conv2d_bn_act) program must match
+    the NCHW conv2d+batch_norm(+add)(+relu) chain: loss AND parameter
+    gradients, over several SGD steps (running BN stats included)."""
+    img, ci, co, k, s, p = 8, 4, 8, 3, 1, 1
+
+    def run(transpile, params=None, steps=3):
+        main, startup = fluid.Program(), fluid.Program()
+        scope = Scope()
+        with fluid.scope_guard(scope):
+            with fluid.program_guard(main, startup):
+                with fluid.unique_name.guard():
+                    data, loss = _build_unfused(img, ci, co, k, s, p,
+                                                act, with_residual)
+                    if transpile:
+                        fluid.transpiler.LayoutTranspiler().transpile(
+                            main, startup_program=startup,
+                            data_format="NHWC", fuse_stages=True)
+                    fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+            exe = fluid.Executor(fluid.CPUPlace())
+            exe.run(startup)
+            if params is not None:
+                for n, v in params.items():
+                    cur = np.asarray(scope.find_var(n))
+                    if v.shape != cur.shape and v.ndim == 4:
+                        v = np.ascontiguousarray(
+                            np.transpose(v, (2, 3, 1, 0)))
+                    scope.set(n, v.astype(cur.dtype))
+            snap = {n: np.asarray(scope.find_var(n))
+                    for n in scope.local_var_names()}
+            rng = np.random.RandomState(3)
+            feed = {"x": rng.randn(2, ci, img, img).astype(np.float32)}
+            if with_residual:
+                feed["r"] = rng.randn(2, co, img, img).astype(np.float32)
+            losses = []
+            for _ in range(steps):
+                l, = exe.run(main, feed=feed, fetch_list=[loss])
+                losses.append(float(np.asarray(l).ravel()[0]))
+            post = {n: np.asarray(scope.find_var(n))
+                    for n in scope.local_var_names()}
+        ops = [o.type for o in main.desc.blocks[0].ops]
+        return losses, snap, post, ops
+
+    base_losses, params, base_post, base_ops = run(False)
+    losses, _, post, ops = run(True, params=dict(params))
+    assert "fused_conv2d_bn_act" in ops
+    assert "conv2d" not in ops and "batch_norm" not in ops
+    assert "fused_conv2d_bn_act_grad" in ops
+    np.testing.assert_allclose(base_losses, losses, rtol=1e-4, atol=1e-4)
+    # post-step parameters: covers Filter/Scale/Bias grads and the
+    # running-stat updates end to end
+    for n, v in base_post.items():
+        w = post.get(n)
+        if w is None or v.dtype.kind != "f":
+            continue
+        if v.shape != w.shape and v.ndim == 4:
+            v = np.transpose(v, (2, 3, 1, 0))
+        if v.shape == w.shape:
+            np.testing.assert_allclose(v, w, rtol=1e-3, atol=1e-4,
+                                       err_msg=n)
+
+
+def test_fused_op_test_mode_runs_without_convout():
+    """is_test: the fully-fused path writes no ConvOut; the program
+    still runs (nothing reads it in an inference program)."""
+    img, ci, co = 8, 4, 8
+    main, startup = fluid.Program(), fluid.Program()
+    scope = Scope()
+    with fluid.scope_guard(scope):
+        with fluid.program_guard(main, startup):
+            with fluid.unique_name.guard():
+                data = fluid.layers.data(name="x", shape=[ci, img, img],
+                                         dtype="float32")
+                conv = fluid.layers.conv2d(input=data, num_filters=co,
+                                           filter_size=3, padding=1,
+                                           act=None, bias_attr=False)
+                out = fluid.layers.batch_norm(input=conv, act="relu",
+                                              is_test=True)
+                mean = fluid.layers.mean(out)
+                fluid.transpiler.LayoutTranspiler().transpile(
+                    main, startup_program=startup, data_format="NHWC",
+                    fuse_stages=True)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        x = np.random.RandomState(0).randn(2, ci, img, img).astype(
+            np.float32)
+        m, = exe.run(main, feed={"x": x}, fetch_list=[mean])
+        assert np.isfinite(np.asarray(m)).all()
+    assert any(o.type == "fused_conv2d_bn_act"
+               for o in main.desc.blocks[0].ops)

@@ -45,6 +45,25 @@ def test_book_program_lints_clean(prog_scope, name, builder):
             name, label, "\n".join(d.format() for d in errs))
 
 
+def test_layout_transformed_resnet_lints_clean(prog_scope):
+    """ISSUE 5 cross-feature gate: the NHWC layout-transformed +
+    stage-fused ResNet training program (rewritten VarDescs, pinned HWIO
+    filters, fused_conv2d_bn_act fwd+grad ops, boundary transposes)
+    must pass the PR 3 program verifier with ZERO errors — the shape
+    checker re-derives every rewritten shape through the lowerings."""
+    from paddle_tpu.models import resnet
+
+    main, startup, scope = prog_scope
+    resnet.get_model(data_set="cifar10", depth=8, data_format="NHWC",
+                     fused_stages=True)
+    assert any(op.type == "fused_conv2d_bn_act"
+               for op in main.desc.blocks[0].ops)
+    for label, prog in (("main", main), ("startup", startup)):
+        errs = _errors(analysis.verify_program(prog))
+        assert errs == [], "layout-transformed %s program: %s" % (
+            label, "\n".join(d.format() for d in errs))
+
+
 def test_transpiled_dist_programs_lint_clean(prog_scope):
     main, startup, scope = prog_scope
     book1.build_fit_a_line()

@@ -32,7 +32,7 @@ FLOPS = 0.5 * (4 + 10) * B * H * T * T * D
 
 
 def bench(dtype, block_q, block_k, force_xla=False,
-          block_q_bwd=0, block_k_bwd=0):
+          block_q_bwd=0, block_k_bwd=0, block_q_dkv=0, block_k_dkv=0):
     # NO lax.scan: kernels inside a while loop measured ~2x slower than
     # the identical kernels in the bench's straight-line step (see
     # PROFILE_r05.md) — unroll over distinct pre-staged inputs instead,
@@ -44,11 +44,13 @@ def bench(dtype, block_q, block_k, force_xla=False,
             for _ in range(STEPS)]
 
     bqb, bkb = (block_q_bwd or None), (block_k_bwd or None)
+    bqd, bkd = (block_q_dkv or None), (block_k_dkv or None)
 
     def loss(q, k, v):
         o = flash_attention(q, k, v, causal=True, block_q=block_q,
                             block_k=block_k, force_xla=force_xla,
-                            block_q_bwd=bqb, block_k_bwd=bkb)
+                            block_q_bwd=bqb, block_k_bwd=bkb,
+                            block_q_dkv=bqd, block_k_dkv=bkd)
         return (o.astype(jnp.float32) ** 2).sum()
 
     grad = jax.grad(loss, argnums=(0, 1, 2))
@@ -76,28 +78,40 @@ def main():
           (B, H, T, D, STEPS))
     print("%-10s %6s %6s %9s %9s" % ("dtype", "bq", "bk", "ms/step",
                                      "TFLOP/s"))
-    # (fwd_bq, fwd_bk, bwd_bq, bwd_bk); 0 = the kernel's default cap
+    # (fwd_bq, fwd_bk, bwd_bq, bwd_bk, dkv_bq, dkv_bk); 0 = default —
+    # bwd tiles cover dQ, the dkv pair overrides the transpose-free
+    # dK/dV kernel alone (its [bk, bq] tiles stream the Q axis, so its
+    # optimum can differ from dQ's; VERDICT r5 weak #2)
     configs = [
-        (1024, 1024, 0, 0),      # current defaults (bwd capped 512)
-        (1024, 1024, 512, 1024),
-        (1024, 1024, 1024, 512),
-        (1024, 1024, 256, 512),
-        (1024, 1024, 512, 256),
-        (1024, 1024, 256, 1024),
-        (512, 1024, 0, 0),
-        (512, 512, 0, 0),
-        (1024, 2048, 0, 0),
-        (1024, 2048, 512, 2048),
+        (1024, 1024, 0, 0, 0, 0),      # current defaults (bwd capped 512)
+        (1024, 1024, 512, 1024, 0, 0),
+        (1024, 1024, 1024, 512, 0, 0),
+        (1024, 1024, 256, 512, 0, 0),
+        (1024, 1024, 512, 256, 0, 0),
+        (1024, 1024, 256, 1024, 0, 0),
+        (512, 1024, 0, 0, 0, 0),
+        (512, 512, 0, 0, 0, 0),
+        (1024, 2048, 0, 0, 0, 0),
+        (1024, 2048, 512, 2048, 0, 0),
+        # dkv-only sweeps at the best dq configuration
+        (1024, 1024, 512, 1024, 1024, 512),
+        (1024, 1024, 512, 1024, 2048, 512),
+        (1024, 1024, 512, 1024, 512, 512),
+        (1024, 1024, 512, 1024, 256, 1024),
+        (1024, 1024, 512, 1024, 1024, 1024),
     ]
-    for bq, bk, bqb, bkb in configs:
+    for bq, bk, bqb, bkb, bqd, bkd in configs:
         try:
-            sec = bench(jnp.bfloat16, bq, bk, False, bqb, bkb)
-            print("bf16 fwd(%4d,%4d) bwd(%4s,%4s) %9.2f ms  %7.1f TF/s" %
-                  (bq, bk, bqb or "cap", bkb or "cap", sec * 1e3,
-                   FLOPS / sec / 1e12))
+            sec = bench(jnp.bfloat16, bq, bk, False, bqb, bkb, bqd, bkd)
+            print("bf16 fwd(%4d,%4d) bwd(%4s,%4s) dkv(%4s,%4s) "
+                  "%9.2f ms  %7.1f TF/s" %
+                  (bq, bk, bqb or "cap", bkb or "cap", bqd or "=bwd",
+                   bkd or "=bwd", sec * 1e3, FLOPS / sec / 1e12))
         except Exception as exc:  # noqa: BLE001 — tuning survey
-            print("bf16 fwd(%4d,%4d) bwd(%4s,%4s)  FAILED: %s" %
-                  (bq, bk, bqb or "cap", bkb or "cap", str(exc)[:80]))
+            print("bf16 fwd(%4d,%4d) bwd(%4s,%4s) dkv(%4s,%4s)  "
+                  "FAILED: %s" %
+                  (bq, bk, bqb or "cap", bkb or "cap", bqd or "=bwd",
+                   bkd or "=bwd", str(exc)[:80]))
 
 
 if __name__ == "__main__":

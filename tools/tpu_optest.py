@@ -193,6 +193,19 @@ spec("conv3d", {"Input": F(1, 2, 5, 6, 6), "Filter": F(3, 2, 3, 3, 3)},
      {"strides": [1, 1, 1], "paddings": [1, 1, 1],
       "dilations": [1, 1, 1], "groups": 1},
      outs=["Output"], grad=["Input", "Filter"], tol=TOL_MM)
+spec("fused_conv2d_bn_act",
+     # NHWC input, HWIO filter — the layout-pinned contract the fuse
+     # pass (fluid/transpiler/layout_transpiler.py) emits; the explicit
+     # grad lowering (residual-consuming, no forward re-run) is covered
+     # through the forward spec's cross-place grad check
+     {"Input": F(2, 8, 8, 3), "Filter": F(3, 3, 3, 4),
+      "Scale": P(4), "Bias": F(4), "Mean": F(4) * 0.1, "Variance": P(4)},
+     {"strides": [1, 1], "paddings": [1, 1], "epsilon": 1e-5,
+      "momentum": 0.9, "is_test": False, "act": "relu",
+      "data_format": "NHWC"},
+     outs=["Y", "ConvOut", "MeanOut", "VarianceOut", "SavedMean",
+           "SavedInvStd"],
+     grad=["Input", "Filter", "Scale", "Bias"], tol=TOL_MM)
 spec("pool2d", {"X": F(2, 3, 8, 8)},
      {"pooling_type": "max", "ksize": [2, 2], "strides": [2, 2],
       "paddings": [0, 0], "global_pooling": False, "exclusive": True,
@@ -497,13 +510,14 @@ def lodt2(n_inner, width, dim):
 
 spec("sub_nested_seq",
      {"X": lodt2([2, 3], 4, 3),
-      "SelectedIndices": lodt(I((2, 2, 1), hi=2), [1, 2])})
+      "SelectedIndices": lodt(I((2, 2, 1), hi=2), [1, 2])},
+     grad=["X"])
 
 spec("scale_sub_region",
      {"X": F(2, 3, 4, 4),
       "Indices": np.asarray([[1, 2, 1, 3, 2, 4], [2, 3, 2, 2, 1, 1]],
                             np.int64)},
-     {"value": 2.0})
+     {"value": 2.0}, grad=["X"])
 
 spec("kmax_seq_score", {"X": lodt(F(2, 6, 1), [6, 3])},
      {"beam_size": 2})
