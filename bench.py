@@ -69,8 +69,20 @@ def _wall_budget(seconds, what):
         return
 
     def _handler(signum, frame):
-        raise TimeoutError("%s exceeded its %ds wall budget"
-                           % (what, int(seconds)))
+        # flight recorder FIRST: the artifact must exist even if the
+        # TimeoutError is swallowed or the process dies during unwind
+        path = None
+        try:
+            from paddle_tpu.observability import flight
+            path = flight.dump("wall_budget:%s" % what,
+                               blocked={"op": what,
+                                        "budget_s": int(seconds)})
+        except Exception:
+            path = None
+        msg = "%s exceeded its %ds wall budget" % (what, int(seconds))
+        if path:
+            msg += " (flight recorder: %s)" % path
+        raise TimeoutError(msg)
 
     prev = signal.signal(signal.SIGALRM, _handler)
     # never truncate a sub-second budget to alarm(0) == "no alarm"
@@ -119,12 +131,24 @@ def _probe_backend(timeout):
 def _exit_with_error_artifact(metric, err, on_accel):
     """Print the explicit JSON error line and LEAVE — os._exit, because
     a wedged runtime thread would otherwise hang interpreter teardown
-    and turn this fast failure back into the driver's rc:124."""
-    print(json.dumps({
+    and turn this fast failure back into the driver's rc:124.  A
+    flight-recorder dump rides along (who-was-waiting-on-whom instead
+    of a bare error string; ISSUE 6 tentpole d)."""
+    rec = {
         "metric": metric,
         "error": "backend unreachable: %s" % str(err)[:200],
         "on_accel": on_accel,
-    }), flush=True)
+    }
+    try:
+        from paddle_tpu.observability import flight
+        path = flight.dump("backend_unreachable",
+                           blocked={"op": "liveness_probe",
+                                    "error": str(err)[:200]})
+        if path:
+            rec["flight_recorder"] = path
+    except Exception:
+        pass
+    print(json.dumps(rec), flush=True)
     sys.stdout.flush()
     os._exit(0)
 
@@ -219,11 +243,17 @@ def transformer_bench(on_accel, as_dict=False):
     if os.environ.get("BENCH_PROFILE"):
         import jax
         prof_ctx = jax.profiler.trace(os.environ["BENCH_PROFILE"])
+    from paddle_tpu.observability import metrics as obs_metrics
+    h_step = obs_metrics.histogram(
+        "bench_transformer_step_ms",
+        "per-step wall of the transformer bench loop")
     with prof_ctx:
         t0 = time.time()
         for _ in range(iters):
+            ts_step = time.time()
             loss, = exe.run(main_prog, feed=feed,
                             fetch_list=[avg_cost], return_numpy=False)
+            h_step.observe((time.time() - ts_step) * 1e3)
         loss = np.asarray(loss)
         elapsed = time.time() - t0
     tokens_per_sec = bs * seq * iters / elapsed
@@ -234,6 +264,9 @@ def transformer_bench(on_accel, as_dict=False):
         "unit": "tokens/sec",
         "vs_baseline": 0.0,  # no reference transformer baseline exists
         "amp": amp,
+        "step_ms_p50": round(h_step.percentile(50), 3),
+        "step_ms_p90": round(h_step.percentile(90), 3),
+        "step_ms_p99": round(h_step.percentile(99), 3),
     }
     if on_accel:
         # standard analytic count: 6*N_params FLOPs/token (fwd+bwd) +
@@ -327,6 +360,15 @@ def main():
     # the same values ride the executor compile-cache key.
     from paddle_tpu.core.flags import FLAGS, apply_xla_flags
     xla_tokens = apply_xla_flags()
+    # a driver SIGTERM (wall-clock kill) leaves a flight-recorder JSON
+    # naming the open span every thread was blocked in, instead of
+    # nothing (ISSUE 6 tentpole d).  SIGALRM stays with _wall_budget,
+    # whose handler dumps before raising.
+    try:
+        from paddle_tpu.observability import flight
+        flight.install_signal_handlers(("SIGTERM",))
+    except Exception:
+        pass
     on_accel = False
     try:
         import jax
@@ -520,6 +562,15 @@ def main():
                                    fetch_list=[avg_cost])
         except ValueError:
             prepared = None  # host ops in the block: run() path
+    # per-step wall times land in an always-on metrics histogram; the
+    # JSON's step_ms_p50/p90/p99 come from ITS snapshot (ISSUE 6).
+    # Steps are dispatched async, so per-step wall is host-side issue
+    # time except the final step, which absorbs the drain — the
+    # percentiles catch host-side stalls (recompiles, loader hiccups)
+    # the mean hides.
+    from paddle_tpu.observability import metrics as obs_metrics
+    h_step = obs_metrics.histogram(
+        "bench_step_ms", "per-step wall of the timed bench loop")
     with prof_ctx:  # exception-safe: a mid-run OOM still finalizes
         t0 = time.time()
         t_host = 0.0  # host-side dispatch time (wall minus run-ahead)
@@ -527,6 +578,7 @@ def main():
         loss = None
         from paddle_tpu.core.executor_impl import PreparedShapeMismatch
         for _ in range(iters):
+            ts_step = time.time()
             step_feed = next(loader_iter) if loader_iter is not None \
                 else feed
             td = time.time()
@@ -548,6 +600,7 @@ def main():
                                 fetch_list=[avg_cost],
                                 return_numpy=False)
             t_host += time.time() - td
+            h_step.observe((time.time() - ts_step) * 1e3)
         loss = np.asarray(loss)  # blocks until the chain has drained
         elapsed = time.time() - t0
     if prepared is not None:
@@ -676,6 +729,13 @@ def main():
         "step_wall_ms": round(elapsed / iters * 1e3, 3),
         "step_host_ms": round(t_host / iters * 1e3, 3),
         "host_overhead_frac": round(t_host / max(elapsed, 1e-9), 4),
+        # per-step distribution, sourced from the telemetry histogram
+        # (ISSUE 6): tail stalls (recompiles, loader hiccups) show in
+        # p99 where the mean hides them.  The last step absorbs the
+        # async drain, so p99 ~ the device step time.
+        "step_ms_p50": round(h_step.percentile(50), 3),
+        "step_ms_p90": round(h_step.percentile(90), 3),
+        "step_ms_p99": round(h_step.percentile(99), 3),
         # ISSUE 5 lever evidence: layout, fused stage count and the
         # scheduler flags the run compiled under — BENCH_*.json rows
         # are self-describing experiments, not env archaeology.

@@ -33,6 +33,25 @@ from .types import proto_to_np_dtype, VarKind
 
 from .flags import FLAGS
 
+from paddle_tpu.observability import metrics as _obs_metrics
+from paddle_tpu.observability.trace import TRACER as _TRC
+
+# always-on metrics (one short lock per step — see
+# tools/telemetry_overhead.py for the hot-path overhead gate); span
+# tracing below is additionally gated on _TRC.on (FLAGS_telemetry)
+_M_STEPS = _obs_metrics.counter(
+    "executor_steps_total", "executor steps (run + run_prepared)")
+_M_CACHE_HITS = _obs_metrics.counter(
+    "compile_cache_hits_total", "compiled-entry cache hits")
+_M_CACHE_MISSES = _obs_metrics.counter(
+    "compile_cache_misses_total", "compiled-entry cache misses (builds)")
+_M_FLUSHES = _obs_metrics.counter(
+    "prepared_flushes_total",
+    "PreparedProgram.sync_scope write-backs of device state")
+_H_STEP_MS = _obs_metrics.histogram(
+    "step_wall_ms",
+    "per-step wall of traced executor steps (FLAGS_telemetry on)")
+
 
 def _matmul_precision_ctx():
     """jax.default_matmul_precision(FLAGS.matmul_precision) when set —
@@ -324,7 +343,36 @@ class PreparedProgram:
     def run_prepared(self, feed=None):
         """Feed staging + one dispatch.  Returns the fetch list as
         device arrays — host conversion is the CALLER's choice (defer
-        np.asarray until the value is actually consumed)."""
+        np.asarray until the value is actually consumed).
+
+        Telemetry: one step counter per COMPLETED step; with
+        FLAGS_telemetry on, a 'step.prepared' span with 'step.feed' /
+        'step.dispatch' phases and a step_wall_ms histogram
+        observation.  A failed attempt records neither (the
+        PreparedShapeMismatch fallback re-runs the step through run(),
+        which does its own counting — inc-ing up front would count
+        such a step twice).  Disabled cost: the counter inc plus one
+        attribute read (the < 2% overhead gate in
+        tools/telemetry_overhead.py)."""
+        if not _TRC.on:
+            out = self._run_prepared_impl(feed, None)
+            _M_STEPS.inc()
+            return out
+        span = _TRC.begin("step.prepared")
+        try:
+            out = self._run_prepared_impl(feed, _TRC)
+        except BaseException:
+            # keep the trace evidence, but under a name the phase
+            # table won't mix into real step stats
+            span.name = "step.prepared.failed"
+            raise
+        finally:
+            _TRC.end(span)
+        _M_STEPS.inc()
+        _H_STEP_MS.observe((span.t1 - span.t0) / 1e6)
+        return out
+
+    def _run_prepared_impl(self, feed, _tr):
         if self.is_stale:
             raise RuntimeError(
                 "program mutated since prepare() (version %d -> %d): the "
@@ -342,6 +390,7 @@ class PreparedProgram:
                 self.sync_scope()
             self._refresh_from_scope()
             self._scope_epoch = scope.chain_version()
+        sp_feed = _tr.begin("step.feed") if _tr is not None else None
         feed = _prepare_lod_feeds(dict(feed or {}))
         if feed.keys() != self._feed_names:
             self._check_feed_names(feed)
@@ -371,9 +420,16 @@ class PreparedProgram:
                 val = np.asarray(val, dtype=dtype)
             args.append(_put(val, self._targets[i], local_rows=True))
         seed, counter = self._core._rng_counter(self._program, scope)
+        if sp_feed is not None:
+            _tr.end(sp_feed)
+        sp_disp = _tr.begin("step.dispatch") if _tr is not None else None
         try:
             fetches, persists = entry.fn(tuple(args), seed, counter)
+            if sp_disp is not None:
+                _tr.end(sp_disp)
         except Exception:
+            if sp_disp is not None:
+                _tr.end(sp_disp, args={"failed": True})
             # an execute-time failure may have consumed the donated
             # inputs: drop exactly the deleted buffers so a finally/
             # context-exit sync installs only values that survived
@@ -417,6 +473,13 @@ class PreparedProgram:
         read/installed it (scope.set by user code, a load, another
         executor) wins: the device copy is dropped and re-staged from
         the scope instead of clobbering the newer value."""
+        _M_FLUSHES.inc()
+        if _TRC.on:
+            with _TRC.span("step.sync_scope"):
+                return self._sync_scope_impl()
+        return self._sync_scope_impl()
+
+    def _sync_scope_impl(self):
         scope = self._scope
         stale = False
         for name in self._entry.persist_outs:
@@ -502,6 +565,41 @@ class ExecutorCore:
     # ------------------------------------------------------------------
     def run(self, program, scope, block_id=0, feed=None, fetch_list=None,
             mode="train", return_numpy=True):
+        # step metrics on COMPLETION only, mirroring run_prepared: a
+        # raising run is not a step, and its aborted duration must not
+        # land in the histogram.  Neither is a sub-block run — a
+        # pserver's listen_and_serv applies each shard's optimize block
+        # through here (ops/distributed_ops apply_block), and counting
+        # those would report shard-apply time as the process's step
+        # stats (10 shards x 100 rounds = 1000 phantom "steps").
+        is_step = block_id == 0
+        if not _TRC.on:
+            out = self._run_impl(program, scope, block_id, feed,
+                                 fetch_list, mode, return_numpy)
+            if is_step:
+                _M_STEPS.inc()
+            return out
+        span = _TRC.begin("executor.run", None, {"block": block_id})
+        try:
+            out = self._run_impl(program, scope, block_id, feed,
+                                 fetch_list, mode, return_numpy)
+        except BaseException:
+            span.name = "executor.run.failed"
+            raise
+        finally:
+            _TRC.end(span)
+        if is_step:
+            _M_STEPS.inc()
+            # a blocking serve (listen_and_serv) is not a training
+            # step either: one minutes-long observation would wreck
+            # the step_wall_ms sum/mean/percentiles.  The executor.run
+            # span still records it for the trace.
+            if not _block_serves(program, block_id):
+                _H_STEP_MS.observe((span.t1 - span.t0) / 1e6)
+        return out
+
+    def _run_impl(self, program, scope, block_id, feed, fetch_list,
+                  mode, return_numpy):
         self._maybe_verify(program)
         # device-resident prepared state (run_prepared) must land in the
         # scope before this unprepared path reads or overwrites it
@@ -629,9 +727,12 @@ class ExecutorCore:
         key = _cache_key(program, block_id, key_spec, fetch_list, mode)
         entry = self._cache.get(key)
         if entry is None:
+            _M_CACHE_MISSES.inc()
             entry = self._build(program, block_id, core_ops, scope,
                                 stub, fetch_list, mode)
             self._cache[key] = entry
+        else:
+            _M_CACHE_HITS.inc()
         return PreparedProgram(self, program, block_id, entry, scope,
                                mode, stub)
 
@@ -662,9 +763,12 @@ class ExecutorCore:
         key = _cache_key(program, block_id, feed_spec, fetch_list, mode)
         entry = self._cache.get(key)
         if entry is None:
+            _M_CACHE_MISSES.inc()
             entry = self._build(program, block_id, core_ops, scope, feed,
                                 fetch_list, mode)
             self._cache[key] = entry
+        else:
+            _M_CACHE_HITS.inc()
 
         dev = self.place.jax_device()
         args = []
@@ -691,7 +795,14 @@ class ExecutorCore:
                                                "_reader_batch_vars", ())))
         seed, counter = self._rng_counter(program, scope)
 
-        fetches, persists = entry.fn(tuple(args), seed, counter)
+        if _TRC.on:
+            sp = _TRC.begin("executor.dispatch")
+            try:
+                fetches, persists = entry.fn(tuple(args), seed, counter)
+            finally:
+                _TRC.end(sp)
+        else:
+            fetches, persists = entry.fn(tuple(args), seed, counter)
         for name, val in zip(entry.persist_outs, persists):
             (scope.find_scope_of(name) or scope).set(name, val)
         return list(fetches)
@@ -1049,6 +1160,21 @@ def _put(val, target, local_rows=False):
         except Exception:
             return jax.device_put(np.asarray(val), target)
     return jax.device_put(val, target)
+
+
+def _block_serves(program, block_id):
+    """True when the block contains a blocking serve op
+    (listen_and_serv) — cached per (block, version) on the program, so
+    the per-step cost after the first call is one dict lookup."""
+    cache = getattr(program, "_serve_blocks", None)
+    if cache is None:
+        cache = program._serve_blocks = {}
+    key = (block_id, program.version)
+    v = cache.get(key)
+    if v is None:
+        v = cache[key] = any(op.type == "listen_and_serv"
+                             for op in program.blocks[block_id].ops)
+    return v
 
 
 def _segment(block):

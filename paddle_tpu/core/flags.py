@@ -28,6 +28,7 @@ class _Flags:
 
     def __init__(self):
         object.__setattr__(self, "_defs", {})
+        object.__setattr__(self, "_watchers", {})
 
     def define(self, name, default, help=""):
         raw = os.environ.get("FLAGS_" + name)
@@ -46,6 +47,19 @@ class _Flags:
         if name not in self._defs:
             raise AttributeError("undefined flag %r" % name)
         self._defs[name]["value"] = value
+        for fn in self._watchers.get(name, ()):
+            fn(value)
+
+    def watch(self, name, fn):
+        """Call ``fn(value)`` now and again on every later
+        ``FLAGS.<name> = value`` assignment — for flags whose value is
+        mirrored into a hot-path attribute (e.g. FLAGS_telemetry ->
+        observability TRACER.on: the mirror keeps the per-step check to
+        one attribute read, the watcher keeps a runtime flag flip from
+        being silently ignored)."""
+        self._watchers.setdefault(name, []).append(fn)
+        if name in self._defs:
+            fn(self._defs[name]["value"])
 
     def flags(self):
         return {k: v["value"] for k, v in self._defs.items()}
@@ -139,6 +153,25 @@ define_flag("xla_extra_flags", "",
             "(e.g. '--xla_tpu_enable_async_collective_fusion=true'); "
             "reproducible-experiment plumbing for scheduler knobs — "
             "part of the executor compile-cache key")
+define_flag("telemetry", False,
+            "span tracing (paddle_tpu/observability): per-step executor "
+            "spans, RPC round spans with (round, sender, seq) "
+            "correlation ids, Pallas launch-site spans.  Off (the "
+            "default) the instrumented hot paths pay one attribute "
+            "read — tools/telemetry_overhead.py gates this at < 2% of "
+            "the prepared step.  Metrics (counters/histograms) are "
+            "ALWAYS on; this flag gates tracing only")
+define_flag("telemetry_ring_size", 4096,
+            "completed-span ring capacity of the process tracer; the "
+            "same ring is the flight recorder's history (oldest spans "
+            "evict first)")
+define_flag("telemetry_dump_dir", "",
+            "when set: processes with tracing on write "
+            "trace_<label>_<pid>.json here at exit (merge them with "
+            "tools/trace_report.py), flight-recorder dumps "
+            "(flight_<pid>_<n>.json) land here instead of the system "
+            "temp dir, and injected faults leave one dump per fault "
+            "point (tools/fault_matrix.py asserts it)")
 define_flag("auto_layout", False,
             "single-device accelerator path: AOT-compile with XLA-chosen "
             "(AUTO) parameter layouts and keep persistable buffers in "

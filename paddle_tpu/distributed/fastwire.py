@@ -39,6 +39,16 @@ import threading
 
 __all__ = ["native_available", "FastServer", "FastConnPool"]
 
+from paddle_tpu.observability import metrics as _obs_metrics
+
+# always-on wire byte counters, incremented per FRAME (never per byte):
+# the "bytes on wire" half of the telemetry metrics next to rpc.py's
+# payload counters
+_M_TX = _obs_metrics.counter(
+    "fastwire_bytes_sent_total", "bytes written to fastwire sockets")
+_M_RX = _obs_metrics.counter(
+    "fastwire_bytes_recv_total", "bytes read from fastwire sockets")
+
 MAGIC = b"FW1\n"
 METHODS = {"SendVariable": 1, "GetVariable": 2,
            "SendVariables": 3, "GetVariables": 4}
@@ -123,6 +133,7 @@ def _send_bytes(lib, fd, parts):
         b = p if isinstance(p, (bytes, bytearray)) else bytes(p)
         if lib.fw_send(fd, b, len(b)) != len(b):
             raise ConnectionError("fastwire send failed")
+        _M_TX.inc(len(b))
 
 
 def _parts_len(parts):
@@ -164,6 +175,7 @@ def _send_parts(lib, fd, parts):
         total += lens[i]
     if lib.fw_sendv(fd, bufs, lens, n) != total:
         raise ConnectionError("fastwire vectored send failed")
+    _M_TX.inc(total)
     del keep
 
 
@@ -178,6 +190,7 @@ def _recv_exact(lib, fd, n):
     got = lib.fw_recv(fd, buf.ctypes.data, n)
     if got != n:
         raise ConnectionError("fastwire recv failed (%d of %d)" % (got, n))
+    _M_RX.inc(n)
     # preserve the wire contract: decoded tensors are READ-ONLY views
     # (a consumer that wants to mutate must .copy())
     buf.flags.writeable = False

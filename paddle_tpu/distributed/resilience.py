@@ -35,6 +35,13 @@ import time
 
 from paddle_tpu.core.flags import FLAGS, define_flag
 
+from paddle_tpu.observability import metrics as _obs_metrics
+
+_M_RETRIES = _obs_metrics.counter(
+    "rpc_retries_total", "retryable RPC failures that entered backoff")
+_M_FAULTS = _obs_metrics.counter(
+    "faults_injected_total", "FaultInjector rules fired")
+
 __all__ = [
     "RetryPolicy", "FaultInjector", "InjectedFault", "DeadlineExceeded",
     "WatchdogTimeout", "EndpointResolver", "fault_point", "get_injector",
@@ -173,6 +180,7 @@ class RetryPolicy:
                 if not self.is_retryable(e):
                     raise
                 last = e
+                _M_RETRIES.inc()
             attempt += 1
             elapsed = time.monotonic() - start
             delay = self.backoff(attempt)
@@ -268,6 +276,15 @@ class FaultInjector:
                     continue
                 rule.fired += 1
                 self.stats[point] = self.stats.get(point, 0) + 1
+            _M_FAULTS.inc()
+            # flight-recorder breadcrumb: with FLAGS_telemetry_dump_dir
+            # set, the first firing per point leaves a dump artifact
+            # (tools/fault_matrix.py asserts it per injected-fault run)
+            try:
+                from paddle_tpu.observability import flight
+                flight.note_fault(point)
+            except Exception:
+                pass
             if rule.action == "delay":
                 time.sleep(rule.value)
             elif rule.action == "drop":
@@ -360,6 +377,29 @@ def watchdog_error(op_name, endpoints, status_fn, cause=None):
            % (op_name, op_name, "; ".join(details) or "<none>"))
     if cause is not None:
         msg += " | cause: %s" % cause
+    # flight recorder: the who-was-waiting-on-whom artifact — blocked
+    # op + per-pserver barrier state + every thread's open span stack
+    # (observability/flight.py); its path rides the error message so
+    # the dump is findable from the raising process's log alone
+    flight_path = None
+    try:
+        from paddle_tpu.observability import flight
+        from paddle_tpu.observability.trace import TRACER
+        # dump only when observability is opted into (a dump dir is
+        # configured, or tracing is on — then flight.py falls back to
+        # the temp dir so a real hang's artifact is never lost).
+        # Ordinary test runs constructing WatchdogTimeouts with neither
+        # must not litter /tmp (same guard rationale as note_fault).
+        if FLAGS.telemetry_dump_dir or TRACER.on:
+            flight_path = flight.dump(
+                "watchdog:%s" % op_name,
+                blocked={"op": op_name, "endpoints": list(endpoints),
+                         "details": details})
+    except Exception:
+        flight_path = None
+    if flight_path:
+        msg += " | flight recorder: %s" % flight_path
     err = WatchdogTimeout(msg)
     err.details = details
+    err.flight_path = flight_path
     return err

@@ -43,6 +43,30 @@ import numpy as np
 
 from .resilience import FLAGS, InjectedFault, RetryPolicy, fault_point
 
+from paddle_tpu.observability import metrics as _obs_metrics
+from paddle_tpu.observability.trace import TRACER as _TRC, \
+    round_cid as _rcid
+
+# always-on wire/round metrics; spans below additionally gate on
+# _TRC.on (FLAGS_telemetry) and carry the (round, sender, seq) wire
+# identity as a correlation id so merged traces line trainer and
+# pserver timelines up (observability/export.py)
+_M_BYTES_TX = _obs_metrics.counter(
+    "rpc_bytes_sent_total", "payload bytes shipped to pservers")
+_M_BYTES_RX = _obs_metrics.counter(
+    "rpc_bytes_recv_total", "payload bytes fetched from pservers")
+_M_TRAINER_ROUNDS = _obs_metrics.counter(
+    "trainer_rounds_total", "sync rounds advanced by this trainer")
+_M_PS_ROUNDS = _obs_metrics.counter(
+    "pserver_rounds_applied_total", "sync rounds applied by this server")
+_M_PS_BYTES_RX = _obs_metrics.counter(
+    "pserver_bytes_recv_total", "scatter payload bytes received")
+_M_DEDUP = _obs_metrics.counter(
+    "pserver_dedup_drops_total",
+    "replayed/duplicate grads dropped by (round, sender, seq) dedup")
+_M_REPLAYS = _obs_metrics.counter(
+    "rpc_round_replays_total", "client round replays after reconnect")
+
 SERVICE = "paddle_tpu.PServer"
 
 # fastwire data plane: raw-socket port = grpc port + this offset
@@ -531,6 +555,7 @@ class VariableServer:
                 # stale replay of an applied round — including one that
                 # slips through the apply loop's lock-release window
                 # (its grads are already counted in the in-flight round)
+                _M_DEDUP.inc()
                 return
             if not self.sync_mode and seq and \
                     self._async_applied.get((sender, name)) == seq:
@@ -538,6 +563,7 @@ class VariableServer:
                 # the round-replay dedup can't help a retried send:
                 # the per-sender send sequence is what makes a
                 # resend of an already-applied grad a no-op
+                _M_DEDUP.inc()
                 return
             key = sender
         self._pending[name][key] = arr
@@ -548,9 +574,21 @@ class VariableServer:
             self._cv.notify_all()
 
     def _send_variable(self, req, ctx=None):
+        _M_PS_BYTES_RX.inc(len(req))
         name, arr, extra = _dec_tensor(req)
-        with self._cv:
-            self._store_grad_locked(name, arr, extra)
+        sp = None
+        if _TRC.on:
+            round_, sender, _ = _unpack_round_sender(extra)
+            sp = _TRC.begin(
+                "pserver.scatter",
+                _rcid(round_) if sender is not None else None,
+                {"n": 1})
+        try:
+            with self._cv:
+                self._store_grad_locked(name, arr, extra)
+        finally:
+            if sp is not None:
+                _TRC.end(sp)
         return b""
 
     def _send_variables(self, req, ctx=None):
@@ -558,13 +596,34 @@ class VariableServer:
         endpoint in one frame, decoded zero-copy sub-frame by
         sub-frame.  Each carries its own (round, sender, seq) identity,
         so dedup/replay semantics match the unbatched wire exactly."""
-        with self._cv:
-            for frame in _iter_batch(req):
-                name, arr, extra = _dec_tensor(frame)
-                self._store_grad_locked(name, arr, extra)
+        _M_PS_BYTES_RX.inc(len(req))
+        sp = _TRC.begin("pserver.scatter") if _TRC.on else None
+        n = 0
+        try:
+            with self._cv:
+                for frame in _iter_batch(req):
+                    name, arr, extra = _dec_tensor(frame)
+                    if sp is not None and sp.cid is None:
+                        round_, sender, _ = _unpack_round_sender(extra)
+                        if sender is not None:
+                            sp.cid = _rcid(round_)
+                            sp.args = {"sender": "%06x" % sender}
+                    self._store_grad_locked(name, arr, extra)
+                    n += 1
+        finally:
+            if sp is not None:
+                _TRC.end(sp, args={"n": n})
         return b""
 
     def _send_barrier(self, req, ctx=None):
+        # span covers the whole handler INCLUDING the durable-ack wait:
+        # a hang here shows up in the flight recorder as an open
+        # pserver.barrier span with the sender in its args (sp is None
+        # when tracing is off; _send_barrier_impl tolerates that)
+        with _TRC.span("pserver.barrier") as sp:
+            return self._send_barrier_impl(req, ctx, sp)
+
+    def _send_barrier_impl(self, req, ctx, sp):
         snapshot = None
         with self._cv:
             if req:
@@ -573,6 +632,9 @@ class VariableServer:
             else:
                 label, round_, sender = None, None, None
             if sender is not None:
+                if sp is not None:
+                    sp.cid = _rcid(round_)
+                    sp.args = {"sender": label}
                 self._touch(sender, label)
                 if round_ >= self._applied_round:
                     self._barrier_senders.add(sender)
@@ -713,13 +775,22 @@ class VariableServer:
         requested shard is ready, replies with the frames
         length-prefixed back to back (count known to the caller)."""
         items = [_dec_msg(f) for f in _iter_batch(req)]
-        with self._cv:
-            if self.sync_mode:
-                if not self._wait_cv(
-                        lambda: all(self._ready_locked(n, r)
-                                    for n, r in items), ctx):
-                    return b""
-            frames = [self._materialize_locked(n) for n, _ in items]
+        sp = None
+        if _TRC.on and items:
+            r = max(min(r for _, r in items) - 1, 0)
+            sp = _TRC.begin("pserver.gather", _rcid(r),
+                            {"n": len(items)})
+        try:
+            with self._cv:
+                if self.sync_mode:
+                    if not self._wait_cv(
+                            lambda: all(self._ready_locked(n, r)
+                                        for n, r in items), ctx):
+                        return b""
+                frames = [self._materialize_locked(n) for n, _ in items]
+        finally:
+            if sp is not None:
+                _TRC.end(sp)
         out = []
         for parts in frames:
             out.append(_parts_nbytes(parts).to_bytes(8, "little"))
@@ -735,23 +806,37 @@ class VariableServer:
         for f in _iter_batch(req):
             name, round_ = _dec_msg(f)
             remaining[name] = round_
-        while remaining:
-            with self._cv:
-                if self.sync_mode:
-                    self._wait_cv(
-                        lambda: any(self._ready_locked(n, r)
-                                    for n, r in remaining.items()), None)
-                    ready = [n for n, r in remaining.items()
-                             if self._ready_locked(n, r)]
-                    if not ready:   # shutdown mid-wait: serve current
+        sp = None
+        if _TRC.on and remaining:
+            # get(round=N) serves the params trainer round N-1 produced
+            r = max(min(remaining.values()) - 1, 0)
+            sp = _TRC.begin("pserver.gather", _rcid(r),
+                            {"n": len(remaining)})
+        try:
+            while remaining:
+                with self._cv:
+                    if self.sync_mode:
+                        self._wait_cv(
+                            lambda: any(self._ready_locked(n, r)
+                                        for n, r in remaining.items()),
+                            None)
+                        ready = [n for n, r in remaining.items()
+                                 if self._ready_locked(n, r)]
+                        if not ready:  # shutdown mid-wait: serve current
+                            ready = list(remaining)
+                    else:
                         ready = list(remaining)
-                else:
-                    ready = list(remaining)
-                frames = [self._materialize_locked(n) for n in ready]
-            for name, parts in zip(ready, frames):
-                write([_parts_nbytes(parts).to_bytes(8, "little")]
-                      + list(parts))
-                del remaining[name]
+                    frames = [self._materialize_locked(n) for n in ready]
+                for name, parts in zip(ready, frames):
+                    write([_parts_nbytes(parts).to_bytes(8, "little")]
+                          + list(parts))
+                    del remaining[name]
+        finally:
+            # a write() failure (client died mid-stream) must not leak
+            # a forever-open span onto this handler thread's stack —
+            # the flight recorder would report a phantom blocked gather
+            if sp is not None:
+                _TRC.end(sp)
 
     def _prefetch_variable(self, req, ctx=None):
         """Row-subset read of a sharded table (reference
@@ -910,6 +995,14 @@ class VariableServer:
             # of re-applying the same gradients once per missing round
             self._applied_round = self._barrier_round
         nxt = self._applied_round + 1
+        # correlate with the TRAINER round the barriers named (the
+        # round whose grads this apply consumes), not the server's
+        # 1-based applied counter
+        cid = _rcid(self._barrier_round if self._barrier_round >= 0
+                    else self._applied_round)
+        sp = _TRC.begin("pserver.apply_round", cid,
+                        {"senders": self._barrier_count()}) \
+            if _TRC.on else None
         self._applying = True
         self._apply_target = nxt
         try:
@@ -920,7 +1013,12 @@ class VariableServer:
                     self._invalidate_locked(g)
                     self._cv.release()
                     try:
-                        self.apply_block(self.grad_to_block[g])
+                        if _TRC.on:
+                            with _TRC.span("pserver.apply_shard", cid,
+                                           {"grad": g}):
+                                self.apply_block(self.grad_to_block[g])
+                        else:
+                            self.apply_block(self.grad_to_block[g])
                     finally:
                         self._cv.acquire()
                     self._invalidate_locked(g)
@@ -932,7 +1030,10 @@ class VariableServer:
                 self._cv.notify_all()
         finally:
             self._applying = False
+            if sp is not None:
+                _TRC.end(sp)
         self._applied_round = nxt
+        _M_PS_ROUNDS.inc()
         self._barrier_senders = set()
         self._barrier_round = -1
         self._legacy_barriers = 0
@@ -960,6 +1061,9 @@ class RPCClient:
         self.label = "trainer%s@%s:%d" % (
             os.getenv("PADDLE_TRAINER_ID", "?"),
             _socket.gethostname(), os.getpid())
+        # name this process's telemetry dumps (first writer wins: a
+        # pserver process labeled itself at listen_and_serv already)
+        _TRC.set_label(self.label)
         self.retry = RetryPolicy.from_env()
         self._resolver = None     # logical ep -> current physical ep
         self._redirects = {}      # logical ep -> physical ep overrides
@@ -1063,6 +1167,7 @@ class RPCClient:
         c = self._round_cache.get(ep)
         if not c:
             return
+        _M_REPLAYS.inc()
         to = self.retry.call_timeout
         for name, (arr, seq) in c["grads"].items():
             self._call(
@@ -1249,6 +1354,17 @@ class RPCClient:
         still be device arrays — conversion happens in the sender
         threads.  FLAGS_pserver_wire_batch=0 restores the per-variable
         wire."""
+        if not _TRC.on:
+            return self._send_vars_impl(triples)
+        sp = _TRC.begin("rpc.send_vars", _rcid(self.step),
+                        {"n": len(triples),
+                         "sender": "%06x" % self.sender})
+        try:
+            return self._send_vars_impl(triples)
+        finally:
+            _TRC.end(sp)
+
+    def _send_vars_impl(self, triples):
         if not FLAGS.pserver_wire_batch:
             return self._send_vars_unbatched(triples)
         by_ep = {}
@@ -1340,6 +1456,9 @@ class RPCClient:
         self._overlapped("SendVariable", "send_grad",
                          [t[0] for t in triples], payloads, replay=True,
                          idempotent=False)
+        # delivered-bytes accounting (after the fan-out returns, like
+        # the batched path)
+        _M_BYTES_TX.inc(sum(len(p) for p in payloads))
 
     def _send_batch(self, ep, frames):
         """One endpoint's batched scatter: fastwire vectored send of
@@ -1354,20 +1473,28 @@ class RPCClient:
                     break
                 try:
                     conn.call("SendVariables", parts)
+                    # count DELIVERED payload bytes only, after the call
+                    # returns: counting up front would double-count a
+                    # round that falls back to gRPC (and count bytes
+                    # that never went out at all)
+                    _M_BYTES_TX.inc(_parts_nbytes(parts))
                     pool.checkin(self._phys(ep), conn)
                     return
                 except ConnectionError as e:
                     pool.discard(conn)
                     if getattr(e, "sent_payload", True):
                         raise
-        self._call(ep, "SendVariables",
-                   _join_parts(_enc_batch_parts(frames)),
+        payload = _join_parts(_enc_batch_parts(frames))
+        self._call(ep, "SendVariables", payload,
                    timeout=self.retry.call_timeout)
+        _M_BYTES_TX.inc(len(payload))
 
     def get_var(self, ep, name, round_=None):
         round_ = self.step if round_ is None else round_
-        return self._retry_op(ep, "GetVariable", _enc_msg(name, round_),
-                              point="get_param", replay=True, decode=True)
+        arr = self._retry_op(ep, "GetVariable", _enc_msg(name, round_),
+                             point="get_param", replay=True, decode=True)
+        _M_BYTES_RX.inc(getattr(arr, "nbytes", 0) or 0)
+        return arr
 
     def get_vars(self, pairs, round_=None, sinks=None):
         """Overlapped gets: [(ep, name)] -> [arr] (reference
@@ -1380,12 +1507,26 @@ class RPCClient:
         while later shards are still on the wire.
         FLAGS_pserver_wire_batch=0 restores per-variable gets."""
         round_ = self.step if round_ is None else round_
+        if not _TRC.on:
+            return self._get_vars_impl(pairs, round_, sinks)
+        # get(round=N) consumes the apply of trainer round N-1: tag the
+        # span with THAT round's correlation id
+        sp = _TRC.begin("rpc.get_vars", _rcid(max(round_ - 1, 0)),
+                        {"n": len(pairs), "wait_round": round_})
+        try:
+            return self._get_vars_impl(pairs, round_, sinks)
+        finally:
+            _TRC.end(sp)
+
+    def _get_vars_impl(self, pairs, round_, sinks):
         if not FLAGS.pserver_wire_batch:
             replies = self._overlapped(
                 "GetVariable", "get_param", [ep for ep, _ in pairs],
                 [_enc_msg(name, round_) for _, name in pairs],
                 replay=True)
             out = [_dec_tensor(r)[1] for r in replies]
+            for a in out:
+                _M_BYTES_RX.inc(getattr(a, "nbytes", 0) or 0)
             if sinks is not None:
                 out = [s(a) if s is not None else a
                        for s, a in zip(sinks, out)]
@@ -1398,6 +1539,7 @@ class RPCClient:
         errs = {}
 
         def consume(i, arr):
+            _M_BYTES_RX.inc(getattr(arr, "nbytes", 0) or 0)
             sink = sinks[i] if sinks is not None else None
             results[i] = sink(arr) if sink is not None else arr
             filled[i] = True
@@ -1497,12 +1639,19 @@ class RPCClient:
         on checkpoint rounds), so sequential calls across endpoints
         could deadlock if trainers ordered them differently."""
         payload = self._barrier_payload(self.step)
+        round_ = self.step
         errs = []
 
         def one(ep):
             try:
-                self._retry_op(ep, "SendBarrier", payload,
-                               point="send_barrier", replay=True)
+                sp = _TRC.begin("rpc.barrier", _rcid(round_),
+                                {"ep": ep}) if _TRC.on else None
+                try:
+                    self._retry_op(ep, "SendBarrier", payload,
+                                   point="send_barrier", replay=True)
+                finally:
+                    if sp is not None:
+                        _TRC.end(sp)
                 c = self._round_cache.get(ep)
                 if c is not None and c["round"] == self.step:
                     c["barriered"] = True
@@ -1517,6 +1666,7 @@ class RPCClient:
         if errs:
             raise errs[0]
         self.step += 1
+        _M_TRAINER_ROUNDS.inc()
 
     def launch_barriers(self, eps):
         """Full-duplex round: START the SendBarrier RPCs in background
@@ -1534,8 +1684,15 @@ class RPCClient:
 
         def one(ep):
             try:
-                self._retry_op(ep, "SendBarrier", payload,
-                               point="send_barrier", replay=True)
+                sp = _TRC.begin("rpc.barrier", _rcid(round_),
+                                {"ep": ep, "overlapped": True}) \
+                    if _TRC.on else None
+                try:
+                    self._retry_op(ep, "SendBarrier", payload,
+                                   point="send_barrier", replay=True)
+                finally:
+                    if sp is not None:
+                        _TRC.end(sp)
                 with self._cache_lock:
                     c = self._round_cache.get(ep)
                     if c is not None and c["round"] == round_:
@@ -1549,6 +1706,7 @@ class RPCClient:
             t.start()
         self._barrier_pending = (ts, errs)
         self.step += 1
+        _M_TRAINER_ROUNDS.inc()
 
     def join_barriers(self):
         """Join the overlapped barriers launched by launch_barriers,

@@ -14,6 +14,7 @@ import numpy as np
 from paddle_tpu.core.executor_impl import PreparedShapeMismatch
 from paddle_tpu.core.place import CPUPlace, TPUPlace
 from paddle_tpu.core.scope import Scope
+from paddle_tpu.observability.trace import TRACER as _TRC
 
 from . import framework
 from . import io
@@ -355,6 +356,12 @@ class Trainer:
                     prepared.sync_scope()
 
     def _run_one_step(self, exe, prepared, feed, metrics, fetch_metrics):
+        with _TRC.span("trainer.step"):
+            return self._run_one_step_impl(exe, prepared, feed, metrics,
+                                           fetch_metrics)
+
+    def _run_one_step_impl(self, exe, prepared, feed, metrics,
+                           fetch_metrics):
         if prepared:
             try:
                 outs = prepared.run_prepared(feed,

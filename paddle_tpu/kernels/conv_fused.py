@@ -32,6 +32,8 @@ import jax.numpy as jnp
 import numpy as np
 from jax.experimental import pallas as pl
 
+from paddle_tpu.observability.trace import traced as _traced
+
 __all__ = ["conv2d_nhwc", "fused_conv_bn_act_reference"]
 
 # Per-image VMEM budget for (padded input + weights + f32 accumulator +
@@ -110,6 +112,11 @@ def _vmem_bytes(hp, wp, ci, kh, kw, co, ho, wo, in_dtype):
             + ho * wo * co * ib)        # output block
 
 
+# launch-site span (FLAGS_telemetry): records the TRACE/lowering-time
+# cost of building this kernel — the on-device execution shows up in
+# the xplane capture that observability/export.py merges alongside
+@_traced("pallas.conv2d_nhwc",
+         lambda x, w, *a, **kw: {"x": str(x.shape), "w": str(w.shape)})
 def conv2d_nhwc(x, w, strides=(1, 1), paddings=(0, 0), *, stats=False,
                 affine=None, residual=None, act="", out_dtype=None,
                 force_xla=False, interpret=False):

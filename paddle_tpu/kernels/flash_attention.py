@@ -24,6 +24,8 @@ import jax.numpy as jnp
 import numpy as np
 from jax.experimental import pallas as pl
 
+from paddle_tpu.observability.trace import traced as _traced
+
 __all__ = ["flash_attention", "flash_attention_fwd_lse",
            "flash_attention_bwd"]
 
@@ -121,6 +123,10 @@ def _fit_block(block, size):
     return block
 
 
+# launch-site span (FLAGS_telemetry): trace/lowering-time cost; the
+# device-side kernel time lives in the xplane capture
+@_traced("pallas.flash_attention",
+         lambda q, *a, **kw: {"q": str(q.shape)})
 def flash_attention(q, k, v, scale=None, causal=False, block_q=1024,
                     block_k=1024, force_xla=False, interpret=False,
                     block_q_bwd=None, block_k_bwd=None,
@@ -317,6 +323,7 @@ def _bwd_operands(q, k, v, do, lse, delta):
             delta.astype(jnp.float32).reshape(b * h, t, 1))
 
 
+@_traced("pallas.flash_bwd_dq")
 def _flash_bwd_dq(q, k, v, do, lse, delta, scale, causal, block_q,
                   block_k, interpret):
     from jax.experimental.pallas import tpu as pltpu
@@ -349,6 +356,7 @@ def _flash_bwd_dq(q, k, v, do, lse, delta, scale, causal, block_q,
     return dq.reshape(b, h, t, d)
 
 
+@_traced("pallas.flash_bwd_dkv")
 def _flash_bwd_dkv(q, k, v, do, lse, delta, scale, causal, block_q,
                    block_k, interpret):
     from jax.experimental.pallas import tpu as pltpu

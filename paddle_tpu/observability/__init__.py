@@ -1,0 +1,27 @@
+"""Unified telemetry layer (ISSUE 6): step-scoped tracing, always-on
+metrics, chrome-trace export with distributed round correlation, and a
+hang flight recorder.
+
+  trace      span API + process tracer (FLAGS_telemetry gates; the
+             disabled hot path is one attribute read)
+  metrics    counters/gauges/histograms, always on; Prometheus text +
+             JSON snapshot exports
+  export     merge per-process dumps (+ xplane device traces) into one
+             chrome://tracing JSON; per-phase breakdown rows
+  flight     dump the ring + open spans + metrics on watchdog timeout,
+             wall-budget expiry, injected faults, SIGTERM/SIGALRM
+
+Instrumented sites: core/executor_impl (step/feed/dispatch/sync spans,
+compile-cache + step counters), distributed/rpc (send/gather/barrier/
+apply spans carrying the (round, sender, seq) wire identity as a
+correlation id, dedup/replay counters), distributed/fastwire (wire
+byte counters), kernels (Pallas launch-site spans), fluid/trainer and
+fluid/profiler (RecordEvent is now a telemetry span).
+
+See README "Observability" and tools/trace_report.py.
+"""
+from . import metrics  # noqa: F401
+from . import trace  # noqa: F401
+from .trace import TRACER, round_cid  # noqa: F401
+
+__all__ = ["trace", "metrics", "TRACER", "round_cid"]

@@ -1,0 +1,157 @@
+"""Trace export: merge per-process telemetry dumps (and optional
+xplane device traces) into ONE chrome://tracing JSON, and reduce a
+trace to a per-phase breakdown table.
+
+The per-process dump (trace.Tracer.dump) stamps spans in absolute
+wall-clock microseconds, so merging is pure concatenation: each process
+becomes a chrome pid with its label as the process name, and spans of
+the same sync round share a ``cid`` arg (trace.round_cid) — select one
+in the viewer to see the trainer's send/barrier/get next to the
+pserver's scatter/apply for that round.
+
+Device traces: ``jax.profiler.trace`` captures convert through
+utils/xplane.device_trace_events (XLine.timestamp_ns is unix-epoch
+based, so device ops land on the same absolute timeline).
+"""
+from __future__ import annotations
+
+import json
+import os
+
+from . import metrics
+
+__all__ = ["load_dump", "chrome_trace", "merge_files", "phase_rows",
+           "format_phase_table"]
+
+
+def load_dump(path):
+    with open(path) as f:
+        data = json.load(f)
+    if "traceEvents" in data and "spans" not in data:
+        # already a chrome trace (e.g. a previous merge): adapt
+        spans = []
+        for ev in data["traceEvents"]:
+            if ev.get("ph") != "X":
+                continue
+            # open-span markers carry elapsed-at-dump-time as their
+            # duration; re-ingesting them as completed spans would let
+            # a hung run's open barriers dominate the phase table.
+            # Device events (an --xplane merge) are likewise excluded:
+            # the original dumps never contained them, so the re-loaded
+            # phase table must not be device-op-dominated either.
+            if ev.get("cat") in ("open", "device") \
+                    or (ev.get("args") or {}).get("open"):
+                continue
+            s = {"name": ev.get("name", "?"), "ts_us": ev.get("ts", 0),
+                 "dur_us": ev.get("dur", 0), "tid": ev.get("tid", 0)}
+            cid = (ev.get("args") or {}).get("cid")
+            if cid:
+                s["cid"] = cid
+            spans.append(s)
+        return {"label": os.path.basename(path), "pid": 0,
+                "spans": spans, "open_spans": [], "metrics": {}}
+    return data
+
+
+def chrome_trace(dumps, device_events=None):
+    """[per-process dump dicts] -> chrome trace dict.  ``device_events``
+    is an optional pre-built list of chrome events (see
+    utils/xplane.device_trace_events)."""
+    events = []
+    used_pids = set()
+    for i, d in enumerate(dumps):
+        # fallback pids sit above kernel.pid_max (4194304) so they
+        # can't collide with another dump's real OS pid; an explicit
+        # pid 0 (the profiler's single-process export) is honored
+        pid = d["pid"] if d.get("pid") is not None else (9_000_000 + i)
+        # multi-host merges can present the SAME os pid from different
+        # machines — remap the later dump so each keeps its own chrome
+        # track (and its own process_name label)
+        while pid in used_pids:
+            pid = 9_000_000 + i if pid < 9_000_000 else pid + 1
+        used_pids.add(pid)
+        label = d.get("label") or ("proc%d" % i)
+        events.append({"name": "process_name", "ph": "M", "pid": pid,
+                       "tid": 0, "args": {"name": label}})
+        for s in d.get("spans", []):
+            ev = {"name": s["name"], "ph": "X", "pid": pid,
+                  "tid": s.get("tid", 0), "ts": s.get("ts_us", 0),
+                  "dur": s.get("dur_us", 0), "cat": "host"}
+            args = dict(s.get("args") or {})
+            if s.get("cid"):
+                args["cid"] = s["cid"]
+            if args:
+                ev["args"] = args
+            events.append(ev)
+        for s in d.get("open_spans", []):
+            ev = {"name": s["name"] + " (open)", "ph": "X", "pid": pid,
+                  "tid": s.get("tid", 0), "ts": s.get("ts_us", 0),
+                  "dur": s.get("elapsed_us", 0), "cat": "open"}
+            args = dict(s.get("args") or {})
+            if s.get("cid"):
+                args["cid"] = s["cid"]
+            args["open"] = True
+            ev["args"] = args
+            events.append(ev)
+    if device_events:
+        events.extend(device_events)
+    events.sort(key=lambda e: (e.get("ts", 0), e.get("pid", 0)))
+    return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+
+def merge_files(paths, out_path=None, xplane=None):
+    """Merge per-process dump files (+ an optional xplane capture dir)
+    into one chrome trace; write it to ``out_path`` when given.
+    Returns (trace_dict, dumps)."""
+    dumps = [load_dump(p) for p in paths]
+    device_events = None
+    if xplane:
+        from paddle_tpu.utils.xplane import device_trace_events
+        device_events = device_trace_events(xplane)
+    trace = chrome_trace(dumps, device_events)
+    if out_path:
+        d = os.path.dirname(out_path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        with open(out_path, "w") as f:
+            json.dump(trace, f)
+    return trace, dumps
+
+
+def phase_rows(dumps):
+    """Aggregate span durations by name over per-process dumps:
+    [{name, count, total_ms, mean_ms, p50_ms, p99_ms, share}] sorted by
+    total time — the per-phase step-time breakdown."""
+    groups = {}
+    for d in dumps:
+        for s in d.get("spans", []):
+            dur = s.get("dur_us")
+            if dur is None:
+                continue
+            groups.setdefault(s["name"], []).append(dur / 1e3)
+    total = sum(sum(v) for v in groups.values()) or 1e-12
+    rows = []
+    for name, vals in groups.items():
+        vals.sort()
+        n = len(vals)
+        rows.append({
+            "name": name, "count": n,
+            "total_ms": round(sum(vals), 3),
+            "mean_ms": round(sum(vals) / n, 3),
+            "p50_ms": round(metrics.nearest_rank(vals, 50), 3),
+            "p99_ms": round(metrics.nearest_rank(vals, 99), 3),
+            "share": round(sum(vals) / total, 4),
+        })
+    rows.sort(key=lambda r: -r["total_ms"])
+    return rows
+
+
+def format_phase_table(rows, top=0):
+    out = ["%-32s %7s %10s %9s %9s %9s %7s" % (
+        "phase", "count", "total_ms", "mean_ms", "p50_ms", "p99_ms",
+        "share")]
+    for r in (rows[:top] if top else rows):
+        out.append("%-32s %7d %10.3f %9.3f %9.3f %9.3f %6.1f%%" % (
+            r["name"][:32], r["count"], r["total_ms"], r["mean_ms"],
+            r["p50_ms"], r["p99_ms"], 100.0 * r["share"]))
+    return "\n".join(out)

@@ -25,6 +25,7 @@ import threading
 import numpy as np
 
 from paddle_tpu.core.registry import register_op
+from paddle_tpu.observability.trace import TRACER as _TRC
 
 
 def _host(name):
@@ -190,6 +191,7 @@ def _recv(executor, op, scope, feed, env=None):
     client = RPCClient.instance()
     out = op.output("Out")[0]
     eps, sections, names = _check_rpc_route(op)
+    sp = _TRC.begin("op.recv", None, {"out": out}) if _TRC.on else None
     try:
         if len(eps) == 1:
             parts = client.get_vars(list(zip(eps, names)))
@@ -201,6 +203,9 @@ def _recv(executor, op, scope, feed, env=None):
             val = asm.value(len(eps))
     except DeadlineExceeded as e:
         raise _watchdog("recv", sorted(set(eps)), client, e) from e
+    finally:
+        if sp is not None:
+            _TRC.end(sp)
     _write(out, val, scope, env)
 
 
@@ -256,6 +261,9 @@ def _listen_and_serv(executor, op, scope, feed, env=None):
 
     program = executor._current_program
     endpoint = op.attr("endpoint")
+    # name this process's telemetry dumps after its serving role so the
+    # merged chrome trace labels the pserver timeline
+    _TRC.set_label("pserver@%s" % endpoint)
     fanin = int(op.attr("Fanin", 1))
     sync_mode = bool(op.attr("sync_mode", True))
     grad_to_block = {}

@@ -25,7 +25,8 @@ import re
 
 __all__ = ["read_xspace", "op_totals", "print_op_profile",
            "op_profile", "category_profile", "print_category_profile",
-           "kernel_profile", "print_kernel_profile"]
+           "kernel_profile", "print_kernel_profile",
+           "device_trace_events"]
 
 
 def _varint(buf, i):
@@ -77,25 +78,38 @@ def _fields(buf):
 
 
 def _parse_event(buf):
+    """-> (metadata_id, duration_ps, offset_ps).  offset_ps (XEvent
+    field 2) positions the event within its line's timeline — the
+    chrome-trace export needs it; the aggregate profiles ignore it."""
     meta_id = 0
     dur_ps = 0
+    off_ps = 0
     for fno, wt, v in _fields(buf):
         if fno == 1 and wt == 0:
             meta_id = v
+        elif fno == 2 and wt == 0:
+            off_ps = v
         elif fno == 3 and wt == 0:
             dur_ps = v
-    return meta_id, dur_ps
+    return meta_id, dur_ps, off_ps
 
 
 def _parse_line(buf):
+    """-> (name, [(meta_id, dur_ps, off_ps)], timestamp_ns).
+    XLine.timestamp_ns (field 3) is the line's start in unix-epoch ns,
+    which is what lets device events merge onto the host spans'
+    wall-clock timeline (observability/export.py)."""
     name = ""
     events = []
+    ts_ns = 0
     for fno, wt, v in _fields(buf):
         if fno == 2 and wt == 2:
             name = bytes(v).decode("utf-8", "replace")
+        elif fno == 3 and wt == 0:
+            ts_ns = v
         elif fno == 4 and wt == 2:
             events.append(_parse_event(v))
-    return name, events
+    return name, events, ts_ns
 
 
 def _parse_stat(buf):
@@ -155,9 +169,24 @@ def _parse_stat_metadata_entry(buf):
     return key, name
 
 
+class _Plane(dict):
+    """Plane dict whose legacy ``lines`` view — (name, [(meta_id,
+    dur_ps)]) tuples — is derived from ``xlines`` on first access, so
+    parsing doesn't materialize every event twice for consumers that
+    never read it."""
+
+    def __missing__(self, key):
+        if key == "lines":
+            v = [(ln["name"], [(m, d) for m, d, _ in ln["events"]])
+                 for ln in self["xlines"]]
+            self["lines"] = v
+            return v
+        raise KeyError(key)
+
+
 def _parse_plane(buf):
     name = ""
-    lines = []
+    xlines = []     # timestamped: {name, timestamp_ns, events 3-tuples}
     metadata = {}
     stats_by_id = {}
     stat_names = {}
@@ -165,7 +194,9 @@ def _parse_plane(buf):
         if fno == 2 and wt == 2:
             name = bytes(v).decode("utf-8", "replace")
         elif fno == 3 and wt == 2:
-            lines.append(_parse_line(v))
+            lname, events, ts_ns = _parse_line(v)
+            xlines.append({"name": lname, "timestamp_ns": ts_ns,
+                           "events": events})
         elif fno == 4 and wt == 2:
             k, nm, stats = _parse_metadata_entry(v)
             metadata[k] = nm
@@ -183,8 +214,8 @@ def _parse_plane(buf):
             stat_names.get(mid, "#%d" % mid):
                 (stat_names.get(val, "#%d" % val) if is_ref else val)
             for mid, val, is_ref in stats}
-    return {"name": name, "lines": lines, "event_metadata": metadata,
-            "event_stats": event_stats}
+    return _Plane(name=name, xlines=xlines,
+                  event_metadata=metadata, event_stats=event_stats)
 
 
 def read_xspace(path):
@@ -228,10 +259,10 @@ def op_totals(path, plane_re=r"/device:", line_name="XLA Ops",
         if not re.search(plane_re, plane["name"]):
             continue
         md = plane["event_metadata"]
-        for lname, events in plane["lines"]:
-            if lname != line_name:
+        for line in plane["xlines"]:
+            if line["name"] != line_name:
                 continue
-            for meta_id, dur in events:
+            for meta_id, dur, _ in line["events"]:
                 name = md.get(meta_id, "#%d" % meta_id)
                 name = name.split(" = ")[0]
                 if strip_suffix:
@@ -265,10 +296,10 @@ def op_profile(path, plane_re=r"/device:", line_name="XLA Ops"):
             continue
         md = plane["event_metadata"]
         st = plane.get("event_stats", {})
-        for lname, events in plane["lines"]:
-            if lname != line_name:
+        for line in plane["xlines"]:
+            if line["name"] != line_name:
                 continue
-            for meta_id, dur in events:
+            for meta_id, dur, _ in line["events"]:
                 name = md.get(meta_id, "#%d" % meta_id).split(" = ")[0]
                 r = rows.get(name)
                 if r is None:
@@ -363,3 +394,51 @@ def print_kernel_profile(path, name_re=r".", top=15, flops_per_exec=None,
             "%.1f" % tf if tf is not None else "-",
             "%.1f%%" % (100 * mxu) if mxu is not None else "-"))
     return rows
+
+
+def device_trace_events(path, plane_re=r"/device:", line_re=r".",
+                        max_events=200000):
+    """Chrome-trace events (ph 'X', absolute wall µs) from the device
+    planes of an xplane capture — the device half of a merged telemetry
+    timeline (observability/export.py feeds these next to the host
+    spans; XLine.timestamp_ns is unix-epoch based, matching the
+    tracer's wall-clock anchor).  Each device plane becomes one chrome
+    pid; each XLine one tid."""
+    events = []
+    n_planes = 0
+    for plane in read_xspace(path):
+        if not re.search(plane_re, plane["name"]):
+            continue
+        md = plane["event_metadata"]
+        # one distinct chrome pid per plane, based above any real OS
+        # pid (kernel.pid_max tops out at 4194304) so device tracks
+        # can't collide with the host dumps' genuine pids
+        pid = 10_000_000 + n_planes
+        n_planes += 1
+        events.append({"name": "process_name", "ph": "M", "pid": pid,
+                       "tid": 0, "args": {"name": plane["name"]}})
+        for tid, line in enumerate(plane.get("xlines", [])):
+            if not re.search(line_re, line["name"]):
+                continue
+            base_us = line["timestamp_ns"] / 1e3
+            events.append({"name": "thread_name", "ph": "M", "pid": pid,
+                           "tid": tid, "args": {"name": line["name"]}})
+            for meta_id, dur_ps, off_ps in line["events"]:
+                if len(events) >= max_events:
+                    # no silent cap: a marker event names the drop so a
+                    # merged timeline's empty tail reads as truncation,
+                    # not as the device going idle
+                    events.append({
+                        "name": "XPLANE EVENTS TRUNCATED (max_events="
+                                "%d reached; later lines/planes "
+                                "dropped)" % max_events,
+                        "ph": "I", "pid": pid, "tid": tid,
+                        "ts": base_us + off_ps / 1e6, "s": "g",
+                        "cat": "device"})
+                    return events
+                name = md.get(meta_id, "#%d" % meta_id).split(" = ")[0]
+                events.append({
+                    "name": name, "ph": "X", "pid": pid, "tid": tid,
+                    "ts": base_us + off_ps / 1e6,
+                    "dur": dur_ps / 1e6, "cat": "device"})
+    return events

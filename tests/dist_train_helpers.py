@@ -125,6 +125,17 @@ def _apply_env(env):
         os.environ.update(env)
 
 
+def _dump_telemetry():
+    """Explicit per-process trace dump (FLAGS_telemetry_dump_dir):
+    spawned workers should not rely on atexit ordering to leave their
+    half of a merged distributed trace."""
+    try:
+        from paddle_tpu.observability.trace import TRACER
+        TRACER.dump_if_configured()
+    except Exception:
+        pass
+
+
 def run_pserver(endpoint, pservers, trainers, kind="softmax",
                 sync_mode=True, env=None):
     _apply_env(env)
@@ -138,6 +149,7 @@ def run_pserver(endpoint, pservers, trainers, kind="softmax",
     with fluid.scope_guard(scope):
         exe.run(ps_startup)
         exe.run(ps_prog)   # blocks until all trainers SendComplete
+    _dump_telemetry()
 
 
 def run_trainer(trainer_id, pservers, trainers, steps, queue,
@@ -160,4 +172,5 @@ def run_trainer(trainer_id, pservers, trainers, steps, queue,
                          feed=make_batch(s, kind), fetch_list=[loss])
             losses.append(float(np.ravel(l)[0]))
     RPCClient.instance().send_complete(t.pserver_endpoints)
+    _dump_telemetry()
     queue.put((trainer_id, losses))

@@ -14,16 +14,26 @@ import pytest
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
-def test_wall_budget_degrades_to_timeout():
+def test_wall_budget_degrades_to_timeout(tmp_path):
     sys.path.insert(0, REPO)
     try:
         import bench
     finally:
         sys.path.remove(REPO)
+    # point the handler's flight dump at tmp_path — with no dump dir
+    # configured it falls back to the system temp dir BY DESIGN (a
+    # bare hung run must still leave its who-was-waiting artifact),
+    # but repeated test runs must not litter /tmp
+    from paddle_tpu.core.flags import FLAGS
+    old = FLAGS.telemetry_dump_dir
+    FLAGS.telemetry_dump_dir = str(tmp_path)
     t0 = time.time()
-    with pytest.raises(TimeoutError, match="wall budget"):
-        with bench._wall_budget(1, "probe"):
-            time.sleep(30)
+    try:
+        with pytest.raises(TimeoutError, match="wall budget"):
+            with bench._wall_budget(1, "probe"):
+                time.sleep(30)
+    finally:
+        FLAGS.telemetry_dump_dir = old
     assert time.time() - t0 < 5
     # and the alarm is cancelled afterwards
     with bench._wall_budget(1, "ok"):
@@ -56,13 +66,22 @@ def test_layout_bench_artifact_fields():
         assert "xla_flags" in rec, rec
         assert rec["depth"] == 8, rec
     assert final["value"] > 0
+    # ISSUE 6 satellite: per-step percentiles, sourced from the
+    # telemetry histogram, ride the BENCH JSON (p50 <= p90 <= p99)
+    for rec in (partial, final):
+        assert rec["step_ms_p50"] > 0, rec
+        assert rec["step_ms_p50"] <= rec["step_ms_p90"] \
+            <= rec["step_ms_p99"], rec
 
 
-def test_dead_backend_yields_fast_json_error_line():
+def test_dead_backend_yields_fast_json_error_line(tmp_path):
     """Simulated unreachable backend: bench.py exits in seconds with a
-    valid JSON line carrying an explicit ``error`` field."""
+    valid JSON line carrying an explicit ``error`` field — and (ISSUE 6)
+    a flight-recorder artifact naming what was blocked, so the next
+    dead tunnel is a diagnosis, not an rc:124."""
     env = dict(os.environ, JAX_PLATFORMS="cpu", BENCH_FAKE_DEAD="1",
-               BENCH_LIVENESS_TIMEOUT="3")
+               BENCH_LIVENESS_TIMEOUT="3",
+               FLAGS_telemetry_dump_dir=str(tmp_path))
     t0 = time.time()
     proc = subprocess.run(
         [sys.executable, os.path.join(REPO, "bench.py")],
@@ -75,3 +94,37 @@ def test_dead_backend_yields_fast_json_error_line():
     rec = json.loads(lines[-1])
     assert "error" in rec and "backend unreachable" in rec["error"]
     assert rec["metric"].endswith("_train")
+    # the flight-recorder artifact exists and names the blocked op
+    assert "flight_recorder" in rec, rec
+    assert os.path.exists(rec["flight_recorder"])
+    flight = json.loads(open(rec["flight_recorder"]).read())
+    assert flight["reason"] == "backend_unreachable"
+    assert flight["blocked"]["op"] == "liveness_probe"
+    assert "metrics" in flight
+
+
+def test_wall_budget_expiry_leaves_flight_artifact(tmp_path):
+    """Simulated wall-budget expiry (the BENCH_FAKE_DEAD-style degrade
+    path): the SIGALRM handler dumps a flight record BEFORE raising,
+    and the TimeoutError names its path."""
+    sys.path.insert(0, REPO)
+    try:
+        import bench
+    finally:
+        sys.path.remove(REPO)
+    from paddle_tpu.core.flags import FLAGS
+
+    old = FLAGS.telemetry_dump_dir
+    FLAGS.telemetry_dump_dir = str(tmp_path)
+    try:
+        with pytest.raises(TimeoutError, match="flight recorder:"):
+            with bench._wall_budget(1, "probe"):
+                time.sleep(30)
+    finally:
+        FLAGS.telemetry_dump_dir = old
+    import glob
+    dumps = glob.glob(str(tmp_path / "flight_*.json"))
+    assert dumps, "wall-budget expiry left no flight artifact"
+    rec = json.loads(open(dumps[0]).read())
+    assert rec["reason"].startswith("wall_budget:")
+    assert rec["blocked"]["op"] == "probe"

@@ -22,9 +22,12 @@ Notes:
     --seed 0 for OS entropy.
 """
 import argparse
+import glob
 import os
+import shutil
 import subprocess
 import sys
+import tempfile
 import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -48,6 +51,13 @@ def run_preset(name, spec, seed, pytest_args):
     env["FLAGS_fault_spec"] = spec
     if seed:
         env["FLAGS_fault_seed"] = str(seed)
+    # flight recorder (ISSUE 6): with a dump dir set, the first fault
+    # firing per injection point and every WatchdogTimeout leave a
+    # flight_*.json artifact here — asserted below for every preset
+    # that actually injects faults
+    dump_dir = tempfile.mkdtemp(prefix="fault_flight_%s_" % name)
+    env["FLAGS_telemetry"] = "1"
+    env["FLAGS_telemetry_dump_dir"] = dump_dir
     # generous budgets: heavy drop presets legitimately retry a lot
     env.setdefault("FLAGS_rpc_deadline", "300")
     env.setdefault("FLAGS_rpc_call_timeout", "15")
@@ -57,7 +67,8 @@ def run_preset(name, spec, seed, pytest_args):
            "-q", "-p", "no:cacheprovider", "-o", "addopts="] + pytest_args
     t0 = time.time()
     proc = subprocess.run(cmd, cwd=REPO, env=env)
-    return proc.returncode, time.time() - t0
+    n_dumps = len(glob.glob(os.path.join(dump_dir, "flight_*.json")))
+    return proc.returncode, time.time() - t0, dump_dir, n_dumps
 
 
 def main(argv=None):
@@ -97,14 +108,33 @@ def main(argv=None):
     rows = []
     for name, spec in matrix:
         print("=== preset %r: FLAGS_fault_spec=%r" % (name, spec))
-        rc, secs = run_preset(name, spec, args.seed, pytest_args)
-        rows.append((name, rc, secs))
+        rc, secs, dump_dir, n_dumps = run_preset(name, spec, args.seed,
+                                                 pytest_args)
+        # a preset that injects faults must leave a flight-recorder
+        # artifact (observability/flight.note_fault dumps on the first
+        # firing per point) — a silent injected-fault run means the
+        # breadcrumb path is broken
+        missing = bool(spec) and n_dumps == 0 and rc == 0
+        if missing:
+            print("preset %r: no flight_*.json under %s despite "
+                  "injected faults" % (name, dump_dir), file=sys.stderr)
+            rc = 3
+        if rc == 0:
+            # passing presets clean their flight dir (repeated CI runs
+            # would otherwise accumulate temp dirs without bound);
+            # failures keep theirs as the diagnostic breadcrumb
+            shutil.rmtree(dump_dir, ignore_errors=True)
+        else:
+            print("preset %r FAILED (rc=%d); flight dumps kept at %s"
+                  % (name, rc, dump_dir), file=sys.stderr)
+        rows.append((name, rc, secs, n_dumps))
 
-    print("\n%-14s %-6s %s" % ("preset", "result", "seconds"))
+    print("\n%-14s %-6s %-8s %s" % ("preset", "result", "seconds",
+                                    "flight_dumps"))
     worst = 0
-    for name, rc, secs in rows:
-        print("%-14s %-6s %.1f" % (name, "PASS" if rc == 0 else "FAIL",
-                                   secs))
+    for name, rc, secs, n_dumps in rows:
+        print("%-14s %-6s %-8.1f %d" % (
+            name, "PASS" if rc == 0 else "FAIL", secs, n_dumps))
         worst = max(worst, rc)
     return worst
 

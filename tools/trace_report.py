@@ -1,0 +1,88 @@
+#!/usr/bin/env python
+"""Merge telemetry trace dumps and print a per-phase step-time
+breakdown (ISSUE 6 tentpole c — the timeline.py analog for the new
+telemetry layer).
+
+Inputs are the per-process dump files the tracer writes
+(``trace_<label>_<pid>.json`` under FLAGS_telemetry_dump_dir, or any
+``Tracer.dump`` output; a previously merged chrome trace also loads).
+Device traces from a ``jax.profiler.trace`` capture dir merge in with
+``--xplane`` (utils/xplane.py parses them; XLine timestamps are
+unix-epoch, so they land on the host spans' wall-clock timeline).
+
+Usage:
+    python tools/trace_report.py DUMP.json [DUMP2.json ...]
+    python tools/trace_report.py DUMPS... --merge merged_trace.json
+    python tools/trace_report.py DUMPS... --xplane /tmp/xprof_capture
+    python tools/trace_report.py DUMPS... --prefix step. --top 20
+
+--merge writes one chrome://tracing JSON: each process is a chrome
+pid named by its label, and spans of the same sync round share a
+``cid`` arg ((round, sender, seq) wire identity) — select one in the
+viewer to correlate a trainer's send/barrier/get with the pserver's
+scatter/apply for that round.
+"""
+import argparse
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+
+def main(argv=None):
+    from paddle_tpu.observability import export
+
+    ap = argparse.ArgumentParser(
+        description="merge telemetry dumps; print per-phase breakdown")
+    ap.add_argument("dumps", nargs="+",
+                    help="per-process trace dump JSON files")
+    ap.add_argument("--merge", default=None, metavar="OUT.json",
+                    help="write the merged chrome://tracing JSON here")
+    ap.add_argument("--xplane", default=None, metavar="DIR",
+                    help="jax.profiler.trace capture dir to merge "
+                         "device ops from")
+    ap.add_argument("--prefix", default="",
+                    help="only report span names with this prefix "
+                         "(e.g. 'step.' for the executor phases)")
+    ap.add_argument("--top", type=int, default=0,
+                    help="limit the table to the top-N phases by total")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the breakdown rows as JSON instead")
+    args = ap.parse_args(argv)
+
+    trace, dumps = export.merge_files(args.dumps, out_path=args.merge,
+                                      xplane=args.xplane)
+    rows = export.phase_rows(dumps)
+    if args.prefix:
+        rows = [r for r in rows if r["name"].startswith(args.prefix)]
+    if args.json:
+        print(json.dumps(rows, indent=2))
+    else:
+        total_spans = sum(len(d.get("spans", [])) for d in dumps)
+        print("%d process dump(s), %d spans, %d trace events%s" % (
+            len(dumps), total_spans, len(trace["traceEvents"]),
+            (" -> %s" % args.merge) if args.merge else ""))
+        open_spans = [s for d in dumps
+                      for s in d.get("open_spans", [])]
+        if open_spans:
+            print("OPEN (never finished — where each thread was "
+                  "blocked at dump time):")
+            for s in open_spans:
+                print("  %-32s elapsed %.1f ms  %s" % (
+                    s["name"], s.get("elapsed_us", 0) / 1e3,
+                    s.get("cid", "")))
+        print(export.format_phase_table(rows, top=args.top))
+    if not rows:
+        # a written --merge artifact is a success even when the table
+        # filter matched nothing (e.g. --prefix step. on pserver-only
+        # dumps); fail only when the run produced no output at all
+        print("no completed spans matched", file=sys.stderr)
+        return 0 if args.merge else 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
