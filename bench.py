@@ -189,15 +189,55 @@ def _ensure_bench_recordio(img_shape, data_set, n=2048):
     return path
 
 
+def _xplane_categories(profile_dir):
+    """xplane-sourced per-category device ms for a bench JSON (ISSUE
+    5/7): where the step's bytes actually go.  Table goes to stderr;
+    returns the dict (or an error marker — profile parse never sinks a
+    bench)."""
+    import glob
+
+    from paddle_tpu.utils.xplane import print_category_profile
+    pbs = sorted(glob.glob(os.path.join(
+        profile_dir, "**", "*.xplane.pb"), recursive=True),
+        key=os.path.getmtime)
+    if not pbs:
+        return None
+    stdout, sys.stdout = sys.stdout, sys.stderr
+    try:
+        print("category profile (%s):" % pbs[-1])
+        cats = print_category_profile(pbs[-1])
+        return {c["category"]: round(c["time_ps"] / 1e9, 1)
+                for c in cats[:8]}
+    except Exception as e:
+        return {"error": str(e)[:120]}
+    finally:
+        sys.stdout = stdout
+
+
 def transformer_bench(on_accel, as_dict=False):
     """BENCH_MODEL=transformer: bf16 LM training tokens/sec (flash
     attention on the TPU path; second headline next to ResNet-50).
 
     ``as_dict``: run with the compute-bound flagship dims (d1024 L6 —
     0.55 MFU measured on v5e) and return the result instead of printing,
-    for embedding as the ``secondary`` metric of the default bench."""
+    for embedding as the ``secondary`` metric of the default bench.
+
+    ISSUE 7 knobs: BENCH_FUSED_TRANSFORMER=1 runs
+    FuseTransformerBlockPass at build time (fused QKV / matmul
+    epilogues / add+LN backed by kernels/matmul_fused.py) — the JSON
+    then reports ``fused_stages`` + per-category counts; BENCH_PROFILE
+    adds xplane-sourced ``per_category_ms``.  FLAGS_autotune_cache_dir
+    (or BENCH_AUTOTUNE_CACHE) points the kernels at the persistent
+    tile cache the tune tools write."""
     import paddle_tpu.fluid as fluid
+    from paddle_tpu.core.flags import FLAGS
     from paddle_tpu.models import transformer
+
+    if os.environ.get("BENCH_FUSED_TRANSFORMER") is not None:
+        FLAGS.transformer_fuse = \
+            os.environ["BENCH_FUSED_TRANSFORMER"] == "1"
+    if os.environ.get("BENCH_AUTOTUNE_CACHE"):
+        FLAGS.autotune_cache_dir = os.environ["BENCH_AUTOTUNE_CACHE"]
 
     if as_dict:
         bs, seq, iters = 16, 2048, 10
@@ -210,8 +250,15 @@ def transformer_bench(on_accel, as_dict=False):
         n_layers = int(os.environ.get("BENCH_LAYERS", "6"))
         n_head = int(os.environ.get("BENCH_HEADS", "8"))
     else:
-        bs, seq, iters = 2, 128, 3
-        d_model, n_layers, n_head = 64, 2, 4
+        # CPU tier: tiny defaults, but explicit BENCH_* dims are
+        # honored so the fused-vs-unfused comparison can run at a
+        # noise-resistant shape (PROFILE_r07.md uses bs4 seq256 d256)
+        bs = int(os.environ.get("BENCH_BATCH", "2"))
+        seq = int(os.environ.get("BENCH_SEQ", "128"))
+        iters = int(os.environ.get("BENCH_ITERS", "3"))
+        d_model = int(os.environ.get("BENCH_DMODEL", "64"))
+        n_layers = int(os.environ.get("BENCH_LAYERS", "2"))
+        n_head = int(os.environ.get("BENCH_HEADS", "4"))
     vocab = 8192
     amp = os.environ.get("BENCH_AMP", "1" if on_accel else "0") == "1"
 
@@ -240,9 +287,13 @@ def transformer_bench(on_accel, as_dict=False):
         exe.run(main_prog, feed=feed, fetch_list=[avg_cost])
     import contextlib
     prof_ctx = contextlib.nullcontext()
+    profile_dir = None
     if os.environ.get("BENCH_PROFILE"):
         import jax
-        prof_ctx = jax.profiler.trace(os.environ["BENCH_PROFILE"])
+        # own subdir: the headline loop's capture globs the same root
+        profile_dir = os.path.join(os.environ["BENCH_PROFILE"],
+                                   "transformer")
+        prof_ctx = jax.profiler.trace(profile_dir)
     from paddle_tpu.observability import metrics as obs_metrics
     h_step = obs_metrics.histogram(
         "bench_transformer_step_ms",
@@ -257,6 +308,14 @@ def transformer_bench(on_accel, as_dict=False):
         loss = np.asarray(loss)
         elapsed = time.time() - t0
     tokens_per_sec = bs * seq * iters / elapsed
+    # fused-stage evidence (ISSUE 7): the JSON row names the program it
+    # measured, like the headline's data_format/fused_stages fields
+    fwd_fused = [op.type for op in main_prog.desc.blocks[0].ops
+                 if op.type.startswith("fused_") and
+                 not op.type.endswith("_grad")]
+    fused_counts = {}
+    for t in fwd_fused:
+        fused_counts[t] = fused_counts.get(t, 0) + 1
     out = {
         "metric": "transformer_lm_d%d_L%d_train_bs%d_seq%d%s" % (
             d_model, n_layers, bs, seq, "_bf16" if amp else ""),
@@ -267,7 +326,18 @@ def transformer_bench(on_accel, as_dict=False):
         "step_ms_p50": round(h_step.percentile(50), 3),
         "step_ms_p90": round(h_step.percentile(90), 3),
         "step_ms_p99": round(h_step.percentile(99), 3),
+        "fused_stages": len(fwd_fused),
     }
+    if fused_counts:
+        out["fused_stage_counts"] = fused_counts
+    if FLAGS.autotune_cache_dir:
+        from paddle_tpu import tuning
+        out["autotune_cache_dir"] = FLAGS.autotune_cache_dir
+        out["autotune_cache_entries"] = len(tuning.entries())
+    if profile_dir:
+        cats = _xplane_categories(profile_dir)
+        if cats:
+            out["per_category_ms"] = cats
     if on_accel:
         # standard analytic count: 6*N_params FLOPs/token (fwd+bwd) +
         # causal attention 6*L*d_model*T (the scaling-book estimate)
@@ -605,29 +675,11 @@ def main():
         elapsed = time.time() - t0
     if prepared is not None:
         prepared.sync_scope()
-    per_category_ms = None
-    if profile_dir:
-        import glob
-
-        from paddle_tpu.utils.xplane import print_category_profile
-        pbs = sorted(glob.glob(os.path.join(
-            profile_dir, "**", "*.xplane.pb"), recursive=True),
-            key=os.path.getmtime)
-        if pbs:
-            stdout, sys.stdout = sys.stdout, sys.stderr
-            try:
-                print("category profile (%s):" % pbs[-1])
-                cats = print_category_profile(pbs[-1])
-                # xplane-sourced per-category device ms for the headline
-                # JSON (ISSUE 5): where the step's bytes actually go —
-                # the "data formatting" row is lever (a)'s target
-                per_category_ms = {
-                    c["category"]: round(c["time_ps"] / 1e9, 1)
-                    for c in cats[:8]}
-            except Exception as e:  # profile parse never sinks the bench
-                per_category_ms = {"error": str(e)[:120]}
-            finally:
-                sys.stdout = stdout
+    # xplane-sourced per-category device ms for the headline JSON
+    # (ISSUE 5): where the step's bytes actually go — the "data
+    # formatting" row is lever (a)'s target
+    per_category_ms = _xplane_categories(profile_dir) if profile_dir \
+        else None
 
     images_per_sec = batch_size * iters / elapsed
 

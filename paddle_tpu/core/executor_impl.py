@@ -145,6 +145,14 @@ def _prepare_lod_feeds(feed):
     return feed
 
 
+def _tuning_fingerprint():
+    try:
+        from paddle_tpu import tuning
+        return tuning.fingerprint()
+    except Exception:
+        return ("", 0, 0)
+
+
 def _cache_key(program, block_id, feed_spec, fetch_list, mode):
     """The ONE compiled-entry cache key — shared by run()'s per-feed
     path and prepare(), so a prepared program and run() with the same
@@ -161,7 +169,11 @@ def _cache_key(program, block_id, feed_spec, fetch_list, mode):
             # stale executable (ISSUE 5 lever c; see flags.py
             # apply_xla_flags for the process-lifetime caveat)
             bool(FLAGS.xla_latency_hiding_scheduler),
-            str(FLAGS.xla_extra_flags))
+            str(FLAGS.xla_extra_flags),
+            # autotune-cache state (ISSUE 7): lowerings consult the
+            # cache at trace time, so a re-tuned cache (new file, new
+            # dir, or an in-process record()) must recompile
+            _tuning_fingerprint())
 
 
 class _CacheEntry:

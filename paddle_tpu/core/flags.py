@@ -140,6 +140,30 @@ define_flag("conv_fused_stages", True,
             "(conv+BN(+residual)(+relu) -> fused_conv2d_bn_act backed "
             "by kernels/conv_fused.py); off = layout pass alone, for "
             "attributing wins between the two levers")
+define_flag("transformer_fuse", False,
+            "transformer block fusion (ISSUE 7): models that honor the "
+            "flag (models/transformer.py get_model) run "
+            "FuseTransformerBlockPass before backward generation — the "
+            "QKV projections collapse to one wide matmul, "
+            "matmul+bias(+gelu/relu)(+dropout)(+residual) chains and "
+            "residual-add+layer_norm chains become fused ops backed by "
+            "kernels/matmul_fused.py (f32 VMEM accumulator epilogues, "
+            "explicit saved-activation grad lowerings, identical-math "
+            "XLA fallback off-TPU / over-budget).  Acts at PROGRAM "
+            "BUILD time, like conv_layout; the unfused program stays "
+            "the default for bisection")
+define_flag("autotune_cache_dir", "",
+            "persistent shape-keyed autotune cache directory "
+            "(paddle_tpu/tuning): sweep tools (conv_tune/flash_tune/"
+            "matmul_tune) record their best tile configs per (kernel, "
+            "shape, dtype, backend) into autotune_cache.json here, and "
+            "kernel lowerings consult it at compile time — every "
+            "future model inherits the best tiles instead of "
+            "re-sweeping.  Unset (default) = built-in defaults; a "
+            "corrupt/missing cache file degrades to defaults without "
+            "error.  The cache fingerprint rides the executor "
+            "compile-cache key, so re-tuning never serves a stale "
+            "executable")
 define_flag("xla_latency_hiding_scheduler", False,
             "enable XLA's latency-hiding scheduler "
             "(--xla_tpu_enable_latency_hiding_scheduler): overlaps "

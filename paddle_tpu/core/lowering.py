@@ -85,7 +85,27 @@ def _amp_cast_ins(op_type, ins, role=0):
 
         return Ins({s: [conv_slot(s, v) for v in vs]
                     for s, vs in ins._d.items()})
-    if op_type in AMP_WHITE:
+    if op_type == "fused_add_ln":
+        # mirror the unfused chain under AMP: the residual add is
+        # whitelisted (activation streams go bf16) while layer_norm
+        # passes through — Scale/Bias keep their stored dtype and the
+        # lowering computes statistics in f32 / applies the affine in
+        # x.dtype, exactly like the layer_norm lowering
+        stream_slots = ("X", "Y")
+
+        def ln_slot(slot, x):
+            if slot in stream_slots and x is not None and \
+                    getattr(x, "dtype", None) == jnp.float32:
+                return x.astype(jnp.bfloat16)
+            return x
+
+        return Ins({s: [ln_slot(s, v) for v in vs]
+                    for s, vs in ins._d.items()})
+    if op_type in AMP_WHITE or op_type in (
+            "fused_matmul_bias_act", "fused_qkv_matmul"):
+        # the fused matmul ops absorb whitelisted chains (mul +
+        # elementwise_add bias/residual + act): every f32 operand goes
+        # bf16, matching the unfused ops' casts slot for slot
         if op_type == "elementwise_add":
             # only activation-shaped adds (bias/residual): scalar or [1]
             # adds are lr-schedule / counter arithmetic and keep fp32

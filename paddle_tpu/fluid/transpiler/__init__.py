@@ -6,7 +6,10 @@ from .layout_transpiler import LayoutTranspiler  # noqa: F401
 from .memory_optimization_transpiler import (  # noqa: F401
     memory_optimize, release_memory)
 from .ps_dispatcher import RoundRobin, HashName, PSDispatcher  # noqa: F401
+from .transformer_fuse import (  # noqa: F401
+    FuseTransformerBlockPass, TransformerFuseTranspiler)
 
 __all__ = ["DistributeTranspiler", "slice_variable", "Float16Transpiler",
            "InferenceTranspiler", "LayoutTranspiler", "memory_optimize",
-           "release_memory", "RoundRobin", "HashName", "PSDispatcher"]
+           "release_memory", "RoundRobin", "HashName", "PSDispatcher",
+           "FuseTransformerBlockPass", "TransformerFuseTranspiler"]

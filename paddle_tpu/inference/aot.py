@@ -192,9 +192,15 @@ def load_aot(dirname, scope, place):
         # backend must be the PLACE's client, not the process default —
         # with an accelerator plugin present, a cpu-compiled artifact
         # would otherwise be handed to the accelerator runtime
+        # jax grew (then required) execution_devices across the versions
+        # this repo meets; pass it only when this jax accepts it
+        import inspect
+        kwargs = {"backend": dev.client}
+        if "execution_devices" in inspect.signature(
+                serialize_executable.deserialize_and_load).parameters:
+            kwargs["execution_devices"] = [dev]
         compiled = serialize_executable.deserialize_and_load(
-            *payload, backend=dev.client,
-            execution_devices=[dev])
+            *payload, **kwargs)
         return AotExecutable(compiled, meta, scope, place)
     except Exception as e:
         # version/backend drift — the re-jit path still works, but say so

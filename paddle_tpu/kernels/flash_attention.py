@@ -123,12 +123,28 @@ def _fit_block(block, size):
     return block
 
 
+DEF_BLOCK_Q = 1024
+DEF_BLOCK_K = 1024
+
+
+def _tuned_config(q_shape, kv_len, dtype):
+    """Autotune-cache hit for this attention shape ({} on miss) — the
+    persistent form of a flash_tune.py sweep (ISSUE 7).  Keyed on
+    (B, H, T, D, T_kv) + dtype + backend; consulted at trace time."""
+    from paddle_tpu import tuning
+
+    cfg = tuning.lookup("flash_attention",
+                        tuple(q_shape) + (int(kv_len),),
+                        jnp.dtype(dtype).name)
+    return cfg or {}
+
+
 # launch-site span (FLAGS_telemetry): trace/lowering-time cost; the
 # device-side kernel time lives in the xplane capture
 @_traced("pallas.flash_attention",
          lambda q, *a, **kw: {"q": str(q.shape)})
-def flash_attention(q, k, v, scale=None, causal=False, block_q=1024,
-                    block_k=1024, force_xla=False, interpret=False,
+def flash_attention(q, k, v, scale=None, causal=False, block_q=None,
+                    block_k=None, force_xla=False, interpret=False,
                     block_q_bwd=None, block_k_bwd=None,
                     block_q_dkv=None, block_k_dkv=None):
     """softmax(QK^T scale) V, [B,H,T,D] in/out.
@@ -144,11 +160,28 @@ def flash_attention(q, k, v, scale=None, causal=False, block_q=1024,
     ``block_q_dkv``/``block_k_dkv`` override the dK/dV kernel alone —
     its transpose-free [bk, bq] tile orientation (``_dkv_kernel``) has a
     different optimum than dQ's, so tools/flash_tune.py sweeps them
-    independently (VERDICT r5 weak #2)."""
+    independently (VERDICT r5 weak #2).
+
+    Tile arguments left as None resolve through the persistent autotune
+    cache (paddle_tpu/tuning, written by flash_tune.py) and fall back to
+    the built-in defaults on a miss; an explicit argument always wins."""
     b, h, t, d = q.shape
     tk = k.shape[2]
     if scale is None:
         scale = 1.0 / np.sqrt(d)
+    cfg = _tuned_config(q.shape, tk, q.dtype)
+    if block_q is None:
+        block_q = int(cfg.get("block_q", DEF_BLOCK_Q))
+    if block_k is None:
+        block_k = int(cfg.get("block_k", DEF_BLOCK_K))
+    if block_q_bwd is None:
+        block_q_bwd = cfg.get("block_q_bwd")
+    if block_k_bwd is None:
+        block_k_bwd = cfg.get("block_k_bwd")
+    if block_q_dkv is None:
+        block_q_dkv = cfg.get("block_q_dkv")
+    if block_k_dkv is None:
+        block_k_dkv = cfg.get("block_k_dkv")
     on_tpu = target_platform() == "tpu"
 
     block_q = _fit_block(block_q, t)
@@ -434,7 +467,7 @@ def _flash_pallas(q, k, v, scale, causal, block_q, block_k, interpret):
 
 
 def flash_attention_fwd_lse(q, k, v, scale=None, causal=False,
-                            block_q=1024, block_k=1024, force_xla=False,
+                            block_q=None, block_k=None, force_xla=False,
                             interpret=False):
     """Forward returning ``(out, lse)`` — the op-level residual form.
 
@@ -448,6 +481,11 @@ def flash_attention_fwd_lse(q, k, v, scale=None, causal=False,
     tk = k.shape[2]
     if scale is None:
         scale = 1.0 / np.sqrt(d)
+    cfg = _tuned_config(q.shape, tk, q.dtype)
+    if block_q is None:
+        block_q = int(cfg.get("block_q", DEF_BLOCK_Q))
+    if block_k is None:
+        block_k = int(cfg.get("block_k", DEF_BLOCK_K))
     block_q = _fit_block(block_q, t)
     block_k = _fit_block(block_k, tk)
     usable = (t % block_q == 0 and tk % block_k == 0)
@@ -468,7 +506,7 @@ def flash_attention_fwd_lse(q, k, v, scale=None, causal=False,
 
 
 def flash_attention_bwd(q, k, v, out, lse, do, scale=None, causal=False,
-                        block_q=1024, block_k=1024, force_xla=False,
+                        block_q=None, block_k=None, force_xla=False,
                         interpret=False):
     """Backward from op-level residuals: rebuilds P tile-by-tile from
     the saved lse (Dao et al. 2022 alg. 2) — no forward re-execution,
@@ -477,6 +515,11 @@ def flash_attention_bwd(q, k, v, out, lse, do, scale=None, causal=False,
     tk = k.shape[2]
     if scale is None:
         scale = 1.0 / np.sqrt(d)
+    cfg = _tuned_config(q.shape, tk, q.dtype)
+    if block_q is None:
+        block_q = int(cfg.get("block_q", DEF_BLOCK_Q))
+    if block_k is None:
+        block_k = int(cfg.get("block_k", DEF_BLOCK_K))
     block_q = _fit_block(block_q, t)
     block_k = _fit_block(block_k, tk)
     usable = (t % block_q == 0 and tk % block_k == 0)
@@ -501,14 +544,22 @@ def flash_attention_bwd(q, k, v, out, lse, do, scale=None, causal=False,
                 dv.astype(v.dtype))
     do = do.astype(out.dtype)
     delta = (do.astype(jnp.float32) * out.astype(jnp.float32)).sum(-1)
-    bq = _fit_block(min(block_q, 512), t)
-    bk = _fit_block(block_k, tk)     # K tile follows the forward (see
-    if t % bq:                       # the cap note in _flash_bwd)
-        bq = block_q
+    bq = _fit_block(cfg.get("block_q_bwd") or min(block_q, 512), t)
+    bk = _fit_block(cfg.get("block_k_bwd") or block_k, tk)
+    if t % bq:                       # K tile follows the forward (see
+        bq = block_q                 # the cap note in _flash_bwd)
     if tk % bk:
         bk = block_k
+    # dK/dV-specific tiles: tuned independently of dQ's (the [bk, bq]
+    # orientation streams the Q axis — see _dkv_kernel)
+    bq_dkv = _fit_block(cfg.get("block_q_dkv") or bq, t)
+    bk_dkv = _fit_block(cfg.get("block_k_dkv") or bk, tk)
+    if t % bq_dkv:
+        bq_dkv = bq
+    if tk % bk_dkv:
+        bk_dkv = bk
     dq = _flash_bwd_dq(q, k, v, do, lse, delta, scale, causal, bq, bk,
                        interpret)
     dk, dv = _flash_bwd_dkv(q, k, v, do, lse, delta, scale, causal,
-                            bq, bk, interpret)
+                            bq_dkv, bk_dkv, interpret)
     return dq, dk, dv

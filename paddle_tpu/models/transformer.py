@@ -120,8 +120,22 @@ def transformer_lm(src, vocab_size, max_len, d_model=256, n_head=8,
 
 def get_model(vocab_size=1000, seq_len=64, batch_size=None, d_model=256,
               n_head=8, n_layers=4, d_ff=1024, learning_rate=1e-3,
-              tp=False, sp=False, moe_experts=0, ep=False):
-    """(avg_cost, [src, label], []) — next-token LM loss."""
+              tp=False, sp=False, moe_experts=0, ep=False,
+              fuse_transformer=None):
+    """(avg_cost, [src, label], []) — next-token LM loss.
+
+    ``fuse_transformer`` None → ``FLAGS.transformer_fuse``; True runs
+    FuseTransformerBlockPass on the built graph BEFORE backward
+    generation (fused QKV / matmul+bias+act / residual+LN ops backed by
+    kernels/matmul_fused.py), so minimize differentiates the fused
+    forward through the explicit saved-activation grad lowerings.  The
+    unfused program stays the default for bisection, like conv_layout.
+    """
+    from paddle_tpu.core.flags import FLAGS
+
+    if fuse_transformer is None:
+        fuse_transformer = bool(FLAGS.transformer_fuse)
+
     src = fluid.layers.data(name="src", shape=[seq_len], dtype="int64")
     label = fluid.layers.data(name="label", shape=[seq_len, 1],
                               dtype="int64")
@@ -130,6 +144,9 @@ def get_model(vocab_size=1000, seq_len=64, batch_size=None, d_model=256,
                             moe_experts=moe_experts, ep=ep)
     loss = fluid.layers.softmax_with_cross_entropy(logits, label)
     avg_cost = fluid.layers.mean(loss)
+    if fuse_transformer:
+        from paddle_tpu.fluid.transpiler import TransformerFuseTranspiler
+        TransformerFuseTranspiler().transpile(fluid.default_main_program())
     opt = fluid.optimizer.Adam(learning_rate=learning_rate)
     opt.minimize(avg_cost)
     return avg_cost, [src, label], []

@@ -21,7 +21,7 @@ import os
 from . import metrics
 
 __all__ = ["load_dump", "chrome_trace", "merge_files", "phase_rows",
-           "format_phase_table"]
+           "format_phase_table", "kernel_rows", "format_kernel_table"]
 
 
 def load_dump(path):
@@ -144,6 +144,71 @@ def phase_rows(dumps):
         })
     rows.sort(key=lambda r: -r["total_ms"])
     return rows
+
+
+def _kernel_group(name):
+    """Normalize a device-op / launch-site name to its kernel family:
+    'pallas.flash_attention' -> 'flash_attention',
+    '%fusion.123' / 'fusion.4' -> 'fusion',
+    'jit__matmul_kernel.12' -> 'jit__matmul_kernel' — so one row per
+    kernel, not one per compiled instance."""
+    import re
+
+    if name.startswith("pallas."):
+        name = name[len("pallas."):]
+    name = name.lstrip("%")
+    # strip compiled-instance suffixes ('.123') only — a bare trailing
+    # digit is part of the op name ('exp2', 'atan2')
+    name = re.sub(r"(\.\d+)+$", "", name)
+    return name or "?"
+
+
+def kernel_rows(dumps, trace=None):
+    """Per-kernel rollup (ISSUE 7 satellite): Pallas launch-site spans
+    (the ``pallas.*`` spans the kernels emit under FLAGS_telemetry)
+    grouped by kernel name, merged with device-side events from an
+    --xplane capture (cat 'device' in the merged chrome trace), so a
+    fusion win is readable straight from a telemetry dump.  Returns
+    [{kernel, side, count, total_ms, mean_ms, share}] sorted by total
+    time; host and device entries stay separate rows ('side')."""
+    groups = {}
+    for d in dumps:
+        for s in d.get("spans", []):
+            if not s.get("name", "").startswith("pallas."):
+                continue
+            dur = s.get("dur_us")
+            if dur is None:     # open span: no duration to roll up
+                continue
+            key = (_kernel_group(s["name"]), "host")
+            groups.setdefault(key, []).append(dur / 1e3)
+    for ev in (trace or {}).get("traceEvents", []):
+        if ev.get("cat") != "device" or ev.get("ph") != "X":
+            continue
+        key = (_kernel_group(ev.get("name", "?")), "device")
+        groups.setdefault(key, []).append((ev.get("dur") or 0) / 1e3)
+    total = {side: sum(sum(v) for (k, s), v in groups.items()
+                       if s == side) or 1e-12
+             for side in ("host", "device")}
+    rows = []
+    for (kernel, side), vals in groups.items():
+        rows.append({
+            "kernel": kernel, "side": side, "count": len(vals),
+            "total_ms": round(sum(vals), 3),
+            "mean_ms": round(sum(vals) / len(vals), 3),
+            "share": round(sum(vals) / total[side], 4),
+        })
+    rows.sort(key=lambda r: (r["side"], -r["total_ms"]))
+    return rows
+
+
+def format_kernel_table(rows):
+    out = ["%-40s %-7s %7s %10s %9s %7s" % (
+        "kernel", "side", "count", "total_ms", "mean_ms", "share")]
+    for r in rows:
+        out.append("%-40s %-7s %7d %10.3f %9.3f %6.1f%%" % (
+            r["kernel"][:40], r["side"], r["count"], r["total_ms"],
+            r["mean_ms"], 100.0 * r["share"]))
+    return "\n".join(out)
 
 
 def format_phase_table(rows, top=0):

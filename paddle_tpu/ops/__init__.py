@@ -7,6 +7,7 @@ ops default to the vjp of the forward lowering (core/lowering.py).
 from paddle_tpu.ops import (  # noqa: F401
     math,
     nn,
+    fused_ops,
     loss,
     tensor,
     random,

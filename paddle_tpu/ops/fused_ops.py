@@ -1,0 +1,358 @@
+"""Fused transformer-block ops (ISSUE 7).
+
+The ops FuseTransformerBlockPass (fluid/transpiler/transformer_fuse.py)
+emits, backed by the Pallas kernels in kernels/matmul_fused.py:
+
+- ``fused_qkv_matmul``:   X @ [W_q | W_k | W_v] — one wide matmul
+  feeding flash attention's q/k/v instead of three reads of X.
+- ``fused_matmul_bias_act``: matmul + bias (+relu/gelu) (+dropout)
+  (+residual add) with the elementwise tail fused into the matmul's
+  f32 VMEM accumulator epilogue.
+- ``fused_add_ln``: LayerNorm(X + Y) with the residual sum and the LN
+  statistics computed from one VMEM tile; the sum is also an output
+  (the residual stream reads it downstream).
+
+Each has an EXPLICIT grad lowering consuming the forward's saved
+activations (MulOut / Mask / Sum) — the dropout-Mask pattern from
+fused_conv2d_bn_act: the backward never re-executes the forward matmul
+or activation chain.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.registry import register_op
+
+
+def _flat2(x, num_col_dims):
+    lead = x.shape[:num_col_dims]
+    return x.reshape(int(np.prod(lead)), -1), lead
+
+
+def _compute_dtype(ctx, *vals):
+    if getattr(ctx, "amp", False):
+        return jnp.bfloat16
+    return jnp.result_type(*vals)
+
+
+# ---------------------------------------------------------------------------
+# fused_qkv_matmul
+# ---------------------------------------------------------------------------
+
+def _qkv_lower(ctx, ins, attrs, op):
+    from paddle_tpu.kernels import matmul_fused
+
+    x = ins["X"]
+    ws = [w for w in ins.list("W") if w is not None]
+    xn = attrs.get("x_num_col_dims", 1)
+    x2, lead = _flat2(x, xn)
+    wcat = jnp.concatenate(ws, axis=1)
+    y2 = matmul_fused.matmul_epilogue(
+        x2, wcat,
+        force_xla=bool(attrs.get("force_xla", False)),
+        interpret=bool(attrs.get("interpret", False)))
+    outs = []
+    off = 0
+    for w in ws:
+        n = w.shape[1]
+        outs.append(y2[:, off:off + n].reshape(lead + (n,)))
+        off += n
+    return {"Out": outs}
+
+
+def _qkv_infer(ins, attrs, op):
+    x = ins["X"]
+    xn = attrs.get("x_num_col_dims", 1)
+    lead = x.shape[:xn]
+    return {"Out": [jax.ShapeDtypeStruct(lead + (w.shape[1],), x.dtype)
+                    for w in ins.list("W")]}
+
+
+register_op("fused_qkv_matmul", lower=_qkv_lower, infer_shape=_qkv_infer)
+
+
+@register_op("fused_qkv_matmul_grad", grad_maker=None)
+def _qkv_grad(ctx, ins, attrs, op):
+    """One wide backward pair: dX = dYcat @ Wcat^T and
+    dWcat = X^T @ dYcat, sliced back per head — the same two matmuls
+    the unfused three-mul chain needs, at a third of the X reads."""
+    x = ins["X"]
+    ws = list(ins.list("W"))
+    xn = attrs.get("x_num_col_dims", 1)
+    x2, _ = _flat2(x, xn)
+    m = x2.shape[0]
+    dys = list(ins.list("Out@GRAD"))
+    d2s = []
+    for w, dy in zip(ws, dys):
+        if dy is None:
+            d2s.append(jnp.zeros((m, w.shape[1]),
+                                 jnp.result_type(x2, w)))
+        else:
+            d2s.append(dy.reshape(m, w.shape[1]))
+    dcat = jnp.concatenate(d2s, axis=1)
+    wcat = jnp.concatenate(ws, axis=1)
+    cdt = _compute_dtype(ctx, x2, wcat)
+    dx2 = jnp.dot(dcat.astype(cdt), wcat.astype(cdt).T,
+                  preferred_element_type=jnp.result_type(x2))
+    dwcat = jnp.dot(x2.astype(cdt).T, dcat.astype(cdt),
+                    preferred_element_type=jnp.result_type(wcat))
+    dws = []
+    off = 0
+    for w in ws:
+        n = w.shape[1]
+        dws.append(dwcat[:, off:off + n].astype(w.dtype))
+        off += n
+    return {"X@GRAD": dx2.reshape(x.shape).astype(x.dtype),
+            "W@GRAD": dws}
+
+
+# ---------------------------------------------------------------------------
+# fused_matmul_bias_act
+# ---------------------------------------------------------------------------
+
+def _mba_lower(ctx, ins, attrs, op):
+    from paddle_tpu.kernels import matmul_fused
+
+    x, w = ins["X"], ins["W"]
+    bias = ins.get("Bias")
+    residual = ins.get("Residual")
+    xn = attrs.get("x_num_col_dims", 1)
+    act = attrs.get("act", "")
+    p = float(attrs.get("dropout_prob", 0.0))
+    is_test = bool(attrs.get("is_test", False)) or ctx.mode == "test"
+    force_xla = bool(attrs.get("force_xla", False))
+    interpret = bool(attrs.get("interpret", False))
+    x2, lead = _flat2(x, xn)
+    n = w.shape[1]
+    res2 = residual.reshape(-1, n) if residual is not None else None
+    save_pre = bool(op.outputs.get("MulOut"))
+    want_mask = bool(op.outputs.get("Mask"))
+
+    outs = {}
+    if p > 0.0 and not is_test:
+        # matmul+bias+act in the kernel; the dropout mask and the
+        # residual tail compose in XLA (mask generation needs the
+        # program PRNG stream, which lives outside the kernel)
+        r = matmul_fused.matmul_epilogue(
+            x2, w, bias, None, act, save_preact=save_pre,
+            force_xla=force_xla, interpret=interpret)
+        h2, pre2 = r if save_pre else (r, None)
+        seed = attrs.get("seed", 0)
+        key = jax.random.PRNGKey(seed) if seed else ctx.next_key()
+        # draw at the op-output shape so an explicit seed reproduces
+        # the unfused dropout op's mask bit-for-bit (same key, same
+        # element count, same layout)
+        keep = jax.random.bernoulli(key, 1.0 - p, lead + (h2.shape[-1],))
+        mask2 = keep.astype(h2.dtype).reshape(h2.shape)
+        if attrs.get("dropout_implementation",
+                     "downgrade_in_infer") == "upscale_in_train":
+            mask2 = mask2 / (1.0 - p)
+        y2 = h2 * mask2
+        if res2 is not None:
+            y2 = y2 + res2
+        outs["Mask"] = mask2.reshape(lead + (n,))
+    else:
+        if p > 0.0:  # test mode: downgrade (reference dropout_op)
+            impl = attrs.get("dropout_implementation",
+                             "downgrade_in_infer")
+            r = matmul_fused.matmul_epilogue(
+                x2, w, bias, None, act, save_preact=save_pre,
+                force_xla=force_xla, interpret=interpret)
+            h2, pre2 = r if save_pre else (r, None)
+            if impl != "upscale_in_train":
+                h2 = h2 * (1.0 - p)
+            y2 = h2 + res2 if res2 is not None else h2
+            if want_mask:
+                outs["Mask"] = jnp.ones(lead + (n,), h2.dtype)
+        else:
+            r = matmul_fused.matmul_epilogue(
+                x2, w, bias, res2, act, save_preact=save_pre,
+                force_xla=force_xla, interpret=interpret)
+            y2, pre2 = r if save_pre else (r, None)
+    outs["Out"] = y2.reshape(lead + (n,)).astype(x.dtype)
+    if save_pre and pre2 is not None:
+        outs["MulOut"] = pre2.reshape(lead + (n,))
+    return outs
+
+
+def _mba_infer(ins, attrs, op):
+    x, w = ins["X"], ins["W"]
+    xn = attrs.get("x_num_col_dims", 1)
+    shp = x.shape[:xn] + (w.shape[1],)
+    sds = jax.ShapeDtypeStruct
+    return {"Out": sds(shp, x.dtype), "MulOut": sds(shp, x.dtype),
+            "Mask": sds(shp, x.dtype)}
+
+
+register_op("fused_matmul_bias_act", lower=_mba_lower,
+            infer_shape=_mba_infer, stateful=True)
+
+
+@register_op("fused_matmul_bias_act_grad", grad_maker=None)
+def _mba_grad(ctx, ins, attrs, op):
+    """Backward from saved residuals only: the activation derivative
+    comes from MulOut (or the Out sign for plain relu), the dropout
+    tail replays the saved Mask, and the two grad matmuls run on the
+    forward's operands — no forward re-execution."""
+    x, w = ins["X"], ins["W"]
+    bias = ins.get("Bias")
+    residual = ins.get("Residual")
+    dy = ins["Out@GRAD"]
+    xn = attrs.get("x_num_col_dims", 1)
+    act = attrs.get("act", "")
+    p = float(attrs.get("dropout_prob", 0.0))
+    is_test = bool(attrs.get("is_test", False))
+    x2, _ = _flat2(x, xn)
+    n = w.shape[1]
+    dy2 = dy.reshape(-1, n)
+
+    dh = dy2
+    out_grads = {}
+    if residual is not None:
+        out_grads["Residual@GRAD"] = dy.reshape(
+            residual.shape).astype(residual.dtype)
+    mask = ins.get("Mask")
+    if p > 0.0 and not is_test and mask is not None:
+        dh = dh * mask.reshape(-1, n)
+    elif p > 0.0 and is_test and attrs.get(
+            "dropout_implementation",
+            "downgrade_in_infer") != "upscale_in_train":
+        dh = dh * (1.0 - p)
+
+    if act:
+        pre = ins.get("MulOut")
+        if pre is not None:
+            pre2 = pre.reshape(-1, n)
+            from paddle_tpu.kernels.matmul_fused import apply_act
+            _, act_vjp = jax.vjp(lambda t: apply_act(t, act), pre2)
+            dpre = act_vjp(dh.astype(pre2.dtype))[0]
+        elif act == "relu":
+            # no saved pre-activation: Out IS relu(pre) (the pass only
+            # omits MulOut when nothing follows the activation)
+            out = ins["Out"].reshape(-1, n)
+            dpre = jnp.where(out > 0, dh, jnp.zeros_like(dh))
+        else:
+            raise ValueError(
+                "fused_matmul_bias_act_grad: act %r needs the saved "
+                "MulOut output" % (act,))
+    else:
+        dpre = dh
+    # a direct MulOut consumer (a test harness differentiating through
+    # the saved pre-activation) contributes straight into dpre
+    dmul = ins.get("MulOut@GRAD")
+    if dmul is not None:
+        dpre = dpre + dmul.reshape(-1, n).astype(dpre.dtype)
+
+    if bias is not None:
+        out_grads["Bias@GRAD"] = dpre.sum(axis=0).astype(bias.dtype)
+    cdt = _compute_dtype(ctx, x2, w)
+    dx2 = jnp.dot(dpre.astype(cdt), w.astype(cdt).T,
+                  preferred_element_type=jnp.result_type(x2))
+    dw = jnp.dot(x2.astype(cdt).T, dpre.astype(cdt),
+                 preferred_element_type=jnp.result_type(w))
+    out_grads["X@GRAD"] = dx2.reshape(x.shape).astype(x.dtype)
+    out_grads["W@GRAD"] = dw.astype(w.dtype)
+    return out_grads
+
+
+# ---------------------------------------------------------------------------
+# fused_add_ln
+# ---------------------------------------------------------------------------
+
+def _add_ln_lower(ctx, ins, attrs, op):
+    from paddle_tpu.kernels import matmul_fused
+
+    x, y = ins["X"], ins["Y"]
+    scale, bias = ins.get("Scale"), ins.get("Bias")
+    begin = attrs.get("begin_norm_axis", 1)
+    eps = attrs.get("epsilon", 1e-5)
+    lead = x.shape[:begin]
+    d = int(np.prod(x.shape[begin:]))
+    x2 = x.reshape(-1, d)
+    y2 = y.reshape(-1, d)
+    out2, sum2, mean, var = matmul_fused.add_ln(
+        x2, y2, scale, bias, eps,
+        force_xla=bool(attrs.get("force_xla", False)),
+        interpret=bool(attrs.get("interpret", False)))
+    return {"Out": out2.reshape(x.shape), "Sum": sum2.reshape(x.shape),
+            "Mean": mean.reshape(lead), "Variance": var.reshape(lead)}
+
+
+def _add_ln_infer(ins, attrs, op):
+    x = ins["X"]
+    begin = attrs.get("begin_norm_axis", 1)
+    sds = jax.ShapeDtypeStruct
+    return {"Out": sds(x.shape, x.dtype), "Sum": sds(x.shape, x.dtype),
+            "Mean": sds(x.shape[:begin], x.dtype),
+            "Variance": sds(x.shape[:begin], x.dtype)}
+
+
+register_op("fused_add_ln", lower=_add_ln_lower,
+            infer_shape=_add_ln_infer)
+
+
+@register_op("fused_add_ln_grad", grad_maker=None)
+def _add_ln_grad(ctx, ins, attrs, op):
+    """Backward from the SAVED residual sum: the LN normalization is
+    replayed from Sum (association-identical to the layer_norm
+    lowering, so its vjp matches the unfused chain's vjp exactly) and
+    dX = dY = d(Sum) — the X+Y add is never re-executed, and a direct
+    Sum@GRAD contribution from other Sum consumers folds in."""
+    from paddle_tpu.kernels import matmul_fused
+
+    x, y = ins["X"], ins["Y"]
+    scale, bias = ins.get("Scale"), ins.get("Bias")
+    s = ins["Sum"]
+    dout = ins["Out@GRAD"]
+    begin = attrs.get("begin_norm_axis", 1)
+    eps = attrs.get("epsilon", 1e-5)
+    d = int(np.prod(x.shape[begin:]))
+    s2 = s.reshape(-1, d)
+    dout2 = dout.reshape(-1, d)
+
+    def replay(s2_, scale_, bias_):
+        # the layer_norm lowering's exact math on the saved sum; Mean/
+        # Variance ride along so a direct consumer's cotangent (test
+        # harnesses; real programs mark them stop_gradient) folds in
+        return matmul_fused.ln_from_sum(s2_, scale_, bias_, eps)
+
+    rows = s2.shape[0]
+
+    def _aux_cot(slot):
+        g = ins.get(slot)
+        if g is None:
+            return jnp.zeros((rows,), s2.dtype)
+        return g.reshape(rows).astype(s2.dtype)
+
+    cots = (dout2.astype(s2.dtype), _aux_cot("Mean@GRAD"),
+            _aux_cot("Variance@GRAD"))
+    if scale is not None and bias is not None:
+        _, vjp = jax.vjp(replay, s2, scale, bias)
+        ds2, dscale, dbias = vjp(cots)
+    elif scale is not None:
+        _, vjp = jax.vjp(lambda a, b: replay(a, b, None), s2, scale)
+        ds2, dscale = vjp(cots)
+        dbias = None
+    elif bias is not None:
+        _, vjp = jax.vjp(lambda a, b: replay(a, None, b), s2, bias)
+        ds2, dbias = vjp(cots)
+        dscale = None
+    else:
+        _, vjp = jax.vjp(lambda a: replay(a, None, None), s2)
+        ds2, = vjp(cots)
+        dscale = dbias = None
+
+    dsum = ds2.reshape(x.shape)
+    dsum_in = ins.get("Sum@GRAD")
+    if dsum_in is not None:
+        dsum = dsum + dsum_in.astype(dsum.dtype)
+    out = {"X@GRAD": dsum.astype(x.dtype),
+           "Y@GRAD": dsum.astype(y.dtype)}
+    if dscale is not None:
+        out["Scale@GRAD"] = dscale.astype(scale.dtype)
+    if dbias is not None:
+        out["Bias@GRAD"] = dbias.astype(bias.dtype)
+    return out

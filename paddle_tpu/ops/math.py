@@ -100,6 +100,10 @@ _act("hard_shrink", lambda x, a: jnp.where(
 _act("softshrink", lambda x, a: jnp.sign(x) * jnp.maximum(
     jnp.abs(x) - a.get("lambda", 0.5), 0.0))
 _act("swish", lambda x, a: x * jax.nn.sigmoid(a.get("beta", 1.0) * x))
+# tanh-approximate gelu — the transformer MLP activation; the fused
+# matmul epilogue (kernels/matmul_fused.py apply_act) must stay in
+# lockstep with this definition
+_act("gelu", lambda x, a: jax.nn.gelu(x, approximate=True))
 _act("sign", lambda x, a: jnp.sign(x), grad_maker=None)
 
 

@@ -309,6 +309,19 @@ def _fused_conv_bn_lower(ctx, ins, attrs, op):
     interpret = bool(attrs.get("interpret", False))
     force_xla = bool(attrs.get("force_xla", False))
     co = w.shape[3]
+    if not force_xla:
+        # persistent autotune cache (ISSUE 7): conv_tune.py records the
+        # measured winner per stage shape — 'pallas' (the fused kernel)
+        # or 'xla' (the identical-math fallback was faster there)
+        from paddle_tpu import tuning
+
+        cfg = tuning.lookup(
+            "fused_conv2d_bn_act",
+            tuple(x.shape) + tuple(w.shape) +
+            tuple(strides) + tuple(paddings),
+            jnp.dtype(x.dtype).name)
+        if cfg and cfg.get("impl") == "xla":
+            force_xla = True
 
     if is_test:
         inv = jax.lax.rsqrt(var_in.astype(jnp.float32) + eps)

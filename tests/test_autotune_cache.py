@@ -1,0 +1,282 @@
+"""Persistent shape-keyed autotune cache (paddle_tpu/tuning): sweep
+writes an entry, a fresh process's lowering picks it up, a cached tile
+config provably changes the lowered kernel's grid/block spec, the
+executor compile-cache key tracks the cache state, and corrupt/missing
+cache files degrade to defaults without error (ISSUE 7 acceptance)."""
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+import paddle_tpu.fluid as fluid  # noqa: F401 — registers ops
+from paddle_tpu import tuning
+from paddle_tpu.core.flags import FLAGS
+from paddle_tpu.kernels import matmul_fused
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture
+def cache_dir(tmp_path):
+    old = FLAGS.autotune_cache_dir
+    FLAGS.autotune_cache_dir = str(tmp_path)
+    tuning.invalidate()
+    yield str(tmp_path)
+    FLAGS.autotune_cache_dir = old
+    tuning.invalidate()
+
+
+def test_record_lookup_roundtrip(cache_dir):
+    assert tuning.lookup("matmul_fused", (16, 128, 256),
+                         "float32") is None
+    fp0 = tuning.fingerprint()
+    assert tuning.record("matmul_fused", (16, 128, 256), "float32",
+                         {"block_m": 16, "block_n": 128,
+                          "block_k": 128}, ms=1.25, source="test")
+    cfg = tuning.lookup("matmul_fused", (16, 128, 256), "float32")
+    assert cfg == {"block_m": 16, "block_n": 128, "block_k": 128}
+    # different shape/dtype/kernel miss
+    assert tuning.lookup("matmul_fused", (16, 128, 512),
+                         "float32") is None
+    assert tuning.lookup("matmul_fused", (16, 128, 256),
+                         "bfloat16") is None
+    assert tuning.lookup("flash_attention", (16, 128, 256),
+                         "float32") is None
+    # the fingerprint changed -> executor compile cache cannot serve a
+    # stale executable
+    assert tuning.fingerprint() != fp0
+    # file on disk is the human-readable JSON
+    with open(tuning.cache_path()) as f:
+        data = json.load(f)
+    assert any("matmul_fused|16x128x256" in k for k in data["entries"])
+
+
+def test_disabled_cache_is_inert():
+    old = FLAGS.autotune_cache_dir
+    FLAGS.autotune_cache_dir = ""
+    tuning.invalidate()
+    try:
+        assert tuning.cache_path() is None
+        assert tuning.lookup("matmul_fused", (1, 2, 3),
+                             "float32") is None
+        assert tuning.record("matmul_fused", (1, 2, 3), "float32",
+                             {"block_m": 8}) is False
+        assert tuning.fingerprint() == ("", 0, 0)
+    finally:
+        FLAGS.autotune_cache_dir = old
+        tuning.invalidate()
+
+
+def test_corrupt_cache_degrades_to_defaults(cache_dir):
+    with open(os.path.join(cache_dir, tuning.CACHE_FILE), "w") as f:
+        f.write("{not json!!")
+    assert tuning.lookup("matmul_fused", (16, 128, 256),
+                         "float32") is None
+    # a kernel call with the corrupt cache present still runs (defaults)
+    x = jnp.ones((8, 128), jnp.float32)
+    w = jnp.ones((128, 128), jnp.float32)
+    y = matmul_fused.matmul_epilogue(x, w, interpret=True)
+    assert np.asarray(y).shape == (8, 128)
+    # and record() recovers the file
+    assert tuning.record("matmul_fused", (8, 128, 128), "float32",
+                         {"block_m": 8})
+    assert tuning.lookup("matmul_fused", (8, 128, 128),
+                         "float32") == {"block_m": 8}
+
+
+def _capture_grids(monkeypatch):
+    grids = []
+    orig = matmul_fused._pallas_call
+
+    def spy(kernel, **kwargs):
+        grids.append(kwargs.get("grid"))
+        return orig(kernel, **kwargs)
+
+    monkeypatch.setattr(matmul_fused, "_pallas_call", spy)
+    return grids
+
+
+def test_cached_tile_config_changes_grid(cache_dir, monkeypatch):
+    """ACCEPTANCE: a cached tile config changes the lowered kernel's
+    grid/block spec.  Same call, same shape — the only difference is
+    the cache entry, and the pallas grid provably follows it."""
+    grids = _capture_grids(monkeypatch)
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randn(32, 256), jnp.float32)
+    w = jnp.asarray(rng.randn(256, 256) * 0.1, jnp.float32)
+
+    y0 = matmul_fused.matmul_epilogue(x, w, interpret=True)
+    # defaults: blocks clamp to (32, 256, 256) -> grid (1, 1, 1)
+    assert grids[-1] == (1, 1, 1)
+
+    tuning.record("matmul_fused", (32, 256, 256), "float32",
+                  {"block_m": 8, "block_n": 128, "block_k": 128},
+                  source="test")
+    y1 = matmul_fused.matmul_epilogue(x, w, interpret=True)
+    assert grids[-1] == (4, 2, 2)   # 32/8, 256/128, 256/128
+    np.testing.assert_allclose(np.asarray(y0), np.asarray(y1),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_flash_attention_blocks_from_cache(cache_dir, monkeypatch):
+    """The flash kernels resolve None block args through the cache: the
+    tuned block_q/block_k reshape the pallas grid."""
+    import importlib
+
+    # the kernels package re-exports the flash_attention FUNCTION under
+    # the same name; import_module gets the module itself
+    fa = importlib.import_module("paddle_tpu.kernels.flash_attention")
+    grids = []
+    orig = fa.pl.pallas_call
+
+    def spy(kernel, **kwargs):
+        grids.append(kwargs.get("grid"))
+        return orig(kernel, **kwargs)
+
+    monkeypatch.setattr(fa.pl, "pallas_call", spy)
+    rng = np.random.RandomState(0)
+    q = jnp.asarray(rng.randn(1, 2, 256, 64), jnp.float32)
+    k = jnp.asarray(rng.randn(1, 2, 256, 64), jnp.float32)
+    v = jnp.asarray(rng.randn(1, 2, 256, 64), jnp.float32)
+    out0, _ = fa.flash_attention_fwd_lse(q, k, v, causal=True,
+                                         interpret=True)
+    # defaults clamp to T=256 -> one q tile, one k tile
+    assert grids[-1] == (2, 1, 1)
+    tuning.record("flash_attention", (1, 2, 256, 64, 256), "float32",
+                  {"block_q": 64, "block_k": 128}, source="test")
+    out1, _ = fa.flash_attention_fwd_lse(q, k, v, causal=True,
+                                         interpret=True)
+    assert grids[-1] == (2, 4, 2)   # t/64, tk/128
+    np.testing.assert_allclose(np.asarray(out0), np.asarray(out1),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_conv_impl_from_cache(cache_dir, monkeypatch):
+    """conv_tune.py's recorded winner ('xla' vs 'pallas') steers the
+    fused conv lowering's force_xla choice."""
+    from paddle_tpu.kernels import conv_fused
+    from paddle_tpu.ops import nn as ops_nn
+    from paddle_tpu.core.lowering import Ins
+
+    calls = []
+    orig = conv_fused.conv2d_nhwc
+
+    def spy(*args, **kwargs):
+        calls.append(kwargs.get("force_xla", False))
+        return orig(*args, **kwargs)
+
+    monkeypatch.setattr(conv_fused, "conv2d_nhwc", spy)
+
+    rng = np.random.RandomState(0)
+    ins = Ins({
+        "Input": [jnp.asarray(rng.randn(2, 8, 8, 4), jnp.float32)],
+        "Filter": [jnp.asarray(rng.randn(3, 3, 4, 8) * 0.1,
+                               jnp.float32)],
+        "Scale": [jnp.asarray(rng.rand(8) + 0.5, jnp.float32)],
+        "Bias": [jnp.asarray(rng.randn(8), jnp.float32)],
+        "Mean": [jnp.asarray(rng.randn(8) * 0.1, jnp.float32)],
+        "Variance": [jnp.asarray(rng.rand(8) + 0.5, jnp.float32)],
+    })
+
+    class _Ctx:
+        mode = "train"
+        amp = False
+
+    class _Op:
+        outputs = {}
+
+    attrs = {"strides": [1, 1], "paddings": [1, 1], "epsilon": 1e-5,
+             "momentum": 0.9, "act": "relu"}
+    ops_nn._fused_conv_bn_lower(_Ctx(), ins, attrs, _Op())
+    assert calls[-1] is False
+    shape = (2, 8, 8, 4, 3, 3, 4, 8, 1, 1, 1, 1)
+    tuning.record("fused_conv2d_bn_act", shape, "float32",
+                  {"impl": "xla"}, source="test")
+    ops_nn._fused_conv_bn_lower(_Ctx(), ins, attrs, _Op())
+    assert calls[-1] is True
+
+
+def test_executor_cache_key_tracks_cache_state(cache_dir):
+    """The compile-cache key includes the tuning fingerprint: an
+    in-process record() (or a new cache file) changes the key, so a
+    re-tuned cache never serves a stale executable."""
+    from paddle_tpu.core import executor_impl
+
+    prog = fluid.Program().desc
+    key0 = executor_impl._cache_key(prog, 0, ("spec",), ["f"], "train")
+    tuning.record("matmul_fused", (1, 2, 3), "float32",
+                  {"block_m": 8}, source="test")
+    key1 = executor_impl._cache_key(prog, 0, ("spec",), ["f"], "train")
+    assert key0 != key1
+
+
+def test_fresh_process_lowering_picks_up_cache(cache_dir):
+    """ACCEPTANCE: an entry written by one process (the sweep) is
+    consulted by a FRESH process's lowering via the
+    FLAGS_autotune_cache_dir env contract."""
+    tuning.record("matmul_fused", (32, 256, 256), "float32",
+                  {"block_m": 8, "block_n": 128, "block_k": 128},
+                  source="parent")
+    code = """
+import numpy as np, jax.numpy as jnp
+from paddle_tpu.kernels import matmul_fused
+grids = []
+orig = matmul_fused._pallas_call
+def spy(kernel, **kw):
+    grids.append(kw.get("grid"))
+    return orig(kernel, **kw)
+matmul_fused._pallas_call = spy
+x = jnp.ones((32, 256), jnp.float32)
+w = jnp.ones((256, 256), jnp.float32)
+matmul_fused.matmul_epilogue(x, w, interpret=True)
+print("GRID", grids[-1])
+"""
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               FLAGS_autotune_cache_dir=cache_dir)
+    out = subprocess.run([sys.executable, "-c", code], env=env,
+                         capture_output=True, text=True, timeout=240,
+                         cwd=REPO)
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert "GRID (4, 2, 2)" in out.stdout, out.stdout
+
+
+def test_tune_tools_record_into_cache(cache_dir, monkeypatch):
+    """All three tune tools persist winners (acceptance): their record
+    paths write entries keyed exactly as the lowerings look them up."""
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    argv = sys.argv
+    sys.argv = [argv[0]]     # the tools read argv[1] as a step count
+    try:
+        import conv_tune
+        import flash_tune
+        import matmul_tune
+    finally:
+        sys.argv = argv
+        sys.path.pop(0)
+    # conv_tune: stage winner -> impl choice under the lowering's key
+    stage = ("r1_3x3", 56, 64, 64, 3, 1, 1)
+    conv_tune._record_stage(stage, {"fused": 2.0, "nhwc": 1.0,
+                                    "nchw": 1.5})
+    key_shape = (conv_tune.BATCH, 56, 56, 64, 3, 3, 64, 64, 1, 1, 1, 1)
+    assert tuning.lookup("fused_conv2d_bn_act", key_shape,
+                         "bfloat16") == {"impl": "xla"}
+    # flash_tune: best config under the flash key
+    flash_tune._record_best((1024, 1024, 512, 1024, 1024, 512), 0.012)
+    cfg = tuning.lookup(
+        "flash_attention",
+        (flash_tune.B, flash_tune.H, flash_tune.T, flash_tune.D,
+         flash_tune.T), "bfloat16")
+    assert cfg["block_q"] == 1024 and cfg["block_q_dkv"] == 1024
+    # matmul_tune: one real (tiny) sweep stage end to end
+    monkeypatch.setattr(matmul_tune, "TILE_GRID", [(8, 128, 128)])
+    monkeypatch.setattr(matmul_tune, "STEPS", 1)
+    best_cfg, _ = matmul_tune.tune_stage("tiny", 16, 128, 128, "",
+                                         False, dtype=jnp.float32)
+    assert best_cfg == {"block_m": 8, "block_n": 128, "block_k": 128}
+    assert tuning.lookup("matmul_fused", (16, 128, 128),
+                         "float32") == best_cfg

@@ -355,14 +355,8 @@ def test_device_loader_hides_producer_latency():
     place = fluid.CPUPlace()
     dev = place.jax_device()
     n_batches = 6
-    delay = 0.08            # per-batch reader latency (I/O stand-in)
     field = np.random.RandomState(0).rand(1 << 20).astype(np.float32)
     prebuilt = [field + np.float32(i) for i in range(n_batches)]
-
-    def reader():
-        for b in prebuilt:
-            time.sleep(delay)
-            yield [(b,)]
 
     w = jax.device_put(np.random.RandomState(1).rand(1024, 1024)
                        .astype(np.float32), dev)
@@ -375,6 +369,25 @@ def test_device_loader_hides_producer_latency():
         return acc.sum() + x.reshape(-1)[0]
 
     compute(jax.device_put(field[None], dev), w).block_until_ready()
+
+    # per-batch compute time on THIS rig: the reader delay is sized to
+    # match it, so the overlappable quantity (min(compute, delay) per
+    # steady-state batch) is a fixed fraction of the loop whatever the
+    # machine's speed — a hard-coded delay made the bound unsatisfiable
+    # on rigs whose compute runs faster than the delay (the streamed
+    # loop is then reader-bound at ~n*delay, which can exceed
+    # t_naive - hidden for ANY overlap quality)
+    t0 = time.time()
+    for i in range(n_batches):
+        compute(jax.device_put(prebuilt[i][None], dev),
+                w).block_until_ready()
+    t_comp = (time.time() - t0) / n_batches
+    delay = max(0.03, t_comp)
+
+    def reader():
+        for b in prebuilt:
+            time.sleep(delay)
+            yield [(b,)]
 
     # naive serial loop: read -> stage -> compute, one at a time
     t0 = time.time()
@@ -392,10 +405,12 @@ def test_device_loader_hides_producer_latency():
         r.block_until_ready()
     t_stream = time.time() - t0
 
-    # the loader must hide most of the reader latency: allow keeping
-    # one pipeline-fill delay plus half of one more (scheduler noise)
-    budget = t_naive - (n_batches - 2.5) * delay
+    # the loader must hide most of the hideable time.  Hideable =
+    # min(compute, delay) per steady-state batch; allow keeping one
+    # pipeline-fill delay plus 1.5 more for scheduler noise.
+    hideable = min(t_comp, delay)
+    budget = t_naive - (n_batches - 2.5) * hideable
     assert t_stream < budget, (
         "reader latency not hidden: naive %.3fs, streamed %.3fs, "
-        "budget %.3fs (delay %.2fs x %d batches)"
-        % (t_naive, t_stream, budget, delay, n_batches))
+        "budget %.3fs (compute %.3fs, delay %.3fs x %d batches)"
+        % (t_naive, t_stream, budget, t_comp, delay, n_batches))

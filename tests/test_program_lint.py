@@ -64,6 +64,29 @@ def test_layout_transformed_resnet_lints_clean(prog_scope):
             label, "\n".join(d.format() for d in errs))
 
 
+def test_fused_transformer_lints_clean(prog_scope):
+    """ISSUE 7 cross-feature gate: the fused-transformer-transformed
+    training program (fused_qkv_matmul / fused_matmul_bias_act /
+    fused_add_ln fwd+grad ops, dropped chain intermediates) must pass
+    the PR 3 program verifier with ZERO errors — the shape checker
+    re-derives every fused op's outputs through its registered
+    infer_shape."""
+    from paddle_tpu.models import transformer
+
+    main, startup, scope = prog_scope
+    transformer.get_model(vocab_size=101, seq_len=16, d_model=32,
+                          n_head=4, n_layers=2, d_ff=64,
+                          fuse_transformer=True)
+    ops = [op.type for op in main.desc.blocks[0].ops]
+    for t in ("fused_qkv_matmul", "fused_matmul_bias_act",
+              "fused_add_ln", "fused_add_ln_grad"):
+        assert t in ops
+    for label, prog in (("main", main), ("startup", startup)):
+        errs = _errors(analysis.verify_program(prog))
+        assert errs == [], "fused-transformer %s program: %s" % (
+            label, "\n".join(d.format() for d in errs))
+
+
 def test_transpiled_dist_programs_lint_clean(prog_scope):
     main, startup, scope = prog_scope
     book1.build_fit_a_line()

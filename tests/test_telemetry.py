@@ -299,6 +299,53 @@ def test_export_merge_and_phase_report(tmp_path, capsys):
     assert os.path.exists(tmp_path / "m2.json")
 
 
+def test_kernel_rollup_groups_launch_sites_and_device_ops(tmp_path,
+                                                          capsys):
+    """ISSUE 7 satellite: the per-kernel rollup groups pallas.*
+    launch-site spans by kernel name and xplane device events by their
+    normalized op family, and the trace_report CLI prints it."""
+    d1 = _make_dump(tmp_path, "trainer0", [
+        {"name": "pallas.matmul_fused", "ts_us": 0.0, "dur_us": 1000.0,
+         "tid": 1},
+        {"name": "pallas.matmul_fused", "ts_us": 5.0, "dur_us": 3000.0,
+         "tid": 1},
+        {"name": "pallas.flash_attention", "ts_us": 9.0,
+         "dur_us": 500.0, "tid": 1},
+        {"name": "step.dispatch", "ts_us": 20.0, "dur_us": 400.0,
+         "tid": 1},
+    ], pid=31)
+    dumps = [export.load_dump(d1)]
+    trace = {"traceEvents": [
+        {"name": "%fusion.123", "cat": "device", "ph": "X", "ts": 0,
+         "dur": 2000},
+        {"name": "%fusion.7", "cat": "device", "ph": "X", "ts": 1,
+         "dur": 1000},
+        {"name": "jit__matmul_kernel.3", "cat": "device", "ph": "X",
+         "ts": 2, "dur": 500},
+    ]}
+    rows = export.kernel_rows(dumps, trace)
+    by = {(r["kernel"], r["side"]): r for r in rows}
+    assert by[("matmul_fused", "host")]["count"] == 2
+    assert by[("matmul_fused", "host")]["total_ms"] == 4.0
+    assert by[("flash_attention", "host")]["count"] == 1
+    assert by[("fusion", "device")]["count"] == 2
+    assert by[("fusion", "device")]["total_ms"] == 3.0
+    assert by[("jit__matmul_kernel", "device")]["count"] == 1
+    # non-pallas host spans stay out of the kernel rollup
+    assert ("step.dispatch", "host") not in by
+    # CLI prints the rollup table whenever kernel rows exist
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    try:
+        import trace_report
+    finally:
+        sys.path.pop(0)
+    rc = trace_report.main([d1])
+    assert rc == 0
+    printed = capsys.readouterr().out
+    assert "per-kernel rollup" in printed
+    assert "matmul_fused" in printed
+
+
 # ------------------------------------------- cross-process correlation
 
 def _free_port():

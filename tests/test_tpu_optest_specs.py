@@ -28,6 +28,20 @@ def test_sweep_selfcheck_classifies_every_op():
 
 
 @pytest.mark.slow
+def test_sweep_selfcheck_fused_transformer_stages():
+    """The ISSUE 7 fused transformer ops run green in self-check mode
+    (CPU vs CPU), gradients included."""
+    env = dict(os.environ, TPU_OPTEST_SELFCHECK="1", JAX_PLATFORMS="cpu")
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "tpu_optest.py"),
+         "gelu", "fused_matmul_bias_act", "fused_qkv_matmul",
+         "fused_add_ln"],
+        env=env, capture_output=True, text=True, timeout=300)
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert "fail" not in out.stdout, out.stdout
+
+
+@pytest.mark.slow
 def test_sweep_selfcheck_fused_conv_stage():
     """The ISSUE 5 fused conv-stage op runs green in self-check mode
     (CPU vs CPU), gradients included."""
@@ -61,12 +75,16 @@ def test_late_ops_are_spec_covered():
     the op is differentiable — so the next sweep is complete by
     construction.  'eos' is a v2 COMPOSITE (fill_constant + equal +
     cast, v2/layers_ext.py), not a registered op: its constituents must
-    be spec'd instead."""
+    be spec'd instead.  ISSUE 7's fused transformer ops (and gelu)
+    join the late list the same way."""
     mod = _load_sweep_module()
     from paddle_tpu.core import registry
 
     late = ["lambda_rank", "kmax_seq_score", "scale_sub_region",
-            "sub_nested_seq"]
+            "sub_nested_seq",
+            # ISSUE 7: fused transformer block stages
+            "gelu", "fused_matmul_bias_act", "fused_qkv_matmul",
+            "fused_add_ln"]
     for op in late:
         assert op in mod.SPECS, "%s has no sweep spec" % op
         info = registry._registry[op]

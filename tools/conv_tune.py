@@ -115,6 +115,26 @@ def bench_stage(name, h, ci, co, k, s, p, dtype=jnp.bfloat16):
     return out
 
 
+def _record_stage(stage, r):
+    """Persist the per-stage pallas-vs-xla winner into the autotune
+    cache (ISSUE 7): the fused_conv2d_bn_act lowering consults it and
+    takes the identical-math XLA path where that measured faster.
+    Keyed exactly as the lowering keys its lookup."""
+    from paddle_tpu import tuning
+
+    name, h, ci, co, k, s, p = stage
+    fused, nhwc = r.get("fused"), r.get("nhwc")
+    if not (isinstance(fused, float) and isinstance(nhwc, float)):
+        return
+    impl = "pallas" if fused <= nhwc else "xla"
+    shape = (BATCH, h, h, ci, k, k, ci, co, s, s, p, p)
+    ok = tuning.record("fused_conv2d_bn_act", shape, "bfloat16",
+                       {"impl": impl}, ms=min(fused, nhwc),
+                       source="conv_tune:%s" % name)
+    if ok:
+        print("  autotune cache <- %s impl=%s" % (name, impl))
+
+
 def main():
     print("ResNet-50 stage sweep, bs=%d, %d unrolled steps, bf16" %
           (BATCH, STEPS))
@@ -136,6 +156,7 @@ def main():
             print("%-12s %s %s %s  %s" % (
                 stage[0], fmt(r["nchw"]), fmt(r["nhwc"]),
                 fmt(r["fused"]), ratio), flush=True)
+            _record_stage(stage, r)
 
 
 if __name__ == "__main__":

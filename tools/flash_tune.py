@@ -73,6 +73,28 @@ def bench(dtype, block_q, block_k, force_xla=False,
     return dt
 
 
+def _record_best(best_cfg, best_sec):
+    """Persist the sweep winner into the shape-keyed autotune cache
+    (FLAGS_autotune_cache_dir; no-op when unset) — the kernels'
+    lowerings pick it up at the next compile (ISSUE 7)."""
+    from paddle_tpu import tuning
+
+    bq, bk, bqb, bkb, bqd, bkd = best_cfg
+    cfg = {"block_q": bq, "block_k": bk}
+    for key, val in (("block_q_bwd", bqb), ("block_k_bwd", bkb),
+                     ("block_q_dkv", bqd), ("block_k_dkv", bkd)):
+        if val:
+            cfg[key] = val
+    ok = tuning.record("flash_attention", (B, H, T, D, T), "bfloat16",
+                       cfg, ms=best_sec * 1e3, source="flash_tune")
+    if ok:
+        print("autotune cache <- flash_attention %s (%s)"
+              % (cfg, tuning.cache_path()))
+    else:
+        print("autotune cache unset (FLAGS_autotune_cache_dir) — "
+              "winner not persisted")
+
+
 def main():
     print("shape B=%d H=%d T=%d D=%d causal, %d chained steps" %
           (B, H, T, D, STEPS))
@@ -100,6 +122,7 @@ def main():
         (1024, 1024, 512, 1024, 256, 1024),
         (1024, 1024, 512, 1024, 1024, 1024),
     ]
+    best_cfg, best_sec = None, None
     for bq, bk, bqb, bkb, bqd, bkd in configs:
         try:
             sec = bench(jnp.bfloat16, bq, bk, False, bqb, bkb, bqd, bkd)
@@ -107,11 +130,15 @@ def main():
                   "%9.2f ms  %7.1f TF/s" %
                   (bq, bk, bqb or "cap", bkb or "cap", bqd or "=bwd",
                    bkd or "=bwd", sec * 1e3, FLOPS / sec / 1e12))
+            if best_sec is None or sec < best_sec:
+                best_cfg, best_sec = (bq, bk, bqb, bkb, bqd, bkd), sec
         except Exception as exc:  # noqa: BLE001 — tuning survey
             print("bf16 fwd(%4d,%4d) bwd(%4s,%4s) dkv(%4s,%4s)  "
                   "FAILED: %s" %
                   (bq, bk, bqb or "cap", bkb or "cap", bqd or "=bwd",
                    bkd or "=bwd", str(exc)[:80]))
+    if best_cfg is not None:
+        _record_best(best_cfg, best_sec)
 
 
 if __name__ == "__main__":

@@ -1,0 +1,175 @@
+"""Fused-matmul kernel tuner at the transformer-bench stage shapes.
+
+flash_tune.py's method applied to the ISSUE 7 fused block stages: for
+each distinct matmul of the transformer-LM secondary bench (fused QKV,
+attention output projection, MLP up/down, lm_head) measure fwd wall
+time of kernels/matmul_fused.matmul_epilogue over a grid of
+(block_m, block_n, block_k) tiles, plus the fused add+LN row tile —
+with the microbench traps handled (distinct pre-staged inputs,
+unrolled chain, one final d2h drain).
+
+The per-shape winner lands in the persistent autotune cache
+(FLAGS_autotune_cache_dir -> paddle_tpu/tuning); the fused op
+lowerings consult it at the next compile, so the sweep self-applies
+to every future run of the same shapes.
+
+Usage: FLAGS_autotune_cache_dir=... python tools/matmul_tune.py [steps]
+Env: MM_TUNE_BATCH/MM_TUNE_SEQ/MM_TUNE_DMODEL/MM_TUNE_VOCAB override
+the secondary-bench dims (16 / 2048 / 1024 / 8192).
+"""
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+from paddle_tpu import tuning  # noqa: E402
+from paddle_tpu.kernels import matmul_fused  # noqa: E402
+
+STEPS = int(sys.argv[1]) if len(sys.argv) > 1 else 8
+B = int(os.environ.get("MM_TUNE_BATCH", "16"))
+S = int(os.environ.get("MM_TUNE_SEQ", "2048"))
+D = int(os.environ.get("MM_TUNE_DMODEL", "1024"))
+V = int(os.environ.get("MM_TUNE_VOCAB", "8192"))
+M = B * S
+
+# (name, m, k, n, act, residual) — the transformer block's matmuls at
+# the secondary-bench shape; qkv is the width-concatenated projection
+STAGES = [
+    ("qkv", M, D, 3 * D, "", False),
+    ("out_proj", M, D, D, "", False),
+    ("mlp_up", M, D, 4 * D, "gelu", False),
+    ("mlp_down", M, 4 * D, D, "", True),
+    ("lm_head", M, D, V, "", False),
+]
+
+TILE_GRID = [
+    (256, 256, 512),    # built-in defaults
+    (512, 256, 512),
+    (256, 512, 512),
+    (128, 512, 512),
+    (512, 512, 256),
+    (256, 256, 1024),
+    (1024, 256, 512),
+    (256, 1024, 512),
+    (512, 512, 512),
+]
+
+LN_TILES = [128, 256, 512, 1024]
+
+
+def bench_matmul(m, k, n, act, residual, cfg, dtype=jnp.bfloat16):
+    rng = np.random.RandomState(0)
+    xs = [jnp.asarray(rng.randn(m, k) * 0.1, dtype)
+          for _ in range(STEPS)]
+    w = jnp.asarray(rng.randn(k, n) * 0.02, dtype)
+    bias = jnp.asarray(rng.randn(n) * 0.1, jnp.float32)
+    res = jnp.asarray(rng.randn(m, n) * 0.1, dtype) if residual else None
+
+    def run(ops):
+        acc = 0.0
+        for x in ops:        # unrolled: STEPS independent launches
+            y = matmul_fused.matmul_epilogue(x, w, bias, res, act,
+                                             config=cfg)
+            acc = acc + y[0, 0].astype(jnp.float32)
+        return acc
+
+    jfn = jax.jit(run)
+    float(np.asarray(jfn(xs)))            # compile + warm
+    t0 = time.time()
+    float(np.asarray(jfn(xs)))            # d2h drain = the sync
+    return (time.time() - t0) / STEPS
+
+
+def bench_add_ln(m, d, bm, dtype=jnp.bfloat16):
+    rng = np.random.RandomState(0)
+    pairs = [(jnp.asarray(rng.randn(m, d), dtype),
+              jnp.asarray(rng.randn(m, d), dtype))
+             for _ in range(STEPS)]
+    scale = jnp.asarray(rng.rand(d) + 0.5, jnp.float32)
+    bias = jnp.asarray(rng.randn(d), jnp.float32)
+
+    def run(ops):
+        acc = 0.0
+        for x, y in ops:
+            o, s, mn, vr = matmul_fused.add_ln(
+                x, y, scale, bias, config={"block_m": bm})
+            acc = acc + o[0, 0].astype(jnp.float32) + s[0, 0].astype(
+                jnp.float32)
+        return acc
+
+    jfn = jax.jit(run)
+    float(np.asarray(jfn(pairs)))
+    t0 = time.time()
+    float(np.asarray(jfn(pairs)))
+    return (time.time() - t0) / STEPS
+
+
+def tune_stage(name, m, k, n, act, residual, dtype=jnp.bfloat16):
+    """Sweep TILE_GRID for one matmul stage and record the winner into
+    the autotune cache.  Returns (best_cfg, best_sec)."""
+    best_cfg, best_sec = None, None
+    print("%s  [%d x %d] @ [%d x %d] act=%r residual=%s"
+          % (name, m, k, k, n, act or None, residual))
+    for bm, bn, bk in TILE_GRID:
+        cfg = {"block_m": bm, "block_n": bn, "block_k": bk}
+        _, _, _, usable = matmul_fused.plan_matmul(m, k, n, dtype, cfg)
+        try:
+            sec = bench_matmul(m, k, n, act, residual, cfg, dtype)
+            gflops = 2.0 * m * k * n / sec / 1e9
+            print("  (%4d,%4d,%4d)%s %9.2f ms  %8.1f GF/s" %
+                  (bm, bn, bk, " " if usable else "*",
+                   sec * 1e3, gflops), flush=True)
+            if best_sec is None or sec < best_sec:
+                best_cfg, best_sec = cfg, sec
+        except Exception as exc:  # noqa: BLE001 — tuning survey
+            print("  (%4d,%4d,%4d)  FAILED: %s" %
+                  (bm, bn, bk, str(exc)[:80]))
+    if best_cfg is not None:
+        ok = tuning.record("matmul_fused", (m, k, n),
+                           jnp.dtype(dtype).name, best_cfg,
+                           ms=best_sec * 1e3,
+                           source="matmul_tune:%s" % name)
+        print("  best %s %s" % (
+            best_cfg,
+            "-> %s" % tuning.cache_path() if ok else
+            "(FLAGS_autotune_cache_dir unset: not persisted)"))
+    return best_cfg, best_sec
+
+
+def main():
+    print("transformer matmul sweep M=%d D=%d V=%d, %d unrolled "
+          "steps, bf16" % (M, D, V, STEPS))
+    for name, m, k, n, act, residual in STAGES:
+        tune_stage(name, m, k, n, act, residual)
+
+    best_bm, best_sec = None, None
+    print("add_ln  [%d x %d]" % (M, D))
+    for bm in LN_TILES:
+        try:
+            sec = bench_add_ln(M, D, bm)
+            print("  block_m=%4d %9.2f ms" % (bm, sec * 1e3),
+                  flush=True)
+            if best_sec is None or sec < best_sec:
+                best_bm, best_sec = bm, sec
+        except Exception as exc:  # noqa: BLE001
+            print("  block_m=%4d  FAILED: %s" % (bm, str(exc)[:80]))
+    if best_bm is not None:
+        ok = tuning.record("add_ln", (M, D), "bfloat16",
+                           {"block_m": best_bm}, ms=best_sec * 1e3,
+                           source="matmul_tune:add_ln")
+        print("  best block_m=%d %s" % (
+            best_bm, "-> %s" % tuning.cache_path() if ok else
+            "(FLAGS_autotune_cache_dir unset: not persisted)"))
+
+
+if __name__ == "__main__":
+    main()

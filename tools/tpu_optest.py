@@ -206,6 +206,26 @@ spec("fused_conv2d_bn_act",
      outs=["Y", "ConvOut", "MeanOut", "VarianceOut", "SavedMean",
            "SavedInvStd"],
      grad=["Input", "Filter", "Scale", "Bias"], tol=TOL_MM)
+# --- fused transformer block stages (ISSUE 7) --- the explicit
+# saved-activation grad lowerings are covered through each forward
+# spec's cross-place grad check, like fused_conv2d_bn_act above
+spec("gelu", {"X": F(3, 5)}, grad=["X"], tol=TOL_EXP)
+spec("fused_matmul_bias_act",
+     {"X": F(3, 4, 6), "W": F(6, 5), "Bias": F(5),
+      "Residual": F(3, 4, 5)},
+     {"x_num_col_dims": 2, "act": "gelu", "dropout_prob": 0.0},
+     outs=["Out", "MulOut"], grad=["X", "W", "Bias", "Residual"],
+     tol=TOL_MM)
+spec("fused_qkv_matmul",
+     {"X": F(3, 4, 6), "W": [("qkv_wq", F(6, 5)), ("qkv_wk", F(6, 5)),
+                             ("qkv_wv", F(6, 4))]},
+     {"x_num_col_dims": 2},
+     outs=[("Out", 3)], grad=["X", "qkv_wq", "qkv_wv"], tol=TOL_MM)
+spec("fused_add_ln",
+     {"X": F(3, 4, 6), "Y": F(3, 4, 6), "Scale": P(6), "Bias": F(6)},
+     {"begin_norm_axis": 2, "epsilon": 1e-5},
+     outs=["Out", "Sum", "Mean", "Variance"],
+     grad=["X", "Y", "Scale", "Bias"], tol=TOL_EXP)
 spec("pool2d", {"X": F(2, 3, 8, 8)},
      {"pooling_type": "max", "ksize": [2, 2], "strides": [2, 2],
       "paddings": [0, 0], "global_pooling": False, "exclusive": True,

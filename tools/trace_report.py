@@ -51,6 +51,10 @@ def main(argv=None):
                     help="limit the table to the top-N phases by total")
     ap.add_argument("--json", action="store_true",
                     help="emit the breakdown rows as JSON instead")
+    ap.add_argument("--kernels", action="store_true",
+                    help="with --json: wrap output as {phases, kernels} "
+                         "including the per-kernel rollup (text mode "
+                         "always prints the rollup when kernels exist)")
     args = ap.parse_args(argv)
 
     trace, dumps = export.merge_files(args.dumps, out_path=args.merge,
@@ -58,8 +62,17 @@ def main(argv=None):
     rows = export.phase_rows(dumps)
     if args.prefix:
         rows = [r for r in rows if r["name"].startswith(args.prefix)]
+    # per-kernel rollup (ISSUE 7): Pallas launch-site spans grouped by
+    # kernel name + device events from the --xplane capture — fusion
+    # wins readable straight from a telemetry dump.  Skipped in plain
+    # --json mode (pre-existing contract emits bare phase rows), which
+    # also spares the full extra span walk on large rings
+    krows = export.kernel_rows(dumps, trace) \
+        if (args.kernels or not args.json) else []
     if args.json:
-        print(json.dumps(rows, indent=2))
+        print(json.dumps(
+            {"phases": rows, "kernels": krows} if args.kernels
+            else rows, indent=2))
     else:
         total_spans = sum(len(d.get("spans", [])) for d in dumps)
         print("%d process dump(s), %d spans, %d trace events%s" % (
@@ -75,6 +88,10 @@ def main(argv=None):
                     s["name"], s.get("elapsed_us", 0) / 1e3,
                     s.get("cid", "")))
         print(export.format_phase_table(rows, top=args.top))
+        if krows:
+            print("\nper-kernel rollup (pallas launch sites + xplane "
+                  "device ops):")
+            print(export.format_kernel_table(krows))
     if not rows:
         # a written --merge artifact is a success even when the table
         # filter matched nothing (e.g. --prefix step. on pserver-only
