@@ -495,6 +495,13 @@ def main():
         FLAGS.conv_fused_stages = \
             os.environ["BENCH_FUSED_STAGES"] == "1"
     bench_depth = int(os.environ.get("BENCH_DEPTH", "0"))
+    # numerics observatory (ISSUE 8): BENCH_CHECK_NUMERICS=metrics runs
+    # the headline WITH the fused health fetch (grad-norm / absmax /
+    # nonfinite stats in the always-on registry) — the measured
+    # overhead per mode is recorded in PROFILE_r08.md, and the JSON row
+    # carries the mode so A/B rows stay self-describing
+    if os.environ.get("BENCH_CHECK_NUMERICS"):
+        FLAGS.check_numerics = os.environ["BENCH_CHECK_NUMERICS"]
 
     main_prog, startup = fluid.Program(), fluid.Program()
     with fluid.program_guard(main_prog, startup):
@@ -788,6 +795,10 @@ def main():
         "step_ms_p50": round(h_step.percentile(50), 3),
         "step_ms_p90": round(h_step.percentile(90), 3),
         "step_ms_p99": round(h_step.percentile(99), 3),
+        # numerics observatory mode this row ran under (ISSUE 8); with
+        # 'metrics' on, grad_global_norm percentiles ride along below
+        # so the bench doubles as a training-health probe
+        "check_numerics": str(FLAGS.check_numerics or "off"),
         # ISSUE 5 lever evidence: layout, fused stage count and the
         # scheduler flags the run compiled under — BENCH_*.json rows
         # are self-describing experiments, not env archaeology.
@@ -804,6 +815,17 @@ def main():
     }
     if per_category_ms:
         out["per_category_ms"] = per_category_ms
+    if out["check_numerics"] not in ("", "off"):
+        # training-health evidence from the always-on registry
+        # (observability/numerics.py): the run's grad-norm distribution
+        # + any nonfinite sightings
+        from paddle_tpu.observability import metrics as _metrics
+        snap = _metrics.snapshot()
+        gh = snap.get("grad_global_norm", {})
+        out["grad_global_norm_p50"] = gh.get("p50", 0.0)
+        out["grad_global_norm_p99"] = gh.get("p99", 0.0)
+        out["nonfinite_total"] = snap.get(
+            "numerics_nonfinite_total", {}).get("value", 0)
     if bench_depth:
         out["depth"] = bench_depth  # non-default model size: mark it
     if not use_fake:

@@ -378,6 +378,100 @@ def verify_transpiled_pair(trainer_desc, pserver_descs):
 
 
 # ---------------------------------------------------------------------------
+# numerics: known-risk ops consuming low-precision inputs (ISSUE 8)
+# ---------------------------------------------------------------------------
+
+# Ops whose output explodes in half precision for in-range inputs:
+# exp/pow overflow (bf16/fp16 max ~3.4e38/65504), log of a value that
+# rounded to 0, division/reciprocal/rsqrt of a denormal-flushed tiny.
+# Grounded in the float16 transpiler's compute lists (core/lowering.py
+# AMP_WHITE/AMP_BLACK, the TPU-native form of the reference
+# contrib/float16 transpiler's black/white lists): a risk op in
+# AMP_BLACK gets its inputs cast back to f32 by the lowering under AMP,
+# so only the *unprotected* combinations are reported.
+_NUMERICS_RISK_OPS = frozenset({
+    "exp", "log", "sqrt", "reciprocal", "elementwise_div",
+    "elementwise_pow", "pow", "rsqrt",
+})
+
+_LOW_PRECISION = frozenset({"float16", "bfloat16"})
+
+
+def _declared_low_precision(vd):
+    try:
+        from paddle_tpu.core.types import proto_to_np_dtype
+        import numpy as _np
+        return _np.dtype(proto_to_np_dtype(vd.dtype)).name \
+            in _LOW_PRECISION
+    except Exception:
+        return False
+
+
+@register_checker("numerics")
+def check_numerics_static(du):
+    """Warn on known-risk ops (log/div/rsqrt/exp/...) consuming
+    half-precision inputs without an upstream cast:
+
+    - a var DECLARED float16/bfloat16 feeding a risk op runs the risky
+      math in half precision on every path;
+    - under AMP (program.amp_bf16), a risk op fed by an AMP_WHITE
+      producer sees a bf16 activation at trace time — unless the op is
+      itself AMP_BLACK, in which case the lowering inserts the f32
+      upcast and no diagnostic is due.
+
+    These are the overflow sites FLAGS_check_numerics=bisect names at
+    runtime; this checker names them at compile-cache cadence, before
+    a single step runs."""
+    from paddle_tpu.core.lowering import AMP_AUTOCAST_OPS as amp_white
+    from paddle_tpu.core.lowering import AMP_BLACK
+
+    amp = bool(getattr(du.program, "amp_bf16", False))
+    diags = []
+    for bi, block in enumerate(du.program.blocks):
+        producer = {}  # var -> type of the op that last wrote it
+        for oi, op in enumerate(block.ops):
+            if op.type in _NUMERICS_RISK_OPS:
+                protected = amp and op.type in AMP_BLACK
+                for n in set(op.input_arg_names()):
+                    if not n:
+                        continue
+                    vd = du.find_var(bi, n)
+                    declared_low = _declared_low_precision(vd)
+                    amp_low = (amp and not protected
+                               and producer.get(n) in amp_white)
+                    if declared_low and not protected:
+                        diags.append(Diagnostic(
+                            "numerics", Severity.WARNING,
+                            "%s-risk op consumes a %s input: overflow/"
+                            "underflow is the expected mixed-precision "
+                            "failure mode here" % (
+                                op.type,
+                                "declared half-precision"),
+                            block_idx=bi, op_idx=oi, op_type=op.type,
+                            var=n,
+                            suggestion="insert a cast to float32 before "
+                                       "this op (AMP_BLACK ops get it "
+                                       "automatically), or run with "
+                                       "FLAGS_check_numerics=guard"))
+                    elif amp_low:
+                        diags.append(Diagnostic(
+                            "numerics", Severity.WARNING,
+                            "%s-risk op consumes the bf16 output of "
+                            "autocast op %r under AMP with no upstream "
+                            "f32 cast (op is not AMP_BLACK)" % (
+                                op.type, producer.get(n)),
+                            block_idx=bi, op_idx=oi, op_type=op.type,
+                            var=n,
+                            suggestion="cast the input to float32, or "
+                                       "add the op to AMP_BLACK if it "
+                                       "must always run full precision"))
+            for n in op.output_arg_names():
+                if n:
+                    producer[n] = op.type
+    return diags
+
+
+# ---------------------------------------------------------------------------
 # concurrency: unsynchronized writes from concurrent blocks + prepared
 # donation hazards
 # ---------------------------------------------------------------------------

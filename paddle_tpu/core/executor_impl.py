@@ -21,7 +21,6 @@ import time
 import warnings
 
 import jax
-import jax.numpy as jnp
 import numpy as np
 
 from . import lowering
@@ -34,6 +33,7 @@ from .types import proto_to_np_dtype, VarKind
 from .flags import FLAGS
 
 from paddle_tpu.observability import metrics as _obs_metrics
+from paddle_tpu.observability import numerics as _num
 from paddle_tpu.observability.trace import TRACER as _TRC
 
 # always-on metrics (one short lock per step — see
@@ -153,11 +153,14 @@ def _tuning_fingerprint():
         return ("", 0, 0)
 
 
-def _cache_key(program, block_id, feed_spec, fetch_list, mode):
+def _cache_key(program, block_id, feed_spec, fetch_list, mode,
+               numerics=None):
     """The ONE compiled-entry cache key — shared by run()'s per-feed
     path and prepare(), so a prepared program and run() with the same
     signature reuse a single executable.  Trace-time flag reads are part
-    of the key: toggling them must not hit a stale executable."""
+    of the key: toggling them must not hit a stale executable.
+    ``numerics`` pins the health-fetch variant explicitly (the prepared
+    path caches BOTH twins of one signature); None reads the flag."""
     return (program.uid, program.version, block_id, feed_spec,
             tuple(fetch_list), mode,
             bool(getattr(program, "amp_bf16", False)),
@@ -173,21 +176,34 @@ def _cache_key(program, block_id, feed_spec, fetch_list, mode):
             # autotune-cache state (ISSUE 7): lowerings consult the
             # cache at trace time, so a re-tuned cache (new file, new
             # dir, or an in-process record()) must recompile
-            _tuning_fingerprint())
+            _tuning_fingerprint(),
+            # numerics observatory (ISSUE 8): any mode but 'off' adds
+            # the fused health reduction as an extra step output —
+            # toggling it must never serve an executable without (or
+            # with) the fetch.  The plain twin of a health entry keys
+            # identically to the flag-off build, so toggling the
+            # observatory never recompiles the common executable.
+            _num.trace_enabled() if numerics is None else bool(numerics))
 
 
 class _CacheEntry:
     __slots__ = ("fn", "input_names", "persist_outs", "fetch_names",
-                 "input_shardings", "jit_fn")
+                 "input_shardings", "jit_fn", "watched", "monitor")
 
     def __init__(self, fn, input_names, persist_outs, fetch_names,
-                 input_shardings=None, jit_fn=None):
+                 input_shardings=None, jit_fn=None, watched=()):
         self.fn = fn
         self.input_names = input_names
         self.persist_outs = persist_outs
         self.fetch_names = fetch_names
         self.input_shardings = input_shardings
         self.jit_fn = jit_fn  # the raw jax.jit object (AOT lower/compile)
+        # numerics observatory (ISSUE 8): names whose health stats ride
+        # the step as an extra output when FLAGS_check_numerics is on;
+        # the monitor owns the read-back cadence + escalation
+        self.watched = tuple(watched)
+        self.monitor = _num.HealthMonitor(self.watched, "executor.run") \
+            if self.watched else None
 
 
 def flush_prepared(scope, exclude=None):
@@ -248,11 +264,15 @@ class PreparedProgram:
     """
 
     def __init__(self, core, program, block_id, entry, scope, mode,
-                 feed_specs):
+                 feed_specs, entry_health=None):
         self._core = core
         self._program = program
         self._block_id = block_id
         self._entry = entry
+        # health-instrumented twin (ISSUE 8): same signature + state
+        # contract, plus the packed health output; dispatched instead
+        # of the plain entry on numerics cadence steps
+        self._entry_health = entry_health
         self._scope = scope
         self._mode = mode
         self._feed_names = frozenset(feed_specs)
@@ -287,6 +307,11 @@ class PreparedProgram:
         self._seen = {}  # name -> (owning scope, write version) we read
         self._read_only = [n for n in self._state_targets
                            if n not in set(entry.persist_outs)]
+        # numerics observatory (ISSUE 8): own monitor = own read-back
+        # cadence per prepared program (the entries may be shared)
+        self._monitor = _num.HealthMonitor(entry_health.watched,
+                                           "step.prepared") \
+            if entry_health is not None and entry_health.watched else None
         # another prepared program/pipeline may hold newer values for
         # the persistables we are about to stage
         flush_prepared(scope)
@@ -434,9 +459,28 @@ class PreparedProgram:
         seed, counter = self._core._rng_counter(self._program, scope)
         if sp_feed is not None:
             _tr.end(sp_feed)
+        # numerics (ISSUE 8): pick the health-instrumented twin on
+        # cadence steps (bisect: every step), the plain executable
+        # otherwise — both share the signature and state contract.
+        # Bisect additionally snapshots the resident state BEFORE the
+        # dispatch consumes the donated buffers: the forensic re-run of
+        # a tripped step must start from the exact pre-step values (the
+        # expensive debug tier; metrics/guard pay nothing here).
+        snap = None
+        use_health = self._monitor is not None and \
+            self._monitor.want_health()
+        if use_health:
+            entry = self._entry_health
+            if _num.effective_mode() == "bisect":
+                snap = {name: _snapshot_value(v)
+                        for name, v in self._state.items()}
         sp_disp = _tr.begin("step.dispatch") if _tr is not None else None
         try:
-            fetches, persists = entry.fn(tuple(args), seed, counter)
+            out = entry.fn(tuple(args), seed, counter)
+            if entry.watched:
+                fetches, persists, health = out
+            else:
+                fetches, persists = out
             if sp_disp is not None:
                 _tr.end(sp_disp)
         except Exception:
@@ -460,7 +504,32 @@ class PreparedProgram:
         for name, val in zip(entry.persist_outs, persists):
             state[name] = val
         self._dirty = True
+        if self._monitor is not None:
+            rerun = None
+            if snap is not None:
+                def rerun(_snap=snap, _feed=feed, _seed=seed,
+                          _counter=counter):
+                    self._restore_snapshot(_snap)
+                    block = self._program.blocks[self._block_id]
+                    return self._core._bisect_rerun(
+                        self._program, self._block_id, list(block.ops),
+                        self._scope, _feed, _seed, _counter, self._mode)
+            self._monitor.observe(health if use_health else None,
+                                  rerun=rerun,
+                                  checked=True if use_health else None)
         return list(fetches)
+
+    def _restore_snapshot(self, snap):
+        """Rewind to the pre-step state (numerics bisect): the tripped
+        step's device results are discarded, the scope gets the host
+        snapshot back, and the next step (if any) re-stages from it."""
+        scope = self._scope
+        for name, arr in snap.items():
+            (scope.find_scope_of(name) or scope).set(name, arr)
+        self._state.clear()
+        self._seen.clear()
+        self._dirty = False
+        self._scope_epoch = None
 
     def _check_feed_names(self, feed):
         missing = self._feed_names - feed.keys()
@@ -626,10 +695,13 @@ class ExecutorCore:
 
         prelude, core_ops, postlude, mixed = _segment(block)
         if FLAGS.check_nan_inf:
-            # debug mode: run op-by-op eagerly so EVERY op's outputs are
-            # validated and the first bad op is named (reference
-            # FLAGS_check_nan_inf, framework/operator.cc:590 — inside one
-            # fused XLA program that granularity doesn't exist)
+            # legacy debug mode: run op-by-op eagerly so EVERY op's
+            # outputs are validated and the first bad op is named
+            # (reference FLAGS_check_nan_inf, operator.cc:590 — checks
+            # even transients a downstream op would mask).  The ISSUE 8
+            # observatory (FLAGS_check_numerics=bisect) keeps run()
+            # compiled instead and re-runs only a TRIPPED step op-by-op;
+            # the prepared path uses that machinery for this flag too.
             mixed = True
         if mixed:
             # the interpreted path executes EVERY op of the block itself
@@ -694,8 +766,7 @@ class ExecutorCore:
         LoDTensor}, e.g. the first minibatch — its shapes/dtypes let the
         compiled entry share the run() cache) or a bare iterable of feed
         names.  Raises ValueError for blocks the compiled path cannot
-        own whole (host ops, FLAGS.check_nan_inf) — callers fall back to
-        run()."""
+        own whole (host ops) — callers fall back to run()."""
         if scope is None:
             raise ValueError(
                 "prepare() requires the scope holding the program's "
@@ -712,9 +783,12 @@ class ExecutorCore:
             raise ValueError(
                 "block %d has host op(s) %s; the prepared hot path "
                 "compiles the whole block — use run()" % (block_id, host))
-        if FLAGS.check_nan_inf:
-            raise ValueError("FLAGS.check_nan_inf runs op-by-op; the "
-                             "prepared path is whole-block — use run()")
+        # FLAGS.check_nan_inf no longer refuses the prepared path
+        # (ISSUE 8): the legacy flag maps onto the numerics guard+bisect
+        # machinery — the step stays one dispatch with the fused health
+        # fetch, and a trip re-runs THAT step op-by-op to name the first
+        # bad op, preserving the reference semantics on both paths
+        # (MIGRATION.md "check_nan_inf on the prepared path").
         if hasattr(feed_specs, "keys"):
             sample = _prepare_lod_feeds(dict(feed_specs))
             # the SAME cache key _run_compiled builds from a real feed,
@@ -745,8 +819,29 @@ class ExecutorCore:
             self._cache[key] = entry
         else:
             _M_CACHE_HITS.inc()
+        # Numerics observatory (ISSUE 8): with a mode on, the entry
+        # above carries the health output — also compile the PLAIN twin
+        # (keyed exactly like the flag-off build, so it is usually a
+        # cache hit) and let run_prepared dispatch the health twin only
+        # on cadence steps: the stats pass costs one memory pass over
+        # the watched bytes, and amortizing it by 1/every is what keeps
+        # metrics mode under tools/telemetry_overhead.py's 2% gate.
+        entry_health = None
+        if entry.watched:
+            entry_health = entry
+            key_plain = _cache_key(program, block_id, key_spec,
+                                   fetch_list, mode, numerics=False)
+            entry = self._cache.get(key_plain)
+            if entry is None:
+                _M_CACHE_MISSES.inc()
+                entry = self._build(program, block_id, core_ops, scope,
+                                    stub, fetch_list, mode,
+                                    with_health=False)
+                self._cache[key_plain] = entry
+            else:
+                _M_CACHE_HITS.inc()
         return PreparedProgram(self, program, block_id, entry, scope,
-                               mode, stub)
+                               mode, stub, entry_health=entry_health)
 
     # ------------------------------------------------------------------
     def _rng_key(self, program, scope):
@@ -807,20 +902,48 @@ class ExecutorCore:
                                                "_reader_batch_vars", ())))
         seed, counter = self._rng_counter(program, scope)
 
+        # numerics bisect (ISSUE 8): host snapshot of the scope-read
+        # inputs BEFORE the dispatch consumes the donated persistable
+        # buffers — from step 2 on, the scope's persistables ARE the
+        # arrays donated to this dispatch, so the forensic re-run of a
+        # tripped step must start from copies taken now (mirrors the
+        # prepared path's per-step snapshot; the expensive debug tier)
+        snap = None
+        if entry.watched and _num.effective_mode() == "bisect":
+            snap = {name: _snapshot_value(args[i])
+                    for i, name in enumerate(entry.input_names)
+                    if name not in feed}
         if _TRC.on:
             sp = _TRC.begin("executor.dispatch")
             try:
-                fetches, persists = entry.fn(tuple(args), seed, counter)
+                out = entry.fn(tuple(args), seed, counter)
             finally:
                 _TRC.end(sp)
         else:
-            fetches, persists = entry.fn(tuple(args), seed, counter)
+            out = entry.fn(tuple(args), seed, counter)
+        if entry.watched:
+            fetches, persists, health = out
+        else:
+            fetches, persists = out
+        # write-back BEFORE the health check: on a guard trip the scope
+        # then holds the post-step (poisoned but LIVE) values, never
+        # donated husks — post-mortem reads and skip-batch continuation
+        # keep working; bisect restores its pre-step snapshot instead
         for name, val in zip(entry.persist_outs, persists):
             (scope.find_scope_of(name) or scope).set(name, val)
+        if entry.watched:
+            def _rerun(_snap=snap):
+                if _snap is not None:
+                    for name, v in _snap.items():
+                        (scope.find_scope_of(name) or scope).set(name, v)
+                return self._bisect_rerun(program, block_id, core_ops,
+                                          scope, feed, seed, counter,
+                                          mode)
+            entry.monitor.observe(health, rerun=_rerun)
         return list(fetches)
 
     def _build(self, program, block_id, core_ops, scope, feed, fetch_list,
-               mode):
+               mode, with_health=None):
         block = program.blocks[block_id]
         written = set()
         external = []  # ordered reads satisfied by feed or scope
@@ -876,6 +999,24 @@ class ExecutorCore:
 
         ops = list(core_ops)
 
+        # numerics observatory (ISSUE 8): the watch list is fixed BEFORE
+        # tracing so the packed health rows align with entry.watched;
+        # the reduction is part of the jitted step (one dispatch).
+        # Sub-block runs (block_id != 0) are NOT watched — a pserver's
+        # listen_and_serv applies each shard's optimize block through
+        # here, and a guard trip raising mid-apply (lock released
+        # around the block) would wedge the serve loop with every
+        # trainer stuck in retry; poisoned inbound grads are the wire
+        # health check's job (numerics.server_check_grad names the
+        # (round, sender) cid), and the trainer's own guard trips on
+        # the poisoned params it fetches back.  Mirrors the
+        # executor_steps_total sub-block exclusion.
+        watched = ()
+        if block_id == 0 and (_num.trace_enabled() if with_health is None
+                              else with_health):
+            watched = _num.select_watched(program, block, ops,
+                                          persist_outs, fetch_list)
+
         def fn(inputs, seed, counter):
             env = dict(zip(input_names, inputs))
             rng = jax.random.fold_in(jax.random.PRNGKey(seed), counter)
@@ -886,6 +1027,8 @@ class ExecutorCore:
                 run_op(ctx, op)
             fetches = tuple(env.get(n) for n in fetch_list)
             persists = tuple(env[n] for n in persist_outs)
+            if watched:
+                return fetches, persists, _num.pack_health(env, watched)
             return fetches, persists
 
         # Donate persistable inputs that the block overwrites: XLA reuses
@@ -928,10 +1071,13 @@ class ExecutorCore:
             jit_kwargs["in_shardings"] = tuple(input_shardings) + (repl, repl)
             # Fetches come back replicated (they are consumed on host);
             # written persistables keep their annotated placement so e.g.
-            # tensor-parallel weights never gather.
-            jit_kwargs["out_shardings"] = (
-                tuple(repl for _ in fetch_list),
-                tuple(shard_of(n) for n in persist_outs))
+            # tensor-parallel weights never gather.  The health array is
+            # tiny and host-consumed: replicated.
+            out_sh = (tuple(repl for _ in fetch_list),
+                      tuple(shard_of(n) for n in persist_outs))
+            if watched:
+                out_sh = out_sh + (repl,)
+            jit_kwargs["out_shardings"] = out_sh
         # Scheduler-flag knobs (FLAGS_xla_*): best-effort late application
         # — a no-op once a backend exists; bench.py applies them before
         # backend init, which is the supported path (MIGRATION.md).
@@ -947,7 +1093,7 @@ class ExecutorCore:
                 and input_names):
             entry = self._build_auto_layout(
                 fn_flat, jit_kwargs, input_names, persist_outs, fetch_list,
-                block, feed, scope, pin)
+                block, feed, scope, pin, watched)
             if entry is not None:
                 return entry
 
@@ -961,11 +1107,11 @@ class ExecutorCore:
                     return jflat(*inputs, seed, counter)
 
         return _CacheEntry(jfn, input_names, persist_outs, tuple(fetch_list),
-                           input_shardings, jit_fn=jflat)
+                           input_shardings, jit_fn=jflat, watched=watched)
 
     def _build_auto_layout(self, fn_flat, jit_kwargs, input_names,
                            persist_outs, fetch_list, block, feed, scope,
-                           dev):
+                           dev, watched=()):
         """Single-chip experiment path: AOT-compile with AUTO argument
         layouts.  AUTO lets XLA's layout assignment pick the parameter
         layouts; donation then aliases input and output buffers in that
@@ -1007,7 +1153,8 @@ class ExecutorCore:
             # default-layout output subtree is rejected by jax ("Input
             # layout being donated was AUTO while output layout was
             # None"); host reads convert on transfer regardless
-            kw["out_shardings"] = (fmt, fmt)  # (fetches, persists)
+            kw["out_shardings"] = ((fmt, fmt, fmt) if watched
+                                   else (fmt, fmt))  # (+ health)
             with _matmul_precision_ctx(), jax.default_device(dev):
                 compiled = jax.jit(fn_flat, **kw).lower(*specs).compile()
             in_fmts = compiled.input_formats[0]
@@ -1020,7 +1167,8 @@ class ExecutorCore:
                     return compiled(*inputs, seed, counter)
 
             return _CacheEntry(jfn, input_names, persist_outs,
-                               tuple(fetch_list), input_shardings)
+                               tuple(fetch_list), input_shardings,
+                               watched=watched)
         except Exception as e:  # any version/platform mismatch: plain jit
             warnings.warn("auto_layout compile failed (%s); falling back "
                           "to default layouts" % e)
@@ -1037,15 +1185,17 @@ class ExecutorCore:
                 dev)
         ctx = LoweringContext(program, block.idx, env,
                               self._rng_key(program, scope), mode)
+        check_ops = FLAGS.check_nan_inf or \
+            _num.effective_mode() == "bisect"
         with jax.default_device(dev):
-            for op in block.ops:
+            for oi, op in enumerate(block.ops):
                 info = get_op_info(op.type)
                 if info.host_op:
                     _run_host_op(self, op, scope, feed, env)
                 else:
                     run_op(ctx, op)
-                    if FLAGS.check_nan_inf:
-                        _check_op_outputs(op, env)
+                    if check_ops:
+                        _num.check_op_outputs(op, env, block.idx, oi)
         # sync written persistables back
         for name in env.written:
             vd = block.find_var_recursive(name)
@@ -1053,6 +1203,39 @@ class ExecutorCore:
                 s = scope.find_scope_of(name) or scope
                 s.set(name, env[name])
         return [env.get(n) for n in fetch_list]
+
+    def _bisect_rerun(self, program, block_id, ops, scope, feed, seed,
+                      counter, mode):
+        """Forensic re-run of ONE already-dispatched step, op by op,
+        with per-op output checks (numerics bisect): expected to raise
+        NumericsError naming the FIRST offending op, its input stats
+        and program location.  The caller guarantees the scope holds
+        the step's PRE-dispatch state (both run() and the prepared
+        path restore their per-step host snapshot before calling),
+        and ``(seed, counter)`` replay the dispatched step's exact RNG
+        stream, so stateful ops (dropout) reproduce bit-for-bit.  Host
+        ops are skipped — prelude/postlude already ran — and nothing is
+        written back: this is evidence collection, not execution."""
+        block = program.blocks[block_id]
+        dev = self.place.jax_device()
+        env = _ScopeEnv(scope, dev)
+        for name, val in feed.items():
+            vd = block.find_var_recursive(name)
+            dtype = (proto_to_np_dtype(vd.dtype) if vd is not None
+                     else None)
+            env[name] = jax.device_put(
+                np.asarray(val, dtype=dtype) if dtype
+                else np.asarray(val), dev)
+        rng = jax.random.fold_in(jax.random.PRNGKey(seed), counter)
+        ctx = LoweringContext(program, block.idx, env, rng, mode)
+        ctx.mesh = self.mesh
+        with jax.default_device(dev):
+            for oi, op in enumerate(ops):
+                if get_op_info(op.type).host_op:
+                    continue
+                run_op(ctx, op)
+                _num.check_op_outputs(op, env, block.idx, oi)
+        return None  # did not reproduce — the monitor reports that
 
 
 class _ScopeEnv(dict):
@@ -1083,21 +1266,17 @@ class _ScopeEnv(dict):
             return default
 
 
-def _check_op_outputs(op, env):
-    """Validate every float output of one eagerly-run op; name the op and
-    var of the first nan/inf (reference operator.cc:590)."""
-    for name in op.output_arg_names():
-        if not name:
-            continue
-        val = env.get(name)
-        if val is None or not hasattr(val, "dtype"):
-            continue
-        if not jnp.issubdtype(jnp.result_type(val), jnp.floating):
-            continue
-        if not bool(jnp.isfinite(val).all()):
-            raise FloatingPointError(
-                "operator %r produced nan/inf in output %r" %
-                (op.type, name))
+def _snapshot_value(v):
+    """Host copy of one resident value that survives buffer donation
+    (numerics bisect pre-step snapshots).  jax.Arrays copy to host;
+    SelectedRows copies its parts — keeping the object by reference
+    would hand the restore a consumed values buffer."""
+    if hasattr(v, "rows") and hasattr(v, "values"):
+        from .selected_rows import SelectedRows
+        return SelectedRows(np.array(np.asarray(v.rows), copy=True),
+                            np.array(np.asarray(v.values), copy=True),
+                            v.height)
+    return np.asarray(v)
 
 
 def _in_feed_only(name, feed, scope):

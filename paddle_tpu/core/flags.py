@@ -98,8 +98,13 @@ def apply_xla_flags():
 
 # core runtime flags (reference analogs cited above)
 define_flag("check_nan_inf", False,
-            "run blocks op-by-op and raise on the first op producing "
-            "nan/inf (reference FLAGS_check_nan_inf)")
+            "raise on the first op producing nan/inf, naming it "
+            "(reference FLAGS_check_nan_inf).  run() executes op-by-op "
+            "like the reference; the prepared hot path instead maps "
+            "this onto the ISSUE 8 numerics observatory (fused health "
+            "fetch + bisect re-run of a tripped step — same first-bad-"
+            "op answer, one-dispatch steps; see FLAGS_check_numerics "
+            "in observability/numerics.py and MIGRATION.md)")
 define_flag("benchmark", False,
             "print per-run wall time (reference FLAGS_benchmark)")
 define_flag("check_program", "warn",

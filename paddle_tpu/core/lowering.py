@@ -61,6 +61,17 @@ AMP_BLACK = frozenset({
     "sigmoid_cross_entropy_with_logits",
 })
 
+# AMP_WHITE plus the fused ops that absorb whitelisted chains (their
+# _amp_cast_ins branches take the casts slot-for-slot): the ops whose
+# outputs are bf16 activations under AMP.  The ONE definition shared by
+# the numerics watch list (observability/numerics.select_watched) and
+# the static numerics checker (analysis/checkers.py) — a new fused op
+# added here is covered by both at once.
+AMP_AUTOCAST_OPS = AMP_WHITE | frozenset({
+    "fused_conv2d_bn_act", "fused_matmul_bias_act",
+    "fused_qkv_matmul", "fused_add_ln",
+})
+
 
 _OPTIMIZE_ROLE = 0x0002  # framework.OpRole.Optimize
 

@@ -45,7 +45,7 @@ _M_FAULTS = _obs_metrics.counter(
 __all__ = [
     "RetryPolicy", "FaultInjector", "InjectedFault", "DeadlineExceeded",
     "WatchdogTimeout", "EndpointResolver", "fault_point", "get_injector",
-    "install_faults", "watchdog_error",
+    "install_faults", "maybe_corrupt", "watchdog_error",
 ]
 
 define_flag("fault_spec", "",
@@ -222,12 +222,24 @@ class FaultInjector:
                                        with probability <prob>
       <point>:delay:<secs>[:<limit>]   sleep <secs> before the call
       <point>:error:<prob>[:<limit>]   raise a FATAL InjectedFault
+      <point>:corrupt:<round>[:<limit>]  poison wire tensors with NaN
+                                       at sync round <round> (ISSUE 8:
+                                       the numerics-observatory crash
+                                       lab — limit 1 poisons exactly
+                                       one tensor of that round)
     ``limit`` caps total firings of that rule (0 / omitted = unlimited).
     Known points: send_grad, get_param, prefetch, send_barrier,
     fetch_barrier, master_rpc (a rule may also name any custom point).
+
+    ``corrupt`` rules never raise; the data plane calls
+    ``maybe_corrupt(point, round, arr)`` with each outbound tensor and
+    ships whatever comes back — detection and (round, sender)
+    attribution is the PSERVER's job (observability/numerics.py
+    server_check_grad, asserted end-to-end by ``tools/fault_matrix.py
+    --preset numerics``).
     """
 
-    ACTIONS = ("drop", "delay", "error")
+    ACTIONS = ("drop", "delay", "error", "corrupt")
 
     def __init__(self, spec="", seed=None):
         self.rules = self._parse(spec)
@@ -261,9 +273,11 @@ class FaultInjector:
         return rules
 
     def fire(self, point):
-        """Run every rule registered for ``point`` — may sleep or raise."""
+        """Run every rule registered for ``point`` — may sleep or raise.
+        ``corrupt`` rules are payload transforms, not call faults: they
+        fire only through maybe_corrupt()."""
         for rule in self.rules:
-            if rule.point != point:
+            if rule.point != point or rule.action == "corrupt":
                 continue
             with self._lock:
                 if rule.limit and rule.fired >= rule.limit:
@@ -291,6 +305,48 @@ class FaultInjector:
                 raise InjectedFault(point, "drop", retryable=True)
             else:
                 raise InjectedFault(point, "error", retryable=False)
+
+    def maybe_corrupt(self, point, round_, arr):
+        """Return ``arr``, NaN-poisoned when a ``corrupt`` rule for
+        ``point`` names sync round ``round_`` (and has firings left).
+        The poison is written into a COPY — the caller's buffer (which
+        the round-replay cache may alias) is never mutated in place by
+        the injector itself; the copy is what gets cached and shipped,
+        so retries/replays of the poisoned round stay bit-identical."""
+        import numpy as np
+
+        for rule in self.rules:
+            if rule.point != point or rule.action != "corrupt":
+                continue
+            with self._lock:
+                if rule.limit and rule.fired >= rule.limit:
+                    continue
+                if int(rule.value) != int(round_):
+                    continue
+                if not np.issubdtype(
+                        np.asarray(arr).dtype, np.floating):
+                    continue
+                rule.fired += 1
+                self.stats[point] = self.stats.get(point, 0) + 1
+            _M_FAULTS.inc()
+            try:
+                from paddle_tpu.observability import flight
+                flight.note_fault("%s:corrupt" % point)
+            except Exception:
+                pass
+            poisoned = np.array(np.asarray(arr), copy=True)
+            poisoned.reshape(-1)[:1] = np.nan
+            return poisoned
+        return arr
+
+
+def maybe_corrupt(point, round_, arr):
+    """Module-level hook mirroring fault_point(): a no-op unless a
+    corrupt rule is installed."""
+    inj = get_injector()
+    if inj.rules:
+        return inj.maybe_corrupt(point, round_, arr)
+    return arr
 
 
 _injector = None

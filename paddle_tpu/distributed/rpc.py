@@ -41,7 +41,8 @@ from concurrent import futures
 
 import numpy as np
 
-from .resilience import FLAGS, InjectedFault, RetryPolicy, fault_point
+from .resilience import FLAGS, InjectedFault, RetryPolicy, fault_point, \
+    maybe_corrupt as _maybe_corrupt
 
 from paddle_tpu.observability import metrics as _obs_metrics
 from paddle_tpu.observability.trace import TRACER as _TRC, \
@@ -573,9 +574,28 @@ class VariableServer:
                 self._async_applied[(sender, name)] = seq
             self._cv.notify_all()
 
+    def _inbound_health(self, name, arr, extra):
+        """Numerics observatory (ISSUE 8): health-check one inbound
+        grad — a poisoned round gets attributed to its (round, sender)
+        cid in a numerics_*.json artifact, so the fault_matrix
+        'numerics' preset (and a real mixed-precision blowup on a
+        trainer) names the trainer that shipped it.  A no-op (one flag
+        read) with FLAGS_check_numerics=off; with a mode on, the
+        isfinite pass costs one read of the payload (the batched
+        handler pays it under the scatter lock — acceptable for a
+        debugging/observability tier, never on by default)."""
+        from paddle_tpu.observability import numerics as _numerics
+
+        try:
+            round_, sender, _ = _unpack_round_sender(extra)
+            _numerics.server_check_grad(name, arr, round_, sender)
+        except Exception:
+            pass  # diagnostics never sink the scatter they observe
+
     def _send_variable(self, req, ctx=None):
         _M_PS_BYTES_RX.inc(len(req))
         name, arr, extra = _dec_tensor(req)
+        self._inbound_health(name, arr, extra)
         sp = None
         if _TRC.on:
             round_, sender, _ = _unpack_round_sender(extra)
@@ -603,6 +623,7 @@ class VariableServer:
             with self._cv:
                 for frame in _iter_batch(req):
                     name, arr, extra = _dec_tensor(frame)
+                    self._inbound_health(name, arr, extra)
                     if sp is not None and sp.cid is None:
                         round_, sender, _ = _unpack_round_sender(extra)
                         if sender is not None:
@@ -1202,6 +1223,7 @@ class RPCClient:
 
     # -- data plane ---------------------------------------------------
     def send_var(self, ep, name, arr):
+        arr = _maybe_corrupt("send_grad", self.step, arr)
         seq = self._record_send(ep, name, arr)
         self._retry_op(
             ep, "SendVariable",
@@ -1377,6 +1399,10 @@ class RPCClient:
             frames = []
             for name, arr in items:
                 arr = self._to_host(arr)
+                # numerics crash lab (ISSUE 8): a corrupt rule poisons
+                # the wire copy BEFORE it is cached, so replays of the
+                # poisoned round stay bit-identical
+                arr = _maybe_corrupt("send_grad", self.step, arr)
                 seq = self._record_send(ep, name, arr)
                 frames.append(_enc_tensor_parts(
                     name, arr,
@@ -1449,6 +1475,7 @@ class RPCClient:
         payloads = []
         for ep, name, arr in triples:
             arr = self._to_host(arr)
+            arr = _maybe_corrupt("send_grad", self.step, arr)
             seq = self._record_send(ep, name, arr)
             payloads.append(_enc_tensor(
                 name, arr,

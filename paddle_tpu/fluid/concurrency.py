@@ -20,7 +20,8 @@ import time as _time
 __all__ = ["Channel", "ChannelClosed", "Go", "make_channel",
            "channel_send", "channel_recv", "channel_close", "Select",
            "ProgramGo", "program_make_channel", "program_channel_send",
-           "program_channel_recv", "program_channel_close"]
+           "program_channel_recv", "program_channel_close",
+           "program_select"]
 
 
 class ChannelClosed(Exception):
@@ -333,6 +334,56 @@ def program_channel_close(channel):
     default_main_program().current_block().append_op(
         type="channel_close", inputs={"Channel": [channel.name]},
         outputs={}, infer_shape=False)
+
+
+def program_select(cases, timeout=0.0):
+    """Append ONE in-program ``select`` op (reference
+    operators/select_op.cc; ISSUE 8 parity rider — the last CSP piece
+    that was host-only).  ``cases`` entries:
+
+        ("recv", channel_var, out_var)   receive into out_var
+        ("send", channel_var, x_var)     send x_var's value
+        ("default",)                     run when nothing is ready
+
+    Exactly one ready case executes when the op runs (interpreted
+    path); returns the int32 [1] CaseIndex variable holding the chosen
+    case's position — branch on it (IfElse / conditional_block) where
+    the reference would attach per-case sub-blocks.  ``timeout`` <= 0
+    blocks forever, Go semantics."""
+    from .framework import default_main_program
+    from . import unique_name
+
+    block = default_main_program().current_block()
+    chans, chan_pos = [], {}
+    specs, xs, outs = [], [], []
+    for case in cases:
+        kind = case[0]
+        if kind == "default":
+            specs.append("default")
+            continue
+        if kind not in ("recv", "send"):
+            raise ValueError("unknown select case kind %r" % (kind,))
+        ch = case[1]
+        if ch.name not in chan_pos:
+            chan_pos[ch.name] = len(chans)
+            chans.append(ch.name)
+        specs.append("%s:%d" % (kind, chan_pos[ch.name]))
+        if kind == "recv":
+            outs.append(case[2].name)
+        else:
+            xs.append(case[2].name)
+    idx = block.create_var(name=unique_name.generate("select_case"),
+                           shape=[1], dtype="int32", persistable=False)
+    inputs = {"Channels": chans}
+    if xs:
+        inputs["X"] = xs
+    outputs = {"CaseIndex": [idx.name]}
+    if outs:
+        outputs["Out"] = outs
+    block.append_op(type="select", inputs=inputs, outputs=outputs,
+                    attrs={"cases": specs, "timeout": float(timeout)},
+                    infer_shape=False)
+    return idx
 
 
 class ProgramGo:

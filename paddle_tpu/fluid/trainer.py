@@ -14,6 +14,7 @@ import numpy as np
 from paddle_tpu.core.executor_impl import PreparedShapeMismatch
 from paddle_tpu.core.place import CPUPlace, TPUPlace
 from paddle_tpu.core.scope import Scope
+from paddle_tpu.observability import numerics as _num
 from paddle_tpu.observability.trace import TRACER as _TRC
 
 from . import framework
@@ -329,6 +330,11 @@ class Trainer:
                         vals = self._run_one_step(exe, prepared, feed,
                                                   metrics,
                                                   begin.fetch_metrics)
+                        # numerics observatory: the recent-loss ring
+                        # rides every numerics_*.json dump — the "what
+                        # was training doing when it blew up" context
+                        if vals and _num.trace_enabled():
+                            _num.note_loss(vals[0])
                         if (self.checkpoint_cfg and
                                 step_id %
                                 self.checkpoint_cfg.step_interval == 0

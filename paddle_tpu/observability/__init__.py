@@ -10,6 +10,12 @@ hang flight recorder.
              chrome://tracing JSON; per-phase breakdown rows
   flight     dump the ring + open spans + metrics on watchdog timeout,
              wall-budget expiry, injected faults, SIGTERM/SIGALRM
+  numerics   (ISSUE 8) on-device tensor-health guards: fused per-step
+             health reduction over watched tensors, four-mode
+             escalation (FLAGS_check_numerics =
+             off|metrics|guard|bisect), numerics_*.json forensics
+             incl. first-bad-op bisection; imported lazily by its
+             consumers (executor, rpc, trainer)
 
 Instrumented sites: core/executor_impl (step/feed/dispatch/sync spans,
 compile-cache + step counters), distributed/rpc (send/gather/barrier/

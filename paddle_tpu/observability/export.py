@@ -21,7 +21,8 @@ import os
 from . import metrics
 
 __all__ = ["load_dump", "chrome_trace", "merge_files", "phase_rows",
-           "format_phase_table", "kernel_rows", "format_kernel_table"]
+           "format_phase_table", "kernel_rows", "format_kernel_table",
+           "numerics_rows", "format_numerics_table"]
 
 
 def load_dump(path):
@@ -208,6 +209,48 @@ def format_kernel_table(rows):
         out.append("%-40s %-7s %7d %10.3f %9.3f %6.1f%%" % (
             r["kernel"][:40], r["side"], r["count"], r["total_ms"],
             r["mean_ms"], 100.0 * r["share"]))
+    return "\n".join(out)
+
+
+def numerics_rows(dumps):
+    """Numerics-observatory rollup (ISSUE 8 satellite): per process
+    dump, the training-health metrics the always-on registry carried —
+    the gradient-norm distribution (trend over the run's recent
+    window), parameter abs-max, nonfinite sightings and guard trips.
+    Works on any trace dump (the metrics snapshot rides every one);
+    processes that never observed a health read-back report zeros."""
+    rows = []
+    for d in dumps:
+        m = d.get("metrics", {})
+        gh = m.get("grad_global_norm", {})
+        rows.append({
+            "label": d.get("label", "?"),
+            "checks": m.get("numerics_checks_total", {}).get("value", 0),
+            "grad_norm_mean": round(gh.get("mean", 0.0), 6),
+            "grad_norm_p50": round(gh.get("p50", 0.0), 6),
+            "grad_norm_p90": round(gh.get("p90", 0.0), 6),
+            "grad_norm_p99": round(gh.get("p99", 0.0), 6),
+            "param_absmax": round(
+                m.get("param_absmax", {}).get("value", 0.0), 6),
+            "nonfinite": m.get("numerics_nonfinite_total",
+                               {}).get("value", 0),
+            "trips": m.get("numerics_trips_total", {}).get("value", 0),
+            "pserver_nonfinite_grads": m.get(
+                "pserver_nonfinite_grads_total", {}).get("value", 0),
+        })
+    rows.sort(key=lambda r: r["label"])
+    return rows
+
+
+def format_numerics_table(rows):
+    out = ["%-24s %7s %13s %12s %12s %12s %10s %6s" % (
+        "process", "checks", "grad_norm_p50", "p90", "p99",
+        "param_absmax", "nonfinite", "trips")]
+    for r in rows:
+        out.append("%-24s %7d %13.4g %12.4g %12.4g %12.4g %10d %6d" % (
+            r["label"][:24], r["checks"], r["grad_norm_p50"],
+            r["grad_norm_p90"], r["grad_norm_p99"], r["param_absmax"],
+            r["nonfinite"], r["trips"]))
     return "\n".join(out)
 
 

@@ -9,9 +9,14 @@ ops make a *program* contain channel traffic — channel_create leaves a
 Channel in the scope, send/recv are host ops reading/writing program
 variables, and ``go`` launches its sub-block on a daemon thread through
 a nested interpreted executor (go_op.cc:84 ExecuteOnThread).  ``select``
-stays a host-level facility (fluid.concurrency.Select) — a data-driven
-select inside a ProgramDesc would need per-case sub-blocks wired by the
-front-end, which the superseded reference API never stabilized.
+(reference operators/select_op.cc over framework/channel.h:33) is an
+in-program op since ISSUE 8: the case list serializes as a string attr
+('recv:<k>' / 'send:<k>' / 'default', <k> indexing the Channels input
+slot), the chosen case's recv target / send value are program
+variables, and the chosen case INDEX lands in the CaseIndex output so
+downstream program logic (IfElse / conditional_block on CaseIndex)
+plays the role of the reference's per-case sub-blocks — which its
+superseded front-end never stabilized.
 """
 from __future__ import annotations
 
@@ -101,6 +106,70 @@ def _channel_recv(executor, op, scope, feed, env=None):
 @_host("channel_close")
 def _channel_close(executor, op, scope, feed, env=None):
     scope.find_var(op.input("Channel")[0]).close()
+
+
+@_host("select")
+def _select(executor, op, scope, feed, env=None):
+    """In-program multi-channel select (reference select_op.cc).
+
+    inputs:  Channels — the live Channel vars the cases name;
+             X        — send-case values, in send-case order.
+    outputs: Out       — recv-case targets, in recv-case order;
+             CaseIndex — [1] int32, the position of the case that ran.
+    attrs:   cases   — ['recv:<k>' | 'send:<k>' | 'default', ...]
+                       (<k> indexes the Channels slot);
+             timeout — seconds; <= 0 blocks forever (Go semantics).
+
+    Exactly one ready case executes (fluid.concurrency.Select does the
+    polling); a recv on a closed+drained channel yields the typed zero
+    channel_recv produces, so a select over a dead producer terminates
+    instead of hanging."""
+    from paddle_tpu.fluid.concurrency import Select
+
+    chans = op.inputs.get("Channels", [])
+    xs = op.inputs.get("X", [])
+    outs = op.outputs.get("Out", [])
+    case_specs = [str(c) for c in (op.attr("cases") or [])]
+    timeout = float(op.attr("timeout") or 0.0)
+
+    def _write(name, val):
+        _scope_set(scope, name, val)
+        if env is not None:
+            env[name] = val
+
+    cases = []
+    ri = si = 0
+    for ci, spec in enumerate(case_specs):
+        kind, _, k = spec.partition(":")
+        if kind == "default":
+            cases.append(("default", lambda _ci=ci: _ci))
+            continue
+        ch = scope.find_var(chans[int(k)])
+        if kind == "recv":
+            out_name = outs[ri]
+            ri += 1
+
+            def on_recv(val, _ci=ci, _out=out_name, _ch=ch):
+                if val is None:  # closed + drained: typed zero
+                    dt = np.dtype(getattr(_ch, "dtype", None)
+                                  or np.float32)
+                    val = np.zeros((1,), dt)
+                _write(_out, np.asarray(val))
+                return _ci
+
+            cases.append(("recv", ch, on_recv))
+        elif kind == "send":
+            val = _value_of(xs[si], scope, feed, env)
+            si += 1
+            cases.append(("send", ch, np.asarray(val),
+                          lambda _ci=ci: _ci))
+        else:
+            raise ValueError("select: bad case spec %r" % spec)
+    chosen = Select(cases).run(
+        timeout=timeout if timeout > 0 else None)
+    idx_out = op.outputs.get("CaseIndex")
+    if idx_out and idx_out[0]:
+        _write(idx_out[0], np.asarray([chosen], np.int32))
 
 
 def _block_idx(attr_val):

@@ -1,0 +1,396 @@
+"""Numerics observatory (ISSUE 8): on-device tensor-health guards,
+gradient telemetry, and first-bad-op forensics.
+
+Pins the tentpole contracts:
+
+- planted-overflow e2e: an fp32 model whose activation overflows at a
+  KNOWN op (exp of a large pre-activation) — ``bisect`` must name
+  exactly that op on BOTH the compiled run() path and the prepared
+  one-dispatch path, leave a ``numerics_*.json`` flight artifact, and
+  (prepared) restore the pre-step parameters for post-mortem;
+- bit-exactness of ``metrics`` mode vs ``off``: the fused health
+  reduction is an extra OUTPUT, never a change to the math — losses
+  and params identical over 3 steps on run() AND prepared paths;
+- guard-trip flight-dump schema golden;
+- gradient telemetry feeding the always-on registry;
+- the legacy FLAGS_check_nan_inf no longer refuses prepare() — it maps
+  onto the guard+bisect machinery with the same first-bad-op answer;
+- wire-corruption attribution: a NaN-poisoned gradient injected at a
+  chosen sync round (FaultInjector ``corrupt``) leaves a pserver-side
+  numerics artifact naming that round's cid and the sender
+  (tools/fault_matrix.py --preset numerics drives this same test).
+"""
+import glob
+import json
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.core.flags import FLAGS
+from paddle_tpu.core.scope import Scope
+from paddle_tpu.observability import metrics as obs_metrics
+from paddle_tpu.observability import numerics
+from paddle_tpu.observability.numerics import NumericsError
+
+CORRUPT_ROUND = 2  # keep in sync with tools/fault_matrix.py NUMERICS_ROUND
+
+
+@pytest.fixture(autouse=True)
+def _numerics_flags(tmp_path):
+    """Every test runs with a private dump dir and restored flags."""
+    saved = (FLAGS.check_numerics, FLAGS.check_numerics_every,
+             FLAGS.check_nan_inf, FLAGS.telemetry_dump_dir)
+    # normalize: each test states its own mode (the fault_matrix
+    # preset exports FLAGS_check_numerics=guard process-wide)
+    FLAGS.check_numerics = "off"
+    FLAGS.check_numerics_every = 16
+    FLAGS.check_nan_inf = False
+    FLAGS.telemetry_dump_dir = str(tmp_path / "dumps")
+    numerics.reset()
+    yield
+    (FLAGS.check_numerics, FLAGS.check_numerics_every,
+     FLAGS.check_nan_inf, FLAGS.telemetry_dump_dir) = saved
+    numerics.reset()
+
+
+def _artifacts():
+    return sorted(glob.glob(
+        os.path.join(FLAGS.telemetry_dump_dir, "numerics_*.json")))
+
+
+def _overflow_model(train=False):
+    """exp() of a 300x-scaled pre-activation: with constant 0.1
+    weights and an all-ones feed the fc output is 0.4, 300*0.4 = 120,
+    and exp(120) overflows float32 -> inf AT THE EXP OP."""
+    x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+    h = fluid.layers.fc(x, size=4, param_attr=fluid.ParamAttr(
+        name="w", initializer=fluid.initializer.ConstantInitializer(0.1)))
+    bad = fluid.layers.exp(fluid.layers.scale(h, scale=300.0))
+    loss = fluid.layers.mean(bad)
+    if train:
+        fluid.optimizer.SGD(learning_rate=0.01).minimize(loss)
+    return loss
+
+
+def _build(model_fn, **kw):
+    main, startup = fluid.Program(), fluid.Program()
+    scope = Scope()
+    with fluid.scope_guard(scope):
+        with fluid.program_guard(main, startup):
+            with fluid.unique_name.guard():
+                loss = model_fn(**kw)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+    return main, scope, exe, loss
+
+
+FEED = {"x": np.ones((2, 4), np.float32)}
+
+
+# ---------------------------------------------------------------- bisect
+
+def test_bisect_names_planted_overflow_op_on_run_path():
+    main, scope, exe, loss = _build(_overflow_model)
+    with fluid.scope_guard(scope):
+        FLAGS.check_numerics = "bisect"
+        with pytest.raises(NumericsError) as ei:
+            exe.run(main, feed=FEED, fetch_list=[loss])
+    e = ei.value
+    assert e.op_type == "exp"
+    assert "'exp'" in str(e)
+    assert e.location["block"] == 0 and e.location["op_idx"] is not None
+    # forensics artifact names the same op
+    arts = _artifacts()
+    assert arts, "bisect trip left no numerics_*.json"
+    rec = json.loads(open(arts[0]).read())
+    assert rec["kind"] == "numerics"
+    assert rec["first_bad_op"]["type"] == "exp"
+    assert rec["first_bad_op"]["inputs"]  # input stats recorded
+
+
+def test_bisect_on_prepared_path_names_op_and_restores_state():
+    main, scope, exe, loss = _build(_overflow_model, train=True)
+    with fluid.scope_guard(scope):
+        FLAGS.check_numerics = "bisect"
+        prep = exe.prepare(main, feed_specs=FEED, fetch_list=[loss])
+        w0 = np.array(np.asarray(scope.find_var("w")), copy=True)
+        with pytest.raises(NumericsError) as ei:
+            prep.run_prepared(FEED)
+        assert ei.value.op_type == "exp"
+        # the pre-step snapshot was restored: params are NOT poisoned
+        # and NOT donated husks — post-mortem inspection works
+        assert np.array_equal(w0, np.asarray(scope.find_var("w")))
+    assert any("first_bad_op" in json.loads(open(p).read())
+               for p in _artifacts())
+
+
+def test_legacy_check_nan_inf_is_allowed_on_prepared_path():
+    """PR 2 refused prepare() under FLAGS.check_nan_inf; the flag now
+    maps onto the guard+bisect machinery and gives the reference
+    answer (first bad op, by name) without giving up the one-dispatch
+    step (MIGRATION.md)."""
+    main, scope, exe, loss = _build(_overflow_model)
+    with fluid.scope_guard(scope):
+        FLAGS.check_nan_inf = True
+        prep = exe.prepare(main, feed_specs=FEED,
+                           fetch_list=[loss])  # must NOT raise
+        with pytest.raises(FloatingPointError) as ei:
+            prep.run_prepared(FEED)
+    assert getattr(ei.value, "op_type", None) == "exp"
+
+
+def test_bisect_run_path_trip_at_later_step_of_training_program():
+    """Regression (review): from step 2 on, the scope's persistables
+    ARE the arrays donated to the dispatch — a trip then must still
+    produce the first-bad-op answer (pre-step snapshot, like the
+    prepared path) and leave the scope holding LIVE pre-step values,
+    not consumed husks."""
+    main, scope, exe, loss = _build(_overflow_model, train=True)
+    with fluid.scope_guard(scope):
+        FLAGS.check_numerics = "bisect"
+        # step 1: tiny feed, exp(300*0.004*4) stays finite; params
+        # update in place (donation)
+        exe.run(main, feed={"x": np.full((2, 4), 0.001, np.float32)},
+                fetch_list=[loss])
+        w1 = np.array(np.asarray(scope.find_var("w")), copy=True)
+        # step 2: the planted overflow (large feed overwhelms the
+        # bias shift step 1's update introduced)
+        with pytest.raises(NumericsError) as ei:
+            exe.run(main, feed={"x": np.full((2, 4), 10.0, np.float32)},
+                    fetch_list=[loss])
+        assert ei.value.op_type == "exp"
+        # scope restored to pre-step-2 values, readable (live buffers)
+        assert np.array_equal(w1, np.asarray(scope.find_var("w")))
+
+
+def test_guard_run_path_trip_leaves_live_scope():
+    """Guard mode (no snapshot): a trip at step 2 publishes the
+    post-step values first — poisoned, but live and readable for
+    post-mortem (never donated husks)."""
+    main, scope, exe, loss = _build(_overflow_model, train=True)
+    with fluid.scope_guard(scope):
+        FLAGS.check_numerics = "guard"
+        FLAGS.check_numerics_every = 1
+        exe.run(main, feed={"x": np.full((2, 4), 0.001, np.float32)},
+                fetch_list=[loss])
+        with pytest.raises(NumericsError):
+            exe.run(main, feed={"x": np.full((2, 4), 10.0, np.float32)},
+                    fetch_list=[loss])
+        np.asarray(scope.find_var("w"))  # must not raise 'deleted'
+
+
+# ---------------------------------------------------------------- guard
+
+def test_guard_trip_flight_dump_schema():
+    main, scope, exe, loss = _build(_overflow_model)
+    with fluid.scope_guard(scope):
+        FLAGS.check_numerics = "guard"
+        numerics.note_loss(1.25)  # recent-loss context rides the dump
+        with pytest.raises(NumericsError) as ei:
+            exe.run(main, feed=FEED, fetch_list=[loss])
+    assert ei.value.flight_path and os.path.exists(ei.value.flight_path)
+    rec = json.loads(open(ei.value.flight_path).read())
+    # schema golden: the keys the tooling (trace_report --numerics,
+    # fault_matrix) and humans rely on
+    for key in ("kind", "reason", "wall_time", "pid", "mode", "losses",
+                "site", "step", "trip_vars", "stats"):
+        assert key in rec, key
+    assert rec["kind"] == "numerics"
+    assert rec["mode"] == "guard"
+    assert rec["reason"].startswith("guard:")
+    assert rec["losses"][-1] == 1.25
+    assert rec["trip_vars"]
+    tripped = rec["stats"][rec["trip_vars"][0]]
+    assert tripped["finite"] == 0.0
+    assert set(tripped) == set(numerics.STAT_FIELDS)
+
+
+def test_off_mode_lets_nonfinite_flow():
+    main, scope, exe, loss = _build(_overflow_model)
+    with fluid.scope_guard(scope):
+        out, = exe.run(main, feed=FEED, fetch_list=[loss])
+    assert np.isinf(np.asarray(out)).all()
+    assert _artifacts() == []
+
+
+# --------------------------------------------------------------- metrics
+
+def _healthy_model():
+    x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+    h = fluid.layers.fc(x, size=8, act="relu")
+    loss = fluid.layers.mean(fluid.layers.fc(h, size=2))
+    fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    return loss
+
+
+def _train_steps(mode, prepared, steps=3):
+    # build + startup under 'off' so the mode applies to exactly the
+    # training steps (a startup run would otherwise contribute a
+    # health check of its own)
+    FLAGS.check_numerics = "off"
+    main, scope, exe, loss = _build(_healthy_model)
+    FLAGS.check_numerics = mode
+    losses = []
+    with fluid.scope_guard(scope):
+        prep = exe.prepare(main, feed_specs=FEED, fetch_list=[loss]) \
+            if prepared else None
+        for i in range(steps):
+            feed = {"x": np.full((2, 4), 1.0 + i, np.float32)}
+            if prep is not None:
+                out, = prep.run_prepared(feed)
+            else:
+                out, = exe.run(main, feed=feed, fetch_list=[loss])
+            losses.append(np.array(np.asarray(out), copy=True))
+        if prep is not None:
+            prep.sync_scope()
+        params = {n: np.array(np.asarray(scope.find_var(n)), copy=True)
+                  for n in ("fc_0.w_0", "fc_0.b_0", "fc_1.w_0",
+                            "fc_1.b_0")}
+    return losses, params
+
+
+@pytest.mark.parametrize("prepared", [False, True],
+                         ids=["run", "prepared"])
+def test_metrics_mode_is_bit_exact_with_off(prepared):
+    """The health reduction is an extra OUTPUT of the step, never a
+    change to its math: losses and params bitwise identical."""
+    FLAGS.check_numerics_every = 1
+    base_l, base_p = _train_steps("off", prepared)
+    met_l, met_p = _train_steps("metrics", prepared)
+    for a, b in zip(base_l, met_l):
+        assert np.array_equal(a, b)
+    for n in base_p:
+        assert np.array_equal(base_p[n], met_p[n]), n
+
+
+def test_metrics_mode_feeds_registry():
+    obs_metrics.zero_all()
+    FLAGS.check_numerics_every = 1
+    _train_steps("metrics", True, steps=4)
+    snap = obs_metrics.snapshot()
+    assert snap["numerics_checks_total"]["value"] >= 4
+    assert snap["grad_global_norm"]["count"] >= 4
+    assert snap["grad_global_norm"]["p50"] > 0.0
+    assert snap["param_absmax"]["value"] > 0.0
+    assert snap["numerics_nonfinite_total"]["value"] == 0
+    assert snap["numerics_trips_total"]["value"] == 0
+
+
+def test_cadence_amortizes_health_dispatch():
+    """With every=4, only steps 1, 4, 8, ... dispatch the health twin
+    (the rest run the plain executable): checks_total counts exactly
+    the cadence steps."""
+    obs_metrics.zero_all()
+    FLAGS.check_numerics_every = 4
+    _train_steps("metrics", True, steps=8)
+    snap = obs_metrics.snapshot()
+    assert snap["numerics_checks_total"]["value"] == 3  # steps 1, 4, 8
+
+
+# ------------------------------------------------- wire corruption e2e
+
+def test_corrupt_round_is_attributed_to_sender_cid():
+    """FaultInjector 'corrupt' poisons ONE wire gradient with NaN at
+    round CORRUPT_ROUND; the pserver scatter health check writes a
+    numerics artifact naming that round's cid and the sender — the
+    contract tools/fault_matrix.py --preset numerics enforces."""
+    from paddle_tpu.distributed.resilience import install_faults
+    from paddle_tpu.distributed.rpc import RPCClient, VariableServer
+
+    FLAGS.check_numerics = "guard"
+    # tools/fault_matrix.py --preset numerics exports a dump dir and
+    # asserts the corrupt-round artifact lands THERE; standalone runs
+    # keep the fixture's private tmp dir
+    env_dir = os.environ.get("FLAGS_telemetry_dump_dir")
+    if env_dir:
+        FLAGS.telemetry_dump_dir = env_dir
+    install_faults("send_grad:corrupt:%d:1" % CORRUPT_ROUND)
+    scope = Scope()
+    scope.set("p1", np.zeros((8, 4), np.float32))
+
+    def apply_block(bid):
+        p = np.array(np.asarray(scope.find_var("p1")), copy=True)
+        p -= np.asarray(scope.find_var("g1"))
+        scope.set("p1", p)
+
+    srv = VariableServer(scope, {"g1": 0}, apply_block, fanin=1,
+                         grad_params={"g1": ("p1",)})
+    port = srv.start("127.0.0.1:0")
+    ep = "127.0.0.1:%d" % port
+    RPCClient.reset()
+    cli = RPCClient.instance()
+    try:
+        for _ in range(CORRUPT_ROUND + 2):
+            cli.send_vars([(ep, "g1",
+                            np.full((8, 4), 1.0, np.float32))])
+            cli.send_barrier([ep])
+            cli.get_vars([(ep, "p1")])
+    finally:
+        try:
+            cli.send_complete([ep])
+            srv.wait()
+        finally:
+            install_faults("")
+            RPCClient.reset()
+    arts = _artifacts()
+    assert arts, "poisoned round left no numerics artifact"
+    recs = [json.loads(open(p).read()) for p in arts]
+    hit = [r for r in recs if r.get("cid") == "round:%d" % CORRUPT_ROUND]
+    assert hit, [r.get("cid") for r in recs]
+    assert hit[0]["site"] == "pserver.scatter"
+    assert hit[0]["sender"]
+    assert hit[0]["stats"]["nan"] == 1  # exactly one poisoned element
+    assert obs_metrics.snapshot()[
+        "pserver_nonfinite_grads_total"]["value"] >= 1
+
+
+def test_corrupt_rule_poisons_copy_not_caller_buffer():
+    from paddle_tpu.distributed.resilience import FaultInjector
+
+    inj = FaultInjector("send_grad:corrupt:3:1")
+    arr = np.ones((4,), np.float32)
+    out = inj.maybe_corrupt("send_grad", 3, arr)
+    assert np.isnan(out[0]) and not np.isnan(arr).any()
+    # limit exhausted: second call passes through
+    again = inj.maybe_corrupt("send_grad", 3, arr)
+    assert not np.isnan(again).any()
+    # wrong round / wrong point: untouched
+    inj2 = FaultInjector("send_grad:corrupt:3:1")
+    assert not np.isnan(
+        inj2.maybe_corrupt("send_grad", 2, arr)).any()
+    assert not np.isnan(
+        inj2.maybe_corrupt("get_param", 3, arr)).any()
+
+
+# ------------------------------------------------------------- tooling
+
+def test_trace_report_numerics_rollup(tmp_path, capsys):
+    """trace_report --numerics prints the grad-norm rollup from a
+    trace dump and summarizes numerics trip artifacts."""
+    import sys
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..",
+                                    "tools"))
+    import trace_report
+
+    obs_metrics.zero_all()
+    FLAGS.check_numerics_every = 1
+    _train_steps("metrics", True, steps=3)
+    from paddle_tpu.observability.trace import Tracer
+    dump = str(tmp_path / "trace_t0.json")
+    t = Tracer(enabled=True)
+    t.set_label("trainer0")
+    t.end(t.begin("step.prepared"))  # one span so the report has rows
+    t.dump(dump)
+    trip = str(tmp_path / "numerics_1_1.json")
+    with open(trip, "w") as f:
+        json.dump({"kind": "numerics", "reason": "guard:test",
+                   "cid": "round:7", "trip_vars": ["w"],
+                   "losses": [1.0, 2.0]}, f)
+    rc = trace_report.main([dump, trip, "--numerics"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "numerics rollup" in out
+    assert "trainer0" in out
+    assert "numerics trip artifacts" in out and "round:7" in out

@@ -164,3 +164,92 @@ def test_go_block_captures_parent_temp(prog_scope, exe):
     np.testing.assert_allclose(np.asarray(res), xs * 5.0, rtol=1e-5)
     from paddle_tpu.ops.concurrency_ops import join_go_threads
     join_go_threads(scope)
+
+
+def test_program_select_recv_takes_ready_channel(prog_scope, exe):
+    """In-program select (ISSUE 8 parity rider; reference
+    operators/select_op.cc): a producer go-routine feeds channel B;
+    select over (recv A, recv B) takes the ready case, CaseIndex names
+    it, and the received value lands in the case's Out var."""
+    main, startup, scope = prog_scope
+    x = fluid.layers.data(name="sx", shape=[3], dtype="float32")
+    ch_a = C.program_make_channel(dtype="float32", capacity=1)
+    ch_b = C.program_make_channel(dtype="float32", capacity=1)
+    with C.ProgramGo():
+        C.program_channel_send(ch_b, x)
+    got_a = fluid.layers.data(name="sel_a", shape=[3], dtype="float32")
+    got_b = fluid.layers.data(name="sel_b", shape=[3], dtype="float32")
+    idx = C.program_select([("recv", ch_a, got_a),
+                            ("recv", ch_b, got_b)], timeout=10.0)
+    out = fluid.layers.scale(got_b, scale=5.0)
+    exe.run(startup)
+    xs = np.arange(3, dtype=np.float32).reshape(1, 3)
+    iv, ov = exe.run(main, feed={"sx": xs}, fetch_list=[idx, out])
+    assert int(np.asarray(iv).ravel()[0]) == 1  # case 1 = recv B
+    np.testing.assert_allclose(np.asarray(ov), xs * 5.0, rtol=1e-6)
+    from paddle_tpu.ops.concurrency_ops import join_go_threads
+    join_go_threads(scope)
+
+
+def test_program_select_default_and_send(prog_scope, exe):
+    """Nothing ready -> the default case runs; a send case delivers
+    into a buffered channel and a later recv sees the value."""
+    main, startup, scope = prog_scope
+    empty = C.program_make_channel(dtype="float32", capacity=0)
+    buf = C.program_make_channel(dtype="float32", capacity=2)
+    x = fluid.layers.data(name="dx", shape=[2], dtype="float32")
+    # select 1: recv on an empty rendezvous channel vs default
+    idx1 = C.program_select([("recv", empty,
+                              fluid.layers.data(name="d_got", shape=[2],
+                                                dtype="float32")),
+                             ("default",)])
+    # select 2: send into the buffered channel (always ready)
+    idx2 = C.program_select([("send", buf, x)], timeout=10.0)
+    got = fluid.layers.data(name="d_got2", shape=[2], dtype="float32")
+    C.program_channel_recv(buf, got)
+    exe.run(startup)
+    xs = np.full((1, 2), 7.0, np.float32)
+    i1, i2, gv = exe.run(main, feed={"dx": xs},
+                         fetch_list=[idx1, idx2, got])
+    assert int(np.asarray(i1).ravel()[0]) == 1  # default case position
+    assert int(np.asarray(i2).ravel()[0]) == 0
+    np.testing.assert_allclose(np.asarray(gv), xs, rtol=0)
+
+
+def test_program_select_roundtrip_serialized(prog_scope, exe):
+    """The select structure survives proto round-trip: serialize,
+    reparse, run — same chosen case and value (the VERDICT 'missing'
+    item: select as part of the serialized program)."""
+    main, startup, scope = prog_scope
+    x = fluid.layers.data(name="rx", shape=[2], dtype="float32")
+    ch = C.program_make_channel(dtype="float32", capacity=1)
+    with C.ProgramGo():
+        C.program_channel_send(ch, x)
+    got = fluid.layers.data(name="r_got", shape=[2], dtype="float32")
+    idx = C.program_select([("recv", ch, got)], timeout=10.0)
+    out = fluid.layers.scale(got, scale=2.0)
+    reparsed = fluid.Program.parse_from_string(
+        main.serialize_to_string())
+    exe.run(startup)
+    xs = np.ones((1, 2), np.float32)
+    iv, ov = exe.run(reparsed, feed={"rx": xs},
+                     fetch_list=[idx.name, out.name])
+    assert int(np.asarray(iv).ravel()[0]) == 0
+    np.testing.assert_allclose(np.asarray(ov), xs * 2.0, rtol=1e-6)
+    from paddle_tpu.ops.concurrency_ops import join_go_threads
+    join_go_threads(scope)
+
+
+def test_program_select_closed_channel_yields_typed_zero(prog_scope,
+                                                         exe):
+    """select recv on a closed+drained channel terminates with the
+    typed zero channel_recv produces (no hang on a dead producer)."""
+    main, startup, scope = prog_scope
+    ch = C.program_make_channel(dtype="float32", capacity=1)
+    C.program_channel_close(ch)
+    got = fluid.layers.data(name="c_got", shape=[1], dtype="float32")
+    idx = C.program_select([("recv", ch, got)], timeout=10.0)
+    exe.run(startup)
+    iv, gv = exe.run(main, feed={}, fetch_list=[idx, got])
+    assert int(np.asarray(iv).ravel()[0]) == 0
+    assert np.asarray(gv).ravel()[0] == 0.0

@@ -393,3 +393,57 @@ def test_op_slot_error_names_op_and_slots():
     # probing with an explicit default stays non-raising
     assert op.input("Z", []) == []
     assert op.output("Result", []) == []
+
+
+# ---------------------------------------------------------------------------
+# numerics (ISSUE 8): risk ops x half-precision inputs
+# ---------------------------------------------------------------------------
+
+def test_numerics_flags_declared_half_precision_risk_input():
+    from paddle_tpu.core.types import DataType
+
+    prog = _prog_with(
+        [O("exp", {"X": ["h"]}, {"Out": ["e"]})],
+        [V("h", shape=(2, 3), dtype=DataType.FP16),
+         V("e", shape=(2, 3), dtype=DataType.FP16)])
+    diags = _diags(prog, "numerics")
+    assert len(diags) == 1
+    d = diags[0]
+    assert d.severity == Severity.WARNING and d.op_type == "exp" \
+        and d.var == "h"
+    assert "half-precision" in d.message
+
+
+def test_numerics_flags_amp_white_producer_into_unprotected_risk_op():
+    prog = _prog_with(
+        [O("mul", {"X": ["x"], "Y": ["w"]}, {"Out": ["h"]}),
+         O("elementwise_div", {"X": ["h"], "Y": ["d"]},
+           {"Out": ["q"]})],
+        [V("x", shape=(2, 3)), V("w", shape=(3, 3)),
+         V("h", shape=(2, 3)), V("d", shape=(2, 3)),
+         V("q", shape=(2, 3))])
+    # without AMP: nothing is bf16 at trace time -> clean
+    assert _diags(prog, "numerics") == []
+    prog.amp_bf16 = True
+    diags = _diags(prog, "numerics")
+    assert any(d.op_type == "elementwise_div" and d.var == "h"
+               and "bf16 output of autocast op 'mul'" in d.message
+               for d in diags)
+
+
+def test_numerics_amp_black_risk_op_is_protected():
+    """log/exp are AMP_BLACK: the lowering casts their inputs back to
+    f32 under AMP, so no diagnostic is due for the same pattern."""
+    prog = _prog_with(
+        [O("mul", {"X": ["x"], "Y": ["w"]}, {"Out": ["h"]}),
+         O("log", {"X": ["h"]}, {"Out": ["l"]})],
+        [V("x", shape=(2, 3)), V("w", shape=(3, 3)),
+         V("h", shape=(2, 3)), V("l", shape=(2, 3))])
+    prog.amp_bf16 = True
+    assert _diags(prog, "numerics") == []
+
+
+def test_numerics_clean_f32_program(prog_scope):
+    main, startup, scope = prog_scope
+    build_fit_a_line()
+    assert _diags(main.desc, "numerics") == []

@@ -42,7 +42,55 @@ PRESETS = {
     "mixed": ("send_grad:drop:0.15:15,get_param:delay:0.05:10,"
               "get_param:drop:0.15:15,send_barrier:drop:0.25:6,"
               "master_rpc:drop:0.1:10"),
+    # numerics observatory (ISSUE 8): poison ONE wire gradient with NaN
+    # at sync round 2 and require the pserver-side attribution artifact
+    # — run_numerics_preset() runs tests/test_numerics.py and FAILs
+    # unless a numerics_*.json names the poisoned round's cid
+    "numerics": "send_grad:corrupt:%d:1" % 2,
 }
+
+NUMERICS_ROUND = 2
+
+
+def run_numerics_preset(pytest_args):
+    """The 'numerics' preset is an end-to-end attribution check, not a
+    resilience sweep: tests/test_numerics.py sends a NaN-poisoned
+    gradient at round NUMERICS_ROUND through the real wire, and this
+    runner FAILs (rc 3) unless the run leaves a numerics_*.json flight
+    artifact whose cid is exactly that round — the breadcrumb that
+    makes a poisoned round attributable to the trainer that sent it."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["FLAGS_check_numerics"] = "guard"
+    dump_dir = tempfile.mkdtemp(prefix="fault_flight_numerics_")
+    env["FLAGS_telemetry_dump_dir"] = dump_dir
+    cmd = [sys.executable, "-m", "pytest", "tests/test_numerics.py",
+           "-q", "-p", "no:cacheprovider", "-o", "addopts="] + pytest_args
+    t0 = time.time()
+    proc = subprocess.run(cmd, cwd=REPO, env=env)
+    rc = proc.returncode
+    want_cid = "round:%d" % NUMERICS_ROUND
+    arts = glob.glob(os.path.join(dump_dir, "numerics_*.json"))
+    matched = 0
+    for path in arts:
+        try:
+            import json
+            with open(path) as f:
+                if json.load(f).get("cid") == want_cid:
+                    matched += 1
+        except Exception:
+            pass
+    if rc == 0 and matched == 0:
+        print("preset 'numerics': no numerics_*.json naming cid %r "
+              "under %s — the poisoned round was not attributed"
+              % (want_cid, dump_dir), file=sys.stderr)
+        rc = 3
+    if rc == 0:
+        shutil.rmtree(dump_dir, ignore_errors=True)
+    else:
+        print("preset 'numerics' FAILED (rc=%d); artifacts kept at %s"
+              % (rc, dump_dir), file=sys.stderr)
+    return rc, time.time() - t0, dump_dir, matched
 
 
 def run_preset(name, spec, seed, pytest_args):
@@ -76,6 +124,10 @@ def main(argv=None):
         description="fault-injection suite matrix runner")
     ap.add_argument("presets", nargs="*",
                     help="preset names (default: the whole matrix)")
+    ap.add_argument("--preset", action="append", default=[],
+                    dest="preset_flags", metavar="NAME",
+                    help="preset name (flag form; may repeat — merged "
+                         "with the positional list)")
     ap.add_argument("--list", action="store_true",
                     help="list presets and exit")
     ap.add_argument("--spec", default=None,
@@ -98,7 +150,8 @@ def main(argv=None):
     if args.spec is not None:
         matrix = [("adhoc", args.spec)]
     else:
-        names = args.presets or list(PRESETS)
+        names = (list(args.presets) + list(args.preset_flags)) \
+            or list(PRESETS)
         unknown = [n for n in names if n not in PRESETS]
         if unknown:
             ap.error("unknown preset(s) %s; --list shows the matrix"
@@ -108,6 +161,11 @@ def main(argv=None):
     rows = []
     for name, spec in matrix:
         print("=== preset %r: FLAGS_fault_spec=%r" % (name, spec))
+        if name == "numerics":
+            rc, secs, dump_dir, n_dumps = run_numerics_preset(
+                pytest_args)
+            rows.append((name, rc, secs, n_dumps))
+            continue
         rc, secs, dump_dir, n_dumps = run_preset(name, spec, args.seed,
                                                  pytest_args)
         # a preset that injects faults must leave a flight-recorder

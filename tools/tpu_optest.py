@@ -749,12 +749,35 @@ def composite_conditional_block():
     return {"cb_flag": np.asarray([3.0], np.float32)}, [out]
 
 
+def composite_select():
+    """In-program CSP select (ISSUE 8 parity rider; reference
+    operators/select_op.cc): channel_create + go producer +
+    channel_send + select(recv|recv) + the device consumer of the
+    received value.  Credits: select, channel_create, channel_send,
+    go."""
+    from paddle_tpu.fluid import concurrency as C
+
+    x = layers.data(name="sel_x", shape=[3], dtype="float32")
+    ch_idle = C.program_make_channel(dtype="float32", capacity=1)
+    ch_live = C.program_make_channel(dtype="float32", capacity=1)
+    with C.ProgramGo():
+        C.program_channel_send(ch_live, layers.scale(x, scale=2.0))
+    got_a = layers.data(name="sel_got_a", shape=[3], dtype="float32")
+    got_b = layers.data(name="sel_got_b", shape=[3], dtype="float32")
+    idx = C.program_select([("recv", ch_idle, got_a),
+                            ("recv", ch_live, got_b)], timeout=10.0)
+    out = layers.scale(got_b, scale=10.0)
+    xv = np.random.RandomState(6).randn(2, 3).astype(np.float32)
+    return {"sel_x": xv}, [idx, out]
+
+
 COMPOSITES = {
     "while_array": composite_while_array,
     "ifelse": composite_ifelse,
     "dynrnn": composite_dynrnn,
     "lod_array_round_trip": composite_lod_array_round_trip,
     "conditional_block": composite_conditional_block,
+    "select": composite_select,
 }
 
 

@@ -15,6 +15,9 @@ Usage:
     python tools/trace_report.py DUMPS... --merge merged_trace.json
     python tools/trace_report.py DUMPS... --xplane /tmp/xprof_capture
     python tools/trace_report.py DUMPS... --prefix step. --top 20
+    python tools/trace_report.py DUMPS... --numerics   # grad-norm
+        rollup per process; numerics_*.json trip artifacts passed as
+        inputs are summarized (first bad op, round cid, recent losses)
 
 --merge writes one chrome://tracing JSON: each process is a chrome
 pid named by its label, and spans of the same sync round share a
@@ -30,6 +33,36 @@ import sys
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 if REPO not in sys.path:
     sys.path.insert(0, REPO)
+
+
+def _print_trips(paths):
+    """Summarize numerics_*.json trip artifacts: who tripped, where,
+    which round/step, and the first bad op when bisect named one."""
+    print("numerics trip artifacts:")
+    for p in sorted(paths):
+        try:
+            with open(p) as f:
+                rec = json.load(f)
+        except Exception as e:
+            print("  %s: unreadable (%s)" % (p, e))
+            continue
+        parts = [rec.get("reason", "?")]
+        if rec.get("cid"):
+            parts.append("cid=%s" % rec["cid"])
+        if rec.get("sender"):
+            parts.append("sender=%s" % rec["sender"])
+        fbo = rec.get("first_bad_op")
+        if fbo:
+            parts.append("first_bad_op=%s (block %s op %s, out %s)" % (
+                fbo.get("type"), fbo.get("block"), fbo.get("op_idx"),
+                fbo.get("output")))
+        if rec.get("trip_vars"):
+            parts.append("vars=%s" % rec["trip_vars"][:4])
+        losses = rec.get("losses") or []
+        if losses:
+            parts.append("recent_losses=%s" % [
+                round(v, 4) for v in losses[-4:]])
+        print("  %s: %s" % (os.path.basename(p), "  ".join(parts)))
 
 
 def main(argv=None):
@@ -55,9 +88,32 @@ def main(argv=None):
                     help="with --json: wrap output as {phases, kernels} "
                          "including the per-kernel rollup (text mode "
                          "always prints the rollup when kernels exist)")
+    ap.add_argument("--numerics", action="store_true",
+                    help="print the numerics-observatory rollup "
+                         "(grad-norm trend, param absmax, nonfinite "
+                         "counts per process — ISSUE 8); "
+                         "numerics_*.json trip artifacts may also be "
+                         "passed as inputs and are summarized")
     args = ap.parse_args(argv)
 
-    trace, dumps = export.merge_files(args.dumps, out_path=args.merge,
+    # numerics trip artifacts ride the same dump dir as trace dumps;
+    # partition them out by their fixed filename shape
+    # (numerics_<pid>_<n>.json, see numerics.dump_numerics) so the
+    # merge only sees real trace dumps — a multi-MB trace is never
+    # json-parsed twice just to read a 'kind' key
+    trips = []
+    dump_paths = []
+    for p in args.dumps:
+        if os.path.basename(p).startswith("numerics_"):
+            trips.append(p)
+        else:
+            dump_paths.append(p)
+    if not dump_paths and trips:
+        # trip-artifacts-only invocation: summarize and exit
+        _print_trips(trips)
+        return 0
+
+    trace, dumps = export.merge_files(dump_paths, out_path=args.merge,
                                       xplane=args.xplane)
     rows = export.phase_rows(dumps)
     if args.prefix:
@@ -69,10 +125,16 @@ def main(argv=None):
     # also spares the full extra span walk on large rings
     krows = export.kernel_rows(dumps, trace) \
         if (args.kernels or not args.json) else []
+    nrows = export.numerics_rows(dumps) if args.numerics else []
     if args.json:
-        print(json.dumps(
-            {"phases": rows, "kernels": krows} if args.kernels
-            else rows, indent=2))
+        if args.numerics:
+            print(json.dumps({"phases": rows, "kernels": krows,
+                              "numerics": nrows}, indent=2))
+        elif args.kernels:
+            print(json.dumps({"phases": rows, "kernels": krows},
+                             indent=2))
+        else:
+            print(json.dumps(rows, indent=2))
     else:
         total_spans = sum(len(d.get("spans", [])) for d in dumps)
         print("%d process dump(s), %d spans, %d trace events%s" % (
@@ -92,6 +154,12 @@ def main(argv=None):
             print("\nper-kernel rollup (pallas launch sites + xplane "
                   "device ops):")
             print(export.format_kernel_table(krows))
+        if args.numerics:
+            print("\nnumerics rollup (grad-norm trend / nonfinite "
+                  "sightings per process):")
+            print(export.format_numerics_table(nrows))
+    if trips:
+        _print_trips(trips)
     if not rows:
         # a written --merge artifact is a success even when the table
         # filter matched nothing (e.g. --prefix step. on pserver-only
