@@ -108,17 +108,17 @@ extern "C" pd_predictor_t pd_create_predictor(const char* model_dir,
   return out;
 }
 
-extern "C" int pd_predictor_run(pd_predictor_t pred_, const char** names,
-                                const float** data,
-                                const int64_t* const* shapes,
-                                const int* ndims, int n_inputs,
-                                float** out_data, int64_t (*out_shapes)[8],
-                                int* out_ndims, int* n_outputs_inout) {
-  if (pred_ == nullptr) {
-    g_err = "null predictor";
-    return -1;
-  }
-  PyObject* pred = static_cast<PyObject*>(pred_);
+// Shared marshalling: feed float32 buffers into target.run(feed) and
+// copy the outputs back out.  ``target`` is anything predictor-shaped
+// — a PaddlePredictor or the serving tier's in-process server handle
+// (serving.create_c_server), whose run() routes through the
+// continuous batcher.
+static int run_on_target(PyObject* pred, const char** names,
+                         const float** data,
+                         const int64_t* const* shapes,
+                         const int* ndims, int n_inputs,
+                         float** out_data, int64_t (*out_shapes)[8],
+                         int* out_ndims, int* n_outputs_inout) {
   PyGILState_STATE gil = PyGILState_Ensure();
   int rc = -1;
   PyObject* feed = PyDict_New();
@@ -214,10 +214,83 @@ extern "C" int pd_predictor_run(pd_predictor_t pred_, const char** names,
   return rc;
 }
 
+extern "C" int pd_predictor_run(pd_predictor_t pred_, const char** names,
+                                const float** data,
+                                const int64_t* const* shapes,
+                                const int* ndims, int n_inputs,
+                                float** out_data, int64_t (*out_shapes)[8],
+                                int* out_ndims, int* n_outputs_inout) {
+  if (pred_ == nullptr) {
+    g_err = "null predictor";
+    return -1;
+  }
+  return run_on_target(static_cast<PyObject*>(pred_), names, data, shapes,
+                       ndims, n_inputs, out_data, out_shapes, out_ndims,
+                       n_outputs_inout);
+}
+
 extern "C" void pd_predictor_destroy(pd_predictor_t pred) {
   if (pred == nullptr) return;
   PyGILState_STATE gil = PyGILState_Ensure();
   Py_DECREF(static_cast<PyObject*>(pred));
+  PyGILState_Release(gil);
+}
+
+// ---------------------------------------------------------------------
+// Serving-tier entry points (ISSUE 9 parity rider): the minimal predict
+// path the reference paddle_inference_api.h played for C servers, but
+// routed through paddle_tpu.serving's in-process API — requests from a
+// multithreaded C program join the SAME continuous batcher as every
+// other client of the process.
+
+extern "C" pd_server_t pd_create_server(const char* model_dir,
+                                        int use_accelerator) {
+  if (g_inference == nullptr) {
+    g_err = "pd_init not called (or failed)";
+    return nullptr;
+  }
+  PyGILState_STATE gil = PyGILState_Ensure();
+  pd_server_t out = nullptr;
+  PyObject* serving = PyImport_ImportModule("paddle_tpu.serving");
+  PyObject* handle =
+      serving ? PyObject_CallMethod(serving, "create_c_server", "si",
+                                    model_dir, use_accelerator)
+              : nullptr;
+  Py_XDECREF(serving);
+  if (handle == nullptr) {
+    set_err_from_python();
+  } else {
+    out = static_cast<pd_server_t>(handle);  // owned reference
+  }
+  PyGILState_Release(gil);
+  return out;
+}
+
+extern "C" int pd_server_run(pd_server_t server_, const char** names,
+                             const float** data,
+                             const int64_t* const* shapes,
+                             const int* ndims, int n_inputs,
+                             float** out_data, int64_t (*out_shapes)[8],
+                             int* out_ndims, int* n_outputs_inout) {
+  if (server_ == nullptr) {
+    g_err = "null server";
+    return -1;
+  }
+  return run_on_target(static_cast<PyObject*>(server_), names, data,
+                       shapes, ndims, n_inputs, out_data, out_shapes,
+                       out_ndims, n_outputs_inout);
+}
+
+extern "C" void pd_server_destroy(pd_server_t server_) {
+  if (server_ == nullptr) return;
+  PyGILState_STATE gil = PyGILState_Ensure();
+  PyObject* server = static_cast<PyObject*>(server_);
+  PyObject* r = PyObject_CallMethod(server, "close", nullptr);
+  if (r == nullptr) {
+    PyErr_Clear();  // a failed shutdown must not leak an exception
+  }
+  Py_XDECREF(r);
+  Py_DECREF(server);
   PyGILState_Release(gil);
 }
 

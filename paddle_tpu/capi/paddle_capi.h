@@ -54,6 +54,32 @@ int pd_predictor_run(pd_predictor_t pred,
                      int* n_outputs_inout);
 
 void pd_predictor_destroy(pd_predictor_t pred);
+
+/* --- serving tier (paddle_tpu/serving) ---------------------------------
+ * The continuous-batching multi-tenant server behind a minimal C
+ * predict entry: pd_server_run has pd_predictor_run's exact contract,
+ * but requests route through the in-process InferenceServer — calls
+ * from concurrent C threads coalesce into shape-bucketed batches on
+ * the pre-compiled AOT executables instead of serializing on one
+ * predictor. */
+typedef void* pd_server_t;
+
+pd_server_t pd_create_server(const char* model_dir, int use_accelerator);
+
+int pd_server_run(pd_server_t server,
+                  const char** names,
+                  const float** data,
+                  const int64_t* const* shapes,
+                  const int* ndims,
+                  int n_inputs,
+                  float** out_data,
+                  int64_t (*out_shapes)[8],
+                  int* out_ndims,
+                  int* n_outputs_inout);
+
+/* Shuts the server down (in-flight requests drain first). */
+void pd_server_destroy(pd_server_t server);
+
 void pd_free(void* buf);
 const char* pd_last_error(void);
 

@@ -201,6 +201,27 @@ define_flag("telemetry_dump_dir", "",
             "(flight_<pid>_<n>.json) land here instead of the system "
             "temp dir, and injected faults leave one dump per fault "
             "point (tools/fault_matrix.py asserts it)")
+define_flag("serve_max_batch", 16,
+            "serving tier (paddle_tpu/serving): cap of the power-of-2 "
+            "shape-bucket ladder (1, 2, 4, ... serve_max_batch).  The "
+            "continuous batcher assembles at most this many rows per "
+            "dispatch; each bucket is backed by its own pre-compiled "
+            "AOT executable (compiled at model load for the warm set, "
+            "in the background on a bucket miss)")
+define_flag("serve_max_wait_us", 2000,
+            "serving tier: continuous-batching deadline, microseconds, "
+            "anchored at the FIRST queued request's arrival.  The "
+            "scheduler launches a batch the moment it is full OR this "
+            "deadline expires — it never waits for a full batch, and a "
+            "request that arrived while the device was busy ships on "
+            "the very next dispatch (its deadline already passed).  "
+            "0 = never coalesce-wait: launch whatever is queued")
+define_flag("serve_warm_buckets", "",
+            "serving tier: comma-separated bucket sizes to pre-compile "
+            "at model load (e.g. '1,8').  Empty (default) warms the "
+            "whole ladder up to serve_max_batch.  A cold bucket hit at "
+            "runtime falls to the nearest warm bucket while a "
+            "background thread compiles the missed one")
 define_flag("auto_layout", False,
             "single-device accelerator path: AOT-compile with XLA-chosen "
             "(AUTO) parameter layouts and keep persistable buffers in "

@@ -51,7 +51,13 @@ _M_RX = _obs_metrics.counter(
 
 MAGIC = b"FW1\n"
 METHODS = {"SendVariable": 1, "GetVariable": 2,
-           "SendVariables": 3, "GetVariables": 4}
+           "SendVariables": 3, "GetVariables": 4,
+           # serving tier (paddle_tpu/serving/wire.py): inference
+           # requests ride the same framing — magic, u8 method,
+           # u64 len | payload, reply u64 len | payload — so a native
+           # FastServer/FastConnPool peer interoperates with the
+           # Python predict endpoint byte-for-byte
+           "Predict": 5}
 
 _lib = None
 _lib_tried = False

@@ -28,10 +28,31 @@ import threading
 
 import numpy as np
 
-__all__ = ["save_aot", "AotExecutable", "load_aot"]
+from paddle_tpu.observability import metrics as _obs_metrics
+
+__all__ = ["save_aot", "AotExecutable", "load_aot", "build_aot"]
 
 AOT_BIN = "__aot__.pkl"
 AOT_META = "__aot__.json"
+
+# ISSUE 9 satellite: a fleet quietly re-jitting because its AOT
+# artifacts stopped loading is invisible when the only signal is a
+# warning in some container's stderr.  Every load fallback increments
+# the always-on counter and leaves a bounded reason record the serving
+# bench surfaces in SERVE_BENCH.json.
+_M_FALLBACK = _obs_metrics.counter(
+    "aot_load_fallback_total",
+    "load_aot fell back to the re-jit path (platform mismatch or "
+    "deserialize failure); reasons in inference.aot.FALLBACKS")
+FALLBACKS = []          # newest-last [{dir, reason, detail}], bounded
+_FALLBACK_KEEP = 64
+
+
+def _note_fallback(dirname, reason, detail=""):
+    _M_FALLBACK.inc()
+    FALLBACKS.append({"dir": str(dirname), "reason": reason,
+                      "detail": str(detail)[:500]})
+    del FALLBACKS[:-_FALLBACK_KEEP]
 
 
 def _example_feed(specs):
@@ -39,14 +60,14 @@ def _example_feed(specs):
             for name, (shape, dtype) in specs.items()}
 
 
-def save_aot(dirname, inference_program, feed_specs, fetch_names, scope,
-             place, mode="test"):
+def _compile(inference_program, feed_specs, fetch_names, scope, place,
+             mode="test"):
     """Compile block 0 of ``inference_program`` for ``feed_specs``
-    ({name: (shape, dtype)}) and write the serialized executable into
-    ``dirname``.  Parameters come from ``scope`` (their values don't
-    matter for compilation — shapes/dtypes do)."""
+    ({name: (shape, dtype)}); returns (compiled, meta).  Shared by
+    save_aot (which serializes the binary) and build_aot (the serving
+    tier's in-memory bucket compiles).  Parameters come from ``scope``
+    (their values don't matter for compilation — shapes/dtypes do)."""
     import jax
-    from jax.experimental import serialize_executable
 
     from paddle_tpu.core.executor_impl import (ExecutorCore, _put,
                                                _segment)
@@ -76,9 +97,6 @@ def save_aot(dirname, inference_program, feed_specs, fetch_names, scope,
                          else val, dev))
     flat += [np.uint32(0), np.uint32(0)]  # seed/counter slots
     compiled = entry.jit_fn.lower(*flat).compile()
-    payload = serialize_executable.serialize(compiled)
-    with open(os.path.join(dirname, AOT_BIN), "wb") as f:
-        pickle.dump(payload, f)
     meta = {
         "specs": {k: [list(v[0]), np.dtype(v[1]).name]
                   for k, v in feed_specs.items()},
@@ -88,6 +106,32 @@ def save_aot(dirname, inference_program, feed_specs, fetch_names, scope,
         "platform": dev.platform,
         "jax": jax.__version__,
     }
+    return compiled, meta
+
+
+def build_aot(inference_program, feed_specs, fetch_names, scope, place,
+              mode="test"):
+    """In-memory AOT compile: the same executable save_aot would
+    serialize, returned directly as an AotExecutable.  The serving
+    tier's shape-bucket compiles go through here — one bucket spec, one
+    finished executable, no artifact on disk."""
+    compiled, meta = _compile(inference_program, dict(feed_specs),
+                              list(fetch_names), scope, place, mode)
+    return AotExecutable(compiled, meta, scope, place)
+
+
+def save_aot(dirname, inference_program, feed_specs, fetch_names, scope,
+             place, mode="test"):
+    """Compile block 0 of ``inference_program`` for ``feed_specs``
+    ({name: (shape, dtype)}) and write the serialized executable into
+    ``dirname``."""
+    from jax.experimental import serialize_executable
+
+    compiled, meta = _compile(inference_program, dict(feed_specs),
+                              list(fetch_names), scope, place, mode)
+    payload = serialize_executable.serialize(compiled)
+    with open(os.path.join(dirname, AOT_BIN), "wb") as f:
+        pickle.dump(payload, f)
     with open(os.path.join(dirname, AOT_META), "w") as f:
         json.dump(meta, f, indent=1)
     return meta
@@ -162,6 +206,18 @@ class AotExecutable:
                                                       jax.Array)
                                     else feed[name], self._dev)
                   for name, i in self._feed_slots.items()}
+        if not self._persist_slots:
+            # pure test-mode executable (no written persistables after
+            # the PR 5 full fusion): nothing is donated and nothing is
+            # written back, so the staged params are read-only shared
+            # state — cloned predictors overlap their dispatches
+            # instead of serializing on the lock
+            args = list(self._args)
+            for i, v in staged.items():
+                args[i] = v
+            fetches, _ = self.compiled(*args, np.uint32(0),
+                                       np.uint32(0))
+            return list(fetches)
         with self._run_lock:
             args = list(self._args)
             for i, v in staged.items():
@@ -183,6 +239,10 @@ def load_aot(dirname, scope, place):
     with open(meta_path) as f:
         meta = json.load(f)
     if meta.get("platform") != place.jax_device().platform:
+        _note_fallback(dirname, "platform_mismatch",
+                       "artifact %r vs runtime %r" %
+                       (meta.get("platform"),
+                        place.jax_device().platform))
         return None
     try:
         from jax.experimental import serialize_executable
@@ -203,8 +263,13 @@ def load_aot(dirname, scope, place):
             *payload, **kwargs)
         return AotExecutable(compiled, meta, scope, place)
     except Exception as e:
-        # version/backend drift — the re-jit path still works, but say so
+        # version/backend drift — the re-jit path still works, but say
+        # so AND count it: a warning alone left a fleet quietly on the
+        # slow path (ISSUE 9 satellite; SERVE_BENCH.json surfaces the
+        # counter)
         import warnings
+        _note_fallback(dirname, "load_error",
+                       "%s: %s" % (type(e).__name__, e))
         warnings.warn("AOT executable in %s could not be loaded (%s: %s); "
                       "falling back to re-jit" %
                       (dirname, type(e).__name__, e))

@@ -1,0 +1,180 @@
+"""Multi-tenant inference server on the AOT/prepared path.
+
+One InferenceServer multiplexes any number of loaded models (tenants)
+in one process.  Each tenant owns a ModelEngine (parameter scope
+device-resident via the AotExecutable staging — the PR 2 Scope/prepared
+machinery), a request queue, and a continuous-batching dispatcher
+thread (batcher.py).  The request plane:
+
+- in-process: ``submit(model, feed) -> Future`` / ``predict`` (the
+  blocking convenience) — the API the C entry points (capi) route
+  through;
+- socket: ``start_endpoint(port)`` serves the fastwire-framed Predict
+  method (wire.py) for out-of-process clients.
+
+Hot swap: ``swap(model, new_dir)`` builds the new engine IN SHADOW
+(fresh scope, params loaded, warm buckets compiled) and then atomically
+flips the tenant's route pointer.  In-flight and queued requests are
+never dropped or torn: a batch snapshots the route once, so every
+request is served whole by exactly one engine version.
+"""
+from __future__ import annotations
+
+import threading
+from concurrent.futures import Future
+
+import numpy as np
+
+from paddle_tpu.core.flags import FLAGS
+from paddle_tpu.observability import metrics as _metrics
+
+from . import batcher as _batcher
+from .batcher import Dispatcher, Request, RequestQueue
+from .engine import ModelEngine
+
+__all__ = ["InferenceServer"]
+
+_M_MODELS = _metrics.gauge("serve_models", "tenants currently loaded")
+_M_SWAPS = _metrics.counter("serve_swaps_total",
+                            "hot model swaps completed")
+
+
+class _Tenant:
+    __slots__ = ("name", "engine", "queue", "dispatcher")
+
+    def __init__(self, name, engine, max_wait_us):
+        self.name = name
+        self.engine = engine     # the atomically-swappable route
+        self.queue = RequestQueue()
+        self.dispatcher = Dispatcher(self.queue, lambda: self.engine,
+                                     max_wait_us=max_wait_us,
+                                     label=name)
+
+
+class InferenceServer:
+    """``load`` tenants, ``submit``/``predict`` requests, ``swap``
+    checkpoints, ``start_endpoint`` for socket clients."""
+
+    def __init__(self, place=None, max_batch=None, max_wait_us=None):
+        self.place = place
+        self.max_batch = max_batch
+        self.max_wait_us = max_wait_us
+        self._tenants = {}
+        self._lock = threading.Lock()
+        self._endpoint = None
+        self._closed = False
+
+    # -- tenants -------------------------------------------------------
+    def load(self, name, model_dir, warm=None):
+        """Load ``model_dir`` as tenant ``name`` (its bucket ladder is
+        compiled per ``warm`` / FLAGS_serve_warm_buckets before the
+        first request is accepted)."""
+        self._check_loadable(name)   # reject BEFORE the warm compiles
+        engine = ModelEngine(model_dir, place=self.place,
+                             max_batch=self.max_batch, warm=warm,
+                             name=name)
+        with self._lock:
+            self._check_loadable(name, locked=True)
+            self._tenants[name] = _Tenant(name, engine,
+                                          self.max_wait_us)
+            _M_MODELS.set(len(self._tenants))
+        return engine
+
+    def _check_loadable(self, name, locked=False):
+        """Fail a doomed load cheaply — building an engine compiles
+        the whole warm ladder, seconds of work.  Re-checked under the
+        lock at insert (a concurrent load of the same name can still
+        win the race; the loser raises after its build)."""
+        if not locked:
+            with self._lock:
+                return self._check_loadable(name, locked=True)
+        if self._closed:
+            raise RuntimeError("server closed")
+        if name in self._tenants:
+            raise ValueError("tenant %r already loaded (use swap)"
+                             % name)
+
+    def swap(self, name, model_dir, warm=None):
+        """Hot-swap tenant ``name`` to the model in ``model_dir`` (a
+        fresh training checkpoint export).  The new engine is built and
+        warmed in shadow; the route flip is one reference assignment —
+        zero dropped, zero torn requests."""
+        with self._lock:
+            if self._closed:
+                raise RuntimeError("server closed")
+        tenant = self._tenant(name)
+        shadow = ModelEngine(model_dir, place=self.place,
+                             max_batch=self.max_batch, warm=warm,
+                             name=name)
+        tenant.engine = shadow    # the atomic flip
+        _M_SWAPS.inc()
+        return shadow
+
+    def unload(self, name):
+        with self._lock:
+            tenant = self._tenants.pop(name, None)
+            _M_MODELS.set(len(self._tenants))
+        if tenant is not None:
+            tenant.dispatcher.stop()
+
+    def _tenant(self, name):
+        with self._lock:
+            tenant = self._tenants.get(name)
+        if tenant is None:
+            raise KeyError("unknown model %r (loaded: %r)"
+                           % (name, sorted(self._tenants)))
+        return tenant
+
+    def models(self):
+        with self._lock:
+            return sorted(self._tenants)
+
+    def engine(self, name):
+        return self._tenant(name).engine
+
+    # -- request plane -------------------------------------------------
+    def submit(self, name, feed):
+        """Enqueue one request; returns a Future resolving to
+        {fetch_name: ndarray} with the request's own batch dim."""
+        tenant = self._tenant(name)
+        feed = {k: np.asarray(v) for k, v in feed.items()}
+        rows = tenant.engine.validate(feed)
+        fut = Future()
+        if _batcher._METRICS_ON:
+            _batcher._M_REQS.inc()
+        tenant.queue.put(Request(feed, rows, fut))
+        return fut
+
+    def predict(self, name, feed, timeout=None):
+        return self.submit(name, feed).result(timeout)
+
+    # -- socket endpoint -----------------------------------------------
+    def start_endpoint(self, port=0, host="127.0.0.1"):
+        """Serve the fastwire-framed Predict method; returns the bound
+        port (``port=0`` picks a free one)."""
+        from .wire import PredictEndpoint
+
+        if self._endpoint is not None:
+            raise RuntimeError("endpoint already running on port %d"
+                               % self._endpoint.port)
+        self._endpoint = PredictEndpoint(self, host=host, port=port)
+        return self._endpoint.port
+
+    # -- lifecycle -----------------------------------------------------
+    def close(self):
+        with self._lock:
+            self._closed = True
+            tenants = list(self._tenants.values())
+            self._tenants.clear()
+            _M_MODELS.set(0)
+        if self._endpoint is not None:
+            self._endpoint.stop()
+            self._endpoint = None
+        for t in tenants:
+            t.dispatcher.stop()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

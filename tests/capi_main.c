@@ -1,15 +1,20 @@
 /* C serving program for the capi test: loads a saved model dir, runs
  * one batch, prints the first output tensor as CSV on stdout.
- * Usage: capi_main <repo_path> <model_dir> <feed_name> <n> <d>
+ * Usage: capi_main <repo_path> <model_dir> <feed_name> <n> <d> [mode]
+ * mode "predictor" (default) uses pd_create_predictor/pd_predictor_run;
+ * mode "server" routes through the continuous-batching serving tier
+ * (pd_create_server/pd_server_run) — same output contract.
  * Feeds an [n, d] float32 ramp (i*0.01). */
 #include <stdio.h>
 #include <stdlib.h>
+#include <string.h>
 
 #include "paddle_capi.h"
 
 int main(int argc, char** argv) {
-  if (argc != 6) {
-    fprintf(stderr, "usage: %s repo model_dir feed n d\n", argv[0]);
+  if (argc != 6 && argc != 7) {
+    fprintf(stderr, "usage: %s repo model_dir feed n d [mode]\n",
+            argv[0]);
     return 2;
   }
   const char* repo = argv[1];
@@ -17,13 +22,20 @@ int main(int argc, char** argv) {
   const char* feed_name = argv[3];
   int n = atoi(argv[4]);
   int d = atoi(argv[5]);
+  int use_server = argc == 7 && strcmp(argv[6], "server") == 0;
 
   if (pd_init(repo) != 0) {
     fprintf(stderr, "pd_init: %s\n", pd_last_error());
     return 3;
   }
-  pd_predictor_t pred = pd_create_predictor(model_dir, 0);
-  if (pred == NULL) {
+  pd_predictor_t pred = NULL;
+  pd_server_t server = NULL;
+  if (use_server) {
+    server = pd_create_server(model_dir, 0);
+  } else {
+    pred = pd_create_predictor(model_dir, 0);
+  }
+  if (pred == NULL && server == NULL) {
     fprintf(stderr, "create: %s\n", pd_last_error());
     return 4;
   }
@@ -46,8 +58,12 @@ int main(int argc, char** argv) {
   int64_t out_shapes[4][8];
   int out_ndims[4];
   int n_out = 4;
-  int rc = pd_predictor_run(pred, names, datas, shapes, ndims, 1,
-                            out_data, out_shapes, out_ndims, &n_out);
+  int rc = use_server
+               ? pd_server_run(server, names, datas, shapes, ndims, 1,
+                               out_data, out_shapes, out_ndims, &n_out)
+               : pd_predictor_run(pred, names, datas, shapes, ndims, 1,
+                                  out_data, out_shapes, out_ndims,
+                                  &n_out);
   if (rc != 0) {
     fprintf(stderr, "run: %s\n", pd_last_error());
     return 5;
@@ -57,8 +73,11 @@ int main(int argc, char** argv) {
   int64_t shp2[4][8];
   int nd2[4];
   int n2 = 4;
-  rc = pd_predictor_run(pred, names, datas, shapes, ndims, 1, out2, shp2,
-                        nd2, &n2);
+  rc = use_server
+           ? pd_server_run(server, names, datas, shapes, ndims, 1, out2,
+                           shp2, nd2, &n2)
+           : pd_predictor_run(pred, names, datas, shapes, ndims, 1, out2,
+                              shp2, nd2, &n2);
   if (rc != 0) {
     fprintf(stderr, "run2: %s\n", pd_last_error());
     return 6;
@@ -75,6 +94,10 @@ int main(int argc, char** argv) {
   }
   for (int j = 0; j < n_out; j++) pd_free(out_data[j]);
   for (int j = 0; j < n2; j++) pd_free(out2[j]);
-  pd_predictor_destroy(pred);
+  if (use_server) {
+    pd_server_destroy(server);
+  } else {
+    pd_predictor_destroy(pred);
+  }
   return 0;
 }

@@ -49,7 +49,13 @@ def capi_binary(tmp_path_factory):
     return exe_path
 
 
-def test_c_program_serves_model(tmp_path, capi_binary):
+@pytest.mark.parametrize("mode", ["predictor", "server"])
+def test_c_program_serves_model(tmp_path, capi_binary, mode):
+    """mode 'predictor': the classic pd_create_predictor path.  mode
+    'server' (ISSUE 9 rider): the same C contract routed through
+    pd_create_server — the continuous-batching serving tier's
+    in-process API — closing the reference paddle_inference_api.h
+    role gap."""
     n, d = 4, 5
     model_dir = str(tmp_path / "model")
     ref = _save_model(model_dir, n, d)
@@ -61,7 +67,7 @@ def test_c_program_serves_model(tmp_path, capi_binary):
     env["JAX_PLATFORMS"] = "cpu"
     env.pop("PALLAS_AXON_POOL_IPS", None)
     proc = subprocess.run(
-        [capi_binary, REPO, model_dir, "x", str(n), str(d)],
+        [capi_binary, REPO, model_dir, "x", str(n), str(d), mode],
         capture_output=True, text=True, timeout=240, env=env, cwd=REPO)
     assert proc.returncode == 0, (proc.stdout, proc.stderr)
     got = np.asarray([float(v) for v in

@@ -152,6 +152,105 @@ def test_aot_concurrent_cloned_predictors(tmp_path):
     assert not errors, errors[0]
 
 
+def test_aot_lock_skipped_without_persists(tmp_path):
+    """ISSUE 9 satellite: a pure test-mode executable (no written
+    persistables, nothing donated) must NOT serialize dispatches on
+    _run_lock — cloned predictors overlap.  Proof: run() completes
+    while another thread HOLDS the lock."""
+    import threading
+
+    from paddle_tpu import inference as inf
+
+    d = str(tmp_path / "m")
+    xs, ref = _build_and_save(d)           # fc model: no BN stats
+    pred = inf.create_paddle_predictor(inf.NativeConfig(model_dir=d))
+    assert pred.aot is not None
+    assert pred.aot._persist_slots == []
+    done = threading.Event()
+    out = {}
+
+    def serve():
+        out["v"] = pred.run({"x": xs})
+        done.set()
+
+    with pred.aot._run_lock:               # a "stuck" concurrent run
+        t = threading.Thread(target=serve, daemon=True)
+        t.start()
+        assert done.wait(60), \
+            "persist-free run() blocked on _run_lock"
+    t.join(30)
+    np.testing.assert_allclose(out["v"][0].data, ref, atol=1e-6)
+
+
+def test_aot_lock_still_serializes_persist_writeback(tmp_path):
+    """Counterpart: an executable WITH donated persistables (BN running
+    stats) must keep taking the lock — two overlapped calls would hand
+    the same donated buffer to two executions."""
+    import threading
+
+    from paddle_tpu import inference as inf
+
+    d = str(tmp_path / "m")
+    xs, _ = _build_and_save_bn(d)
+    pred = inf.create_paddle_predictor(inf.NativeConfig(model_dir=d))
+    assert pred.aot is not None
+    assert pred.aot._persist_slots, "BN model lost its persist slots"
+    done = threading.Event()
+
+    def serve():
+        pred.run({"x": xs})
+        done.set()
+
+    with pred.aot._run_lock:
+        t = threading.Thread(target=serve, daemon=True)
+        t.start()
+        assert not done.wait(0.5), \
+            "run() with donated persistables skipped _run_lock"
+    assert done.wait(60)
+    t.join(30)
+
+
+def test_aot_load_fallback_metered(tmp_path):
+    """ISSUE 9 satellite: load_aot falling back to re-jit must feed
+    aot_load_fallback_total and record the reason — a fleet quietly on
+    the slow path is visible in SERVE_BENCH.json, not only in a
+    warning."""
+    import json as _json
+    import warnings
+
+    from paddle_tpu.core.scope import Scope as _Scope
+    from paddle_tpu.inference import aot as aot_mod
+    from paddle_tpu.observability import metrics as _metrics
+
+    ctr = _metrics.counter("aot_load_fallback_total")
+    d = str(tmp_path / "m")
+    _build_and_save(d)
+    # corrupt artifact -> load_error fallback
+    with open(os.path.join(d, "__aot__.pkl"), "wb") as f:
+        f.write(b"\x80\x04 garbage")
+    before = ctr.value
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        got = aot_mod.load_aot(d, _Scope(), __import__(
+            "paddle_tpu.fluid", fromlist=["CPUPlace"]).CPUPlace())
+    assert got is None
+    assert ctr.value == before + 1
+    assert aot_mod.FALLBACKS[-1]["reason"] == "load_error"
+    assert aot_mod.FALLBACKS[-1]["dir"] == d
+    # platform mismatch -> its own reason, counted too
+    meta_path = os.path.join(d, "__aot__.json")
+    with open(meta_path) as f:
+        meta = _json.load(f)
+    meta["platform"] = "not-a-platform"
+    with open(meta_path, "w") as f:
+        _json.dump(meta, f)
+    got = aot_mod.load_aot(d, _Scope(), __import__(
+        "paddle_tpu.fluid", fromlist=["CPUPlace"]).CPUPlace())
+    assert got is None
+    assert ctr.value == before + 2
+    assert aot_mod.FALLBACKS[-1]["reason"] == "platform_mismatch"
+
+
 def test_aot_skipped_under_analysis_passes(tmp_path):
     """AnalysisConfig's BN-fold mutates the parameter scope; the AOT
     artifact (compiled from the unfolded program) must not be served

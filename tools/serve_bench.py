@@ -1,0 +1,378 @@
+#!/usr/bin/env python
+"""Serving-tier load harness (ISSUE 9): open-loop Poisson arrivals
+against the continuous-batching InferenceServer, with a single-request-
+at-a-time floor to quantify the batching win, and a hot model swap
+under load asserting zero dropped requests.
+
+Phases (all on the CPU tier unless JAX_PLATFORMS says otherwise):
+  floor      closed-loop serial predict() through a max_batch=1,
+             max_wait=0 server — what one request at a time sustains.
+             This is the Clipper no-batching baseline.
+  saturated  bounded-window pipelined submits (the capacity probe):
+             the max QPS the batcher reaches when arrivals never gate.
+  poisson    open-loop Poisson arrivals at ``--rate-x`` times the floor
+             QPS (open-loop = every arrival is an independent simulated
+             client; completions are recorded via future callbacks so a
+             slow server cannot gate the arrival process).  Halfway
+             through, ``swap()`` flips the tenant to a second model
+             version built from different parameters — every request
+             must complete and classify bit-clean as served by exactly
+             one version (zero dropped, zero torn).
+
+Output: ONE JSON line (``--out FILE`` also writes it to a file —
+SERVE_BENCH.json in the repo ledger), including the batch-occupancy
+histogram and the queue-wait/assemble/dispatch phase breakdown from
+the always-on metrics registry, plus the aot_load_fallback_total
+counter (a fleet quietly re-jitting is visible here, not only in
+stderr).  ``--quick`` shrinks everything to a seconds-long tier-1
+smoke (wired like pserver_bench --quick).  Set FLAGS_telemetry=1 and
+FLAGS_telemetry_dump_dir to get the serve.batch/assemble/dispatch
+spans into tools/trace_report.py.
+"""
+import argparse
+import json
+import os
+import random
+import sys
+import threading
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+import numpy as np
+
+# model dims (env-overridable like pserver_bench): heavy enough that
+# the single-request floor pays real per-dispatch compute — the
+# batching win being measured is amortization of exactly that
+D_IN = int(os.environ.get("SVB_D_IN", "128"))
+HIDDEN = int(os.environ.get("SVB_HIDDEN", "512"))
+D_OUT = int(os.environ.get("SVB_D_OUT", "32"))
+
+
+def _build_and_save(dirname, seed, max_batch):
+    """Save one model version; ``seed`` differentiates the parameter
+    draw so the swap phase can classify which engine served each
+    request (constant inits would be degenerate: softmax over equal
+    logits answers uniform for every version)."""
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.core.scope import Scope
+
+    main, startup = fluid.Program(), fluid.Program()
+    scope = Scope()
+    init = fluid.initializer.UniformInitializer
+    with fluid.scope_guard(scope):
+        with fluid.program_guard(main, startup):
+            with fluid.unique_name.guard():
+                x = fluid.layers.data(name="x", shape=[D_IN],
+                                      dtype="float32")
+                h = fluid.layers.fc(
+                    x, size=HIDDEN, act="tanh",
+                    param_attr=fluid.ParamAttr(
+                        initializer=init(-0.08, 0.08, seed=seed)))
+                h = fluid.layers.fc(
+                    h, size=HIDDEN, act="tanh",
+                    param_attr=fluid.ParamAttr(
+                        initializer=init(-0.08, 0.08, seed=seed + 1)))
+                out = fluid.layers.fc(
+                    h, size=D_OUT, act="softmax",
+                    param_attr=fluid.ParamAttr(
+                        initializer=init(-0.08, 0.08, seed=seed + 2)))
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        fluid.io.save_inference_model(
+            dirname, ["x"], [out], exe, main_program=main,
+            aot_feed_specs={"x": ((1, D_IN), "float32")})
+
+
+def _pctl(vals, p):
+    from paddle_tpu.observability.metrics import nearest_rank
+
+    return nearest_rank(sorted(vals), p)
+
+
+def _lat_ms(vals):
+    return {"p50_ms": round(_pctl(vals, 50) * 1e3, 3),
+            "p90_ms": round(_pctl(vals, 90) * 1e3, 3),
+            "p99_ms": round(_pctl(vals, 99) * 1e3, 3)}
+
+
+def _measure_floor(model_dir, x, seconds):
+    """Single-request-at-a-time QPS: serial closed loop, no batching
+    (max_batch=1), no coalesce wait (max_wait=0)."""
+    from paddle_tpu.serving import InferenceServer
+
+    lats = []
+    with InferenceServer(max_batch=1, max_wait_us=0) as srv:
+        srv.load("m", model_dir)
+        for _ in range(10):
+            srv.predict("m", {"x": x})
+        t_end = time.perf_counter() + seconds
+        n = 0
+        t0 = time.perf_counter()
+        while time.perf_counter() < t_end:
+            t = time.perf_counter()
+            srv.predict("m", {"x": x})
+            lats.append(time.perf_counter() - t)
+            n += 1
+        wall = time.perf_counter() - t0
+    return dict(qps=round(n / wall, 1), n=n, **_lat_ms(lats))
+
+
+def _measure_saturated(srv, x, seconds, window):
+    """Capacity probe: keep ``window`` requests in flight."""
+    from collections import deque
+
+    done = []
+    lock = threading.Lock()
+
+    def _done_cb(t0):
+        def cb(fut):
+            fut.result()
+            with lock:
+                done.append(time.perf_counter() - t0)
+        return cb
+
+    for _ in range(5):
+        srv.predict("m", {"x": x})
+    inflight = deque()
+    t0 = time.perf_counter()
+    t_end = t0 + seconds
+    n = 0
+    while time.perf_counter() < t_end:
+        while len(inflight) >= window:
+            inflight.popleft().result()
+        t = time.perf_counter()
+        fut = srv.submit("m", {"x": x})
+        fut.add_done_callback(_done_cb(t))
+        inflight.append(fut)
+        n += 1
+    for f in inflight:
+        f.result(60)
+    wall = time.perf_counter() - t0
+    with lock:
+        lats = list(done)
+    return dict(qps=round(n / wall, 1), n=n, window=window,
+                **_lat_ms(lats))
+
+
+def _poisson(srv, x, ref_v1, seconds, rate, seed=7, swap_to=None,
+             swap_at=0.5):
+    """Open-loop arrivals at ``rate``/s; with ``swap_to`` set, swap the
+    tenant to that model dir at ``swap_at`` x seconds.  Returns stats +
+    the zero-dropped/zero-torn classification."""
+    rng = random.Random(seed)
+    results = []     # (latency_s, output ndarray) via callbacks
+    lock = threading.Lock()
+    errors = []
+
+    def _cb(t0):
+        def cb(fut):
+            t = time.perf_counter() - t0
+            try:
+                out = next(iter(fut.result().values()))
+            except Exception as e:       # a dropped request
+                with lock:
+                    errors.append(repr(e))
+                return
+            with lock:
+                results.append((t, np.asarray(out)))
+        return cb
+
+    swap_state = {}
+
+    def _swapper():
+        time.sleep(seconds * swap_at)
+        t0 = time.perf_counter()
+        srv.swap("m", swap_to)
+        swap_state["swap_ms"] = round(
+            (time.perf_counter() - t0) * 1e3, 2)
+
+    swapper = None
+    if swap_to is not None:
+        swapper = threading.Thread(target=_swapper, daemon=True)
+        swapper.start()
+    n = 0
+    t0 = time.perf_counter()
+    next_t = t0
+    t_end = t0 + seconds
+    while next_t < t_end:
+        # sleep, never spin: a spinning arrival thread starves the
+        # dispatcher of the GIL and manufactures an overload that is
+        # the harness's, not the server's.  Oversleep just lowers the
+        # realized rate — reported from the actual submission count.
+        gap = next_t - time.perf_counter()
+        if gap > 0:
+            time.sleep(gap)
+        t = time.perf_counter()
+        fut = srv.submit("m", {"x": x})
+        fut.add_done_callback(_cb(t))
+        n += 1
+        next_t += rng.expovariate(rate)
+    if swapper is not None:
+        swapper.join(timeout=120)
+    # drain: every submitted request must complete
+    deadline = time.perf_counter() + 120
+    while time.perf_counter() < deadline:
+        with lock:
+            if len(results) + len(errors) >= n:
+                break
+        time.sleep(0.01)
+    wall = time.perf_counter() - t0
+    ref_v2 = np.asarray(next(iter(
+        srv.predict("m", {"x": x}).values())))
+    with lock:
+        lats = [r[0] for r in results]
+        v1 = sum(1 for _, o in results
+                 if np.allclose(o, ref_v1, atol=1e-5))
+        v2 = 0 if swap_to is None else sum(
+            1 for _, o in results
+            if np.allclose(o, ref_v2, atol=1e-5))
+        completed = len(results)
+        n_err = len(errors)
+    torn = completed - v1 - v2
+    stats = dict(
+        offered_qps=round(rate, 1), qps=round(completed / wall, 1),
+        n_requests=n, n_simulated_clients=n, completed=completed,
+        duration_s=round(wall, 2), **_lat_ms(lats))
+    if swap_to is None:
+        return stats, dict(zero_dropped=(completed == n and not n_err),
+                           dropped=n - completed, errors=errors[:5])
+    return stats, dict(
+        zero_dropped=(completed == n and n_err == 0),
+        dropped=n - completed, errors=errors[:5],
+        served_v1=v1, served_v2=v2, torn=torn,
+        swap_ms=swap_state.get("swap_ms"))
+
+
+def _wire_sanity(srv, x):
+    """One request over the socket endpoint — the fastwire-framed
+    Predict method answers and matches the in-process result."""
+    from paddle_tpu.serving import PredictClient
+
+    port = srv.start_endpoint()
+    with PredictClient("127.0.0.1", port) as cli:
+        t0 = time.perf_counter()
+        outs = cli.predict("m", {"x": x})
+        lat = time.perf_counter() - t0
+    ref = srv.predict("m", {"x": x})
+    ok = all(np.allclose(outs[k], ref[k], atol=1e-5) for k in outs)
+    return {"ok": bool(ok), "latency_ms": round(lat * 1e3, 3),
+            "port": port}
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--quick", action="store_true",
+                    help="seconds-long tier-1 smoke (CPU)")
+    ap.add_argument("--out", default="",
+                    help="also write the JSON to this file")
+    ap.add_argument("--rate-x", type=float, default=4.0,
+                    help="poisson offered rate as a multiple of the "
+                         "measured floor QPS")
+    ap.add_argument("--seconds", type=float, default=0.0,
+                    help="override per-phase duration")
+    args = ap.parse_args(argv)
+
+    import tempfile
+
+    from paddle_tpu.core.flags import FLAGS, apply_xla_flags
+    from paddle_tpu.inference import aot as aot_mod
+    from paddle_tpu.observability import metrics
+    from paddle_tpu.serving import InferenceServer
+
+    apply_xla_flags()
+    seconds = args.seconds or (1.0 if args.quick else 6.0)
+    max_batch = int(os.environ.get("SVB_MAX_BATCH",
+                                   "8" if args.quick else "16"))
+    max_wait_us = int(os.environ.get("SVB_MAX_WAIT_US", "2000"))
+    tmp = tempfile.mkdtemp(prefix="serve_bench_")
+    d1, d2 = os.path.join(tmp, "v1"), os.path.join(tmp, "v2")
+    t_build = time.perf_counter()
+    _build_and_save(d1, 11, max_batch)
+    _build_and_save(d2, 911, max_batch)
+    build_s = time.perf_counter() - t_build
+    x = np.linspace(-1, 1, D_IN).astype(np.float32).reshape(1, D_IN)
+
+    floor = _measure_floor(d1, x, seconds)
+
+    metrics.zero_all()
+    srv = InferenceServer(max_batch=max_batch, max_wait_us=max_wait_us)
+    t_load = time.perf_counter()
+    srv.load("m", d1)
+    load_s = time.perf_counter() - t_load
+    ref_v1 = np.asarray(next(iter(srv.predict("m", {"x": x}).values())))
+
+    saturated = _measure_saturated(srv, x, seconds,
+                                   window=4 * max_batch)
+    metrics.zero_all()
+    # open-loop offered rate: rate_x x floor, capped under the probed
+    # capacity — an open-loop rate above capacity has no steady state
+    # (the queue and p99 grow without bound for as long as you let it)
+    rate = min(args.rate_x * floor["qps"], 0.65 * saturated["qps"])
+    # headline phase: steady open-loop load, no configuration churn
+    poisson, steady_drop = _poisson(srv, x, ref_v1, 2 * seconds, rate)
+    # swap phase: same load while swap() builds + flips to v2 — the
+    # shadow compile competes for the host, so its latency spike is
+    # reported HERE, not folded into the steady-state headline
+    poisson_swap, swap = _poisson(srv, x, ref_v1, 2 * seconds, rate,
+                                  seed=13, swap_to=d2, swap_at=0.33)
+    swap["steady_phase_dropped"] = steady_drop["dropped"]
+    snap = metrics.snapshot()
+    occupancy = snap["serve_batch_occupancy"]
+    phases = {k: {"p50_ms": snap[k]["p50"], "p99_ms": snap[k]["p99"],
+                  "count": snap[k]["count"]}
+              for k in ("serve_queue_wait_ms", "serve_batch_assemble_ms",
+                        "serve_dispatch_ms")}
+    wire = _wire_sanity(srv, x)
+    srv.close()
+
+    speedup = round(poisson["qps"] / max(floor["qps"], 1e-9), 2)
+    speedup_saturated = round(
+        saturated["qps"] / max(floor["qps"], 1e-9), 2)
+    p99_budget_ms = max(2.0 * floor["p99_ms"], 10.0)
+    out = {
+        "metric": "serve_bench",
+        "quick": bool(args.quick),
+        "platform": os.environ.get("JAX_PLATFORMS", ""),
+        "model": {"d_in": D_IN, "hidden": HIDDEN, "d_out": D_OUT},
+        "max_batch": max_batch,
+        "max_wait_us": max_wait_us,
+        "build_s": round(build_s, 2),
+        "load_warm_s": round(load_s, 2),
+        "floor": floor,
+        "saturated": saturated,
+        "poisson": poisson,
+        "poisson_under_swap": poisson_swap,
+        "speedup_vs_floor": speedup,
+        "speedup_saturated_vs_floor": speedup_saturated,
+        "p99_budget_ms": round(p99_budget_ms, 3),
+        "within_p99_budget": poisson["p99_ms"] <= p99_budget_ms,
+        "batch_occupancy": {"count": occupancy["count"],
+                            "p50": occupancy["p50"],
+                            "buckets": occupancy["buckets"]},
+        "phases": phases,
+        "swap": swap,
+        "wire": wire,
+        "aot_load_fallback_total":
+            metrics.counter("aot_load_fallback_total").value,
+        "aot_load_fallbacks": list(aot_mod.FALLBACKS),
+        "ok": bool(speedup >= 3.0
+                   and poisson["p99_ms"] <= p99_budget_ms
+                   and steady_drop["zero_dropped"]
+                   and swap["zero_dropped"] and swap["torn"] == 0
+                   and wire["ok"]),
+    }
+    line = json.dumps(out)
+    print(line)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(line + "\n")
+    return 0 if out["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
