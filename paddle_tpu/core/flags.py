@@ -222,6 +222,46 @@ define_flag("serve_warm_buckets", "",
             "whole ladder up to serve_max_batch.  A cold bucket hit at "
             "runtime falls to the nearest warm bucket while a "
             "background thread compiles the missed one")
+define_flag("dist_compress", "",
+            "gradient compression codec for the pserver wire "
+            "(distributed/compress.py): '' (raw frames, the default), "
+            "'fp16' (half-precision dense grads, bit-exact on fp16-"
+            "representable values), 'int8' (per-chunk linear "
+            "quantization with a trainer-side error-feedback residual "
+            "so the quantization bias cancels across steps), or "
+            "'topk' (top-k magnitude sparsification with error "
+            "feedback; ratio via FLAGS_dist_topk_ratio).  SelectedRows "
+            "grads additionally ship int8 rows + delta-encoded int32 "
+            "ids under ANY non-empty mode.  Compressed frames are "
+            "wire-format v2: the client negotiates per endpoint "
+            "(WireVersion RPC) and falls back to raw frames against an "
+            "old server — see MIGRATION.md")
+define_flag("dist_topk_ratio", 0.01,
+            "fraction of dense-grad elements kept by the 'topk' codec "
+            "(indices + values of the largest-|g| entries; the rest "
+            "accumulates in the error-feedback residual)")
+define_flag("dist_staleness", 0,
+            "bounded-staleness sync training: a trainer's barrier for "
+            "round r acks once round r-k is applied+durable, so "
+            "trainers run up to k rounds ahead of the slowest peer "
+            "(param gets accept k-stale values).  0 (default) is "
+            "today's fully-synchronous round — bit-exact with the "
+            "k-unaware wire.  With k>0 the client retains k+1 rounds "
+            "of replay cache; a server crash can lose at most the k "
+            "un-acked rounds (bounded loss, like bounded staleness)")
+define_flag("dist_hier_local", 0,
+            "hierarchical gradient aggregation: number of trainers "
+            "per host group (0 disables).  Group leader (lowest "
+            "trainer id in the group) pre-reduces the group's grads "
+            "locally and makes ONE upload + ONE barrier per round, "
+            "cutting pserver ingress and fanin by this factor; "
+            "followers talk to the leader over a loopback fastwire "
+            "channel (distributed/hierarchy.py) and keep reading "
+            "params directly.  Requires PADDLE_TRAINER_ID and "
+            "trainers %% dist_hier_local == 0")
+define_flag("dist_hier_port", 18970,
+            "base TCP port of the host-local aggregation channel; "
+            "group g listens on dist_hier_port + g")
 define_flag("auto_layout", False,
             "single-device accelerator path: AOT-compile with XLA-chosen "
             "(AUTO) parameter layouts and keep persistable buffers in "

@@ -458,7 +458,15 @@ def _is_float(x):
 # Build-time shape inference by abstract evaluation.
 # ---------------------------------------------------------------------------
 
-_FAKE_BATCH = 97  # sentinel for dynamic (-1) dims during eval_shape
+# Sentinels for dynamic (-1) dims during eval_shape.  The inference
+# runs TWICE, once per sentinel, and an output dim maps back to -1 only
+# when it tracks BOTH substitutions — a model whose real dim happens to
+# equal one sentinel (e.g. vocab_size=97) stays static because it holds
+# its value in the other run (ISSUE 10 satellite; previously any output
+# dim equal to 97 was silently declared dynamic).  Both values are
+# prime so either run fails the same divisibility asserts, if any.
+_FAKE_BATCH = 97
+_FAKE_BATCH_ALT = 89
 
 
 def infer_op_outputs(program, block, op, var_specs=None):
@@ -468,7 +476,9 @@ def infer_op_outputs(program, block, op, var_specs=None):
 
     Replaces reference per-op InferShape (operator.cc:606): abstract
     evaluation of the lowering needs no hand-written shape functions.
-    Dynamic dims (-1) are substituted with a sentinel and mapped back.
+    Dynamic dims (-1) are substituted with a sentinel and mapped back;
+    disambiguation against real dims that equal the sentinel is by a
+    second evaluation under a different sentinel (see _FAKE_BATCH).
 
     ``var_specs`` ({name: (shape, np dtype)}) overrides the declared
     VarDesc of an input — the verifier's shape checker threads its own
@@ -482,32 +492,40 @@ def infer_op_outputs(program, block, op, var_specs=None):
     (see core/registry.py).
     """
     info = get_op_info(op.type)
-    specs = {}
-    for slot, names in op.inputs.items():
-        lst = []
-        for n in names:
-            if n == EMPTY_VAR:
-                lst.append(None)
-                continue
-            override = var_specs.get(n) if var_specs else None
-            if override is not None:
-                shape, dtype = override
-            else:
-                vd = _find_var(program, block, n)
-                if vd is None:
-                    raise KeyError("var %s not found for shape inference"
-                                   % n)
-                shape, dtype = vd.shape, proto_to_np_dtype(vd.dtype)
-            shape = tuple(_FAKE_BATCH if d == -1 else d for d in shape)
-            lst.append(jax.ShapeDtypeStruct(shape, dtype))
-        specs[slot] = lst
     attrs = {k: a.value for k, a in op.attrs.items()}
 
-    if callable(info.infer_shape):
-        shaped = info.infer_shape(Ins(specs), attrs, op)
-        shaped = {slot: (list(v) if isinstance(v, (list, tuple)) else [v])
-                  for slot, v in (shaped or {}).items()}
-    else:
+    def build_specs(fake):
+        specs = {}
+        dynamic = False
+        for slot, names in op.inputs.items():
+            lst = []
+            for n in names:
+                if n == EMPTY_VAR:
+                    lst.append(None)
+                    continue
+                override = var_specs.get(n) if var_specs else None
+                if override is not None:
+                    shape, dtype = override
+                else:
+                    vd = _find_var(program, block, n)
+                    if vd is None:
+                        raise KeyError(
+                            "var %s not found for shape inference" % n)
+                    shape, dtype = vd.shape, proto_to_np_dtype(vd.dtype)
+                if any(d == -1 for d in shape):
+                    dynamic = True
+                shape = tuple(fake if d == -1 else d for d in shape)
+                lst.append(jax.ShapeDtypeStruct(shape, dtype))
+            specs[slot] = lst
+        return specs, dynamic
+
+    def run(specs):
+        if callable(info.infer_shape):
+            shaped = info.infer_shape(Ins(specs), attrs, op)
+            return {slot: (list(v) if isinstance(v, (list, tuple))
+                           else [v])
+                    for slot, v in (shaped or {}).items()}
+
         def f(s):
             env = {}
             ctx = LoweringContext(program, block.idx, env,
@@ -515,22 +533,58 @@ def infer_op_outputs(program, block, op, var_specs=None):
             outs = info.lower(ctx, Ins(s), attrs, op)
             norm = {}
             for slot, v in (outs or {}).items():
-                norm[slot] = list(v) if isinstance(v, (list, tuple)) else [v]
+                norm[slot] = list(v) if isinstance(v, (list, tuple)) \
+                    else [v]
             return norm
 
-        shaped = jax.eval_shape(f, specs)
+        return jax.eval_shape(f, specs)
+
+    specs, dynamic = build_specs(_FAKE_BATCH)
+    shaped = run(specs)
+    shaped_alt = None
+    if dynamic and any(
+            _FAKE_BATCH in getattr(sd, "shape", ())
+            for outs in shaped.values() for sd in outs
+            if sd is not None):
+        # second pass under the alternate sentinel, run ONLY when an
+        # output dim actually equals the primary sentinel (for most
+        # ops no output dim is 97 and there is nothing to
+        # disambiguate): dims that moved 97 -> 89 in lockstep are
+        # really the dynamic dim.  Any failure of the alternate
+        # evaluation (an op with a genuine size constraint the other
+        # sentinel violates) degrades to the single-sentinel mapping
+        # rather than losing inference.
+        try:
+            shaped_alt = run(build_specs(_FAKE_BATCH_ALT)[0])
+        except Exception:
+            shaped_alt = None
+
     result = {}
     for slot, names in op.outputs.items():
         if slot not in shaped:
             continue
-        for n, sd in zip(names, shaped[slot]):
+        alt_slot = shaped_alt.get(slot) if shaped_alt else None
+        for i, (n, sd) in enumerate(zip(names, shaped[slot])):
             # non-dense outputs (SelectedRows grads, TensorArrays) have
             # no single (shape, dtype); their consumers validate them
             if n == EMPTY_VAR or sd is None or \
                     not hasattr(sd, "shape") or not hasattr(sd, "dtype"):
                 continue
-            shape = tuple(-1 if d == _FAKE_BATCH else d for d in sd.shape)
-            result[n] = (shape, sd.dtype)
+            alt = alt_slot[i] if alt_slot and i < len(alt_slot) else None
+            alt_shape = tuple(alt.shape) if alt is not None and \
+                hasattr(alt, "shape") and len(alt.shape) == len(sd.shape) \
+                else None
+            shape = []
+            for j, d in enumerate(sd.shape):
+                if not dynamic:
+                    shape.append(d)       # no -1 inputs: nothing to map
+                elif d == _FAKE_BATCH and (
+                        alt_shape is None
+                        or alt_shape[j] == _FAKE_BATCH_ALT):
+                    shape.append(-1)
+                else:
+                    shape.append(d)
+            result[n] = (tuple(shape), sd.dtype)
     return result
 
 

@@ -85,3 +85,21 @@ def merge_rows(sr):
     n_seg = seg[-1] + 1
     rows_m = jnp.where(jnp.arange(k) < n_seg, rep, sr.height)
     return SelectedRows(rows_m.astype(jnp.int32), merged_vals, sr.height)
+
+
+def merge_rows_host(rows, values):
+    """Host-side (numpy) duplicate-row merge: returns (unique sorted
+    rows, per-row summed values).  The ONE definition of the
+    unique+scatter-add idiom shared by the pserver send path
+    (ops/distributed_ops._merge_dup_rows) and the hierarchical
+    aggregator's group mean (distributed/hierarchy.py) — unlike
+    :func:`merge_rows` above, the row count SHRINKS (host callers are
+    outside jit and may change shape freely)."""
+    import numpy as np
+
+    rows = np.asarray(rows)
+    values = np.asarray(values)
+    uniq, inv = np.unique(rows, return_inverse=True)
+    merged = np.zeros((uniq.shape[0],) + values.shape[1:], values.dtype)
+    np.add.at(merged, inv, values)
+    return uniq, merged

@@ -16,6 +16,7 @@
 #include <errno.h>
 #include <netinet/in.h>
 #include <netinet/tcp.h>
+#include <poll.h>
 #include <string.h>
 #include <sys/socket.h>
 #include <sys/uio.h>
@@ -137,6 +138,34 @@ long long fw_sendv(int fd, const char **bufs, const long long *lens,
         i += k;
     }
     return total;
+}
+
+/* fw_recv with a deadline: like fw_recv, but returns -3 if timeout_ms
+ * elapses before the full n bytes arrive.  Used for the connection
+ * handshake — a listener that accepts and then goes silent (half-dead
+ * process, wedged accept queue) must not pin a client thread forever
+ * before the gRPC fallback can take over. */
+long long fw_recv_timeout(int fd, char *buf, long long n, int timeout_ms) {
+    long long done = 0;
+    while (done < n) {
+        struct pollfd pfd;
+        pfd.fd = fd;
+        pfd.events = POLLIN;
+        int pr = poll(&pfd, 1, timeout_ms);
+        if (pr == 0) return -3;
+        if (pr < 0) {
+            if (errno == EINTR) continue;
+            return -1;
+        }
+        ssize_t r = recv(fd, buf + done, (size_t)(n - done), 0);
+        if (r == 0) return done == 0 ? 0 : -2;
+        if (r < 0) {
+            if (errno == EINTR) continue;
+            return -1;
+        }
+        done += r;
+    }
+    return done;
 }
 
 /* Receive exactly n bytes; returns n, 0 on orderly close at a message

@@ -57,7 +57,15 @@ METHODS = {"SendVariable": 1, "GetVariable": 2,
            # u64 len | payload, reply u64 len | payload — so a native
            # FastServer/FastConnPool peer interoperates with the
            # Python predict endpoint byte-for-byte
-           "Predict": 5}
+           "Predict": 5,
+           # host-local hierarchical aggregation (distributed/
+           # hierarchy.py): follower -> group-leader grad frames,
+           # round barriers, and job completion over loopback
+           "HierSend": 6, "HierBarrier": 7, "HierComplete": 8,
+           # sharded-table row prefetch (distributed_lookup): tens of
+           # MB of embedding rows per CTR step — bulk data, so it
+           # belongs on the data plane with the scatters/gathers
+           "PrefetchVariable": 9}
 
 _lib = None
 _lib_tried = False
@@ -121,6 +129,12 @@ def _build_and_bind():
         lib.fw_recv.argtypes = [ctypes.c_int, ctypes.c_void_p,
                                 ctypes.c_longlong]  # addr via addressof
         lib.fw_recv.restype = ctypes.c_longlong
+        if hasattr(lib, "fw_recv_timeout"):
+            lib.fw_recv_timeout.argtypes = [ctypes.c_int,
+                                            ctypes.c_void_p,
+                                            ctypes.c_longlong,
+                                            ctypes.c_int]
+            lib.fw_recv_timeout.restype = ctypes.c_longlong
         lib.fw_close.argtypes = [ctypes.c_int]
         return lib
     except Exception:
@@ -183,6 +197,31 @@ def _send_parts(lib, fd, parts):
         raise ConnectionError("fastwire vectored send failed")
     _M_TX.inc(total)
     del keep
+
+
+def _recv_exact_timeout(lib, fd, n, timeout_ms):
+    """Bounded receive for the connection handshake: a listener that
+    accepts and then goes silent must fail the handshake within
+    ``timeout_ms`` instead of pinning the caller's thread — the caller
+    then falls back to gRPC.  Degrades to the unbounded read when the
+    native library predates fw_recv_timeout."""
+    import numpy as np
+
+    if not hasattr(lib, "fw_recv_timeout"):
+        return _recv_exact(lib, fd, n)
+    buf = np.empty(n, np.uint8)
+    got = lib.fw_recv_timeout(fd, buf.ctypes.data, n, int(timeout_ms))
+    if got != n:
+        # -3 = deadline expired: the peer ANSWERED the connect but is
+        # slow (mid-compile, GC pause) — transient, NOT a foreign
+        # listener; the caller must retry next round, never blacklist
+        e = ConnectionError(
+            "fastwire handshake recv failed (%d of %d)" % (got, n))
+        e.handshake_timeout = (got == -3)
+        raise e
+    _M_RX.inc(n)
+    buf.flags.writeable = False
+    return memoryview(buf)
 
 
 def _recv_exact(lib, fd, n):
@@ -361,11 +400,14 @@ class FastConnPool:
             return None
         try:
             _send_bytes(lib, fd, [MAGIC])
-            if bytes(_recv_exact(lib, fd, len(MAGIC))) != MAGIC:
+            if bytes(_recv_exact_timeout(lib, fd, len(MAGIC),
+                                         5000)) != MAGIC:
                 lib.fw_close(fd)
                 return "foreign"
-        except ConnectionError:
+        except ConnectionError as e:
             lib.fw_close(fd)
+            if getattr(e, "handshake_timeout", False):
+                return None    # slow peer: retry next round
             return "foreign"   # answered, then hung up mid-handshake
         return _Conn(lib, fd)
 

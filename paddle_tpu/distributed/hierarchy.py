@@ -1,0 +1,463 @@
+"""Host-local hierarchical gradient aggregation (ISSUE 10 tentpole b).
+
+Trainers on one host pre-reduce their grads through a LOCAL aggregator
+before one upload per host hits the pservers — tree fan-in that cuts
+pserver ingress (and sync fanin) by the trainers-per-host factor, the
+reference's multi-level ParameterServer topology (Li et al., OSDI'14).
+
+Topology (FLAGS_dist_hier_local = L trainers per host group):
+- trainer ids are grouped contiguously: group g = trainer_id // L; the
+  group's LEADER is its lowest id (trainer_id % L == 0).
+- Followers ship their grads to the leader over a loopback fastwire
+  channel (HierSend frames: the normal rpc frame with the target
+  pserver endpoint folded into the name, '<ep>\\x00<name>'), signal
+  round completion with HierBarrier, and job completion with
+  HierComplete.  They keep READING params directly from the pservers
+  (reads are stateless) and their recv naturally blocks until the
+  leader's round lands.
+- The leader stashes its own grads in-process; at barrier time it
+  waits for every follower's HierBarrier, computes the group-local
+  mean per (endpoint, grad) with the same add-then-scale the server
+  uses, and makes ONE (optionally compressed) upload + ONE barrier to
+  the pservers under its own (round, sender, seq) identity — PR 1's
+  replay/dedup machinery covers the upload verbatim.
+- The pserver therefore sees fanin = number of GROUPS (the transpiler
+  sets listen_and_serv Fanin accordingly), and mean-over-groups of
+  equal-size group means equals the flat mean over trainers.
+
+Contract notes: followers trust the leader for round durability (the
+pserver's durable ack lands at the leader); group sizes must be equal
+(transpile() enforces trainers % L == 0); aggregation order within a
+group is follower-arrival order — commutative for the 2-trainer rig,
+documented fp-rounding freedom beyond it.
+"""
+from __future__ import annotations
+
+import os
+import threading
+import time
+
+import numpy as np
+
+from paddle_tpu.core.flags import FLAGS
+from paddle_tpu.observability import metrics as _obs_metrics
+
+__all__ = ["enabled", "role", "Role", "HostAggregator", "reset"]
+
+_M_LOCAL_FRAMES = _obs_metrics.counter(
+    "hier_local_frames_total",
+    "grad frames received by host-local aggregators")
+_M_UPLOADS = _obs_metrics.counter(
+    "hier_uploads_total",
+    "pre-reduced (endpoint, grad) uploads shipped by group leaders")
+
+_SEP = "\x00"   # folds the target pserver endpoint into the frame name
+
+# leader-side sparse merge pays a full sort; below this sampled
+# cross-member row overlap the dedup saves too few bytes to buy it
+_MERGE_MIN_OVERLAP = 0.25
+
+
+def _overlap_worth_merging(row_sets, sample=2048):
+    """Cheap overlap estimate across members' row-id sets: sampled
+    membership of the first set in the second (the common 2-member
+    case; wider groups always merge — overlap compounds)."""
+    if len(row_sets) != 2:
+        return True
+    a, b = row_sets
+    if a.size == 0 or b.size == 0:
+        return False
+    probe = a[:: max(1, a.size // sample)][:sample]
+    return float(np.isin(probe, b).mean()) >= _MERGE_MIN_OVERLAP
+
+
+def enabled():
+    return int(FLAGS.dist_hier_local or 0) > 1
+
+
+class Role:
+    __slots__ = ("trainer_id", "n_local", "group", "leader", "port")
+
+    def __init__(self, trainer_id, n_local):
+        self.trainer_id = int(trainer_id)
+        self.n_local = int(n_local)
+        self.group = self.trainer_id // self.n_local
+        self.leader = self.trainer_id % self.n_local == 0
+        self.port = int(FLAGS.dist_hier_port) + self.group
+
+
+def role():
+    tid = os.environ.get("PADDLE_TRAINER_ID")
+    if tid is None:
+        raise RuntimeError(
+            "FLAGS_dist_hier_local is set but PADDLE_TRAINER_ID is not "
+            "in the environment — hierarchical aggregation needs the "
+            "trainer id to elect the group leader")
+    return Role(int(tid), int(FLAGS.dist_hier_local))
+
+
+# ---------------------------------------------------------------------------
+# leader side
+# ---------------------------------------------------------------------------
+
+class HostAggregator:
+    """Leader-side state: follower contributions per round, barrier and
+    completion accounting, and the group-mean flush."""
+
+    def __init__(self, n_local, port, upload=None):
+        from . import fastwire
+
+        if not fastwire.native_available():
+            raise RuntimeError(
+                "hierarchical aggregation needs the fastwire native "
+                "library (g++ self-build failed?)")
+        self.n_local = int(n_local)
+        # EAGER upload hook: callable([(ep, name, group-mean)]).  When
+        # set, a grad whose n_local-th contribution just landed is
+        # aggregated and shipped IMMEDIATELY (on the arrival thread) —
+        # uploads overlap the rest of the round instead of bunching at
+        # the barrier.  flush() then only settles the stragglers.
+        self._upload = upload
+        self._cv = threading.Condition()
+        self._grads = {}      # round -> {(ep, name): {sender: arr}}
+        self._order = {}      # round -> [(ep, name)] first-seen order
+        self._shipped = {}    # round -> {(ep, name)} already uploaded
+        self._barriers = {}   # round -> set(follower senders)
+        self._completed = set()
+        self._inflight = 0    # eager uploads currently on the wire
+        self._errs = []       # eager-upload failures, surfaced at flush
+        self._server = fastwire.FastServer(
+            port, {"HierSend": self._h_send,
+                   "HierBarrier": self._h_barrier,
+                   "HierComplete": self._h_complete},
+            addr="127.0.0.1")
+
+    # -- wire handlers (follower -> leader) --
+    def _h_send(self, req, ctx=None):
+        from .rpc import _dec_tensor, _iter_batch, _unpack_round_sender
+
+        ready = []
+        with self._cv:
+            for frame in _iter_batch(req):
+                wname, arr, extra = _dec_tensor(frame)
+                round_, sender, _ = _unpack_round_sender(extra)
+                ep, name = wname.split(_SEP, 1)
+                ready += self._stash_locked(round_, ep, name, arr,
+                                            sender)
+                _M_LOCAL_FRAMES.inc()
+            self._cv.notify_all()
+        self._ship_async(ready)
+        return b""
+
+    def _h_barrier(self, req, ctx=None):
+        from .rpc import _dec_msg, _unpack_round_sender
+
+        _, extra = _dec_msg(req)
+        round_, sender, _ = _unpack_round_sender(extra)
+        with self._cv:
+            self._barriers.setdefault(round_, set()).add(sender)
+            self._cv.notify_all()
+        return b""
+
+    def _h_complete(self, req, ctx=None):
+        from .rpc import _dec_msg, _unpack_round_sender
+
+        _, extra = _dec_msg(req)
+        _, sender, _ = _unpack_round_sender(extra)
+        with self._cv:
+            self._completed.add(sender)
+            self._cv.notify_all()
+        return b""
+
+    # -- leader-local API --
+    def _stash_locked(self, round_, ep, name, arr, sender):
+        """One contribution (lock held).  Sender-keyed: a follower's
+        retried frame OVERWRITES its previous value — idempotent, like
+        the pserver's (round, sender) dedup.  Returns the [(ep, name,
+        contributions)] entries the caller must SHIP (outside the
+        lock): with an eager-upload hook installed, a grad completes
+        the moment its n_local-th contribution lands."""
+        key = (ep, name)
+        if key in self._shipped.get(round_, ()):
+            # a retried frame for an entry the eager path already
+            # uploaded: its value is in the shipped mean — dropping the
+            # duplicate keeps the retry idempotent (re-creating the
+            # entry would make flush upload a 1-contribution "mean"
+            # over the true group mean)
+            return []
+        r = self._grads.setdefault(round_, {})
+        if key not in r:
+            r[key] = {}
+            self._order.setdefault(round_, []).append(key)
+        r[key][sender] = arr
+        if self._upload is not None and len(r[key]) >= self.n_local:
+            self._order[round_].remove(key)
+            self._shipped.setdefault(round_, set()).add(key)
+            self._inflight += 1
+            return [(key[0], key[1], r.pop(key))]
+        return []
+
+    def _ship_async(self, ready):
+        """Run _ship off the caller's thread: the LEADER's own send op
+        frequently completes an entry (its contribution arrives last),
+        and merging + codec + upload of a multi-MB grad on that thread
+        would serialize straight into the leader's training step.  The
+        flush()-time inflight accounting already covers the handoff —
+        _inflight was incremented under the lock in _stash_locked."""
+        if ready:
+            threading.Thread(target=self._ship, args=(ready,),
+                             daemon=True).start()
+
+    def _ship(self, ready):
+        """Aggregate + upload completed entries (no lock held); eager
+        counterpart of flush()'s straggler pass."""
+        if not ready:
+            return
+        try:
+            triples = [(ep, name, self._aggregate(contrib))
+                       for ep, name, contrib in ready]
+            for _ in triples:
+                _M_UPLOADS.inc()
+            self._upload(triples)
+        except Exception as e:
+            with self._cv:
+                self._errs.append(e)
+        finally:
+            with self._cv:
+                self._inflight -= len(ready)
+                self._cv.notify_all()
+
+    def stash(self, round_, ep, name, arr, sender):
+        with self._cv:
+            ready = self._stash_locked(round_, ep, name, arr, sender)
+            self._cv.notify_all()
+        self._ship_async(ready)
+
+    def _wait(self, pred, deadline, what):
+        end = time.monotonic() + deadline
+        while not pred():
+            left = end - time.monotonic()
+            if left <= 0:
+                raise TimeoutError(
+                    "hierarchical aggregation: leader timed out waiting "
+                    "for %s (followers dead or mis-grouped? "
+                    "FLAGS_dist_hier_local=%d)" % (what, self.n_local))
+            self._cv.wait(timeout=min(left, 0.25))
+
+    @staticmethod
+    def _aggregate(contrib):
+        """Group-mean of one grad's {sender: value} contributions."""
+        from paddle_tpu.core.selected_rows import SelectedRows
+        from .rpc import _aligned_empty
+
+        vals = list(contrib.values())
+        n = len(vals)
+        if any(isinstance(v, SelectedRows) for v in vals):
+            # group-mean of sparse grads: concatenate, then MERGE
+            # duplicate rows by summation (scatter-add equivalent —
+            # tree fan-in cuts sparse ingress when the members' row
+            # sets OVERLAP, i.e. head-heavy traffic).  The merge
+            # itself costs a sort over every row, so estimate the
+            # overlap first from a sample and skip when the tail
+            # dominates — concatenation is the same math either way.
+            from paddle_tpu.core.selected_rows import merge_rows_host
+
+            rows = np.concatenate([np.asarray(v.rows) for v in vals])
+            values = np.concatenate(
+                [np.asarray(v.values) for v in vals]) / n
+            if _overlap_worth_merging(
+                    [np.asarray(v.rows) for v in vals]):
+                uniq, merged = merge_rows_host(rows, values)
+                return SelectedRows(uniq, merged, vals[0].height)
+            return SelectedRows(rows, values, vals[0].height)
+        if n == 1:
+            return np.asarray(vals[0])
+        # same add-then-scale the pserver's aggregate uses
+        v0 = np.asarray(vals[0])
+        agg = _aligned_empty(v0.shape, v0.dtype)
+        np.add(v0, vals[1], out=agg)
+        for v in vals[2:]:
+            agg += v
+        agg *= 1.0 / n
+        return agg
+
+    def flush(self, round_, deadline=300.0):
+        """Wait for every follower's HierBarrier of ``round_`` and for
+        the eager uploads in flight, surface any eager-upload failure,
+        then return the STRAGGLER [(ep, name, group-mean)] entries
+        (everything not already shipped eagerly) and drop the round's
+        state.  Follower sends precede their barrier on one FIFO
+        connection, so a complete barrier set implies complete grads."""
+        with self._cv:
+            self._wait(
+                lambda: (len(self._barriers.get(round_, ())) >=
+                         self.n_local - 1 and self._inflight == 0),
+                deadline, "round %d follower barriers" % round_)
+            if self._errs:
+                raise self._errs.pop(0)
+            grads = self._grads.pop(round_, {})
+            order = self._order.pop(round_, [])
+            self._barriers.pop(round_, None)
+            self._shipped.pop(round_, None)
+        out = []
+        for key in order:
+            out.append((key[0], key[1], self._aggregate(grads[key])))
+            _M_UPLOADS.inc()
+        return out
+
+    def wait_complete(self, deadline=300.0):
+        with self._cv:
+            self._wait(lambda: len(self._completed) >= self.n_local - 1,
+                       deadline, "follower completions")
+
+    def stop(self):
+        try:
+            self._server.stop()
+        except Exception:
+            pass
+
+
+# ---------------------------------------------------------------------------
+# follower side
+# ---------------------------------------------------------------------------
+
+class _FollowerLink:
+    """One persistent loopback connection to the group leader.  FIFO
+    per connection: a follower's HierBarrier can never overtake its
+    grads.  Retries reconnect freely — the aggregator's sender-keyed
+    stash makes duplicate frames idempotent."""
+
+    def __init__(self, port):
+        from . import fastwire
+
+        self._fw = fastwire
+        self._ep = "127.0.0.1:%d" % int(port)
+        self._pool = fastwire.FastConnPool(0)
+        self._lock = threading.Lock()
+
+    def call(self, method, payload, deadline=300.0):
+        end = time.monotonic() + deadline
+        last = None
+        with self._lock:
+            while time.monotonic() < end:
+                conn = self._pool.checkout(self._ep)
+                if conn is None:
+                    # leader not listening yet (startup race) — the
+                    # loopback connect is cheap, poll it
+                    time.sleep(0.05)
+                    continue
+                try:
+                    conn.call(method, payload)
+                    self._pool.checkin(self._ep, conn)
+                    return
+                except ConnectionError as e:
+                    last = e
+                    self._pool.discard(conn)
+                    time.sleep(0.05)
+        raise TimeoutError(
+            "hierarchical aggregation: follower could not reach its "
+            "group leader at %s (%s)" % (self._ep, last))
+
+
+# ---------------------------------------------------------------------------
+# process-wide wiring (used by rpc.RPCClient)
+# ---------------------------------------------------------------------------
+
+_state_lock = threading.Lock()
+_agg = None
+_link = None
+
+
+def _aggregator(r, client=None):
+    global _agg
+    with _state_lock:
+        if _agg is None:
+            upload = None
+            if client is not None:
+                # eager-upload hook: ship a completed grad through the
+                # leader's normal (compressed, replay-recorded) wire
+                # path the moment the whole group contributed
+                upload = client._send_vars_wire
+            _agg = HostAggregator(r.n_local, r.port, upload=upload)
+        elif client is not None and _agg._upload is None:
+            _agg._upload = client._send_vars_wire
+        return _agg
+
+
+def _follower_link(r):
+    global _link
+    with _state_lock:
+        if _link is None:
+            _link = _FollowerLink(r.port)
+        return _link
+
+
+def reset():
+    """Tear down the process's aggregator/link (tests, RPCClient.reset)."""
+    global _agg, _link
+    with _state_lock:
+        if _agg is not None:
+            _agg.stop()
+        _agg = None
+        _link = None
+
+
+def leader_stash(client, triples):
+    """The leader's own send op: contributions go straight into the
+    in-process aggregator (host-materialized; the wire codec runs on
+    the aggregated upload)."""
+    agg = _aggregator(role(), client)
+    for ep, name, arr in triples:
+        agg.stash(client.step, ep, name, client._to_host(arr),
+                  client.sender)
+
+
+def follower_send(client, triples):
+    from .rpc import _enc_batch_parts, _enc_tensor_parts, \
+        _pack_round_sender
+
+    r = role()
+    frames = []
+    for ep, name, arr in triples:
+        arr = client._to_host(arr)
+        seq = client._next_seq()
+        frames.append(_enc_tensor_parts(
+            "%s%s%s" % (ep, _SEP, name), arr,
+            _pack_round_sender(client.step, client.sender, seq)))
+    _follower_link(r).call("HierSend", _enc_batch_parts(frames),
+                           deadline=client.retry.deadline)
+
+
+def follower_barrier(client):
+    from .rpc import _enc_msg, _pack_round_sender
+
+    r = role()
+    _follower_link(r).call(
+        "HierBarrier",
+        _enc_msg(client.label,
+                 _pack_round_sender(client.step, client.sender)),
+        deadline=client.retry.deadline)
+
+
+def follower_complete(client):
+    from .rpc import _enc_msg, _pack_round_sender
+
+    r = role()
+    _follower_link(r).call(
+        "HierComplete",
+        _enc_msg(client.label,
+                 _pack_round_sender(client.step, client.sender)),
+        deadline=min(30.0, client.retry.deadline))
+
+
+def leader_flush(client):
+    """Barrier-time settle: wait for the group's followers (and any
+    eager uploads in flight), return the straggler [(ep, name,
+    group-mean)] upload list for the current round."""
+    agg = _aggregator(role(), client)
+    return agg.flush(client.step, deadline=client.retry.deadline)
+
+
+def leader_wait_complete(client):
+    agg = _aggregator(role())
+    agg.wait_complete(deadline=min(60.0, client.retry.deadline))

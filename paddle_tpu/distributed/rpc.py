@@ -41,6 +41,8 @@ from concurrent import futures
 
 import numpy as np
 
+from . import compress as _czip
+from .compress import Compressed
 from .resilience import FLAGS, InjectedFault, RetryPolicy, fault_point, \
     maybe_corrupt as _maybe_corrupt
 
@@ -67,6 +69,26 @@ _M_DEDUP = _obs_metrics.counter(
     "replayed/duplicate grads dropped by (round, sender, seq) dedup")
 _M_REPLAYS = _obs_metrics.counter(
     "rpc_round_replays_total", "client round replays after reconnect")
+# gradient-compression effectiveness (ISSUE 10): raw vs on-wire payload
+# bytes of every outbound grad (equal when compression is off/raw), the
+# codec's encode cost, and the server-side staleness spread
+_M_WIRE_RAW = _obs_metrics.counter(
+    "wire_bytes_raw_total",
+    "outbound grad payload bytes BEFORE compression")
+_M_WIRE_COMP = _obs_metrics.counter(
+    "wire_bytes_compressed_total",
+    "outbound grad payload bytes as shipped (post-codec)")
+_M_COMPRESS_MS = _obs_metrics.histogram(
+    "compress_ms", "per-tensor gradient codec encode time")
+_M_STALE_GAP = _obs_metrics.gauge(
+    "pserver_staleness_gap",
+    "barriered-round spread between the fastest and slowest live "
+    "trainer (bounded-staleness mode; 0 in lockstep sync)")
+
+# wire-format version: 2 adds compressed frames (kind byte 2).  A
+# client only ships them to an endpoint whose WireVersion RPC
+# advertises >= 2; old servers (no such method) get raw frames.
+WIRE_VERSION = 2
 
 SERVICE = "paddle_tpu.PServer"
 
@@ -130,16 +152,63 @@ def _dec_arr(view, off):
     return arr, off + nbytes
 
 
+def _compressed_head(c):
+    """Kind-2 frame sub-header: codec | param | height | dtype | shape |
+    n_arrays — everything decode needs besides the codec arrays."""
+    dt = c.dtype.str.encode("ascii")
+    head = [b"\x02", c.codec.to_bytes(1, "little"),
+            int(c.param).to_bytes(4, "little"),
+            int(c.height).to_bytes(8, "little", signed=True),
+            len(dt).to_bytes(2, "little"), dt,
+            len(c.shape).to_bytes(1, "little")]
+    for d in c.shape:
+        head.append(int(d).to_bytes(8, "little"))
+    head.append(len(c.arrays).to_bytes(1, "little"))
+    return b"".join(head)
+
+
+def _dec_compressed(view, off):
+    codec = view[off]
+    off += 1
+    param = int.from_bytes(view[off:off + 4], "little")
+    off += 4
+    height = int.from_bytes(view[off:off + 8], "little", signed=True)
+    off += 8
+    n = int.from_bytes(view[off:off + 2], "little")
+    off += 2
+    dtype = np.dtype(view[off:off + n].tobytes().decode("ascii"))
+    off += n
+    ndim = view[off]
+    off += 1
+    shape = []
+    for _ in range(ndim):
+        shape.append(int.from_bytes(view[off:off + 8], "little"))
+        off += 8
+    n_arrays = view[off]
+    off += 1
+    arrays = []
+    for _ in range(n_arrays):
+        a, off = _dec_arr(view, off)
+        arrays.append(a)
+    return Compressed(codec, param, dtype, shape, height, arrays), off
+
+
 def _enc_tensor(name, arr, extra=0):
-    """Wire format: name | extra | kind (0 dense, 1 SelectedRows) | arrays.
-    SelectedRows travel as (rows, values, height) — reference
-    VariableMessage's SELECTED_ROWS type (send_recv.proto:48)."""
+    """Wire format: name | extra | kind | arrays.  Kinds: 0 dense, 1
+    SelectedRows (rows, values, height — reference VariableMessage's
+    SELECTED_ROWS type, send_recv.proto:48), 2 compressed
+    (wire-format v2: codec header + codec arrays, distributed/
+    compress.py; decoded transparently by _dec_tensor)."""
     from paddle_tpu.core.selected_rows import SelectedRows
 
     nb = name.encode("utf-8")
     parts = [len(nb).to_bytes(4, "little"), nb,
              int(extra).to_bytes(8, "little", signed=True)]
-    if isinstance(arr, SelectedRows):
+    if isinstance(arr, Compressed):
+        parts.append(_compressed_head(arr))
+        for a in arr.arrays:
+            _enc_arr(parts, a)
+    elif isinstance(arr, SelectedRows):
         parts.append(b"\x01")
         parts.append(int(arr.height).to_bytes(8, "little"))
         _enc_arr(parts, np.asarray(arr.rows))
@@ -167,6 +236,13 @@ def _dec_tensor(data):
         rows, off = _dec_arr(view, off)
         values, off = _dec_arr(view, off)
         return name, SelectedRows(rows, values, height), extra
+    if kind == 2:
+        # compressed frame (wire v2): decode to the dense/SelectedRows
+        # value HERE, before any aggregation/dedup logic sees it — the
+        # (round, sender, seq) semantics operate on decoded tensors
+        # exactly as on raw frames
+        c, off = _dec_compressed(view, off)
+        return name, _czip.decompress(c), extra
     arr, off = _dec_arr(view, off)
     return name, arr, extra
 
@@ -231,7 +307,11 @@ def _enc_tensor_parts(name, arr, extra=0):
     head = (len(nb).to_bytes(4, "little") + nb
             + int(extra).to_bytes(8, "little", signed=True))
     parts = []
-    if isinstance(arr, SelectedRows):
+    if isinstance(arr, Compressed):
+        parts.append(head + _compressed_head(arr))
+        for a in arr.arrays:
+            _enc_arr_parts(parts, a)
+    elif isinstance(arr, SelectedRows):
         parts.append(head + b"\x01"
                      + int(arr.height).to_bytes(8, "little"))
         _enc_arr_parts(parts, np.asarray(arr.rows))
@@ -330,7 +410,7 @@ class VariableServer:
     def __init__(self, scope, grad_to_block, apply_block, fanin,
                  sync_mode=True, checkpoint_dir=None,
                  checkpoint_every_n=0, trainer_lease=None,
-                 grad_params=None):
+                 grad_params=None, staleness=None):
         import grpc
 
         self.scope = scope
@@ -338,6 +418,12 @@ class VariableServer:
         self.apply_block = apply_block
         self.fanin_total = int(fanin)
         self.sync_mode = bool(sync_mode)
+        # bounded-staleness window (ISSUE 10): a barrier for round r
+        # acks once round r-k is applied+durable, and gets accept
+        # k-stale params — k=0 (the default) is lockstep sync,
+        # bit-exact with the k-unaware wire
+        self.staleness = max(0, int(FLAGS.dist_staleness
+                                    if staleness is None else staleness))
         self.grad_params = {k: tuple(v) for k, v in grad_params.items()} \
             if grad_params else {}
         # shard checkpointing (reference go/pserver/service.go:346:
@@ -362,8 +448,20 @@ class VariableServer:
         # (name -> (ready-round, encoded parts)): both trainers fetch
         # the same shard every round — materialize + encode it once
         self._reply_cache = {}
-        self._barrier_senders = set()   # senders barriered this round
-        self._barrier_round = -1        # highest round those barriers name
+        # per-shard reader/writer fence: an optimize block DONATES its
+        # param buffers to the jit call, so a prefetch gathering rows
+        # from the zero-copy scope view must exclude the window where
+        # that param's own block is dispatching (bounded staleness
+        # serves reads during the round's apply; lockstep sync already
+        # fences by round structure).  Readers are ~ms row gathers, so
+        # the apply's wait-for-readers is negligible.
+        self._shard_readers = {}      # param name -> active reader count
+        self._shard_applying = set()  # params whose block is in flight
+        # sender -> highest round barriered.  Persistent across rounds
+        # (bounded staleness: a fast trainer's round r+j barrier also
+        # witnesses every round <= r+j); the per-round count is derived
+        # against _applied_round.
+        self._barrier_rounds = {}
         self._legacy_barriers = 0       # anonymous (empty-payload) barriers
         self._anon_seq = 0
         self._senders = {}              # sender -> {"label", "last_seen"}
@@ -398,6 +496,7 @@ class VariableServer:
             "BarrierStatus": self._h(self._barrier_status),
             "ToggleProfile": self._h(self._toggle_profile),
             "SendComplete": self._h(self._send_complete),
+            "WireVersion": self._h(self._wire_version),
         }
         # enough workers that fanin-1 blocked GetVariable waiters (plus
         # retried barrier handlers that linger until their client's
@@ -437,6 +536,9 @@ class VariableServer:
                     {"SendVariable": self._send_variable,
                      "GetVariable": self._get_variable,
                      "SendVariables": self._send_variables,
+                     # embedding-row prefetches are bulk frames too
+                     # (a CTR step moves tens of MB of rows)
+                     "PrefetchVariable": self._prefetch_variable,
                      # streamed batched gather: frames go out per-shard
                      # the moment each apply commits
                      "GetVariables": (self._get_variables_stream,
@@ -445,7 +547,28 @@ class VariableServer:
                 self._fast = None
         if self.sync_mode and self.trainer_lease > 0:
             threading.Thread(target=self._lease_loop, daemon=True).start()
+        if self.sync_mode and self.staleness > 0:
+            # bounded staleness: the apply must NOT run inside a
+            # barrier handler (the handler's ack would then wait on the
+            # apply it is itself executing, and no trainer could run
+            # ahead) — a dedicated worker applies rounds as their
+            # barriers complete, handlers just ack at durable > r-k
+            threading.Thread(target=self._apply_loop, daemon=True).start()
         return port
+
+    def _apply_loop(self):
+        """Background apply worker (staleness > 0 only): applies each
+        round the moment its barriers are complete, off every handler
+        thread, and publishes durability for the relaxed acks."""
+        while not self._shutdown.is_set():
+            with self._cv:
+                while not (0 < self._alive <= self._barrier_count()) \
+                        and not self._shutdown.is_set():
+                    self._cv.wait(timeout=0.25)
+                if self._shutdown.is_set():
+                    return
+                snapshot = self._maybe_apply_locked()
+            self._persist_and_ack(snapshot)
 
     def wait(self):
         """Block until every trainer sent SendComplete."""
@@ -480,26 +603,57 @@ class VariableServer:
             self._alive = min(self._alive + 1, self.fanin_total)
 
     def _barrier_count(self):
-        return len(self._barrier_senders) + self._legacy_barriers
+        """Barriers witnessing the round about to apply (lock held):
+        LIVE senders whose highest barriered round reached
+        _applied_round, plus the legacy anonymous count.  Completed and
+        expired senders are excluded on purpose: their grads for every
+        round they witnessed are already in (or gone forever), and
+        counting their persistent high-water barriers against the
+        ``alive`` quota would let rounds apply before a slower LIVE
+        peer barriered them — that peer's late grads would then be
+        dedup-dropped as stale, violating the bounded-staleness
+        contract (delayed <= k, never discarded).  An unseen live
+        trainer contributes nothing here, so the count also cannot
+        reach ``alive`` while someone has not even connected."""
+        return sum(1 for s, r in self._barrier_rounds.items()
+                   if r >= self._applied_round
+                   and s not in self._completed
+                   and s not in self._expired) + self._legacy_barriers
+
+    def _barrier_max(self):
+        return max(self._barrier_rounds.values(), default=-1)
 
     def _maybe_apply_locked(self):
-        """Apply the round if every live trainer barriered (lock held).
+        """Apply every round whose barriers are complete (lock held).
         Returns a state snapshot the CALLER must persist (outside the
         lock) before bumping _durable_round, or None.  ``_applying``
         guards re-entry: _apply_round releases the lock around each
-        optimize block, so another handler can get here mid-round."""
-        if self._applying:
-            return None
-        if not (0 < self._alive <= self._barrier_count()):
-            return None
-        self._apply_round()
-        if (self.checkpoint_every_n and self.checkpoint_dir and
-                self._applied_round % self.checkpoint_every_n == 0):
-            # collect under the lock, WRITE outside it — disk I/O must
-            # not stall every other RPC handler
-            return self._collect_state()
-        self._durable_round = self._applied_round
-        return None
+        optimize block, so another handler can get here mid-round.
+        Loops: under bounded staleness a straggler's barrier can
+        complete SEVERAL pent-up rounds at once (the fast trainers'
+        later barriers witness every earlier round), and a server
+        restarted from a checkpoint OLDER than the trainers' rounds
+        walks forward through the missing rounds — each applies only
+        ITS OWN pending grads, so the rounds whose grads are
+        unrecoverable (outside every trainer's replay window) pass as
+        cheap empty applies instead of double-counting replays.  At
+        k=0 in steady state at most one round can ever be complete, so
+        one iteration runs — the lockstep path is unchanged."""
+        need_ckpt = False
+        while not self._applying and \
+                0 < self._alive <= self._barrier_count():
+            self._apply_round()
+            if (self.checkpoint_every_n and self.checkpoint_dir and
+                    self._applied_round % self.checkpoint_every_n == 0):
+                # collect under the lock, WRITE outside it — disk I/O
+                # must not stall every other RPC handler
+                need_ckpt = True
+            elif not need_ckpt:
+                # no checkpoint pending: the round is durable the
+                # moment it applied (once a checkpoint IS pending,
+                # durability may not advance past it until persisted)
+                self._durable_round = self._applied_round
+        return self._collect_state() if need_ckpt else None
 
     def _persist_and_ack(self, snapshot):
         """Write the snapshot, then publish durability (barrier acks for
@@ -524,7 +678,8 @@ class VariableServer:
                     continue    # nobody is waiting on a round
                 now = time.time()
                 for sender, ent in list(self._senders.items()):
-                    if sender in self._barrier_senders or \
+                    if self._barrier_rounds.get(sender, -1) \
+                            >= self._applied_round or \
                             sender in self._expired or \
                             sender in self._completed:
                         continue   # contributed, gone, or cleanly done
@@ -547,7 +702,8 @@ class VariableServer:
             self._reply_cache.pop(name, None)
             return
         if sender is None:
-            key = ("anon", self._anon_seq)
+            key = (int(round_) if isinstance(round_, int) else 0,
+                   ("anon", self._anon_seq))
             self._anon_seq += 1
         else:
             if self.sync_mode and (
@@ -566,7 +722,14 @@ class VariableServer:
                 # resend of an already-applied grad a no-op
                 _M_DEDUP.inc()
                 return
-            key = sender
+            # keyed by (round, sender): under bounded staleness a fast
+            # trainer's round r+1 grad arrives BEFORE round r applied —
+            # it must accumulate, not overwrite, while a same-round
+            # replay still lands on its own key (dedup by overwrite).
+            # At k=0 every pending entry names the current round, so
+            # insertion (= arrival) order and the aggregation mean are
+            # bit-identical to the round-keyless wire.
+            key = (int(round_), sender)
         self._pending[name][key] = arr
         if not self.sync_mode:
             self._apply_one(name)
@@ -658,9 +821,15 @@ class VariableServer:
                     sp.args = {"sender": label}
                 self._touch(sender, label)
                 if round_ >= self._applied_round:
-                    self._barrier_senders.add(sender)
-                    self._barrier_round = max(self._barrier_round, round_)
-                    snapshot = self._maybe_apply_locked()
+                    self._barrier_rounds[sender] = max(
+                        self._barrier_rounds.get(sender, -1), round_)
+                    self._update_staleness_locked()
+                    if self.staleness > 0:
+                        # wake the apply worker; this handler only
+                        # waits for durable > r-k below
+                        self._cv.notify_all()
+                    else:
+                        snapshot = self._maybe_apply_locked()
                 # else: replay of an applied round — do NOT join the
                 # current round's barrier set, but do NOT ack early
                 # either: the round may still be mid-checkpoint-write,
@@ -675,10 +844,28 @@ class VariableServer:
             return b""  # legacy anonymous barrier: ack immediately
         # ack only once the round is applied AND (on checkpoint rounds)
         # durably on disk — a crash before this point leaves every
-        # trainer un-acked and replaying the round, so nothing is lost
+        # trainer un-acked and replaying the round, so nothing is lost.
+        # Bounded staleness relaxes this by k rounds: the trainer may
+        # run ahead while the last k rounds are still applying (and a
+        # crash can lose at most those k un-acked rounds).
+        k = self.staleness
         with self._cv:
-            self._wait_cv(lambda: self._durable_round > round_, ctx)
+            self._wait_cv(lambda: self._durable_round > round_ - k, ctx)
         return b""
+
+    def _update_staleness_locked(self):
+        """Refresh the fast-vs-slow barrier spread gauge (lock held)."""
+        live = [r for s, r in self._barrier_rounds.items()
+                if s not in self._expired and s not in self._completed]
+        if len(live) >= 2:
+            _M_STALE_GAP.set(max(live) - min(live))
+
+    def _wire_version(self, req, ctx=None):
+        """Wire-format negotiation (ISSUE 10): a client probes this
+        before shipping compressed (kind 2) frames; an OLD server has
+        no such method, the call fails UNIMPLEMENTED, and the client
+        falls back to raw frames for that endpoint — see MIGRATION.md."""
+        return _enc_msg(",".join(sorted(_czip.CODECS)), WIRE_VERSION)
 
     # -- shard checkpointing ------------------------------------------
     def _collect_state(self):
@@ -744,13 +931,43 @@ class VariableServer:
     def _ready_locked(self, name, round_):
         """True when ``name`` is safe to serve at ``round_``: the whole
         round applied, or — mid-round — this shard's own apply already
-        committed (per-shard completion event via grad_params)."""
-        if self._applied_round >= round_:
+        committed (per-shard completion event via grad_params).  Under
+        bounded staleness the effective wait round relaxes by k: a get
+        may observe params missing up to the last k rounds' updates."""
+        eff = round_ - self.staleness
+        if self._applied_round >= eff:
             return True
         r = self._param_ready.get(name)
-        return r is not None and r >= round_
+        return r is not None and r >= eff
 
-    def _materialize_locked(self, name):
+    def _read_var_locked(self, name, ctx=None):
+        """Materialize a scope value robustly (lock held).  Under
+        bounded staleness a k-stale read is allowed WHILE the value's
+        own optimize block is in flight — and that apply DONATES the
+        param buffer, so the scope can briefly hold an invalidated jax
+        array.  On such a read, wait for the apply to commit its fresh
+        buffer and retry.  Returns None only when the client vanished
+        mid-wait."""
+        from paddle_tpu.core.selected_rows import SelectedRows
+
+        for _ in range(10000):
+            val = self.scope.find_var(name)
+            try:
+                if isinstance(val, SelectedRows):
+                    return SelectedRows(np.asarray(val.rows),
+                                        np.asarray(val.values),
+                                        val.height)
+                return np.asarray(val)
+            except Exception:
+                # donated husk: the in-flight apply owns the buffer —
+                # its commit (scope.set) publishes a fresh one
+                if not self._wait_cv(lambda: not self._applying, ctx):
+                    return None
+        raise RuntimeError(
+            "pserver could not materialize %r: buffer repeatedly "
+            "invalidated by concurrent applies" % name)
+
+    def _materialize_locked(self, name, ctx=None):
         """Encoded parts for ``name``'s current value (lock held).
         Cached per shard-round: with fanin trainers fetching the same
         shard every round, the host materialization + encode happens
@@ -761,10 +978,9 @@ class VariableServer:
             return ent[1]
         # materialize INSIDE the lock: a concurrent async-mode apply
         # donates the param's device buffer, invalidating it
-        val = self.scope.find_var(name)
-        from paddle_tpu.core.selected_rows import SelectedRows
-        if not isinstance(val, SelectedRows):
-            val = np.asarray(val)
+        val = self._read_var_locked(name, ctx)
+        if val is None:
+            return []
         parts = _enc_tensor_parts(name, val)
         self._reply_cache[name] = (key, parts)
         return parts
@@ -869,11 +1085,25 @@ class VariableServer:
         name, ids, round_ = _dec_tensor(req)
         with self._cv:
             if self.sync_mode:
+                eff = round_ - self.staleness
                 if not self._wait_cv(
-                        lambda: self._applied_round >= round_, ctx):
+                        lambda: self._applied_round >= eff, ctx):
                     return b""
+            # reader side of the per-shard fence: never gather while
+            # the table's own optimize block is dispatching (the jit
+            # call owns — and will delete — the scope buffer)
+            if not self._wait_cv(
+                    lambda: name not in self._shard_applying, ctx):
+                return b""
+            self._shard_readers[name] = \
+                self._shard_readers.get(name, 0) + 1
+        try:
             table = np.asarray(self.scope.find_var(name))
-        rows = table[np.asarray(ids, np.int64)]
+            rows = table[np.asarray(ids, np.int64)]
+        finally:
+            with self._cv:
+                self._shard_readers[name] -= 1
+                self._cv.notify_all()
         return _enc_tensor(name, rows)
 
     def _fetch_barrier(self, req, ctx=None):
@@ -886,17 +1116,24 @@ class VariableServer:
 
         with self._cv:
             arrived = sorted(
-                self._senders[s]["label"] for s in self._barrier_senders
-                if s in self._senders)
+                self._senders[s]["label"]
+                for s, r in self._barrier_rounds.items()
+                if r >= self._applied_round and s in self._senders)
             known = sorted(
                 ent["label"] for s, ent in self._senders.items()
                 if s not in self._expired)
+            sender_rounds = {
+                self._senders[s]["label"]: r
+                for s, r in self._barrier_rounds.items()
+                if s in self._senders}
             return json.dumps({
                 "applied_round": self._applied_round,
                 "durable_round": self._durable_round,
                 "alive": self._alive,
                 "fanin": self.fanin_total,
                 "barriers": self._barrier_count(),
+                "staleness": self.staleness,
+                "sender_rounds": sender_rounds,
                 "arrived": arrived,
                 "known": known,
                 "waiting_for": sorted(set(known) - set(arrived)),
@@ -951,6 +1188,28 @@ class VariableServer:
                 else:
                     self._alive -= 1
             if self._alive <= 0:
+                # drain before shutdown: under bounded staleness the
+                # last k rounds can still be pending when the final
+                # complete arrives — every completed sender barriered
+                # them, so finish the in-flight apply and run the rest
+                # now (at k=0 every acked round already applied and
+                # this loop is a no-op)
+                while True:
+                    if self._applying:
+                        # the apply worker owns a round right now
+                        # (lock released around its optimize blocks) —
+                        # wait it out, then re-check for more
+                        self._cv.wait(timeout=0.05)
+                        continue
+                    if self._barrier_max() < self._applied_round:
+                        break
+                    self._apply_round()
+                    if (self.checkpoint_every_n and self.checkpoint_dir
+                            and self._applied_round
+                            % self.checkpoint_every_n == 0):
+                        snapshot = self._collect_state()
+                    else:
+                        self._durable_round = self._applied_round
                 self._shutdown.set()
             else:
                 # stragglers of a half-round: apply what arrived
@@ -960,15 +1219,22 @@ class VariableServer:
         return b""
 
     # -- application (lock held) --
-    def _aggregate_locked(self, gname):
-        """Mean the pending grads for ``gname`` and clear them (lock
-        held); None when nothing arrived this round."""
+    def _aggregate_locked(self, gname, upto=None):
+        """Mean the pending grads for ``gname`` with round <= ``upto``
+        (None = everything) and remove them (lock held); None when
+        nothing arrived this round.  Later rounds' entries — a fast
+        trainer running ahead under bounded staleness — stay pending
+        for THEIR round's apply."""
         from paddle_tpu.core.selected_rows import SelectedRows
 
-        vals = list(self._pending[gname].values())
+        ent = self._pending[gname]
+        if upto is None:
+            keys = list(ent)
+        else:
+            keys = [k for k in ent if k[0] <= upto]
+        vals = [ent.pop(k) for k in keys]
         if not vals:
             return None
-        self._pending[gname] = {}
         if any(isinstance(v, SelectedRows) for v in vals):
             # mean of sparse grads = concatenated rows, values / N
             # (scatter-add makes concatenation a sum)
@@ -998,6 +1264,13 @@ class VariableServer:
             return
         self.scope.set(gname, agg)
         self._invalidate_locked(gname)
+        # same per-shard fence as _apply_round: a concurrent prefetch
+        # gathering from the zero-copy view must finish before this
+        # apply donates the param buffer
+        outs = self.grad_params.get(gname, ())
+        while any(self._shard_readers.get(p) for p in outs) and \
+                not self._shutdown.is_set():
+            self._cv.wait(timeout=0.05)
         self.apply_block(self.grad_to_block[gname])
         self._invalidate_locked(gname)
 
@@ -1008,19 +1281,11 @@ class VariableServer:
         params raise their per-shard completion event the moment its
         apply commits — streamed gathers return them while later
         shards (and the durability write) are still in flight."""
-        if self._barrier_round > self._applied_round:
-            # restarted from a checkpoint OLDER than the trainers'
-            # round (checkpoint_every_n > 1): the skipped rounds' grads
-            # are unrecoverable, so jump to the trainers' round and
-            # count the replayed grads ONCE — bounded staleness instead
-            # of re-applying the same gradients once per missing round
-            self._applied_round = self._barrier_round
         nxt = self._applied_round + 1
-        # correlate with the TRAINER round the barriers named (the
-        # round whose grads this apply consumes), not the server's
-        # 1-based applied counter
-        cid = _rcid(self._barrier_round if self._barrier_round >= 0
-                    else self._applied_round)
+        # correlate with the TRAINER round whose grads this apply
+        # consumes (== the applied counter: trainer rounds are 0-based)
+        consume = self._applied_round
+        cid = _rcid(consume)
         sp = _TRC.begin("pserver.apply_round", cid,
                         {"senders": self._barrier_count()}) \
             if _TRC.on else None
@@ -1028,10 +1293,19 @@ class VariableServer:
         self._apply_target = nxt
         try:
             for g in self.grad_to_block:
-                agg = self._aggregate_locked(g)
+                agg = self._aggregate_locked(g, upto=consume)
                 if agg is not None:
                     self.scope.set(g, agg)
                     self._invalidate_locked(g)
+                    # writer side of the per-shard fence: wait out any
+                    # in-flight row gathers of this shard's params,
+                    # then mark them applying for the donation window
+                    outs = self.grad_params.get(g, ())
+                    while any(self._shard_readers.get(p)
+                              for p in outs) and \
+                            not self._shutdown.is_set():
+                        self._cv.wait(timeout=0.05)
+                    self._shard_applying.update(outs)
                     self._cv.release()
                     try:
                         if _TRC.on:
@@ -1042,6 +1316,7 @@ class VariableServer:
                             self.apply_block(self.grad_to_block[g])
                     finally:
                         self._cv.acquire()
+                        self._shard_applying.difference_update(outs)
                     self._invalidate_locked(g)
                 # shard committed (or had nothing to do — its params
                 # already hold the round's values): publish per-shard
@@ -1055,8 +1330,6 @@ class VariableServer:
                 _TRC.end(sp)
         self._applied_round = nxt
         _M_PS_ROUNDS.inc()
-        self._barrier_senders = set()
-        self._barrier_round = -1
         self._legacy_barriers = 0
         self._cv.notify_all()
 
@@ -1088,9 +1361,15 @@ class RPCClient:
         self.retry = RetryPolicy.from_env()
         self._resolver = None     # logical ep -> current physical ep
         self._redirects = {}      # logical ep -> physical ep overrides
-        self._round_cache = {}    # ep -> {"round", "grads", "barriered"}
+        # ep -> {round: {"grads": {name: (arr, seq)}, "barriered"}}.
+        # Rounds > step - (staleness+1) are retained for replay: at
+        # k=0 that is exactly the current round (the PR 4 cache); with
+        # k>0 the k un-acked rounds stay replayable too.
+        self._round_cache = {}
         self._cache_lock = threading.Lock()  # seq + replay cache: the
         #                           batched senders record from threads
+        self._residuals = {}      # (ep, name) -> error-feedback residual
+        self._wire_ver = {}       # ep -> negotiated wire version
         self._barrier_pending = None  # (threads, errs) of in-flight
         #                           overlapped barriers (launch/join)
 
@@ -1103,6 +1382,8 @@ class RPCClient:
     @classmethod
     def reset(cls):
         cls._instance = None
+        from . import hierarchy
+        hierarchy.reset()
 
     def set_resolver(self, fn):
         """Install an endpoint re-resolver (resilience.EndpointResolver
@@ -1165,41 +1446,60 @@ class RPCClient:
     def _record_send(self, ep, name, arr):
         """Cache this round's send for replay; returns its seq.
         Thread-safe: the batched scatter records from per-endpoint
-        sender threads."""
+        sender threads.  Rounds older than the bounded-staleness
+        replay window (step - staleness) are pruned here."""
         seq = self._next_seq()
         with self._cache_lock:
-            c = self._round_cache.get(ep)
-            if c is None or c["round"] != self.step:
-                c = {"round": self.step, "grads": {}, "barriered": False}
-                self._round_cache[ep] = c
+            eph = self._round_cache.setdefault(ep, {})
+            c = eph.get(self.step)
+            if c is None:
+                c = eph[self.step] = {"grads": {}, "barriered": False}
+                keep = self.step - max(0, int(FLAGS.dist_staleness))
+                for r in [r for r in eph if r < keep]:
+                    del eph[r]
             # latest value per name: a round resend replaces, never
             # appends
             c["grads"][name] = (arr, seq)
         return seq
 
+    def _recorded(self, ep, name, round_=None):
+        """The cached (arr, seq) of this round's send of ``name`` to
+        ``ep``, or None.  The cached value is post-codec, so a replay
+        or retry ships bit-identical frames."""
+        with self._cache_lock:
+            c = self._round_cache.get(ep, {}).get(
+                self.step if round_ is None else round_)
+            return c["grads"].get(name) if c else None
+
     def _barrier_payload(self, round_):
         return _enc_msg(self.label, _pack_round_sender(round_, self.sender))
 
     def _replay_round(self, ep):
-        """After a reconnect the server may have restarted and lost this
-        round's un-applied state: resend the cached grads (the server
-        dedups by sender+seq, so this is idempotent) and, if this
-        trainer already barriered the round, the barrier too."""
-        c = self._round_cache.get(ep)
-        if not c:
+        """After a reconnect the server may have restarted and lost its
+        un-applied state: resend every retained round's cached grads
+        oldest-first (the server dedups by sender+seq, so this is
+        idempotent) and, where this trainer already barriered a round,
+        the barrier too.  At staleness 0 exactly one round is retained
+        — the PR 4 behavior."""
+        with self._cache_lock:
+            eph = {r: {"grads": dict(c["grads"]),
+                       "barriered": c["barriered"]}
+                   for r, c in (self._round_cache.get(ep) or {}).items()}
+        if not eph:
             return
         _M_REPLAYS.inc()
         to = self.retry.call_timeout
-        for name, (arr, seq) in c["grads"].items():
-            self._call(
-                ep, "SendVariable",
-                _enc_tensor(name, arr,
-                            _pack_round_sender(c["round"], self.sender,
-                                               seq)),
-                timeout=to)
-        if c["barriered"]:
-            self._call(ep, "SendBarrier", self._barrier_payload(c["round"]),
-                       timeout=to)
+        for r in sorted(eph):
+            c = eph[r]
+            for name, (arr, seq) in c["grads"].items():
+                self._call(
+                    ep, "SendVariable",
+                    _enc_tensor(name, arr,
+                                _pack_round_sender(r, self.sender, seq)),
+                    timeout=to)
+            if c["barriered"]:
+                self._call(ep, "SendBarrier", self._barrier_payload(r),
+                           timeout=to)
 
     def _retry_op(self, ep, method, payload, point=None, replay=False,
                   decode=False):
@@ -1221,10 +1521,87 @@ class RPCClient:
             attempt, describe="%s(%s)" % (method, ep), on_retry=on_retry)
         return _dec_tensor(reply)[1] if decode else reply
 
+    # -- compression (wire v2) ----------------------------------------
+    def wire_version(self, ep):
+        """Negotiated wire version of ``ep``, probed once (WireVersion
+        RPC).  An old server has no such method — the UNIMPLEMENTED
+        reply pins the endpoint to v1 (raw frames); a TRANSIENT failure
+        is not cached, so the next round re-probes."""
+        v = self._wire_ver.get(ep)
+        if v is not None:
+            return v
+        try:
+            reply = self._call(ep, "WireVersion", b"",
+                               timeout=self.retry.call_timeout)
+            _, v = _dec_msg(reply)
+            v = int(v)
+        except Exception as e:
+            v = 1
+            if RetryPolicy.is_retryable(e):
+                return v          # transient: do not pin the endpoint
+        self._wire_ver[ep] = v
+        return v
+
+    def _prep_send(self, ep, name, arr):
+        """Host conversion + fault-lab corruption + the negotiated
+        codec (FLAGS_dist_compress) with trainer-side error feedback.
+        Called exactly once per (ep, name, round) — _prep_and_record
+        guards re-entry via the replay cache, so residual updates never
+        double-apply under retries."""
+        arr = self._to_host(arr)
+        arr = _maybe_corrupt("send_grad", self.step, arr)
+        mode = FLAGS.dist_compress
+        raw_nb = _czip.wire_nbytes(arr)
+        _M_WIRE_RAW.inc(raw_nb)
+        if not mode or self.wire_version(ep) < 2:
+            _M_WIRE_COMP.inc(raw_nb)
+            return arr
+        t0 = time.perf_counter()
+        from paddle_tpu.core.selected_rows import SelectedRows
+
+        if not isinstance(arr, SelectedRows) and mode in ("int8", "topk") \
+                and np.asarray(arr).dtype in (np.float32, np.float64) \
+                and np.asarray(arr).size >= _czip.MIN_COMPRESS_ELEMS:
+            # error feedback: fold the previous rounds' quantization
+            # residual into this grad, then keep what THIS encode
+            # dropped — the bias cancels across steps instead of
+            # compounding (Lin et al., DGC)
+            key = (ep, name)
+            with self._cache_lock:
+                res = self._residuals.get(key)
+            base = np.asarray(arr)
+            eff = base + res if res is not None \
+                and res.shape == base.shape else base
+            out = _czip.compress(eff, mode, FLAGS.dist_topk_ratio)
+            if isinstance(out, Compressed):
+                with self._cache_lock:
+                    self._residuals[key] = np.asarray(
+                        eff - _czip.decompress(out), np.float32)
+        else:
+            out = _czip.compress(arr, mode, FLAGS.dist_topk_ratio)
+        _M_COMPRESS_MS.observe((time.perf_counter() - t0) * 1e3)
+        _M_WIRE_COMP.inc(_czip.wire_nbytes(out))
+        return out
+
+    def _prep_and_record(self, ep, name, arr, reuse=False):
+        """(wire-ready value, seq) for one outbound grad.  With
+        ``reuse`` (the RETRY paths) a value already recorded for this
+        round is returned verbatim — the resend ships the SAME
+        post-codec bytes under the same seq, and error-feedback state
+        advances exactly once per round.  A fresh send (reuse=False)
+        always re-runs the codec and REPLACES the round-cache entry
+        under a new seq — async mode re-sends the same grad name every
+        step within one client round."""
+        if reuse:
+            rec = self._recorded(ep, name)
+            if rec is not None:
+                return rec
+        out = self._prep_send(ep, name, arr)
+        return out, self._record_send(ep, name, out)
+
     # -- data plane ---------------------------------------------------
     def send_var(self, ep, name, arr):
-        arr = _maybe_corrupt("send_grad", self.step, arr)
-        seq = self._record_send(ep, name, arr)
+        arr, seq = self._prep_and_record(ep, name, arr)
         self._retry_op(
             ep, "SendVariable",
             _enc_tensor(name, arr, _pack_round_sender(self.step,
@@ -1375,7 +1752,22 @@ class RPCClient:
         sender, seq) identity so replay dedup is unchanged.  Values may
         still be device arrays — conversion happens in the sender
         threads.  FLAGS_pserver_wire_batch=0 restores the per-variable
-        wire."""
+        wire.
+
+        With FLAGS_dist_hier_local set (hierarchical aggregation),
+        grads detour through the host-local group leader: followers
+        ship them over the loopback channel, the leader stashes its own
+        in-process — the pserver upload happens once per group, at
+        barrier time (``_hier_upload``)."""
+        from . import hierarchy
+        if hierarchy.enabled():
+            if hierarchy.role().leader:
+                return hierarchy.leader_stash(self, triples)
+            return hierarchy.follower_send(self, triples)
+        return self._send_vars_wire(triples)
+
+    def _send_vars_wire(self, triples):
+        """The pserver-facing send fan-out (post any hierarchy detour)."""
         if not _TRC.on:
             return self._send_vars_impl(triples)
         sp = _TRC.begin("rpc.send_vars", _rcid(self.step),
@@ -1398,12 +1790,10 @@ class RPCClient:
             fault_point("send_grad")
             frames = []
             for name, arr in items:
-                arr = self._to_host(arr)
-                # numerics crash lab (ISSUE 8): a corrupt rule poisons
-                # the wire copy BEFORE it is cached, so replays of the
-                # poisoned round stay bit-identical
-                arr = _maybe_corrupt("send_grad", self.step, arr)
-                seq = self._record_send(ep, name, arr)
+                # _prep_and_record: host convert + corrupt-lab poison +
+                # negotiated codec, all BEFORE the replay cache records
+                # the value — replays of the round stay bit-identical
+                arr, seq = self._prep_and_record(ep, name, arr)
                 frames.append(_enc_tensor_parts(
                     name, arr,
                     _pack_round_sender(self.step, self.sender, seq)))
@@ -1449,16 +1839,12 @@ class RPCClient:
             # that WERE recorded reuse their original (arr, seq), so a
             # duplicate delivery stays dedup-able.
             frames = []
-            with self._cache_lock:
-                c = self._round_cache.get(ep)
-                recorded = {} if c is None or c["round"] != self.step \
-                    else dict(c["grads"])
             for name, arr in by_ep[ep]:
-                if name in recorded:
-                    arr, seq = recorded[name]
-                else:
-                    arr = self._to_host(arr)
-                    seq = self._record_send(ep, name, arr)
+                # tensors that WERE recorded reuse their original
+                # (post-codec arr, seq) so a duplicate delivery stays
+                # dedup-able; unrecorded ones run the codec now
+                arr, seq = self._prep_and_record(ep, name, arr,
+                                                 reuse=True)
                 frames.append(_enc_tensor_parts(
                     name, arr,
                     _pack_round_sender(self.step, self.sender, seq)))
@@ -1474,9 +1860,7 @@ class RPCClient:
         FLAGS_pserver_wire_batch=0."""
         payloads = []
         for ep, name, arr in triples:
-            arr = self._to_host(arr)
-            arr = _maybe_corrupt("send_grad", self.step, arr)
-            seq = self._record_send(ep, name, arr)
+            arr, seq = self._prep_and_record(ep, name, arr)
             payloads.append(_enc_tensor(
                 name, arr,
                 _pack_round_sender(self.step, self.sender, seq)))
@@ -1651,20 +2035,46 @@ class RPCClient:
 
     def prefetch_vars(self, triples, round_=None):
         """Overlapped row prefetches: [(ep, block_name, local_ids)] ->
-        [rows] (reference AsyncPrefetchVar + Wait)."""
+        [rows] (reference AsyncPrefetchVar + Wait).  Rides the fastwire
+        data plane (a CTR-shaped step prefetches tens of MB of
+        embedding rows); reads are idempotent, so the gRPC fallback
+        re-fetch is always safe."""
         round_ = self.step if round_ is None else round_
         replies = self._overlapped(
             "PrefetchVariable", "prefetch", [t[0] for t in triples],
             [_enc_tensor(name, np.asarray(ids, np.int64), round_)
              for _, name, ids in triples],
-            replay=False, use_fast=False)
+            replay=False)
         return [_dec_tensor(r)[1] for r in replies]
+
+    def _hier_round_start(self):
+        """Hierarchical-aggregation hook at the trainer's barrier.
+        Returns True when this client handled the round locally (a
+        FOLLOWER: barrier signaled to the group leader, local round
+        advanced — no pserver barrier).  A LEADER flushes the group's
+        pre-reduced grads to the pservers here (ONE upload per group,
+        through the normal compressed/recorded send path) and then
+        falls through to the real barrier."""
+        from . import hierarchy
+        if not hierarchy.enabled():
+            return False
+        if not hierarchy.role().leader:
+            hierarchy.follower_barrier(self)
+            self.step += 1
+            _M_TRAINER_ROUNDS.inc()
+            return True
+        triples = hierarchy.leader_flush(self)
+        if triples:
+            self._send_vars_wire(triples)
+        return False
 
     def send_barrier(self, eps):
         """Barrier every pserver CONCURRENTLY: the server-side barrier
         now blocks until the round is applied (and durably checkpointed
         on checkpoint rounds), so sequential calls across endpoints
         could deadlock if trainers ordered them differently."""
+        if self._hier_round_start():
+            return
         payload = self._barrier_payload(self.step)
         round_ = self.step
         errs = []
@@ -1679,9 +2089,10 @@ class RPCClient:
                 finally:
                     if sp is not None:
                         _TRC.end(sp)
-                c = self._round_cache.get(ep)
-                if c is not None and c["round"] == self.step:
-                    c["barriered"] = True
+                with self._cache_lock:
+                    c = self._round_cache.get(ep, {}).get(round_)
+                    if c is not None:
+                        c["barriered"] = True
             except Exception as e:
                 errs.append(e)
 
@@ -1705,6 +2116,8 @@ class RPCClient:
         collects acks/errors before the next round's sends, preserving
         the ack-implies-durable contract at the round boundary."""
         self.join_barriers()   # defensive: never two rounds in flight
+        if self._hier_round_start():
+            return
         payload = self._barrier_payload(self.step)
         round_ = self.step
         errs = []
@@ -1721,8 +2134,8 @@ class RPCClient:
                     if sp is not None:
                         _TRC.end(sp)
                 with self._cache_lock:
-                    c = self._round_cache.get(ep)
-                    if c is not None and c["round"] == round_:
+                    c = self._round_cache.get(ep, {}).get(round_)
+                    if c is not None:
                         c["barriered"] = True
             except Exception as e:
                 errs.append(e)
@@ -1767,6 +2180,25 @@ class RPCClient:
                        _enc_msg(profile_path, 1 if on else 0))
 
     def send_complete(self, eps):
+        # hierarchical mode: followers complete to their group leader
+        # (the pserver's fanin counts GROUPS); the leader waits for its
+        # followers so the single group completion is really last
+        from . import hierarchy
+        if hierarchy.enabled():
+            if not hierarchy.role().leader:
+                # followers NEVER complete to the pservers — Fanin
+                # counts groups, and a follower's SendComplete would
+                # decrement the server's fanin under the still-running
+                # leader.  Best-effort like the sends below.
+                try:
+                    hierarchy.follower_complete(self)
+                except Exception:
+                    pass
+                return
+            try:
+                hierarchy.leader_wait_complete(self)
+            except Exception:
+                pass   # completion is best-effort, like the sends below
         # identity payload: the server must not double-decrement its
         # fanin for a trainer the lease already expired, and must drop
         # a duplicate complete from the same process

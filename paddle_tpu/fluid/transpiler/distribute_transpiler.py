@@ -107,6 +107,23 @@ class DistributeTranspiler:
         self.trainer_id = trainer_id
         self.trainer_num = trainers
         self.sync_mode = sync_mode
+        # hierarchical aggregation (ISSUE 10): with L trainers per host
+        # group pre-reducing through their leader, the pserver's sync
+        # fanin is the number of GROUPS — one upload + one barrier per
+        # group per round.  Equal group sizes keep mean-over-groups ==
+        # mean-over-trainers, so uneven grouping is refused here.
+        from paddle_tpu.core.flags import FLAGS as _CORE_FLAGS
+        hier = int(_CORE_FLAGS.dist_hier_local or 0)
+        if hier > 1:
+            if trainers % hier != 0:
+                raise ValueError(
+                    "FLAGS_dist_hier_local=%d must divide trainers=%d "
+                    "(equal host groups keep the hierarchical mean "
+                    "exact)" % (hier, trainers))
+            self.effective_fanin = trainers // hier
+        else:
+            self.effective_fanin = trainers
+        self.staleness = int(_CORE_FLAGS.dist_staleness or 0)
         self.origin_program = program or default_main_program()
         self.startup_program = startup_program or default_startup_program()
         self.pserver_endpoints = [e.strip() for e in pservers.split(",")
@@ -324,8 +341,9 @@ class DistributeTranspiler:
         gb.append_op(
             type="listen_and_serv", inputs={}, outputs={},
             attrs={"endpoint": endpoint,
-                   "Fanin": self.trainer_num,
+                   "Fanin": self.effective_fanin,
                    "sync_mode": self.sync_mode,
+                   "staleness": self.staleness,
                    "grad_to_block_id": grad_to_block_id},
             infer_shape=False)
         prog._pserver_var_origin = ep_var_origin

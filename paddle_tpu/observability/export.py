@@ -254,6 +254,55 @@ def format_numerics_table(rows):
     return "\n".join(out)
 
 
+def wire_rows(dumps):
+    """Pserver wire/compression rollup (ISSUE 10 satellite): per
+    process dump, the outbound grad bytes before/after the negotiated
+    codec (equal when compression is off), the codec's encode-time
+    distribution, fastwire socket traffic, and the bounded-staleness
+    barrier spread.  Works on any trace dump — the always-on metrics
+    snapshot rides every one."""
+    rows = []
+    for d in dumps:
+        m = d.get("metrics", {})
+
+        def val(name, default=0):
+            return (m.get(name) or {}).get("value", default)
+
+        raw = val("wire_bytes_raw_total")
+        comp = val("wire_bytes_compressed_total")
+        ch = m.get("compress_ms", {})
+        rows.append({
+            "label": d.get("label", "?"),
+            "grad_bytes_raw": raw,
+            "grad_bytes_compressed": comp,
+            "compression_ratio": round(raw / comp, 2) if comp else 1.0,
+            "compress_ms_p50": round(ch.get("p50", 0.0), 3),
+            "compress_ms_p99": round(ch.get("p99", 0.0), 3),
+            "compress_count": ch.get("count", 0),
+            "fastwire_tx": val("fastwire_bytes_sent_total"),
+            "fastwire_rx": val("fastwire_bytes_recv_total"),
+            "staleness_gap": val("pserver_staleness_gap"),
+            "replays": val("rpc_round_replays_total"),
+            "dedup_drops": val("pserver_dedup_drops_total"),
+        })
+    rows.sort(key=lambda r: r["label"])
+    return rows
+
+
+def format_wire_table(rows):
+    out = ["%-24s %12s %12s %6s %9s %9s %12s %12s %6s" % (
+        "process", "grad_raw_B", "grad_wire_B", "ratio", "czip_p50",
+        "czip_p99", "fastwire_tx", "fastwire_rx", "stale")]
+    for r in rows:
+        out.append("%-24s %12d %12d %6.2f %9.3f %9.3f %12d %12d %6d"
+                   % (r["label"][:24], r["grad_bytes_raw"],
+                      r["grad_bytes_compressed"],
+                      r["compression_ratio"], r["compress_ms_p50"],
+                      r["compress_ms_p99"], r["fastwire_tx"],
+                      r["fastwire_rx"], r["staleness_gap"]))
+    return "\n".join(out)
+
+
 def format_phase_table(rows, top=0):
     out = ["%-32s %7s %10s %9s %9s %9s %7s" % (
         "phase", "count", "total_ms", "mean_ms", "p50_ms", "p99_ms",

@@ -1,0 +1,735 @@
+"""Compressed fastwire frames, bounded staleness, and hierarchical
+aggregation (ISSUE 10).
+
+In-process contracts (real VariableServer + RPCClient over real
+sockets, no spawned trainers), mirroring test_pserver_dataplane.py:
+
+- per-codec round-trip bounds (fp16 bit-exact on representables, int8
+  bounded by the chunk scale, topk exact on the kept entries, rows
+  exact ids);
+- error-feedback convergence: N SGD steps under int8/topk track the
+  uncompressed trajectory;
+- wire-version negotiation: a server without WireVersion pins the
+  endpoint to raw frames and training still works;
+- replay/duplicate idempotence holds verbatim on compressed frames
+  (the replay cache stores POST-codec values);
+- bounded staleness: k=0 is bit-exact lockstep, k=1 lets the trainer
+  run exactly one round ahead and drains pending rounds at shutdown;
+- hierarchical aggregation: the group-local mean equals the flat sync
+  mean, duplicate sparse rows merge, and the pserver sees one sender.
+"""
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from paddle_tpu.core.scope import Scope
+from paddle_tpu.core.selected_rows import SelectedRows
+from paddle_tpu.distributed import compress as czip
+from paddle_tpu.distributed.resilience import FLAGS, install_faults
+from paddle_tpu.distributed.rpc import (RPCClient, VariableServer,
+                                        _dec_tensor, _enc_tensor)
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    install_faults("")
+    prev = (FLAGS.dist_compress, FLAGS.dist_staleness,
+            FLAGS.dist_hier_local, FLAGS.dist_topk_ratio)
+    yield
+    install_faults("")
+    (FLAGS.dist_compress, FLAGS.dist_staleness,
+     FLAGS.dist_hier_local, FLAGS.dist_topk_ratio) = prev
+    RPCClient.reset()
+
+
+# ---------------------------------------------------------------------------
+# codec round-trip bounds
+# ---------------------------------------------------------------------------
+
+def test_fp16_bit_exact_on_representable_values():
+    # every value below is exactly representable in fp16
+    a = (np.arange(1024, dtype=np.float32) - 512) * 0.25
+    c = czip.compress(a, "fp16")
+    assert isinstance(c, czip.Compressed)
+    assert c.nbytes == a.nbytes // 2
+    np.testing.assert_array_equal(czip.decompress(c), a)
+
+
+def test_int8_error_bounded_by_chunk_scale():
+    rng = np.random.RandomState(3)
+    a = rng.randn(3, 3000).astype(np.float32) * 5.0
+    c = czip.compress(a, "int8")
+    d = czip.decompress(c)
+    assert d.shape == a.shape and d.dtype == a.dtype
+    # per-chunk scale = absmax/127; rounding error <= scale/2
+    bound = np.abs(a).max() / 127.0 * 0.5 + 1e-7
+    assert float(np.abs(d - a).max()) <= bound
+    assert c.nbytes < a.nbytes / 3.5   # >= 3.5x smaller
+
+
+def test_topk_keeps_exactly_the_largest_entries():
+    rng = np.random.RandomState(4)
+    a = rng.randn(4000).astype(np.float32)
+    c = czip.compress(a, "topk", topk_ratio=0.01)
+    d = czip.decompress(c)
+    k = max(1, int(round(0.01 * a.size)))
+    kept = np.argsort(np.abs(a))[-k:]
+    np.testing.assert_array_equal(d[np.sort(kept)], a[np.sort(kept)])
+    mask = np.ones(a.size, bool)
+    mask[kept] = False
+    assert not d[mask].any()
+    assert c.nbytes < a.nbytes / 10    # >= 10x smaller at 1%
+
+
+def test_rows_codec_ids_exact_values_bounded():
+    rng = np.random.RandomState(5)
+    rows = rng.randint(0, 10**7, 700).astype(np.int64)
+    vals = rng.randn(700, 8).astype(np.float32)
+    sr = SelectedRows(rows, vals, 10**7)
+    c = czip.compress(sr, "int8")
+    d = czip.decompress(c)
+    order = np.argsort(rows, kind="stable")
+    np.testing.assert_array_equal(np.asarray(d.rows), rows[order])
+    per_row_bound = (np.abs(vals).max(axis=1, keepdims=True) / 127.0
+                     * 0.5 + 1e-7)
+    assert np.all(np.abs(np.asarray(d.values) - vals[order])
+                  <= per_row_bound[order])
+    assert d.height == sr.height
+
+
+def test_tiny_and_integer_tensors_ship_raw():
+    small = np.ones(7, np.float32)
+    assert czip.compress(small, "int8") is small
+    ints = np.arange(4096, dtype=np.int64)
+    assert czip.compress(ints, "topk") is ints
+
+
+def test_wire_frame_roundtrip_compressed():
+    rng = np.random.RandomState(6)
+    a = rng.randn(2048).astype(np.float32)
+    payload = _enc_tensor("g", czip.compress(a, "int8"), 42)
+    name, val, extra = _dec_tensor(payload)
+    assert name == "g" and extra == 42
+    assert val.shape == a.shape
+    assert float(np.abs(val - a).max()) <= np.abs(a).max() / 127.0
+
+
+# ---------------------------------------------------------------------------
+# live-server harness
+# ---------------------------------------------------------------------------
+
+def _sgd_server(scope, grads_to_params, fanin, lr=1.0, **kw):
+    items = list(grads_to_params.items())
+
+    def apply_block(bid):
+        g, p = items[bid]
+        gv = scope.find_var(g)
+        pv = np.array(np.asarray(scope.find_var(p)), copy=True)
+        if isinstance(gv, SelectedRows):
+            np.subtract.at(pv, np.asarray(gv.rows),
+                           lr * np.asarray(gv.values))
+        else:
+            pv -= lr * np.asarray(gv)
+        scope.set(p, pv)
+
+    srv = VariableServer(
+        scope, {g: i for i, (g, _) in enumerate(items)}, apply_block,
+        fanin=fanin, grad_params={g: (p,) for g, p in items}, **kw)
+    port = srv.start("127.0.0.1:0")
+    return srv, "127.0.0.1:%d" % port
+
+
+def _quadratic_descent(mode, steps=12, lr=0.05, topk_ratio=None):
+    """Minimize ||w||^2 via the real wire: grad = 2w shipped per round
+    under ``mode``; returns the loss trajectory."""
+    FLAGS.dist_compress = mode
+    if topk_ratio is not None:
+        FLAGS.dist_topk_ratio = topk_ratio
+    scope = Scope()
+    rng = np.random.RandomState(11)
+    w0 = rng.randn(40, 40).astype(np.float32)
+    scope.set("p", w0.copy())
+    srv, ep = _sgd_server(scope, {"g": "p"}, fanin=1, lr=lr)
+    RPCClient.reset()
+    cli = RPCClient.instance()
+    losses = []
+    try:
+        w = w0.copy()
+        for r in range(steps):
+            losses.append(float((w * w).sum()))
+            cli.send_vars([(ep, "g", 2.0 * w)])
+            cli.send_barrier([ep])
+            got, = cli.get_vars([(ep, "p")])
+            w = np.array(np.asarray(got), copy=True)
+    finally:
+        cli.send_complete([ep])
+        srv.wait()
+    FLAGS.dist_compress = ""
+    return np.array(losses)
+
+
+def test_error_feedback_convergence_parity_int8():
+    """N SGD steps under int8 with error feedback must track the
+    uncompressed trajectory: same monotone descent, final loss within
+    15% (the EF residual cancels quantization bias — without it int8
+    stalls an order of magnitude higher)."""
+    ref = _quadratic_descent("")
+    got = _quadratic_descent("int8")
+    assert got[-1] < got[0] * 0.35          # it actually descends
+    assert got[-1] <= ref[-1] * 1.15 + 1e-3  # and tracks the exact path
+
+
+def test_error_feedback_convergence_parity_topk():
+    """Top-k at 20% with error feedback over a longer horizon: every
+    coordinate's update eventually ships (the residual carries what the
+    sparsifier dropped), so the loss keeps descending toward the exact
+    trajectory instead of freezing the never-selected coordinates."""
+    steps = 30
+    ref = _quadratic_descent("", steps=steps)
+    got = _quadratic_descent("topk", steps=steps, topk_ratio=0.2)
+    assert got[-1] < got[0] * 0.05          # deep descent, not a stall
+    assert got[-1] <= ref[-1] * 4 + 1e-2    # within sight of exact SGD
+
+
+def test_error_feedback_residual_accumulates():
+    """The trainer-side residual is what cancels the bias: after a
+    compressed send, the client holds exactly (grad - decoded)."""
+    FLAGS.dist_compress = "topk"
+    scope = Scope()
+    scope.set("p", np.zeros(2048, np.float32))
+    srv, ep = _sgd_server(scope, {"g": "p"}, fanin=1)
+    RPCClient.reset()
+    cli = RPCClient.instance()
+    try:
+        rng = np.random.RandomState(7)
+        g = rng.randn(2048).astype(np.float32)
+        cli.send_vars([(ep, "g", g)])
+        res = cli._residuals[(ep, "g")]
+        # residual + what the server received == the full gradient
+        cli.send_barrier([ep])
+        got, = cli.get_vars([(ep, "p")])
+        np.testing.assert_allclose(-np.asarray(got) + res, g,
+                                   rtol=1e-6, atol=1e-6)
+    finally:
+        cli.send_complete([ep])
+        srv.wait()
+
+
+def test_compressed_replay_and_duplicates_are_idempotent():
+    """PR 1's dedup/replay semantics hold verbatim on compressed
+    frames: a duplicated batch and a full round replay ship the SAME
+    cached post-codec bytes and the sync mean counts each trainer
+    once."""
+    FLAGS.dist_compress = "int8"
+    scope = Scope()
+    scope.set("p1", np.zeros(1024, np.float32))
+    srv, ep = _sgd_server(scope, {"g1": "p1"}, fanin=2)
+    RPCClient.reset()
+    a, b = RPCClient.instance(), RPCClient()
+    try:
+        ga = np.full(1024, 2.0, np.float32)
+        gb = np.full(1024, 4.0, np.float32)
+        a.send_vars([(ep, "g1", ga)])
+        a.send_vars([(ep, "g1", ga)])     # duplicate batch
+        a._replay_round(ep)               # full replay after "reconnect"
+        b.send_vars([(ep, "g1", gb)])
+        ts = [threading.Thread(target=c.send_barrier, args=([ep],))
+              for c in (a, b)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        p1, = a.get_vars([(ep, "p1")])
+        # constant grads quantize exactly: mean(2, 4) applied once
+        np.testing.assert_allclose(np.asarray(p1),
+                                   np.full(1024, -3.0))
+    finally:
+        a.send_complete([ep])
+        b.send_complete([ep])
+        srv.wait()
+
+
+# ---------------------------------------------------------------------------
+# wire-version negotiation
+# ---------------------------------------------------------------------------
+
+class _OldWireServer(VariableServer):
+    """A pre-v2 server: the WireVersion method errors like an
+    unimplemented handler, and a kind-2 frame would be undecodable —
+    the client must pin the endpoint to raw frames."""
+
+    def _wire_version(self, req, ctx=None):
+        raise RuntimeError("Method not found!")
+
+
+def test_negotiation_falls_back_to_raw_against_old_server():
+    FLAGS.dist_compress = "int8"
+    scope = Scope()
+    scope.set("p1", np.zeros(1024, np.float32))
+    items = [("g1", "p1")]
+
+    def apply_block(bid):
+        scope.set("p1", np.asarray(scope.find_var("p1"))
+                  - np.asarray(scope.find_var("g1")))
+
+    srv = _OldWireServer(scope, {"g1": 0}, apply_block, fanin=1,
+                         grad_params={"g1": ("p1",)})
+    ep = "127.0.0.1:%d" % srv.start("127.0.0.1:0")
+    RPCClient.reset()
+    cli = RPCClient.instance()
+    try:
+        g = np.linspace(-1, 1, 1024).astype(np.float32)
+        cli.send_vars([(ep, "g1", g)])
+        assert cli.wire_version(ep) == 1   # pinned to raw
+        cli.send_barrier([ep])
+        p1, = cli.get_vars([(ep, "p1")])
+        # raw frames: BIT-exact, no quantization anywhere
+        np.testing.assert_array_equal(np.asarray(p1), -g)
+        # no compressed bytes were recorded for this client
+        raw, seq = cli._recorded(ep, "g1", round_=0)
+        assert isinstance(raw, np.ndarray)
+    finally:
+        cli.send_complete([ep])
+        srv.wait()
+
+
+def test_new_server_advertises_v2_and_codecs():
+    scope = Scope()
+    scope.set("p1", np.zeros(4, np.float32))
+    srv, ep = _sgd_server(scope, {"g1": "p1"}, fanin=1)
+    RPCClient.reset()
+    cli = RPCClient.instance()
+    try:
+        assert cli.wire_version(ep) == 2
+    finally:
+        cli.send_complete([ep])
+        srv.wait()
+
+
+# ---------------------------------------------------------------------------
+# bounded staleness
+# ---------------------------------------------------------------------------
+
+def _run_rounds(staleness, rounds=3, compress=""):
+    """Two clients x N sync rounds against a 2-shard server; returns
+    the fetched params per round (the test_pserver_dataplane harness
+    with a staleness knob)."""
+    FLAGS.dist_compress = compress
+    FLAGS.dist_staleness = staleness
+    scope = Scope()
+    scope.set("p1", np.zeros((8, 4), np.float32))
+    scope.set("p2", np.zeros((50, 8), np.float32))
+    srv, ep = _sgd_server(scope, {"g1": "p1", "g2": "p2"}, fanin=2,
+                          staleness=staleness)
+    RPCClient.reset()
+    a, b = RPCClient.instance(), RPCClient()
+    fetched = []
+    try:
+        for r in range(rounds):
+            for cli, k in ((a, 1.0), (b, 3.0)):
+                rows = np.arange(0, 10, 2, dtype=np.int64) + r
+                vals = np.full((5, 8), k, np.float32)
+                cli.send_vars([
+                    (ep, "g1", np.full((8, 4), k * (r + 1), np.float32)),
+                    (ep, "g2", SelectedRows(rows, vals, 50)),
+                ])
+            ts = [threading.Thread(target=c.send_barrier, args=([ep],))
+                  for c in (a, b)]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join()
+            got = a.get_vars([(ep, "p1"), (ep, "p2")])
+            fetched.append([np.array(np.asarray(x), copy=True)
+                            for x in got])
+    finally:
+        a.send_complete([ep])
+        b.send_complete([ep])
+        srv.wait()
+    FLAGS.dist_compress = ""
+    FLAGS.dist_staleness = 0
+    return fetched
+
+
+def test_staleness_zero_bit_exact_with_lockstep_sync():
+    """k=0 (the default) must be BIT-exact with the k-unaware PR 4
+    wire — same pending/barrier bookkeeping, same aggregation order,
+    compressed-off."""
+    k0 = _run_rounds(0)
+    # exact closed form: mean grad of round r is 2*(r+1) for p1
+    expect = 0.0
+    for r, (p1, _) in enumerate(k0):
+        expect -= 2.0 * (r + 1)
+        np.testing.assert_array_equal(p1, np.full((8, 4), expect,
+                                                  np.float32))
+
+
+def test_staleness_k1_runs_ahead_and_converges():
+    """k=1: barrier acks stop gating on the in-flight apply, but the
+    final state after the shutdown drain matches lockstep exactly (the
+    same grads all applied, rounds in order)."""
+    FLAGS.dist_staleness = 1
+    scope = Scope()
+    scope.set("p1", np.zeros(4, np.float32))
+    applied = []
+
+    def apply_block(bid):
+        time.sleep(0.3)
+        applied.append(time.time())
+        scope.set("p1", np.asarray(scope.find_var("p1"))
+                  - np.asarray(scope.find_var("g1")))
+
+    srv = VariableServer(scope, {"g1": 0}, apply_block, fanin=1,
+                         grad_params={"g1": ("p1",)}, staleness=1)
+    ep = "127.0.0.1:%d" % srv.start("127.0.0.1:0")
+    RPCClient.reset()
+    cli = RPCClient.instance()
+    try:
+        cli.send_vars([(ep, "g1", np.ones(4, np.float32))])
+        t0 = time.time()
+        cli.send_barrier([ep])
+        ahead = time.time() - t0
+        cli.send_vars([(ep, "g1", np.ones(4, np.float32))])
+        t0 = time.time()
+        cli.send_barrier([ep])
+        bounded = time.time() - t0
+    finally:
+        cli.send_complete([ep])
+        srv.wait()
+    assert ahead < 0.25, "round 0 ack should not wait for the apply"
+    assert bounded > 0.2, "round 1 ack must wait for round 0 (k=1)"
+    assert len(applied) == 2
+    np.testing.assert_array_equal(np.asarray(scope.find_var("p1")),
+                                  np.full(4, -2.0, np.float32))
+
+
+def test_staleness_gap_gauge_and_status():
+    from paddle_tpu.observability import metrics as obs
+
+    FLAGS.dist_staleness = 2
+    scope = Scope()
+    scope.set("p1", np.zeros(4, np.float32))
+    srv, ep = _sgd_server(scope, {"g1": "p1"}, fanin=2, staleness=2)
+    RPCClient.reset()
+    a, b = RPCClient.instance(), RPCClient()
+    try:
+        # a runs two rounds ahead; b stays at round 0 (no barrier)
+        for _ in range(2):
+            a.send_vars([(ep, "g1", np.ones(4, np.float32))])
+            a.send_barrier([ep])
+        b.send_vars([(ep, "g1", np.ones(4, np.float32))])
+        b.send_barrier([ep])
+        st = a.barrier_status(ep)
+        assert st["staleness"] == 2
+        # both clients share this process's label, so assert the raw
+        # per-sender rounds: a is one round ahead of b
+        assert sorted(srv._barrier_rounds.values()) == [0, 1]
+        assert obs.snapshot()["pserver_staleness_gap"]["value"] >= 1
+    finally:
+        a.send_complete([ep])
+        b.send_complete([ep])
+        srv.wait()
+
+
+def test_stale_complete_does_not_drop_slow_peers_grads():
+    """Regression (review): a fast trainer's SendComplete must not let
+    its persistent high-water barriers stand in for a slower LIVE
+    peer — the pent-up rounds wait for the live peer's own barriers,
+    and its grads count (bounded staleness delays grads <= k, never
+    discards them)."""
+    FLAGS.dist_staleness = 2
+    scope = Scope()
+    scope.set("p1", np.zeros(4, np.float32))
+    srv, ep = _sgd_server(scope, {"g1": "p1"}, fanin=2, staleness=2)
+    RPCClient.reset()
+    a, b = RPCClient.instance(), RPCClient()
+    try:
+        for r in range(2):     # A runs 2 rounds ahead (k=2: acks free)
+            a.send_vars([(ep, "g1", np.full(4, 2.0, np.float32))])
+            a.send_barrier([ep])
+        a.send_complete([ep])
+        time.sleep(0.3)        # the buggy path would rush both rounds
+        for r in range(2):     # B catches up; its grads must count
+            b.send_vars([(ep, "g1", np.full(4, 4.0, np.float32))])
+            b.send_barrier([ep])
+    finally:
+        b.send_complete([ep])
+        srv.wait()
+    # mean(2, 4) applied twice — NOT 2.0-only rounds
+    np.testing.assert_allclose(np.asarray(scope.find_var("p1")),
+                               np.full(4, -6.0))
+
+
+def test_stale_completed_sender_never_counts_toward_live_quorum():
+    """fanin=3 variant (review): with A completed and B barriered, the
+    round must keep waiting for C — A's persistent high-water barrier
+    plus B must NOT satisfy the 2-live quorum, or C's grads would be
+    dedup-dropped when they arrive."""
+    FLAGS.dist_staleness = 2
+    scope = Scope()
+    scope.set("p1", np.zeros(4, np.float32))
+    srv, ep = _sgd_server(scope, {"g1": "p1"}, fanin=3, staleness=2)
+    RPCClient.reset()
+    a, b, c = RPCClient.instance(), RPCClient(), RPCClient()
+    try:
+        for cli, v in ((a, 3.0), (b, 6.0)):
+            cli.send_vars([(ep, "g1", np.full(4, v, np.float32))])
+            cli.send_barrier([ep])
+        a.send_complete([ep])
+        time.sleep(0.4)
+        assert srv._applied_round == 0      # round 0 waits for C
+        c.send_vars([(ep, "g1", np.full(4, 9.0, np.float32))])
+        c.send_barrier([ep])
+    finally:
+        b.send_complete([ep])
+        c.send_complete([ep])
+        srv.wait()
+    # mean(3, 6, 9) applied once — C's grads counted, nothing dropped
+    np.testing.assert_allclose(np.asarray(scope.find_var("p1")),
+                               np.full(4, -6.0))
+
+
+def test_hier_retry_after_eager_ship_is_idempotent():
+    """Regression (review): a follower frame RETRIED after the eager
+    upload already shipped must not resurrect the entry — flush would
+    otherwise upload a 1-contribution 'mean' over the true group
+    mean."""
+    from paddle_tpu.distributed import hierarchy
+
+    shipped = []
+    agg = hierarchy.HostAggregator(2, 0, upload=shipped.extend)
+    try:
+        g_lead = np.full(4, 2.0, np.float32)
+        g_foll = np.full(4, 4.0, np.float32)
+        agg.stash(0, "ep0", "g", g_lead, 100)
+        agg.stash(0, "ep0", "g", g_foll, 101)   # completes -> ships
+        agg._barriers[0] = {101}
+        # the follower's conn dropped mid-reply and it resent BEFORE
+        # the leader's barrier-time flush:
+        agg.stash(0, "ep0", "g", g_foll, 101)
+        stragglers = agg.flush(0, deadline=5.0)
+        assert stragglers == []                 # duplicate ignored
+        assert len(shipped) == 1
+        np.testing.assert_allclose(shipped[0][2], np.full(4, 3.0))
+    finally:
+        agg.stop()
+
+
+def test_staleness_compressed_matches_lockstep_compressed():
+    """k=1 + int8 over constant grads (exactly representable): the
+    per-round fetches may trail by one round, but the final fetched
+    params of the last round match lockstep's trajectory values."""
+    k0 = _run_rounds(0, compress="int8")
+    k1 = _run_rounds(1, compress="int8")
+    # lockstep trajectory values per round
+    vals0 = [p1[0, 0] for p1, _ in k0]
+    # k=1 fetches are each some prefix value of the same trajectory
+    traj = [0.0] + [float(v) for v in vals0]
+    for p1, _ in k1:
+        assert float(p1[0, 0]) in traj
+
+
+# ---------------------------------------------------------------------------
+# hierarchical aggregation
+# ---------------------------------------------------------------------------
+
+@pytest.fixture
+def _hier(monkeypatch):
+    """Route hierarchy.role() through a thread-local so one process can
+    host a leader thread and a follower thread (the real deployment
+    puts them in separate processes with PADDLE_TRAINER_ID set)."""
+    from paddle_tpu.distributed import hierarchy
+
+    tl = threading.local()
+    monkeypatch.setattr(hierarchy, "role",
+                        lambda: hierarchy.Role(tl.tid, 2))
+    import socket
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    FLAGS.dist_hier_port = s.getsockname()[1]
+    s.close()
+    yield tl
+    hierarchy.reset()
+    FLAGS.dist_hier_local = 0
+
+
+def test_hier_group_mean_matches_flat_sync(_hier):
+    """2 trainers through the leader vs 2 trainers flat: identical
+    final params (2-term mean addition is commutative, so the leader's
+    local mean == the server's flat mean bit-for-bit)."""
+    flat = _run_rounds(0)            # hier still off for the reference
+    from paddle_tpu.distributed import hierarchy
+    hierarchy.reset()
+    FLAGS.dist_hier_local = 2        # now route through the leader
+
+    scope = Scope()
+    scope.set("p1", np.zeros((8, 4), np.float32))
+    scope.set("p2", np.zeros((50, 8), np.float32))
+    srv, ep = _sgd_server(scope, {"g1": "p1", "g2": "p2"}, fanin=1)
+    RPCClient.reset()
+    leader, follower = RPCClient.instance(), RPCClient()
+    fetched = []
+    errs = []
+
+    def trainer(cli, tid, k):
+        _hier.tid = tid
+        try:
+            for r in range(3):
+                rows = np.arange(0, 10, 2, dtype=np.int64) + r
+                vals = np.full((5, 8), k, np.float32)
+                cli.send_vars([
+                    (ep, "g1", np.full((8, 4), k * (r + 1),
+                                       np.float32)),
+                    (ep, "g2", SelectedRows(rows, vals, 50)),
+                ])
+                cli.send_barrier([ep])
+                if tid == 0:
+                    got = cli.get_vars([(ep, "p1"), (ep, "p2")])
+                    fetched.append([np.array(np.asarray(x), copy=True)
+                                    for x in got])
+            cli.send_complete([ep])
+        except Exception as e:   # pragma: no cover - surfaced below
+            errs.append(e)
+
+    ts = [threading.Thread(target=trainer, args=(leader, 0, 1.0)),
+          threading.Thread(target=trainer, args=(follower, 1, 3.0))]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    srv.wait()
+    assert not errs, errs
+    assert len(fetched) == 3
+    for (fp1, fp2), (hp1, hp2) in zip(flat, fetched):
+        np.testing.assert_allclose(fp1, hp1, rtol=1e-6, atol=1e-7)
+        np.testing.assert_allclose(fp2, hp2, rtol=1e-6, atol=1e-7)
+
+
+def test_hier_sparse_rows_merge_duplicates(_hier):
+    """Both group members touching the SAME rows: the leader's upload
+    merges them (one row on the wire, summed values)."""
+    from paddle_tpu.distributed import hierarchy
+
+    agg = hierarchy.HostAggregator(2, FLAGS.dist_hier_port + 1)
+    try:
+        rows = np.array([3, 1, 3], np.int64)
+        vals = np.ones((3, 4), np.float32)
+        agg.stash(0, "ep0", "g", SelectedRows(rows, vals, 10), 100)
+        agg.stash(0, "ep0", "g", SelectedRows(rows, 2 * vals, 10), 101)
+        agg._barriers[0] = {101}
+        (ep0, name, merged), = agg.flush(0, deadline=5.0)
+        assert ep0 == "ep0" and name == "g"
+        np.testing.assert_array_equal(np.asarray(merged.rows),
+                                      np.array([1, 3]))
+        # row 1 once per sender, row 3 twice per sender; mean over 2
+        np.testing.assert_allclose(
+            np.asarray(merged.values),
+            np.stack([np.full(4, 1.5), np.full(4, 3.0)]))
+    finally:
+        agg.stop()
+
+
+def test_send_merge_gates_on_duplicate_ratio():
+    """Outbound SelectedRows merging is worth a sort only on
+    head-heavy traffic: near-uniform ids pass through UNTOUCHED (the
+    static row count keeps the pserver's jitted optimize block on one
+    compiled shape — regression: unconditional merging made every
+    round a recompile), duplicate-heavy ids merge by summation."""
+    from paddle_tpu.ops.distributed_ops import _merge_dup_rows
+
+    uniform = SelectedRows(np.arange(8192, dtype=np.int64),
+                           np.ones((8192, 4), np.float32), 10**6)
+    assert _merge_dup_rows(uniform) is uniform
+    hot = SelectedRows(np.zeros(4096, np.int64) + 7,
+                       np.ones((4096, 4), np.float32), 10**6)
+    merged = _merge_dup_rows(hot)
+    np.testing.assert_array_equal(np.asarray(merged.rows), [7])
+    np.testing.assert_allclose(np.asarray(merged.values),
+                               np.full((1, 4), 4096.0))
+
+
+def test_bucket_sparse_grad_pads_to_power_of_two():
+    """Variable-length merged grads bucket to the next power of 2 in
+    the serve loop (sentinel rows == height, zero values — dropped by
+    the scatter), so the jit compiles O(log K) shapes."""
+    from paddle_tpu.ops.distributed_ops import _bucket_sparse_grad
+
+    scope = Scope()
+    scope.set("g", SelectedRows(np.arange(5, dtype=np.int64),
+                                np.ones((5, 3), np.float32), 100))
+    _bucket_sparse_grad(scope, "g")
+    out = scope.find_var("g")
+    assert np.asarray(out.rows).shape == (8,)
+    np.testing.assert_array_equal(np.asarray(out.rows)[5:],
+                                  [100, 100, 100])
+    assert not np.asarray(out.values)[5:].any()
+    # exact power of two: untouched
+    scope.set("g2", SelectedRows(np.arange(8, dtype=np.int64),
+                                 np.ones((8, 3), np.float32), 100))
+    before = scope.find_var("g2")
+    _bucket_sparse_grad(scope, "g2")
+    assert scope.find_var("g2") is before
+
+
+def test_trace_report_wire_rollup_rows():
+    """export.wire_rows: the ISSUE 10 counters surface per process
+    dump (compression ratio, codec time, fastwire traffic, staleness
+    gap) — what `tools/trace_report.py --wire` prints."""
+    from paddle_tpu.observability import export
+
+    dump = {"label": "trainer0", "metrics": {
+        "wire_bytes_raw_total": {"value": 4000},
+        "wire_bytes_compressed_total": {"value": 1000},
+        "compress_ms": {"p50": 1.5, "p99": 3.0, "count": 7},
+        "fastwire_bytes_sent_total": {"value": 123},
+        "fastwire_bytes_recv_total": {"value": 456},
+        "pserver_staleness_gap": {"value": 2},
+        "rpc_round_replays_total": {"value": 1},
+        "pserver_dedup_drops_total": {"value": 4},
+    }}
+    row, = export.wire_rows([dump])
+    assert row["compression_ratio"] == 4.0
+    assert row["compress_ms_p99"] == 3.0
+    assert row["staleness_gap"] == 2
+    table = export.format_wire_table([row])
+    assert "trainer0" in table and "4.00" in table
+
+
+def test_transpiler_fanin_is_group_count():
+    import paddle_tpu.fluid as fluid
+
+    FLAGS.dist_hier_local = 2
+    try:
+        main, startup = fluid.Program(), fluid.Program()
+        scope = fluid.Scope()
+        with fluid.scope_guard(scope):
+            with fluid.program_guard(main, startup):
+                with fluid.unique_name.guard():
+                    x = fluid.layers.data(name="x", shape=[4],
+                                          dtype="float32")
+                    y = fluid.layers.data(name="y", shape=[1],
+                                          dtype="float32")
+                    pred = fluid.layers.fc(input=x, size=1)
+                    loss = fluid.layers.mean(
+                        fluid.layers.square_error_cost(input=pred,
+                                                       label=y))
+                    fluid.optimizer.SGD(
+                        learning_rate=0.1).minimize(loss)
+        t = fluid.DistributeTranspiler()
+        t.transpile(trainer_id=0, program=main, startup_program=startup,
+                    pservers="127.0.0.1:0", trainers=4, sync_mode=True)
+        ps = t.get_pserver_program("127.0.0.1:0")
+        ls = [op for op in ps.global_block().desc.ops
+              if op.type == "listen_and_serv"][0]
+        assert ls.attr("Fanin") == 2      # 4 trainers / 2 per group
+        assert ls.attr("staleness") == 0
+        # uneven grouping is refused
+        FLAGS.dist_hier_local = 3
+        with pytest.raises(ValueError, match="divide"):
+            fluid.DistributeTranspiler().transpile(
+                trainer_id=0, program=main, startup_program=startup,
+                pservers="127.0.0.1:0", trainers=4, sync_mode=True)
+    finally:
+        FLAGS.dist_hier_local = 0

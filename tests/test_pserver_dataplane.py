@@ -255,16 +255,24 @@ def test_overlapped_barriers_join_surfaces_errors():
         srv.wait()
 
 
-def test_quick_bench_smoke():
+@pytest.mark.parametrize("compress", ["", "int8", "topk"])
+def test_quick_bench_smoke(compress):
     """tools/pserver_bench.py --quick completes in seconds and reports
     sane round-throughput machinery fields (tier-1 guard: a data-plane
-    regression that stalls or crashes the round shows up here)."""
+    regression that stalls or crashes the round shows up here).
+    Parametrized over the FLAGS_dist_compress codecs so a codec that
+    wedges or corrupts the real 2x2 spawned round fails tier-1, not
+    just the in-process tests (ISSUE 10 satellite).  The sweep/CTR
+    scenarios stay out of tier-1 (measured by the full bench run)."""
     out = os.path.join(os.environ.get("TMPDIR", "/tmp"),
-                       "psb_quick_%d.json" % os.getpid())
-    env = dict(os.environ, JAX_PLATFORMS="cpu")
+                       "psb_quick_%d_%s.json" % (os.getpid(),
+                                                 compress or "raw"))
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               FLAGS_dist_compress=compress)
     proc = subprocess.run(
         [sys.executable, os.path.join(REPO, "tools", "pserver_bench.py"),
-         "--quick", "--json", out, "--no-floor"],
+         "--quick", "--json", out, "--no-floor", "--no-ctr",
+         "--no-sweep"],
         cwd=REPO, env=env, capture_output=True, text=True, timeout=240)
     assert proc.returncode == 0, proc.stderr[-2000:]
     with open(out) as f:

@@ -699,12 +699,49 @@ def _collect(ctx, q, n_trainers, timeout=300):
 
 
 def _baseline(ctx, steps, kind="softmax"):
+    """The e2e parity reference.  Plain runs compare against the local
+    single-process trajectory; with FLAGS_dist_compress exported
+    (tools/fault_matrix.py 'compressed' preset) the reference is
+    instead a FAULT-FREE distributed run under the same codec — the
+    parity claim becomes 'faults + replays on the compressed wire are
+    invisible to the math', which is exactly the idempotence guarantee
+    compression must not break (a lossy codec can never match the
+    uncompressed local baseline)."""
+    if os.environ.get("FLAGS_dist_compress", "").strip():
+        return _dist_reference(ctx, steps, kind)
     bq = ctx.Queue()
     bp = ctx.Process(target=_baseline_to_queue, args=(steps, kind, bq))
     bp.start()
     local = bq.get(timeout=240)
     bp.join(timeout=60)
     return local
+
+
+def _dist_reference(ctx, steps, kind="softmax"):
+    """A fault-free 2x2 distributed run (same topology as the e2e
+    tests), fault injection explicitly CLEARED in every child."""
+    eps = ["127.0.0.1:%d" % _free_port() for _ in range(2)]
+    pservers = ",".join(eps)
+    clean = {"FLAGS_fastwire_port_offset": "0", "FLAGS_fault_spec": ""}
+    ps_procs = [ctx.Process(target=H.run_pserver,
+                            args=(ep, pservers, 2, kind, True, clean))
+                for ep in eps]
+    for p in ps_procs:
+        p.start()
+    q = ctx.Queue()
+    tr_procs = [ctx.Process(target=H.run_trainer,
+                            args=(tid, pservers, 2, steps, q, kind,
+                                  True, clean))
+                for tid in range(2)]
+    for p in tr_procs:
+        p.start()
+    results = _collect(ctx, q, 2)
+    for p in tr_procs + ps_procs:
+        p.join(timeout=60)
+        if p.is_alive():
+            p.terminate()
+    np.testing.assert_allclose(results[0], results[1], rtol=1e-6)
+    return results[0]
 
 
 def _merged_spec(base):

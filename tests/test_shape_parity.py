@@ -109,6 +109,46 @@ ANCHORS = [
 ]
 
 
+def _one_op_program(shape):
+    from paddle_tpu.core.types import np_dtype_to_proto
+
+    prog = core_desc.ProgramDesc()
+    block = prog.blocks[0]
+    dt = np_dtype_to_proto(np.dtype(np.float32))
+    block.add_var(core_desc.VarDesc("x", shape=list(shape), dtype=dt))
+    block.add_var(core_desc.VarDesc("out", shape=list(shape), dtype=dt))
+    op = block.append_op(core_desc.OpDesc(
+        "softmax", {"X": ["x"]}, {"Out": ["out"]}, {}))
+    return prog, block, op
+
+
+def test_fake_batch_sentinel_vocab_97_stays_static():
+    """Regression (ISSUE 10 satellite, noted in PR 7): a REAL dim equal
+    to the dynamic-dim sentinel (vocab_size=97) must survive inference
+    as 97.  The old single-sentinel mapping declared every 97-sized
+    output dim dynamic; the two-sentinel cross-check only maps dims
+    that track BOTH substitutions."""
+    prog, block, op = _one_op_program([-1, 97])
+    shape, dtype = lowering.infer_op_outputs(prog, block, op)["out"]
+    assert tuple(shape) == (-1, 97), shape
+    assert np.dtype(dtype) == np.float32
+
+
+def test_fake_batch_sentinel_inert_without_dynamic_dims():
+    """A fully-static program containing a 97-sized dim has nothing to
+    map back: inference must return it verbatim."""
+    prog, block, op = _one_op_program([3, 97])
+    shape, _ = lowering.infer_op_outputs(prog, block, op)["out"]
+    assert tuple(shape) == (3, 97), shape
+
+
+def test_fake_batch_sentinel_dynamic_dim_still_maps():
+    """The ordinary case keeps working: the dynamic batch maps to -1."""
+    prog, block, op = _one_op_program([-1, 10])
+    shape, _ = lowering.infer_op_outputs(prog, block, op)["out"]
+    assert tuple(shape) == (-1, 10), shape
+
+
 @pytest.mark.parametrize("op_type,ins,outs,attrs", ANCHORS,
                          ids=[a[0] for a in ANCHORS])
 def test_abstract_inference_anchor(op_type, ins, outs, attrs):

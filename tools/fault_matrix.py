@@ -47,6 +47,21 @@ PRESETS = {
     # — run_numerics_preset() runs tests/test_numerics.py and FAILs
     # unless a numerics_*.json names the poisoned round's cid
     "numerics": "send_grad:corrupt:%d:1" % 2,
+    # compressed wire (ISSUE 10): the drop/replay/SIGKILL-restart
+    # resilience suite over int8-quantized frames.  The e2e parity
+    # tests switch their reference to a FAULT-FREE compressed
+    # distributed run (test_resilience._baseline), so a pass means
+    # exact-loss-parity holds: retries/replays ship the cached
+    # compressed frames bit-identically and PR 1's idempotence
+    # guarantees survive the codec.
+    "compressed": ("send_grad:drop:0.2:12,get_param:drop:0.2:12,"
+                   "send_barrier:drop:0.3:6"),
+}
+
+# extra environment a preset exports into the pytest run (and, by
+# inheritance, into every spawned trainer/pserver worker)
+PRESET_ENV = {
+    "compressed": {"FLAGS_dist_compress": "int8"},
 }
 
 NUMERICS_ROUND = 2
@@ -97,6 +112,7 @@ def run_preset(name, spec, seed, pytest_args):
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
     env["FLAGS_fault_spec"] = spec
+    env.update(PRESET_ENV.get(name, {}))
     if seed:
         env["FLAGS_fault_seed"] = str(seed)
     # flight recorder (ISSUE 6): with a dump dir set, the first fault

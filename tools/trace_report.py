@@ -94,6 +94,11 @@ def main(argv=None):
                          "counts per process — ISSUE 8); "
                          "numerics_*.json trip artifacts may also be "
                          "passed as inputs and are summarized")
+    ap.add_argument("--wire", action="store_true",
+                    help="print the pserver wire/compression rollup "
+                         "(grad bytes raw vs on-wire, codec encode "
+                         "time, fastwire traffic, staleness gap per "
+                         "process — ISSUE 10)")
     args = ap.parse_args(argv)
 
     # numerics trip artifacts ride the same dump dir as trace dumps;
@@ -126,13 +131,15 @@ def main(argv=None):
     krows = export.kernel_rows(dumps, trace) \
         if (args.kernels or not args.json) else []
     nrows = export.numerics_rows(dumps) if args.numerics else []
+    wrows = export.wire_rows(dumps) if args.wire else []
     if args.json:
-        if args.numerics:
-            print(json.dumps({"phases": rows, "kernels": krows,
-                              "numerics": nrows}, indent=2))
-        elif args.kernels:
-            print(json.dumps({"phases": rows, "kernels": krows},
-                             indent=2))
+        if args.numerics or args.kernels or args.wire:
+            # one wrapped object, keys present for the rollups asked
+            # for; bare phase rows stay the no-flag contract
+            print(json.dumps(dict(
+                {"phases": rows, "kernels": krows},
+                **({"numerics": nrows} if args.numerics else {}),
+                **({"wire": wrows} if args.wire else {})), indent=2))
         else:
             print(json.dumps(rows, indent=2))
     else:
@@ -158,6 +165,10 @@ def main(argv=None):
             print("\nnumerics rollup (grad-norm trend / nonfinite "
                   "sightings per process):")
             print(export.format_numerics_table(nrows))
+        if args.wire:
+            print("\nwire rollup (grad compression / fastwire traffic "
+                  "/ staleness per process):")
+            print(export.format_wire_table(wrows))
     if trips:
         _print_trips(trips)
     if not rows:
