@@ -222,6 +222,22 @@ define_flag("serve_warm_buckets", "",
             "whole ladder up to serve_max_batch.  A cold bucket hit at "
             "runtime falls to the nearest warm bucket while a "
             "background thread compiles the missed one")
+define_flag("serve_kv_block_size", 16,
+            "generative serving (serving/generative.py): tokens per KV "
+            "cache block.  Power of two; every sequence's K/V occupies "
+            "ceil(context/block_size) blocks of the tenant's paged "
+            "pool, gathered through a per-sequence block table by the "
+            "decode-mode flash attention kernel "
+            "(kernels/flash_attention.paged_attention)")
+define_flag("serve_kv_blocks", 512,
+            "generative serving: KV cache blocks in a tenant's "
+            "device-resident pool (one is reserved as the padding "
+            "scratch block).  Memory = 2 x layers x blocks x "
+            "block_size x d_model x 4 bytes.  When admission or "
+            "mid-decode growth would exceed the pool, the scheduler "
+            "counts serve_kv_alloc_failures_total and preempts the "
+            "youngest sequence (serve_kv_preemptions_total) — "
+            "recompute-style eviction, requeued at the queue front")
 define_flag("dist_compress", "",
             "gradient compression codec for the pserver wire "
             "(distributed/compress.py): '' (raw frames, the default), "

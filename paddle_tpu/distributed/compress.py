@@ -36,7 +36,7 @@ from __future__ import annotations
 import numpy as np
 
 __all__ = ["Compressed", "compress", "decompress", "wire_nbytes",
-           "CODECS", "MIN_COMPRESS_ELEMS"]
+           "CODECS", "MIN_COMPRESS_ELEMS", "quantize_symmetric"]
 
 # codec ids (wire bytes — append-only, never renumber)
 FP16, INT8, TOPK, ROWS, ROWS16 = 1, 2, 3, 4, 5
@@ -103,17 +103,30 @@ def _fp16(arr):
                       [np.ascontiguousarray(arr, np.float16)])
 
 
+def quantize_symmetric(chunks):
+    """Per-chunk symmetric int8 quantization of ``chunks`` [n, chunk]:
+    scale = absmax/127 per row (1.0 for all-zero rows so dequant stays
+    exact zeros).  Returns (q int8 [n, chunk], scales f32 [n]).  The ONE
+    quantizer definition shared by the wire codecs below and the
+    serving tier's int8 weight-quantized matmuls
+    (kernels/matmul_fused.quantize_weight) — keep the rounding rule in
+    one place so a wire-parity bound proven here transfers there."""
+    chunks = np.ascontiguousarray(chunks, np.float32)
+    absmax = np.abs(chunks).max(axis=1) if chunks.shape[0] else \
+        np.zeros(0, np.float32)
+    scales = np.where(absmax > 0, absmax / 127.0, 1.0).astype(np.float32)
+    q = np.clip(np.rint(chunks / scales[:, None]), -127, 127) \
+        .astype(np.int8)
+    return q, scales
+
+
 def _int8(arr):
     flat = np.ascontiguousarray(arr, np.float32).reshape(-1)
     n = flat.size
     nchunks = -(-n // CHUNK)
     padded = np.zeros(nchunks * CHUNK, np.float32)
     padded[:n] = flat
-    chunks = padded.reshape(nchunks, CHUNK)
-    absmax = np.abs(chunks).max(axis=1)
-    scales = np.where(absmax > 0, absmax / 127.0, 1.0).astype(np.float32)
-    q = np.clip(np.rint(chunks / scales[:, None]), -127, 127) \
-        .astype(np.int8)
+    q, scales = quantize_symmetric(padded.reshape(nchunks, CHUNK))
     return Compressed(INT8, CHUNK, arr.dtype, arr.shape, -1,
                       [q.reshape(-1)[:n], scales])
 
@@ -152,11 +165,7 @@ def _rows(sr):
     values = values[order] if values.ndim else values
     n = order.size
     vflat = values.reshape(n, -1) if n else values.reshape(0, -1)
-    absmax = np.abs(vflat).max(axis=1) if n else \
-        np.zeros(0, np.float32)
-    scales = np.where(absmax > 0, absmax / 127.0, 1.0).astype(np.float32)
-    q = np.clip(np.rint(vflat / scales[:, None]), -127, 127) \
-        .astype(np.int8)
+    q, scales = quantize_symmetric(vflat)
     return Compressed(ROWS, 0, np.asarray(sr.values).dtype,
                       np.asarray(sr.values).shape, sr.height,
                       [deltas, scales, q])

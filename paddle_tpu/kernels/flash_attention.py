@@ -27,7 +27,7 @@ from jax.experimental import pallas as pl
 from paddle_tpu.observability.trace import traced as _traced
 
 __all__ = ["flash_attention", "flash_attention_fwd_lse",
-           "flash_attention_bwd"]
+           "flash_attention_bwd", "paged_attention"]
 
 NEG_INF = -1e30
 
@@ -464,6 +464,147 @@ def _flash_pallas(q, k, v, scale, causal, block_q, block_k, interpret):
         interpret=interpret,
     )(qf, kf, vf)
     return out.reshape(b, h, t, d), lse.reshape(b, h, t)
+
+
+# ---------------------------------------------------------------------------
+# Decode-mode paged attention (ISSUE 11): one query token per sequence
+# attends over K/V gathered THROUGH a block table from a paged pool.
+# ---------------------------------------------------------------------------
+
+def _paged_attention_xla(q, k_pages, v_pages, block_tables, context_lens,
+                         scale):
+    """Identical-math XLA path: gather the pages, mask past the context
+    length, softmax, weighted sum.  The gather materializes the
+    per-sequence context [B, NB*bs, H, D] — fine off-TPU; the Pallas
+    kernel below streams pages through VMEM instead."""
+    k_ctx = k_pages[block_tables]            # [B, NB, bs, H, D]
+    b, nb, bs, h, d = k_ctx.shape
+    k_ctx = k_ctx.reshape(b, nb * bs, h, d)
+    v_ctx = v_pages[block_tables].reshape(b, nb * bs, h, d)
+    s = jnp.einsum("bhd,bshd->bhs", q.astype(jnp.float32),
+                   k_ctx.astype(jnp.float32)) * scale
+    pos = jnp.arange(nb * bs, dtype=jnp.int32)
+    live = pos[None, None, :] < context_lens[:, None, None]
+    s = jnp.where(live, s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    out = jnp.einsum("bhs,bshd->bhd", p, v_ctx.astype(jnp.float32))
+    return out.astype(q.dtype)
+
+
+def _paged_kernel(tables_ref, lens_ref, q_ref, k_ref, v_ref, o_ref,
+                  m_ref, l_ref, acc_ref, *, scale, block_size, n_b):
+    """One (sequence, page) grid step of decode attention: the page the
+    block table named for this step was DMA'd into VMEM by the
+    scalar-prefetch index maps; online-softmax scratch carries across
+    the sequential page axis exactly like _flash_kernel's K tiles."""
+    bi = pl.program_id(0)
+    ki = pl.program_id(1)
+
+    @pl.when(ki == 0)
+    def _init():
+        m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    ctx = lens_ref[bi]
+    # pages wholly past the context are dead weight (padding rows of a
+    # bucketed decode batch point every table slot at the scratch
+    # block); skip their FLOPs, not just their probability mass
+    live = ki * block_size < ctx
+
+    @pl.when(live)
+    def _step():
+        q = q_ref[0].astype(jnp.float32) * scale       # [H, D]
+        k = k_ref[0].astype(jnp.float32)               # [bs, H, D]
+        v = v_ref[0].astype(jnp.float32)
+        s = jnp.einsum("hd,shd->hs", q, k)             # [H, bs]
+        pos = ki * block_size + jax.lax.broadcasted_iota(
+            jnp.int32, s.shape, 1)
+        s = jnp.where(pos < ctx, s, NEG_INF)
+        m_prev = m_ref[...][:, 0]
+        m_new = jnp.maximum(m_prev, s.max(axis=1))
+        p = jnp.exp(s - m_new[:, None])
+        alpha = jnp.exp(m_prev - m_new)
+        l_ref[...] = (l_ref[...][:, 0] * alpha + p.sum(axis=1))[:, None]
+        acc_ref[...] = acc_ref[...] * alpha[:, None] + \
+            jnp.einsum("hs,shd->hd", p, v)
+        m_ref[...] = m_new[:, None]
+
+    @pl.when(ki == n_b - 1)
+    def _done():
+        l = l_ref[...][:, 0]
+        o_ref[0] = (acc_ref[...] / l[:, None]).astype(o_ref.dtype)
+
+
+@_traced("pallas.paged_attention",
+         lambda q, *a, **kw: {"q": str(q.shape)})
+def paged_attention(q, k_pages, v_pages, block_tables, context_lens,
+                    scale=None, force_xla=False, interpret=False):
+    """Decode-mode attention through a paged KV cache (ISSUE 11; the
+    vLLM/PagedAttention access pattern, TPU-native).
+
+    ``q`` [B, H, D] — ONE query token per sequence (the decode step);
+    ``k_pages``/``v_pages`` [N, bs, H, D] — the shared block pool;
+    ``block_tables`` [B, NB] int32 — per-sequence page indices (unused
+    slots may point anywhere; they are masked);
+    ``context_lens`` [B] int32 — tokens of real context per sequence
+    (positions >= context_len are masked; a padding row uses 1 so its
+    softmax stays finite).
+
+    On TPU (or under ``interpret``) runs the Pallas kernel: the grid is
+    (sequence, page) and the block table rides scalar prefetch, so each
+    grid step DMAs exactly the page the table names — the gathered
+    [B, S] context never materializes in HBM.  Elsewhere the
+    identical-math XLA gather path runs."""
+    b, h, d = q.shape
+    n, bs, hp, dp = k_pages.shape
+    assert (hp, dp) == (h, d), (q.shape, k_pages.shape)
+    nb = block_tables.shape[1]
+    if scale is None:
+        scale = 1.0 / np.sqrt(d)
+    block_tables = block_tables.astype(jnp.int32)
+    context_lens = context_lens.astype(jnp.int32)
+    on_tpu = target_platform() == "tpu"
+    if force_xla or not (on_tpu or interpret):
+        return _paged_attention_xla(q, k_pages, v_pages, block_tables,
+                                    context_lens, scale)
+    from jax.experimental.pallas import tpu as pltpu
+
+    kernel = functools.partial(_paged_kernel, scale=scale,
+                               block_size=bs, n_b=nb)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(b, nb),
+        in_specs=[
+            pl.BlockSpec((1, h, d),
+                         lambda bi, ki, tables, lens: (bi, 0, 0)),
+            pl.BlockSpec((1, bs, h, d),
+                         lambda bi, ki, tables, lens:
+                         (tables[bi, ki], 0, 0, 0)),
+            pl.BlockSpec((1, bs, h, d),
+                         lambda bi, ki, tables, lens:
+                         (tables[bi, ki], 0, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, h, d),
+                               lambda bi, ki, tables, lens: (bi, 0, 0)),
+        scratch_shapes=[pltpu.VMEM((h, 1), jnp.float32),
+                        pltpu.VMEM((h, 1), jnp.float32),
+                        pltpu.VMEM((h, d), jnp.float32)],
+    )
+    kwargs = {}
+    if not interpret:
+        # compiler_params are Mosaic-only; the interpreter rejects them
+        # on some jax versions (matmul_fused._pallas_call's rule)
+        kwargs["compiler_params"] = _compiler_params(
+            dimension_semantics=("parallel", "arbitrary"))
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, h, d), q.dtype),
+        interpret=interpret,
+        **kwargs,
+    )(block_tables, context_lens, q, k_pages, v_pages)
+    return out
 
 
 def flash_attention_fwd_lse(q, k, v, scale=None, causal=False,

@@ -35,7 +35,8 @@ from jax.experimental import pallas as pl
 from paddle_tpu.observability.trace import traced as _traced
 
 __all__ = ["matmul_epilogue", "add_ln", "matmul_epilogue_reference",
-           "add_ln_reference", "plan_matmul", "plan_add_ln", "apply_act"]
+           "add_ln_reference", "plan_matmul", "plan_add_ln", "apply_act",
+           "quantize_weight", "dequantize_weight", "matmul_int8_dequant"]
 
 # Per-grid-step VMEM budget (operand tiles + f32 accumulator + output
 # tiles, double-buffering headroom included) — same ceiling discipline
@@ -221,6 +222,155 @@ def matmul_epilogue(x2, w, bias=None, residual=None, act="", *,
         interpret=interpret,
     )(*operands)
     return outs
+
+
+# ---------------------------------------------------------------------------
+# int8 weight-quantized matmul with epilogue dequant (ISSUE 11)
+# ---------------------------------------------------------------------------
+#
+# Serving decode is weight-bound: every step re-reads every parameter
+# for one token per sequence, so int8 weights halve-again the byte
+# floor bf16 set.  The quantizer is distributed/compress.py's per-chunk
+# symmetric rule (scale = absmax/127 per chunk) applied along K — each
+# [chunk, 1] column segment of W gets one f32 scale, so one outlier
+# weight cannot flatten a whole matrix's resolution.  The kernel DMAs
+# the int8 tile and rescales it in VMEM right before the MXU dot — the
+# f32 weights never exist in HBM.
+
+def quantize_weight(w, chunk=None):
+    """Quantize a [K, N] weight matrix int8, per-(K-chunk, column):
+    returns (q int8 [K, N], scales f32 [K//chunk, N], chunk).  ``chunk``
+    defaults to the wire codec's granularity (compress.CHUNK) and clamps
+    to a divisor of K (whole-K when K doesn't divide — coarse, never
+    wrong)."""
+    import numpy as np
+
+    from paddle_tpu.distributed.compress import CHUNK, quantize_symmetric
+
+    w = np.ascontiguousarray(np.asarray(w), np.float32)
+    k, n = w.shape
+    chunk = int(chunk or CHUNK)
+    chunk = min(chunk, k)
+    if k % chunk:
+        chunk = k
+    nc = k // chunk
+    # [nc, chunk, N] -> chunks along K per column: [nc*N, chunk]
+    cols = w.reshape(nc, chunk, n).transpose(0, 2, 1).reshape(-1, chunk)
+    q, scales = quantize_symmetric(cols)
+    q = q.reshape(nc, n, chunk).transpose(0, 2, 1).reshape(k, n)
+    return np.ascontiguousarray(q), \
+        np.ascontiguousarray(scales.reshape(nc, n)), chunk
+
+
+def dequantize_weight(q, scales, chunk):
+    """The [K, N] f32 weights ``quantize_weight``'s output reconstructs
+    — the XLA-fallback half of the kernel's in-VMEM rescale (works on
+    numpy or traced jnp values)."""
+    k, n = q.shape
+    nc = k // chunk
+    return (q.astype(jnp.float32).reshape(nc, chunk, n)
+            * scales.reshape(nc, 1, n)).reshape(k, n)
+
+
+def _matmul_int8_kernel(*refs, nk, act, with_bias, with_residual):
+    it = iter(refs)
+    x_ref = next(it)
+    w_ref = next(it)
+    s_ref = next(it)
+    b_ref = next(it) if with_bias else None
+    r_ref = next(it) if with_residual else None
+    o_ref = next(it)
+    acc_ref = next(it)
+
+    ki = pl.program_id(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    # dequant in VMEM: within one K tile every row shares the chunk, so
+    # the scale varies only by column — one [1, bn] tile broadcast
+    w = w_ref[...].astype(jnp.float32) * s_ref[...][0][None, :]
+    acc_ref[...] += jnp.dot(x_ref[...].astype(jnp.float32), w,
+                            preferred_element_type=jnp.float32)
+
+    @pl.when(ki == nk - 1)
+    def _done():
+        y = acc_ref[...]
+        if with_bias:
+            y = y + b_ref[...][0][None, :]
+        y = apply_act(y, act)
+        if with_residual:
+            y = y + r_ref[...].astype(jnp.float32)
+        o_ref[...] = y.astype(o_ref.dtype)
+
+
+@_traced("pallas.matmul_int8",
+         lambda x, w, *a, **kw: {"x": str(x.shape), "w": str(w.shape)})
+def matmul_int8_dequant(x2, wq, scales, chunk, bias=None, residual=None,
+                        act="", *, out_dtype=None, config=None,
+                        force_xla=False, interpret=False):
+    """[M, K] @ dequant(int8 [K, N]) with the per-chunk scales applied
+    in the kernel's VMEM epilogue-side rescale and the bias/act/residual
+    tail fused like ``matmul_epilogue``.  Identical-math XLA fallback
+    (dequantize + the reference epilogue) off-TPU / non-tiling shapes —
+    both paths answer the same floats, so serving parity tests run on
+    CPU transfer to the kernel."""
+    from paddle_tpu import tuning
+    from .flash_attention import target_platform
+
+    m, k = x2.shape
+    k2, n = wq.shape
+    assert k == k2, (x2.shape, wq.shape)
+    assert k % int(chunk) == 0, (k, chunk)
+    out_dtype = out_dtype or x2.dtype
+    on_tpu = target_platform() == "tpu"
+    if config is None:
+        config = tuning.lookup("matmul_int8", (m, k, n),
+                               jnp.dtype(x2.dtype).name)
+    bm, bn, bk, usable = plan_matmul(m, k, n, x2.dtype, config)
+    # each K tile must sit inside ONE quantization chunk (the kernel
+    # rescales a tile with a single [1, bn] scale row)
+    usable = usable and (int(chunk) % bk == 0 or bk % int(chunk) == 0)
+    if bk > int(chunk):
+        usable = False
+    if force_xla or not usable or not (on_tpu or interpret):
+        w = dequantize_weight(jnp.asarray(wq), jnp.asarray(scales),
+                              int(chunk))
+        y, _ = matmul_epilogue_reference(
+            x2.astype(jnp.float32), w, bias, residual, act, out_dtype)
+        return y
+
+    with_bias = bias is not None
+    with_residual = residual is not None
+    nk = k // bk
+    per = int(chunk) // bk          # K tiles per quantization chunk
+    kernel = functools.partial(
+        _matmul_int8_kernel, nk=nk, act=act, with_bias=with_bias,
+        with_residual=with_residual)
+    in_specs = [
+        pl.BlockSpec((bm, bk), lambda i, j, kk: (i, kk)),
+        pl.BlockSpec((bk, bn), lambda i, j, kk: (kk, j)),
+        pl.BlockSpec((1, bn), lambda i, j, kk: (kk // per, j)),
+    ]
+    operands = [x2, wq, scales]
+    if with_bias:
+        in_specs.append(pl.BlockSpec((1, bn), lambda i, j, kk: (0, j)))
+        operands.append(bias.astype(jnp.float32).reshape(1, n))
+    if with_residual:
+        in_specs.append(pl.BlockSpec((bm, bn), lambda i, j, kk: (i, j)))
+        operands.append(residual)
+    return _pallas_call(
+        kernel,
+        grid=(m // bm, n // bn, nk),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((bm, bn), lambda i, j, kk: (i, j)),
+        out_shape=jax.ShapeDtypeStruct((m, n), out_dtype),
+        scratch_shapes=[_vmem_scratch((bm, bn), jnp.float32)],
+        compiler_params=_compiler_params(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=interpret,
+    )(*operands)
 
 
 # ---------------------------------------------------------------------------

@@ -22,7 +22,8 @@ from . import metrics
 
 __all__ = ["load_dump", "chrome_trace", "merge_files", "phase_rows",
            "format_phase_table", "kernel_rows", "format_kernel_table",
-           "numerics_rows", "format_numerics_table"]
+           "numerics_rows", "format_numerics_table", "serve_rows",
+           "format_serve_table"]
 
 
 def load_dump(path):
@@ -300,6 +301,66 @@ def format_wire_table(rows):
                       r["compression_ratio"], r["compress_ms_p50"],
                       r["compress_ms_p99"], r["fastwire_tx"],
                       r["fastwire_rx"], r["staleness_gap"]))
+    return "\n".join(out)
+
+
+def serve_rows(dumps):
+    """Serving-tier rollup (ISSUE 11 satellite): per process dump, the
+    request/token plane — predict batches and occupancy, and the
+    generative decode loop's tokens/TTFT/inter-token distributions with
+    the paged KV cache pressure (blocks used/total, allocation
+    failures, preemptions).  Works on any trace dump — the always-on
+    metrics snapshot rides every one."""
+    rows = []
+    for d in dumps:
+        m = d.get("metrics", {})
+
+        def val(name, default=0):
+            return (m.get(name) or {}).get("value", default)
+
+        def hist(name, field, default=0.0):
+            return (m.get(name) or {}).get(field, default)
+
+        slots = val("serve_decode_slots_total")
+        rows.append({
+            "label": d.get("label", "?"),
+            "requests": val("serve_requests_total"),
+            "batches": val("serve_batches_total"),
+            "gen_requests": val("serve_gen_requests_total"),
+            "tokens": val("serve_tokens_total"),
+            "prefills": val("serve_prefills_total"),
+            "decode_steps": val("serve_decode_steps_total"),
+            "decode_occupancy_pct": round(
+                100.0 * val("serve_decode_rows_total") / slots, 1)
+            if slots else 0.0,
+            "ttft_p50_ms": round(hist("serve_ttft_ms", "p50"), 3),
+            "ttft_p99_ms": round(hist("serve_ttft_ms", "p99"), 3),
+            "itl_p50_ms": round(hist("serve_itl_ms", "p50"), 3),
+            "itl_p99_ms": round(hist("serve_itl_ms", "p99"), 3),
+            "kv_blocks_used": val("serve_kv_blocks_used"),
+            "kv_blocks_total": val("serve_kv_blocks_total"),
+            "kv_alloc_failures": val("serve_kv_alloc_failures_total"),
+            "preemptions": val("serve_kv_preemptions_total"),
+        })
+    rows.sort(key=lambda r: r["label"])
+    return rows
+
+
+def format_serve_table(rows):
+    out = ["%-20s %7s %8s %8s %6s %9s %9s %8s %8s %9s %7s %8s" % (
+        "process", "reqs", "tokens", "steps", "occ%", "ttft_p50",
+        "ttft_p99", "itl_p50", "itl_p99", "kv_used", "allocF",
+        "preempt")]
+    for r in rows:
+        out.append("%-20s %7d %8d %8d %6.1f %9.3f %9.3f %8.3f %8.3f "
+                   "%5d/%-3d %7d %8d" % (
+                       r["label"][:20],
+                       r["requests"] + r["gen_requests"], r["tokens"],
+                       r["decode_steps"], r["decode_occupancy_pct"],
+                       r["ttft_p50_ms"], r["ttft_p99_ms"],
+                       r["itl_p50_ms"], r["itl_p99_ms"],
+                       r["kv_blocks_used"], r["kv_blocks_total"],
+                       r["kv_alloc_failures"], r["preemptions"]))
     return "\n".join(out)
 
 

@@ -23,12 +23,15 @@ from __future__ import annotations
 
 from .batcher import set_metrics_enabled
 from .engine import ModelEngine, bucket_ladder
+from .generative import GenerativeEngine, LMConfig, tiny_lm
+from .kv_cache import BlockPool
 from .server import InferenceServer
 from .wire import PredictClient, RemoteError
 
-__all__ = ["InferenceServer", "ModelEngine", "PredictClient",
-           "RemoteError", "bucket_ladder", "create_c_server",
-           "set_metrics_enabled"]
+__all__ = ["BlockPool", "GenerativeEngine", "InferenceServer",
+           "LMConfig", "ModelEngine", "PredictClient", "RemoteError",
+           "bucket_ladder", "create_c_server", "set_metrics_enabled",
+           "tiny_lm"]
 
 
 class _CServerHandle:

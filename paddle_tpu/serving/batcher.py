@@ -33,7 +33,7 @@ import numpy as np
 from paddle_tpu.observability import metrics as _metrics
 from paddle_tpu.observability.trace import TRACER
 
-__all__ = ["Request", "RequestQueue", "Dispatcher"]
+__all__ = ["Request", "RequestQueue", "Dispatcher", "TokenScheduler"]
 
 _M_REQS = _metrics.counter("serve_requests_total",
                            "requests accepted by the serving tier")
@@ -315,3 +315,81 @@ class Dispatcher:
             r.future.set_result(res)
             if _METRICS_ON:
                 _M_REQ_MS.observe((t_done - r.t_arrival) * 1e3)
+
+
+# ---------------------------------------------------------------------------
+# Token-granular scheduling (ISSUE 11): the batcher above coalesces
+# whole REQUESTS per dispatch; generative decode coalesces per TOKEN —
+# every decode iteration re-decides the batch, admitting new prefills
+# into the running set the moment blocks exist for them (Orca
+# iteration-level scheduling, for real this time).
+# ---------------------------------------------------------------------------
+
+class TokenScheduler:
+    """Admission + preemption policy over a kv_cache.BlockPool.
+
+    Pure policy, no dispatch mechanics (generative.DecodeLoop owns the
+    loop): sequences are duck-typed — the scheduler reads
+    ``seq.prompt`` (token list) and owns ``seq.blocks`` (allocated
+    block ids).  Invariants:
+
+    - admission is FIFO and stops at the first request the pool cannot
+      hold whole (counted in serve_kv_alloc_failures_total; the request
+      stays at the queue front so arrival order survives — no
+      starvation of big prompts by small ones);
+    - a running sequence that cannot grow (mid-decode block boundary
+      with an empty pool) preempts the YOUNGEST running sequence:
+      recompute-style eviction — blocks freed, request requeued at the
+      front, its greedy tokens regenerate bit-identically on
+      re-admission (determinism is pinned by test);
+    - the victim is never an older sequence (oldest-first completion
+      keeps head-of-line latency bounded), and a lone sequence that
+      cannot grow out of an EMPTY pool is a configuration error
+      surfaced to the caller, not an infinite preempt-readmit loop.
+    """
+
+    def __init__(self, pool, max_batch):
+        self.pool = pool
+        self.max_batch = int(max_batch)
+
+    def try_admit(self, queue, n_running):
+        """Pop and return the requests admissible RIGHT NOW (their
+        prompt blocks are allocated on return, as ``req.blocks``)."""
+        admitted = []
+        while n_running + len(admitted) < self.max_batch:
+            req = queue.get(timeout=0)
+            if req is None:
+                break
+            blocks = self.pool.alloc(self.pool.blocks_for(
+                len(req.prompt)))
+            if blocks is None:
+                queue.put_front([req])      # keeps its arrival stamp
+                break
+            req.blocks = blocks
+            admitted.append(req)
+        return admitted
+
+    def grow(self, seq):
+        """One more block for ``seq`` (decode crossed a block
+        boundary); True on success."""
+        got = self.pool.alloc(1)
+        if got is None:
+            return False
+        seq.blocks.extend(got)
+        return True
+
+    def pick_victim(self, running, needing):
+        """The sequence to preempt so ``needing`` can grow: the
+        youngest running sequence other than ``needing`` — or
+        ``needing`` itself when it IS the youngest (evicting an older
+        peer for the youngest would invert completion order).  None
+        when there is nothing to evict (lone sequence, empty pool)."""
+        candidates = [s for s in running if s is not needing]
+        if not candidates:
+            return None
+        victim = candidates[-1]
+        # never steal from an OLDER sequence for a younger one
+        if running.index(victim) < running.index(needing):
+            return needing
+        return victim
+

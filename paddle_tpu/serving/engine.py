@@ -30,7 +30,7 @@ import numpy as np
 from paddle_tpu.core.flags import FLAGS
 from paddle_tpu.observability import metrics as _metrics
 
-__all__ = ["ModelEngine", "bucket_ladder"]
+__all__ = ["ModelEngine", "bucket_ladder", "StepCache", "pow2_bucket"]
 
 _M_COMPILES = _metrics.counter(
     "serve_bucket_compiles_total",
@@ -54,6 +54,119 @@ def bucket_ladder(max_batch):
         b *= 2
     out.append(int(max_batch))
     return out
+
+
+def pow2_bucket(n, cap):
+    """Smallest power of two >= n, clamped to cap (which joins the
+    ladder even when it is not itself a power of two)."""
+    b = 1
+    while b < n and b < cap:
+        b *= 2
+    return min(b, int(cap))
+
+
+class StepCache:
+    """Bucket-keyed compiled-step cache — the generative tier's analog
+    of ModelEngine's executable ladder (ISSUE 11).
+
+    Keys are tuples of bucket dims (e.g. ``(batch, block_count)`` for a
+    decode step, ``(seq_len,)`` for a prefill).  ``compile_fn(key)``
+    AOT-compiles the step for that key.  ``pick(key)`` returns an exact
+    hit, or the smallest warm key COVERING the request (every dim >=;
+    the caller pads up to whatever key comes back) while ONE background
+    thread compiles the miss — the ModelEngine cold-bucket discipline.
+    With nothing covering, the first caller compiles synchronously (a
+    cold engine must still answer)."""
+
+    def __init__(self, compile_fn, name=""):
+        self.name = name
+        self._compile_fn = compile_fn
+        self._exes = {}
+        self._lock = threading.Lock()
+        self._compiling = set()
+        self._threads = []
+
+    def drain(self, timeout=120):
+        """Join any in-flight background compiles — tear down a tenant
+        with a compile thread still inside XLA and the runtime aborts
+        the whole process at interpreter exit."""
+        with self._lock:
+            threads = [t for t in self._threads if t.is_alive()]
+            self._threads = []
+        for t in threads:
+            t.join(timeout)
+
+    def warm(self, keys):
+        for key in keys:
+            key = tuple(key)
+            if self.get(key) is None:
+                exe = self._compile_fn(key)
+                _M_COMPILES.inc()
+                with self._lock:
+                    self._exes[key] = exe
+
+    def get(self, key):
+        with self._lock:
+            return self._exes.get(tuple(key))
+
+    @property
+    def warm_keys(self):
+        with self._lock:
+            return sorted(self._exes)
+
+    def pick(self, key):
+        """(key, exe) serving the request NOW.  On a miss the smallest
+        covering warm key answers and the ideal key compiles in the
+        background; with no covering key the compile happens inline."""
+        key = tuple(key)
+        with self._lock:
+            exe = self._exes.get(key)
+            if exe is not None:
+                return key, exe
+            covering = sorted(
+                k for k in self._exes
+                if len(k) == len(key)
+                and all(a >= b for a, b in zip(k, key)))
+        _M_MISS.inc()
+        if covering:
+            self.ensure_async(key)
+            return covering[0], self._exes[covering[0]]
+        exe = self._compile_fn(key)
+        _M_COMPILES.inc()
+        with self._lock:
+            self._exes[key] = exe
+        return key, exe
+
+    def ensure_async(self, key):
+        key = tuple(key)
+        with self._lock:
+            if key in self._exes or key in self._compiling:
+                return
+            self._compiling.add(key)
+
+        def _bg():
+            try:
+                exe = self._compile_fn(key)
+                _M_COMPILES.inc()
+                with self._lock:
+                    self._exes[key] = exe
+            except Exception as e:
+                import warnings
+                _M_COMPILE_FAIL.inc()
+                warnings.warn(
+                    "step bucket %r compile failed for %r (%s: %s); "
+                    "traffic stays on covering buckets"
+                    % (key, self.name, type(e).__name__, e))
+            finally:
+                with self._lock:
+                    self._compiling.discard(key)
+
+        t = threading.Thread(target=_bg, daemon=True,
+                             name="serve-stepcompile-%s" % (self.name,))
+        with self._lock:
+            self._threads = [x for x in self._threads if x.is_alive()]
+            self._threads.append(t)
+        t.start()
 
 
 class ModelEngine:

@@ -1,0 +1,151 @@
+"""Paged KV cache accounting: the block pool behind generative decode
+(ISSUE 11 tentpole a).
+
+The vLLM/PagedAttention memory design, TPU-native: the device holds ONE
+pool of fixed-size KV blocks per tenant (``generative.GenerativeEngine``
+owns the actual [L, N, bs, H, D] page arrays, donated through every
+prefill/decode dispatch so they never round-trip the host — the PR 2
+prepared-program contract applied to serving state).  This module is
+the host-side ledger over that pool: a free list of block ids, per-
+sequence block tables, and the always-on accounting the ISSUE 11
+satellite asks for:
+
+- ``serve_kv_blocks_used`` / ``serve_kv_blocks_total`` gauges — live
+  pool pressure, scraped by the serve rollup (tools/trace_report.py
+  --serve) and SERVE_BENCH.json;
+- ``serve_kv_alloc_failures_total`` — admissions (or mid-decode block
+  growth) the pool could not satisfy;
+- ``serve_kv_preemptions_total`` — sequences evicted and requeued to
+  make room (the scheduler's recompute-style preemption,
+  batcher.TokenScheduler).
+
+Block 0 is RESERVED as the padding scratch block: bucket-padding rows
+of a decode batch point every block-table slot at it and write their
+(discarded) K/V there, so a padded dispatch never touches a live
+sequence's blocks.
+"""
+from __future__ import annotations
+
+import threading
+
+from paddle_tpu.observability import metrics as _metrics
+
+__all__ = ["BlockPool"]
+
+M_USED = _metrics.gauge(
+    "serve_kv_blocks_used",
+    "KV cache blocks currently allocated to live sequences")
+M_TOTAL = _metrics.gauge(
+    "serve_kv_blocks_total",
+    "KV cache blocks in the pool (excludes the reserved padding block)")
+M_ALLOC_FAIL = _metrics.counter(
+    "serve_kv_alloc_failures_total",
+    "block allocations (admission or mid-decode growth) the pool could "
+    "not satisfy")
+M_PREEMPT = _metrics.counter(
+    "serve_kv_preemptions_total",
+    "sequences evicted (blocks freed, request requeued) because the "
+    "block pool was exhausted")
+
+
+# live pools; the process gauges are recomputed ABSOLUTELY from this
+# registry (never incremented by deltas) so a mid-run
+# metrics.zero_all() — the bench/test rebasing pattern — self-heals at
+# the next allocation instead of leaving the gauges negative forever
+_LIVE = []
+_LIVE_LOCK = threading.Lock()
+
+
+def _refresh_gauges():
+    with _LIVE_LOCK:
+        pools = list(_LIVE)
+    M_TOTAL.set(sum(p.capacity for p in pools))
+    M_USED.set(sum(p.used_blocks for p in pools))
+
+
+class BlockPool:
+    """Free-list allocator over ``num_blocks`` fixed-size KV blocks.
+
+    Thread-safe; the gauges track the process-wide combined pressure
+    of every live pool (multi-tenant processes read the sum, like
+    every serve_* metric)."""
+
+    def __init__(self, num_blocks, block_size):
+        if num_blocks < 2:
+            raise ValueError("kv pool needs >= 2 blocks (one is the "
+                             "reserved padding block)")
+        self.num_blocks = int(num_blocks)
+        self.block_size = int(block_size)
+        # block 0 reserved: the padding scratch target
+        self._free = list(range(self.num_blocks - 1, 0, -1))
+        self._lock = threading.Lock()
+        with _LIVE_LOCK:
+            _LIVE.append(self)
+        _refresh_gauges()
+
+    @property
+    def capacity(self):
+        return self.num_blocks - 1
+
+    @property
+    def free_blocks(self):
+        with self._lock:
+            return len(self._free)
+
+    @property
+    def used_blocks(self):
+        return self.capacity - self.free_blocks
+
+    def blocks_for(self, tokens):
+        """Blocks needed to hold ``tokens`` positions."""
+        return max(1, -(-int(tokens) // self.block_size))
+
+    def alloc(self, n):
+        """``n`` block ids, or None (counted) when the pool cannot
+        satisfy the request — the caller decides between waiting,
+        requeueing, and preempting (batcher.TokenScheduler)."""
+        n = int(n)
+        with self._lock:
+            if n > len(self._free):
+                ok = False
+            else:
+                out = [self._free.pop() for _ in range(n)]
+                ok = True
+        if not ok:
+            M_ALLOC_FAIL.inc()
+            return None
+        _refresh_gauges()
+        return out
+
+    def free(self, blocks):
+        blocks = [int(b) for b in blocks]
+        if not blocks:
+            return
+        # validate BEFORE mutating: a partial append on the guard
+        # raising mid-loop would leak the tail blocks and desync the
+        # ledger from the gauge — the caller bug stays a caller bug
+        if any(b == 0 for b in blocks):
+            raise ValueError("block 0 is the reserved padding block; "
+                             "it is never allocated")
+        with self._lock:
+            self._free.extend(blocks)
+        _refresh_gauges()
+
+    def note_preemption(self):
+        M_PREEMPT.inc()
+
+    def close(self):
+        """Retire the pool from the process gauges (tenant unload) —
+        without this, every load/unload cycle would leave phantom
+        capacity in serve_kv_blocks_total."""
+        with self._lock:
+            self._free = []
+            self.num_blocks = 1
+        with _LIVE_LOCK:
+            if self in _LIVE:
+                _LIVE.remove(self)
+        _refresh_gauges()
+
+    def __repr__(self):
+        return "BlockPool(%d/%d free, block_size=%d)" % (
+            self.free_blocks, self.capacity, self.block_size)

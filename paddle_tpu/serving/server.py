@@ -51,6 +51,22 @@ class _Tenant:
                                      label=name)
 
 
+class _GenTenant:
+    """A generative (token-level) tenant: GenerativeEngine + its
+    DecodeLoop (serving/generative.py) instead of the request-granular
+    Dispatcher — requests are admitted per ITERATION, not per batch."""
+
+    __slots__ = ("name", "engine", "queue", "dispatcher")
+
+    def __init__(self, name, engine):
+        from .generative import DecodeLoop
+
+        self.name = name
+        self.engine = engine
+        self.queue = RequestQueue()
+        self.dispatcher = DecodeLoop(engine, self.queue, label=name)
+
+
 class InferenceServer:
     """``load`` tenants, ``submit``/``predict`` requests, ``swap``
     checkpoints, ``start_endpoint`` for socket clients."""
@@ -103,6 +119,10 @@ class InferenceServer:
             if self._closed:
                 raise RuntimeError("server closed")
         tenant = self._tenant(name)
+        if isinstance(tenant, _GenTenant):
+            raise TypeError("tenant %r is generative — hot swap serves "
+                            "the predict tier; reload the generative "
+                            "tenant instead" % (name,))
         shadow = ModelEngine(model_dir, place=self.place,
                              max_batch=self.max_batch, warm=warm,
                              name=name)
@@ -110,12 +130,38 @@ class InferenceServer:
         _M_SWAPS.inc()
         return shadow
 
+    def load_generative(self, name, config, params, quant="",
+                        kv_blocks=None, warm=True):
+        """Load a generative (autoregressive decode) tenant: a
+        GenerativeEngine built from ``(config, params)`` — e.g.
+        ``generative.tiny_lm`` output — with int8 weight quantization
+        gated per tenant via ``quant='int8'``.  Requests go through
+        ``generate()``; the tenant runs token-level continuous batching
+        (serving/generative.py), not the predict dispatcher."""
+        from .generative import GenerativeEngine
+
+        self._check_loadable(name)
+        engine = GenerativeEngine(config, params, quant=quant,
+                                  kv_blocks=kv_blocks, name=name,
+                                  place=self.place, warm=warm)
+        try:
+            with self._lock:
+                self._check_loadable(name, locked=True)
+                self._tenants[name] = _GenTenant(name, engine)
+                _M_MODELS.set(len(self._tenants))
+        except Exception:
+            engine.close()
+            raise
+        return engine
+
     def unload(self, name):
         with self._lock:
             tenant = self._tenants.pop(name, None)
             _M_MODELS.set(len(self._tenants))
         if tenant is not None:
             tenant.dispatcher.stop()
+            if isinstance(tenant, _GenTenant):
+                tenant.engine.close()
 
     def _tenant(self, name):
         with self._lock:
@@ -137,6 +183,9 @@ class InferenceServer:
         """Enqueue one request; returns a Future resolving to
         {fetch_name: ndarray} with the request's own batch dim."""
         tenant = self._tenant(name)
+        if isinstance(tenant, _GenTenant):
+            raise TypeError("tenant %r is generative — use generate(), "
+                            "not submit/predict" % (name,))
         feed = {k: np.asarray(v) for k, v in feed.items()}
         rows = tenant.engine.validate(feed)
         fut = Future()
@@ -147,6 +196,49 @@ class InferenceServer:
 
     def predict(self, name, feed, timeout=None):
         return self.submit(name, feed).result(timeout)
+
+    def generate(self, name, prompt, max_new_tokens, eos_id=None):
+        """Enqueue one generate request against a generative tenant;
+        returns a Future resolving to ``{"tokens": [...], "ttft_ms":
+        float, "itl_ms": [...], "preempted": int}``.  Greedy decode;
+        the request joins the tenant's running decode batch at the next
+        iteration the block pool can hold its prompt."""
+        from . import generative as _gen
+        from .generative import GenRequest
+
+        tenant = self._tenant(name)
+        if not isinstance(tenant, _GenTenant):
+            raise TypeError("tenant %r is a predict model — generate() "
+                            "needs a load_generative tenant" % (name,))
+        prompt = [int(t) for t in np.asarray(prompt).reshape(-1)]
+        if not prompt:
+            raise ValueError("empty prompt")
+        if max(prompt) >= tenant.engine.config.vocab or min(prompt) < 0:
+            raise ValueError("prompt token out of range [0, %d)"
+                             % tenant.engine.config.vocab)
+        if int(max_new_tokens) < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+        # reject HERE, not in the decode loop (MIGRATION.md contract):
+        # an unadmittable request would otherwise wedge the tenant —
+        # admission is FIFO and stops at the first request that does
+        # not fit, so a prompt that can NEVER fit blocks all behind it
+        cfg = tenant.engine.config
+        if len(prompt) > cfg.max_seq:
+            raise ValueError(
+                "prompt length %d exceeds max_seq %d (block_size x "
+                "max_blocks)" % (len(prompt), cfg.max_seq))
+        pool = tenant.engine.pool
+        if pool.blocks_for(len(prompt)) > pool.capacity:
+            raise ValueError(
+                "prompt needs %d KV blocks but the tenant's pool holds "
+                "%d — raise FLAGS_serve_kv_blocks"
+                % (pool.blocks_for(len(prompt)), pool.capacity))
+        fut = Future()
+        if _batcher._METRICS_ON:
+            _gen._M_GEN_REQS.inc()
+        tenant.queue.put(GenRequest(prompt, max_new_tokens, eos_id,
+                                    fut))
+        return fut
 
     # -- socket endpoint -----------------------------------------------
     def start_endpoint(self, port=0, host="127.0.0.1"):
@@ -172,6 +264,8 @@ class InferenceServer:
             self._endpoint = None
         for t in tenants:
             t.dispatcher.stop()
+            if isinstance(t, _GenTenant):
+                t.engine.close()
 
     def __enter__(self):
         return self

@@ -371,13 +371,14 @@ def test_serve_bench_quick_smoke():
     """tools/serve_bench.py --quick completes in seconds on the CPU
     backend and reports the full artifact schema (wired like
     pserver_bench --quick).  Perf gates (speedup/p99) are asserted by
-    the full bench run, not here — CI boxes vary."""
+    the full bench run, not here — CI boxes vary.  --mode predict:
+    the generate-mode smoke lives in test_generative_serving.py."""
     env = dict(os.environ)
     env.update(JAX_PLATFORMS="cpu", SVB_D_IN="32", SVB_HIDDEN="64",
                SVB_MAX_BATCH="8")
     proc = subprocess.run(
         [sys.executable, os.path.join(REPO, "tools", "serve_bench.py"),
-         "--quick", "--seconds", "0.4"],
+         "--quick", "--seconds", "0.4", "--mode", "predict"],
         capture_output=True, text=True, timeout=300, env=env, cwd=REPO)
     assert proc.returncode in (0, 1), proc.stderr[-2000:]
     line = proc.stdout.strip().splitlines()[-1]

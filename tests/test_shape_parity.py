@@ -149,6 +149,37 @@ def test_fake_batch_sentinel_dynamic_dim_still_maps():
     assert tuple(shape) == (-1, 10), shape
 
 
+@pytest.mark.parametrize("vocab", [97, 89],
+                         ids=["primary-sentinel", "alt-sentinel"])
+def test_decode_shaped_program_sentinel_dims_stay_static(vocab):
+    """ISSUE 11 satellite: the generative decode step is the shape
+    most likely to trip the sentinel — dynamic batch, seq-len 1, and a
+    logits dim that may equal EITHER sentinel (vocab_size=97 collides
+    with _FAKE_BATCH, 89 with _FAKE_BATCH_ALT).  Both must survive
+    inference as static dims while the batch still maps to -1."""
+    prog, block, op = _one_op_program([-1, 1, vocab])
+    shape, dtype = lowering.infer_op_outputs(prog, block, op)["out"]
+    assert tuple(shape) == (-1, 1, vocab), shape
+    assert np.dtype(dtype) == np.float32
+
+
+def test_decode_shaped_matmul_sentinel_logits_dim():
+    """The decode lm_head matmul itself: [-1, d] @ [d, 97] — the
+    inferred logits dim must stay 97, not decay to dynamic."""
+    from paddle_tpu.core.types import np_dtype_to_proto
+
+    prog = core_desc.ProgramDesc()
+    block = prog.blocks[0]
+    dt = np_dtype_to_proto(np.dtype(np.float32))
+    block.add_var(core_desc.VarDesc("h", shape=[-1, 8], dtype=dt))
+    block.add_var(core_desc.VarDesc("w", shape=[8, 97], dtype=dt))
+    block.add_var(core_desc.VarDesc("logits", shape=[-1, 97], dtype=dt))
+    op = block.append_op(core_desc.OpDesc(
+        "mul", {"X": ["h"], "Y": ["w"]}, {"Out": ["logits"]}, {}))
+    shape, _ = lowering.infer_op_outputs(prog, block, op)["logits"]
+    assert tuple(shape) == (-1, 97), shape
+
+
 @pytest.mark.parametrize("op_type,ins,outs,attrs", ANCHORS,
                          ids=[a[0] for a in ANCHORS])
 def test_abstract_inference_anchor(op_type, ins, outs, attrs):

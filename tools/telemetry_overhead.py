@@ -7,7 +7,10 @@ observatory's METRICS mode (health fetch enabled).  Since ISSUE 9 the
 serving tier joins the gate: its per-request metric observations
 (queue-wait/occupancy/request-latency) must cost < 2% of a
 single-request serve, measured as a metrics-on vs metrics-off A/B
-through the in-process request plane.
+through the in-process request plane.  Since ISSUE 11 the generative
+decode loop joins too: the per-token metric op set (tokens/TTFT/ITL/
+occupancy) must cost < 2% of the measured inter-token latency,
+decomposed the same way.
 
 Method for the disabled path — deterministic, not an A/B wall-clock
 race (2% of a ~50 µs dispatch loop is far below scheduler noise on
@@ -254,6 +257,51 @@ def _measure_serving_us(n=None, repeats=3):
     return on_us, on_us - probe_us
 
 
+def _measure_generate_us(tokens=None, repeats=3):
+    """Decode-loop metrics gate (ISSUE 11 satellite): metrics-on vs
+    metrics-off INTER-TOKEN latency through the generative tier,
+    decomposed like the serving gate above (the per-token metric op set
+    costs single-digit µs against a multi-ms decode iteration — a
+    wall-clock A/B is all scheduler noise):
+
+    1. measure the inter-token latency as shipped (metrics ON): one
+       generative tenant, single-sequence closed-loop greedy decode,
+       mean inter-token gap per run, min over repeats;
+    2. micro-time ``generative.token_metrics_probe`` — the COMPLETE
+       per-token op set in the single-sequence worst case (per-
+       iteration ops not amortized across batch neighbours);
+    3. metrics-off latency = on - probe by construction.
+
+    Returns (on_us, off_us)."""
+    from paddle_tpu import serving
+    from paddle_tpu.serving import generative as gen_mod
+    from paddle_tpu.serving import tiny_lm
+
+    n = tokens or int(os.environ.get("GENERATE_OVERHEAD_TOKENS", "96"))
+    cfg, params = tiny_lm(5, vocab=64, d_model=64, n_heads=4,
+                          n_layers=2, d_ff=128, block_size=16,
+                          max_blocks=8, max_batch=2)
+    prompt = list(range(8))
+    on_us = float("inf")
+    with serving.InferenceServer() as srv:
+        srv.load_generative("g", cfg, params, kv_blocks=32, warm=False)
+        srv.generate("g", prompt, max_new_tokens=8).result(120)  # warm
+        for _ in range(repeats):
+            res = srv.generate("g", prompt,
+                               max_new_tokens=n).result(600)
+            itl = res["itl_ms"]
+            on_us = min(on_us, 1e3 * sum(itl) / len(itl))
+    gen_mod.token_metrics_probe(1000)   # warm
+    probe_us = float("inf")
+    iters = 20000
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        gen_mod.token_metrics_probe(iters)
+        probe_us = min(probe_us,
+                       (time.perf_counter() - t0) / iters * 1e6)
+    return on_us, on_us - probe_us
+
+
 def main(argv=None):
     step_us = _measure_step_us()
     probe_ns = _measure_probe_ns()
@@ -270,6 +318,9 @@ def main(argv=None):
     serve_on_us, serve_off_us = _measure_serving_us()
     serve_frac = max(0.0, serve_on_us - serve_off_us) / serve_off_us
     serve_limit = float(os.environ.get("SERVING_OVERHEAD_MAX", "0.02"))
+    gen_on_us, gen_off_us = _measure_generate_us()
+    gen_frac = max(0.0, gen_on_us - gen_off_us) / gen_off_us
+    gen_limit = float(os.environ.get("GENERATE_OVERHEAD_MAX", "0.02"))
     out = {
         "step_us": round(step_us, 2),
         "probe_ns_per_site": round(probe_ns, 1),
@@ -292,8 +343,15 @@ def main(argv=None):
         "serving_request_off_us": round(serve_off_us, 2),
         "serving_overhead_frac": round(serve_frac, 5),
         "serving_limit": serve_limit,
+        # ISSUE 11: generative decode loop — per-token metric op set
+        # vs measured inter-token latency
+        "generate_itl_on_us": round(gen_on_us, 2),
+        "generate_itl_off_us": round(gen_off_us, 2),
+        "generate_overhead_frac": round(gen_frac, 5),
+        "generate_limit": gen_limit,
         "ok": (frac < limit and num_frac < num_limit
-               and serve_frac < serve_limit),
+               and serve_frac < serve_limit
+               and gen_frac < gen_limit),
     }
     print(json.dumps(out))
     return 0 if out["ok"] else 1

@@ -99,6 +99,12 @@ def main(argv=None):
                          "(grad bytes raw vs on-wire, codec encode "
                          "time, fastwire traffic, staleness gap per "
                          "process — ISSUE 10)")
+    ap.add_argument("--serve", action="store_true",
+                    help="print the serving-tier rollup (requests/"
+                         "tokens, decode-batch occupancy, TTFT and "
+                         "inter-token latency, paged KV cache "
+                         "pressure: blocks used/total, allocation "
+                         "failures, preemptions — ISSUE 11)")
     args = ap.parse_args(argv)
 
     # numerics trip artifacts ride the same dump dir as trace dumps;
@@ -132,14 +138,16 @@ def main(argv=None):
         if (args.kernels or not args.json) else []
     nrows = export.numerics_rows(dumps) if args.numerics else []
     wrows = export.wire_rows(dumps) if args.wire else []
+    srows = export.serve_rows(dumps) if args.serve else []
     if args.json:
-        if args.numerics or args.kernels or args.wire:
+        if args.numerics or args.kernels or args.wire or args.serve:
             # one wrapped object, keys present for the rollups asked
             # for; bare phase rows stay the no-flag contract
             print(json.dumps(dict(
                 {"phases": rows, "kernels": krows},
                 **({"numerics": nrows} if args.numerics else {}),
-                **({"wire": wrows} if args.wire else {})), indent=2))
+                **({"wire": wrows} if args.wire else {}),
+                **({"serve": srows} if args.serve else {})), indent=2))
         else:
             print(json.dumps(rows, indent=2))
     else:
@@ -169,6 +177,10 @@ def main(argv=None):
             print("\nwire rollup (grad compression / fastwire traffic "
                   "/ staleness per process):")
             print(export.format_wire_table(wrows))
+        if args.serve:
+            print("\nserve rollup (requests/tokens / decode occupancy "
+                  "/ TTFT+ITL / paged KV pressure per process):")
+            print(export.format_serve_table(srows))
     if trips:
         _print_trips(trips)
     if not rows:
