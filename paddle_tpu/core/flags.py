@@ -278,6 +278,52 @@ define_flag("dist_hier_local", 0,
 define_flag("dist_hier_port", 18970,
             "base TCP port of the host-local aggregation channel; "
             "group g listens on dist_hier_port + g")
+define_flag("ledger_sample_ms", 250,
+            "resource-ledger sampling interval, milliseconds "
+            "(observability/ledger.py): a background collector reads "
+            "every registered per-subsystem probe (pserver pending "
+            "grads, reply/replay caches, barrier quorum, apply "
+            "backlog, hier fan-in buffers, fastwire sockets) at this "
+            "rate, exports the values as ledger_* gauges, and appends "
+            "them to a bounded time-series ring that rides every "
+            "flight-recorder dump.  0 disables the collector (probes "
+            "still answer on-demand snapshots).  Overhead gated < 2% "
+            "by tools/telemetry_overhead.py")
+define_flag("ledger_ring", 2048,
+            "samples retained by the resource-ledger time-series ring "
+            "(oldest evict first); the flight recorder embeds the "
+            "newest slice of it")
+define_flag("ledger_watch", "",
+            "collapse tripwires: comma-separated 'resource>value' "
+            "terms (e.g. 'pserver_pending_grad_bytes>100000000').  "
+            "When a sampled ledger value crosses its threshold the "
+            "collector writes ONE flight-recorder dump per resource "
+            "per process (reason 'ledger:<resource>') carrying the "
+            "full ledger series — the scale lab's collapse forensics "
+            "(tools/scale_bench.py --collapse)")
+define_flag("pserver_reply_cache_mb", 256,
+            "byte cap (MB) of the pserver per-shard reply cache "
+            "(encoded param frames served to every trainer's get).  "
+            "Least-recently-used entries evict past the cap "
+            "(pserver_reply_cache_evictions_total counts them) — an "
+            "eviction only costs a re-encode on the next get, so a "
+            "256-trainer run cannot OOM the server through cached "
+            "replies.  0 = unbounded (the pre-ISSUE-12 behavior)")
+define_flag("rpc_replay_cache_mb", 512,
+            "byte cap (MB) of the trainer-side per-endpoint replay "
+            "cache (post-codec grads retained for reconnect replay; "
+            "k+1 rounds under bounded staleness).  Oldest non-current "
+            "rounds evict first (rpc_replay_cache_evictions_total); "
+            "an evicted round is unrecoverable on a server restart "
+            "and walks forward as an empty apply, exactly like a "
+            "round outside the staleness window — see MIGRATION.md.  "
+            "0 = unbounded (the pre-ISSUE-12 behavior)")
+define_flag("barrier_rescan", False,
+            "legacy O(trainers) barrier-quorum bookkeeping: rescan "
+            "the whole sender map on every ack instead of maintaining "
+            "the quorum count incrementally.  Exists for the scale "
+            "lab's before/after A/B (tools/scale_bench.py "
+            "--before-after) — never enable in production")
 define_flag("auto_layout", False,
             "single-device accelerator path: AOT-compile with XLA-chosen "
             "(AUTO) parameter layouts and keep persistable buffers in "

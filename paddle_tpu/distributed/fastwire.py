@@ -48,6 +48,38 @@ _M_TX = _obs_metrics.counter(
     "fastwire_bytes_sent_total", "bytes written to fastwire sockets")
 _M_RX = _obs_metrics.counter(
     "fastwire_bytes_recv_total", "bytes read from fastwire sockets")
+# socket-population ledger (ISSUE 12): every accepted fastwire
+# connection holds one server thread for its lifetime, so the live
+# connection count IS the server's socket backlog resource — at 256
+# trainers it is the thread bill the scale lab charts.  In-flight
+# counts dispatches currently inside a handler (queue depth behind
+# the server lock).  Tracked as ABSOLUTE module counts and .set()
+# into the gauges (delta inc/dec would stick negative after any
+# mid-run metrics.zero_all() — the kv_cache.py:BlockPool lesson);
+# per-connection / per-frame cadence, same budget class as the byte
+# counters above.
+_M_CONNS = _obs_metrics.gauge(
+    "fastwire_server_conns",
+    "live accepted fastwire connections (one server thread each)")
+_M_INFLIGHT = _obs_metrics.gauge(
+    "fastwire_inflight_requests",
+    "fastwire frames currently inside a server handler")
+_live_lock = threading.Lock()
+_live = {"conns": 0, "inflight": 0}
+
+
+def _live_adj(key, delta, gauge):
+    with _live_lock:
+        _live[key] += delta
+        gauge.set(_live[key])
+
+
+from paddle_tpu.observability import ledger as _ledger
+
+_ledger.register("fastwire", lambda: {
+    "fastwire_server_conns": _live["conns"],
+    "fastwire_inflight_requests": _live["inflight"],
+})
 
 MAGIC = b"FW1\n"
 METHODS = {"SendVariable": 1, "GetVariable": 2,
@@ -279,6 +311,7 @@ class FastServer:
 
     def _serve_conn(self, fd):
         lib = self._lib
+        _live_adj("conns", 1, _M_CONNS)
         try:
             if bytes(_recv_exact(lib, fd, len(MAGIC))) != MAGIC:
                 return
@@ -296,18 +329,24 @@ class FastServer:
                 if ent is None:
                     return
                 fn, mode = ent
-                if mode == "stream":
-                    # the handler writes length-prefixed frames itself,
-                    # each the moment its shard is ready
-                    fn(payload,
-                       lambda parts: _send_parts(lib, fd, parts))
-                else:
-                    reply = fn(payload) or b""
-                    _send_bytes(lib, fd,
-                                [struct.pack("<Q", len(reply)), reply])
+                _live_adj("inflight", 1, _M_INFLIGHT)
+                try:
+                    if mode == "stream":
+                        # the handler writes length-prefixed frames
+                        # itself, each the moment its shard is ready
+                        fn(payload,
+                           lambda parts: _send_parts(lib, fd, parts))
+                    else:
+                        reply = fn(payload) or b""
+                        _send_bytes(
+                            lib, fd,
+                            [struct.pack("<Q", len(reply)), reply])
+                finally:
+                    _live_adj("inflight", -1, _M_INFLIGHT)
         except ConnectionError:
             pass
         finally:
+            _live_adj("conns", -1, _M_CONNS)
             lib.fw_close(fd)
 
     def stop(self):

@@ -40,6 +40,7 @@ import time
 import numpy as np
 
 from paddle_tpu.core.flags import FLAGS
+from paddle_tpu.observability import ledger as _ledger
 from paddle_tpu.observability import metrics as _obs_metrics
 
 __all__ = ["enabled", "role", "Role", "HostAggregator", "reset"]
@@ -126,6 +127,13 @@ class HostAggregator:
         self._completed = set()
         self._inflight = 0    # eager uploads currently on the wire
         self._errs = []       # eager-upload failures, surfaced at flush
+        # fan-in buffer ledger (ISSUE 12): bytes/entries of follower
+        # contributions held by this leader, maintained at stash/pop
+        # sites and sampled by the observability ledger collector
+        self._buf_bytes = 0
+        self._buf_entries = 0
+        self._ledger_handle = _ledger.register(
+            "hier", HostAggregator._ledger_probe, owner=self)
         self._server = fastwire.FastServer(
             port, {"HierSend": self._h_send,
                    "HierBarrier": self._h_barrier,
@@ -189,13 +197,35 @@ class HostAggregator:
         if key not in r:
             r[key] = {}
             self._order.setdefault(round_, []).append(key)
+        old = r[key].get(sender)
+        if old is not None:
+            self._buf_bytes -= _ledger.value_nbytes(old)
+        else:
+            self._buf_entries += 1
+        self._buf_bytes += _ledger.value_nbytes(arr)
         r[key][sender] = arr
         if self._upload is not None and len(r[key]) >= self.n_local:
             self._order[round_].remove(key)
             self._shipped.setdefault(round_, set()).add(key)
             self._inflight += 1
-            return [(key[0], key[1], r.pop(key))]
+            contrib = r.pop(key)
+            self._buf_drop_locked(contrib)
+            return [(key[0], key[1], contrib)]
         return []
+
+    def _buf_drop_locked(self, contrib):
+        """One contribution dict leaves the fan-in buffer (lock held)."""
+        for v in contrib.values():
+            self._buf_bytes -= _ledger.value_nbytes(v)
+            self._buf_entries -= 1
+
+    def _ledger_probe(self):
+        """Leader fan-in resource ledger: buffered follower
+        contributions awaiting their group's completion, plus eager
+        uploads still on the wire."""
+        return {"hier_fanin_bytes": self._buf_bytes,
+                "hier_fanin_entries": self._buf_entries,
+                "hier_inflight_uploads": self._inflight}
 
     def _ship_async(self, ready):
         """Run _ship off the caller's thread: the LEADER's own send op
@@ -299,6 +329,8 @@ class HostAggregator:
             order = self._order.pop(round_, [])
             self._barriers.pop(round_, None)
             self._shipped.pop(round_, None)
+            for contrib in grads.values():
+                self._buf_drop_locked(contrib)
         out = []
         for key in order:
             out.append((key[0], key[1], self._aggregate(grads[key])))
@@ -311,6 +343,7 @@ class HostAggregator:
                        deadline, "follower completions")
 
     def stop(self):
+        _ledger.unregister(self._ledger_handle)
         try:
             self._server.stop()
         except Exception:

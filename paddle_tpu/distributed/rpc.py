@@ -84,6 +84,25 @@ _M_STALE_GAP = _obs_metrics.gauge(
     "pserver_staleness_gap",
     "barriered-round spread between the fastest and slowest live "
     "trainer (bounded-staleness mode; 0 in lockstep sync)")
+# scale observatory (ISSUE 12): cache-eviction meters for the bounded
+# reply/replay caches, and the quorum-bookkeeping work counter the
+# before/after sweep charts (legacy rescan walks O(trainers) entries
+# per ack; the incremental path walks 1)
+_M_REPLY_EVICT = _obs_metrics.counter(
+    "pserver_reply_cache_evictions_total",
+    "encoded-reply entries evicted past FLAGS_pserver_reply_cache_mb")
+_M_REPLAY_EVICT = _obs_metrics.counter(
+    "rpc_replay_cache_evictions_total",
+    "replay-cache rounds evicted past FLAGS_rpc_replay_cache_mb "
+    "(an evicted round is unrecoverable on server restart and walks "
+    "forward as an empty apply)")
+_M_QUORUM_SCAN = _obs_metrics.counter(
+    "pserver_quorum_scan_ops_total",
+    "sender-map entries walked by barrier-quorum bookkeeping "
+    "(incremental: ~2 per ack amortized; FLAGS_barrier_rescan legacy: "
+    "O(trainers) per ack)")
+
+from paddle_tpu.observability import ledger as _ledger
 
 # wire-format version: 2 adds compressed frames (kind byte 2).  A
 # client only ships them to an endpoint whose WireVersion RPC
@@ -445,9 +464,13 @@ class VariableServer:
         self._param_ready = {}
         self._applying = False
         self._apply_target = -1
-        # (name -> (ready-round, encoded parts)): both trainers fetch
-        # the same shard every round — materialize + encode it once
+        # (name -> (ready-round, encoded parts, nbytes)): both trainers
+        # fetch the same shard every round — materialize + encode it
+        # once.  Byte-capped by FLAGS_pserver_reply_cache_mb (LRU:
+        # insertion order refreshed on hit); _reply_bytes is the
+        # incremental ledger the cap and the resource probe read.
         self._reply_cache = {}
+        self._reply_bytes = 0
         # per-shard reader/writer fence: an optimize block DONATES its
         # param buffers to the jit call, so a prefetch gathering rows
         # from the zero-copy scope view must exclude the window where
@@ -464,6 +487,22 @@ class VariableServer:
         self._barrier_rounds = {}
         self._legacy_barriers = 0       # anonymous (empty-payload) barriers
         self._anon_seq = 0
+        # incremental barrier quorum (ISSUE 12): count of LIVE,
+        # non-completed senders whose high-water barrier reached
+        # _applied_round — maintained O(1) on the hot ack path and
+        # recomputed O(senders) only on the rare events (round apply,
+        # lease expiry, completion).  FLAGS_barrier_rescan restores
+        # the legacy full rescan per ack for the scale lab's A/B.
+        self._quorum = 0
+        self._barrier_hi = -1           # max round any sender barriered
+        self._stale_next = 0.0          # staleness-gauge refresh throttle
+        # resource ledgers (ISSUE 12): incremental byte/entry counters
+        # for the per-(round, sender) pending map, sampled by the
+        # observability ledger collector via _ledger_probe
+        self._pending_bytes = 0
+        self._pending_entries = 0
+        self._round_entries = {}        # round -> live pending entries
+        self._round_seen = {}           # round -> first-seen monotonic
         self._senders = {}              # sender -> {"label", "last_seen"}
         self._expired = set()           # senders removed by lease expiry
         self._completed = set()         # senders that sent SendComplete
@@ -484,6 +523,10 @@ class VariableServer:
         # rounds that are visible AND safe against a crash: equal to
         # _applied_round except inside a checkpoint-write window
         self._durable_round = self._applied_round
+        # weakref-owned: a server that is simply dropped (tests) falls
+        # out of the ledger without an explicit unregister
+        self._ledger_handle = _ledger.register(
+            "pserver", VariableServer._ledger_probe, owner=self)
 
         handlers = {
             "SendVariable": self._h(self._send_variable),
@@ -576,6 +619,27 @@ class VariableServer:
         if getattr(self, "_fast", None) is not None:
             self._fast.stop()
         self._server.stop(grace=1).wait()
+        _ledger.unregister(self._ledger_handle)
+
+    def _ledger_probe(self):
+        """Resource-ledger probe (ISSUE 12): O(1) reads of the
+        incremental counters this class maintains on its own paths.
+        Deliberately lock-free — GIL-consistent int reads; a torn
+        sample is a diagnostic hiccup, a probe that contends the
+        server lock at collector cadence is overhead."""
+        backlog = self._barrier_hi - self._applied_round + 1
+        seen = list(self._round_seen.values())
+        oldest = (time.monotonic() - min(seen)) if seen else 0.0
+        return {
+            "pserver_pending_grad_bytes": self._pending_bytes,
+            "pserver_pending_grad_entries": self._pending_entries,
+            "pserver_reply_cache_bytes": self._reply_bytes,
+            "pserver_reply_cache_entries": len(self._reply_cache),
+            "pserver_barrier_set": self._quorum + self._legacy_barriers,
+            "pserver_apply_backlog_rounds": max(0, backlog),
+            "pserver_oldest_pending_age_s": round(oldest, 3),
+            "pserver_known_senders": len(self._senders),
+        }
 
     # -- condition helpers --
     def _wait_cv(self, pred, ctx):
@@ -601,27 +665,59 @@ class VariableServer:
         if sender in self._expired:
             self._expired.discard(sender)
             self._alive = min(self._alive + 1, self.fanin_total)
+            if self._barrier_rounds.get(sender, -1) \
+                    >= self._applied_round \
+                    and sender not in self._completed:
+                # rejoined WITH a standing barrier for the current
+                # round: it re-enters the incremental quorum
+                self._quorum += 1
+                _M_QUORUM_SCAN.inc()
 
     def _barrier_count(self):
         """Barriers witnessing the round about to apply (lock held):
         LIVE senders whose highest barriered round reached
-        _applied_round, plus the legacy anonymous count.  Completed and
-        expired senders are excluded on purpose: their grads for every
-        round they witnessed are already in (or gone forever), and
-        counting their persistent high-water barriers against the
-        ``alive`` quota would let rounds apply before a slower LIVE
-        peer barriered them — that peer's late grads would then be
-        dedup-dropped as stale, violating the bounded-staleness
-        contract (delayed <= k, never discarded).  An unseen live
-        trainer contributes nothing here, so the count also cannot
-        reach ``alive`` while someone has not even connected."""
+        _applied_round, plus the legacy anonymous count.  Served from
+        the incrementally-maintained ``_quorum`` — the legacy full
+        rescan (FLAGS_barrier_rescan) cost O(trainers) per ack, i.e.
+        O(trainers²) per round, the first knee the scale lab charts
+        (tools/scale_bench.py --before-after)."""
+        if FLAGS.barrier_rescan:
+            return self._barrier_scan_locked()
+        return self._quorum + self._legacy_barriers
+
+    def _barrier_scan_locked(self):
+        """The full-rescan quorum (lock held) — the pre-ISSUE-12
+        definition, kept as the A/B arm and the parity oracle for
+        ``_quorum``.  Completed and expired senders are excluded on
+        purpose: their grads for every round they witnessed are
+        already in (or gone forever), and counting their persistent
+        high-water barriers against the ``alive`` quota would let
+        rounds apply before a slower LIVE peer barriered them — that
+        peer's late grads would then be dedup-dropped as stale,
+        violating the bounded-staleness contract (delayed <= k, never
+        discarded).  An unseen live trainer contributes nothing here,
+        so the count also cannot reach ``alive`` while someone has not
+        even connected."""
+        _M_QUORUM_SCAN.inc(len(self._barrier_rounds))
         return sum(1 for s, r in self._barrier_rounds.items()
                    if r >= self._applied_round
                    and s not in self._completed
                    and s not in self._expired) + self._legacy_barriers
 
+    def _quorum_recompute_locked(self):
+        """Rebuild ``_quorum`` from scratch (lock held) — the rare-
+        event path: round apply (applied_round moved), lease expiry,
+        sender completion.  Hot acks never pay this walk."""
+        _M_QUORUM_SCAN.inc(len(self._barrier_rounds))
+        self._quorum = sum(1 for s, r in self._barrier_rounds.items()
+                           if r >= self._applied_round
+                           and s not in self._completed
+                           and s not in self._expired)
+
     def _barrier_max(self):
-        return max(self._barrier_rounds.values(), default=-1)
+        # _barrier_hi is maintained at every barrier write and can only
+        # grow, exactly like max() over the (never-shrinking) map
+        return self._barrier_hi
 
     def _maybe_apply_locked(self):
         """Apply every round whose barriers are complete (lock held).
@@ -686,6 +782,9 @@ class VariableServer:
                     if now - ent["last_seen"] > self.trainer_lease:
                         self._expired.add(sender)
                         self._alive -= 1
+                # expiry changes quorum membership; lease cadence is
+                # rare, so the full rebuild is the simple correct move
+                self._quorum_recompute_locked()
                 snapshot = self._maybe_apply_locked()
             self._persist_and_ack(snapshot)
 
@@ -699,7 +798,7 @@ class VariableServer:
         if name not in self._pending:
             # direct write (e.g. init push or non-optimized var)
             self.scope.set(name, arr)
-            self._reply_cache.pop(name, None)
+            self._reply_drop_locked(name)
             return
         if sender is None:
             key = (int(round_) if isinstance(round_, int) else 0,
@@ -730,7 +829,20 @@ class VariableServer:
             # insertion (= arrival) order and the aggregation mean are
             # bit-identical to the round-keyless wire.
             key = (int(round_), sender)
-        self._pending[name][key] = arr
+        ent = self._pending[name]
+        old = ent.get(key)
+        if old is not None:
+            # same-key replay overwrites: swap its bytes in the ledger
+            self._pending_bytes -= _ledger.value_nbytes(old)
+        else:
+            self._pending_entries += 1
+            r = key[0]
+            self._round_entries[r] = self._round_entries.get(r, 0) + 1
+            # first pending entry of this round stamps its age — the
+            # ledger's oldest-round-age resource reads it
+            self._round_seen.setdefault(r, time.monotonic())
+        self._pending_bytes += _ledger.value_nbytes(arr)
+        ent[key] = arr
         if not self.sync_mode:
             self._apply_one(name)
             if sender is not None and seq:
@@ -821,8 +933,18 @@ class VariableServer:
                     sp.args = {"sender": label}
                 self._touch(sender, label)
                 if round_ >= self._applied_round:
-                    self._barrier_rounds[sender] = max(
-                        self._barrier_rounds.get(sender, -1), round_)
+                    prev = self._barrier_rounds.get(sender, -1)
+                    self._barrier_rounds[sender] = max(prev, round_)
+                    if round_ > self._barrier_hi:
+                        self._barrier_hi = round_
+                    if prev < self._applied_round \
+                            and sender not in self._completed \
+                            and sender not in self._expired:
+                        # first barrier from this sender to reach the
+                        # applying round: O(1) quorum bump — the whole
+                        # point of the incremental bookkeeping
+                        self._quorum += 1
+                        _M_QUORUM_SCAN.inc()
                     self._update_staleness_locked()
                     if self.staleness > 0:
                         # wake the apply worker; this handler only
@@ -854,7 +976,16 @@ class VariableServer:
         return b""
 
     def _update_staleness_locked(self):
-        """Refresh the fast-vs-slow barrier spread gauge (lock held)."""
+        """Refresh the fast-vs-slow barrier spread gauge (lock held).
+        Throttled past 32 senders: the spread scan is O(senders), and
+        per-ack it would be O(trainers²) per round at 256 trainers —
+        a 20 Hz gauge is every bit as observable.  Small fanins stay
+        per-ack exact."""
+        if len(self._barrier_rounds) > 32:
+            now = time.monotonic()
+            if now < self._stale_next:
+                return
+            self._stale_next = now + 0.05
         live = [r for s, r in self._barrier_rounds.items()
                 if s not in self._expired and s not in self._completed]
         if len(live) >= 2:
@@ -967,14 +1098,28 @@ class VariableServer:
             "pserver could not materialize %r: buffer repeatedly "
             "invalidated by concurrent applies" % name)
 
+    def _reply_drop_locked(self, name):
+        """Remove one reply-cache entry, keeping the byte ledger exact
+        (lock held)."""
+        ent = self._reply_cache.pop(name, None)
+        if ent is not None:
+            self._reply_bytes -= ent[2]
+
     def _materialize_locked(self, name, ctx=None):
         """Encoded parts for ``name``'s current value (lock held).
         Cached per shard-round: with fanin trainers fetching the same
         shard every round, the host materialization + encode happens
-        once, not fanin times."""
+        once, not fanin times.  Byte-capped (ISSUE 12): past
+        FLAGS_pserver_reply_cache_mb the least-recently-served entries
+        evict (metered) — an eviction only costs a re-encode on the
+        next get, so cached replies can never OOM a 256-trainer
+        server."""
         key = self._param_ready.get(name, self._applied_round)
         ent = self._reply_cache.get(name)
         if ent is not None and ent[0] == key:
+            # LRU refresh: dicts iterate in insertion order, so a
+            # move-to-end keeps eviction aimed at cold shards
+            self._reply_cache[name] = self._reply_cache.pop(name)
             return ent[1]
         # materialize INSIDE the lock: a concurrent async-mode apply
         # donates the param's device buffer, invalidating it
@@ -982,20 +1127,32 @@ class VariableServer:
         if val is None:
             return []
         parts = _enc_tensor_parts(name, val)
-        self._reply_cache[name] = (key, parts)
+        self._reply_drop_locked(name)   # stale-round entry, if any
+        nbytes = _parts_nbytes(parts)
+        self._reply_cache[name] = (key, parts, nbytes)
+        self._reply_bytes += nbytes
+        cap = float(FLAGS.pserver_reply_cache_mb) * 1e6
+        while cap > 0 and self._reply_bytes > cap \
+                and len(self._reply_cache) > 1:
+            oldest = next(iter(self._reply_cache))
+            if oldest == name:
+                break   # never evict the entry being served
+            self._reply_drop_locked(oldest)
+            _M_REPLY_EVICT.inc()
         return parts
 
     def _invalidate_locked(self, gname):
         """Drop cached replies a just-applied block may have rewritten
         (lock held).  Without a grad->outputs map we cannot know what
         the block wrote — drop everything."""
-        self._reply_cache.pop(gname, None)
+        self._reply_drop_locked(gname)
         outs = self.grad_params.get(gname)
         if outs is None:
             self._reply_cache.clear()
+            self._reply_bytes = 0
         else:
             for p in outs:
-                self._reply_cache.pop(p, None)
+                self._reply_drop_locked(p)
 
     def _get_variable(self, req, ctx=None):
         name, round_ = _dec_msg(req)
@@ -1187,6 +1344,9 @@ class VariableServer:
                     self._expired.discard(sender)
                 else:
                     self._alive -= 1
+            # completion excludes the sender from the quorum — rebuild
+            # (once per trainer lifetime; never on the ack path)
+            self._quorum_recompute_locked()
             if self._alive <= 0:
                 # drain before shutdown: under bounded staleness the
                 # last k rounds can still be pending when the final
@@ -1232,7 +1392,21 @@ class VariableServer:
             keys = list(ent)
         else:
             keys = [k for k in ent if k[0] <= upto]
-        vals = [ent.pop(k) for k in keys]
+        vals = []
+        for k in keys:
+            v = ent.pop(k)
+            vals.append(v)
+            self._pending_bytes -= _ledger.value_nbytes(v)
+            self._pending_entries -= 1
+            # key is (round, sender) from _store_grad_locked; tolerate
+            # a bare round key (tests inject entries directly)
+            r = k[0] if isinstance(k, tuple) else int(k)
+            n = self._round_entries.get(r, 0) - 1
+            if n <= 0:
+                self._round_entries.pop(r, None)
+                self._round_seen.pop(r, None)
+            else:
+                self._round_entries[r] = n
         if not vals:
             return None
         if any(isinstance(v, SelectedRows) for v in vals):
@@ -1331,6 +1505,10 @@ class VariableServer:
         self._applied_round = nxt
         _M_PS_ROUNDS.inc()
         self._legacy_barriers = 0
+        # applied_round moved: the quorum's membership predicate
+        # changed for every sender — one O(senders) rebuild per ROUND
+        # (vs per ack in the legacy rescan)
+        self._quorum_recompute_locked()
         self._cv.notify_all()
 
 
@@ -1372,6 +1550,25 @@ class RPCClient:
         self._wire_ver = {}       # ep -> negotiated wire version
         self._barrier_pending = None  # (threads, errs) of in-flight
         #                           overlapped barriers (launch/join)
+        # replay-cache byte ledger (ISSUE 12): maintained under
+        # _cache_lock wherever rounds/grads are recorded or pruned;
+        # FLAGS_rpc_replay_cache_mb caps it (oldest non-current rounds
+        # evict, metered).  Weakref-owned probe: test-created extra
+        # clients fall out of the ledger when collected.
+        self._replay_bytes = 0
+        self._ledger_handle = _ledger.register(
+            "rpc_client", RPCClient._ledger_probe, owner=self)
+
+    def _ledger_probe(self):
+        """Client-side resource ledger: replay-cache footprint and the
+        error-feedback residual store (both can only be judged per
+        process — the server never sees them)."""
+        rounds = sum(len(eph) for eph in self._round_cache.values())
+        res = sum(int(getattr(a, "nbytes", 0))
+                  for a in self._residuals.values())
+        return {"rpc_replay_cache_bytes": self._replay_bytes,
+                "rpc_replay_cache_rounds": rounds,
+                "rpc_residual_bytes": res}
 
     @classmethod
     def instance(cls):
@@ -1449,18 +1646,55 @@ class RPCClient:
         sender threads.  Rounds older than the bounded-staleness
         replay window (step - staleness) are pruned here."""
         seq = self._next_seq()
+        nb = _ledger.value_nbytes(arr)
         with self._cache_lock:
             eph = self._round_cache.setdefault(ep, {})
             c = eph.get(self.step)
             if c is None:
-                c = eph[self.step] = {"grads": {}, "barriered": False}
+                c = eph[self.step] = {"grads": {}, "barriered": False,
+                                      "bytes": 0}
                 keep = self.step - max(0, int(FLAGS.dist_staleness))
                 for r in [r for r in eph if r < keep]:
+                    self._replay_bytes -= eph[r]["bytes"]
                     del eph[r]
             # latest value per name: a round resend replaces, never
             # appends
+            old = c["grads"].get(name)
+            if old is not None:
+                onb = _ledger.value_nbytes(old[0])
+                c["bytes"] -= onb
+                self._replay_bytes -= onb
             c["grads"][name] = (arr, seq)
+            c["bytes"] += nb
+            self._replay_bytes += nb
+            self._evict_replay_locked()
         return seq
+
+    def _evict_replay_locked(self):
+        """Enforce FLAGS_rpc_replay_cache_mb (cache lock held): evict
+        whole retained ROUNDS, oldest first across endpoints, never the
+        in-flight round (a retry of the current send must find its
+        recorded frames).  An evicted round is unrecoverable on a
+        server restart and walks forward as a cheap empty apply —
+        exactly the fate of a round outside the staleness window
+        (MIGRATION.md).  If the current round alone exceeds the cap,
+        correctness wins over the cap."""
+        cap = float(FLAGS.rpc_replay_cache_mb) * 1e6
+        if cap <= 0:
+            return
+        while self._replay_bytes > cap:
+            oldest_ep = oldest_r = None
+            for ep, eph in self._round_cache.items():
+                for r in eph:
+                    if r >= self.step:
+                        continue
+                    if oldest_r is None or r < oldest_r:
+                        oldest_ep, oldest_r = ep, r
+            if oldest_r is None:
+                return
+            c = self._round_cache[oldest_ep].pop(oldest_r)
+            self._replay_bytes -= c["bytes"]
+            _M_REPLAY_EVICT.inc()
 
     def _recorded(self, ep, name, round_=None):
         """The cached (arr, seq) of this round's send of ``name`` to

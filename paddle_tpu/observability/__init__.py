@@ -8,8 +8,17 @@ hang flight recorder.
              JSON snapshot exports
   export     merge per-process dumps (+ xplane device traces) into one
              chrome://tracing JSON; per-phase breakdown rows
-  flight     dump the ring + open spans + metrics on watchdog timeout,
-             wall-budget expiry, injected faults, SIGTERM/SIGALRM
+  flight     dump the ring + open spans + metrics + resource ledgers
+             on watchdog timeout, wall-budget expiry, injected
+             faults, SIGTERM/SIGALRM
+  ledger     (ISSUE 12) per-subsystem resource ledgers: pserver
+             pending grads / reply cache / barrier quorum / apply
+             backlog, client replay cache, hier fan-in buffers,
+             fastwire sockets — incremental byte/entry counters
+             sampled by a low-rate collector into ledger_* gauges +
+             a bounded time-series ring; FLAGS_ledger_watch turns a
+             crossed threshold into a flight dump (collapse
+             forensics for tools/scale_bench.py)
   numerics   (ISSUE 8) on-device tensor-health guards: fused per-step
              health reduction over watched tensors, four-mode
              escalation (FLAGS_check_numerics =

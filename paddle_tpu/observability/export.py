@@ -23,7 +23,7 @@ from . import metrics
 __all__ = ["load_dump", "chrome_trace", "merge_files", "phase_rows",
            "format_phase_table", "kernel_rows", "format_kernel_table",
            "numerics_rows", "format_numerics_table", "serve_rows",
-           "format_serve_table"]
+           "format_serve_table", "scale_rows", "format_scale_table"]
 
 
 def load_dump(path):
@@ -361,6 +361,64 @@ def format_serve_table(rows):
                        r["itl_p50_ms"], r["itl_p99_ms"],
                        r["kv_blocks_used"], r["kv_blocks_total"],
                        r["kv_alloc_failures"], r["preemptions"]))
+    return "\n".join(out)
+
+
+def scale_rows(dumps):
+    """Scale-observatory rollup (ISSUE 12): per process dump, the
+    resource-ledger gauges the collector mirrors into the always-on
+    registry — pending-grad footprint, reply/replay cache bytes and
+    their metered evictions, the live barrier set, apply backlog and
+    oldest-pending age, hier fan-in buffers, fastwire socket
+    population, and the quorum-bookkeeping work counter.  Works on any
+    trace OR flight dump (the metrics snapshot rides both); flight
+    dumps additionally carry the full ledger time series under their
+    'ledger' key."""
+    rows = []
+    for d in dumps:
+        m = d.get("metrics", {})
+
+        def val(name, default=0):
+            return (m.get(name) or {}).get("value", default)
+
+        rows.append({
+            "label": d.get("label", "?"),
+            "pending_bytes": val("ledger_pserver_pending_grad_bytes"),
+            "pending_entries": val(
+                "ledger_pserver_pending_grad_entries"),
+            "reply_cache_bytes": val(
+                "ledger_pserver_reply_cache_bytes"),
+            "reply_evictions": val(
+                "pserver_reply_cache_evictions_total"),
+            "replay_cache_bytes": val("ledger_rpc_replay_cache_bytes"),
+            "replay_evictions": val("rpc_replay_cache_evictions_total"),
+            "barrier_set": val("ledger_pserver_barrier_set"),
+            "apply_backlog_rounds": val(
+                "ledger_pserver_apply_backlog_rounds"),
+            "oldest_pending_age_s": val(
+                "ledger_pserver_oldest_pending_age_s"),
+            "hier_fanin_bytes": val("ledger_hier_fanin_bytes"),
+            "fastwire_conns": val("ledger_fastwire_server_conns"),
+            "quorum_scan_ops": val("pserver_quorum_scan_ops_total"),
+        })
+    rows.sort(key=lambda r: r["label"])
+    return rows
+
+
+def format_scale_table(rows):
+    out = ["%-22s %12s %8s %12s %7s %12s %7s %8s %8s %8s %10s" % (
+        "process", "pending_B", "entries", "reply_B", "replyEv",
+        "replay_B", "rplyEv", "barrier", "backlog", "oldest_s",
+        "scan_ops")]
+    for r in rows:
+        out.append(
+            "%-22s %12d %8d %12d %7d %12d %7d %8d %8d %8.2f %10d" % (
+                r["label"][:22], r["pending_bytes"],
+                r["pending_entries"], r["reply_cache_bytes"],
+                r["reply_evictions"], r["replay_cache_bytes"],
+                r["replay_evictions"], r["barrier_set"],
+                r["apply_backlog_rounds"], r["oldest_pending_age_s"],
+                r["quorum_scan_ops"]))
     return "\n".join(out)
 
 

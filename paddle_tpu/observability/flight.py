@@ -34,6 +34,7 @@ __all__ = ["dump", "note_fault", "install_signal_handlers"]
 
 # keep the artifact bounded even with a huge ring configured
 MAX_RECENT_SPANS = 1024
+MAX_LEDGER_SAMPLES = 256
 
 # RLock, same reasoning as metrics.py: a signal-handler dump (SIGTERM
 # arriving during a SIGALRM dump, both on the main thread) must not
@@ -61,6 +62,15 @@ def dump(reason, blocked=None, directory=None):
         os.makedirs(directory, exist_ok=True)
         from . import metrics
         spans = TRACER.completed(limit=MAX_RECENT_SPANS)
+        # resource-ledger snapshot (ISSUE 12): current per-subsystem
+        # values + the newest time-series slice, so a collapse
+        # artifact shows the resource curve INTO the failure.  Best
+        # effort like everything else here.
+        try:
+            from . import ledger as _ledger
+            ledger_snap = _ledger.snapshot(limit=MAX_LEDGER_SAMPLES)
+        except Exception:
+            ledger_snap = None
         rec = {
             "kind": "flight_recorder",
             "reason": str(reason),
@@ -72,6 +82,7 @@ def dump(reason, blocked=None, directory=None):
             "open_spans": TRACER.open_spans(),
             "recent_spans": spans,
             "metrics": metrics.snapshot(),
+            "ledger": ledger_snap,
         }
         path = os.path.join(
             directory, "flight_%d_%d.json" % (os.getpid(), _next_seq()))

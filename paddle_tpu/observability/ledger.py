@@ -1,0 +1,267 @@
+"""Per-subsystem resource ledgers (ISSUE 12): the "how big is it
+RIGHT NOW" half of the observability layer.
+
+Metrics answer "how many / how long"; traces answer "where did the
+time go".  Neither answers the question a 256-trainer collapse poses:
+*which bounded-in-theory data structure was growing when the protocol
+fell over* — the pserver's per-(round, sender) pending grads, the
+reply/replay caches, the live-sender barrier quorum, the apply
+worker's backlog, a hier leader's fan-in buffers, the fastwire socket
+population.  This module is that answer:
+
+- **Probes.**  A subsystem registers a cheap callable returning
+  ``{resource_name: number}`` — O(1) reads of byte/entry counters the
+  subsystem maintains incrementally on its own hot path (rpc.py,
+  hierarchy.py, fastwire.py).  Probes may be tied to an ``owner``
+  object via weakref so a dead server/client drops out of the ledger
+  without an explicit unregister.
+- **Collector.**  One daemon thread samples every probe at
+  ``FLAGS_ledger_sample_ms`` (0 disables), sums same-named resources
+  across probes, mirrors each value into an always-on ``ledger_<name>``
+  gauge (so every metrics snapshot — trace dumps, flight dumps,
+  Prometheus text — carries the latest ledger row), and appends the
+  sample to a bounded time-series ring (``FLAGS_ledger_ring``).
+- **Forensics.**  Every flight-recorder dump embeds
+  :func:`snapshot` — current values plus the newest ring slice — so a
+  collapse artifact shows the resource *curve into* the failure, not
+  just the final state.  ``FLAGS_ledger_watch`` ("resource>value"
+  terms) turns the collector into a tripwire: the first sample past a
+  threshold writes one flight dump per resource (reason
+  ``ledger:<resource>``), which is how tools/scale_bench.py pins each
+  driven collapse mode to evidence.
+
+Cost: the collector touches the ledger a few times a second; nothing
+here runs on a training/serving hot path (the incremental counters
+the probes read are maintained by their subsystems at per-event
+cadence, same budget class as the always-on metrics).  Gated < 2% by
+tools/telemetry_overhead.py like the trace/metrics/numerics gates.
+"""
+from __future__ import annotations
+
+import threading
+import time
+import weakref
+
+from paddle_tpu.core.flags import FLAGS
+
+from . import metrics as _metrics
+
+__all__ = ["register", "unregister", "collect", "sample_now",
+           "snapshot", "peaks", "series", "reset", "value_nbytes"]
+
+_lock = threading.RLock()
+_probes = {}          # handle -> (subsystem, fn, owner_ref or None)
+_last_rows = {}       # handle -> last successful probe row
+_next_handle = 0
+_ring = None          # deque of {"t", "values"}; built lazily
+_gauges = {}          # resource -> Gauge (registry-backed)
+_tripped = set()      # ledger-watch resources already dumped
+_collector = None     # the sampling thread, started lazily
+
+
+def value_nbytes(v):
+    """Byte footprint of one wire/pending value: dense ndarray,
+    SelectedRows (rows + values), or a post-codec Compressed frame
+    (whose own ``.nbytes`` property sums its codec arrays).  The ONE
+    definition the incremental byte ledgers in rpc.py / hierarchy.py
+    share."""
+    rows = getattr(v, "rows", None)
+    if rows is not None and hasattr(v, "values"):   # SelectedRows
+        return (int(getattr(rows, "nbytes", 0))
+                + int(getattr(v.values, "nbytes", 0)))
+    return int(getattr(v, "nbytes", 0))
+
+
+def register(subsystem, probe, owner=None):
+    """Register ``probe`` (callable -> {resource: number}).  With
+    ``owner``, the registration lives exactly as long as the owner
+    object (weakref) and ``probe`` is called as ``probe(owner)`` — the
+    natural form for a per-instance method (``Cls._ledger_probe``).
+    Returns an opaque handle for :func:`unregister`."""
+    global _next_handle
+    with _lock:
+        _next_handle += 1
+        handle = _next_handle
+        ref = weakref.ref(owner) if owner is not None else None
+        _probes[handle] = (str(subsystem), probe, ref)
+    _ensure_collector()
+    return handle
+
+
+def unregister(handle):
+    with _lock:
+        _probes.pop(handle, None)
+        _last_rows.pop(handle, None)
+
+
+def collect():
+    """One ledger row: every live probe read, same-named resources
+    SUMMED across probes (two servers in one test process report their
+    combined pending bytes).  A probe that RAISES serves its last
+    successful row instead — the lock-free probes can lose a race
+    with a dict resize exactly when the subsystem is busiest, and a
+    zeroed sample at that moment would make a collapse look idle.
+    Only a dead owner (weakref cleared) truly drops out."""
+    with _lock:
+        entries = list(_probes.items())
+    values = {}
+    dead = []
+    for handle, (_sub, fn, ref) in entries:
+        try:
+            if ref is not None:
+                obj = ref()
+                if obj is None:
+                    dead.append(handle)
+                    continue
+                row = fn(obj)
+            else:
+                row = fn()
+            _last_rows[handle] = dict(row or {})
+        except Exception:
+            row = _last_rows.get(handle)
+        for name, v in (row or {}).items():
+            values[name] = values.get(name, 0) + v
+    if dead:
+        with _lock:
+            for h in dead:
+                _probes.pop(h, None)
+                _last_rows.pop(h, None)
+    return values
+
+
+def _get_ring():
+    global _ring
+    if _ring is None:
+        from collections import deque
+        with _lock:
+            if _ring is None:
+                _ring = deque(maxlen=max(1, int(FLAGS.ledger_ring)))
+    return _ring
+
+
+def sample_now():
+    """Force one collector iteration: collect, mirror into gauges,
+    append to the ring, and fire any ledger-watch tripwires.  Returns
+    the sampled values (the collector thread calls this on cadence;
+    tests and dump paths call it directly)."""
+    values = collect()
+    for name, v in values.items():
+        g = _gauges.get(name)
+        if g is None:
+            g = _gauges[name] = _metrics.gauge(
+                "ledger_" + name, "resource ledger: " + name)
+        g.set(v)
+    # a resource whose probe died (server stopped, client collected)
+    # must read 0, not freeze at its last value — a later flight dump
+    # would otherwise attribute a collapse to a subsystem that no
+    # longer exists
+    for name, g in _gauges.items():
+        if name not in values:
+            g.set(0)
+    _get_ring().append({"t": round(time.time(), 3),
+                        "values": values})
+    _check_watch(values)
+    return values
+
+
+def _parse_watch():
+    out = []
+    for term in str(FLAGS.ledger_watch or "").split(","):
+        term = term.strip()
+        if ">" not in term:
+            continue
+        name, thr = term.split(">", 1)
+        try:
+            out.append((name.strip(), float(thr)))
+        except ValueError:
+            continue
+    return out
+
+
+def _check_watch(values):
+    watches = _parse_watch()
+    if not watches:
+        return
+    for name, thr in watches:
+        if name in _tripped or values.get(name, 0) <= thr:
+            continue
+        _tripped.add(name)
+        try:
+            from . import flight
+            flight.dump("ledger:%s" % name,
+                        blocked={"resource": name,
+                                 "value": values.get(name, 0),
+                                 "threshold": thr})
+        except Exception:
+            pass
+
+
+def snapshot(limit=256):
+    """The flight-recorder payload: fresh probe values plus the newest
+    ``limit`` ring samples (the curve INTO the failure)."""
+    try:
+        values = sample_now()
+    except Exception:
+        values = {}
+    ring = list(_get_ring())
+    if limit is not None and len(ring) > int(limit):
+        ring = ring[-int(limit):]
+    return {"resources": values, "series": ring}
+
+
+def series():
+    """The full retained time-series (newest last)."""
+    return list(_get_ring())
+
+
+def peaks():
+    """Max per resource over the retained series (+ the current
+    values) — the per-sweep-point resource curve tools/scale_bench.py
+    charts against trainer count."""
+    out = {}
+    for row in list(_get_ring()):
+        for name, v in row["values"].items():
+            if v > out.get(name, float("-inf")):
+                out[name] = v
+    return out
+
+
+def _ensure_collector():
+    global _collector
+    if _collector is not None or int(FLAGS.ledger_sample_ms) <= 0:
+        return
+    with _lock:
+        if _collector is not None:
+            return
+        t = threading.Thread(target=_collect_loop, daemon=True,
+                             name="ledger-collector")
+        _collector = t
+        t.start()
+
+
+def _collect_loop():
+    while True:
+        ms = int(FLAGS.ledger_sample_ms)
+        if ms <= 0:
+            time.sleep(0.25)
+            continue
+        time.sleep(ms / 1000.0)
+        with _lock:
+            empty = not _probes
+        if empty:
+            continue
+        try:
+            sample_now()
+        except Exception:
+            pass
+
+
+def reset():
+    """Drop probes, ring, and tripwire state (tests).  The collector
+    thread, once started, survives — it idles on an empty registry."""
+    global _ring
+    with _lock:
+        _probes.clear()
+        _last_rows.clear()
+        _tripped.clear()
+        _ring = None

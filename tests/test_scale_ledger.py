@@ -1,0 +1,423 @@
+"""Scale observatory (ISSUE 12): resource-ledger accounting, the
+bounded collector, collapse forensics, knee detection, the incremental
+barrier quorum, the bounded reply/replay caches, and the scale_bench
+--quick smoke."""
+import glob
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from paddle_tpu.core.flags import FLAGS
+from paddle_tpu.core.scope import Scope
+from paddle_tpu.distributed.rpc import (RPCClient, VariableServer,
+                                        _enc_msg, _enc_tensor,
+                                        _pack_round_sender)
+from paddle_tpu.observability import flight, ledger
+from paddle_tpu.observability import metrics as obs_metrics
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+A, B = 0x111111, 0x222222
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    prev = (FLAGS.pserver_reply_cache_mb, FLAGS.rpc_replay_cache_mb,
+            FLAGS.barrier_rescan, FLAGS.ledger_watch,
+            FLAGS.telemetry_dump_dir, FLAGS.dist_staleness,
+            FLAGS.ledger_ring)
+    ledger.reset()
+    yield
+    (FLAGS.pserver_reply_cache_mb, FLAGS.rpc_replay_cache_mb,
+     FLAGS.barrier_rescan, FLAGS.ledger_watch,
+     FLAGS.telemetry_dump_dir, FLAGS.dist_staleness,
+     FLAGS.ledger_ring) = prev
+    ledger.reset()
+    RPCClient.reset()
+
+
+def _grad(sender, round_, seq, n=16, fill=1.0):
+    return _enc_tensor("g1", np.full(n, fill, np.float32),
+                       _pack_round_sender(round_, sender, seq))
+
+
+def _barrier(sender, round_):
+    return _enc_msg("t%x" % sender, _pack_round_sender(round_, sender))
+
+
+def _server(fanin=2, staleness=0, grads=("g1",)):
+    scope = Scope()
+    return VariableServer(scope, {g: i for i, g in enumerate(grads)},
+                          lambda b: None, fanin=fanin,
+                          staleness=staleness)
+
+
+# ---------------------------------------------------------------------------
+# ledger accounting on the pserver
+# ---------------------------------------------------------------------------
+
+def test_pending_ledger_exact_under_injected_growth():
+    """k=2 lets one sender run ahead without the peer: every pending
+    byte/entry and the backlog/age resources must be EXACT."""
+    srv = _server(fanin=2, staleness=2)
+    nb = np.zeros(16, np.float32).nbytes
+    for r in range(3):
+        srv._send_variable(_grad(A, r, seq=r + 1))
+    probe = srv._ledger_probe()
+    assert probe["pserver_pending_grad_bytes"] == 3 * nb
+    assert probe["pserver_pending_grad_entries"] == 3
+    # a same-(round, sender) replay overwrites — no double count
+    srv._send_variable(_grad(A, 1, seq=9))
+    probe = srv._ledger_probe()
+    assert probe["pserver_pending_grad_bytes"] == 3 * nb
+    assert probe["pserver_pending_grad_entries"] == 3
+    # the peer contributes its own entries
+    srv._send_variable(_grad(B, 0, seq=1))
+    probe = srv._ledger_probe()
+    assert probe["pserver_pending_grad_bytes"] == 4 * nb
+    assert probe["pserver_pending_grad_entries"] == 4
+    assert probe["pserver_oldest_pending_age_s"] >= 0.0
+    # barriers for rounds 0..1 ack instantly at k=2 (durable > r-2)
+    # and no apply worker is running: backlog grows, quorum counts A
+    srv._send_barrier(_barrier(A, 0))
+    srv._send_barrier(_barrier(A, 1))
+    probe = srv._ledger_probe()
+    assert probe["pserver_apply_backlog_rounds"] == 2
+    assert probe["pserver_barrier_set"] == 1
+    assert probe["pserver_known_senders"] == 2
+
+
+def test_pending_ledger_drains_to_zero_after_apply():
+    srv = _server(fanin=2)
+    srv._send_variable(_grad(A, 0, seq=1))
+    srv._send_variable(_grad(B, 0, seq=1))
+    t = threading.Thread(target=srv._send_barrier,
+                         args=(_barrier(A, 0),))
+    t.start()
+    srv._send_barrier(_barrier(B, 0))
+    t.join(timeout=10)
+    assert not t.is_alive()
+    probe = srv._ledger_probe()
+    assert probe["pserver_pending_grad_bytes"] == 0
+    assert probe["pserver_pending_grad_entries"] == 0
+    assert probe["pserver_apply_backlog_rounds"] == 0
+    assert srv._round_seen == {} and srv._round_entries == {}
+
+
+def test_reply_cache_bytes_and_lru_eviction():
+    obs_metrics.zero_all()
+    srv = _server(fanin=1, grads=("g1", "g2", "g3"))
+    for name in ("p1", "p2", "p3"):
+        srv.scope.set(name, np.zeros(256, np.float32))
+    with srv._cv:
+        for name in ("p1", "p2"):
+            srv._materialize_locked(name)
+        exact = srv._reply_bytes
+        assert exact == sum(e[2] for e in srv._reply_cache.values())
+        assert set(srv._reply_cache) == {"p1", "p2"}
+        # serve p1 again: LRU order now p2, p1 — then cap to ~1 entry
+        srv._materialize_locked("p1")
+        FLAGS.pserver_reply_cache_mb = (exact / 2) / 1e6
+        srv._materialize_locked("p3")
+    ev = obs_metrics.snapshot()[
+        "pserver_reply_cache_evictions_total"]["value"]
+    assert ev >= 2
+    # the entry just served always survives; the LRU ones went first
+    assert "p3" in srv._reply_cache
+    assert srv._reply_bytes == sum(e[2]
+                                   for e in srv._reply_cache.values())
+
+
+def test_replay_cache_cap_evicts_oldest_rounds_not_current():
+    obs_metrics.zero_all()
+    RPCClient.reset()
+    cli = RPCClient.instance()
+    FLAGS.dist_staleness = 8          # retain many rounds
+    arr = np.zeros(1024, np.float32)  # 4 KB
+    for r in range(4):
+        cli.step = r
+        cli._record_send("ep0", "g1", arr)
+    assert cli._replay_bytes == 4 * arr.nbytes
+    # cap to ~2 rounds: the two OLDEST evict, the current survives
+    FLAGS.rpc_replay_cache_mb = (2 * arr.nbytes) / 1e6
+    cli.step = 4
+    cli._record_send("ep0", "g1", arr)
+    rounds = sorted(cli._round_cache["ep0"])
+    assert 4 in rounds and 0 not in rounds and 1 not in rounds
+    ev = obs_metrics.snapshot()[
+        "rpc_replay_cache_evictions_total"]["value"]
+    assert ev >= 2
+    assert cli._replay_bytes == sum(
+        c["bytes"] for eph in cli._round_cache.values()
+        for c in eph.values())
+    probe = cli._ledger_probe()
+    assert probe["rpc_replay_cache_bytes"] == cli._replay_bytes
+    assert probe["rpc_replay_cache_rounds"] == len(rounds)
+
+
+# ---------------------------------------------------------------------------
+# incremental barrier quorum
+# ---------------------------------------------------------------------------
+
+def test_quorum_incremental_matches_full_scan():
+    srv = _server(fanin=3, staleness=2)
+
+    def parity():
+        with srv._cv:
+            scan = srv._barrier_scan_locked()
+        assert srv._quorum + srv._legacy_barriers == scan
+
+    parity()
+    srv._send_barrier(_barrier(A, 0))
+    parity()
+    srv._send_barrier(_barrier(A, 1))   # same sender, higher round
+    parity()
+    assert srv._quorum == 1
+    srv._send_barrier(_barrier(B, 0))
+    parity()
+    assert srv._quorum == 2
+    # completion excludes the sender from the quorum
+    srv._send_complete(_enc_msg("tA", _pack_round_sender(2, A)))
+    parity()
+    assert srv._quorum == 1
+    # the legacy rescan flag answers the same number
+    FLAGS.barrier_rescan = True
+    with srv._cv:
+        legacy = srv._barrier_count()
+    FLAGS.barrier_rescan = False
+    with srv._cv:
+        assert srv._barrier_count() == legacy
+
+
+def test_quorum_scan_counter_separates_legacy_from_incremental():
+    """The before/after evidence channel: per-ack work is O(1) on the
+    incremental path and O(senders) under FLAGS_barrier_rescan."""
+    obs_metrics.zero_all()
+    srv = _server(fanin=64, staleness=4)
+    for i in range(32):
+        srv._send_barrier(_barrier(0x300000 + i, 0))
+    inc_ops = obs_metrics.snapshot()[
+        "pserver_quorum_scan_ops_total"]["value"]
+    # one +1 per ack (no apply happened): far below senders^2
+    assert inc_ops <= 64
+    obs_metrics.zero_all()
+    FLAGS.barrier_rescan = True
+    for i in range(32):
+        with srv._cv:
+            srv._barrier_count()
+    rescan_ops = obs_metrics.snapshot()[
+        "pserver_quorum_scan_ops_total"]["value"]
+    assert rescan_ops == 32 * 32
+
+
+# ---------------------------------------------------------------------------
+# collector / ring / flight integration
+# ---------------------------------------------------------------------------
+
+def test_collector_ring_is_bounded():
+    FLAGS.ledger_ring = 8
+    ledger.reset()
+    ledger.register("t", lambda: {"r": 1})
+    for _ in range(40):
+        ledger.sample_now()
+    assert len(ledger.series()) == 8
+    assert ledger.peaks() == {"r": 1}
+
+
+def test_probe_sum_weakref_and_gauge_export():
+    class Box:
+        def probe(self):
+            return {"x_bytes": 7}
+
+    b1, b2 = Box(), Box()
+    ledger.register("s1", Box.probe, owner=b1)
+    ledger.register("s2", Box.probe, owner=b2)
+    assert ledger.sample_now()["x_bytes"] == 14
+    assert obs_metrics.snapshot()["ledger_x_bytes"]["value"] == 14
+    del b2
+    import gc
+    gc.collect()
+    assert ledger.sample_now()["x_bytes"] == 7
+    # a resource whose LAST probe died must read 0, not freeze at its
+    # final value (a later flight dump would blame a dead subsystem)
+    del b1
+    gc.collect()
+    assert "x_bytes" not in ledger.sample_now()
+    assert obs_metrics.snapshot()["ledger_x_bytes"]["value"] == 0
+
+
+def test_transient_probe_failure_serves_last_row_not_zero():
+    """Regression (review): a probe losing a race (RuntimeError from a
+    lock-free dict walk) must serve its LAST row — zeroing it would
+    make the busiest sample of a collapse look idle.  Only a dead
+    owner drops the resource."""
+    state = {"boom": False}
+
+    class Box:
+        def probe(self):
+            if state["boom"]:
+                raise RuntimeError("dict changed size during iteration")
+            return {"p_bytes": 42}
+
+    b = Box()
+    ledger.register("t", Box.probe, owner=b)
+    assert ledger.sample_now()["p_bytes"] == 42
+    state["boom"] = True
+    assert ledger.sample_now()["p_bytes"] == 42   # last row, not 0
+    assert obs_metrics.snapshot()["ledger_p_bytes"]["value"] == 42
+    del b
+    import gc
+    gc.collect()
+    assert "p_bytes" not in ledger.sample_now()   # dead owner: gone
+    assert obs_metrics.snapshot()["ledger_p_bytes"]["value"] == 0
+
+
+def test_fastwire_gauges_absolute_across_zero_all():
+    """Regression (review): conn/inflight gauges are recomputed from
+    absolute live counts — a mid-run metrics.zero_all() (the bench
+    rebasing pattern) must not leave them stuck negative."""
+    from paddle_tpu.distributed import fastwire
+
+    base = fastwire._live["conns"]
+    fastwire._live_adj("conns", 1, fastwire._M_CONNS)
+    obs_metrics.zero_all()
+    fastwire._live_adj("conns", -1, fastwire._M_CONNS)
+    assert fastwire._live["conns"] == base
+    assert obs_metrics.snapshot()[
+        "fastwire_server_conns"]["value"] == base
+
+
+def test_flight_dump_contains_ledger_snapshot():
+    d = tempfile.mkdtemp(prefix="ledger_flight_")
+    ledger.register("t", lambda: {"pending": 1234})
+    ledger.sample_now()
+    path = flight.dump("test", directory=d)
+    with open(path) as f:
+        rec = json.load(f)
+    assert rec["ledger"]["resources"]["pending"] == 1234
+    assert any(s["values"].get("pending") == 1234
+               for s in rec["ledger"]["series"])
+
+
+def test_ledger_watch_trips_one_flight_dump():
+    d = tempfile.mkdtemp(prefix="ledger_watch_")
+    FLAGS.telemetry_dump_dir = d
+    FLAGS.ledger_watch = "grow_bytes>100"
+    state = {"v": 10}
+    ledger.register("t", lambda: {"grow_bytes": state["v"]})
+    ledger.sample_now()
+    assert glob.glob(os.path.join(d, "flight_*.json")) == []
+    state["v"] = 500
+    ledger.sample_now()
+    ledger.sample_now()   # second crossing must NOT dump again
+    arts = glob.glob(os.path.join(d, "flight_*.json"))
+    assert len(arts) == 1
+    with open(arts[0]) as f:
+        rec = json.load(f)
+    assert rec["reason"] == "ledger:grow_bytes"
+    assert rec["blocked"]["threshold"] == 100.0
+
+
+def test_hier_fanin_buffer_ledger():
+    from paddle_tpu.distributed import fastwire, hierarchy
+
+    if not fastwire.native_available():
+        pytest.skip("fastwire native library unavailable")
+    import socket
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    agg = hierarchy.HostAggregator(2, port)
+    try:
+        arr = np.ones(64, np.float32)
+        agg.stash(0, "ep0", "g1", arr, sender=A)
+        assert agg._ledger_probe() == {
+            "hier_fanin_bytes": arr.nbytes, "hier_fanin_entries": 1,
+            "hier_inflight_uploads": 0}
+        agg.stash(0, "ep0", "g1", arr, sender=A)   # overwrite
+        assert agg._ledger_probe()["hier_fanin_entries"] == 1
+        agg.stash(0, "ep0", "g1", arr * 3, sender=B)
+        assert agg._ledger_probe()["hier_fanin_bytes"] == 2 * arr.nbytes
+        agg._h_barrier(_barrier(B, 0))
+        out = agg.flush(0, deadline=10)
+        assert len(out) == 1
+        np.testing.assert_allclose(out[0][2], arr * 2)
+        probe = agg._ledger_probe()
+        assert probe["hier_fanin_bytes"] == 0
+        assert probe["hier_fanin_entries"] == 0
+    finally:
+        agg.stop()
+
+
+# ---------------------------------------------------------------------------
+# knee detection + rollup
+# ---------------------------------------------------------------------------
+
+def test_knee_detector_on_synthetic_curves():
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    try:
+        from scale_bench import detect_knee
+    finally:
+        sys.path.pop(0)
+    # perfectly linear scaling: no knee
+    assert detect_knee([(8, 800), (16, 1600), (32, 3200)]) is None
+    # saturation: marginal throughput/trainer collapses at 32
+    knee = detect_knee([(8, 800), (16, 1600), (32, 2000), (64, 2100)])
+    assert knee["trainers"] == 32
+    assert knee["marginal_per_trainer"] == 25.0
+    assert knee["base_per_trainer"] == 100.0
+    # regression past the knee still names the FIRST bend
+    knee = detect_knee([(8, 800), (16, 1500), (32, 1400)])
+    assert knee["trainers"] == 32
+    # degenerate inputs
+    assert detect_knee([(8, 800)]) is None
+    assert detect_knee([]) is None
+
+
+def test_scale_rows_rollup_reads_ledger_gauges():
+    from paddle_tpu.observability import export
+
+    dump = {"label": "pserver@x", "metrics": {
+        "ledger_pserver_pending_grad_bytes": {"value": 4096},
+        "ledger_pserver_barrier_set": {"value": 17},
+        "pserver_quorum_scan_ops_total": {"value": 99},
+        "rpc_replay_cache_evictions_total": {"value": 3},
+    }}
+    rows = export.scale_rows([dump])
+    assert rows[0]["pending_bytes"] == 4096
+    assert rows[0]["barrier_set"] == 17
+    assert rows[0]["quorum_scan_ops"] == 99
+    assert rows[0]["replay_evictions"] == 3
+    assert "pserver@x" in export.format_scale_table(rows)
+
+
+# ---------------------------------------------------------------------------
+# the harness itself (tier-1 smoke, like pserver_bench --quick)
+# ---------------------------------------------------------------------------
+
+def test_scale_bench_quick_smoke():
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.run(
+        [sys.executable, "tools/scale_bench.py", "--quick",
+         "--no-variants", "--trainers", "4,8", "--rounds", "2"],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=420)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    out = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert out["metric"] == "scale_bench" and out["quick"]
+    assert len(out["sweep"]) == 2
+    for row in out["sweep"]:
+        assert row["rows_per_sec"] > 0
+        assert row["barrier_ms_p99"] >= row["barrier_ms_p50"] > 0
+        peaks = row["ledger_peaks"]
+        assert peaks["pserver_pending_grad_bytes"] > 0
+        assert peaks["pserver_barrier_set"] == row["trainers"]
+    assert "knee" in out

@@ -56,6 +56,11 @@ PRESETS = {
     # guarantees survive the codec.
     "compressed": ("send_grad:drop:0.2:12,get_param:drop:0.2:12,"
                    "send_barrier:drop:0.3:6"),
+    # scale observatory (ISSUE 12): drive the pending-state collapse
+    # mode in tools/scale_bench.py (one straggler under a k=3 window)
+    # and FAIL unless the ledger tripwire left a flight artifact whose
+    # embedded ledger SERIES shows the growth — run_scale_preset()
+    "scale": "",
 }
 
 # extra environment a preset exports into the pytest run (and, by
@@ -105,6 +110,56 @@ def run_numerics_preset(pytest_args):
     else:
         print("preset 'numerics' FAILED (rc=%d); artifacts kept at %s"
               % (rc, dump_dir), file=sys.stderr)
+    return rc, time.time() - t0, dump_dir, matched
+
+
+def run_scale_preset():
+    """The 'scale' preset is a collapse-forensics check, not a fault
+    sweep: tools/scale_bench.py --collapse pending drives real pending-
+    state growth on a real pserver (straggler + staleness window), and
+    this runner FAILs (rc 3) unless a flight_*.json lands whose
+    'ledger' section carries a non-empty time series including the
+    pending-grad resource — the breadcrumb that makes a 256-trainer
+    collapse diagnosable after the fact."""
+    import json
+
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    out_json = os.path.join(tempfile.mkdtemp(prefix="fault_scale_"),
+                            "scale.json")
+    cmd = [sys.executable, "tools/scale_bench.py", "--quick",
+           "--no-sweep", "--collapse", "pending", "--json", out_json]
+    t0 = time.time()
+    proc = subprocess.run(cmd, cwd=REPO, env=env,
+                          stdout=subprocess.DEVNULL)
+    rc = proc.returncode
+    dump_dir, matched = "", 0
+    try:
+        with open(out_json) as f:
+            col = json.load(f).get("collapse", {})
+        dump_dir = col.get("dump_dir", "")
+        arts = glob.glob(os.path.join(dump_dir, "flight_*.json"))
+        for path in arts:
+            with open(path) as f:
+                led = json.load(f).get("ledger") or {}
+            series = led.get("series") or []
+            if any("pserver_pending_grad_bytes" in s.get("values", {})
+                   for s in series):
+                matched += 1
+    except Exception:
+        pass
+    if rc == 0 and matched == 0:
+        print("preset 'scale': no flight_*.json with ledger rows "
+              "naming pserver_pending_grad_bytes under %r — the "
+              "collapse was not attributed" % dump_dir,
+              file=sys.stderr)
+        rc = 3
+    if rc == 0:
+        shutil.rmtree(dump_dir, ignore_errors=True)
+        shutil.rmtree(os.path.dirname(out_json), ignore_errors=True)
+    else:
+        print("preset 'scale' FAILED (rc=%d); artifacts kept at %s"
+              % (rc, dump_dir or out_json), file=sys.stderr)
     return rc, time.time() - t0, dump_dir, matched
 
 
@@ -180,6 +235,10 @@ def main(argv=None):
         if name == "numerics":
             rc, secs, dump_dir, n_dumps = run_numerics_preset(
                 pytest_args)
+            rows.append((name, rc, secs, n_dumps))
+            continue
+        if name == "scale":
+            rc, secs, dump_dir, n_dumps = run_scale_preset()
             rows.append((name, rc, secs, n_dumps))
             continue
         rc, secs, dump_dir, n_dumps = run_preset(name, spec, args.seed,

@@ -1,0 +1,633 @@
+#!/usr/bin/env python
+"""Scale observatory (ISSUE 12): the 64-256-trainer stress lab.
+
+Every distributed number in this repo was measured at 2x2 on
+localhost; the protocol, though, is designed for hundreds of trainers.
+This harness finds where it actually collapses BEFORE production does:
+
+- **Process-multiplexed trainers.**  N *simulated* trainers — lean
+  protocol clients speaking the real wire ((round, sender, seq)
+  identities, batched SendVariables frames, durable barriers, batched
+  gathers, SendComplete) — are multiplexed as threads over a few
+  worker processes and driven against REAL pservers (full transpiled
+  listen_and_serv programs, the same VariableServer the training path
+  uses).  The workers never import jax: 256 trainers cost 8 light
+  processes, not 256 heavyweight ones.
+- **Sweep.**  trainers x staleness k x codec x hier-depth (hier-depth
+  L is simulated as fan-in reduction: the pserver sees trainers/L
+  group leaders, exactly what hierarchical aggregation presents to the
+  data plane).  Each point reports aggregate rows/s, barrier-latency
+  p50/p99, the pserver's resource-ledger PEAKS (pending-grad bytes,
+  reply-cache bytes, barrier set, apply backlog — observability/
+  ledger.py), and the quorum-bookkeeping work per round.
+- **Knee detection.**  ``detect_knee`` flags the first sweep point
+  whose marginal throughput per added trainer drops below a fraction
+  of the baseline per-trainer throughput.
+- **Collapse forensics** (``--collapse pending``): one straggler + a
+  k>0 window drives per-(round, sender) pending-state growth on the
+  pserver; ``FLAGS_ledger_watch`` trips a flight-recorder dump whose
+  embedded ledger series is the forensic artifact (asserted by the
+  tools/fault_matrix.py 'scale' preset).
+- **Before/after** (``--before-after``): re-runs a sweep subset with
+  the legacy O(trainers)-per-ack barrier rescan + unbounded caches
+  (FLAGS_barrier_rescan=1, cache caps 0) against the incremental
+  quorum + bounded caches, charting quorum scan ops/round and ledger
+  peaks — the measured proof for the ISSUE 12 collapse fix.
+
+Run:  python tools/scale_bench.py --json SCALE_BENCH.json
+      python tools/scale_bench.py --quick          # CI tier-1 smoke
+"""
+import argparse
+import glob
+import json
+import multiprocessing as mp
+import os
+import shutil
+import sys
+import tempfile
+import threading
+import time
+
+os.environ.setdefault("XLA_FLAGS",
+                      "--xla_force_host_platform_device_count=2")
+os.environ["JAX_PLATFORMS"] = "cpu"   # host-path benchmark, like pserver_bench
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+import numpy as np
+
+# dense model dims (grad = DIM_IN x DIM_OUT f32).  Env-overridable:
+# spawned children re-import this module and re-derive them.
+DIM_IN = int(os.environ.get("SCB_DIM_IN", "512"))
+DIM_OUT = int(os.environ.get("SCB_DIM_OUT", "128"))
+# nominal minibatch rows one simulated trainer round represents — the
+# rows/s numerator (a sync round ships one batch's grads per trainer)
+ROWS_PER_ROUND = int(os.environ.get("SCB_ROWS", "64"))
+N_PSERVERS = int(os.environ.get("SCB_PSERVERS", "2"))
+WORKER_PROCS = int(os.environ.get("SCB_PROCS", "8"))
+STRAGGLE_S = float(os.environ.get("SCB_STRAGGLE_S", "0.4"))
+
+KNEE_FRAC = float(os.environ.get("SCB_KNEE_FRAC", "0.5"))
+
+
+# ---------------------------------------------------------------------------
+# knee detection (unit-tested by tests/test_scale_ledger.py)
+# ---------------------------------------------------------------------------
+
+def detect_knee(points, frac=KNEE_FRAC):
+    """``points``: [(n_trainers, aggregate_throughput)], sorted by n.
+    The knee is the FIRST sweep point whose marginal throughput per
+    added trainer, (thr[i]-thr[i-1])/(n[i]-n[i-1]), drops below
+    ``frac`` x the baseline per-trainer throughput (thr[0]/n[0]) —
+    i.e. where adding trainers stops buying proportional throughput.
+    Returns {"trainers", "marginal_per_trainer", "base_per_trainer",
+    "threshold_frac"} or None when the curve never bends."""
+    pts = sorted((int(n), float(t)) for n, t in points)
+    if len(pts) < 2 or pts[0][0] <= 0:
+        return None
+    base = pts[0][1] / pts[0][0]
+    if base <= 0:
+        return None
+    for (n0, t0), (n1, t1) in zip(pts, pts[1:]):
+        marginal = (t1 - t0) / max(1, n1 - n0)
+        if marginal < frac * base:
+            return {"trainers": n1,
+                    "marginal_per_trainer": round(marginal, 3),
+                    "base_per_trainer": round(base, 3),
+                    "threshold_frac": frac}
+    return None
+
+
+# ---------------------------------------------------------------------------
+# pserver child: the REAL transpiled serve loop + a ledger-peaks report
+# ---------------------------------------------------------------------------
+
+def _build_model():
+    import paddle_tpu.fluid as fluid
+
+    zinit = fluid.initializer.ConstantInitializer(0.0)
+    x = fluid.layers.data(name="x", shape=[DIM_IN], dtype="float32")
+    y = fluid.layers.data(name="y", shape=[1], dtype="float32")
+    h = fluid.layers.fc(
+        input=x, size=DIM_OUT,
+        param_attr=fluid.ParamAttr(name="big_w", initializer=zinit),
+        bias_attr=False)
+    pred = fluid.layers.fc(
+        input=h, size=1,
+        param_attr=fluid.ParamAttr(name="head_w", initializer=zinit),
+        bias_attr=False)
+    loss = fluid.layers.mean(
+        fluid.layers.square_error_cost(input=pred, label=y))
+    fluid.optimizer.SGD(learning_rate=0.01).minimize(loss)
+    return loss
+
+
+def _transpile(pservers, n_senders):
+    import paddle_tpu.fluid as fluid
+
+    main, startup = fluid.Program(), fluid.Program()
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        with fluid.program_guard(main, startup):
+            with fluid.unique_name.guard():
+                _build_model()
+    t = fluid.DistributeTranspiler()
+    t.transpile(trainer_id=0, program=main, startup_program=startup,
+                pservers=pservers, trainers=n_senders, sync_mode=True)
+    return t, scope
+
+
+def trainer_routes(pservers, n_senders):
+    """[(ep, grad_block_name, param_block_name, shape)] — the wire
+    routing the transpiler stamped into the trainer's send/recv ops,
+    extracted so the simulated trainers can speak it without carrying
+    the whole fluid stack."""
+    t, _scope = _transpile(pservers, n_senders)
+    routes = []
+    for p, g in t.params_grads:
+        for blk in t.param_blocks[p]:
+            routes.append((t.block_ep[blk.name],
+                           t._grad_block_name(g, blk), blk.name,
+                           [int(d) for d in blk.shape]))
+    return routes
+
+
+def run_pserver(endpoint, pservers, n_senders, env, ledger_out):
+    for k, v in (env or {}).items():
+        os.environ[k] = v
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.observability import ledger as obs_ledger
+    from paddle_tpu.observability import metrics as obs_metrics
+
+    t, scope = _transpile(pservers, n_senders)
+    ps_prog = t.get_pserver_program(endpoint)
+    ps_startup = t.get_startup_program(endpoint, ps_prog)
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(scope):
+        exe.run(ps_startup)
+        exe.run(ps_prog)          # serves until every sender completes
+    # final sample + peaks over the whole run: the per-sweep-point
+    # resource curve the parent charts against trainer count
+    try:
+        obs_ledger.sample_now()
+    except Exception:
+        pass
+    snap = obs_metrics.snapshot()
+
+    def _val(name):
+        return (snap.get(name) or {}).get("value", 0)
+
+    rec = {
+        "endpoint": endpoint,
+        "ledger_peaks": obs_ledger.peaks(),
+        "rounds_applied": _val("pserver_rounds_applied_total"),
+        "quorum_scan_ops": _val("pserver_quorum_scan_ops_total"),
+        "reply_cache_evictions": _val(
+            "pserver_reply_cache_evictions_total"),
+        "dedup_drops": _val("pserver_dedup_drops_total"),
+    }
+    with open(ledger_out, "w") as f:
+        json.dump(rec, f)
+
+
+# ---------------------------------------------------------------------------
+# worker child: a few processes, many simulated-trainer threads, NO jax
+# ---------------------------------------------------------------------------
+
+class SimTrainer:
+    """One simulated trainer: the real wire protocol over a shared
+    per-process gRPC channel set.  Grad payloads are generated once
+    and re-sent each round under fresh (round, sender, seq)
+    identities — the pserver's bookkeeping (pending maps, dedup,
+    quorum, reply cache) does exactly the work a real trainer causes;
+    only the local SGD compute is elided."""
+
+    def __init__(self, sender_id, routes, channels, codec, timeout):
+        from paddle_tpu.distributed import compress as czip
+
+        self.sender = 0x0A0000 + sender_id
+        self.label = "sim%04d" % sender_id
+        self.timeout = timeout
+        self.channels = channels
+        self._seq = 0
+        rng = np.random.RandomState(1234 + sender_id)
+        self.by_ep = {}
+        for ep, gname, pname, shape in routes:
+            arr = rng.rand(*shape).astype(np.float32)
+            if codec:
+                # pre-encode once; the same post-codec frame re-sends
+                # every round (real trainers re-encode per round, but
+                # the pserver-side decode + bookkeeping — the stress
+                # target — is identical)
+                arr = czip.compress(arr, codec)
+            self.by_ep.setdefault(ep, []).append((gname, pname, arr))
+        self.round_s = []
+        self.barrier_s = []
+        # wall-clock bounds of the TIMED rounds (time.time: comparable
+        # across worker processes, unlike perf_counter) — round 0 is a
+        # warm-up (channel connect, first-apply jit) and must not
+        # dilute the throughput denominator
+        self.t_start = self.t_end = 0.0
+
+    def _call(self, ep, method, payload):
+        fn = self.channels[ep].unary_unary(
+            "/paddle_tpu.PServer/%s" % method)
+        return fn(payload, wait_for_ready=True, timeout=self.timeout)
+
+    def _next_seq(self):
+        self._seq = (self._seq % ((1 << 14) - 1)) + 1
+        return self._seq
+
+    def run(self, rounds, straggle_s=0.0):
+        from paddle_tpu.distributed.rpc import (
+            _enc_batch_parts, _enc_msg, _enc_tensor_parts, _join_parts,
+            _pack_round_sender)
+
+        eps = sorted(self.by_ep)
+        for r in range(rounds + 1):       # +1: round 0 is the warm-up
+            if r == 1:
+                self.t_start = time.time()
+            t_round = time.perf_counter()
+            if straggle_s and r > 0:
+                time.sleep(straggle_s)
+            for ep in eps:
+                frames = [
+                    _enc_tensor_parts(
+                        gname, arr,
+                        _pack_round_sender(r, self.sender,
+                                           self._next_seq()))
+                    for gname, _p, arr in self.by_ep[ep]]
+                self._call(ep, "SendVariables",
+                           _join_parts(_enc_batch_parts(frames)))
+            t_bar = time.perf_counter()
+            for ep in eps:     # same ep order on every sender: safe
+                self._call(ep, "SendBarrier",
+                           _enc_msg(self.label,
+                                    _pack_round_sender(r, self.sender)))
+            t_ack = time.perf_counter()
+            for ep in eps:
+                gets = [[_enc_msg(pname, r + 1)]
+                        for _g, pname, _a in self.by_ep[ep]]
+                self._call(ep, "GetVariables",
+                           _join_parts(_enc_batch_parts(gets)))
+            if r > 0:
+                self.round_s.append(time.perf_counter() - t_round)
+                self.barrier_s.append(t_ack - t_bar)
+                self.t_end = time.time()
+
+    def complete(self):
+        from paddle_tpu.distributed.rpc import _enc_msg, \
+            _pack_round_sender
+
+        for ep in sorted(self.by_ep):
+            try:
+                self._call(ep, "SendComplete",
+                           _enc_msg(self.label,
+                                    _pack_round_sender(0, self.sender)))
+            except Exception:
+                pass
+
+
+def run_workers(sender_ids, routes, rounds, straggler_ids, codec,
+                timeout, queue, env):
+    for k, v in (env or {}).items():
+        os.environ[k] = v
+    import grpc
+
+    eps = sorted({r[0] for r in routes})
+    channels = {ep: grpc.insecure_channel(
+        ep, options=[("grpc.max_send_message_length", -1),
+                     ("grpc.max_receive_message_length", -1)])
+        for ep in eps}
+    trainers = [SimTrainer(sid, routes, channels, codec, timeout)
+                for sid in sender_ids]
+    errs = {}
+
+    def one(tr, sid):
+        try:
+            tr.run(rounds,
+                   straggle_s=STRAGGLE_S if sid in straggler_ids else 0)
+        except Exception as e:
+            errs[sid] = "%s: %s" % (type(e).__name__, str(e)[:200])
+
+    ts = [threading.Thread(target=one, args=(tr, sid))
+          for tr, sid in zip(trainers, sender_ids)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    for tr in trainers:
+        tr.complete()
+    queue.put({
+        "senders": len(trainers),
+        # timed-round wall bounds only (warm-up excluded); the parent
+        # takes min(start)/max(end) ACROSS workers — time.time is the
+        # one clock comparable between processes on this host
+        "t_start": min((tr.t_start for tr in trainers
+                        if tr.t_start), default=0.0),
+        "t_end": max(tr.t_end for tr in trainers),
+        "round_s": [s for tr in trainers for s in tr.round_s],
+        "barrier_s": [s for tr in trainers for s in tr.barrier_s],
+        "errors": errs,
+    })
+
+
+# ---------------------------------------------------------------------------
+# one sweep point
+# ---------------------------------------------------------------------------
+
+def _pctl(vals, p):
+    # the ONE nearest-rank definition (observability/metrics.py) —
+    # scale_bench's p99 must agree with trace_report's for the same
+    # data.  Parent-process only; the jax-free workers never need it.
+    from paddle_tpu.observability.metrics import nearest_rank
+
+    return nearest_rank(sorted(vals), p)
+
+
+def run_point(trainers, base_port, rounds, staleness=0, codec="",
+              hier=1, extra_env=None, straggler_ids=(), dump_dir=None,
+              timeout=None):
+    """One (trainers, k, codec, hier) run; returns the sweep row."""
+    senders = trainers // max(1, hier)
+    if senders < 1:
+        raise ValueError("hier=%d leaves no senders for trainers=%d"
+                         % (hier, trainers))
+    timeout = timeout or max(120.0, rounds * 20.0)
+    ctx = mp.get_context("spawn")
+    eps = ["127.0.0.1:%d" % (base_port + i) for i in range(N_PSERVERS)]
+    pservers = ",".join(eps)
+    own_dump = dump_dir is None
+    if own_dump:
+        dump_dir = tempfile.mkdtemp(prefix="scale_bench_")
+    env = {
+        "FLAGS_dist_staleness": str(staleness),
+        "FLAGS_ledger_sample_ms": os.environ.get(
+            "SCB_LEDGER_MS", "50"),
+        "FLAGS_telemetry_dump_dir": dump_dir,
+        "SCB_DIM_IN": str(DIM_IN), "SCB_DIM_OUT": str(DIM_OUT),
+        # sim clients pre-encode frames; trainer-side codec flags are
+        # irrelevant to the children but the pserver decodes kind-2
+        # frames unconditionally
+    }
+    env.update(extra_env or {})
+    ledger_files = [os.path.join(dump_dir, "ledger_ps%d.json" % i)
+                    for i in range(N_PSERVERS)]
+    ps_procs = [ctx.Process(target=run_pserver,
+                            args=(ep, pservers, senders, env, lf))
+                for ep, lf in zip(eps, ledger_files)]
+    results, wk_procs = [], []
+    try:
+        for p in ps_procs:
+            p.start()
+        time.sleep(2.0)
+        routes = trainer_routes(pservers, senders)
+        q = ctx.Queue()
+        n_procs = max(1, min(senders, WORKER_PROCS))
+        chunks = [list(range(senders))[i::n_procs]
+                  for i in range(n_procs)]
+        wk_procs = [ctx.Process(
+            target=run_workers,
+            args=(chunk, routes, rounds, tuple(straggler_ids), codec,
+                  timeout, q, env))
+            for chunk in chunks if chunk]
+        for p in wk_procs:
+            p.start()
+        results = [q.get(timeout=timeout + 120) for _ in wk_procs]
+        for p in wk_procs + ps_procs:
+            p.join(timeout=120)
+    finally:
+        for p in wk_procs + ps_procs:
+            if p.is_alive():
+                p.terminate()
+                p.join(timeout=10)
+    starts = [r["t_start"] for r in results if r["t_start"]]
+    wall = (max(r["t_end"] for r in results) - min(starts)) \
+        if starts else 0.0
+    barrier_ms = [1e3 * s for r in results for s in r["barrier_s"]]
+    errors = {}
+    for r in results:
+        errors.update(r["errors"])
+    # merge pserver ledger reports: peak = max across shards, work
+    # counters summed
+    peaks, scans, applied = {}, 0, 0
+    for lf in ledger_files:
+        try:
+            with open(lf) as f:
+                rec = json.load(f)
+        except Exception:
+            continue
+        for k, v in rec.get("ledger_peaks", {}).items():
+            peaks[k] = max(peaks.get(k, 0), v)
+        scans += rec.get("quorum_scan_ops", 0)
+        applied += rec.get("rounds_applied", 0)
+    rps = rounds / wall if wall > 0 else 0.0
+    row = {
+        "trainers": trainers, "hier": hier, "senders": senders,
+        "staleness": staleness, "codec": codec or "raw",
+        "rounds": rounds,
+        "rounds_per_sec": round(rps, 3),
+        "rows_per_sec": int(rps * trainers * ROWS_PER_ROUND),
+        "round_ms_p50": round(
+            _pctl([1e3 * s for r in results for s in r["round_s"]], 50),
+            1),
+        "barrier_ms_p50": round(_pctl(barrier_ms, 50), 1),
+        "barrier_ms_p99": round(_pctl(barrier_ms, 99), 1),
+        "ledger_peaks": peaks,
+        "quorum_scan_ops_per_round": int(scans / applied)
+        if applied else 0,
+    }
+    if errors:
+        row["errors"] = dict(list(errors.items())[:4])
+    if own_dump:
+        shutil.rmtree(dump_dir, ignore_errors=True)
+    return row
+
+
+# ---------------------------------------------------------------------------
+# collapse forensics
+# ---------------------------------------------------------------------------
+
+def run_collapse(mode, trainers, base_port, rounds):
+    """Drive one collapse mode and return {mode, tripped,
+    flight_artifacts, ...}: a straggler under a k>0 window grows the
+    pserver's per-(round, sender) pending state; FLAGS_ledger_watch
+    turns the crossing into a flight dump whose embedded ledger series
+    is the forensic evidence."""
+    assert mode == "pending", "collapse modes: pending"
+    grad_bytes = DIM_IN * DIM_OUT * 4
+    k = 3
+    # threshold: ~1.5 fast rounds' worth of pending grads per shard —
+    # crossed only when the fast senders run ahead of the straggler
+    thr = int(0.75 * (trainers - 1) * grad_bytes)
+    dump_dir = tempfile.mkdtemp(prefix="scale_collapse_")
+    row = run_point(
+        trainers, base_port, rounds, staleness=k,
+        extra_env={
+            "FLAGS_ledger_watch":
+                "pserver_pending_grad_bytes>%d" % thr,
+            "FLAGS_ledger_sample_ms": "20",
+        },
+        straggler_ids=(0,), dump_dir=dump_dir)
+    arts = sorted(glob.glob(os.path.join(dump_dir, "flight_*.json")))
+    evidence = []
+    for path in arts:
+        try:
+            with open(path) as f:
+                rec = json.load(f)
+        except Exception:
+            continue
+        led = rec.get("ledger") or {}
+        series = led.get("series") or []
+        if not series:
+            continue
+        peak = max((s["values"].get("pserver_pending_grad_bytes", 0)
+                    for s in series), default=0)
+        evidence.append({
+            "path": path, "reason": rec.get("reason"),
+            "ledger_samples": len(series),
+            "peak_pending_grad_bytes": peak,
+        })
+    return {
+        "mode": mode, "trainers": trainers, "staleness": k,
+        "straggler_delay_s": STRAGGLE_S,
+        "watch_threshold_bytes": thr,
+        "tripped": bool(evidence),
+        "flight_artifacts": evidence,
+        "dump_dir": dump_dir,
+        "rounds_per_sec": row["rounds_per_sec"],
+        "ledger_peaks": row["ledger_peaks"],
+    }
+
+
+# ---------------------------------------------------------------------------
+# main
+# ---------------------------------------------------------------------------
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="scale observatory: N simulated trainers vs real "
+                    "pservers, resource-ledger curves, knee detection")
+    ap.add_argument("--quick", action="store_true",
+                    help="tiny dims, 4+8 trainers, 3 rounds: a "
+                         "seconds-scale smoke (CI tier-1)")
+    ap.add_argument("--json", metavar="PATH", default=None)
+    ap.add_argument("--trainers", default=None,
+                    help="comma-separated sweep counts "
+                         "(default 8,16,32,64,128,256)")
+    ap.add_argument("--rounds", type=int, default=None)
+    ap.add_argument("--collapse", choices=["pending"], default=None,
+                    help="drive one collapse mode and collect the "
+                         "ledger flight artifact")
+    ap.add_argument("--before-after", action="store_true",
+                    help="re-run a sweep subset with the legacy "
+                         "O(trainers) barrier rescan + unbounded "
+                         "caches vs the fixed path")
+    ap.add_argument("--no-sweep", action="store_true",
+                    help="skip the trainer-count sweep (e.g. with "
+                         "--collapse only)")
+    ap.add_argument("--no-variants", action="store_true",
+                    help="skip the staleness/codec/hier variants")
+    args = ap.parse_args(argv)
+
+    global DIM_IN, DIM_OUT
+    if args.quick:
+        os.environ.setdefault("SCB_DIM_IN", "128")
+        os.environ.setdefault("SCB_DIM_OUT", "32")
+        DIM_IN = int(os.environ["SCB_DIM_IN"])
+        DIM_OUT = int(os.environ["SCB_DIM_OUT"])
+        counts = [4, 8]
+        rounds = args.rounds or 3
+    else:
+        counts = [8, 16, 32, 64, 128, 256]
+        rounds = args.rounds or 6
+    if args.trainers:
+        counts = [int(c) for c in args.trainers.split(",")]
+
+    out = {
+        "metric": "scale_bench",
+        "quick": bool(args.quick),
+        "pservers": N_PSERVERS,
+        "worker_procs": WORKER_PROCS,
+        "grad_bytes_per_trainer_round": DIM_IN * DIM_OUT * 4,
+        "rows_per_trainer_round": ROWS_PER_ROUND,
+        "knee_threshold_frac": KNEE_FRAC,
+    }
+    port = 21310
+    if not args.no_sweep:
+        sweep = []
+        for n in counts:
+            try:
+                sweep.append(run_point(n, port, rounds))
+            except Exception as e:
+                sweep.append({"trainers": n,
+                              "error": str(e)[:200]})
+            port += 40
+            # emit-immediately discipline (bench.py): partial results
+            # survive a wall-budget kill of a later, bigger point
+            out["sweep"] = sweep
+            out["knee"] = detect_knee(
+                [(r["trainers"], r["rows_per_sec"])
+                 for r in sweep if "rows_per_sec" in r])
+            print(json.dumps({"partial": True, "sweep": sweep[-1]}),
+                  flush=True)
+    if not args.no_variants and not args.no_sweep:
+        base_n = min(64, max(counts))
+        variants = []
+        for label, kw in (
+                ("staleness_k2", {"staleness": 2}),
+                ("int8", {"codec": "int8"}),
+                ("hier_4", {"hier": 4}),
+                ("hier4_k2_int8", {"staleness": 2, "codec": "int8",
+                                   "hier": 4})):
+            if base_n // kw.get("hier", 1) < 1:
+                continue
+            try:
+                row = run_point(base_n, port, rounds, **kw)
+                row["variant"] = label
+                variants.append(row)
+            except Exception as e:
+                variants.append({"variant": label,
+                                 "error": str(e)[:200]})
+            port += 40
+        out["variants"] = variants
+    if args.before_after:
+        legacy_env = {"FLAGS_barrier_rescan": "1",
+                      "FLAGS_pserver_reply_cache_mb": "0",
+                      "FLAGS_rpc_replay_cache_mb": "0"}
+        subset = [c for c in counts if c <= 64] or counts[:3]
+        ba = {"legacy": [], "fixed": []}
+        for arm, env in (("legacy", legacy_env), ("fixed", {})):
+            for n in subset:
+                try:
+                    ba[arm].append(run_point(n, port, rounds,
+                                             extra_env=env))
+                except Exception as e:
+                    ba[arm].append({"trainers": n,
+                                    "error": str(e)[:200]})
+                port += 40
+        for arm in ("legacy", "fixed"):
+            ba["knee_" + arm] = detect_knee(
+                [(r["trainers"], r["rows_per_sec"])
+                 for r in ba[arm] if "rows_per_sec" in r])
+        out["before_after"] = ba
+    if args.collapse:
+        out["collapse"] = run_collapse(
+            args.collapse, 8 if args.quick else 16, port, rounds)
+
+    line = json.dumps(out)
+    print(line)
+    if args.json:
+        with open(args.json, "w") as f:
+            f.write(line + "\n")
+    # a requested collapse that left no ledger-bearing artifact is a
+    # failure — the fault_matrix 'scale' preset keys off this rc
+    if args.collapse and not out["collapse"]["tripped"]:
+        return 2
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

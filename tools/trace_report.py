@@ -99,6 +99,15 @@ def main(argv=None):
                          "(grad bytes raw vs on-wire, codec encode "
                          "time, fastwire traffic, staleness gap per "
                          "process — ISSUE 10)")
+    ap.add_argument("--scale", action="store_true",
+                    help="print the scale-observatory rollup "
+                         "(resource ledgers per process: pending-grad "
+                         "footprint, reply/replay cache bytes + "
+                         "evictions, barrier set, apply backlog, "
+                         "oldest-pending age, quorum scan work — "
+                         "ISSUE 12); flight dumps work as inputs too "
+                         "(their metrics snapshot carries the ledger "
+                         "gauges)")
     ap.add_argument("--serve", action="store_true",
                     help="print the serving-tier rollup (requests/"
                          "tokens, decode-batch occupancy, TTFT and "
@@ -139,15 +148,18 @@ def main(argv=None):
     nrows = export.numerics_rows(dumps) if args.numerics else []
     wrows = export.wire_rows(dumps) if args.wire else []
     srows = export.serve_rows(dumps) if args.serve else []
+    crows = export.scale_rows(dumps) if args.scale else []
     if args.json:
-        if args.numerics or args.kernels or args.wire or args.serve:
+        if args.numerics or args.kernels or args.wire or args.serve \
+                or args.scale:
             # one wrapped object, keys present for the rollups asked
             # for; bare phase rows stay the no-flag contract
             print(json.dumps(dict(
                 {"phases": rows, "kernels": krows},
                 **({"numerics": nrows} if args.numerics else {}),
                 **({"wire": wrows} if args.wire else {}),
-                **({"serve": srows} if args.serve else {})), indent=2))
+                **({"serve": srows} if args.serve else {}),
+                **({"scale": crows} if args.scale else {})), indent=2))
         else:
             print(json.dumps(rows, indent=2))
     else:
@@ -181,14 +193,21 @@ def main(argv=None):
             print("\nserve rollup (requests/tokens / decode occupancy "
                   "/ TTFT+ITL / paged KV pressure per process):")
             print(export.format_serve_table(srows))
+        if args.scale:
+            print("\nscale rollup (resource ledgers per process: "
+                  "pending grads / caches+evictions / barrier quorum "
+                  "/ apply backlog):")
+            print(export.format_scale_table(crows))
     if trips:
         _print_trips(trips)
     if not rows:
-        # a written --merge artifact is a success even when the table
-        # filter matched nothing (e.g. --prefix step. on pserver-only
-        # dumps); fail only when the run produced no output at all
+        # a written --merge artifact — or any requested rollup that
+        # produced rows (flight dumps carry metrics but no completed
+        # spans) — is a success even when the span table is empty;
+        # fail only when the run produced no output at all
         print("no completed spans matched", file=sys.stderr)
-        return 0 if args.merge else 1
+        return 0 if (args.merge or krows or nrows or wrows or srows
+                     or crows) else 1
     return 0
 
 
