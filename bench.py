@@ -439,6 +439,15 @@ def main():
         flight.install_signal_handlers(("SIGTERM",))
     except Exception:
         pass
+    # Watchtower (ISSUE 13): with FLAGS_tsdb_dir set, a bench run
+    # retains its whole metric history (bench_step_ms, compile-cache
+    # counters, numerics gauges) as durable time series the perf
+    # sentinel and watchtower report read afterwards
+    try:
+        from paddle_tpu.observability import tsdb as _tsdb
+        _tsdb.ensure_sampler()
+    except Exception:
+        pass
     on_accel = False
     try:
         import jax

@@ -324,6 +324,45 @@ define_flag("barrier_rescan", False,
             "the quorum count incrementally.  Exists for the scale "
             "lab's before/after A/B (tools/scale_bench.py "
             "--before-after) — never enable in production")
+define_flag("tsdb_dir", "",
+            "root directory of the Watchtower time-series store "
+            "(observability/tsdb.py).  When set, a background sampler "
+            "appends a fixed-interval snapshot of EVERY always-on "
+            "metric (counters/gauges + histogram percentiles, with "
+            "the resource ledger refreshed into the same row) to a "
+            "per-(label, pid) subdirectory of append-only binary "
+            "segments — the durable history the SLO engine "
+            "(FLAGS_slo_spec), tools/watchtower.py and "
+            "tools/perf_sentinel.py read.  Empty disables (the "
+            "default: nothing is written)")
+define_flag("tsdb_sample_ms", 250,
+            "Watchtower sampler interval, milliseconds; 0 disables "
+            "the background sampler (explicit "
+            "tsdb.sample_registry() calls still work).  Overhead "
+            "gated < 2% of the interval by "
+            "tools/telemetry_overhead.py")
+define_flag("tsdb_segment_bytes", 1 << 20,
+            "active tsdb segment seals and rotates at this size; "
+            "each sealed segment is one mmap-friendly fixed-width "
+            "binary file plus a row in the JSON meta index")
+define_flag("tsdb_retention_mb", 64,
+            "per-process tsdb byte budget: oldest sealed segments "
+            "drop once the store exceeds it (the active segment "
+            "always survives).  0 = unbounded")
+define_flag("slo_spec", "",
+            "SLO specs for the Watchtower burn-rate engine "
+            "(observability/slo.py): a .json/.toml file path or an "
+            "inline comma-separated objective list "
+            "('serve_request_ms.p99<=10,"
+            "pserver_rounds_applied_total.rate>=1').  With "
+            "FLAGS_tsdb_dir set, a background evaluator checks every "
+            "spec against the store on FLAGS_slo_eval_ms cadence; a "
+            "window whose burn rate crosses its threshold increments "
+            "slo_alerts_total and writes ONE flight dump per "
+            "(slo, window) with the offending series embedded")
+define_flag("slo_eval_ms", 1000,
+            "SLO evaluation cadence, milliseconds; 0 disables the "
+            "background evaluator (slo.evaluate_once() still works)")
 define_flag("auto_layout", False,
             "single-device accelerator path: AOT-compile with XLA-chosen "
             "(AUTO) parameter layouts and keep persistable buffers in "

@@ -101,6 +101,12 @@ _M_QUORUM_SCAN = _obs_metrics.counter(
     "sender-map entries walked by barrier-quorum bookkeeping "
     "(incremental: ~2 per ack amortized; FLAGS_barrier_rescan legacy: "
     "O(trainers) per ack)")
+# Watchtower (ISSUE 13): the barrier handler's wall time INCLUDING the
+# durable-ack wait — the data-plane latency distribution the pserver
+# SLOs (barrier p99) evaluate from the tsdb's sampled percentiles
+_M_BARRIER_MS = _obs_metrics.histogram(
+    "pserver_barrier_ms",
+    "SendBarrier handler wall time incl. the durable-ack wait")
 
 from paddle_tpu.observability import ledger as _ledger
 
@@ -527,6 +533,15 @@ class VariableServer:
         # out of the ledger without an explicit unregister
         self._ledger_handle = _ledger.register(
             "pserver", VariableServer._ledger_probe, owner=self)
+        # Watchtower (ISSUE 13): with FLAGS_tsdb_dir set, this server
+        # process retains its metric history (rounds, barrier p99,
+        # pending bytes via the ledger mirror) and arms the SLO
+        # evaluator.  No-op without the flag; best-effort always.
+        try:
+            from paddle_tpu.observability import tsdb as _tsdb
+            _tsdb.ensure_sampler()
+        except Exception:
+            pass
 
         handlers = {
             "SendVariable": self._h(self._send_variable),
@@ -916,8 +931,12 @@ class VariableServer:
         # a hang here shows up in the flight recorder as an open
         # pserver.barrier span with the sender in its args (sp is None
         # when tracing is off; _send_barrier_impl tolerates that)
-        with _TRC.span("pserver.barrier") as sp:
-            return self._send_barrier_impl(req, ctx, sp)
+        t0 = time.perf_counter()
+        try:
+            with _TRC.span("pserver.barrier") as sp:
+                return self._send_barrier_impl(req, ctx, sp)
+        finally:
+            _M_BARRIER_MS.observe((time.perf_counter() - t0) * 1e3)
 
     def _send_barrier_impl(self, req, ctx, sp):
         snapshot = None
@@ -1283,7 +1302,7 @@ class VariableServer:
                 self._senders[s]["label"]: r
                 for s, r in self._barrier_rounds.items()
                 if s in self._senders}
-            return json.dumps({
+            status = {
                 "applied_round": self._applied_round,
                 "durable_round": self._durable_round,
                 "alive": self._alive,
@@ -1294,7 +1313,17 @@ class VariableServer:
                 "arrived": arrived,
                 "known": known,
                 "waiting_for": sorted(set(known) - set(arrived)),
-            }).encode()
+            }
+        # Watchtower (ISSUE 13): currently-firing burn-rate alerts ride
+        # the same introspection reply the watchdog already polls, so
+        # "is the server healthy" and "is it meeting its SLOs" are one
+        # call.  Best-effort — an empty list when no evaluator runs.
+        try:
+            from paddle_tpu.observability import slo as _slo
+            status["slo_alerts"] = _slo.alerts_brief()
+        except Exception:
+            status["slo_alerts"] = []
+        return json.dumps(status).encode()
 
     def _toggle_profile(self, req, ctx=None):
         """Trainer-driven server profiling (reference
@@ -1574,6 +1603,14 @@ class RPCClient:
     def instance(cls):
         if cls._instance is None:
             cls._instance = RPCClient()
+            # Watchtower (ISSUE 13): the trainer side of the data
+            # plane also retains its history when FLAGS_tsdb_dir is
+            # set (rpc bytes, trainer rounds, step walls)
+            try:
+                from paddle_tpu.observability import tsdb as _tsdb
+                _tsdb.ensure_sampler()
+            except Exception:
+                pass
         return cls._instance
 
     @classmethod

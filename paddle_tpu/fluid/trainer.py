@@ -285,6 +285,15 @@ class Trainer:
 
     def _train_by_executor(self, num_epochs, event_handler, reader,
                            feed_order):
+        # Watchtower (ISSUE 13): a training process with FLAGS_tsdb_dir
+        # set retains its metric history (step wall, grad norm,
+        # numerics trips) and arms the SLO evaluator.  No-op without
+        # the flag.
+        try:
+            from paddle_tpu.observability import tsdb as _tsdb
+            _tsdb.ensure_sampler()
+        except Exception:
+            pass
         feeder = self._feeder(feed_order, self.train_program)
         exe = Executor(self.place)
         metrics = [v.name for v in self.train_func_outputs]

@@ -25,6 +25,20 @@ hang flight recorder.
              off|metrics|guard|bisect), numerics_*.json forensics
              incl. first-bad-op bisection; imported lazily by its
              consumers (executor, rpc, trainer)
+  tsdb       (ISSUE 13) Watchtower time-series store: a background
+             sampler appends every counter/gauge/histogram-percentile
+             (and the refreshed ledger) to size-bounded append-only
+             binary segments under FLAGS_tsdb_dir, with range-scan /
+             downsample / rate() queries and byte-bounded retention —
+             the durable history slo.py, tools/watchtower.py and
+             tools/perf_sentinel.py read
+  slo        (ISSUE 13) declarative SLOs (FLAGS_slo_spec: JSON/TOML
+             file or inline objectives) evaluated continuously
+             against the tsdb with multi-window burn-rate alerting:
+             a firing (slo, window) bumps slo_alerts_total, writes
+             ONE flight dump embedding the offending series, and is
+             visible in BarrierStatus introspection; both imported
+             lazily by their consumers
 
 Instrumented sites: core/executor_impl (step/feed/dispatch/sync spans,
 compile-cache + step counters), distributed/rpc (send/gather/barrier/

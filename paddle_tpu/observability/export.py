@@ -23,7 +23,8 @@ from . import metrics
 __all__ = ["load_dump", "chrome_trace", "merge_files", "phase_rows",
            "format_phase_table", "kernel_rows", "format_kernel_table",
            "numerics_rows", "format_numerics_table", "serve_rows",
-           "format_serve_table", "scale_rows", "format_scale_table"]
+           "format_serve_table", "scale_rows", "format_scale_table",
+           "slo_rows", "format_slo_table"]
 
 
 def load_dump(path):
@@ -419,6 +420,60 @@ def format_scale_table(rows):
                 r["replay_evictions"], r["barrier_set"],
                 r["apply_backlog_rounds"], r["oldest_pending_age_s"],
                 r["quorum_scan_ops"]))
+    return "\n".join(out)
+
+
+def slo_rows(dumps):
+    """Watchtower SLO rollup (ISSUE 13): per process dump, the
+    per-spec burn-rate gauges the evaluator mirrors into the always-on
+    registry (``slo_burn_fast_<name>`` / ``slo_burn_slow_<name>`` /
+    ``slo_budget_remaining_<name>``) plus the alert counters.  Works
+    on any trace OR flight dump — the metrics snapshot rides both;
+    flight dumps written by a firing alert additionally carry the
+    offending series under their top-level 'slo' key."""
+    rows = []
+    for d in dumps:
+        m = d.get("metrics", {})
+
+        def val(name, default=0):
+            return (m.get(name) or {}).get("value", default)
+
+        prefix = "slo_burn_fast_"
+        names = sorted(k[len(prefix):] for k in m
+                       if k.startswith(prefix))
+        alerts = val("slo_alerts_total")
+        active = val("slo_alerts_active")
+        if not names:
+            if alerts or active:
+                rows.append({"label": d.get("label", "?"), "slo": "",
+                             "burn_fast": 0.0, "burn_slow": 0.0,
+                             "budget_remaining": 1.0,
+                             "alerts_total": alerts,
+                             "alerts_active": active})
+            continue
+        for n in names:
+            rows.append({
+                "label": d.get("label", "?"), "slo": n,
+                "burn_fast": round(val("slo_burn_fast_" + n, 0.0), 4),
+                "burn_slow": round(val("slo_burn_slow_" + n, 0.0), 4),
+                "budget_remaining": round(
+                    val("slo_budget_remaining_" + n, 1.0), 4),
+                "alerts_total": alerts,
+                "alerts_active": active,
+            })
+    rows.sort(key=lambda r: (r["label"], r["slo"]))
+    return rows
+
+
+def format_slo_table(rows):
+    out = ["%-22s %-28s %10s %10s %10s %7s %7s" % (
+        "process", "slo", "burn_fast", "burn_slow", "budget_rem",
+        "alerts", "active")]
+    for r in rows:
+        out.append("%-22s %-28s %10.2f %10.2f %10.2f %7d %7d" % (
+            r["label"][:22], r["slo"][:28], r["burn_fast"],
+            r["burn_slow"], r["budget_remaining"], r["alerts_total"],
+            r["alerts_active"]))
     return "\n".join(out)
 
 

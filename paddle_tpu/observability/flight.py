@@ -30,7 +30,16 @@ from paddle_tpu.core.flags import FLAGS
 
 from .trace import TRACER
 
-__all__ = ["dump", "note_fault", "install_signal_handlers"]
+__all__ = ["dump", "note_fault", "install_signal_handlers",
+           "SCHEMA_VERSION"]
+
+# Envelope version (ISSUE 13 satellite): the artifact is parsed by
+# tools/fault_matrix.py, tools/watchtower.py, tools/trace_report.py
+# and the scale/slo preset asserts — PR 12 embedded the ledger with no
+# versioning and downstream parsers would break silently on shape
+# changes.  Bump this WITH a tests/test_flight_schema.py golden update
+# whenever a top-level key is added/removed/renamed.
+SCHEMA_VERSION = 1
 
 # keep the artifact bounded even with a huge ring configured
 MAX_RECENT_SPANS = 1024
@@ -51,11 +60,14 @@ def _next_seq():
         return _seq
 
 
-def dump(reason, blocked=None, directory=None):
+def dump(reason, blocked=None, directory=None, sections=None):
     """Write the flight-recorder artifact; returns its path, or None if
     the write failed (best-effort by design).  ``blocked`` is a
     JSON-able dict describing what the process was stuck on — e.g.
-    {"op": "recv", "details": [per-pserver barrier state...]}."""
+    {"op": "recv", "details": [per-pserver barrier state...]}.
+    ``sections`` lets the caller enrich/override a top-level envelope
+    section (the SLO engine embeds the offending series under "slo");
+    envelope keys are pinned by tests/test_flight_schema.py."""
     try:
         directory = (directory or FLAGS.telemetry_dump_dir
                      or tempfile.gettempdir())
@@ -71,8 +83,17 @@ def dump(reason, blocked=None, directory=None):
             ledger_snap = _ledger.snapshot(limit=MAX_LEDGER_SAMPLES)
         except Exception:
             ledger_snap = None
+        # SLO status (ISSUE 13): spec table + active burn-rate alerts
+        # when an evaluator is installed; the key is present either
+        # way so parsers never branch on existence
+        try:
+            from . import slo as _slo
+            slo_snap = _slo.snapshot_for_flight()
+        except Exception:
+            slo_snap = None
         rec = {
             "kind": "flight_recorder",
+            "schema_version": SCHEMA_VERSION,
             "reason": str(reason),
             "wall_time": time.strftime("%Y-%m-%dT%H:%M:%S"),
             "pid": os.getpid(),
@@ -83,7 +104,10 @@ def dump(reason, blocked=None, directory=None):
             "recent_spans": spans,
             "metrics": metrics.snapshot(),
             "ledger": ledger_snap,
+            "slo": slo_snap,
         }
+        if sections:
+            rec.update(sections)
         path = os.path.join(
             directory, "flight_%d_%d.json" % (os.getpid(), _next_seq()))
         tmp = path + ".tmp"

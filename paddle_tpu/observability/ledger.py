@@ -47,7 +47,8 @@ from paddle_tpu.core.flags import FLAGS
 from . import metrics as _metrics
 
 __all__ = ["register", "unregister", "collect", "sample_now",
-           "snapshot", "peaks", "series", "reset", "value_nbytes"]
+           "snapshot", "peaks", "series", "reset", "value_nbytes",
+           "has_probes"]
 
 _lock = threading.RLock()
 _probes = {}          # handle -> (subsystem, fn, owner_ref or None)
@@ -92,6 +93,14 @@ def unregister(handle):
     with _lock:
         _probes.pop(handle, None)
         _last_rows.pop(handle, None)
+
+
+def has_probes():
+    """True when any probe is registered — the cheap predicate
+    callers (tsdb.sample_registry) use to decide whether a ledger
+    refresh would do anything."""
+    with _lock:
+        return bool(_probes)
 
 
 def collect():

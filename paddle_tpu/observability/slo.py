@@ -1,0 +1,537 @@
+"""Watchtower SLO engine (ISSUE 13 tentpole b): declarative
+objectives evaluated continuously against the time-series store, with
+multi-window burn-rate alerting.
+
+An SLO is "this metric, compared this way, against this threshold,
+with this error budget": ``serve_request_ms.p99 <= 10`` with budget
+0.01 means "at most 1% of sampled windows may show a p99 above
+10 ms".  Specs load from a JSON/TOML file or an inline FLAGS string
+(``FLAGS_slo_spec``); metrics name tsdb series the registry sampler
+writes (tsdb.sample_registry), including the ``.p50/.p90/.p99``
+histogram decompositions and a ``<counter>.rate`` suffix for
+throughput floors (``pserver_rounds_applied_total.rate >= 1.0``).
+
+Burn-rate alerting (the Google-SRE multi-window shape): per spec, the
+fraction of BAD samples in a window divided by the budget is the burn
+rate — 1.0 burns the budget exactly at the window's length.  Two
+windows fire independently: a FAST window (default 300 s) with a high
+threshold (default 14.0 — a sharp regression pages in minutes) and a
+SLOW window (default 3600 s) with a low threshold (default 2.0 — a
+simmering leak still surfaces).  A firing (slo, window):
+
+- increments ``slo_alerts_total`` and joins ``slo_alerts_active``,
+- mirrors its burn/budget into always-on gauges
+  (``slo_burn_<window>_<name>``, ``slo_budget_remaining_<name>``) so
+  every trace/flight dump and the trace_report --slo rollup carry it,
+- writes ONE flight dump per (slo, window) per process (reason
+  ``slo:<name>:<window>``) with the offending window's series
+  embedded — the forensics artifact tools/fault_matrix.py's ``slo``
+  preset asserts,
+- is visible in BarrierStatus-style introspection (rpc.py attaches
+  ``alerts_brief()`` to the pserver's BarrierStatus reply).
+
+``ensure_evaluator()`` arms a background evaluation thread when
+``FLAGS_slo_spec`` is set (cadence ``FLAGS_slo_eval_ms``); evaluation
+cost is gated < 2% by tools/telemetry_overhead.py.
+"""
+from __future__ import annotations
+
+import json
+import os
+import re
+import threading
+import time
+
+from paddle_tpu.core.flags import FLAGS
+
+from . import metrics as _metrics
+from . import tsdb as _tsdb
+
+__all__ = ["SLO", "Evaluator", "load_specs", "parse_objective",
+           "install", "ensure_evaluator", "evaluate_once", "status",
+           "active_alerts", "alerts_brief", "snapshot_for_flight",
+           "reset"]
+
+_M_ALERTS = _metrics.counter(
+    "slo_alerts_total", "burn-rate alerts fired (one per slo x window "
+    "transition into firing)")
+_G_ACTIVE = _metrics.gauge(
+    "slo_alerts_active", "slo x window pairs currently firing")
+
+_OPS = {
+    "<=": lambda v, th: v <= th,
+    "<": lambda v, th: v < th,
+    ">=": lambda v, th: v >= th,
+    ">": lambda v, th: v > th,
+    "==": lambda v, th: v == th,
+    "!=": lambda v, th: v != th,
+}
+_OBJ_RE = re.compile(r"^\s*([A-Za-z0-9_.:-]+)\s*"
+                     r"(<=|>=|==|!=|<|>)\s*([-+0-9.eE]+)\s*$")
+
+DEFAULT_BUDGET = 0.01
+DEFAULT_FAST_S = 300.0
+DEFAULT_SLOW_S = 3600.0
+DEFAULT_BURN_FAST = 14.0
+DEFAULT_BURN_SLOW = 2.0
+MIN_SAMPLES = 3
+
+
+def _safe(name):
+    return re.sub(r"[^A-Za-z0-9_]", "_", str(name))
+
+
+class SLO:
+    """One declarative objective.  ``metric`` names a tsdb series
+    (with the optional ``.rate`` suffix); a sample is BAD when
+    ``op(value, threshold)`` is False."""
+
+    __slots__ = ("name", "metric", "op", "threshold", "budget",
+                 "fast_s", "slow_s", "burn_fast", "burn_slow",
+                 "min_samples")
+
+    def __init__(self, metric, op, threshold, name=None,
+                 budget=DEFAULT_BUDGET, fast_s=DEFAULT_FAST_S,
+                 slow_s=DEFAULT_SLOW_S, burn_fast=DEFAULT_BURN_FAST,
+                 burn_slow=DEFAULT_BURN_SLOW,
+                 min_samples=MIN_SAMPLES):
+        if op not in _OPS:
+            raise ValueError("bad SLO op %r (want one of %s)"
+                             % (op, "/".join(sorted(_OPS))))
+        if not (0 < float(budget) <= 1):
+            raise ValueError("SLO budget must be in (0, 1], got %r"
+                             % (budget,))
+        self.metric = str(metric)
+        self.op = op
+        self.threshold = float(threshold)
+        self.name = _safe(name or self.metric)
+        self.budget = float(budget)
+        self.fast_s = float(fast_s)
+        self.slow_s = float(slow_s)
+        self.burn_fast = float(burn_fast)
+        self.burn_slow = float(burn_slow)
+        self.min_samples = int(min_samples)
+
+    @property
+    def objective(self):
+        return "%s %s %g" % (self.metric, self.op, self.threshold)
+
+    def good(self, value):
+        return bool(_OPS[self.op](float(value), self.threshold))
+
+    def to_dict(self):
+        return {"name": self.name, "metric": self.metric,
+                "objective": self.objective, "budget": self.budget,
+                "fast_s": self.fast_s, "slow_s": self.slow_s,
+                "burn_fast": self.burn_fast,
+                "burn_slow": self.burn_slow}
+
+
+def parse_objective(text):
+    """'metric <= 10' -> (metric, op, threshold)."""
+    m = _OBJ_RE.match(str(text))
+    if not m:
+        raise ValueError("bad SLO objective %r (want 'metric OP "
+                         "number', OP in %s)"
+                         % (text, "/".join(sorted(_OPS))))
+    return m.group(1), m.group(2), float(m.group(3))
+
+
+def _spec_from_dict(d):
+    d = dict(d)
+    if "objective" in d:
+        metric, op, th = parse_objective(d.pop("objective"))
+        d.setdefault("metric", metric)
+        d.setdefault("op", op)
+        d.setdefault("threshold", th)
+    return SLO(d.pop("metric"), d.pop("op"), d.pop("threshold"), **d)
+
+
+def _load_toml_slo(path):
+    """TOML spec files: stdlib tomllib when available (3.11+), else a
+    dependency-free subset parser — ``[[slo]]`` table arrays of
+    ``key = value`` lines (quoted strings, numbers, booleans,
+    ``#`` comments), which is exactly the shape an SLO file uses.
+    Anything fancier should just use JSON."""
+    try:
+        import tomllib
+    except ImportError:
+        tomllib = None
+    if tomllib is not None:
+        with open(path, "rb") as f:
+            return tomllib.load(f).get("slo", [])
+    items = []
+    current = None
+    with open(path) as f:
+        for lineno, raw in enumerate(f, 1):
+            line = raw.split("#", 1)[0].strip()
+            if not line:
+                continue
+            if line == "[[slo]]":
+                current = {}
+                items.append(current)
+                continue
+            if line.startswith("["):
+                current = None      # some other table: not ours
+                continue
+            if "=" not in line or current is None:
+                if current is None:
+                    continue
+                raise ValueError("bad TOML line %d in %r: %r"
+                                 % (lineno, path, raw.rstrip()))
+            key, val = (s.strip() for s in line.split("=", 1))
+            if len(val) >= 2 and val[0] == val[-1] and val[0] in "\"'":
+                current[key] = val[1:-1]
+            elif val in ("true", "false"):
+                current[key] = val == "true"
+            else:
+                try:
+                    current[key] = int(val)
+                except ValueError:
+                    current[key] = float(val)
+    return items
+
+
+def load_specs(source):
+    """SLO list from: a ``.json`` / ``.toml`` file path ({"slo":
+    [...]} or a bare list, each entry an objective string or a dict),
+    an inline comma-separated objective string
+    (``serve_request_ms.p99<=10,pserver_rounds_applied_total.rate>=1``),
+    or an already-built list/dict."""
+    if isinstance(source, (list, tuple)):
+        items = list(source)
+    elif isinstance(source, dict):
+        items = list(source.get("slo", []))
+    else:
+        text = str(source).strip()
+        if not text:
+            return []
+        if text.endswith((".toml", ".json")):
+            # a spec that LOOKS like a file path must be one: a typo'd
+            # path silently re-parsed as inline objectives would
+            # disable monitoring with no diagnostic
+            if not os.path.exists(text):
+                raise FileNotFoundError(
+                    "SLO spec file %r does not exist" % text)
+            if text.endswith(".toml"):
+                items = _load_toml_slo(text)
+            else:
+                with open(text) as f:
+                    data = json.load(f)
+                items = data.get("slo", []) if isinstance(data, dict) \
+                    else list(data)
+        else:
+            items = [t for t in text.split(",") if t.strip()]
+    out = []
+    for item in items:
+        if isinstance(item, SLO):
+            out.append(item)
+        elif isinstance(item, dict):
+            out.append(_spec_from_dict(item))
+        else:
+            metric, op, th = parse_objective(item)
+            out.append(SLO(metric, op, th))
+    names = [s.name for s in out]
+    if len(set(names)) != len(names):
+        raise ValueError("duplicate SLO names: %r" % names)
+    return out
+
+
+class Evaluator:
+    """Evaluate specs against a store; fire burn-rate alerts.
+
+    ``dump_alerts=False`` turns the side effects off (watchtower's
+    one-shot report evaluates somebody else's store and must not
+    write flight dumps into it)."""
+
+    def __init__(self, store, specs, dump_alerts=True):
+        self.store = store
+        self.specs = list(specs)
+        self.dump_alerts = bool(dump_alerts)
+        # REENTRANT, same invariant as metrics.py/ledger.py: a
+        # signal-handler flight dump (snapshot_for_flight -> status())
+        # landing on the very thread that is mid-evaluate must read
+        # through the held lock instead of deadlocking inside its own
+        # crash artifact
+        self._lock = threading.RLock()
+        self._dumped = set()     # (name, window) ever dumped
+        self._active = {}        # (name, window) -> since (unix time)
+        self._status = []
+
+    # -- math ----------------------------------------------------------
+    def _window_eval(self, spec, t, v, burn_threshold, now):
+        """Burn over the window already SLICED into (t, v).  The store
+        is scanned once per spec — the fast window is a numpy mask of
+        the slow window's arrays, never a second disk read."""
+        import numpy as np
+
+        n = int(len(v))
+        if n == 0:
+            return {"samples": 0, "bad": 0, "bad_frac": 0.0,
+                    "burn": 0.0, "firing": False, "_t": t, "_v": v}
+        # vectorized goodness: the comparison ops broadcast over the
+        # whole window (the evaluator runs on cadence — gated < 2% of
+        # FLAGS_slo_eval_ms by tools/telemetry_overhead.py)
+        bad = n - int(np.count_nonzero(
+            _OPS[spec.op](v, spec.threshold)))
+        bad_frac = bad / n
+        burn = bad_frac / spec.budget
+        firing = n >= spec.min_samples and burn >= burn_threshold
+        return {"samples": n, "bad": bad,
+                "bad_frac": round(bad_frac, 6),
+                "burn": round(burn, 4), "firing": firing,
+                "_t": t, "_v": v}
+
+    def evaluate(self, now=None):
+        """One evaluation pass over every spec; returns (and caches)
+        the status rows.  Alert side effects (counter, gauges, ONE
+        flight dump per (slo, window)) fire AFTER the status rows are
+        committed, so a first-evaluation alert's flight dump carries
+        this pass's full status table, not the previous (possibly
+        empty) one."""
+        now = float(time.time() if now is None else now)
+        rows = []
+        pending = []     # (spec, window name, window dict + arrays)
+        for spec in self.specs:
+            # ONE store scan per spec (the slow window); the fast
+            # window is a mask over the same arrays
+            st, sv = _tsdb.series_values(self.store, spec.metric,
+                                         now - spec.slow_s, now)
+            mask = st >= now - spec.fast_s
+            slow = self._window_eval(spec, st, sv, spec.burn_slow,
+                                     now)
+            fast = self._window_eval(spec, st[mask], sv[mask],
+                                     spec.burn_fast, now)
+            # last observed value straight from the slow window's
+            # already-fetched array — never an unbounded store scan
+            sv = slow.get("_v")
+            last_v = float(sv[-1]) if sv is not None and len(sv) \
+                else None
+            # budget remaining over the SLOW window: the long-horizon
+            # "how much error budget is left" number watchtower charts
+            remaining = max(0.0, 1.0 - slow["bad_frac"] / spec.budget)
+            row = {
+                "name": spec.name, "metric": spec.metric,
+                "objective": spec.objective, "budget": spec.budget,
+                "last_value": (round(last_v, 6)
+                               if last_v is not None else None),
+                "budget_remaining": round(remaining, 4),
+                "windows": {
+                    "fast": dict(fast, window_s=spec.fast_s,
+                                 burn_threshold=spec.burn_fast),
+                    "slow": dict(slow, window_s=spec.slow_s,
+                                 burn_threshold=spec.burn_slow),
+                },
+            }
+            for wname in ("fast", "slow"):
+                w = row["windows"][wname]
+                _metrics.gauge(
+                    "slo_burn_%s_%s" % (wname, spec.name),
+                    "burn rate over the %s window" % wname
+                ).set(w["burn"])
+                # keep the window arrays for the deferred alert pass
+                pending.append((spec, wname, dict(w)))
+            _metrics.gauge(
+                "slo_budget_remaining_%s" % spec.name,
+                "error budget remaining (slow window)"
+            ).set(row["budget_remaining"])
+            # drop the raw window arrays from the cached status: the
+            # offending series is materialized only into an alert's
+            # flight dump (watchtower re-scans the store when it
+            # wants the curve)
+            for w in row["windows"].values():
+                w.pop("_t", None)
+                w.pop("_v", None)
+            rows.append(row)
+        with self._lock:
+            self._status = rows
+        for spec, wname, w in pending:
+            self._alert(spec, wname, w, now)
+        with self._lock:
+            _G_ACTIVE.set(len(self._active))
+        return rows
+
+    # -- alerts --------------------------------------------------------
+    def _alert(self, spec, window, w, now):
+        key = (spec.name, window)
+        with self._lock:
+            was_active = key in self._active
+            if w["firing"] and not was_active:
+                self._active[key] = now
+            elif not w["firing"] and was_active:
+                self._active.pop(key, None)
+            newly = w["firing"] and not was_active
+            need_dump = newly and self.dump_alerts \
+                and key not in self._dumped
+            if need_dump:
+                self._dumped.add(key)
+        if not newly:
+            return
+        _M_ALERTS.inc()
+        if not need_dump:
+            return
+        # ONE flight dump per (slo, window) per process, carrying the
+        # offending window's series — the alert's forensics artifact
+        series = [[round(float(a), 3), float(b)]
+                  for a, b in zip(w.get("_t", ()), w.get("_v", ()))]
+        try:
+            from . import flight
+            flight.dump(
+                "slo:%s:%s" % (spec.name, window),
+                blocked={"slo": spec.name, "window": window,
+                         "burn": w["burn"],
+                         "objective": spec.objective},
+                sections={"slo": {
+                    "alert": {"slo": spec.name, "window": window,
+                              "burn": w["burn"],
+                              "burn_threshold": w["burn_threshold"],
+                              "bad_frac": w["bad_frac"],
+                              "samples": w["samples"],
+                              "objective": spec.objective,
+                              "budget": spec.budget,
+                              "series": series},
+                    "status": self.status(),
+                    "alerts": self.active_alerts(),
+                }})
+        except Exception:
+            pass
+
+    # -- introspection -------------------------------------------------
+    def status(self):
+        """The cached status rows (already array-free — the raw
+        window series never enter the cache)."""
+        with self._lock:
+            return [dict(r) for r in self._status]
+
+    def active_alerts(self):
+        with self._lock:
+            return [{"slo": name, "window": win,
+                     "since": round(since, 3)}
+                    for (name, win), since in sorted(
+                        self._active.items())]
+
+
+# ---------------------------------------------------------------------
+# process-wide evaluator
+# ---------------------------------------------------------------------
+
+_EVAL = None
+# reentrant: install() is callable both directly and from inside
+# ensure_evaluator's locked section
+_eval_lock = threading.RLock()
+_eval_thread = None
+_eval_stop = None
+
+
+def install(store=None, specs=None, dump_alerts=True):
+    """Build (and adopt as the process evaluator) an Evaluator over
+    ``store`` (default: the FLAGS_tsdb_dir default store) and
+    ``specs`` (default: FLAGS_slo_spec).  The background loop (if
+    armed) re-reads the process evaluator each tick, so a later
+    install() genuinely replaces what runs AND what introspection
+    reports."""
+    global _EVAL
+    store = store or _tsdb.default_store()
+    if store is None:
+        raise ValueError("no tsdb store (set FLAGS_tsdb_dir or pass "
+                         "store=)")
+    if specs is None:
+        specs = load_specs(FLAGS.slo_spec)
+    elif not isinstance(specs, (list, tuple)):
+        specs = load_specs(specs)
+    with _eval_lock:
+        _EVAL = Evaluator(store, specs, dump_alerts=dump_alerts)
+        return _EVAL
+
+
+def ensure_evaluator():
+    """Arm the background evaluation thread once per process when
+    FLAGS_slo_spec names specs (cadence FLAGS_slo_eval_ms; 0
+    disables).  Idempotent — called from tsdb.ensure_sampler so the
+    sampler and the evaluator arm as one plane.  A broken spec is a
+    loud warning, never a silent no-monitoring state."""
+    global _eval_thread, _eval_stop
+    if not FLAGS.slo_spec or int(FLAGS.slo_eval_ms) <= 0:
+        return None
+    with _eval_lock:
+        if _EVAL is None:
+            try:
+                install()
+            except Exception as e:
+                import warnings
+                warnings.warn(
+                    "FLAGS_slo_spec=%r did not arm the SLO "
+                    "evaluator: %s — burn-rate alerting is OFF"
+                    % (FLAGS.slo_spec, e))
+                return None
+        if _eval_thread is not None:
+            return _eval_thread
+        _eval_stop = threading.Event()
+        t = threading.Thread(target=_eval_loop, args=(_eval_stop,),
+                             daemon=True, name="slo-evaluator")
+        _eval_thread = t
+        t.start()
+        return t
+
+
+def _eval_loop(stop):
+    while not stop.is_set():
+        ms = int(FLAGS.slo_eval_ms)
+        if stop.wait(max(ms, 10) / 1000.0):
+            break
+        # re-read each tick: a later install() swaps what runs, so
+        # the loop and the introspection surface never split
+        ev = _EVAL
+        if ev is None:
+            continue
+        try:
+            ev.evaluate()
+        except Exception:
+            pass
+
+
+def evaluate_once():
+    """One synchronous evaluation of the process evaluator (tests,
+    tools); None when none is installed."""
+    ev = _EVAL
+    if ev is None:
+        return None
+    return ev.evaluate()
+
+
+def status():
+    ev = _EVAL
+    return ev.status() if ev is not None else []
+
+
+def active_alerts():
+    ev = _EVAL
+    return ev.active_alerts() if ev is not None else []
+
+
+def alerts_brief():
+    """['name:window', ...] of currently-firing alerts — the
+    BarrierStatus-sized summary rpc.py attaches to its introspection
+    reply."""
+    return ["%s:%s" % (a["slo"], a["window"])
+            for a in active_alerts()]
+
+
+def snapshot_for_flight():
+    """The flight-recorder payload: spec status + active alerts, or
+    None when no evaluator is installed (the envelope still carries
+    the key — tests/test_flight_schema.py pins that)."""
+    ev = _EVAL
+    if ev is None:
+        return None
+    return {"status": ev.status(), "alerts": ev.active_alerts()}
+
+
+def reset():
+    """Drop the process evaluator and its thread (tests)."""
+    global _EVAL, _eval_thread, _eval_stop
+    with _eval_lock:
+        stop, _eval_thread, _eval_stop = _eval_stop, None, None
+        _EVAL = None
+    if stop is not None:
+        stop.set()

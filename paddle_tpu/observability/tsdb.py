@@ -1,0 +1,591 @@
+"""Watchtower time-series store (ISSUE 13 tentpole a): durable metric
+history, dependency-free.
+
+Everything before this was point-in-time: the always-on registry
+(metrics.py) answers "how many / how long *right now*", the ledger
+collector answers "how big is it right now", and both evaporate with
+the process.  This module is the durable half — an on-disk store a
+sampler appends fixed-interval snapshots of every counter, gauge and
+histogram-percentile into, so an SLO can be evaluated over a window
+(slo.py), an overhead gate's history survives the tool run, and a
+collapse can be read back hours later.
+
+On-disk format (an internal contract — MIGRATION.md "Watchtower"):
+
+- one directory per writer process (two processes never share a
+  segment file; ``default_store()`` keys the subdirectory by
+  label + pid the way flight dumps are keyed),
+- ``tsdb_meta.json``: the series name -> integer id map plus the
+  sealed-segment index (t0/t1/records per segment), rewritten
+  atomically via core/fsutil only when it changes (new series, seal),
+- ``seg_NNNNNN.bin``: append-only fixed-width binary frames, 20 bytes
+  each — ``<u4 series_id | f8 unix_time | f8 value>`` little-endian —
+  chosen so a whole segment reads as ONE numpy structured array
+  (mmap-friendly, no parsing): a torn tail (crash mid-frame) truncates
+  to the last whole record,
+- rotation: the active segment seals at ``FLAGS_tsdb_segment_bytes``
+  and a new one opens; retention drops the OLDEST sealed segments once
+  the directory exceeds ``FLAGS_tsdb_retention_mb``.
+
+Query API: ``scan`` (range read), ``downsample`` (bucketed
+mean/min/max for sparklines), ``rate`` (counter rate with reset
+handling), ``latest``.  Readers re-stat the files per call, so a
+reader process sees a live writer's appends without coordination.
+
+Sampler: ``sample_registry(store)`` appends one row per metric —
+counters/gauges as themselves, histograms as ``name.count``,
+``name.sum`` and ``name.p50/.p90/.p99`` — after refreshing the ISSUE
+12 resource ledger (whose ``ledger_*`` gauges then ride the same row).
+``ensure_sampler()`` starts the background thread when
+``FLAGS_tsdb_dir`` is set; it is called best-effort from the trainer
+loop, the serving server and the RPC plane, so any instrumented
+process with the flag set retains its history.  Cost is gated < 2% by
+tools/telemetry_overhead.py like every other telemetry site.
+"""
+from __future__ import annotations
+
+import atexit
+import json
+import os
+import struct
+import threading
+import time
+
+import numpy as np
+
+from paddle_tpu.core.flags import FLAGS
+from paddle_tpu.core.fsutil import atomic_write
+
+__all__ = ["TSDB", "RECORD", "sample_registry", "default_store",
+           "ensure_sampler", "stop_sampler", "open_stores",
+           "series_values"]
+
+# one frame: series id, unix time, value.  '<' = packed little-endian
+# (no padding), so itemsize is exactly 20 and numpy reads a segment
+# zero-copy with the matching structured dtype.
+RECORD = struct.Struct("<Idd")
+_DTYPE = np.dtype([("sid", "<u4"), ("t", "<f8"), ("v", "<f8")])
+META_NAME = "tsdb_meta.json"
+META_VERSION = 1
+
+
+class TSDB:
+    """One process's time-series store over one directory.
+
+    Writer methods (``append``/``append_row``) and reader methods
+    (``scan``/``rate``/``downsample``) coexist; a read-only open
+    (``create=False``) of another process's live directory re-loads
+    the meta per query so new series resolve."""
+
+    def __init__(self, directory, segment_bytes=None,
+                 retention_bytes=None, create=True):
+        self.dir = str(directory)
+        self.segment_bytes = int(segment_bytes
+                                 or FLAGS.tsdb_segment_bytes)
+        self.retention_bytes = int(
+            retention_bytes
+            if retention_bytes is not None
+            else FLAGS.tsdb_retention_mb * (1 << 20))
+        self._lock = threading.RLock()
+        self._series = {}            # name -> sid
+        self._segments = []          # sealed: {file, records, t0, t1}
+        # parsed-array cache for SEALED segments (immutable once
+        # sealed, so (file, size) fully keys the content): bounds
+        # repeated window queries — the SLO evaluator re-scans every
+        # tick — to one disk read + parse per segment, not per query.
+        # Small LRU (newest segments are what window queries hit).
+        self._seg_cache = {}         # file -> (size, array)
+        self._seg_cache_max = 8
+        self._active = None          # {file, t0, t1}
+        self._next_seg = 1
+        self._fh = None
+        self._meta_dirty = False
+        self._writable = bool(create)
+        meta_path = os.path.join(self.dir, META_NAME)
+        if os.path.exists(meta_path):
+            self._load_meta()
+        elif create:
+            os.makedirs(self.dir, exist_ok=True)
+            self._open_segment()
+            self._write_meta()
+        else:
+            raise FileNotFoundError("no %s under %r" % (META_NAME,
+                                                        self.dir))
+
+    # -- meta ----------------------------------------------------------
+    def _load_meta(self):
+        with open(os.path.join(self.dir, META_NAME)) as f:
+            meta = json.load(f)
+        if int(meta.get("version", 0)) != META_VERSION:
+            raise ValueError("tsdb meta version %r (want %d) under %r"
+                             % (meta.get("version"), META_VERSION,
+                                self.dir))
+        self._series = {k: int(v) for k, v in meta["series"].items()}
+        self._segments = list(meta.get("segments", []))
+        self._active = meta.get("active")
+        self._next_seg = int(meta.get("next_seg", 1))
+
+    def _write_meta(self):
+        meta = {"version": META_VERSION, "record_bytes": RECORD.size,
+                "series": self._series, "segments": self._segments,
+                "active": self._active, "next_seg": self._next_seg}
+        atomic_write(os.path.join(self.dir, META_NAME),
+                     json.dumps(meta))
+        self._meta_dirty = False
+
+    def _maybe_reload(self):
+        """Read-only opens follow a live writer: re-load the meta so
+        series/segments added since open() resolve."""
+        if not self._writable:
+            try:
+                self._load_meta()
+            except Exception:
+                pass
+
+    # -- write path ----------------------------------------------------
+    def _open_segment(self):
+        name = "seg_%06d.bin" % self._next_seg
+        self._next_seg += 1
+        self._active = {"file": name, "t0": None, "t1": None}
+        if self._fh is not None:
+            self._fh.close()
+        self._fh = open(os.path.join(self.dir, name), "ab")
+        self._meta_dirty = True
+
+    def _sid(self, name):
+        sid = self._series.get(name)
+        if sid is None:
+            sid = self._series[name] = len(self._series)
+            self._meta_dirty = True
+        return sid
+
+    def append(self, name, value, t=None):
+        self.append_row({name: value}, t=t)
+
+    def append_row(self, values, t=None):
+        """Append one timestamped row of ``{series: value}`` samples;
+        flushes so live readers see it, seals/rotates when the active
+        segment crosses the size bound."""
+        if not values:
+            return
+        t = float(time.time() if t is None else t)
+        with self._lock:
+            if self._fh is None:
+                if not self._writable:
+                    raise IOError("read-only tsdb %r" % self.dir)
+                self._fh = open(os.path.join(self.dir,
+                                             self._active["file"]),
+                                "ab")
+            buf = b"".join(
+                RECORD.pack(self._sid(n), t, float(v))
+                for n, v in values.items()
+                if v is not None and np.isfinite(float(v)))
+            if not buf:
+                return
+            self._fh.write(buf)
+            self._fh.flush()
+            if self._active["t0"] is None:
+                self._active["t0"] = t
+            self._active["t1"] = t
+            if self._meta_dirty:
+                self._write_meta()
+            if self._fh.tell() >= self.segment_bytes:
+                self._seal_locked()
+
+    def _seal_locked(self):
+        self._fh.flush()
+        size = self._fh.tell()
+        self._segments.append({
+            "file": self._active["file"],
+            "records": size // RECORD.size,
+            "t0": self._active["t0"], "t1": self._active["t1"]})
+        self._open_segment()
+        self._enforce_retention_locked()
+        self._write_meta()
+
+    def _enforce_retention_locked(self):
+        """Drop the OLDEST sealed segments until total bytes fit the
+        retention bound (the active segment always survives)."""
+        if self.retention_bytes <= 0:
+            return
+        total = sum(s["records"] * RECORD.size for s in self._segments)
+        while self._segments and total > self.retention_bytes:
+            victim = self._segments.pop(0)
+            total -= victim["records"] * RECORD.size
+            self._seg_cache.pop(victim["file"], None)
+            try:
+                os.remove(os.path.join(self.dir, victim["file"]))
+            except OSError:
+                pass
+            self._meta_dirty = True
+
+    def flush(self):
+        with self._lock:
+            if self._fh is not None:
+                self._fh.flush()
+            if self._meta_dirty:
+                self._write_meta()
+
+    def close(self):
+        with self._lock:
+            if self._fh is not None:
+                self._fh.flush()
+                self._fh.close()
+                self._fh = None
+            # persist the active segment's final bounds: a reopened
+            # store's meta must know how far the last session reached
+            # (writers only — a read-only view must never clobber the
+            # live writer's meta)
+            if self._writable:
+                self._write_meta()
+
+    # -- read path -----------------------------------------------------
+    def names(self):
+        self._maybe_reload()
+        with self._lock:
+            return sorted(self._series)
+
+    def total_bytes(self):
+        with self._lock:
+            files = [s["file"] for s in self._segments]
+            if self._active:
+                files.append(self._active["file"])
+        total = 0
+        for f in files:
+            try:
+                total += os.path.getsize(os.path.join(self.dir, f))
+            except OSError:
+                pass
+        return total
+
+    def _segment_array(self, fname, sealed=False):
+        """One segment as a structured array; a torn tail truncates to
+        the last whole record (crash-mid-frame is data loss of one
+        sample, never a parse error).  Sealed segments are served from
+        the parsed-array cache — their bytes never change."""
+        path = os.path.join(self.dir, fname)
+        try:
+            size = os.path.getsize(path)
+        except OSError:
+            return None
+        if sealed:
+            with self._lock:
+                hit = self._seg_cache.get(fname)
+                if hit is not None and hit[0] == size:
+                    return hit[1]
+        n = size // RECORD.size
+        if n == 0:
+            return None
+        with open(path, "rb") as f:
+            raw = f.read(n * RECORD.size)
+        arr = np.frombuffer(raw, dtype=_DTYPE,
+                            count=len(raw) // RECORD.size)
+        if sealed:
+            with self._lock:
+                while len(self._seg_cache) >= self._seg_cache_max:
+                    self._seg_cache.pop(next(iter(self._seg_cache)))
+                self._seg_cache[fname] = (size, arr)
+        return arr
+
+    def _iter_arrays(self, t0, t1):
+        self._maybe_reload()
+        with self._lock:
+            sealed = list(self._segments)
+            active = dict(self._active) if self._active else None
+        for seg in sealed:
+            if t0 is not None and seg["t1"] is not None \
+                    and seg["t1"] < t0:
+                continue
+            if t1 is not None and seg["t0"] is not None \
+                    and seg["t0"] > t1:
+                continue
+            arr = self._segment_array(seg["file"], sealed=True)
+            if arr is not None:
+                yield arr
+        if active:
+            arr = self._segment_array(active["file"])
+            if arr is not None:
+                yield arr
+
+    def scan(self, name, t0=None, t1=None):
+        """(times, values) float64 arrays for ``name`` over [t0, t1],
+        time-ordered.  Unknown series -> empty arrays."""
+        self._maybe_reload()
+        with self._lock:
+            sid = self._series.get(name)
+        if sid is None:
+            return (np.empty(0), np.empty(0))
+        ts, vs = [], []
+        for arr in self._iter_arrays(t0, t1):
+            mask = arr["sid"] == sid
+            if t0 is not None:
+                mask &= arr["t"] >= t0
+            if t1 is not None:
+                mask &= arr["t"] <= t1
+            if mask.any():
+                ts.append(arr["t"][mask])
+                vs.append(arr["v"][mask])
+        if not ts:
+            return (np.empty(0), np.empty(0))
+        t = np.concatenate(ts)
+        v = np.concatenate(vs)
+        order = np.argsort(t, kind="stable")
+        return (t[order], v[order])
+
+    def last_time(self):
+        """Newest sample timestamp across ALL series, or None for an
+        empty store — the post-hoc anchor watchtower evaluates
+        windows at.  Sealed bounds come from the meta; the active
+        segment's tail is read from the file itself (its meta bound
+        is only as fresh as the last meta rewrite — a crashed or
+        still-live writer leaves it stale)."""
+        self._maybe_reload()
+        with self._lock:
+            times = [s["t1"] for s in self._segments
+                     if s.get("t1") is not None]
+            active = dict(self._active) if self._active else None
+        if active:
+            arr = self._segment_array(active["file"])
+            if arr is not None and len(arr):
+                times.append(float(arr["t"].max()))
+            elif active.get("t1") is not None:
+                times.append(active["t1"])
+        return max(times) if times else None
+
+    def latest(self, name):
+        """(t, value) of the newest sample, or None."""
+        t, v = self.scan(name)
+        if len(t) == 0:
+            return None
+        return (float(t[-1]), float(v[-1]))
+
+    def rate(self, name, t0=None, t1=None):
+        """Per-second rate of a cumulative counter over the window:
+        sum of POSITIVE deltas / elapsed (a negative delta is a counter
+        reset — the decrease is discarded, Prometheus-style)."""
+        t, v = self.scan(name, t0, t1)
+        if len(t) < 2 or t[-1] <= t[0]:
+            return 0.0
+        deltas = np.diff(v)
+        return float(deltas[deltas > 0].sum() / (t[-1] - t[0]))
+
+    def downsample(self, name, buckets=60, t0=None, t1=None):
+        """Bucketed rollup for sparkline rows: [{t, mean, min, max,
+        count}] over up to ``buckets`` equal time bins (empty bins are
+        skipped)."""
+        t, v = self.scan(name, t0, t1)
+        if len(t) == 0:
+            return []
+        lo = float(t[0]) if t0 is None else float(t0)
+        hi = float(t[-1]) if t1 is None else float(t1)
+        if hi <= lo:
+            return [{"t": lo, "mean": float(v[-1]),
+                     "min": float(v.min()), "max": float(v.max()),
+                     "count": int(len(v))}]
+        edges = np.linspace(lo, hi, int(buckets) + 1)
+        idx = np.clip(np.searchsorted(edges, t, side="right") - 1,
+                      0, int(buckets) - 1)
+        out = []
+        for b in range(int(buckets)):
+            mask = idx == b
+            if not mask.any():
+                continue
+            vb = v[mask]
+            out.append({"t": float((edges[b] + edges[b + 1]) / 2),
+                        "mean": float(vb.mean()),
+                        "min": float(vb.min()),
+                        "max": float(vb.max()),
+                        "count": int(mask.sum())})
+        return out
+
+
+# ---------------------------------------------------------------------
+# registry sampler
+# ---------------------------------------------------------------------
+
+def sample_registry(store, t=None):
+    """Append one snapshot row of the whole always-on registry:
+    counters/gauges as themselves; histograms decomposed into
+    ``.count``/``.sum`` (cumulative — ``rate()`` works on them) and
+    the recent-window ``.p50/.p90/.p99``.  The ISSUE 12 ledger is
+    refreshed first (when any probe is registered) so its ``ledger_*``
+    gauges ride the same row.  Returns the number of series written."""
+    from . import ledger as _ledger
+    from . import metrics as _metrics
+
+    try:
+        if _ledger.has_probes():
+            _ledger.sample_now()
+    except Exception:
+        pass
+    row = {}
+    snap = _metrics.snapshot()
+    for name, m in snap.items():
+        kind = m.get("type")
+        if kind == "histogram":
+            row[name + ".count"] = m.get("count", 0)
+            row[name + ".sum"] = m.get("sum", 0.0)
+            for p in ("p50", "p90", "p99"):
+                row[name + "." + p] = m.get(p, 0.0)
+        else:
+            row[name] = m.get("value", 0)
+    store.append_row(row, t=t)
+    return len(row)
+
+
+def series_values(store, metric, t0=None, t1=None):
+    """Resolve an SLO-style metric name against a store: a plain name
+    scans the series; ``<counter>.rate`` evaluates the per-interval
+    rate between consecutive samples (resets clamp to 0).  Returns
+    (times, values)."""
+    if metric.endswith(".rate"):
+        t, v = store.scan(metric[:-len(".rate")], t0, t1)
+        if len(t) < 2:
+            return (np.empty(0), np.empty(0))
+        dt = np.diff(t)
+        dv = np.diff(v)
+        good = dt > 0
+        rates = np.where(dv > 0, dv, 0.0)[good] / dt[good]
+        return (t[1:][good], rates)
+    return store.scan(metric, t0, t1)
+
+
+# ---------------------------------------------------------------------
+# per-process default store + background sampler
+# ---------------------------------------------------------------------
+
+_default = None
+_default_lock = threading.Lock()
+_sampler = None
+_sampler_stop = None
+
+
+def _safe_label():
+    from .trace import TRACER, _default_label
+    label = TRACER.label or _default_label()
+    return "".join(c if c.isalnum() or c in "-_." else "_"
+                   for c in label)
+
+
+def default_store(create=True):
+    """The process's own store under FLAGS_tsdb_dir — one
+    subdirectory per (label, pid), because segment files are
+    single-writer (flight dumps are keyed the same way).  None when
+    the flag is unset."""
+    global _default
+    root = FLAGS.tsdb_dir
+    if not root:
+        return None
+    root_abs = os.path.abspath(root)
+    with _default_lock:
+        if _default is not None \
+                and os.path.dirname(_default.dir) != root_abs:
+            # the root moved (tests, reconfiguration): close the old
+            # store cleanly and build a fresh one under the new root
+            try:
+                _default.close()
+            except Exception:
+                pass
+            _default = None
+        if _default is None:
+            d = os.path.join(root_abs,
+                             "%s_%d" % (_safe_label(), os.getpid()))
+            _default = TSDB(d, create=create)
+            atexit.register(_default.close)
+        return _default
+
+
+def open_stores(root):
+    """Read-only open of every per-process store under ``root`` (or of
+    ``root`` itself when it is a single store).  Returns
+    {label_dirname: TSDB} — the query side of the per-process layout."""
+    root = str(root)
+    if os.path.exists(os.path.join(root, META_NAME)):
+        return {os.path.basename(root.rstrip("/")) or root:
+                TSDB(root, create=False)}
+    out = {}
+    try:
+        children = sorted(os.listdir(root))
+    except OSError:
+        return out
+    for child in children:
+        d = os.path.join(root, child)
+        if os.path.exists(os.path.join(d, META_NAME)):
+            try:
+                out[child] = TSDB(d, create=False)
+            except Exception:
+                continue
+    return out
+
+
+def ensure_sampler():
+    """Start the background registry sampler once per process when
+    FLAGS_tsdb_dir is set (interval FLAGS_tsdb_sample_ms; 0 disables).
+    Best-effort and idempotent — instrumented subsystems (trainer
+    loop, serving server, RPC plane) call this at init so any process
+    with the flag set retains its metric history.  Also arms the SLO
+    evaluator (slo.ensure_evaluator) — the two run as one plane."""
+    global _sampler, _sampler_stop
+    if not FLAGS.tsdb_dir or int(FLAGS.tsdb_sample_ms) <= 0:
+        return None
+    with _default_lock:
+        if _sampler is not None:
+            return _sampler
+    store = default_store()
+    if store is None:
+        return None
+    with _default_lock:
+        if _sampler is not None:
+            return _sampler
+        _sampler_stop = threading.Event()
+        t = threading.Thread(target=_sample_loop,
+                             args=(store, _sampler_stop),
+                             daemon=True, name="tsdb-sampler")
+        _sampler = t
+        t.start()
+    # one FINAL sample at interpreter exit (runs before the store's
+    # own atexit close — LIFO): a short-lived worker's last counter
+    # increments land in the store even when the process exits inside
+    # a sampling interval
+    atexit.register(_final_sample, store)
+    try:
+        from . import slo as _slo
+        _slo.ensure_evaluator()
+    except Exception:
+        pass
+    return _sampler
+
+
+def _final_sample(store):
+    try:
+        if store._fh is not None:   # not already closed
+            sample_registry(store)
+    except Exception:
+        pass
+
+
+def _sample_loop(store, stop):
+    while not stop.is_set():
+        ms = int(FLAGS.tsdb_sample_ms)
+        if stop.wait(max(ms, 10) / 1000.0):
+            break
+        try:
+            sample_registry(store)
+        except Exception:
+            pass
+
+
+def stop_sampler():
+    """Stop the background sampler and forget the default store
+    (tests)."""
+    global _sampler, _sampler_stop, _default
+    with _default_lock:
+        stop, _sampler, _sampler_stop = _sampler_stop, None, None
+        store, _default = _default, None
+    if stop is not None:
+        stop.set()
+    if store is not None:
+        try:
+            store.close()
+        except Exception:
+            pass

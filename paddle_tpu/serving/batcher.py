@@ -30,6 +30,7 @@ from collections import deque
 
 import numpy as np
 
+from paddle_tpu.distributed.resilience import fault_point
 from paddle_tpu.observability import metrics as _metrics
 from paddle_tpu.observability.trace import TRACER
 
@@ -247,6 +248,12 @@ class Dispatcher:
                     feed = self._assemble(engine, batch, bucket, rows)
                 t1 = time.perf_counter()
                 exe = engine.executable(bucket)
+                # fault-lab hook (ISSUE 13): the 'serve_dispatch'
+                # point lets tools/fault_matrix.py's slo preset inject
+                # a latency fault into the serving data plane and
+                # assert the burn-rate alert + flight dump fire.
+                # No-op (one empty-tuple check) without FLAGS_fault_spec
+                fault_point("serve_dispatch")
                 with TRACER.span("serve.dispatch"):
                     outs = exe.run(feed)
                     outs = [np.asarray(o) for o in outs]

@@ -39,8 +39,27 @@ _M_SWAPS = _metrics.counter("serve_swaps_total",
                             "hot model swaps completed")
 
 
+def _tenant_metrics(name):
+    """Per-tenant SLO tagging (ISSUE 13): every tenant gets its own
+    always-on request-latency histogram and error counter, named
+    ``serve_request_ms_<tenant>`` / ``serve_request_errors_total_
+    <tenant>`` — the series a per-tenant latency/drop SLO
+    (``serve_request_ms_<tenant>.p99 <= budget``) evaluates from the
+    tsdb.  Registered once at tenant creation (registry lookups never
+    ride the request path)."""
+    safe = "".join(c if c.isalnum() or c == "_" else "_"
+                   for c in str(name))
+    return (_metrics.histogram(
+                "serve_request_ms_" + safe,
+                "end-to-end request latency, tenant %r" % name),
+            _metrics.counter(
+                "serve_request_errors_total_" + safe,
+                "requests failed/dropped, tenant %r" % name))
+
+
 class _Tenant:
-    __slots__ = ("name", "engine", "queue", "dispatcher")
+    __slots__ = ("name", "engine", "queue", "dispatcher", "m_lat",
+                 "m_err")
 
     def __init__(self, name, engine, max_wait_us):
         self.name = name
@@ -49,6 +68,7 @@ class _Tenant:
         self.dispatcher = Dispatcher(self.queue, lambda: self.engine,
                                      max_wait_us=max_wait_us,
                                      label=name)
+        self.m_lat, self.m_err = _tenant_metrics(name)
 
 
 class _GenTenant:
@@ -56,7 +76,8 @@ class _GenTenant:
     DecodeLoop (serving/generative.py) instead of the request-granular
     Dispatcher — requests are admitted per ITERATION, not per batch."""
 
-    __slots__ = ("name", "engine", "queue", "dispatcher")
+    __slots__ = ("name", "engine", "queue", "dispatcher", "m_lat",
+                 "m_err")
 
     def __init__(self, name, engine):
         from .generative import DecodeLoop
@@ -65,6 +86,7 @@ class _GenTenant:
         self.engine = engine
         self.queue = RequestQueue()
         self.dispatcher = DecodeLoop(engine, self.queue, label=name)
+        self.m_lat, self.m_err = _tenant_metrics(name)
 
 
 class InferenceServer:
@@ -79,6 +101,15 @@ class InferenceServer:
         self._lock = threading.Lock()
         self._endpoint = None
         self._closed = False
+        # Watchtower (ISSUE 13): a serving process with FLAGS_tsdb_dir
+        # set retains its request/latency history and arms the SLO
+        # evaluator (per-tenant p99/drop SLOs).  No-op without the
+        # flag.
+        try:
+            from paddle_tpu.observability import tsdb as _tsdb
+            _tsdb.ensure_sampler()
+        except Exception:
+            pass
 
     # -- tenants -------------------------------------------------------
     def load(self, name, model_dir, warm=None):
@@ -187,12 +218,42 @@ class InferenceServer:
             raise TypeError("tenant %r is generative — use generate(), "
                             "not submit/predict" % (name,))
         feed = {k: np.asarray(v) for k, v in feed.items()}
-        rows = tenant.engine.validate(feed)
+        try:
+            rows = tenant.engine.validate(feed)
+        except Exception:
+            # a rejected request is a per-tenant drop too — the drop
+            # SLO must see admission failures, not just batch failures
+            if _batcher._METRICS_ON:
+                tenant.m_err.inc()
+            raise
         fut = Future()
         if _batcher._METRICS_ON:
             _batcher._M_REQS.inc()
+            self._tag_tenant(tenant, fut)
         tenant.queue.put(Request(feed, rows, fut))
         return fut
+
+    @staticmethod
+    def _tag_tenant(tenant, fut):
+        """Per-tenant SLO tagging: observe this request's end-to-end
+        latency (success) or error/drop (exception) into the tenant's
+        own metrics when the future resolves — every completion path
+        (dispatch, validation inside the batch, dispatcher failure,
+        wire) funnels through the future, so nothing is missed."""
+        import time as _time
+
+        t0 = _time.perf_counter()
+
+        def _done(f):
+            try:
+                failed = f.exception() is not None
+            except Exception:   # cancelled: that is a drop
+                failed = True
+            if failed:
+                tenant.m_err.inc()
+            else:
+                tenant.m_lat.observe((_time.perf_counter() - t0) * 1e3)
+        fut.add_done_callback(_done)
 
     def predict(self, name, feed, timeout=None):
         return self.submit(name, feed).result(timeout)
@@ -236,6 +297,7 @@ class InferenceServer:
         fut = Future()
         if _batcher._METRICS_ON:
             _gen._M_GEN_REQS.inc()
+            self._tag_tenant(tenant, fut)
         tenant.queue.put(GenRequest(prompt, max_new_tokens, eos_id,
                                     fut))
         return fut

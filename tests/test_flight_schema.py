@@ -1,0 +1,107 @@
+"""Flight-recorder envelope golden (ISSUE 13 satellite).
+
+The flight_*.json artifact is parsed by tools/fault_matrix.py (every
+preset), tools/trace_report.py (--scale/--slo rollups),
+tools/watchtower.py (alert section) and the scale/slo preset asserts.
+PR 12 embedded the ledger with no versioning, so a shape change broke
+downstream parsers silently — this golden pins the envelope (reason,
+spans, metrics, ledger, slo, ...) and its schema_version: changing
+either without touching this file is a test failure, which is the
+point.
+"""
+import json
+import os
+
+from paddle_tpu.core.flags import FLAGS
+from paddle_tpu.observability import flight, ledger
+from paddle_tpu.observability import metrics as obs_metrics
+from paddle_tpu.observability import slo, tsdb
+
+# THE golden: the exact top-level key set of a flight dump.  Adding,
+# removing or renaming a key is a schema change — bump
+# flight.SCHEMA_VERSION and update this set in the same commit.
+ENVELOPE_KEYS = {
+    "kind", "schema_version", "reason", "wall_time", "pid", "label",
+    "telemetry_on", "blocked", "open_spans", "recent_spans",
+    "metrics", "ledger", "slo",
+}
+SCHEMA_VERSION = 1
+
+
+def _dump(tmp_path, **kw):
+    path = flight.dump("schema:test", directory=str(tmp_path), **kw)
+    assert path is not None
+    with open(path) as f:
+        return json.load(f)
+
+
+def test_envelope_keys_and_version(tmp_path):
+    rec = _dump(tmp_path)
+    assert set(rec.keys()) == ENVELOPE_KEYS, (
+        "flight envelope changed — bump flight.SCHEMA_VERSION and "
+        "update ENVELOPE_KEYS together: %r"
+        % sorted(set(rec.keys()) ^ ENVELOPE_KEYS))
+    assert rec["schema_version"] == SCHEMA_VERSION
+    assert flight.SCHEMA_VERSION == SCHEMA_VERSION
+    assert rec["kind"] == "flight_recorder"
+    assert rec["reason"] == "schema:test"
+    assert rec["pid"] == os.getpid()
+    # always-present sections keep their shape even when empty
+    assert isinstance(rec["metrics"], dict)
+    assert isinstance(rec["open_spans"], list)
+    assert isinstance(rec["recent_spans"], list)
+
+
+def test_ledger_section_shape(tmp_path):
+    """The embedded ledger keeps its {resources, series} shape (the
+    PR 12 contract fault_matrix's scale preset parses)."""
+    ledger.reset()
+    ledger.register("t", lambda: {"schema_probe_bytes": 42})
+    try:
+        ledger.sample_now()
+        rec = _dump(tmp_path)
+        led = rec["ledger"]
+        assert set(led.keys()) == {"resources", "series"}
+        assert led["resources"]["schema_probe_bytes"] == 42
+        assert isinstance(led["series"], list)
+        assert led["series"][-1]["values"]["schema_probe_bytes"] == 42
+    finally:
+        ledger.reset()
+
+
+def test_slo_section_shape(tmp_path):
+    """Without an evaluator the slo key is present-but-None; with one
+    it carries {status, alerts}; an alert-written dump's sections
+    override embeds the offending series under slo.alert."""
+    slo.reset()
+    rec = _dump(tmp_path)
+    assert rec["slo"] is None
+
+    store = tsdb.TSDB(str(tmp_path / "ts"))
+    import time
+    now = time.time()
+    for i in range(10):
+        store.append_row({"m": 1.0}, t=now - 10 + i)
+    ev = slo.install(store=store,
+                     specs=slo.load_specs("m<=5"))
+    ev.evaluate(now=now)
+    try:
+        rec = _dump(tmp_path)
+        assert set(rec["slo"].keys()) == {"status", "alerts"}
+        assert rec["slo"]["status"][0]["name"] == "m"
+        # sections= enriches the envelope without changing its keys
+        rec2 = _dump(tmp_path, sections={"slo": {"alert": {
+            "slo": "m", "series": [[now, 1.0]]}}})
+        assert set(rec2.keys()) == ENVELOPE_KEYS
+        assert rec2["slo"]["alert"]["slo"] == "m"
+    finally:
+        slo.reset()
+        store.close()
+
+
+def test_dump_is_json_roundtrippable(tmp_path):
+    """Every envelope value is plain JSON (no numpy scalars leak):
+    a full dumps/loads round trip is identity."""
+    obs_metrics.counter("flight_schema_counter").inc(3)
+    rec = _dump(tmp_path)
+    assert json.loads(json.dumps(rec)) == rec

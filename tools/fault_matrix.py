@@ -61,6 +61,12 @@ PRESETS = {
     # and FAIL unless the ledger tripwire left a flight artifact whose
     # embedded ledger SERIES shows the growth — run_scale_preset()
     "scale": "",
+    # Watchtower (ISSUE 13): inject a LATENCY fault into the serving
+    # dispatch path during a short serve+train loop with the tsdb
+    # sampler + SLO evaluator armed, and FAIL unless a burn-rate
+    # alert fires and its flight dump names the violated SLO and
+    # embeds the offending series — run_slo_preset()
+    "slo": "serve_dispatch:delay:0.02",
 }
 
 # extra environment a preset exports into the pytest run (and, by
@@ -163,6 +169,54 @@ def run_scale_preset():
     return rc, time.time() - t0, dump_dir, matched
 
 
+def run_slo_preset(spec, pytest_args):
+    """The 'slo' preset is a burn-rate drill, not a resilience sweep:
+    tests/test_slo.py's fault drill runs a short serve+train loop with
+    the Watchtower sampler + SLO evaluator on while the injected
+    ``serve_dispatch`` delay blows the request-latency SLO, and this
+    runner FAILs (rc 3) unless a flight_*.json with an ``slo:*``
+    reason lands whose embedded alert names the violated SLO and
+    carries a non-empty offending series — the breadcrumb that makes
+    a burned error budget diagnosable after the fact."""
+    import json
+
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["FLAGS_fault_spec"] = spec
+    dump_dir = tempfile.mkdtemp(prefix="fault_flight_slo_")
+    env["FLAGS_telemetry_dump_dir"] = dump_dir
+    cmd = [sys.executable, "-m", "pytest", "tests/test_slo.py",
+           "-q", "-k", "fault_drill", "-p", "no:cacheprovider",
+           "-o", "addopts="] + pytest_args
+    t0 = time.time()
+    proc = subprocess.run(cmd, cwd=REPO, env=env)
+    rc = proc.returncode
+    matched = 0
+    for path in glob.glob(os.path.join(dump_dir, "flight_*.json")):
+        try:
+            with open(path) as f:
+                rec = json.load(f)
+        except Exception:
+            continue
+        if not str(rec.get("reason", "")).startswith("slo:"):
+            continue
+        alert = (rec.get("slo") or {}).get("alert") or {}
+        if alert.get("slo") and alert.get("series"):
+            matched += 1
+    if rc == 0 and matched == 0:
+        print("preset 'slo': no flight_*.json with an slo:* reason "
+              "naming the violated SLO + offending series under %s — "
+              "the burned budget was not attributed" % dump_dir,
+              file=sys.stderr)
+        rc = 3
+    if rc == 0:
+        shutil.rmtree(dump_dir, ignore_errors=True)
+    else:
+        print("preset 'slo' FAILED (rc=%d); artifacts kept at %s"
+              % (rc, dump_dir), file=sys.stderr)
+    return rc, time.time() - t0, dump_dir, matched
+
+
 def run_preset(name, spec, seed, pytest_args):
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
@@ -239,6 +293,11 @@ def main(argv=None):
             continue
         if name == "scale":
             rc, secs, dump_dir, n_dumps = run_scale_preset()
+            rows.append((name, rc, secs, n_dumps))
+            continue
+        if name == "slo":
+            rc, secs, dump_dir, n_dumps = run_slo_preset(spec,
+                                                         pytest_args)
             rows.append((name, rc, secs, n_dumps))
             continue
         rc, secs, dump_dir, n_dumps = run_preset(name, spec, args.seed,

@@ -1,0 +1,396 @@
+#!/usr/bin/env python
+"""Perf-regression sentinel (ISSUE 13 tentpole c): turn the pile of
+bench artifacts into ONE trajectory, and fail loudly when a run
+regresses.
+
+Every measured claim this repo makes lives in a disconnected JSON file
+— ``SERVE_BENCH.json``, ``PSERVER_BENCH.json``, ``SCALE_BENCH.json``,
+the driver-wrapped ``BENCH_r*.json`` training runs — and nothing
+compares across them.  This tool:
+
+1. **ingests** every known artifact under ``--repo`` (plus optional
+   Watchtower tsdb stores via ``--tsdb``) through per-shape extractors
+   into named scalar metrics with an explicit better-direction,
+2. **builds** ``PERF_TRAJECTORY.json``: metric -> ordered runs ->
+   recorded floor (the best non-quick value ever measured) — the
+   canonical perf record every later run is judged against
+   (MIGRATION.md),
+3. **checks** (``--check RUN.json``): extracts the same metrics from a
+   fresh run and exits **rc 3** when any regresses more than
+   ``--max-regress`` (default 15%) against its recorded floor.  Quick
+   (smoke-sized) runs only ever compare against quick floors — a
+   seconds-scale CI smoke is not evidence against a full run's floor.
+
+Wired as ``--sentinel`` at the end of tools/serve_bench.py,
+tools/pserver_bench.py and tools/scale_bench.py (ROADMAP: bench tools
+should always pass it), and smoke-tested in tier-1
+(tests/test_watchtower.py: a synthetic trajectory with a planted
+regression must rc 3; clean must rc 0).
+
+Usage:
+    python tools/perf_sentinel.py                      # build + write
+    python tools/perf_sentinel.py --check NEW.json     # gate a run
+    python tools/perf_sentinel.py --tsdb /tmp/tsdb --json
+"""
+import argparse
+import glob
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+TRAJECTORY_NAME = "PERF_TRAJECTORY.json"
+DEFAULT_MAX_REGRESS = 0.15
+RC_REGRESSION = 3
+
+
+def _m(value, hib=True, unit=""):
+    if value is None:
+        return None
+    try:
+        v = float(value)
+    except (TypeError, ValueError):
+        return None
+    return {"value": v, "higher_is_better": bool(hib), "unit": unit}
+
+
+def _get(obj, *path):
+    for key in path:
+        if not isinstance(obj, dict):
+            return None
+        obj = obj.get(key)
+    return obj
+
+
+def _extract_serve(obj):
+    out = {
+        "serve_floor_qps": _m(_get(obj, "floor", "qps"), True, "qps"),
+        "serve_poisson_qps": _m(_get(obj, "poisson", "qps"), True,
+                                "qps"),
+        "serve_poisson_p99_ms": _m(_get(obj, "poisson", "p99_ms"),
+                                   False, "ms"),
+        "serve_saturated_qps": _m(_get(obj, "saturated", "qps"), True,
+                                  "qps"),
+        "serve_gen_floor_tokens_s": _m(
+            _get(obj, "generate", "floor", "tokens_s"), True, "tok/s"),
+        "serve_gen_poisson_tokens_s": _m(
+            _get(obj, "generate", "poisson", "tokens_s"), True,
+            "tok/s"),
+        "serve_gen_itl_p99_ms": _m(
+            _get(obj, "generate", "poisson", "itl_p99_ms"), False,
+            "ms"),
+    }
+    return {k: v for k, v in out.items() if v is not None}
+
+
+def _extract_pserver(obj):
+    out = {
+        "pserver_dense_rounds_per_sec": _m(
+            obj.get("dense_rounds_per_sec"), True, "rounds/s"),
+        "pserver_sparse_rows_per_sec": _m(
+            obj.get("sparse_rows_per_sec"), True, "rows/s"),
+        "pserver_ctr_flat_rows_per_sec": _m(
+            _get(obj, "ctr", "flat_sync", "rows_per_sec"), True,
+            "rows/s"),
+        "pserver_ctr_hier_async_rows_per_sec": _m(
+            _get(obj, "ctr", "hier_async_int8", "rows_per_sec"), True,
+            "rows/s"),
+    }
+    return {k: v for k, v in out.items() if v is not None}
+
+
+def _extract_scale(obj):
+    rows = [r.get("rows_per_sec")
+            for r in (obj.get("sweep") or []) + (obj.get("variants")
+                                                 or [])
+            if isinstance(r, dict) and r.get("rows_per_sec")]
+    out = {}
+    if rows:
+        out["scale_peak_rows_per_sec"] = _m(max(rows), True, "rows/s")
+    knee = _get(obj, "knee", "trainers")
+    if knee:
+        out["scale_knee_trainers"] = _m(knee, True, "trainers")
+    return out
+
+
+def _extract_bench_lines(text):
+    """The driver-wrapped training bench (BENCH_r*.json 'tail'): each
+    measured claim is one ``{"metric": ..., "value": ..., "unit"}``
+    JSON line on stdout; the 'partial' headline is superseded by the
+    enriched exit line of the same metric when both landed."""
+    found = {}
+    for line in str(text).splitlines():
+        line = line.strip()
+        if not (line.startswith("{") and '"metric"' in line):
+            continue
+        try:
+            d = json.loads(line)
+        except ValueError:
+            continue
+        name, value = d.get("metric"), d.get("value")
+        if not name or value is None:
+            continue
+        unit = d.get("unit") or ""
+        hib = not ("ms" in unit or unit.endswith("_s")
+                   or "latency" in str(name))
+        if name in found and d.get("partial") \
+                and not found[name].get("_partial"):
+            continue
+        m = _m(value, hib, unit)
+        if m is None:       # non-numeric value: not a metric line
+            continue
+        found[name] = dict(m, _partial=bool(d.get("partial")))
+        sec = d.get("secondary")
+        if isinstance(sec, dict) and sec.get("metric") \
+                and sec.get("value") is not None:
+            found[sec["metric"]] = dict(
+                _m(sec["value"], True, sec.get("unit") or "") or {})
+    return {k: {kk: vv for kk, vv in v.items() if kk != "_partial"}
+            for k, v in found.items() if v}
+
+
+def extract_metrics(obj):
+    """Route one artifact (parsed JSON) to its extractor; returns
+    ({metric: {value, higher_is_better, unit}}, quick_flag)."""
+    if isinstance(obj, dict) and "tail" in obj and "cmd" in obj:
+        return _extract_bench_lines(obj.get("tail", "")), False
+    kind = obj.get("metric") if isinstance(obj, dict) else None
+    quick = bool(isinstance(obj, dict) and obj.get("quick"))
+    if kind == "serve_bench":
+        return _extract_serve(obj), quick
+    if kind == "pserver_bench":
+        return _extract_pserver(obj), quick
+    if kind == "scale_bench":
+        return _extract_scale(obj), quick
+    if isinstance(obj, dict) and kind and "value" in obj:
+        # a bare bench.py headline line saved to a file
+        return _extract_bench_lines(json.dumps(obj)), quick
+    return {}, quick
+
+
+def load_artifact(path):
+    with open(path) as f:
+        return json.load(f)
+
+
+def collect_repo(repo):
+    """[(source, metrics, quick)] over every known artifact, in a
+    deterministic order (numbered training rounds first — they are
+    the oldest evidence — then the current per-subsystem records)."""
+    runs = []
+    paths = sorted(glob.glob(os.path.join(repo, "BENCH_r*.json")))
+    for name in ("PSERVER_BENCH.json", "SERVE_BENCH.json",
+                 "SCALE_BENCH.json"):
+        p = os.path.join(repo, name)
+        if os.path.exists(p):
+            paths.append(p)
+    for p in paths:
+        try:
+            metrics, quick = extract_metrics(load_artifact(p))
+        except Exception:
+            continue
+        if metrics:
+            runs.append((os.path.basename(p), metrics, quick))
+    return runs
+
+
+def ingest_tsdb(root):
+    """Summarize every per-process Watchtower store under ``root``:
+    {store: {series: {last, mean, max, n}}} — durable evidence rows
+    for the trajectory (context, not gated)."""
+    from paddle_tpu.observability import tsdb as _tsdb
+
+    out = {}
+    for label, store in sorted(_tsdb.open_stores(root).items()):
+        rows = {}
+        for name in store.names():
+            t, v = store.scan(name)
+            if len(v) == 0:
+                continue
+            rows[name] = {"last": round(float(v[-1]), 6),
+                          "mean": round(float(v.mean()), 6),
+                          "max": round(float(v.max()), 6),
+                          "n": int(len(v))}
+        if rows:
+            out[label] = rows
+    return out
+
+
+def build_trajectory(repo=None, tsdb_root=None, runs=None):
+    """metric -> ordered runs -> floor.  The floor is the best
+    FULL-run value ever recorded (max for higher-is-better, min
+    otherwise); quick smoke runs track their own quick_floor so a CI
+    smoke is only ever judged against smoke-sized evidence."""
+    runs = collect_repo(repo or REPO) if runs is None else runs
+    metrics = {}
+    for source, mdict, quick in runs:
+        for name, m in mdict.items():
+            ent = metrics.setdefault(name, {
+                "unit": m.get("unit", ""),
+                "higher_is_better": m["higher_is_better"],
+                "runs": []})
+            ent["runs"].append({"source": source,
+                                "value": m["value"],
+                                "quick": bool(quick)})
+    for name, ent in metrics.items():
+        pick = max if ent["higher_is_better"] else min
+        for key, want_quick in (("floor", False), ("quick_floor",
+                                                   True)):
+            vals = [r["value"] for r in ent["runs"]
+                    if r["quick"] == want_quick]
+            ent[key] = pick(vals) if vals else None
+        ent["latest"] = ent["runs"][-1]["value"]
+    traj = {"kind": "perf_trajectory", "version": 1,
+            "built_from": [s for s, _, _ in runs],
+            "metrics": metrics}
+    if tsdb_root:
+        try:
+            traj["tsdb"] = ingest_tsdb(tsdb_root)
+        except Exception as e:
+            traj["tsdb_error"] = str(e)[:200]
+    return traj
+
+
+def check_metrics(traj, mdict, quick=False,
+                  max_regress=DEFAULT_MAX_REGRESS):
+    """Compare one run's metrics against the trajectory floors.
+    Returns (regressions, checked, skipped) — a regression row names
+    the metric, the floor, the new value and the fraction lost."""
+    regressions, checked, skipped = [], [], []
+    floor_key = "quick_floor" if quick else "floor"
+    for name, m in sorted(mdict.items()):
+        ent = (traj.get("metrics") or {}).get(name)
+        floor = ent.get(floor_key) if ent else None
+        if ent is None or floor is None or floor == 0:
+            skipped.append(name)
+            continue
+        v = m["value"]
+        if ent["higher_is_better"]:
+            regress = (floor - v) / abs(floor)
+        else:
+            regress = (v - floor) / abs(floor)
+        row = {"metric": name, "floor": floor, "value": v,
+               "regress_frac": round(regress, 4),
+               "higher_is_better": ent["higher_is_better"],
+               "quick": bool(quick)}
+        checked.append(row)
+        if regress > max_regress:
+            regressions.append(row)
+    return regressions, checked, skipped
+
+
+def check_artifact(path_or_obj, traj=None, repo=None,
+                   max_regress=DEFAULT_MAX_REGRESS):
+    """The --sentinel entry the bench tools call: extract the fresh
+    run, compare against the recorded trajectory (built from the repo
+    when none is passed), return (rc, report_dict)."""
+    obj = load_artifact(path_or_obj) \
+        if isinstance(path_or_obj, str) else path_or_obj
+    if traj is None:
+        traj_path = os.path.join(repo or REPO, TRAJECTORY_NAME)
+        traj = load_artifact(traj_path) \
+            if os.path.exists(traj_path) \
+            else build_trajectory(repo or REPO)
+    mdict, quick = extract_metrics(obj)
+    regressions, checked, skipped = check_metrics(
+        traj, mdict, quick=quick, max_regress=max_regress)
+    report = {"kind": "perf_sentinel_check",
+              "max_regress": max_regress, "quick": bool(quick),
+              "checked": checked, "skipped": skipped,
+              "regressions": regressions,
+              "ok": not regressions}
+    return (0 if not regressions else RC_REGRESSION), report
+
+
+def sentinel_gate(out):
+    """The ONE --sentinel implementation the bench tools share:
+    check the fresh run dict against the recorded trajectory, print
+    a one-line JSON report, return the rc (0 clean, 3 regression).
+    Report shape / rc policy live here, not in three copies."""
+    rc, report = check_artifact(out)
+    print(json.dumps({"sentinel": {
+        "ok": report["ok"], "checked": len(report["checked"]),
+        "skipped": len(report["skipped"]),
+        "regressions": report["regressions"]}}))
+    return rc
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="build PERF_TRAJECTORY.json from every bench "
+                    "artifact; gate new runs against recorded floors")
+    ap.add_argument("--repo", default=REPO,
+                    help="repo root to scan for *_BENCH.json / "
+                         "BENCH_r*.json artifacts")
+    ap.add_argument("--out", default=None, metavar="PATH",
+                    help="trajectory output path (default "
+                         "<repo>/%s)" % TRAJECTORY_NAME)
+    ap.add_argument("--no-write", action="store_true",
+                    help="build/report only; leave the trajectory "
+                         "file untouched")
+    ap.add_argument("--tsdb", default=None, metavar="DIR",
+                    help="Watchtower tsdb root to ingest (per-process "
+                         "store summaries ride the trajectory)")
+    ap.add_argument("--check", default=None, metavar="RUN.json",
+                    help="gate this fresh run artifact against the "
+                         "recorded floors; rc 3 on regression")
+    ap.add_argument("--max-regress", type=float,
+                    default=DEFAULT_MAX_REGRESS,
+                    help="tolerated loss vs the recorded floor "
+                         "(fraction, default 0.15)")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the full JSON instead of the table")
+    args = ap.parse_args(argv)
+
+    traj = build_trajectory(args.repo, tsdb_root=args.tsdb)
+    out_path = args.out or os.path.join(args.repo, TRAJECTORY_NAME)
+    if not args.no_write:
+        from paddle_tpu.core.fsutil import atomic_write
+        atomic_write(out_path, json.dumps(traj, indent=1,
+                                          sort_keys=True) + "\n")
+
+    if args.check:
+        rc, report = check_artifact(args.check, traj=traj,
+                                    max_regress=args.max_regress)
+        if args.json:
+            print(json.dumps(report, indent=2))
+        else:
+            for row in report["checked"]:
+                mark = "REGRESSED" if row in report["regressions"] \
+                    else "ok"
+                print("%-40s floor %12.4g  new %12.4g  %+7.1f%%  %s"
+                      % (row["metric"], row["floor"], row["value"],
+                         -100.0 * row["regress_frac"], mark))
+            for name in report["skipped"]:
+                print("%-40s (no recorded %sfloor — skipped)"
+                      % (name,
+                         "quick " if report["quick"] else ""))
+            print("sentinel: %d checked, %d skipped, %d regression(s)"
+                  % (len(report["checked"]), len(report["skipped"]),
+                     len(report["regressions"])))
+        return rc
+
+    if args.json:
+        print(json.dumps(traj, indent=2, sort_keys=True))
+    else:
+        print("%-40s %7s %12s %12s %12s" % (
+            "metric", "runs", "floor", "latest", "direction"))
+        for name, ent in sorted(traj["metrics"].items()):
+            print("%-40s %7d %12.4g %12.4g %12s" % (
+                name, len(ent["runs"]),
+                ent["floor"] if ent["floor"] is not None
+                else float("nan"),
+                ent["latest"],
+                "higher" if ent["higher_is_better"] else "lower"))
+        if not args.no_write:
+            print("wrote %s (%d metrics from %d artifacts)"
+                  % (out_path, len(traj["metrics"]),
+                     len(traj["built_from"])))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -409,6 +409,12 @@ def main(argv=None):
                     help="skip the CTR-shaped scenario")
     ap.add_argument("--no-sweep", action="store_true",
                     help="skip the dense compression-codec sweep")
+    ap.add_argument("--sentinel", action="store_true",
+                    help="gate this run against PERF_TRAJECTORY.json "
+                         "via tools/perf_sentinel.py (rc 3 on a >15%% "
+                         "regression vs the recorded floor; quick "
+                         "runs only compare against quick floors).  "
+                         "ROADMAP: always pass this")
     args = ap.parse_args(argv)
 
     if args.quick:
@@ -489,7 +495,15 @@ def main(argv=None):
     if args.json:
         with open(args.json, "w") as f:
             f.write(line + "\n")
+    if args.sentinel:
+        # perf sentinel (ISSUE 13): rc 3 when a measured metric
+        # regresses >15% against its recorded PERF_TRAJECTORY floor
+        sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+        from perf_sentinel import sentinel_gate
+
+        return sentinel_gate(out)
+    return 0
 
 
 if __name__ == "__main__":
-    main()
+    sys.exit(main())

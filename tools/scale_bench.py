@@ -530,6 +530,12 @@ def main(argv=None):
                          "--collapse only)")
     ap.add_argument("--no-variants", action="store_true",
                     help="skip the staleness/codec/hier variants")
+    ap.add_argument("--sentinel", action="store_true",
+                    help="gate this run against PERF_TRAJECTORY.json "
+                         "via tools/perf_sentinel.py (rc 3 on a >15%% "
+                         "regression vs the recorded floor; quick "
+                         "runs only compare against quick floors).  "
+                         "ROADMAP: always pass this")
     args = ap.parse_args(argv)
 
     global DIM_IN, DIM_OUT
@@ -626,6 +632,13 @@ def main(argv=None):
     # failure — the fault_matrix 'scale' preset keys off this rc
     if args.collapse and not out["collapse"]["tripped"]:
         return 2
+    if args.sentinel:
+        # perf sentinel (ISSUE 13): rc 3 when a measured metric
+        # regresses >15% against its recorded PERF_TRAJECTORY floor
+        sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+        from perf_sentinel import sentinel_gate
+
+        return sentinel_gate(out)
     return 0
 
 

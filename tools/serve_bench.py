@@ -561,6 +561,12 @@ def main(argv=None):
                     help="which serving planes to bench: the PR 9 "
                          "predict phases, the ISSUE 11 token-level "
                          "generate phases, or both (default)")
+    ap.add_argument("--sentinel", action="store_true",
+                    help="gate this run against PERF_TRAJECTORY.json "
+                         "via tools/perf_sentinel.py (rc 3 on a >15%% "
+                         "regression vs the recorded floor; quick "
+                         "runs only compare against quick floors).  "
+                         "ROADMAP: always pass this")
     args = ap.parse_args(argv)
 
     import tempfile
@@ -587,7 +593,8 @@ def main(argv=None):
         if args.out:
             with open(args.out, "w") as f:
                 f.write(line + "\n")
-        return 0 if out["ok"] else 1
+        rc = 0 if out["ok"] else 1
+        return rc or (_sentinel_check(out) if args.sentinel else 0)
 
     tmp = tempfile.mkdtemp(prefix="serve_bench_")
     d1, d2 = os.path.join(tmp, "v1"), os.path.join(tmp, "v2")
@@ -675,7 +682,18 @@ def main(argv=None):
     if args.out:
         with open(args.out, "w") as f:
             f.write(line + "\n")
-    return 0 if out["ok"] else 1
+    rc = 0 if out["ok"] else 1
+    return rc or (_sentinel_check(out) if args.sentinel else 0)
+
+
+def _sentinel_check(out):
+    """Perf sentinel (ISSUE 13): gate the fresh run against the
+    recorded PERF_TRAJECTORY.json floors; rc 3 (and a one-line JSON
+    report) on regression."""
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from perf_sentinel import sentinel_gate
+
+    return sentinel_gate(out)
 
 
 if __name__ == "__main__":

@@ -350,6 +350,113 @@ def _measure_ledger_us(repeats=3, iters=2000):
     return best * 1e6, max(1, int(FLAGS.ledger_sample_ms))
 
 
+def _measure_tsdb_us(repeats=3, iters=300):
+    """Watchtower registry-sampler gate (ISSUE 13 satellite): the
+    sampler appends one snapshot row of the whole registry every
+    FLAGS_tsdb_sample_ms, so its steady-state cost is bounded by
+    sample_cost / interval — measured deterministically like the
+    ledger gate (micro-time one full ``tsdb.sample_registry`` against
+    a real on-disk store, over the registry as populated by the gates
+    above: ~100 metrics, the realistic worst case).
+
+    Returns (sample_us, interval_ms)."""
+    import shutil
+    import tempfile
+
+    from paddle_tpu.core.flags import FLAGS
+    from paddle_tpu.observability import tsdb
+
+    d = tempfile.mkdtemp(prefix="tsdb_gate_")
+    try:
+        store = tsdb.TSDB(d)
+        tsdb.sample_registry(store)      # warm (sid assignment, meta)
+        best = float("inf")
+        for _ in range(repeats):
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                tsdb.sample_registry(store)
+            best = min(best, (time.perf_counter() - t0) / iters)
+        store.close()
+    finally:
+        shutil.rmtree(d, ignore_errors=True)
+    return best * 1e6, max(1, int(FLAGS.tsdb_sample_ms))
+
+
+def _measure_slo_us(repeats=3, iters=200, samples=600):
+    """Watchtower SLO-evaluator gate (ISSUE 13 satellite): the
+    evaluator scans each spec's fast+slow windows every
+    FLAGS_slo_eval_ms, so its cost is bounded by eval_cost /
+    interval.  Micro-timed over a realistic store (4 specs incl. a
+    .rate objective, ``samples`` points per series — more history
+    than a default-retention fast window ever holds).
+
+    Returns (eval_us, interval_ms)."""
+    import shutil
+    import tempfile
+
+    from paddle_tpu.core.flags import FLAGS
+    from paddle_tpu.observability import slo as slo_mod
+    from paddle_tpu.observability import tsdb
+
+    d = tempfile.mkdtemp(prefix="slo_gate_")
+    try:
+        store = tsdb.TSDB(d)
+        now = time.time()
+        for i in range(samples):
+            store.append_row(
+                {"serve_request_ms.p99": 1.0 + (i % 7),
+                 "executor_step_wall_ms.p99": 5.0,
+                 "pserver_rounds_applied_total": i,
+                 "numerics_nonfinite_total": 0},
+                t=now - samples + i)
+        specs = slo_mod.load_specs(
+            "serve_request_ms.p99<=10,"
+            "executor_step_wall_ms.p99<=100,"
+            "pserver_rounds_applied_total.rate>=0.5,"
+            "numerics_nonfinite_total==0")
+        ev = slo_mod.Evaluator(store, specs, dump_alerts=False)
+        ev.evaluate(now=now)             # warm
+        best = float("inf")
+        for _ in range(repeats):
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                ev.evaluate(now=now)
+            best = min(best, (time.perf_counter() - t0) / iters)
+        store.close()
+    finally:
+        shutil.rmtree(d, ignore_errors=True)
+    return best * 1e6, max(1, int(FLAGS.slo_eval_ms))
+
+
+def record_gate_gauges(out):
+    """Mirror every measured gate fraction into the always-on registry
+    (gate name -> ``telemetry_gate_<name>`` gauge) and, when a
+    Watchtower store is configured (FLAGS_tsdb_dir), sample the
+    registry once — so overhead history is retained as durable time
+    series instead of living only in this tool's stdout (ISSUE 13
+    satellite).  Returns the gauge names written."""
+    from paddle_tpu.core.flags import FLAGS
+    from paddle_tpu.observability import metrics
+
+    names = []
+    for key, val in out.items():
+        if not key.endswith("_frac"):
+            continue
+        name = "telemetry_gate_" + key
+        metrics.gauge(name, "measured overhead fraction from "
+                            "tools/telemetry_overhead.py").set(val)
+        names.append(name)
+    if FLAGS.tsdb_dir:
+        try:
+            from paddle_tpu.observability import tsdb
+            store = tsdb.default_store()
+            if store is not None:
+                tsdb.sample_registry(store)
+        except Exception:
+            pass
+    return names
+
+
 def main(argv=None):
     step_us = _measure_step_us()
     probe_ns = _measure_probe_ns()
@@ -372,6 +479,12 @@ def main(argv=None):
     ledger_us, ledger_ms = _measure_ledger_us()
     ledger_frac = ledger_us / (ledger_ms * 1e3)
     ledger_limit = float(os.environ.get("LEDGER_OVERHEAD_MAX", "0.02"))
+    tsdb_us, tsdb_ms = _measure_tsdb_us()
+    tsdb_frac = tsdb_us / (tsdb_ms * 1e3)
+    tsdb_limit = float(os.environ.get("TSDB_OVERHEAD_MAX", "0.02"))
+    slo_us, slo_ms = _measure_slo_us()
+    slo_frac = slo_us / (slo_ms * 1e3)
+    slo_limit = float(os.environ.get("SLO_OVERHEAD_MAX", "0.02"))
     out = {
         "step_us": round(step_us, 2),
         "probe_ns_per_site": round(probe_ns, 1),
@@ -407,11 +520,29 @@ def main(argv=None):
         "ledger_interval_ms": ledger_ms,
         "ledger_overhead_frac": round(ledger_frac, 6),
         "ledger_limit": ledger_limit,
+        # ISSUE 13: Watchtower sampler + SLO evaluator — one full
+        # registry sample / SLO evaluation pass vs their sampling
+        # intervals (the same steady-state core-steal bound as the
+        # ledger collector), decomposed like the other gates
+        "tsdb_sample_us": round(tsdb_us, 2),
+        "tsdb_interval_ms": tsdb_ms,
+        "tsdb_overhead_frac": round(tsdb_frac, 6),
+        "tsdb_limit": tsdb_limit,
+        "slo_eval_us": round(slo_us, 2),
+        "slo_interval_ms": slo_ms,
+        "slo_overhead_frac": round(slo_frac, 6),
+        "slo_limit": slo_limit,
         "ok": (frac < limit and num_frac < num_limit
                and serve_frac < serve_limit
                and gen_frac < gen_limit
-               and ledger_frac < ledger_limit),
+               and ledger_frac < ledger_limit
+               and tsdb_frac < tsdb_limit
+               and slo_frac < slo_limit),
     }
+    # gate name -> gauge (+ one tsdb sample when FLAGS_tsdb_dir is
+    # set): the measured overheads become durable history, not just
+    # this line of stdout
+    record_gate_gauges(out)
     print(json.dumps(out))
     return 0 if out["ok"] else 1
 

@@ -18,12 +18,20 @@ Usage:
     python tools/trace_report.py DUMPS... --numerics   # grad-norm
         rollup per process; numerics_*.json trip artifacts passed as
         inputs are summarized (first bad op, round cid, recent losses)
+    python tools/trace_report.py DUMPS... --all        # every rollup
 
 --merge writes one chrome://tracing JSON: each process is a chrome
 pid named by its label, and spans of the same sync round share a
 ``cid`` arg ((round, sender, seq) wire identity) — select one in the
 viewer to correlate a trainer's send/barrier/get with the pserver's
 scatter/apply for that round.
+
+Per-subsystem rollups are table-registry driven (ROLLUPS below): each
+entry names its flag, the export.py rows/format pair and its section
+title, so a new subsystem adds ONE registry row instead of another
+copy-paste dispatch branch (ISSUE 13 satellite; rollups had been
+copy-pasted per flag since PR 7).  ``--all`` implies ``--kernels``
+plus every registry rollup.
 """
 import argparse
 import json
@@ -33,6 +41,48 @@ import sys
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 if REPO not in sys.path:
     sys.path.insert(0, REPO)
+
+# one row per rollup: (flag/attr name, export rows fn, export format
+# fn, text-mode section title, --help text).  Everything downstream —
+# argparse registration, --all, the JSON wrap and the text sections —
+# iterates this table.
+ROLLUPS = (
+    ("numerics", "numerics_rows", "format_numerics_table",
+     "numerics rollup (grad-norm trend / nonfinite sightings per "
+     "process):",
+     "print the numerics-observatory rollup (grad-norm trend, param "
+     "absmax, nonfinite counts per process — ISSUE 8); "
+     "numerics_*.json trip artifacts may also be passed as inputs "
+     "and are summarized"),
+    ("wire", "wire_rows", "format_wire_table",
+     "wire rollup (grad compression / fastwire traffic / staleness "
+     "per process):",
+     "print the pserver wire/compression rollup (grad bytes raw vs "
+     "on-wire, codec encode time, fastwire traffic, staleness gap "
+     "per process — ISSUE 10)"),
+    ("serve", "serve_rows", "format_serve_table",
+     "serve rollup (requests/tokens / decode occupancy / TTFT+ITL / "
+     "paged KV pressure per process):",
+     "print the serving-tier rollup (requests/tokens, decode-batch "
+     "occupancy, TTFT and inter-token latency, paged KV cache "
+     "pressure: blocks used/total, allocation failures, preemptions "
+     "— ISSUE 11)"),
+    ("scale", "scale_rows", "format_scale_table",
+     "scale rollup (resource ledgers per process: pending grads / "
+     "caches+evictions / barrier quorum / apply backlog):",
+     "print the scale-observatory rollup (resource ledgers per "
+     "process: pending-grad footprint, reply/replay cache bytes + "
+     "evictions, barrier set, apply backlog, oldest-pending age, "
+     "quorum scan work — ISSUE 12); flight dumps work as inputs too "
+     "(their metrics snapshot carries the ledger gauges)"),
+    ("slo", "slo_rows", "format_slo_table",
+     "slo rollup (burn rates / budget remaining / alerts per "
+     "process):",
+     "print the Watchtower SLO rollup (per-spec fast/slow burn "
+     "rates, error budget remaining, alert counters per process — "
+     "ISSUE 13); flight dumps written by a firing alert carry the "
+     "offending series too"),
+)
 
 
 def _print_trips(paths):
@@ -88,33 +138,18 @@ def main(argv=None):
                     help="with --json: wrap output as {phases, kernels} "
                          "including the per-kernel rollup (text mode "
                          "always prints the rollup when kernels exist)")
-    ap.add_argument("--numerics", action="store_true",
-                    help="print the numerics-observatory rollup "
-                         "(grad-norm trend, param absmax, nonfinite "
-                         "counts per process — ISSUE 8); "
-                         "numerics_*.json trip artifacts may also be "
-                         "passed as inputs and are summarized")
-    ap.add_argument("--wire", action="store_true",
-                    help="print the pserver wire/compression rollup "
-                         "(grad bytes raw vs on-wire, codec encode "
-                         "time, fastwire traffic, staleness gap per "
-                         "process — ISSUE 10)")
-    ap.add_argument("--scale", action="store_true",
-                    help="print the scale-observatory rollup "
-                         "(resource ledgers per process: pending-grad "
-                         "footprint, reply/replay cache bytes + "
-                         "evictions, barrier set, apply backlog, "
-                         "oldest-pending age, quorum scan work — "
-                         "ISSUE 12); flight dumps work as inputs too "
-                         "(their metrics snapshot carries the ledger "
-                         "gauges)")
-    ap.add_argument("--serve", action="store_true",
-                    help="print the serving-tier rollup (requests/"
-                         "tokens, decode-batch occupancy, TTFT and "
-                         "inter-token latency, paged KV cache "
-                         "pressure: blocks used/total, allocation "
-                         "failures, preemptions — ISSUE 11)")
+    for flag, _rows, _fmt, _title, help_text in ROLLUPS:
+        ap.add_argument("--" + flag, action="store_true",
+                        help=help_text)
+    ap.add_argument("--all", action="store_true", dest="all_rollups",
+                    help="implies --kernels plus every per-subsystem "
+                         "rollup (%s)" % " ".join(
+                             "--" + f for f, *_ in ROLLUPS))
     args = ap.parse_args(argv)
+    if args.all_rollups:
+        args.kernels = True
+        for flag, *_ in ROLLUPS:
+            setattr(args, flag, True)
 
     # numerics trip artifacts ride the same dump dir as trace dumps;
     # partition them out by their fixed filename shape
@@ -145,21 +180,17 @@ def main(argv=None):
     # also spares the full extra span walk on large rings
     krows = export.kernel_rows(dumps, trace) \
         if (args.kernels or not args.json) else []
-    nrows = export.numerics_rows(dumps) if args.numerics else []
-    wrows = export.wire_rows(dumps) if args.wire else []
-    srows = export.serve_rows(dumps) if args.serve else []
-    crows = export.scale_rows(dumps) if args.scale else []
+    # every registered rollup asked for: flag -> its export rows
+    rollup_rows = {flag: getattr(export, rows_fn)(dumps)
+                   for flag, rows_fn, _fmt, _title, _h in ROLLUPS
+                   if getattr(args, flag)}
     if args.json:
-        if args.numerics or args.kernels or args.wire or args.serve \
-                or args.scale:
+        if rollup_rows or args.kernels:
             # one wrapped object, keys present for the rollups asked
             # for; bare phase rows stay the no-flag contract
             print(json.dumps(dict(
-                {"phases": rows, "kernels": krows},
-                **({"numerics": nrows} if args.numerics else {}),
-                **({"wire": wrows} if args.wire else {}),
-                **({"serve": srows} if args.serve else {}),
-                **({"scale": crows} if args.scale else {})), indent=2))
+                {"phases": rows, "kernels": krows}, **rollup_rows),
+                indent=2))
         else:
             print(json.dumps(rows, indent=2))
     else:
@@ -181,23 +212,11 @@ def main(argv=None):
             print("\nper-kernel rollup (pallas launch sites + xplane "
                   "device ops):")
             print(export.format_kernel_table(krows))
-        if args.numerics:
-            print("\nnumerics rollup (grad-norm trend / nonfinite "
-                  "sightings per process):")
-            print(export.format_numerics_table(nrows))
-        if args.wire:
-            print("\nwire rollup (grad compression / fastwire traffic "
-                  "/ staleness per process):")
-            print(export.format_wire_table(wrows))
-        if args.serve:
-            print("\nserve rollup (requests/tokens / decode occupancy "
-                  "/ TTFT+ITL / paged KV pressure per process):")
-            print(export.format_serve_table(srows))
-        if args.scale:
-            print("\nscale rollup (resource ledgers per process: "
-                  "pending grads / caches+evictions / barrier quorum "
-                  "/ apply backlog):")
-            print(export.format_scale_table(crows))
+        for flag, _rows_fn, fmt_fn, title, _h in ROLLUPS:
+            if not getattr(args, flag):
+                continue
+            print("\n" + title)
+            print(getattr(export, fmt_fn)(rollup_rows[flag]))
     if trips:
         _print_trips(trips)
     if not rows:
@@ -206,8 +225,8 @@ def main(argv=None):
         # spans) — is a success even when the span table is empty;
         # fail only when the run produced no output at all
         print("no completed spans matched", file=sys.stderr)
-        return 0 if (args.merge or krows or nrows or wrows or srows
-                     or crows) else 1
+        return 0 if (args.merge or krows
+                     or any(rollup_rows.values())) else 1
     return 0
 
 

@@ -1,0 +1,293 @@
+#!/usr/bin/env python
+"""Watchtower operational report (ISSUE 13 tentpole d): one shot,
+one page — is the system healthy, and is it getting slower?
+
+Renders, from a Watchtower tsdb root (``--tsdb``) and/or a live
+flight/trace dump dir (``--dump-dir``):
+
+- the **SLO table**: every spec from ``--slo`` (a JSON/TOML file or
+  inline objectives, default FLAGS_slo_spec) evaluated read-only
+  against each per-process store — objective, last value, fast/slow
+  burn rates, error budget remaining, FIRING markers.  Evaluation here
+  never writes flight dumps (it is somebody else's store);
+- **active alerts**: slo:* flight dumps found in the dump dir (the
+  durable evidence a burn fired) plus any currently-firing windows;
+- **hot series sparklines**: the busiest series per store (ranked by
+  recent variation), downsampled to a unicode sparkline row with
+  min/last/max — the collapse curve at a glance;
+- **last bench deltas**: PERF_TRAJECTORY.json's recorded floor vs the
+  latest run per metric (tools/perf_sentinel.py builds it), regressions
+  marked.
+
+Usage:
+    python tools/watchtower.py --tsdb /ckpt/tsdb --slo slo.json
+    python tools/watchtower.py --dump-dir /tmp/dumps
+    python tools/watchtower.py --tsdb D --trajectory PERF_TRAJECTORY.json --json
+"""
+import argparse
+import glob
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+SPARK = "▁▂▃▄▅▆▇█"
+
+
+def sparkline(values, width=32):
+    """Unicode sparkline over ``values`` (downsampled to ``width``)."""
+    vals = [float(v) for v in values if v is not None]
+    if not vals:
+        return ""
+    if len(vals) > width:
+        # mean-pool into width buckets
+        out = []
+        n = len(vals)
+        for b in range(width):
+            lo, hi = b * n // width, max(b * n // width + 1,
+                                         (b + 1) * n // width)
+            chunk = vals[lo:hi]
+            out.append(sum(chunk) / len(chunk))
+        vals = out
+    lo, hi = min(vals), max(vals)
+    if hi <= lo:
+        return SPARK[0] * len(vals)
+    return "".join(SPARK[min(len(SPARK) - 1,
+                             int((v - lo) / (hi - lo)
+                                 * (len(SPARK) - 1)))]
+                   for v in vals)
+
+
+def _slo_section(stores, specs):
+    """Read-only SLO evaluation of every store: one row per
+    (store, slo).  Windows anchor at the STORE's newest sample, not
+    wall-clock now — a collapse read back hours later must still
+    show its burn, not an empty (and therefore 'healthy') window."""
+    from paddle_tpu.observability import slo as _slo
+
+    rows = []
+    for label, store in sorted(stores.items()):
+        as_of = store.last_time()
+        ev = _slo.Evaluator(store, specs, dump_alerts=False)
+        for r in ev.evaluate(now=as_of):
+            fast = r["windows"]["fast"]
+            slow = r["windows"]["slow"]
+            rows.append({
+                "store": label, "slo": r["name"],
+                "as_of": as_of,
+                "objective": r["objective"],
+                "last_value": r["last_value"],
+                "burn_fast": fast["burn"],
+                "burn_slow": slow["burn"],
+                "samples_fast": fast["samples"],
+                "budget_remaining": r["budget_remaining"],
+                "firing": [w for w in ("fast", "slow")
+                           if r["windows"][w]["firing"]],
+            })
+    return rows
+
+
+def _alerts_section(dump_dir):
+    """slo:* flight dumps under the dump dir — the durable alert
+    evidence (reason, slo, window, burn, when)."""
+    alerts = []
+    for path in sorted(glob.glob(os.path.join(dump_dir,
+                                              "flight_*.json"))):
+        try:
+            with open(path) as f:
+                rec = json.load(f)
+        except Exception:
+            continue
+        reason = rec.get("reason", "")
+        if not reason.startswith("slo:"):
+            continue
+        detail = (rec.get("slo") or {}).get("alert") or {}
+        blocked = rec.get("blocked") or {}
+        alerts.append({
+            "file": os.path.basename(path),
+            "reason": reason,
+            "slo": detail.get("slo") or blocked.get("slo"),
+            "window": detail.get("window") or blocked.get("window"),
+            "burn": detail.get("burn") or blocked.get("burn"),
+            "objective": detail.get("objective")
+            or blocked.get("objective"),
+            "wall_time": rec.get("wall_time"),
+            "series_samples": len(detail.get("series") or []),
+        })
+    return alerts
+
+
+def _hot_series(stores, top=8, buckets=32):
+    """Busiest series per store: ranked by coefficient of variation
+    over the retained window (a flat counter of any size is boring; a
+    swinging gauge is the story), sparkline rendered from the
+    downsample."""
+    rows = []
+    for label, store in sorted(stores.items()):
+        scored = []
+        for name in store.names():
+            t, v = store.scan(name)
+            if len(v) < 2:
+                continue
+            mean = float(abs(v).mean())
+            spread = float(v.max() - v.min())
+            if spread <= 0:
+                continue
+            scored.append((spread / (mean + 1e-12), name))
+        scored.sort(reverse=True)
+        for _score, name in scored[:top]:
+            ds = store.downsample(name, buckets=buckets)
+            means = [d["mean"] for d in ds]
+            rows.append({
+                "store": label, "series": name,
+                "spark": sparkline(means),
+                "min": round(min(d["min"] for d in ds), 4),
+                "last": round(means[-1], 4) if means else 0.0,
+                "max": round(max(d["max"] for d in ds), 4),
+                "n": int(sum(d["count"] for d in ds)),
+            })
+    return rows
+
+
+def _bench_section(trajectory_path):
+    try:
+        with open(trajectory_path) as f:
+            traj = json.load(f)
+    except Exception:
+        return []
+    rows = []
+    for name, ent in sorted((traj.get("metrics") or {}).items()):
+        floor, latest = ent.get("floor"), ent.get("latest")
+        if floor in (None, 0):
+            continue
+        if ent.get("higher_is_better", True):
+            delta = (latest - floor) / abs(floor)
+        else:
+            delta = (floor - latest) / abs(floor)
+        rows.append({"metric": name, "floor": floor, "latest": latest,
+                     "delta_frac": round(delta, 4),
+                     "runs": len(ent.get("runs", [])),
+                     "regressed": delta < -0.15})
+    return rows
+
+
+def build_report(tsdb_root=None, dump_dir=None, slo_spec=None,
+                 trajectory=None):
+    from paddle_tpu.core.flags import FLAGS
+    from paddle_tpu.observability import slo as _slo
+    from paddle_tpu.observability import tsdb as _tsdb
+
+    report = {"kind": "watchtower_report"}
+    stores = _tsdb.open_stores(tsdb_root) if tsdb_root else {}
+    report["stores"] = sorted(stores)
+    specs = _slo.load_specs(slo_spec if slo_spec is not None
+                            else FLAGS.slo_spec)
+    if stores and specs:
+        report["slo"] = _slo_section(stores, specs)
+    if dump_dir:
+        report["alerts"] = _alerts_section(dump_dir)
+    if stores:
+        report["hot_series"] = _hot_series(stores)
+    traj_path = trajectory or os.path.join(REPO,
+                                           "PERF_TRAJECTORY.json")
+    bench = _bench_section(traj_path)
+    if bench:
+        report["bench"] = bench
+        report["trajectory"] = traj_path
+    return report
+
+
+def render(report):
+    out = []
+    if report.get("slo") is not None:
+        out.append("SLO status (budget remaining over the slow "
+                   "window; burn >= threshold fires):")
+        out.append("%-18s %-24s %-30s %10s %10s %10s %8s  %s" % (
+            "store", "slo", "objective", "last", "burn_fast",
+            "burn_slow", "budget", "firing"))
+        for r in report["slo"]:
+            out.append("%-18s %-24s %-30s %10s %10.2f %10.2f %7.0f%%"
+                       "  %s" % (
+                           r["store"][:18], r["slo"][:24],
+                           r["objective"][:30],
+                           ("%.4g" % r["last_value"])
+                           if r["last_value"] is not None else "-",
+                           r["burn_fast"], r["burn_slow"],
+                           100.0 * r["budget_remaining"],
+                           ",".join(r["firing"]) or "-"))
+        out.append("")
+    alerts = report.get("alerts")
+    if alerts is not None:
+        out.append("alerts (%d slo:* flight dumps):" % len(alerts))
+        for a in alerts:
+            out.append("  %-28s %-20s window=%-5s burn=%-8s %s  (%d "
+                       "series samples) %s" % (
+                           a["file"], a.get("slo") or "?",
+                           a.get("window") or "?",
+                           a.get("burn"), a.get("objective") or "",
+                           a.get("series_samples") or 0,
+                           a.get("wall_time") or ""))
+        if not alerts:
+            out.append("  (none)")
+        out.append("")
+    hot = report.get("hot_series")
+    if hot:
+        out.append("hot series (by relative swing):")
+        for r in hot:
+            out.append("  %-16s %-40s %s  min %.4g last %.4g max "
+                       "%.4g (%d samples)" % (
+                           r["store"][:16], r["series"][:40],
+                           r["spark"], r["min"], r["last"], r["max"],
+                           r["n"]))
+        out.append("")
+    bench = report.get("bench")
+    if bench:
+        out.append("bench trajectory (%s):"
+                   % report.get("trajectory", ""))
+        out.append("%-40s %7s %12s %12s %9s" % (
+            "metric", "runs", "floor", "latest", "delta"))
+        for r in bench:
+            out.append("%-40s %7d %12.4g %12.4g %+8.1f%%%s" % (
+                r["metric"], r["runs"], r["floor"], r["latest"],
+                100.0 * r["delta_frac"],
+                "  REGRESSED" if r["regressed"] else ""))
+    return "\n".join(out) if out else "(nothing to report — pass " \
+        "--tsdb and/or --dump-dir)"
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="one-shot Watchtower operational report: SLO "
+                    "table, active alerts, hot-series sparklines, "
+                    "bench trajectory deltas")
+    ap.add_argument("--tsdb", default=None, metavar="DIR",
+                    help="Watchtower tsdb root (FLAGS_tsdb_dir of the "
+                         "run under inspection)")
+    ap.add_argument("--dump-dir", default=None, metavar="DIR",
+                    help="flight/trace dump dir to scan for slo:* "
+                         "alert artifacts")
+    ap.add_argument("--slo", default=None, metavar="SPEC",
+                    help="SLO spec file (.json/.toml) or inline "
+                         "objectives (default: FLAGS_slo_spec)")
+    ap.add_argument("--trajectory", default=None, metavar="PATH",
+                    help="PERF_TRAJECTORY.json to diff (default: the "
+                         "repo's)")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the report as JSON")
+    args = ap.parse_args(argv)
+
+    report = build_report(tsdb_root=args.tsdb, dump_dir=args.dump_dir,
+                          slo_spec=args.slo,
+                          trajectory=args.trajectory)
+    if args.json:
+        print(json.dumps(report, indent=2))
+    else:
+        print(render(report))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
