@@ -16,6 +16,7 @@ from paddle_tpu.core.registry import get_op_info, has_op
 
 from .defuse import CONCURRENT_LAUNCH_OPS, DefUse, sub_block_indices
 from .diagnostics import Diagnostic, Severity
+from .lifetime import check_block_lifetime
 from .shapes import check_block_shapes
 
 __all__ = ["CHECKERS", "register_checker", "run_checkers",
@@ -39,11 +40,24 @@ def register_checker(name):
     return deco
 
 
+def _suppressed():
+    """Checker names FLAGS_check_suppress disables for default runs
+    (explicitly-named checkers always run — the lint CLI's --checkers
+    must win over the env)."""
+    from paddle_tpu.core.flags import FLAGS
+    raw = str(getattr(FLAGS, "check_suppress", "") or "")
+    return {c.strip() for c in raw.split(",") if c.strip()}
+
+
 def run_checkers(program, checkers=None):
-    """Run ``checkers`` (names; default all) over one core ProgramDesc;
-    returns the concatenated diagnostics."""
+    """Run ``checkers`` (names; default all minus FLAGS_check_suppress)
+    over one core ProgramDesc; returns the concatenated diagnostics."""
     du = DefUse(program)
-    names = list(checkers) if checkers is not None else list(CHECKERS)
+    if checkers is not None:
+        names = list(checkers)
+    else:
+        skip = _suppressed()
+        names = [n for n in CHECKERS if n not in skip]
     diags = []
     for name in names:
         try:
@@ -579,34 +593,27 @@ def check_concurrency(du):
                         var=n,
                         suggestion="send the routine its input over a "
                                    "channel instead of sharing the var"))
-        # prepared-executor donation hazard: a host op reads a
-        # persistable BEFORE the device ops that overwrite it; the
-        # compiled step donates that buffer, so any by-reference host
-        # consumer (async save/send) can observe a consumed husk
-        first_dev_write = {}
-        for oi, op in enumerate(block.ops):
-            if _is_host(op.type):
-                continue
-            for n in op.output_arg_names():
-                if not n or n in first_dev_write:
-                    continue
-                vd = du.find_var(bi, n)
-                if vd is not None and vd.persistable:
-                    first_dev_write[n] = oi
-        for oi, op in enumerate(block.ops):
-            if not _is_host(op.type):
-                continue
-            for n in set(op.input_arg_names()):
-                wj = first_dev_write.get(n)
-                if wj is not None and wj > oi:
-                    diags.append(Diagnostic(
-                        "concurrency", Severity.WARNING,
-                        "host op reads persistable %r which the "
-                        "compiled step later overwrites in place "
-                        "(donated buffer): a by-reference consumer "
-                        "races the donation" % n,
-                        block_idx=bi, op_idx=oi, op_type=op.type, var=n,
-                        suggestion="move the host read after the device "
-                                   "write, or copy the value before the "
-                                   "step"))
+        # (the prepared-donation host-read hazard this checker carried
+        # since PR 3 moved to the dedicated 'lifetime' checker below,
+        # which models the full live -> donated -> restaged machine)
+    return diags
+
+
+# ---------------------------------------------------------------------------
+# lifetime: donation-lifetime state machine (ISSUE 14; analysis/lifetime.py)
+# ---------------------------------------------------------------------------
+
+@register_checker("lifetime")
+def check_lifetime(du):
+    """Donation-lifetime diagnostics per block: host-read-before-donate
+    (WARNING — the PR 2 flush-protocol class; ERROR for by-reference
+    senders), concurrent sub-block reads of parent-donated persistables
+    (ERROR — the PR 10 k-stale shape), double-donation across parent
+    and launched sub-block dispatches (ERROR), and fetches aliasing
+    donated buffers (ERROR — the PR 8/11 shape).  The model
+    (analysis/lifetime.py) mirrors executor_impl._build's
+    donate_argnums computation exactly."""
+    diags = []
+    for bi in range(len(du.program.blocks)):
+        diags.extend(check_block_lifetime(du, bi))
     return diags

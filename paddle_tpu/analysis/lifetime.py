@@ -1,0 +1,224 @@
+"""Static donation-lifetime analysis (ISSUE 14 tentpole a).
+
+Models every persistable's BUFFER through one step of a block the way
+the compiled executor actually runs it (core/executor_impl._build):
+the step's device ops compile to ONE dispatch, and every persistable
+the dispatch both reads and overwrites is DONATED — XLA reuses its
+buffer, so between the dispatch consuming the old buffer and the
+write-back/sync re-binding the fresh one, the old value is a husk.
+
+Per-var state through the block, in program order::
+
+    live      the previous step's value (on the prepared path this is
+              already a donated husk from step 2 on — only the flush
+              protocol makes a direct read safe)
+    donated   the dispatch consumed the buffer
+    restaged  the write-back published the fresh buffer
+
+Diagnostics (the four postmortems, turned into checks):
+
+- **host-read-before-donate** (WARNING): a host op reads a persistable
+  the step later overwrites.  Synchronous host reads survive through
+  the PR 2 flush protocol (``Scope.find_var`` flushes prepared state),
+  but any by-reference/async consumer races the donation — the PR 2
+  donated-husk class.
+- **concurrent-read-of-donated** (ERROR): a concurrently-launched
+  sub-block (``go``/``parallel_do``) or a ``listen_and_serv`` serve
+  block reads a parent persistable the parent's own step donates — no
+  flush can order the read against the dispatch.  The PR 10 k-stale
+  shape (gets racing the optimize block's donated params).
+- **double-donation** (ERROR): a persistable donated by the parent's
+  dispatch AND written by a launched sub-block's dispatch in the same
+  step — two dispatches each think they own the buffer.
+- **fetch-of-donated** (ERROR): a ``fetch`` op reads a var the step
+  donates.  ``run()`` copies fetches by value, but the AOT/serving
+  path aliases them (the PR 8 consumed-buffer guard trip and the
+  PR 11 KV-pool rebind contract) — a fetch must never name donated
+  state; fetch the re-bound value after the step instead.
+
+``donation_set`` mirrors the executor's donate_argnums computation so
+the static model and the runtime agree on what is donated; the
+executor's verify hook runs this checker at compile-cache-miss cadence
+(zero steady-state cost), and ``tools/lint_program.py`` runs it over
+saved programs.  ``check_serving_fetches`` is the program-free form of
+the fetch rule for serving state that never lives in a ProgramDesc
+(the generative KV page pool).
+"""
+from __future__ import annotations
+
+from .defuse import CONCURRENT_LAUNCH_OPS, sub_block_indices
+from .diagnostics import Diagnostic, Severity
+
+__all__ = ["donation_set", "check_block_lifetime",
+           "check_serving_fetches", "LIFETIME_CONCURRENT"]
+
+# launch ops whose sub-blocks run WITHOUT program ordering against the
+# launching block's dispatch: go/parallel_do execute concurrently, and
+# a listen_and_serv block serves RPC reads (gets/prefetches) while its
+# apply sub-blocks dispatch — the PR 10 data plane
+LIFETIME_CONCURRENT = frozenset(CONCURRENT_LAUNCH_OPS
+                                | {"listen_and_serv"})
+
+# host ops that hand the value to ANOTHER thread by reference (the
+# sender threads of the batched wire, PR 4): the flush protocol cannot
+# cover them — a donation mid-flight is a race, not a stale read
+_ASYNC_HOST_OPS = frozenset({"send", "send_vars"})
+
+
+def _is_host(op_type):
+    from paddle_tpu.core.registry import get_op_info
+    try:
+        return bool(get_op_info(op_type).host_op)
+    except KeyError:
+        return False
+
+
+def donation_set(du, bi, extra=()):
+    """{name: first device-write op index} of the persistables block
+    ``bi``'s compiled step donates — written by a device op AND read
+    somewhere in the block (executor_impl._build: donated inputs are
+    the persist_outs the dispatch also consumes).  ``extra`` adds
+    names the caller knows are donated (a prepared program's
+    persist_outs)."""
+    block = du.block(bi)
+    reads = set()
+    writes = {}
+    for oi, op in enumerate(block.ops):
+        if _is_host(op.type):
+            continue
+        for n in set(op.input_arg_names()):
+            if n:
+                reads.add(n)
+        for n in op.output_arg_names():
+            if n and n not in writes:
+                writes[n] = oi
+    donated = {}
+    for n, oi in writes.items():
+        vd = du.find_var(bi, n)
+        if vd is not None and vd.persistable and n in reads:
+            donated[n] = oi
+    for n in extra:
+        donated.setdefault(n, None)
+    return donated
+
+
+def check_block_lifetime(du, bi, extra_donated=()):
+    """Lifetime diagnostics for one block (see module docstring)."""
+    diags = []
+    block = du.block(bi)
+    donated = donation_set(du, bi, extra=extra_donated)
+    if not donated:
+        return diags
+
+    for oi, op in enumerate(block.ops):
+        if not _is_host(op.type):
+            continue
+        if op.type == "fetch":
+            for n in set(op.input_arg_names()):
+                if n in donated:
+                    diags.append(Diagnostic(
+                        "lifetime", Severity.ERROR,
+                        "fetch aliases a donated buffer: the step's "
+                        "dispatch consumes %r in place, and on the "
+                        "AOT/serving path the fetch hands out the "
+                        "consumed buffer (the PR 8/PR 11 shape)" % n,
+                        block_idx=bi, op_idx=oi, op_type=op.type, var=n,
+                        suggestion="fetch a copy (assign the value to "
+                                   "a non-persistable output) or read "
+                                   "the re-bound value after the step "
+                                   "via Scope.find_var"))
+            continue
+        launches = sub_block_indices(op)
+        if launches:
+            concurrent = op.type in LIFETIME_CONCURRENT
+            for sub in launches:
+                if not (0 <= sub < len(du.program.blocks)) or sub == bi:
+                    continue
+                sub_reads, sub_writes = du.block_reads_writes(sub)
+                sub_local = set(du.block(sub).vars)
+                for n in sorted((sub_writes - sub_local)
+                                & set(donated)):
+                    diags.append(Diagnostic(
+                        "lifetime", Severity.ERROR,
+                        "double-donation: the parent step's dispatch "
+                        "donates %r and sub-block %d's dispatch "
+                        "overwrites it in the same step — two "
+                        "dispatches each consume the one buffer" %
+                        (n, sub),
+                        block_idx=bi, op_idx=oi, op_type=op.type, var=n,
+                        suggestion="give the sub-block its own output "
+                                   "var, or move the parent's write of "
+                                   "%r into the sub-block" % n))
+                if concurrent:
+                    for n in sorted((sub_reads - sub_local - sub_writes)
+                                    & set(donated)):
+                        diags.append(Diagnostic(
+                            "lifetime", Severity.ERROR,
+                            "sub-block %d reads persistable %r while "
+                            "the parent step's dispatch donates its "
+                            "buffer — no flush can order a concurrent "
+                            "read against the donation (the PR 10 "
+                            "k-stale shape)" % (sub, n),
+                            block_idx=bi, op_idx=oi, op_type=op.type,
+                            var=n,
+                            suggestion="hand the value to the "
+                                       "concurrent block through a "
+                                       "channel (a by-value copy), or "
+                                       "fence the read behind the "
+                                       "apply's commit"))
+            continue
+        # plain host op reading a later-donated persistable: from step
+        # 2 of a prepared loop the scope holds last step's husk at this
+        # point.  find_var's flush re-binds it for synchronous readers
+        # (WARNING); async/by-reference consumers race the donation
+        # (ERROR) — the PR 2 class
+        for n in set(op.input_arg_names()):
+            wj = donated.get(n)
+            if wj is None or wj <= oi:
+                continue   # read after the write-back: restaged
+            if op.type in _ASYNC_HOST_OPS:
+                diags.append(Diagnostic(
+                    "lifetime", Severity.ERROR,
+                    "by-reference host op reads persistable %r which "
+                    "the step's dispatch (op %d) donates: the sender "
+                    "thread's view races the donation and can ship a "
+                    "consumed husk" % (n, wj),
+                    block_idx=bi, op_idx=oi, op_type=op.type, var=n,
+                    suggestion="materialize a copy before the send "
+                               "(assign to a temp), or move the send "
+                               "after the device write"))
+            else:
+                diags.append(Diagnostic(
+                    "lifetime", Severity.WARNING,
+                    "host op reads persistable %r which the step's "
+                    "dispatch (op %d) later donates: safe only through "
+                    "the prepared-flush protocol — a by-reference "
+                    "consumer of the read races the donation" % (n, wj),
+                    block_idx=bi, op_idx=oi, op_type=op.type, var=n,
+                    suggestion="move the host read after the device "
+                               "write, or copy the value before the "
+                               "step (FLAGS_sanitizer=buffers names "
+                               "the race at runtime)"))
+    return diags
+
+
+def check_serving_fetches(fetch_names, donated_state, site="serving"):
+    """Program-free form of the fetch rule for serving state that never
+    lives in a ProgramDesc: a tenant's fetch list must not name the
+    donated KV pool (or any other donated device state) — the returned
+    handle would alias a buffer the next decode step consumes (the
+    PR 11 rebind contract).  Returns diagnostics."""
+    donated = set(donated_state)
+    diags = []
+    for n in fetch_names:
+        if n in donated:
+            diags.append(Diagnostic(
+                "lifetime", Severity.ERROR,
+                "serving fetch aliases donated state %r of %s: the "
+                "next dispatch donates (consumes) the fetched buffer "
+                "under the caller" % (n, site),
+                var=n, op_type="fetch",
+                suggestion="fetch through a copying debug entry (the "
+                           "separately-compiled logits path), never "
+                           "the live pool"))
+    return diags

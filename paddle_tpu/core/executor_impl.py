@@ -24,6 +24,7 @@ import jax
 import numpy as np
 
 from . import lowering
+from . import sanitizer as _san
 from .lod import LoDTensor
 from .lowering import LoweringContext, run_ops, run_op
 from .registry import get_op_info
@@ -355,6 +356,11 @@ class PreparedProgram:
             if s is None:
                 raise KeyError(name)
             v = s._vars[name]
+            if _san.is_husk(v):
+                # sanitizer husk: re-raise with the donation's full
+                # story (var, op, step, site) instead of the generic
+                # consumed-buffer message below
+                v._trip()
             if callable(getattr(v, "is_deleted", None)) and \
                     v.is_deleted():
                 # the buffer was donated and consumed — by a failed
@@ -474,6 +480,24 @@ class PreparedProgram:
             if _num.effective_mode() == "bisect":
                 snap = {name: _snapshot_value(v)
                         for name, v in self._state.items()}
+        # buffer sanitizer (ISSUE 14): the dispatch donates the
+        # device-resident persistables it overwrites.  On step 1 the
+        # scope slots still alias these exact arrays; poisoning them
+        # after the dispatch turns any host read that bypasses the
+        # flush protocol into a named BufferLifetimeError instead of a
+        # bare jax deleted-array error.  Later steps find the slots
+        # already husked (or externally rewritten) and skip in O(1).
+        donated_map = None
+        if _san._BUFFERS_ON:
+            # donated = resident INPUTS the block overwrites (the
+            # _build donate_argnums set); a write-only persist_out is
+            # rebuilt, not donated — poisoning it would husk the live
+            # value sync_scope installed last flush
+            donated_map = {n: state[n] for n in entry.persist_outs
+                           if n in state and n in self._state_targets
+                           and n not in self._feed_names}
+            don_site = "prepared block %d of program %s" % (
+                self._block_id, getattr(self._program, "uid", "?"))
         sp_disp = _tr.begin("step.dispatch") if _tr is not None else None
         try:
             out = entry.fn(tuple(args), seed, counter)
@@ -486,6 +510,13 @@ class PreparedProgram:
         except Exception:
             if sp_disp is not None:
                 _tr.end(sp_disp, args={"failed": True})
+            if donated_map:
+                # name the scope slots a failed EXECUTE consumed
+                # (trace failures consume nothing: only_dead)
+                _san.poison_donated(scope, donated_map,
+                                    op="run_prepared",
+                                    step=int(counter), site=don_site,
+                                    only_dead=True)
             # an execute-time failure may have consumed the donated
             # inputs: drop exactly the deleted buffers so a finally/
             # context-exit sync installs only values that survived
@@ -501,6 +532,9 @@ class PreparedProgram:
             if dead:
                 self._scope_epoch = None  # re-stage dropped names
             raise
+        if donated_map:
+            _san.poison_donated(scope, donated_map, op="run_prepared",
+                                step=int(counter), site=don_site)
         for name, val in zip(entry.persist_outs, persists):
             state[name] = val
         self._dirty = True
@@ -913,14 +947,40 @@ class ExecutorCore:
             snap = {name: _snapshot_value(args[i])
                     for i, name in enumerate(entry.input_names)
                     if name not in feed}
-        if _TRC.on:
-            sp = _TRC.begin("executor.dispatch")
-            try:
+        # buffer sanitizer (ISSUE 14): the dispatch donates the scope-
+        # resident persistables it overwrites — the consumed map names
+        # var -> the exact argument handed over, so poisoning swaps
+        # only slots that still alias the dying buffer
+        donated_map = None
+        if _san._BUFFERS_ON:
+            persist_set = set(entry.persist_outs)
+            donated_map = {
+                n: args[i] for i, n in enumerate(entry.input_names)
+                if n in persist_set and n not in feed}
+            don_site = "block %d of program %s" % (
+                block_id, getattr(program, "uid", "?"))
+        try:
+            if _TRC.on:
+                sp = _TRC.begin("executor.dispatch")
+                try:
+                    out = entry.fn(tuple(args), seed, counter)
+                finally:
+                    _TRC.end(sp)
+            else:
                 out = entry.fn(tuple(args), seed, counter)
-            finally:
-                _TRC.end(sp)
-        else:
-            out = entry.fn(tuple(args), seed, counter)
+        except Exception:
+            # a failed EXECUTE consumed the donated inputs; a failed
+            # trace consumed nothing — only_dead tells them apart, so
+            # a trace failure never husks a live value
+            if donated_map:
+                _san.poison_donated(scope, donated_map,
+                                    op="executor.run",
+                                    step=int(counter), site=don_site,
+                                    only_dead=True)
+            raise
+        if donated_map:
+            _san.poison_donated(scope, donated_map, op="executor.run",
+                                step=int(counter), site=don_site)
         if entry.watched:
             fetches, persists, health = out
         else:
@@ -928,7 +988,9 @@ class ExecutorCore:
         # write-back BEFORE the health check: on a guard trip the scope
         # then holds the post-step (poisoned but LIVE) values, never
         # donated husks — post-mortem reads and skip-batch continuation
-        # keep working; bisect restores its pre-step snapshot instead
+        # keep working; bisect restores its pre-step snapshot instead.
+        # The scope.set here is also the sanitizer's RE-BIND: it
+        # replaces the poisoned husks with the fresh buffers.
         for name, val in zip(entry.persist_outs, persists):
             (scope.find_scope_of(name) or scope).set(name, val)
         if entry.watched:

@@ -115,6 +115,30 @@ define_flag("check_program", "warn",
             "diagnostics; 'error' raises ProgramVerificationError "
             "instead.  Zero per-step cost: steady-state training never "
             "re-verifies")
+define_flag("check_suppress", "",
+            "comma-separated checker names the default verification "
+            "pipeline skips (e.g. 'lifetime,numerics'): applies to the "
+            "executor verify hook and any verify_program call that "
+            "does not name explicit checkers.  The escape hatch for "
+            "FLAGS_check_program=error users when a new checker lands "
+            "— see MIGRATION.md 'Donation-lifetime checker'")
+define_flag("sanitizer", "off",
+            "runtime sanitizers (core/sanitizer.py): 'off' (default; "
+            "the instrumented hot paths pay ONE module-attribute read, "
+            "gated < 2% by tools/telemetry_overhead.py), 'buffers' "
+            "(use-after-donate checking: every donation swaps the "
+            "aliasing scope slot to a poisoned husk that raises "
+            "BufferLifetimeError naming var/op/step/site on any host "
+            "access before re-bind), 'locks' (lock-discipline "
+            "checking: instrumented locks record per-thread "
+            "acquisition order, detect order-inversion cycles and "
+            "non-reentrant acquisition on signal-handler-reachable "
+            "paths, reported as lockgraph_<pid>.json), or 'all'.  "
+            "Lock instrumentation is chosen at lock CREATION time — "
+            "set the flag (or FLAGS_sanitizer env) before the "
+            "subsystems under test construct their locks.  Every trip "
+            "increments sanitizer_trips_total and leaves a flight "
+            "dump when FLAGS_telemetry_dump_dir is set")
 define_flag("conv_nhwc", False,
             "lower conv2d through NHWC (MXU-preferred layout); the "
             "boundary transposes cancel across conv chains in XLA")

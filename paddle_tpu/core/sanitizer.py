@@ -1,0 +1,584 @@
+"""Runtime sanitizers: buffer-lifetime and lock-discipline checking
+(ISSUE 14 tentpoles b/c — the ASan/TSan lineage, sized for this
+runtime's two recurring bug classes).
+
+Every generation of this codebase has re-found the same two hazards by
+hand: **use-after-donate** on device buffers (the PR 2 donated-husk
+flush protocol, PR 8's guard-trip-on-consumed-buffers, PR 10's k-stale
+reads racing the optimize block's donated params, PR 11's KV-pool
+rebind contract) and **lock-discipline bugs** (the PR 6/13 reentrant-
+lock fixes for signal-handler flight dumps).  This module makes both
+checked artifacts instead of review-time folklore:
+
+- ``FLAGS_sanitizer=buffers`` (or ``all``): every donation site swaps
+  the scope slot that aliased the consumed buffer to a
+  :class:`PoisonedHusk` — any host access before the re-bind raises
+  :class:`BufferLifetimeError` naming the var, the donating dispatch
+  (op), the step, and the site, instead of a bare jax "Array has been
+  deleted".  Donation bumps a per-(scope, var) generation epoch;
+  re-binding (``scope.set`` / ``sync_scope``) installs the fresh
+  buffer over the husk.  :class:`BufferEpochGuard` applies the same
+  contract to non-scope state (the serving KV page pool).
+- ``FLAGS_sanitizer=locks`` (or ``all``): :func:`make_lock` returns an
+  :class:`InstrumentedLock` recording per-thread acquisition order
+  into a process lock graph; an order inversion (A->B somewhere,
+  B->A elsewhere — a latent deadlock), a non-reentrant re-acquisition
+  (a certain deadlock, raised as :class:`LockDisciplineError` instead
+  of hanging), and a non-reentrant lock marked signal-handler-
+  reachable (the flight.dump invariant) are all recorded and reported
+  as one ranked ``lockgraph_<pid>.json`` artifact.
+
+Disabled cost: the hot-path guard is ONE module-attribute read
+(``_BUFFERS_ON`` / ``_LOCKS_ON``, mirrored from the flag by a
+FLAGS.watch hook) — gated < 2% of a prepared step by
+tools/telemetry_overhead.py.  ``make_lock`` with the lock sanitizer
+off returns a plain ``threading.Lock``/``RLock``: zero per-acquire
+overhead in production.
+
+Every trip increments ``sanitizer_trips_total`` and — when
+``FLAGS_telemetry_dump_dir`` is configured — leaves one flight-recorder
+dump (the tools/fault_matrix.py 'sanitizer' preset asserts both
+artifacts).
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import weakref
+
+from .flags import FLAGS
+
+__all__ = [
+    "BufferEpochGuard", "BufferLifetimeError", "InstrumentedLock",
+    "LockDisciplineError", "PoisonedHusk", "buffer_epoch", "buffers_on",
+    "disabled_probe", "is_husk", "locks_on", "make_lock",
+    "poison_donated", "probe_signal_reentrancy", "reset_lock_graph",
+    "trip", "write_lockgraph",
+]
+
+# hot-path mirrors of FLAGS_sanitizer — the disabled path reads exactly
+# one of these per guarded site (the telemetry_overhead.py contract)
+_BUFFERS_ON = False
+_LOCKS_ON = False
+
+
+def _sync_mode(value):
+    global _BUFFERS_ON, _LOCKS_ON
+    mode = str(value or "off")
+    _BUFFERS_ON = mode in ("buffers", "all")
+    _LOCKS_ON = mode in ("locks", "all")
+
+
+FLAGS.watch("sanitizer", _sync_mode)
+
+
+def buffers_on():
+    return _BUFFERS_ON
+
+
+def locks_on():
+    return _LOCKS_ON
+
+
+def disabled_probe(iters):
+    """Execute exactly the per-site disabled-path work ``iters`` times
+    (one module-attribute read + branch) — micro-timed by the
+    tools/telemetry_overhead.py sanitizer gate."""
+    n = 0
+    for _ in range(iters):
+        if _BUFFERS_ON:
+            n += 1
+    return n
+
+
+def _trips_counter():
+    from paddle_tpu.observability import metrics
+    return metrics.counter(
+        "sanitizer_trips_total",
+        "buffer-lifetime and lock-discipline sanitizer trips")
+
+
+def _note_trip(reason, blocked):
+    """Counter + (dump-dir-gated) flight artifact for one trip.  Never
+    raises: the diagnostic must not mask the error it annotates."""
+    try:
+        _trips_counter().inc()
+    except Exception:
+        pass
+    try:
+        if FLAGS.telemetry_dump_dir:
+            from paddle_tpu.observability import flight
+            flight.dump(reason, blocked=blocked)
+    except Exception:
+        pass
+
+
+# ---------------------------------------------------------------------------
+# Buffer sanitizer
+# ---------------------------------------------------------------------------
+
+class BufferLifetimeError(RuntimeError):
+    """A host access touched a buffer after its donation and before its
+    re-bind.  Names the var, the donating dispatch (op), the step, and
+    the dispatch site — the four facts every one of the PR 2/8/10/11
+    postmortems had to reconstruct by hand."""
+
+    def __init__(self, var, op=None, step=None, site=None, epoch=None):
+        self.var = var
+        self.op = op
+        self.step = step
+        self.site = site
+        self.epoch = epoch
+        super().__init__(
+            "use-after-donate: the buffer of %r was donated to dispatch"
+            " %r (step %s, site %s, epoch %s) and has not been re-bound"
+            " — read it through Scope.find_var / after sync_scope() or"
+            " the apply commits, or copy the value before the step"
+            % (var, op, step, site, epoch))
+
+
+def trip(var, op=None, step=None, site=None, epoch=None):
+    """Record one buffer trip (counter + flight dump) and raise the
+    named :class:`BufferLifetimeError`."""
+    err = BufferLifetimeError(var, op=op, step=step, site=site,
+                              epoch=epoch)
+    _note_trip("sanitizer:buffer:%s" % var,
+               {"var": var, "op": op, "step": step, "site": site,
+                "epoch": epoch})
+    raise err
+
+
+class PoisonedHusk:
+    """The slot-filler a donation leaves behind: any host read raises
+    :class:`BufferLifetimeError` naming the donation that consumed the
+    buffer.  ``is_deleted()`` answers True so the executor's existing
+    consumed-buffer checks keep their semantics."""
+
+    __slots__ = ("var", "op", "step", "site", "epoch")
+
+    def __init__(self, var, op=None, step=None, site=None, epoch=0):
+        object.__setattr__(self, "var", var)
+        object.__setattr__(self, "op", op)
+        object.__setattr__(self, "step", step)
+        object.__setattr__(self, "site", site)
+        object.__setattr__(self, "epoch", epoch)
+
+    def is_deleted(self):
+        return True
+
+    def _trip(self):
+        trip(self.var, op=self.op, step=self.step, site=self.site,
+             epoch=self.epoch)
+
+    # every host materialization path lands on one of these
+    def __array__(self, dtype=None, copy=None):
+        self._trip()
+
+    def __float__(self):
+        self._trip()
+
+    def __int__(self):
+        self._trip()
+
+    def __len__(self):
+        self._trip()
+
+    def __iter__(self):
+        self._trip()
+
+    def __getitem__(self, idx):
+        self._trip()
+
+    def __getattr__(self, name):
+        # duck-typing probes on private/dunder names degrade to the
+        # normal AttributeError (hasattr() checks, pickling probes);
+        # any public data access is a real read — trip with the story
+        if name.startswith("_"):
+            raise AttributeError(name)
+        self._trip()
+
+    def __repr__(self):
+        return ("<PoisonedHusk %r donated by %r step %s site %s>"
+                % (self.var, self.op, self.step, self.site))
+
+
+def is_husk(v):
+    return type(v) is PoisonedHusk
+
+
+def buffer_epoch(scope, name):
+    """Donation generation of ``name`` in ``scope``'s chain (0 = never
+    donated under the sanitizer)."""
+    s = scope.find_scope_of(name) if hasattr(scope, "find_scope_of") \
+        else scope
+    while s is not None:
+        epochs = getattr(s, "_buffer_epochs", None)
+        if epochs and name in epochs:
+            return epochs[name]
+        s = getattr(s, "_parent", None)
+    return 0
+
+
+def poison_donated(scope, consumed, op=None, step=None, site=None,
+                   only_dead=False):
+    """Swap every scope slot that still aliases a just-donated dispatch
+    argument to a :class:`PoisonedHusk` (buffers mode; no-op
+    otherwise).  ``consumed`` maps var name -> the argument handed to
+    the dispatch.  A slot is poisoned when it holds that same object,
+    or already holds a consumed (deleted) jax array — never when a
+    fresh value was written over it.  ``only_dead`` restricts the swap
+    to provably-consumed buffers (the failed-dispatch path: a TRACE
+    failure consumes nothing, and identity alone cannot tell it from a
+    failed execute).  The swap deliberately does NOT bump the scope
+    write version: a husk is an absence marker, not a write, and must
+    not trigger the prepared executor's external-write re-stage."""
+    if not _BUFFERS_ON or not consumed:
+        return 0
+    n = 0
+    for name, arg in consumed.items():
+        s = scope.find_scope_of(name)
+        if s is None:
+            continue
+        cur = s._vars.get(name)
+        if cur is None or type(cur) is PoisonedHusk:
+            continue
+        if only_dead or cur is not arg:
+            fn = getattr(cur, "is_deleted", None)
+            try:
+                dead = callable(fn) and fn()
+            except Exception:
+                dead = False
+            if not dead:
+                continue
+        epochs = getattr(s, "_buffer_epochs", None)
+        if epochs is None:
+            epochs = s._buffer_epochs = {}
+        epochs[name] = epochs.get(name, 0) + 1
+        s._vars[name] = PoisonedHusk(name, op=op, step=step, site=site,
+                                     epoch=epochs[name])
+        n += 1
+    return n
+
+
+class BufferEpochGuard:
+    """The donation/re-bind contract for device state that lives
+    OUTSIDE a Scope (the serving KV page pool, ISSUE 11): the owner
+    brackets every donating dispatch with ``begin()``/``rebind()``,
+    and readers validate a previously-observed ``epoch`` (or mid-
+    dispatch access) through ``check()`` — a stale epoch means the
+    pages the reader is holding were donated and re-bound under it."""
+
+    def __init__(self, name):
+        self.name = name
+        self.epoch = 0
+        self._in_flight = None   # (op, step) while a dispatch owns it
+
+    def begin(self, op, step=None):
+        if _BUFFERS_ON:
+            self._in_flight = (op, step)
+
+    def rebind(self):
+        if self._in_flight is not None or _BUFFERS_ON:
+            self.epoch += 1
+            self._in_flight = None
+
+    def check(self, epoch=None, var=None):
+        """Validate a read of the guarded state.  Raises
+        :class:`BufferLifetimeError` when a donating dispatch is in
+        flight, or when ``epoch`` (from a prior read) is stale."""
+        if not _BUFFERS_ON:
+            return
+        name = var or self.name
+        if self._in_flight is not None:
+            op, step = self._in_flight
+            trip(name, op=op, step=step,
+                 site="%s (dispatch in flight)" % self.name,
+                 epoch=self.epoch)
+        if epoch is not None and epoch != self.epoch:
+            trip(name, op="rebind", step=None,
+                 site="%s (stale epoch %s, current %s)"
+                      % (self.name, epoch, self.epoch),
+                 epoch=self.epoch)
+
+
+# ---------------------------------------------------------------------------
+# Lock sanitizer
+# ---------------------------------------------------------------------------
+
+class LockDisciplineError(RuntimeError):
+    """A lock acquisition that would deadlock (non-reentrant
+    re-acquisition by the holding thread) — raised instead of hanging,
+    naming the lock and thread."""
+
+
+class _LockGraph:
+    """Process-wide acquisition-order graph.  Edges are (held ->
+    acquired) lock-name pairs; an inversion is an (A,B) pair observed
+    in both directions.  Guarded by a RAW lock (never instrumented —
+    the sanitizer must not sanitize itself)."""
+
+    def __init__(self):
+        self._mu = threading.Lock()
+        self.reset()
+
+    def reset(self):
+        with self._mu:
+            self.edges = {}        # (a, b) -> count
+            self.inversions = {}   # (a, b) sorted pair -> count
+            self.violations = []   # [{kind, lock, thread, note}]
+            self.locks = []        # weakrefs of InstrumentedLock
+
+    def register(self, lock):
+        with self._mu:
+            self.locks = [r for r in self.locks if r() is not None]
+            self.locks.append(weakref.ref(lock))
+
+    def live_locks(self):
+        with self._mu:
+            return [l for l in (r() for r in self.locks)
+                    if l is not None]
+
+    def note_edge(self, a, b):
+        if a == b:
+            return
+        first = False
+        with self._mu:
+            k = (a, b)
+            self.edges[k] = self.edges.get(k, 0) + 1
+            if (b, a) in self.edges:
+                pair = (min(a, b), max(a, b))
+                first = pair not in self.inversions
+                self.inversions[pair] = self.inversions.get(pair, 0) + 1
+        if first:
+            self._on_inversion((a, b))
+
+    def note_violation(self, kind, lock, note=""):
+        with self._mu:
+            self.violations.append({
+                "kind": kind, "lock": lock,
+                "thread": threading.current_thread().name,
+                "note": note})
+
+    def _on_inversion(self, pair):
+        _note_trip("sanitizer:lockorder:%s->%s" % pair,
+                   {"locks": list(pair), "kind": "order-inversion"})
+        try:
+            if FLAGS.telemetry_dump_dir:
+                write_lockgraph(FLAGS.telemetry_dump_dir)
+        except Exception:
+            pass
+
+    def cycles(self):
+        """Simple cycles in the acquisition graph (length <= 6),
+        ranked by weight = the rarest edge on the cycle — the cycle a
+        human should look at first is the one every thread keeps
+        re-proving."""
+        with self._mu:
+            edges = dict(self.edges)
+        adj = {}
+        for (a, b) in edges:
+            adj.setdefault(a, []).append(b)
+        found, seen = [], set()
+
+        def dfs(root, node, path):
+            if len(path) > 6:
+                return
+            for nxt in adj.get(node, ()):
+                if nxt == root:
+                    cyc = path[:]
+                    key = frozenset(cyc)
+                    if key not in seen:
+                        seen.add(key)
+                        w = min(edges[(cyc[i], cyc[(i + 1) % len(cyc)])]
+                                for i in range(len(cyc)))
+                        found.append({"locks": cyc, "count": w})
+                elif nxt not in path:
+                    dfs(root, nxt, path + [nxt])
+
+        for root in sorted(adj):
+            dfs(root, root, [root])
+        found.sort(key=lambda c: (-c["count"], len(c["locks"])))
+        return found
+
+    def report_dict(self):
+        with self._mu:
+            edges = [{"from": a, "to": b, "count": c}
+                     for (a, b), c in sorted(self.edges.items())]
+            inversions = [{"locks": list(p), "count": c}
+                          for p, c in sorted(self.inversions.items(),
+                                             key=lambda kv: -kv[1])]
+            violations = list(self.violations)
+        return {
+            "kind": "lockgraph",
+            "pid": os.getpid(),
+            "mode": str(FLAGS.sanitizer),
+            "edges": edges,
+            "cycles": self.cycles(),
+            "inversions": inversions,
+            "violations": violations,
+        }
+
+
+GRAPH = _LockGraph()
+
+_HELD = threading.local()
+
+
+def _held_stack():
+    st = getattr(_HELD, "stack", None)
+    if st is None:
+        st = _HELD.stack = []
+    return st
+
+
+class InstrumentedLock:
+    """A lock that records its place in the process acquisition order.
+
+    - every acquire with other locks held adds (held -> this) edges;
+      an edge pair observed in both directions is an order inversion
+      (latent deadlock) — recorded, counted, and written to the
+      lockgraph artifact;
+    - re-acquiring a NON-reentrant lock on the holding thread is a
+      certain deadlock: recorded and raised as
+      :class:`LockDisciplineError` instead of hanging;
+    - ``signal_safe`` marks locks reachable from signal handlers (the
+      metrics/flight/slo invariant from PRs 6 and 13): such a lock
+      must be reentrant — a non-reentrant one is a violation at
+      creation, before any signal can prove it the hard way."""
+
+    def __init__(self, name, reentrant=False, signal_safe=False):
+        self.name = name
+        self.reentrant = bool(reentrant)
+        self.signal_safe = bool(signal_safe)
+        self._inner = threading.RLock() if reentrant else threading.Lock()
+        GRAPH.register(self)
+        if self.signal_safe and not self.reentrant:
+            GRAPH.note_violation(
+                "signal-unsafe-lock", name,
+                "a signal-handler-reachable lock must be reentrant: a "
+                "signal landing on the holding thread would deadlock "
+                "inside its own diagnostic (the flight.dump invariant)")
+            _note_trip("sanitizer:lock:%s" % name,
+                       {"lock": name, "kind": "signal-unsafe-lock"})
+
+    def acquire(self, blocking=True, timeout=-1):
+        st = _held_stack()
+        held_here = any(h is self for h in st)
+        if held_here and not self.reentrant:
+            GRAPH.note_violation(
+                "non-reentrant-reacquire", self.name,
+                "the holding thread re-acquired a non-reentrant lock — "
+                "a certain deadlock, averted by the sanitizer")
+            _note_trip("sanitizer:lock:%s" % self.name,
+                       {"lock": self.name,
+                        "kind": "non-reentrant-reacquire"})
+            raise LockDisciplineError(
+                "thread %r re-acquired non-reentrant lock %r it already "
+                "holds — this deadlocks without the sanitizer; make the "
+                "lock reentrant or restructure the call path"
+                % (threading.current_thread().name, self.name))
+        if not held_here:
+            for h in st:
+                GRAPH.note_edge(h.name, self.name)
+        ok = self._inner.acquire(blocking, timeout)
+        if ok:
+            st.append(self)
+        return ok
+
+    def release(self):
+        st = _held_stack()
+        for i in range(len(st) - 1, -1, -1):
+            if st[i] is self:
+                del st[i]
+                break
+        self._inner.release()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+    def __repr__(self):
+        return "<InstrumentedLock %r%s%s>" % (
+            self.name, " reentrant" if self.reentrant else "",
+            " signal_safe" if self.signal_safe else "")
+
+
+def make_lock(name, reentrant=False, signal_safe=False):
+    """The one lock constructor sanitizer-adopting subsystems use
+    (observability/, distributed/rpc.py, serving/).  Lock sanitizer
+    off: a plain ``threading.Lock``/``RLock`` — zero per-acquire cost.
+    On (``FLAGS_sanitizer=locks|all`` at creation time): an
+    :class:`InstrumentedLock` feeding the process lock graph.
+    ``signal_safe`` documents (and, instrumented, enforces) the
+    flight.dump invariant: the lock is taken inside signal handlers
+    and must be reentrant."""
+    if not _LOCKS_ON:
+        return threading.RLock() if reentrant else threading.Lock()
+    return InstrumentedLock(name, reentrant=reentrant,
+                            signal_safe=signal_safe)
+
+
+def probe_signal_reentrancy():
+    """Actively prove the flight.dump invariant over every live
+    instrumented ``signal_safe`` lock: acquire it, then re-acquire
+    non-blocking on the same thread (what a signal-handler dump does
+    mid-``observe``).  A lock that refuses is recorded as a violation.
+    Returns the violations found by this probe."""
+    out = []
+    for lock in GRAPH.live_locks():
+        if not lock.signal_safe:
+            continue
+        if not lock._inner.acquire(False):
+            continue   # contended right now; nothing to prove safely
+        try:
+            if lock.reentrant:
+                ok = lock._inner.acquire(False)
+                if ok:
+                    lock._inner.release()
+                else:   # an RLock never refuses its holder
+                    ok = False
+            else:
+                ok = False
+            if not ok:
+                v = {"kind": "signal-reentrancy-probe",
+                     "lock": lock.name,
+                     "thread": threading.current_thread().name,
+                     "note": "re-acquisition on the holding thread "
+                             "failed: a signal-handler dump here would "
+                             "deadlock"}
+                GRAPH.note_violation(v["kind"], v["lock"], v["note"])
+                out.append(v)
+        finally:
+            lock._inner.release()
+    return out
+
+
+def write_lockgraph(directory=None):
+    """Write the ranked ``lockgraph_<pid>.json`` artifact (cycles
+    first, then raw inversions, violations, and the full edge list);
+    returns the path, or None when the write failed (best-effort, like
+    every diagnostic artifact)."""
+    try:
+        import tempfile
+
+        directory = (directory or FLAGS.telemetry_dump_dir
+                     or tempfile.gettempdir())
+        os.makedirs(directory, exist_ok=True)
+        path = os.path.join(directory, "lockgraph_%d.json" % os.getpid())
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(GRAPH.report_dict(), f, indent=1)
+        os.replace(tmp, path)
+        return path
+    except Exception:
+        return None
+
+
+def reset_lock_graph():
+    """Drop all recorded edges/violations (tests)."""
+    GRAPH.reset()

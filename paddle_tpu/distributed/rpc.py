@@ -43,6 +43,7 @@ import numpy as np
 
 from . import compress as _czip
 from .compress import Compressed
+from paddle_tpu.core import sanitizer as _san
 from .resilience import FLAGS, InjectedFault, RetryPolicy, fault_point, \
     maybe_corrupt as _maybe_corrupt
 
@@ -515,7 +516,9 @@ class VariableServer:
         self._async_applied = {}        # (sender, name) -> last applied seq
         self._alive = self.fanin_total
         self._shutdown = threading.Event()
-        self._ckpt_lock = threading.Lock()  # one save at a time
+        # one save at a time (sanitizer-adopted: FLAGS_sanitizer=locks
+        # instruments acquisition order, core/sanitizer.py)
+        self._ckpt_lock = _san.make_lock("rpc.server.ckpt")
         if checkpoint_dir:
             # restore AFTER the round counter exists: load_shard also
             # recovers _applied_round from _SUCCESS, or trainers
@@ -1102,6 +1105,20 @@ class VariableServer:
 
         for _ in range(10000):
             val = self.scope.find_var(name)
+            if _san.is_husk(val):
+                # buffer sanitizer (ISSUE 14): the slot names the
+                # donation that consumed it.  With the apply in flight
+                # this is the SANCTIONED k-stale read racing the
+                # optimize block's donated params (the PR 10 fence):
+                # wait for the commit to re-bind, don't trip.  With no
+                # apply in flight the re-bind never happened — surface
+                # the named BufferLifetimeError.
+                if self._applying or name in self._shard_applying:
+                    if not self._wait_cv(lambda: not self._applying,
+                                         ctx):
+                        return None
+                    continue
+                val._trip()
             try:
                 if isinstance(val, SelectedRows):
                     return SelectedRows(np.asarray(val.rows),
@@ -1553,7 +1570,7 @@ class RPCClient:
         import uuid
 
         self._channels = {}
-        self._lock = threading.Lock()
+        self._lock = _san.make_lock("rpc.client.channels")
         self.step = 0
         # per-process identity: the server dedups (round, sender) so
         # replaying a round after a reconnect cannot double-count
@@ -1573,8 +1590,9 @@ class RPCClient:
         # k=0 that is exactly the current round (the PR 4 cache); with
         # k>0 the k un-acked rounds stay replayable too.
         self._round_cache = {}
-        self._cache_lock = threading.Lock()  # seq + replay cache: the
-        #                           batched senders record from threads
+        # seq + replay cache: the batched senders record from threads
+        # (sanitizer-adopted lock, like every rpc/observability lock)
+        self._cache_lock = _san.make_lock("rpc.client.cache")
         self._residuals = {}      # (ep, name) -> error-feedback residual
         self._wire_ver = {}       # ep -> negotiated wire version
         self._barrier_pending = None  # (threads, errs) of in-flight

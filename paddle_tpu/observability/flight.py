@@ -47,8 +47,12 @@ MAX_LEDGER_SAMPLES = 256
 
 # RLock, same reasoning as metrics.py: a signal-handler dump (SIGTERM
 # arriving during a SIGALRM dump, both on the main thread) must not
-# self-deadlock inside its own hang diagnostic
-_seq_lock = threading.RLock()
+# self-deadlock inside its own hang diagnostic.  Sanitizer-adopted
+# (ISSUE 14): make_lock(signal_safe=True) records — and under
+# FLAGS_sanitizer=locks enforces — exactly that invariant.
+from paddle_tpu.core.sanitizer import make_lock
+
+_seq_lock = make_lock("flight.seq", reentrant=True, signal_safe=True)
 _seq = 0
 _noted_faults = set()
 

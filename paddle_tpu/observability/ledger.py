@@ -50,7 +50,11 @@ __all__ = ["register", "unregister", "collect", "sample_now",
            "snapshot", "peaks", "series", "reset", "value_nbytes",
            "has_probes"]
 
-_lock = threading.RLock()
+from paddle_tpu.core.sanitizer import make_lock
+
+# reentrant + signal-safe: flight.dump embeds ledger.snapshot() from
+# signal handlers (sanitizer-adopted, ISSUE 14)
+_lock = make_lock("ledger.registry", reentrant=True, signal_safe=True)
 _probes = {}          # handle -> (subsystem, fn, owner_ref or None)
 _last_rows = {}       # handle -> last successful probe row
 _next_handle = 0

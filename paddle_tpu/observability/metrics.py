@@ -32,6 +32,13 @@ import re
 import threading
 from collections import deque
 
+# lock-sanitizer adoption (ISSUE 14): every metric lock is created
+# through make_lock — a plain threading lock in production, an
+# instrumented acquisition-order-recording lock under
+# FLAGS_sanitizer=locks|all.  signal_safe documents (and, under the
+# sanitizer, enforces) the REENTRANT invariant explained above.
+from paddle_tpu.core.sanitizer import make_lock
+
 __all__ = ["counter", "gauge", "histogram", "snapshot",
            "prometheus_text", "zero_all", "Counter", "Gauge",
            "Histogram", "nearest_rank"]
@@ -49,7 +56,9 @@ def nearest_rank(sorted_vals, p):
     return sorted_vals[k]
 
 _REGISTRY = {}
-_REG_LOCK = threading.RLock()  # reentrant: see the signal note above
+# reentrant: see the signal note above
+_REG_LOCK = make_lock("metrics.registry", reentrant=True,
+                      signal_safe=True)
 
 # latency-oriented default bounds, in ms (also fine for counts/bytes
 # at small scale; pass explicit bounds otherwise)
@@ -68,7 +77,8 @@ class Counter:
         self.name = name
         self.help = help
         self._v = 0
-        self._lock = threading.RLock()
+        self._lock = make_lock("metrics.counter.%s" % name,
+                               reentrant=True, signal_safe=True)
 
     def inc(self, v=1):
         with self._lock:
@@ -95,7 +105,8 @@ class Gauge:
         self.name = name
         self.help = help
         self._v = 0.0
-        self._lock = threading.RLock()
+        self._lock = make_lock("metrics.gauge.%s" % name,
+                               reentrant=True, signal_safe=True)
 
     def set(self, v):
         with self._lock:
@@ -131,7 +142,8 @@ class Histogram:
         self._sum = 0.0
         self._n = 0
         self._recent = deque(maxlen=RESERVOIR)
-        self._lock = threading.RLock()
+        self._lock = make_lock("metrics.histogram.%s" % name,
+                               reentrant=True, signal_safe=True)
 
     def observe(self, v):
         v = float(v)

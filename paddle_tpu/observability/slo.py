@@ -253,7 +253,9 @@ class Evaluator:
         # landing on the very thread that is mid-evaluate must read
         # through the held lock instead of deadlocking inside its own
         # crash artifact
-        self._lock = threading.RLock()
+        from paddle_tpu.core.sanitizer import make_lock
+        self._lock = make_lock("slo.evaluator", reentrant=True,
+                               signal_safe=True)
         self._dumped = set()     # (name, window) ever dumped
         self._active = {}        # (name, window) -> since (unix time)
         self._status = []
@@ -418,7 +420,8 @@ class Evaluator:
 _EVAL = None
 # reentrant: install() is callable both directly and from inside
 # ensure_evaluator's locked section
-_eval_lock = threading.RLock()
+from paddle_tpu.core.sanitizer import make_lock as _make_lock
+_eval_lock = _make_lock("slo.install", reentrant=True)
 _eval_thread = None
 _eval_stop = None
 

@@ -86,7 +86,8 @@ class TSDB:
             retention_bytes
             if retention_bytes is not None
             else FLAGS.tsdb_retention_mb * (1 << 20))
-        self._lock = threading.RLock()
+        from paddle_tpu.core.sanitizer import make_lock
+        self._lock = make_lock("tsdb.store", reentrant=True)
         self._series = {}            # name -> sid
         self._segments = []          # sealed: {file, records, t0, t1}
         # parsed-array cache for SEALED segments (immutable once
@@ -455,7 +456,8 @@ def series_values(store, metric, t0=None, t1=None):
 # ---------------------------------------------------------------------
 
 _default = None
-_default_lock = threading.Lock()
+from paddle_tpu.core.sanitizer import make_lock as _make_lock
+_default_lock = _make_lock("tsdb.default")
 _sampler = None
 _sampler_stop = None
 

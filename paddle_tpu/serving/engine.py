@@ -27,6 +27,7 @@ import threading
 
 import numpy as np
 
+from paddle_tpu.core import sanitizer as _san
 from paddle_tpu.core.flags import FLAGS
 from paddle_tpu.observability import metrics as _metrics
 
@@ -82,7 +83,7 @@ class StepCache:
         self.name = name
         self._compile_fn = compile_fn
         self._exes = {}
-        self._lock = threading.Lock()
+        self._lock = _san.make_lock("serve.stepcache:%s" % name)
         self._compiling = set()
         self._threads = []
 
@@ -227,7 +228,7 @@ class ModelEngine:
                     "(MIGRATION.md)" % (n, shape))
         self.ladder = bucket_ladder(self.max_batch)
         self._exes = {}          # bucket -> AotExecutable
-        self._lock = threading.Lock()
+        self._lock = _san.make_lock("serve.engine:%s" % self.name)
         self._compiling = set()
         self._compile_errors = {}   # bucket -> repr(exc) of last failure
         # the exported artifact (save_inference_model aot_feed_specs)

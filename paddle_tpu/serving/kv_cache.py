@@ -28,6 +28,7 @@ from __future__ import annotations
 
 import threading
 
+from paddle_tpu.core import sanitizer as _san
 from paddle_tpu.observability import metrics as _metrics
 
 __all__ = ["BlockPool"]
@@ -78,7 +79,7 @@ class BlockPool:
         self.block_size = int(block_size)
         # block 0 reserved: the padding scratch target
         self._free = list(range(self.num_blocks - 1, 0, -1))
-        self._lock = threading.Lock()
+        self._lock = _san.make_lock("serve.kv_pool")
         with _LIVE_LOCK:
             _LIVE.append(self)
         _refresh_gauges()
@@ -128,6 +129,20 @@ class BlockPool:
             raise ValueError("block 0 is the reserved padding block; "
                              "it is never allocated")
         with self._lock:
+            if _san.buffers_on():
+                # double-free is the block-id form of double-donation:
+                # two owners each think they returned the buffer — the
+                # next alloc would hand one sequence's live pages to
+                # another.  Checked and extended under ONE lock hold so
+                # two racing frees of the same id cannot both pass the
+                # check.  O(n) set work paid only in sanitizer mode.
+                dup = set(blocks) & set(self._free)
+                if len(set(blocks)) != len(blocks):
+                    dup |= {b for b in blocks if blocks.count(b) > 1}
+                if dup:
+                    _san.trip("kv_block:%d" % sorted(dup)[0], op="free",
+                              site="BlockPool(block_size=%d)"
+                                   % self.block_size)
             self._free.extend(blocks)
         _refresh_gauges()
 

@@ -106,6 +106,72 @@ def test_transpiled_dist_programs_lint_clean(prog_scope):
     assert analysis.verify_transpiled_pair(main.desc, pserver_descs) == []
 
 
+def test_transpiled_ctr_pair_lints_clean(prog_scope):
+    """ISSUE 14 gate extension: the CTR family — a distributed_lookup
+    (is_distributed embedding) model transpiled for 2 pservers, the
+    PR 10 data plane's program shape — must lint zero-error on every
+    program (trainer main/startup, both pservers + startups) AND pass
+    the cross-program pairing check, with the new lifetime checker in
+    the pipeline."""
+    import dist_train_helpers as helpers
+
+    main, startup, scope = prog_scope
+    helpers.build_model("emb_dist")
+    eps = "127.0.0.1:6291,127.0.0.1:6292"
+    t = fluid.DistributeTranspiler()
+    t.transpile(trainer_id=0, program=main, startup_program=startup,
+                pservers=eps, trainers=2, min_block_size=64)
+    assert any(op.type == "distributed_lookup"
+               for op in main.desc.blocks[0].ops)
+    assert _errors(analysis.verify_program(main)) == []
+    assert _errors(analysis.verify_program(startup)) == []
+    pserver_descs = {}
+    for ep in t.pserver_endpoints:
+        ps = t.get_pserver_program(ep)
+        assert _errors(analysis.verify_program(ps)) == []
+        su = t.get_startup_program(ep, ps)
+        assert _errors(analysis.verify_program(su)) == []
+        pserver_descs[ep] = ps.desc
+    assert analysis.verify_transpiled_pair(main.desc, pserver_descs) == []
+
+
+def test_serving_predict_program_lints_clean(prog_scope, exe, tmp_path):
+    """ISSUE 14 gate extension: the serving family — the PR 9 predict
+    program exactly as load_inference_model hands it to the engine
+    (pruned test-mode graph, feed/fetch ops appended) — must lint
+    zero-error, notably against the new lifetime fetch-of-donated
+    rule."""
+    main, startup, scope = prog_scope
+    x = fluid.layers.data(name="x", shape=[64], dtype="float32")
+    h = fluid.layers.fc(input=x, size=32, act="tanh")
+    out = fluid.layers.fc(input=h, size=16, act="softmax")
+    exe.run(startup)
+    model_dir = str(tmp_path / "serve_model")
+    fluid.io.save_inference_model(model_dir, ["x"], [out], exe,
+                                  main_program=main)
+    prog, feeds, fetches = fluid.io.load_inference_model(model_dir, exe)
+    errs = _errors(analysis.verify_program(prog))
+    assert errs == [], "\n".join(d.format() for d in errs)
+
+
+def test_generative_decode_program_lints_clean(prog_scope):
+    """ISSUE 14 gate extension: the generative decode shape — a
+    seq-len-1 LM step (embedding gather -> blocks -> lm_head matmul,
+    the token-granularity program family PR 11 serves) — must lint
+    zero-error, shape checker included."""
+    main, startup, scope = prog_scope
+    ids = fluid.layers.data(name="ids", shape=[1], dtype="int64")
+    emb = fluid.layers.embedding(ids, size=[64, 32])
+    h = fluid.layers.reduce_mean(emb, dim=1)       # [N, 32]
+    h = fluid.layers.fc(input=h, size=32, act="relu")
+    logits = fluid.layers.fc(input=h, size=64)     # lm_head [N, V]
+    fluid.layers.softmax(logits)
+    for label, prog in (("main", main), ("startup", startup)):
+        errs = _errors(analysis.verify_program(prog))
+        assert errs == [], "generative decode %s program: %s" % (
+            label, "\n".join(d.format() for d in errs))
+
+
 def test_lint_cli_on_saved_inference_model(prog_scope, exe, tmp_path):
     main, startup, scope = prog_scope
     x = fluid.layers.data(name="x", shape=[13], dtype="float32")

@@ -264,14 +264,18 @@ def test_concurrency_channel_recv_synchronizes(prog_scope):
     assert _errors(_diags(main.desc, "concurrency")) == []
 
 
-def test_concurrency_flags_donation_hazard():
+def test_lifetime_flags_donation_hazard():
+    """The PR 3 concurrency checker's prepared-donation hazard moved to
+    the dedicated 'lifetime' checker (ISSUE 14) — same shape, richer
+    state model; the concurrency checker no longer reports it."""
     prog = _prog_with(
         [O("save", {"X": ["w"]}, {}, {"file_path": "/tmp/x"}),
          O("scale", {"X": ["w"]}, {"Out": ["w"]}, {"scale": 0.9})],
         [V("w", shape=(4,), persistable=True)])
-    diags = _diags(prog, "concurrency")
+    diags = _diags(prog, "lifetime")
     assert any(d.var == "w" and d.severity == Severity.WARNING
-               and "donated buffer" in d.message for d in diags)
+               and "donates" in d.message for d in diags)
+    assert not any(d.var == "w" for d in _diags(prog, "concurrency"))
 
 
 # ---------------------------------------------------------------------------

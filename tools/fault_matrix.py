@@ -67,7 +67,19 @@ PRESETS = {
     # alert fires and its flight dump names the violated SLO and
     # embeds the offending series — run_slo_preset()
     "slo": "serve_dispatch:delay:0.02",
+    # Sanitizer suite (ISSUE 14): plant a use-after-donate (direct
+    # host read of a donated param mid-prepared-loop) and a lock-order
+    # inversion under FLAGS_sanitizer=all, and FAIL unless both leave
+    # NAMED artifacts — a sanitizer:buffer:* flight dump carrying the
+    # planted var name, and a lockgraph_<pid>.json whose cycle lists
+    # both planted locks — run_sanitizer_preset()
+    "sanitizer": "",
 }
+
+# the names the sanitizer preset's plants use (tests/test_sanitizer.py
+# fault_plant tests) and this runner greps the artifacts for
+SANITIZER_PLANT_VAR = "sanitizer_plant_w"
+SANITIZER_PLANT_LOCKS = ("plant.A", "plant.B")
 
 # extra environment a preset exports into the pytest run (and, by
 # inheritance, into every spawned trainer/pserver worker)
@@ -217,6 +229,68 @@ def run_slo_preset(spec, pytest_args):
     return rc, time.time() - t0, dump_dir, matched
 
 
+def run_sanitizer_preset(pytest_args):
+    """The 'sanitizer' preset is a named-artifact drill, not a fault
+    sweep: tests/test_sanitizer.py's fault plants run with
+    FLAGS_sanitizer=all — one direct host read of a donated parameter
+    mid-prepared-loop, one deliberate A->B / B->A lock-order inversion
+    — and this runner FAILs (rc 3) unless BOTH left artifacts naming
+    the culprits: a flight_*.json with a sanitizer:buffer:* reason
+    carrying the planted var name, and a lockgraph_*.json whose cycle
+    (or inversion) lists both planted locks.  A run where the plants
+    trip but the breadcrumbs are anonymous is a FAIL — naming the
+    culprit is the whole point of the suite."""
+    import json
+
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["FLAGS_sanitizer"] = "all"
+    dump_dir = tempfile.mkdtemp(prefix="fault_flight_sanitizer_")
+    env["FLAGS_telemetry_dump_dir"] = dump_dir
+    cmd = [sys.executable, "-m", "pytest", "tests/test_sanitizer.py",
+           "-q", "-k", "fault_plant", "-p", "no:cacheprovider",
+           "-o", "addopts="] + pytest_args
+    t0 = time.time()
+    proc = subprocess.run(cmd, cwd=REPO, env=env)
+    rc = proc.returncode
+    buf_named = lock_named = 0
+    for path in glob.glob(os.path.join(dump_dir, "flight_*.json")):
+        try:
+            with open(path) as f:
+                rec = json.load(f)
+        except Exception:
+            continue
+        reason = str(rec.get("reason", ""))
+        blocked = rec.get("blocked") or {}
+        if reason.startswith("sanitizer:buffer:") \
+                and blocked.get("var") == SANITIZER_PLANT_VAR:
+            buf_named += 1
+    a, b = SANITIZER_PLANT_LOCKS
+    for path in glob.glob(os.path.join(dump_dir, "lockgraph_*.json")):
+        try:
+            with open(path) as f:
+                rec = json.load(f)
+        except Exception:
+            continue
+        rings = [c.get("locks", []) for c in rec.get("cycles", [])]
+        rings += [c.get("locks", []) for c in rec.get("inversions", [])]
+        if any(a in locks and b in locks for locks in rings):
+            lock_named += 1
+    if rc == 0 and (buf_named == 0 or lock_named == 0):
+        print("preset 'sanitizer': missing named artifact(s) under %s "
+              "(buffer dumps naming %r: %d; lockgraphs cycling %r<->%r:"
+              " %d) — the planted bugs were not attributed"
+              % (dump_dir, SANITIZER_PLANT_VAR, buf_named, a, b,
+                 lock_named), file=sys.stderr)
+        rc = 3
+    if rc == 0:
+        shutil.rmtree(dump_dir, ignore_errors=True)
+    else:
+        print("preset 'sanitizer' FAILED (rc=%d); artifacts kept at %s"
+              % (rc, dump_dir), file=sys.stderr)
+    return rc, time.time() - t0, dump_dir, buf_named + lock_named
+
+
 def run_preset(name, spec, seed, pytest_args):
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
@@ -298,6 +372,11 @@ def main(argv=None):
         if name == "slo":
             rc, secs, dump_dir, n_dumps = run_slo_preset(spec,
                                                          pytest_args)
+            rows.append((name, rc, secs, n_dumps))
+            continue
+        if name == "sanitizer":
+            rc, secs, dump_dir, n_dumps = run_sanitizer_preset(
+                pytest_args)
             rows.append((name, rc, secs, n_dumps))
             continue
         rc, secs, dump_dir, n_dumps = run_preset(name, spec, args.seed,

@@ -9,8 +9,9 @@ be gated in CI before it ever reaches a TPU.
 Usage:
     python tools/lint_program.py MODEL            # dir or proto file
     python tools/lint_program.py MODEL --json     # machine-readable
-    python tools/lint_program.py MODEL --checkers def-use,shapes
+    python tools/lint_program.py MODEL --checkers def-use,lifetime
     python tools/lint_program.py MODEL --max-level warning
+    python tools/lint_program.py --list-checkers  # registered names
 
 MODEL is either a file holding a serialized framework ProgramDesc proto
 (e.g. the ``__model__`` written by fluid.io.save_inference_model) or a
@@ -43,11 +44,17 @@ def load_program(path, model_filename):
 def main(argv=None):
     ap = argparse.ArgumentParser(
         description="lint a saved ProgramDesc / inference model")
-    ap.add_argument("model", help="proto file or model directory")
+    ap.add_argument("model", nargs="?", default=None,
+                    help="proto file or model directory")
     ap.add_argument("--model-filename", default="__model__",
                     help="proto name inside a model directory")
     ap.add_argument("--checkers", default=None,
-                    help="comma-separated checker names (default: all)")
+                    help="comma-separated checker names (default: all; "
+                         "explicit names override FLAGS_check_suppress)")
+    ap.add_argument("--list-checkers", action="store_true",
+                    help="print every registered checker (incl. "
+                         "'lifetime', the ISSUE 14 donation checker) "
+                         "with its one-line description and exit")
     ap.add_argument("--max-level", default="error",
                     choices=["error", "warning", "note"],
                     help="exit non-zero when findings at or above this "
@@ -62,6 +69,14 @@ def main(argv=None):
     import paddle_tpu.fluid  # noqa: F401
     from paddle_tpu import analysis
     from paddle_tpu.analysis.diagnostics import Severity
+
+    if args.list_checkers:
+        for name, fn in analysis.CHECKERS.items():
+            doc = (fn.__doc__ or "").strip().splitlines()
+            print("%-18s %s" % (name, doc[0] if doc else ""))
+        return 0
+    if args.model is None:
+        ap.error("MODEL is required unless --list-checkers is given")
 
     try:
         program, path = load_program(args.model, args.model_filename)

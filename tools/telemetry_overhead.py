@@ -40,8 +40,15 @@ program prepared twice, FLAGS_check_numerics off vs 'metrics' (fused
 per-tensor stats as one extra step output + the default read-back
 cadence), interleaved repeats, min per arm.
 
-Exit 0 when BOTH fractions are < 2% (TELEMETRY_OVERHEAD_MAX /
-NUMERICS_OVERHEAD_MAX env override); prints one JSON line either way.
+Since ISSUE 14 the sanitizer joins: the FLAGS_sanitizer=off hot path
+must be a single module-attribute read per guarded site
+(``core/sanitizer.disabled_probe``, decomposed like the telemetry
+probe and gated < 2%), and the 'buffers' mode's measured prepared-loop
+step is documented in the gate JSON (opt-in debug tier, not gated).
+
+Exit 0 when EVERY gated fraction is < 2% (TELEMETRY_OVERHEAD_MAX /
+NUMERICS_OVERHEAD_MAX / ... env overrides); prints one JSON line
+either way.
 """
 import json
 import os
@@ -428,6 +435,78 @@ def _measure_slo_us(repeats=3, iters=200, samples=600):
     return best * 1e6, max(1, int(FLAGS.slo_eval_ms))
 
 
+SANITIZER_SITES_PER_STEP = 4
+
+
+def _measure_sanitizer_us(steps=None, repeats=3):
+    """Sanitizer gate (ISSUE 14 satellite), decomposed like the
+    disabled-telemetry gate:
+
+    1. the OFF path: ``core/sanitizer.disabled_probe`` executes exactly
+       the per-site disabled work (one module-attribute read + branch),
+       micro-timed; overhead_frac = probe x SANITIZER_SITES_PER_STEP /
+       the measured prepared step — this is the gated number (< 2%);
+    2. BUFFERS mode: the same tiny prepared loop min-of-repeats A/B
+       with FLAGS_sanitizer=off vs buffers (per-step husk bookkeeping:
+       one dict comprehension over the donated set + O(1) poison
+       skips) — documented in the gate JSON, not gated: it is an
+       opt-in debug tier like numerics bisect, just a cheap one.
+
+    Returns (probe_ns, off_step_us, buffers_step_us)."""
+    import numpy as np
+
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.core import sanitizer as san
+    from paddle_tpu.core.flags import FLAGS
+
+    san.disabled_probe(1000)              # warm
+    probe_ns = float("inf")
+    iters = 200000
+    for _ in range(repeats):
+        t0 = time.perf_counter_ns()
+        san.disabled_probe(iters)
+        probe_ns = min(probe_ns,
+                       (time.perf_counter_ns() - t0) / iters)
+
+    steps = steps or int(os.environ.get("SANITIZER_OVERHEAD_STEPS",
+                                        "200"))
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        with fluid.unique_name.guard():
+            x = fluid.layers.data(name="x", shape=[32], dtype="float32")
+            h = fluid.layers.fc(x, size=32, act="relu")
+            loss = fluid.layers.mean(fluid.layers.fc(h, size=8))
+            fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    scope = fluid.Scope()
+    feed = {"x": np.ones((8, 32), np.float32)}
+    best = {"off": float("inf"), "buffers": float("inf")}
+    prev = FLAGS.sanitizer
+    try:
+        with fluid.scope_guard(scope):
+            exe = fluid.Executor(fluid.CPUPlace())
+            exe.run(startup)
+            prep = exe.prepare(main, feed_specs=feed,
+                               fetch_list=[loss])
+            for _ in range(10):
+                prep.run_prepared(feed)
+            for _ in range(repeats):
+                for arm in ("off", "buffers"):
+                    FLAGS.sanitizer = arm
+                    for _ in range(3):
+                        prep.run_prepared(feed)
+                    t0 = time.perf_counter()
+                    for _ in range(steps):
+                        prep.run_prepared(feed)
+                    best[arm] = min(
+                        best[arm],
+                        (time.perf_counter() - t0) / steps)
+            FLAGS.sanitizer = prev
+            prep.sync_scope()
+    finally:
+        FLAGS.sanitizer = prev
+    return probe_ns, best["off"] * 1e6, best["buffers"] * 1e6
+
+
 def record_gate_gauges(out):
     """Mirror every measured gate fraction into the always-on registry
     (gate name -> ``telemetry_gate_<name>`` gauge) and, when a
@@ -485,6 +564,10 @@ def main(argv=None):
     slo_us, slo_ms = _measure_slo_us()
     slo_frac = slo_us / (slo_ms * 1e3)
     slo_limit = float(os.environ.get("SLO_OVERHEAD_MAX", "0.02"))
+    san_probe_ns, san_off_us, san_buf_us = _measure_sanitizer_us()
+    san_frac = (san_probe_ns * SANITIZER_SITES_PER_STEP / 1e3) \
+        / san_off_us
+    san_limit = float(os.environ.get("SANITIZER_OVERHEAD_MAX", "0.02"))
     out = {
         "step_us": round(step_us, 2),
         "probe_ns_per_site": round(probe_ns, 1),
@@ -532,12 +615,25 @@ def main(argv=None):
         "slo_interval_ms": slo_ms,
         "slo_overhead_frac": round(slo_frac, 6),
         "slo_limit": slo_limit,
+        # ISSUE 14: sanitizer — the FLAGS_sanitizer=off hot path is
+        # ONE module-attribute read per guarded site (gated, like the
+        # disabled-telemetry path); buffers mode's measured prepared-
+        # loop step is documented for the record (opt-in debug tier)
+        "sanitizer_probe_ns_per_site": round(san_probe_ns, 1),
+        "sanitizer_sites_per_step": SANITIZER_SITES_PER_STEP,
+        "sanitizer_step_off_us": round(san_off_us, 2),
+        "sanitizer_step_buffers_us": round(san_buf_us, 2),
+        "sanitizer_buffers_frac": round(
+            max(0.0, san_buf_us - san_off_us) / san_off_us, 5),
+        "sanitizer_overhead_frac": round(san_frac, 6),
+        "sanitizer_limit": san_limit,
         "ok": (frac < limit and num_frac < num_limit
                and serve_frac < serve_limit
                and gen_frac < gen_limit
                and ledger_frac < ledger_limit
                and tsdb_frac < tsdb_limit
-               and slo_frac < slo_limit),
+               and slo_frac < slo_limit
+               and san_frac < san_limit),
     }
     # gate name -> gauge (+ one tsdb sample when FLAGS_tsdb_dir is
     # set): the measured overheads become durable history, not just
