@@ -225,6 +225,13 @@ define_flag("telemetry_dump_dir", "",
             "(flight_<pid>_<n>.json) land here instead of the system "
             "temp dir, and injected faults leave one dump per fault "
             "point (tools/fault_matrix.py asserts it)")
+define_flag("moe_metrics", True,
+            "MoE routing observability (ISSUE 15 rider): the moe_ffn "
+            "routing shard emits per-expert load, dropped-token "
+            "fraction and router entropy into the always-on metrics "
+            "registry via a host callback (one small transfer per "
+            "step; tools/trace_report.py --moe rolls them up).  Off "
+            "removes the callback from the traced program entirely")
 define_flag("serve_max_batch", 16,
             "serving tier (paddle_tpu/serving): cap of the power-of-2 "
             "shape-bucket ladder (1, 2, 4, ... serve_max_batch).  The "

@@ -27,7 +27,9 @@ from jax.experimental import pallas as pl
 from paddle_tpu.observability.trace import traced as _traced
 
 __all__ = ["flash_attention", "flash_attention_fwd_lse",
-           "flash_attention_bwd", "paged_attention"]
+           "flash_attention_bwd", "paged_attention",
+           "flash_attention_chunk", "flash_attention_chunk_bwd",
+           "chunk_finalize"]
 
 NEG_INF = -1e30
 
@@ -695,6 +697,316 @@ def flash_attention_bwd(q, k, v, out, lse, do, scale=None, causal=False,
     # orientation streams the Q axis — see _dkv_kernel)
     bq_dkv = _fit_block(cfg.get("block_q_dkv") or bq, t)
     bk_dkv = _fit_block(cfg.get("block_k_dkv") or bk, tk)
+    if t % bq_dkv:
+        bq_dkv = bq
+    if tk % bk_dkv:
+        bk_dkv = bk
+    dq = _flash_bwd_dq(q, k, v, do, lse, delta, scale, causal, bq, bk,
+                       interpret)
+    dk, dv = _flash_bwd_dkv(q, k, v, do, lse, delta, scale, causal,
+                            bq_dkv, bk_dkv, interpret)
+    return dq, dk, dv
+
+
+# ---------------------------------------------------------------------------
+# Chunk-carry form (ISSUE 15): one online-softmax accumulator update of a
+# Q shard against ONE K/V block, with the (m, l, acc) carry explicit so
+# parallel/ring.py can thread it across ring steps — the tiled kernel
+# replaces the ring's dense per-step einsum and no [Sq, Sk] score block
+# ever lands in HBM, in either framework path.
+# ---------------------------------------------------------------------------
+
+def _tuned_ring_config(q_shape, kv_len, dtype):
+    """Autotune-cache hit for a ring chunk shape ({} on miss): keyed
+    'ring_attention' | (B, H, Sq_local, D, Sk_local) | dtype | backend,
+    written by tools/flash_tune.py --ring (and any future longctx
+    sweep); consulted at trace time, shard-local shapes."""
+    from paddle_tpu import tuning
+
+    cfg = tuning.lookup("ring_attention",
+                        tuple(q_shape) + (int(kv_len),),
+                        jnp.dtype(dtype).name)
+    return cfg or {}
+
+
+def resolve_chunk_blocks(q_shape, kv_len, dtype, block_q=None,
+                         block_k=None, cfg=None):
+    """(block_q, block_k) for a ring chunk: explicit args win, then the
+    'ring_attention' autotune-cache entry, then the flash defaults —
+    always fitted to the local shard lengths.  ``cfg`` lets a caller
+    that already looked the entry up (chunk_bwd needs its *_bwd keys
+    too) pass it through instead of paying a second lookup."""
+    if cfg is None:
+        cfg = _tuned_ring_config(q_shape, kv_len, dtype)
+    if block_q is None:
+        block_q = int(cfg.get("block_q", DEF_BLOCK_Q))
+    if block_k is None:
+        block_k = int(cfg.get("block_k", DEF_BLOCK_K))
+    t, tk = q_shape[2], int(kv_len)
+    block_q = _fit_block(block_q, t)
+    block_k = _fit_block(block_k, tk)
+    if t % block_q:
+        block_q = t
+    if tk % block_k:
+        block_k = tk
+    return block_q, block_k
+
+
+def _chunk_update_xla(q, k, v, m, l, acc, scale, causal, block_k,
+                      k_offset=0):
+    """Blockwise XLA chunk update — identical math to the Pallas chunk
+    kernel, K/V streamed ``block_k`` rows at a time through a scan so
+    even the fallback never materializes the [Sq, Sk] score block."""
+    b, h, t, d = q.shape
+    tk = k.shape[2]
+    qs = q.astype(jnp.float32) * scale
+    nk = tk // block_k
+    kb = jnp.moveaxis(k.reshape(b, h, nk, block_k, d), 2, 0)
+    vb = jnp.moveaxis(v.reshape(b, h, nk, block_k, d), 2, 0)
+
+    def step(carry, xs):
+        m, l, acc = carry
+        kj, vj, j = xs
+        s = jnp.einsum("bhtd,bhkd->bhtk", qs, kj.astype(jnp.float32))
+        if causal:
+            q_pos = jnp.arange(t, dtype=jnp.int32)[:, None]
+            k_pos = k_offset + j * block_k + jnp.arange(
+                block_k, dtype=jnp.int32)[None, :]
+            s = jnp.where((q_pos >= k_pos)[None, None], s, NEG_INF)
+        m_new = jnp.maximum(m, s.max(-1))
+        # fully-masked guard (the ISSUE 15 hazard): when a block's rows
+        # are ALL masked and no prior mass exists, m_new stays NEG_INF
+        # and exp(s - m_new) == exp(0) == 1 — spurious probability mass
+        # (or NaN with a true -inf sentinel).  Masked entries must
+        # contribute exactly zero regardless of the running max.
+        p = jnp.where(s <= 0.5 * NEG_INF, 0.0,
+                      jnp.exp(s - m_new[..., None]))
+        alpha = jnp.exp(m - m_new)
+        l = l * alpha + p.sum(-1)
+        acc = acc * alpha[..., None] + jnp.einsum(
+            "bhtk,bhkd->bhtd", p, vj.astype(jnp.float32))
+        return (m_new, l, acc), None
+
+    (m, l, acc), _ = jax.lax.scan(
+        step, (m, l, acc), (kb, vb, jnp.arange(nk, dtype=jnp.int32)))
+    return m, l, acc
+
+
+def _chunk_kernel(q_ref, k_ref, v_ref, m_in, l_in, acc_in, m_out,
+                  l_out, acc_out, m_s, l_s, acc_s, *, scale, causal,
+                  block_q, block_k, n_k, k_offset):
+    # grid (bh, qi, ki); ki innermost SEQUENTIAL so the VMEM scratch
+    # carries across K tiles — _flash_kernel's loop, but seeded from
+    # the ring carry instead of (-inf, 0, 0) and written back out.
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        m_s[...] = m_in[...]
+        l_s[...] = l_in[...]
+        acc_s[...] = acc_in[...]
+
+    if causal:
+        live = k_offset + ki * block_k <= qi * block_q + block_q - 1
+    else:
+        live = True
+
+    @pl.when(live)
+    def _step():
+        q = q_ref[...].astype(jnp.float32) * scale
+        k = k_ref[...].astype(jnp.float32)
+        v = v_ref[...].astype(jnp.float32)
+        s = q @ k.T                                   # [bq, bk]
+        if causal:
+            q_pos = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, s.shape, 0)
+            k_pos = k_offset + ki * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, s.shape, 1)
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        m_prev = m_s[...][:, 0]
+        m_new = jnp.maximum(m_prev, s.max(axis=1))
+        # fully-masked guard — see _chunk_update_xla
+        p = jnp.where(s <= 0.5 * NEG_INF, 0.0,
+                      jnp.exp(s - m_new[:, None]))
+        alpha = jnp.exp(m_prev - m_new)
+        l_s[...] = (l_s[...][:, 0] * alpha + p.sum(axis=1))[:, None]
+        acc_s[...] = acc_s[...] * alpha[:, None] + p @ v
+        m_s[...] = m_new[:, None]
+
+    @pl.when(ki == n_k - 1)
+    def _done():
+        m_out[...] = m_s[...]
+        l_out[...] = l_s[...]
+        acc_out[...] = acc_s[...]
+
+
+def _chunk_pallas(q, k, v, m, l, acc, scale, causal, block_q, block_k,
+                  interpret, k_offset=0):
+    from jax.experimental.pallas import tpu as pltpu
+
+    b, h, t, d = q.shape
+    tk = k.shape[2]
+    n_k = tk // block_k
+    kernel = functools.partial(_chunk_kernel, scale=scale,
+                               causal=causal, block_q=block_q,
+                               block_k=block_k, n_k=n_k,
+                               k_offset=int(k_offset))
+    qf = q.reshape(b * h, t, d)
+    kf = k.reshape(b * h, tk, d)
+    vf = v.reshape(b * h, tk, d)
+    mf = m.reshape(b * h, t, 1)
+    lf = l.reshape(b * h, t, 1)
+    af = acc.reshape(b * h, t, d)
+    qspec = pl.BlockSpec((None, block_q, d), lambda bh, qi, ki: (bh, qi, 0))
+    kspec = pl.BlockSpec((None, block_k, d), lambda bh, qi, ki: (bh, ki, 0))
+    rspec = pl.BlockSpec((None, block_q, 1), lambda bh, qi, ki: (bh, qi, 0))
+    m2, l2, a2 = pl.pallas_call(
+        kernel,
+        grid=(b * h, t // block_q, n_k),
+        in_specs=[qspec, kspec, kspec, rspec, rspec, qspec],
+        out_specs=[rspec, rspec, qspec],
+        out_shape=[jax.ShapeDtypeStruct((b * h, t, 1), jnp.float32),
+                   jax.ShapeDtypeStruct((b * h, t, 1), jnp.float32),
+                   jax.ShapeDtypeStruct((b * h, t, d), jnp.float32)],
+        scratch_shapes=[pltpu.VMEM((block_q, 1), jnp.float32),
+                        pltpu.VMEM((block_q, 1), jnp.float32),
+                        pltpu.VMEM((block_q, d), jnp.float32)],
+        compiler_params=_compiler_params(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=interpret,
+    )(qf, kf, vf, mf, lf, af)
+    return (m2.reshape(b, h, t), l2.reshape(b, h, t),
+            a2.reshape(b, h, t, d))
+
+
+def flash_attention_chunk(q, k, v, m, l, acc, scale=None, causal=False,
+                          block_q=None, block_k=None, force_xla=False,
+                          interpret=False, k_offset=0):
+    """One ring-step accumulator update: fold the K/V block into the
+    online-softmax carry.
+
+    ``q`` [B, H, Sq, D]; ``k``/``v`` [B, H, Sk, D] (ONE ring block);
+    carry ``m``/``l`` [B, H, Sq] f32 (init NEG_INF / 0) and ``acc``
+    [B, H, Sq, D] f32 (init 0; the UNNORMALIZED numerator).  Returns
+    the updated ``(m, l, acc)``.
+
+    ``causal=True`` means q and this K/V block share the same global
+    sequence offset (the ring's diagonal chunk); off-diagonal live
+    blocks are entirely in the past and take ``causal=False``.  A
+    fully-masked block leaves the carry bit-identically unchanged —
+    masked entries are forced to zero mass before they can poison the
+    running max (the ISSUE 15 numerics hazard; pinned in
+    tests/test_ring_longctx.py).  ``k_offset`` (static int) shifts the
+    K block's global positions under the causal mask — 0 is the ring's
+    diagonal chunk; ``k_offset >= Sq`` makes the whole block future
+    (fully masked), the shard-boundary case the guard exists for.
+
+    Tile sizes resolve through the 'ring_attention' autotune-cache
+    entry (tools/flash_tune.py --ring); on the TPU/interpret path they
+    shape the Pallas grid, elsewhere the blockwise-scan XLA fallback's
+    K streaming, so the fallback is memory-bounded too."""
+    if scale is None:
+        scale = 1.0 / np.sqrt(q.shape[-1])
+    block_q, block_k = resolve_chunk_blocks(q.shape, k.shape[2],
+                                            q.dtype, block_q, block_k)
+    on_tpu = target_platform() == "tpu"
+    if force_xla or not (on_tpu or interpret):
+        return _chunk_update_xla(q, k, v, m, l, acc, scale, causal,
+                                 block_k, k_offset=int(k_offset))
+    return _chunk_pallas(q, k, v, m, l, acc, scale, causal, block_q,
+                         block_k, interpret, k_offset=int(k_offset))
+
+
+def chunk_finalize(m, l, acc, dtype):
+    """(out, lse) from a finished chunk carry: normalize the numerator
+    and fold the running max into the per-row log-sum-exp (the residual
+    the ring backward replays P from).  Rows that never saw a live key
+    yield 0 output and an lse of NEG_INF, not NaN."""
+    l_safe = jnp.maximum(l, 1e-30)
+    out = (acc / l_safe[..., None]).astype(dtype)
+    lse = jnp.where(l > 0, m + jnp.log(l_safe), NEG_INF)
+    return out, lse
+
+
+def _chunk_bwd_xla(q, k, v, do, lse, delta, scale, causal, block_k,
+                   k_offset=0):
+    """Blockwise XLA chunk backward: P rebuilt tile-by-tile from the
+    saved lse (Dao et al. 2022 alg. 2), K/V streamed ``block_k`` rows
+    at a time — the [Sq, Sk] probability block never materializes even
+    off-TPU."""
+    b, h, t, d = q.shape
+    tk = k.shape[2]
+    qf = q.astype(jnp.float32)
+    dof = do.astype(jnp.float32)
+    dead = lse <= 0.5 * NEG_INF            # rows with no live key
+    nk = tk // block_k
+    kb = jnp.moveaxis(k.reshape(b, h, nk, block_k, d), 2, 0)
+    vb = jnp.moveaxis(v.reshape(b, h, nk, block_k, d), 2, 0)
+
+    def step(dq, xs):
+        kj, vj, j = xs
+        kjf = kj.astype(jnp.float32)
+        vjf = vj.astype(jnp.float32)
+        s = jnp.einsum("bhtd,bhkd->bhtk", qf, kjf) * scale
+        if causal:
+            q_pos = jnp.arange(t, dtype=jnp.int32)[:, None]
+            k_pos = k_offset + j * block_k + jnp.arange(
+                block_k, dtype=jnp.int32)[None, :]
+            s = jnp.where((q_pos >= k_pos)[None, None], s, NEG_INF)
+        p = jnp.where((s <= 0.5 * NEG_INF) | dead[..., None], 0.0,
+                      jnp.exp(s - lse[..., None]))
+        dv_j = jnp.einsum("bhtk,bhtd->bhkd", p, dof)
+        dp = jnp.einsum("bhtd,bhkd->bhtk", dof, vjf)
+        ds = p * (dp - delta[..., None]) * scale
+        dq = dq + jnp.einsum("bhtk,bhkd->bhtd", ds, kjf)
+        dk_j = jnp.einsum("bhtk,bhtd->bhkd", ds, qf)
+        return dq, (dk_j, dv_j)
+
+    dq0 = jnp.zeros(q.shape, jnp.float32)
+    dq, (dks, dvs) = jax.lax.scan(
+        step, dq0, (kb, vb, jnp.arange(nk, dtype=jnp.int32)))
+    dk = jnp.moveaxis(dks, 0, 2).reshape(b, h, tk, d)
+    dv = jnp.moveaxis(dvs, 0, 2).reshape(b, h, tk, d)
+    return (dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype))
+
+
+def flash_attention_chunk_bwd(q, k, v, do, lse, delta, scale=None,
+                              causal=False, block_q=None, block_k=None,
+                              force_xla=False, interpret=False,
+                              k_offset=0):
+    """Per-ring-step backward: (dq, dk, dv) of ONE Q shard against ONE
+    K/V block, from the forward's saved per-shard lse and the
+    precomputed ``delta`` = rowsum(dO * O) — no forward recompute.
+
+    Same chunk-offset contract as ``flash_attention_chunk``: causal
+    with the same static ``k_offset`` the forward used (the ring's
+    diagonal chunk is offset 0).  TPU/interpret runs the two flash
+    backward kernels; elsewhere — and for any causal off-diagonal
+    offset, which those kernels' masks do not express — the
+    blockwise-scan fallback."""
+    if scale is None:
+        scale = 1.0 / np.sqrt(q.shape[-1])
+    cfg = _tuned_ring_config(q.shape, k.shape[2], q.dtype)
+    block_q, block_k = resolve_chunk_blocks(q.shape, k.shape[2],
+                                            q.dtype, block_q, block_k,
+                                            cfg=cfg)
+    on_tpu = target_platform() == "tpu"
+    if force_xla or not (on_tpu or interpret) \
+            or (causal and k_offset):
+        return _chunk_bwd_xla(q, k, v, do, lse, delta, scale, causal,
+                              block_k, k_offset=int(k_offset))
+    t, tk = q.shape[2], k.shape[2]
+    do = do.astype(q.dtype)
+    delta = delta.astype(jnp.float32)
+    bq = _fit_block(int(cfg.get("block_q_bwd") or min(block_q, 512)), t)
+    bk = _fit_block(int(cfg.get("block_k_bwd") or block_k), tk)
+    if t % bq:
+        bq = block_q
+    if tk % bk:
+        bk = block_k
+    bq_dkv = _fit_block(int(cfg.get("block_q_dkv") or bq), t)
+    bk_dkv = _fit_block(int(cfg.get("block_k_dkv") or bk), tk)
     if t % bq_dkv:
         bq_dkv = bq
     if tk % bk_dkv:

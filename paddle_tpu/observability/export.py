@@ -477,6 +477,57 @@ def format_slo_table(rows):
     return "\n".join(out)
 
 
+def moe_rows(dumps):
+    """MoE routing rollup (ISSUE 15 rider): per process dump, the
+    capacity-factor stats the moe_ffn routing shard feeds the
+    always-on registry — routed steps/tokens, per-expert load
+    distribution (balance), dropped-token fraction and router entropy.
+    Works on any trace or flight dump (the metrics snapshot rides
+    both)."""
+    rows = []
+    for d in dumps:
+        m = d.get("metrics", {})
+
+        def val(name, default=0):
+            return (m.get(name) or {}).get("value", default)
+
+        def hist(name, field, default=0.0):
+            return (m.get(name) or {}).get(field, default)
+
+        steps = val("moe_router_steps_total")
+        tokens = val("moe_tokens_total")
+        if not steps and not tokens:
+            continue
+        rows.append({
+            "label": d.get("label", "?"),
+            "steps": steps,
+            "tokens": tokens,
+            "dropped_tokens": val("moe_dropped_tokens_total"),
+            "dropped_frac": round(val("moe_dropped_token_frac", 0.0),
+                                  4),
+            "router_entropy": round(val("moe_router_entropy", 0.0), 4),
+            "expert_load_p50": hist("moe_expert_load_tokens", "p50"),
+            "expert_load_p99": hist("moe_expert_load_tokens", "p99"),
+            "expert_load_mean": round(
+                hist("moe_expert_load_tokens", "mean"), 2),
+        })
+    rows.sort(key=lambda r: r["label"])
+    return rows
+
+
+def format_moe_table(rows):
+    out = ["%-22s %7s %9s %9s %9s %9s %9s %9s %9s" % (
+        "process", "steps", "tokens", "dropped", "drop_frac",
+        "entropy", "load_p50", "load_p99", "load_mean")]
+    for r in rows:
+        out.append("%-22s %7d %9d %9d %9.4f %9.4f %9.4g %9.4g %9.4g"
+                   % (r["label"][:22], r["steps"], r["tokens"],
+                      r["dropped_tokens"], r["dropped_frac"],
+                      r["router_entropy"], r["expert_load_p50"],
+                      r["expert_load_p99"], r["expert_load_mean"]))
+    return "\n".join(out)
+
+
 def format_phase_table(rows, top=0):
     out = ["%-32s %7s %10s %9s %9s %9s %7s" % (
         "phase", "count", "total_ms", "mean_ms", "p50_ms", "p99_ms",

@@ -48,19 +48,23 @@ def _ring_attention_lower(ctx, ins, attrs, op=None):
     scale = attrs["scale"] if "scale" in attrs else None
     sp_axis = _axis_or_none(ctx.mesh, attrs.get("sp_axis", "sp"))
     if sp_axis is not None:
-        from paddle_tpu.parallel.ring import ring_attention
-        out = ring_attention(
-            q, k, v, ctx.mesh, axis_name=sp_axis, causal=causal,
-            scale=scale,
+        from paddle_tpu.parallel.ring import (ring_attention,
+                                              ring_attention_fwd_lse)
+        axes = dict(
             batch_axis=_axis_or_none(ctx.mesh, attrs.get("batch_axis", "dp")),
             head_axis=_axis_or_none(ctx.mesh, attrs.get("head_axis", "tp")))
         if op is not None and op.outputs.get("LSE"):
-            # sequence-parallel residuals stay inside the ring primitive;
-            # the LSE output is a zeros placeholder and the grad op takes
-            # the generic-vjp path (it checks sp the same way)
-            return {"Out": out,
-                    "LSE": jnp.zeros(q.shape[:3], jnp.float32)}
-        return {"Out": out}
+            # saved-LSE contract (ISSUE 15): the ring forward's REAL
+            # per-position log-sum-exp rides as the op output, so the
+            # grad op replays the reverse-direction ring from it — no
+            # forward re-execution inside a generic vjp (MIGRATION.md)
+            out, lse = ring_attention_fwd_lse(
+                q, k, v, ctx.mesh, axis_name=sp_axis, causal=causal,
+                scale=scale, **axes)
+            return {"Out": out, "LSE": lse}
+        return {"Out": ring_attention(
+            q, k, v, ctx.mesh, axis_name=sp_axis, causal=causal,
+            scale=scale, **axes)}
     # dense (single-chip) path: the Pallas flash kernel on TPU (1.7x
     # XLA at T=8192, measured), same-math XLA fallback elsewhere.
     # Under a mesh the mesh's devices decide the platform (the default-
@@ -106,23 +110,41 @@ def _moe_ffn_lower(ctx, ins, attrs, op=None):
         h = jax.nn.relu(jnp.einsum("td,edf->tef", x2, w1))
         y = jnp.einsum("tef,efd->ted", h, w2)
         out = y[jnp.arange(x2.shape[0]), expert] * gate[:, None]
+        # dense dispatch has no capacity drop; routing stats still feed
+        # the registry so the --moe rollup works off-mesh too
+        from paddle_tpu.parallel.moe import emit_router_stats
+        emit_router_stats(gates, expert,
+                          jnp.ones(expert.shape, jnp.bool_))
     return {"Out": out.reshape(shape)}
 
 
 @register_op("ring_attention_grad", grad_maker=None)
 def _ring_attention_grad_lower(ctx, ins, attrs, op=None):
     """Flash backward from the forward's saved lse (no forward
-    re-execution).  Falls back to the generic vjp — which re-runs the
-    forward — when the residual is absent (ops built without the LSE
-    output, e.g. the inference transpiler's fused chains) or when the
-    sequence-parallel ring owns the residuals."""
+    re-execution): the reverse-direction ring under sp, the two flash
+    backward kernels dense.  Falls back to the generic vjp — which
+    re-runs the forward — only when the residual is absent (ops built
+    without the LSE output, e.g. the inference transpiler's fused
+    chains)."""
     from paddle_tpu.core import lowering as core_lowering
     from paddle_tpu.kernels.flash_attention import flash_attention_bwd
 
     sp_axis = _axis_or_none(ctx.mesh, attrs.get("sp_axis", "sp"))
     lse = ins.get("LSE")
-    if sp_axis is not None or lse is None:
+    if lse is None:
         return core_lowering.generic_grad_lower(ctx, ins, attrs, op)
+    if sp_axis is not None:
+        from paddle_tpu.parallel.ring import ring_attention_bwd
+        dq, dk, dv = ring_attention_bwd(
+            ins["Q"], ins["K"], ins["V"], ins["Out"], lse,
+            ins["Out@GRAD"], ctx.mesh, axis_name=sp_axis,
+            causal=bool(attrs.get("causal", True)),
+            scale=attrs["scale"] if "scale" in attrs else None,
+            batch_axis=_axis_or_none(ctx.mesh,
+                                     attrs.get("batch_axis", "dp")),
+            head_axis=_axis_or_none(ctx.mesh,
+                                    attrs.get("head_axis", "tp")))
+        return {"Q@GRAD": dq, "K@GRAD": dk, "V@GRAD": dv}
     not_tpu = (ctx.mesh is not None and
                ctx.mesh.devices.flat[0].platform != "tpu")
     dq, dk, dv = flash_attention_bwd(

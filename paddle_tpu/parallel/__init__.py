@@ -16,10 +16,13 @@ Three levels:
 """
 from .mesh import make_mesh, auto_mesh_axes  # noqa: F401
 from .api import shard_var, sharding_constraint  # noqa: F401
-from .ring import ring_attention  # noqa: F401
+from .ring import (ring_attention, ring_attention_fwd_lse,  # noqa: F401
+                   ring_attention_bwd, causal_step_counts)
 from .pipeline import pipeline_apply  # noqa: F401
-from .moe import moe_ffn  # noqa: F401
+from .moe import moe_ffn, emit_router_stats  # noqa: F401
 
 __all__ = ["make_mesh", "auto_mesh_axes", "shard_var",
-           "sharding_constraint", "ring_attention", "pipeline_apply",
-           "moe_ffn"]
+           "sharding_constraint", "ring_attention",
+           "ring_attention_fwd_lse", "ring_attention_bwd",
+           "causal_step_counts", "pipeline_apply", "moe_ffn",
+           "emit_router_stats"]

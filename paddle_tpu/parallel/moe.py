@@ -13,10 +13,73 @@ import functools
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
-__all__ = ["moe_ffn"]
+__all__ = ["moe_ffn", "emit_router_stats"]
+
+
+def _metrics_on():
+    from paddle_tpu.core.flags import FLAGS
+
+    return bool(FLAGS.moe_metrics)
+
+
+def _note_stats(tokens, idx, load, dropped, entropy):
+    """Host side of the routing-stats callback (ISSUE 15 MoE rider):
+    feed the always-on metrics registry.  Only ring/ep position 0
+    reports — under a pure ep mesh every shard routes the SAME
+    replicated tokens, so emitting from all of them would multiply
+    the counts (dp shards each carry idx 0 for their ep row and land
+    as independent samples, which is what we want)."""
+    if int(np.asarray(idx)) != 0:
+        return
+    from paddle_tpu.observability import metrics
+
+    load = np.asarray(load)
+    hist = metrics.histogram(
+        "moe_expert_load_tokens",
+        "tokens routed to one expert in one step (pre-capacity): the "
+        "per-expert load distribution — a balanced router keeps the "
+        "spread tight")
+    for c in load:
+        hist.observe(float(c))
+    dropped = float(np.asarray(dropped))
+    metrics.gauge("moe_dropped_token_frac",
+                  "fraction of tokens dropped by expert capacity in "
+                  "the latest routed step").set(dropped)
+    metrics.gauge("moe_router_entropy",
+                  "mean per-token entropy of the router softmax in "
+                  "the latest routed step (nats; ln(E) = uniform)"
+                  ).set(float(np.asarray(entropy)))
+    metrics.counter("moe_tokens_total",
+                    "tokens routed through moe_ffn").inc(tokens)
+    metrics.counter("moe_dropped_tokens_total",
+                    "tokens dropped by expert capacity").inc(
+                        int(round(dropped * tokens)))
+    metrics.counter("moe_router_steps_total",
+                    "moe_ffn routed steps observed").inc(1)
+
+
+def emit_router_stats(gates, expert, keep, shard_idx=0):
+    """Emit capacity-factor routing stats from inside a traced
+    computation: per-expert load, dropped-token fraction, router
+    entropy -> the always-on metrics registry (jax.debug.callback, one
+    [E]+2-scalar transfer per step; FLAGS_moe_metrics gates the
+    callback out of the program entirely).  ``gates`` [T, E] softmax
+    output, ``expert`` [T] argmax routing, ``keep`` [T] bool kept
+    mask, ``shard_idx`` the ep ring position (only 0 reports)."""
+    if not _metrics_on():
+        return
+    e = gates.shape[-1]
+    load = jnp.sum(jax.nn.one_hot(expert, e, dtype=jnp.int32), axis=0)
+    dropped = 1.0 - keep.astype(jnp.float32).mean()
+    entropy = -(gates * jnp.log(jnp.clip(gates, 1e-20, None))
+                ).sum(-1).mean()
+    jax.debug.callback(
+        functools.partial(_note_stats, int(gates.shape[0])),
+        shard_idx, load, dropped, entropy)
 
 
 def _moe_shard(x, wg, w1, w2, axis_name, capacity_factor):
@@ -37,6 +100,8 @@ def _moe_shard(x, wg, w1, w2, axis_name, capacity_factor):
     pos = jnp.cumsum(onehot, axis=0) * onehot - 1             # [T, E]
     pos_tok = jnp.max(pos, axis=1)                            # [T]
     keep = (pos_tok >= 0) & (pos_tok < cap)
+    emit_router_stats(gates, expert, keep,
+                      shard_idx=lax.axis_index(axis_name))
     # dispatch buffer [E, cap, D]
     disp = jnp.zeros((e, cap, d), x.dtype)
     disp = disp.at[expert, jnp.clip(pos_tok, 0, cap - 1)].add(

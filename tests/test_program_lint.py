@@ -64,6 +64,28 @@ def test_layout_transformed_resnet_lints_clean(prog_scope):
             label, "\n".join(d.format() for d in errs))
 
 
+def test_sp_ring_transformer_lints_clean(prog_scope):
+    """ISSUE 15 cross-feature gate: the sequence-parallel ring-attention
+    training program — ring_attention ops carrying the REAL saved-LSE
+    output and ring_attention_grad ops consuming it — must pass the
+    verifier with ZERO errors, with the lifetime checker in the
+    pipeline."""
+    from paddle_tpu.models.transformer import get_model
+
+    main, startup, scope = prog_scope
+    get_model(vocab_size=64, seq_len=16, d_model=32, n_head=4,
+              n_layers=2, d_ff=64, tp=True, sp=True)
+    ring_ops = [op for op in main.desc.blocks[0].ops
+                if op.type == "ring_attention"]
+    assert ring_ops and all(op.outputs.get("LSE") for op in ring_ops)
+    assert any(op.type == "ring_attention_grad"
+               for op in main.desc.blocks[0].ops)
+    for label, prog in (("main", main), ("startup", startup)):
+        errs = _errors(analysis.verify_program(prog))
+        assert errs == [], "sp ring %s program: %s" % (
+            label, "\n".join(d.format() for d in errs))
+
+
 def test_fused_transformer_lints_clean(prog_scope):
     """ISSUE 7 cross-feature gate: the fused-transformer-transformed
     training program (fused_qkv_matmul / fused_matmul_bias_act /

@@ -1,0 +1,446 @@
+"""Long-context ring attention (ISSUE 15): flash-chunk ring parity,
+causal block skipping, fully-masked-block numerics, MoE routing stats,
+and the longctx_bench tier-1 smoke.
+
+Parity discipline: the single-device flash path
+(kernels/flash_attention.py; the identical-math XLA fallback on this
+CPU suite) is the reference for both directions — the acceptance pin
+is fwd+bwd <= 1e-5 fp32."""
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from paddle_tpu.kernels.flash_attention import (
+    NEG_INF, chunk_finalize, flash_attention, flash_attention_chunk,
+    flash_attention_chunk_bwd, flash_attention_fwd_lse,
+    resolve_chunk_blocks)
+from paddle_tpu.parallel import make_mesh
+from paddle_tpu.parallel.ring import (causal_step_counts,
+                                      ring_attention,
+                                      ring_attention_bwd,
+                                      ring_attention_fwd_lse)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _cpu(n):
+    devs = jax.devices("cpu")
+    if len(devs) < n:
+        pytest.skip("needs %d cpu devices" % n)
+    return devs[:n]
+
+
+def _qkv(shape, dtype=np.float32, seed=0, scale=0.5):
+    rng = np.random.RandomState(seed)
+    return tuple(jnp.asarray((rng.randn(*shape) * scale).astype(
+        np.float32)).astype(dtype) for _ in range(3))
+
+
+# ------------------------------------------------------- ring parity
+
+@pytest.mark.parametrize("p", [2, 4, 8])
+@pytest.mark.parametrize("causal", [True, False])
+def test_ring_fwd_parity_fp32(p, causal):
+    mesh = make_mesh({"sp": p}, devices=_cpu(p))
+    q, k, v = _qkv((2, 3, 32, 8))
+    out = ring_attention(q, k, v, mesh, causal=causal)
+    ref = flash_attention(q, k, v, causal=causal)
+    assert float(jnp.abs(out - ref).max()) <= 1e-5, (p, causal)
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_ring_fwd_parity_bf16(causal):
+    mesh = make_mesh({"sp": 4}, devices=_cpu(4))
+    q, k, v = _qkv((1, 2, 16, 8), dtype=jnp.bfloat16)
+    out = ring_attention(q, k, v, mesh, causal=causal)
+    ref = flash_attention(q, k, v, causal=causal)
+    diff = jnp.abs(out.astype(jnp.float32) - ref.astype(jnp.float32))
+    assert float(diff.max()) <= 3e-2, causal
+    assert out.dtype == jnp.bfloat16
+
+
+@pytest.mark.parametrize("p", [2, 4, 8])
+@pytest.mark.parametrize("causal", [True, False])
+def test_ring_bwd_parity_fp32(p, causal):
+    """Grads through the ring's custom_vjp (the saved-lse reverse ring)
+    vs the single-device flash vjp — the acceptance pin."""
+    mesh = make_mesh({"sp": p}, devices=_cpu(p))
+    q, k, v = _qkv((1, 2, 16, 8), seed=1)
+
+    def loss(fn):
+        return lambda q, k, v: (fn(q, k, v).astype(jnp.float32)
+                                ** 2).sum()
+
+    g_ring = jax.grad(loss(lambda q, k, v: ring_attention(
+        q, k, v, mesh, causal=causal)), argnums=(0, 1, 2))(q, k, v)
+    g_ref = jax.grad(loss(lambda q, k, v: flash_attention(
+        q, k, v, causal=causal)), argnums=(0, 1, 2))(q, k, v)
+    for gr, gf, name in zip(g_ring, g_ref, "qkv"):
+        rel = float(jnp.abs(gr - gf).max()) / max(
+            float(jnp.abs(gf).max()), 1e-9)
+        assert rel <= 1e-5, (p, causal, name, rel)
+
+
+def test_ring_lse_parity():
+    """The op-level saved-LSE residual is the REAL per-position
+    log-sum-exp, not the pre-ISSUE-15 zeros placeholder."""
+    mesh = make_mesh({"sp": 4}, devices=_cpu(4))
+    q, k, v = _qkv((1, 2, 16, 8), seed=2)
+    out, lse = ring_attention_fwd_lse(q, k, v, mesh, causal=True)
+    ref_out, ref_lse = flash_attention_fwd_lse(q, k, v, causal=True,
+                                               force_xla=True)
+    assert float(jnp.abs(out - ref_out).max()) <= 1e-5
+    assert float(jnp.abs(lse - ref_lse).max()) <= 1e-4
+    assert float(jnp.abs(lse).max()) > 0.0
+
+
+def test_ring_bwd_from_residuals():
+    """ring_attention_bwd (the grad op's entry: residuals in, no
+    forward recompute) matches the autodiff path exactly."""
+    mesh = make_mesh({"sp": 4}, devices=_cpu(4))
+    q, k, v = _qkv((1, 2, 16, 8), seed=3)
+    out, lse = ring_attention_fwd_lse(q, k, v, mesh, causal=True)
+    do = out * 0.7 + 0.1
+    dq, dk, dv = ring_attention_bwd(q, k, v, out, lse, do, mesh,
+                                    causal=True)
+    g = jax.vjp(lambda q, k, v: ring_attention(q, k, v, mesh,
+                                               causal=True),
+                q, k, v)[1](do)
+    for a, b, name in zip((dq, dk, dv), g, "qkv"):
+        assert float(jnp.abs(a - b).max()) <= 1e-5, name
+
+
+def test_ring_shard_boundary_rows():
+    """Skip-step correctness at every shard offset: the first and last
+    Q row of EVERY shard matches the dense reference (a wrong liveness
+    predicate shows up exactly at these rows)."""
+    p, sq = 8, 4
+    mesh = make_mesh({"sp": p}, devices=_cpu(p))
+    q, k, v = _qkv((1, 1, p * sq, 8), seed=4)
+    out = np.asarray(ring_attention(q, k, v, mesh, causal=True))
+    ref = np.asarray(flash_attention(q, k, v, causal=True))
+    for s in range(p):
+        for row in (s * sq, s * sq + sq - 1):
+            diff = np.abs(out[:, :, row] - ref[:, :, row]).max()
+            assert diff <= 1e-5, (s, row, diff)
+
+
+# -------------------------------------------------- causal skipping
+
+def test_causal_step_counts():
+    """The FLOP-skip evidence: under causal, ring position i executes
+    i+1 forward chunks (sum p(p+1)/2 vs p^2 dense) and the backward
+    mirror; non-causal runs everything."""
+    mesh = make_mesh({"sp": 8}, devices=_cpu(8))
+    fwd = [int(c) for c in np.asarray(causal_step_counts(mesh))]
+    bwd = [int(c) for c in np.asarray(
+        causal_step_counts(mesh, direction="bwd"))]
+    assert fwd == list(range(1, 9))
+    assert bwd == list(range(8, 0, -1))
+    assert sum(fwd) == 36          # 36/64 = ~2x fewer steps at p=8
+    dense = [int(c) for c in np.asarray(
+        causal_step_counts(mesh, causal=False))]
+    assert dense == [8] * 8
+
+
+def test_ring_hlo_double_buffer_structure():
+    """Optimized-HLO inventory (the MESH_PROFILE_r06.md method): the
+    forward schedules exactly 2*(p-1) collective-permutes — the
+    double-buffered rotation with the last step elided; the naive scan
+    form rotated 2*p — and p-1 causal-skip conditionals."""
+    p = 4
+    mesh = make_mesh({"sp": p}, devices=_cpu(p))
+    q, k, v = _qkv((1, 1, 16, 8))
+
+    def fwd(q, k, v):
+        return ring_attention_fwd_lse(q, k, v, mesh, causal=True)[0]
+
+    txt = jax.jit(fwd).lower(q, k, v).compile().as_text()
+    import re
+    perms = len(re.findall(r"\bcollective-permute(?:-start)?\(", txt))
+    conds = len(re.findall(r"\bconditional\(", txt))
+    assert perms == 2 * (p - 1), perms
+    assert conds == p - 1, conds
+
+
+# ------------------------------------------- chunk kernel + numerics
+
+def test_chunk_carry_matches_full_flash():
+    """Threading the (m, l, acc) carry across split K/V blocks equals
+    one full flash attention — the exact invariant the ring relies
+    on."""
+    q, k, v = _qkv((2, 2, 32, 8), seed=5)
+    m = jnp.full(q.shape[:3], NEG_INF, jnp.float32)
+    l = jnp.zeros(q.shape[:3], jnp.float32)
+    acc = jnp.zeros(q.shape, jnp.float32)
+    for lo, hi in ((0, 8), (8, 24), (24, 32)):
+        m, l, acc = flash_attention_chunk(
+            q, k[:, :, lo:hi], v[:, :, lo:hi], m, l, acc,
+            force_xla=True)
+    out, lse = chunk_finalize(m, l, acc, q.dtype)
+    ref, ref_lse = flash_attention_fwd_lse(q, k, v, force_xla=True)
+    assert float(jnp.abs(out - ref).max()) <= 1e-5
+    assert float(jnp.abs(lse - ref_lse).max()) <= 1e-4
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_chunk_kernel_interpret_parity(causal):
+    """The Pallas chunk kernel (interpret mode) is bit-compatible with
+    the blockwise XLA fallback — the CPU-parity-transfers contract of
+    every kernel PR."""
+    q, k, v = _qkv((1, 2, 32, 8), seed=6)
+    m = jnp.full(q.shape[:3], NEG_INF, jnp.float32)
+    l = jnp.zeros(q.shape[:3], jnp.float32)
+    acc = jnp.zeros(q.shape, jnp.float32)
+    a = flash_attention_chunk(q, k, v, m, l, acc, causal=causal,
+                              block_q=8, block_k=8, interpret=True)
+    b = flash_attention_chunk(q, k, v, m, l, acc, causal=causal,
+                              block_q=8, block_k=8, force_xla=True)
+    for x, y, name in zip(a, b, ("m", "l", "acc")):
+        assert float(jnp.abs(x - y).max()) <= 1e-6, (causal, name)
+
+
+def test_chunk_bwd_interpret_parity():
+    q, k, v = _qkv((1, 2, 32, 8), seed=7)
+    out, lse = flash_attention_fwd_lse(q, k, v, causal=True,
+                                       force_xla=True)
+    do = out * 0.3
+    delta = (do.astype(jnp.float32) * out.astype(jnp.float32)).sum(-1)
+    a = flash_attention_chunk_bwd(q, k, v, do, lse, delta, causal=True,
+                                  block_q=8, block_k=8, interpret=True)
+    b = flash_attention_chunk_bwd(q, k, v, do, lse, delta, causal=True,
+                                  block_q=8, block_k=8, force_xla=True)
+    for x, y, name in zip(a, b, ("dq", "dk", "dv")):
+        assert float(jnp.abs(x - y).max()) <= 2e-5, name
+
+
+def test_fully_masked_block_guard():
+    """The ISSUE 15 numerics hazard, pinned at the shard boundary: a
+    causal block ENTIRELY in the future (k_offset >= Sq) must leave
+    the carry unchanged and finite — without the guard, the online-
+    softmax max collapses and exp() manufactures mass (or NaN)."""
+    q, k, v = _qkv((1, 1, 8, 4), seed=8)
+    m0 = jnp.full(q.shape[:3], NEG_INF, jnp.float32)
+    l0 = jnp.zeros(q.shape[:3], jnp.float32)
+    a0 = jnp.zeros(q.shape, jnp.float32)
+    for interp in (False, True):
+        kw = {"interpret": True} if interp else {"force_xla": True}
+        m, l, acc = flash_attention_chunk(
+            q, k, v, m0, l0, a0, causal=True, k_offset=8,
+            block_q=4, block_k=4, **kw)
+        assert bool(jnp.isfinite(l).all()) and bool(
+            jnp.isfinite(acc).all()), interp
+        assert float(jnp.abs(l - l0).max()) == 0.0, interp
+        assert float(jnp.abs(acc - a0).max()) == 0.0, interp
+    # rows with no live key EVER finalize to zero output + NEG_INF lse
+    out, lse = chunk_finalize(m, l, acc, q.dtype)
+    assert bool(jnp.isfinite(out).all())
+    assert float(jnp.abs(out).max()) == 0.0
+    assert float(lse.max()) <= 0.5 * NEG_INF
+
+
+def test_partially_masked_boundary_block():
+    """A half-future block (k_offset mid-shard) keeps the live half and
+    zeroes the rest — the off-by-one surface of the guard."""
+    q, k, v = _qkv((1, 1, 8, 4), seed=9)
+    m = jnp.full(q.shape[:3], NEG_INF, jnp.float32)
+    l = jnp.zeros(q.shape[:3], jnp.float32)
+    acc = jnp.zeros(q.shape, jnp.float32)
+    m, l, acc = flash_attention_chunk(q, k[:, :, :4], v[:, :, :4], m,
+                                      l, acc, causal=True, k_offset=4,
+                                      force_xla=True)
+    out, lse = chunk_finalize(m, l, acc, q.dtype)
+    assert bool(jnp.isfinite(out).all())
+    # rows 0..3 see nothing (keys start at position 4); rows 4..7 do
+    assert float(jnp.abs(out[:, :, :4]).max()) == 0.0
+    assert float(jnp.abs(out[:, :, 4:]).max()) > 0.0
+    s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
+                   k[:, :, :4].astype(jnp.float32)) * (4 ** -0.5)
+    mask = (jnp.arange(8)[:, None] >= 4 + jnp.arange(4)[None, :])
+    s = jnp.where(mask[None, None], s, -jnp.inf)
+    ref = jnp.einsum("bhqk,bhkd->bhqd", jax.nn.softmax(s, -1),
+                     v[:, :, :4].astype(jnp.float32))
+    assert float(jnp.abs(out[:, :, 4:] - ref[:, :, 4:]).max()) <= 1e-5
+
+
+def test_chunk_bwd_k_offset_matches_forward_mask():
+    """The chunk backward honors the SAME static k_offset as the
+    forward: keys masked in the forward contribute zero gradient, and
+    the live half matches autodiff through the offset-masked
+    reference."""
+    q, k4, v4 = _qkv((1, 1, 8, 4), seed=11)
+    k4, v4 = k4[:, :, :4], v4[:, :, :4]
+
+    def ref(q, k, v):
+        s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
+                       k.astype(jnp.float32)) * (4 ** -0.5)
+        mask = (jnp.arange(8)[:, None] >= 4 + jnp.arange(4)[None, :])
+        s = jnp.where(mask[None, None], s, NEG_INF)
+        p = jnp.exp(s - jax.nn.logsumexp(s, axis=-1, keepdims=True))
+        # rows with no live key: force their (uniform-softmax) mass out
+        p = jnp.where(mask[None, None], p, 0.0)
+        return jnp.einsum("bhqk,bhkd->bhqd", p, v.astype(jnp.float32))
+
+    m = jnp.full(q.shape[:3], NEG_INF, jnp.float32)
+    l = jnp.zeros(q.shape[:3], jnp.float32)
+    acc = jnp.zeros(q.shape, jnp.float32)
+    m, l, acc = flash_attention_chunk(q, k4, v4, m, l, acc,
+                                      causal=True, k_offset=4,
+                                      force_xla=True)
+    out, lse = chunk_finalize(m, l, acc, q.dtype)
+    do = jnp.ones_like(out) * 0.5
+    delta = (do.astype(jnp.float32) * out.astype(jnp.float32)).sum(-1)
+    dq, dk, dv = flash_attention_chunk_bwd(q, k4, v4, do, lse, delta,
+                                           causal=True, k_offset=4,
+                                           force_xla=True)
+    g = jax.vjp(ref, q, k4, v4)[1](do.astype(jnp.float32))
+    for a, b, name in zip((dq, dk, dv), g, ("dq", "dk", "dv")):
+        assert float(jnp.abs(a - b).max()) <= 1e-5, name
+    # dead rows (q_pos < 4) attend to nothing: their dq is exactly 0
+    assert float(jnp.abs(dq[:, :, :4]).max()) == 0.0
+
+
+# ------------------------------------------------- autotune plumbing
+
+def test_ring_chunk_blocks_from_autotune_cache(tmp_path):
+    """Ring chunk tiles resolve through the 'ring_attention' cache
+    entry (tools/flash_tune.py --ring writes it); explicit args always
+    win; a miss falls back to the flash defaults fitted to the
+    shard."""
+    from paddle_tpu import tuning
+    from paddle_tpu.core.flags import FLAGS
+
+    old = FLAGS.autotune_cache_dir
+    FLAGS.autotune_cache_dir = str(tmp_path)
+    tuning.invalidate()
+    try:
+        shape = (1, 2, 64, 8)
+        assert resolve_chunk_blocks(shape, 64, jnp.float32) == (64, 64)
+        assert tuning.record("ring_attention", shape + (64,),
+                             "float32", {"block_q": 16, "block_k": 32})
+        assert resolve_chunk_blocks(shape, 64, jnp.float32) == (16, 32)
+        # explicit argument beats the cache
+        assert resolve_chunk_blocks(shape, 64, jnp.float32,
+                                    block_q=8) == (8, 32)
+        # and the tuned tiles actually reach the chunk math unchanged
+        q, k, v = _qkv(shape, seed=10)
+        m = jnp.full(shape[:3], NEG_INF, jnp.float32)
+        l = jnp.zeros(shape[:3], jnp.float32)
+        acc = jnp.zeros(shape, jnp.float32)
+        got = flash_attention_chunk(q, k, v, m, l, acc, force_xla=True)
+        ref = flash_attention_chunk(q, k, v, m, l, acc, force_xla=True,
+                                    block_q=64, block_k=64)
+        # different tile sizes reorder the reduction; same math
+        for x, y in zip(got, ref):
+            assert float(jnp.abs(x - y).max()) <= 1e-4
+    finally:
+        FLAGS.autotune_cache_dir = old
+        tuning.invalidate()
+
+
+# --------------------------------------------------- MoE stats rider
+
+def test_moe_router_stats_registry():
+    """parallel/moe.py feeds the always-on registry: per-expert load
+    histogram, dropped-token fraction, router entropy (ISSUE 15 MoE
+    rider) — and FLAGS_moe_metrics=0 removes the callback."""
+    from paddle_tpu.core.flags import FLAGS
+    from paddle_tpu.observability import metrics
+    from paddle_tpu.parallel import moe_ffn
+
+    devs = _cpu(4)
+    mesh = make_mesh({"ep": 4}, devices=devs)
+    D, E, F, T = 8, 4, 16, 32
+    rng = np.random.RandomState(0)
+    ops = (jnp.asarray(rng.randn(T, D).astype(np.float32)),
+           jnp.asarray(rng.randn(D, E).astype(np.float32)),
+           jnp.asarray(rng.randn(E, D, F).astype(np.float32) * 0.2),
+           jnp.asarray(rng.randn(E, F, D).astype(np.float32) * 0.2))
+    metrics.zero_all()
+    # capacity_factor 0.25 -> cap 2/expert/device: drops guaranteed
+    y = moe_ffn(*ops, mesh, capacity_factor=0.25)
+    jax.block_until_ready(y)
+    snap = metrics.snapshot()
+    assert snap["moe_router_steps_total"]["value"] == 1
+    assert snap["moe_tokens_total"]["value"] == T
+    assert snap["moe_expert_load_tokens"]["count"] == E
+    assert snap["moe_dropped_token_frac"]["value"] > 0.0
+    assert snap["moe_dropped_tokens_total"]["value"] > 0
+    assert 0.0 < snap["moe_router_entropy"]["value"] <= np.log(E) + 1e-3
+    # the rollup row renders from any dump carrying the snapshot
+    from paddle_tpu.observability import export
+    rows = export.moe_rows([{"label": "trainer", "metrics": snap}])
+    assert len(rows) == 1 and rows[0]["tokens"] == T
+    assert "trainer" in export.format_moe_table(rows)
+    # flag off: no callback in the traced program at all
+    FLAGS.moe_metrics = False
+    try:
+        metrics.zero_all()
+        jax.block_until_ready(moe_ffn(*ops, mesh, capacity_factor=0.25))
+        assert metrics.snapshot().get("moe_router_steps_total",
+                                      {}).get("value", 0) == 0
+    finally:
+        FLAGS.moe_metrics = True
+
+
+def test_trace_report_moe_rollup(tmp_path, capsys):
+    """tools/trace_report.py --moe prints the registry-driven rollup
+    from a process dump (ISSUE 15 rider; ROLLUPS registry row)."""
+    from paddle_tpu.observability import metrics
+    from paddle_tpu.parallel import moe_ffn
+
+    mesh = make_mesh({"ep": 4}, devices=_cpu(4))
+    rng = np.random.RandomState(0)
+    metrics.zero_all()
+    y = moe_ffn(jnp.asarray(rng.randn(16, 8).astype(np.float32)),
+                jnp.asarray(rng.randn(8, 4).astype(np.float32)),
+                jnp.asarray(rng.randn(4, 8, 16).astype(np.float32)),
+                jnp.asarray(rng.randn(4, 16, 8).astype(np.float32)),
+                mesh)
+    jax.block_until_ready(y)
+    dump = {"label": "moe_proc", "pid": 1, "spans": [],
+            "metrics": metrics.snapshot()}
+    path = tmp_path / "trace_moe_1.json"
+    path.write_text(json.dumps(dump))
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    import trace_report
+    rc = trace_report.main([str(path), "--moe"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "moe rollup" in out and "moe_proc" in out
+
+
+# ------------------------------------------------------ bench smoke
+
+def test_longctx_bench_quick_smoke():
+    """tools/longctx_bench.py --quick completes on the CPU backend and
+    reports the full artifact schema: ring/baseline points, the parity
+    pin, the skip counts, the HLO double-buffer inventory (ISSUE 15
+    satellite; wired like serve_bench/pserver_bench smokes)."""
+    env = dict(os.environ)
+    env["LONGCTX_CHILD_TIMEOUT"] = "300"
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools",
+                                      "longctx_bench.py"),
+         "--quick", "--seqs", "1024", "--steps", "1"],
+        capture_output=True, text=True, timeout=560, env=env, cwd=REPO)
+    assert proc.returncode == 0, (proc.stdout[-1500:],
+                                  proc.stderr[-1500:])
+    rec = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert rec["metric"] == "longctx_bench" and rec["quick"] is True
+    assert rec["ok"] is True
+    pt = rec["points"][0]
+    assert pt["ring"]["tokens_s"] > 0
+    assert pt["ring"]["peak_rss_mb"] > 0
+    assert pt["baseline"]["tokens_s"] > 0
+    assert rec["parity"]["ok"] is True
+    assert rec["parity"]["fwd_maxdiff"] <= 1e-5
+    assert rec["skip"]["counts"] == list(range(1, rec["p"] + 1))
+    assert rec["hlo"]["double_buffer_structure"] is True
+    assert rec["hlo"]["causal_skip_structure"] is True

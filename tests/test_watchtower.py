@@ -432,7 +432,7 @@ def test_trace_report_all_implies_every_rollup(tmp_path, capsys):
     tr = _tool("trace_report")
     # registry covers exactly the known rollups
     assert [r[0] for r in tr.ROLLUPS] == [
-        "numerics", "wire", "serve", "scale", "slo"]
+        "numerics", "wire", "serve", "scale", "slo", "moe"]
     from paddle_tpu.observability.trace import Tracer
     obs_metrics.counter("slo_alerts_total").inc()
     t = Tracer(enabled=True)
@@ -444,14 +444,15 @@ def test_trace_report_all_implies_every_rollup(tmp_path, capsys):
     assert rc == 0
     out = capsys.readouterr().out
     for title_frag in ("numerics rollup", "wire rollup",
-                       "serve rollup", "scale rollup", "slo rollup"):
+                       "serve rollup", "scale rollup", "slo rollup",
+                       "moe rollup"):
         assert title_frag in out, title_frag
     # JSON mode wraps every requested rollup key
     rc = tr.main([dump, "--all", "--json"])
     assert rc == 0
     obj = json.loads(capsys.readouterr().out)
     assert set(obj) == {"phases", "kernels", "numerics", "wire",
-                        "serve", "scale", "slo"}
+                        "serve", "scale", "slo", "moe"}
 
 
 def test_trace_report_slo_rollup_reads_gauges(tmp_path, capsys):

@@ -1,0 +1,421 @@
+#!/usr/bin/env python
+"""Long-context frontier bench (ISSUE 15 tentpole): ring attention vs
+the non-ring flash baseline, 8k-128k tokens on the 8-device mesh.
+
+Measures, per sequence length, a full fwd+bwd attention step (the
+training hot path) in an ISOLATED child process per point:
+
+- **ring**: parallel/ring.py over ``{"sp": p}`` — flash-chunk inner
+  compute, double-buffered K/V rotation, causal block skipping, the
+  saved-lse reverse-ring backward.  tokens/s, step wall, peak RSS.
+- **baseline**: the dense single-program flash path
+  (kernels/flash_attention.py; the XLA fallback off-TPU) at the same
+  total sequence.  Its score block is O(S^2): the bench PREDICTS the
+  footprint first and records ``oom_predicted`` instead of taking the
+  host down; a child that dies anyway is recorded as ``collapsed``.
+  Either record satisfies the acceptance gate — that collapse is the
+  point.
+
+The smallest ring point also collects the structural evidence:
+
+- **parity**: ring fwd+bwd vs the single-device flash fallback
+  (<= 1e-5 fp32, the acceptance pin);
+- **skip**: ``causal_step_counts`` — executed chunks per ring position
+  ([1..p]; sum p(p+1)/2 vs p^2 dense, ~2x fewer FLOPs at p=8);
+- **hlo**: the optimized-HLO collective inventory
+  (MESH_PROFILE_r06.md method, via ``jit(...).lower().compile()
+  .as_text()``): the double-buffered forward schedules exactly
+  2*(p-1) collective-permutes (the naive scan rotates 2*p) and the
+  causal skip contributes p-1 ``conditional`` branches.
+
+Writes ``LONGCTX_BENCH.json`` (--out); ``--quick`` is the seconds-long
+tier-1 smoke (wired in tests/test_ring_longctx.py); ``--sentinel``
+gates the run against PERF_TRAJECTORY.json floors (ROADMAP: always
+pass it).
+
+Usage:
+    python tools/longctx_bench.py --out LONGCTX_BENCH.json --sentinel
+    python tools/longctx_bench.py --quick
+"""
+import argparse
+import json
+import os
+import re
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+FULL_SEQS = (8192, 32768, 65536, 131072)
+QUICK_SEQS = (2048, 4096)
+PARITY_TOL = 1e-5
+# fwd+bwd slabs the dense XLA fallback holds live per attention step
+# (s, p, dp, ds + the grad-of-softmax temp): the OOM predictor's
+# multiplier over the raw [B, H, S, S] f32 score block
+BASELINE_SLABS = 5
+
+# opcode-position matches only (the opcode is directly followed by its
+# operand list) — a bare word match would also count every %name
+# operand reference and inflate the inventory
+_COLL_RE = re.compile(
+    r"\b(collective-permute-start|collective-permute|conditional)\(")
+
+
+def _mem_budget_bytes():
+    budget = os.environ.get("LONGCTX_MEM_BUDGET_MB")
+    if budget:
+        return int(budget) * (1 << 20)
+    try:
+        with open("/proc/meminfo") as f:
+            for line in f:
+                if line.startswith("MemAvailable:"):
+                    return int(line.split()[1]) * 1024 * 7 // 10
+    except OSError:
+        pass
+    return 8 << 30
+
+
+def _peak_rss_mb():
+    import resource
+
+    ru = resource.getrusage(resource.RUSAGE_SELF)
+    return round(ru.ru_maxrss / 1024.0, 1)   # linux: KB
+
+
+# ------------------------------------------------------------ children
+
+def _child_inputs(args):
+    import numpy as np
+    import jax.numpy as jnp
+
+    rng = np.random.RandomState(7)
+    shape = (args.batch, args.heads, args.seq, args.head_dim)
+    # randn scaled down so softmax at long S stays in a realistic range
+    return tuple(jnp.asarray(rng.randn(*shape).astype(np.float32) * 0.5)
+                 for _ in range(3))
+
+
+def _timed(step, ops, steps):
+    import numpy as np
+
+    float(np.asarray(step(*ops)))            # warmup + compile
+    t0 = time.time()
+    for _ in range(steps):
+        r = step(*ops)
+    float(np.asarray(r))                     # d2h drain = the only sync
+    return (time.time() - t0) / steps
+
+
+def _run_ring_child(args):
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu.core.flags import apply_xla_flags
+    apply_xla_flags()
+    from paddle_tpu.parallel import make_mesh
+    from paddle_tpu.parallel.ring import ring_attention
+
+    p = args.devices
+    mesh = make_mesh({"sp": p}, devices=jax.devices("cpu")[:p])
+    q, k, v = _child_inputs(args)
+
+    def loss(q, k, v):
+        o = ring_attention(q, k, v, mesh, causal=True)
+        return (o.astype(jnp.float32) ** 2).sum()
+
+    grad = jax.jit(jax.grad(loss, argnums=(0, 1, 2)))
+
+    def step(q, k, v):
+        dq, dk, dv = grad(q, k, v)
+        return dq[0, 0, 0, 0] + dk[0, 0, 0, 0] + dv[0, 0, 0, 0]
+
+    sec = _timed(step, (q, k, v), args.steps)
+    tokens = args.batch * args.seq
+    out = {
+        "mode": "ring", "seq": args.seq,
+        "step_s": round(sec, 4),
+        "tokens_s": round(tokens / sec, 1),
+        "tokens_s_per_device": round(tokens / sec / p, 1),
+        "peak_rss_mb": _peak_rss_mb(),
+    }
+    if args.extras:
+        out.update(_ring_extras(args, mesh, q, k, v))
+    print(json.dumps(out))
+    return 0
+
+
+def _ring_extras(args, mesh, q, k, v):
+    """Parity + causal-skip + HLO structure evidence, collected once at
+    the smallest ring point (compiles are cheap there)."""
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu.kernels.flash_attention import flash_attention
+    from paddle_tpu.parallel.ring import (causal_step_counts,
+                                          ring_attention,
+                                          ring_attention_fwd_lse)
+
+    p = args.devices
+    # --- fwd+bwd parity vs the single-device flash fallback
+    out_ring = ring_attention(q, k, v, mesh, causal=True)
+    out_ref = flash_attention(q, k, v, causal=True)
+    fwd_diff = float(jnp.abs(out_ring - out_ref).max())
+
+    def loss_ring(q):
+        return (ring_attention(q, k, v, mesh, causal=True)
+                .astype(jnp.float32) ** 2).sum()
+
+    def loss_ref(q):
+        return (flash_attention(q, k, v, causal=True)
+                .astype(jnp.float32) ** 2).sum()
+
+    g_ring = jax.grad(loss_ring)(q)
+    g_ref = jax.grad(loss_ref)(q)
+    scale_ref = float(jnp.abs(g_ref).max()) or 1.0
+    bwd_diff = float(jnp.abs(g_ring - g_ref).max()) / scale_ref
+    parity = {"fwd_maxdiff": fwd_diff, "bwd_rel_maxdiff": bwd_diff,
+              "tol": PARITY_TOL,
+              "ok": fwd_diff <= PARITY_TOL and bwd_diff <= PARITY_TOL}
+
+    # --- causal block skipping: executed chunks per ring position
+    counts = [int(c) for c in np.asarray(causal_step_counts(mesh))]
+    executed = sum(counts)
+    skip = {"counts": counts, "executed_chunks": executed,
+            "dense_chunks": p * p,
+            "flop_ratio": round(executed / float(p * p), 4),
+            "ok": counts == list(range(1, p + 1))}
+
+    # --- optimized-HLO inventory (the MESH_PROFILE_r06.md method):
+    # forward module alone so the expected counts are exact
+    def fwd(q, k, v):
+        return ring_attention_fwd_lse(q, k, v, mesh, causal=True)[0]
+
+    txt = jax.jit(fwd).lower(q, k, v).compile().as_text()
+    hits = {}
+    for mm in _COLL_RE.finditer(txt):
+        hits[mm.group(1)] = hits.get(mm.group(1), 0) + 1
+    permutes = hits.get("collective-permute", 0) \
+        + hits.get("collective-permute-start", 0)
+    conds = hits.get("conditional", 0)
+    hlo = {
+        "collective_permute": permutes,
+        "collective_permute_start": hits.get(
+            "collective-permute-start", 0),
+        "conditional": conds,
+        # double-buffered forward: K and V each rotate p-1 times (the
+        # last rotation is elided); the naive scan rotated both p times
+        "expected_permutes": 2 * (p - 1),
+        "naive_scan_permutes": 2 * p,
+        # p-1 cond-guarded off-diagonal steps under causal
+        "expected_conditionals": p - 1,
+        "double_buffer_structure": permutes == 2 * (p - 1),
+        "causal_skip_structure": conds >= p - 1,
+    }
+    return {"parity": parity, "skip": skip, "hlo": hlo}
+
+
+def _run_baseline_child(args):
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu.core.flags import apply_xla_flags
+    apply_xla_flags()
+    from paddle_tpu.kernels.flash_attention import flash_attention
+
+    q, k, v = _child_inputs(args)
+
+    def loss(q, k, v):
+        o = flash_attention(q, k, v, causal=True)
+        return (o.astype(jnp.float32) ** 2).sum()
+
+    grad = jax.jit(jax.grad(loss, argnums=(0, 1, 2)))
+
+    def step(q, k, v):
+        dq, dk, dv = grad(q, k, v)
+        return dq[0, 0, 0, 0] + dk[0, 0, 0, 0] + dv[0, 0, 0, 0]
+
+    sec = _timed(step, (q, k, v), args.steps)
+    tokens = args.batch * args.seq
+    print(json.dumps({
+        "mode": "baseline", "seq": args.seq,
+        "step_s": round(sec, 4),
+        "tokens_s": round(tokens / sec, 1),
+        "peak_rss_mb": _peak_rss_mb(),
+    }))
+    return 0
+
+
+# ------------------------------------------------------------ parent
+
+def _spawn(mode, seq, args, extras=False):
+    env = dict(os.environ)
+    dev = args.devices if mode == "ring" else 1
+    flags = [t for t in env.get("XLA_FLAGS", "").split()
+             if "host_platform_device_count" not in t]
+    flags.append("--xla_force_host_platform_device_count=%d" % dev)
+    env["XLA_FLAGS"] = " ".join(flags)
+    env["JAX_PLATFORMS"] = "cpu"
+    steps = args.steps if seq < 65536 else max(1, args.steps // 2)
+    cmd = [sys.executable, os.path.abspath(__file__), "--child", mode,
+           "--seq", str(seq), "--devices", str(args.devices),
+           "--batch", str(args.batch), "--heads", str(args.heads),
+           "--head-dim", str(args.head_dim), "--steps", str(steps)]
+    if extras:
+        cmd.append("--extras")
+    timeout = float(os.environ.get(
+        "LONGCTX_CHILD_TIMEOUT", "240" if args.quick else "3600"))
+    t0 = time.time()
+    try:
+        proc = subprocess.run(cmd, capture_output=True, text=True,
+                              timeout=timeout, env=env, cwd=REPO)
+    except subprocess.TimeoutExpired:
+        return {"collapsed": True, "reason": "timeout",
+                "timeout_s": timeout}
+    if proc.returncode != 0:
+        return {"collapsed": True, "rc": proc.returncode,
+                "wall_s": round(time.time() - t0, 1),
+                "stderr_tail": proc.stderr[-400:]}
+    line = proc.stdout.strip().splitlines()[-1]
+    try:
+        return json.loads(line)
+    except ValueError:
+        return {"collapsed": True, "rc": 0,
+                "reason": "unparseable child output",
+                "stdout_tail": proc.stdout[-400:]}
+
+
+def _baseline_point(seq, args, budget):
+    est = (args.batch * args.heads * seq * seq * 4) * BASELINE_SLABS
+    if est > budget:
+        # the expected long-context story: the dense score block alone
+        # does not fit — record the OOM instead of taking the rig down
+        return {"oom_predicted": True, "estimated_bytes": est,
+                "budget_bytes": budget}
+    return _spawn("baseline", seq, args)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="ring vs dense-flash long-context bench "
+                    "(tokens/s + peak memory vs sequence length)")
+    ap.add_argument("--quick", action="store_true",
+                    help="seconds-long tier-1 smoke (2k/4k, small "
+                         "heads)")
+    ap.add_argument("--out", default="",
+                    help="also write the JSON artifact here")
+    ap.add_argument("--seqs", default="",
+                    help="comma-separated sequence lengths (default "
+                         "8192,32768,65536,131072; quick 2048,4096)")
+    ap.add_argument("--devices", type=int,
+                    default=int(os.environ.get("LONGCTX_DEVICES", "8")),
+                    help="ring width p (simulated host devices)")
+    ap.add_argument("--batch", type=int, default=1)
+    ap.add_argument("--heads", type=int, default=0,
+                    help="attention heads (default 2; the bench "
+                         "stresses the sequence axis, not d_model)")
+    ap.add_argument("--head-dim", type=int, default=0,
+                    help="head dim (default 64 full / 32 quick)")
+    ap.add_argument("--steps", type=int, default=0,
+                    help="timed steps per point (default 2; halved "
+                         "past 64k)")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="skip the dense baseline points")
+    ap.add_argument("--sentinel", action="store_true",
+                    help="gate this run against PERF_TRAJECTORY.json "
+                         "via tools/perf_sentinel.py (rc 3 on a >15%% "
+                         "regression vs the recorded floor).  ROADMAP: "
+                         "always pass this")
+    ap.add_argument("--json", action="store_true",
+                    help="pretty-print the artifact")
+    # child plumbing
+    ap.add_argument("--child", default="", choices=("", "ring",
+                                                    "baseline"))
+    ap.add_argument("--seq", type=int, default=0)
+    ap.add_argument("--extras", action="store_true")
+    args = ap.parse_args(argv)
+
+    args.heads = args.heads or 2
+    args.head_dim = args.head_dim or (32 if args.quick else 64)
+    args.steps = args.steps or 2
+
+    if args.child:
+        return (_run_ring_child(args) if args.child == "ring"
+                else _run_baseline_child(args))
+
+    seqs = tuple(int(s) for s in args.seqs.split(",") if s) or \
+        (QUICK_SEQS if args.quick else FULL_SEQS)
+    budget = _mem_budget_bytes()
+    points = []
+    extras = {}
+    for i, seq in enumerate(sorted(seqs)):
+        ring = _spawn("ring", seq, args, extras=(i == 0))
+        for key in ("parity", "skip", "hlo"):
+            if key in ring:
+                extras[key] = ring.pop(key)
+        point = {"seq": seq, "ring": ring}
+        if not args.no_baseline:
+            point["baseline"] = _baseline_point(seq, args, budget)
+            base = point["baseline"]
+            if ring.get("tokens_s") and base.get("tokens_s"):
+                point["ring_vs_baseline"] = round(
+                    ring["tokens_s"] / base["tokens_s"], 2)
+        points.append(point)
+        print("# %s" % json.dumps(point), file=sys.stderr)
+
+    ring_ok = all(not pt["ring"].get("collapsed") for pt in points)
+    # acceptance: at 64k the ring is >= 2x the baseline, or the
+    # baseline's OOM/collapse is on record
+    gate_seq = 65536
+    gate = None
+    for pt in points:
+        if pt["seq"] == gate_seq and "baseline" in pt:
+            base = pt["baseline"]
+            if base.get("oom_predicted") or base.get("collapsed"):
+                gate = {"seq": gate_seq, "baseline_oom": True,
+                        "ok": True}
+            else:
+                r = pt.get("ring_vs_baseline") or 0.0
+                gate = {"seq": gate_seq, "baseline_oom": False,
+                        "ring_vs_baseline": r, "ok": r >= 2.0}
+    out = {
+        "metric": "longctx_bench",
+        "quick": bool(args.quick),
+        "platform": os.environ.get("JAX_PLATFORMS", "cpu"),
+        "p": args.devices,
+        "dims": {"batch": args.batch, "heads": args.heads,
+                 "head_dim": args.head_dim, "dtype": "float32",
+                 "fwd_bwd": True},
+        "mem_budget_bytes": budget,
+        "points": points,
+        "ok": bool(
+            ring_ok
+            and extras.get("parity", {}).get("ok")
+            and extras.get("skip", {}).get("ok")
+            and extras.get("hlo", {}).get("double_buffer_structure")
+            and extras.get("hlo", {}).get("causal_skip_structure")
+            and (gate is None or gate["ok"])),
+    }
+    out.update(extras)
+    if gate is not None:
+        out["gate_64k"] = gate
+    line = json.dumps(out)
+    print(json.dumps(out, indent=2) if args.json else line)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(line + "\n")
+    rc = 0 if out["ok"] else 1
+    if rc or not args.sentinel:
+        return rc
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from perf_sentinel import sentinel_gate
+
+    return sentinel_gate(out)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

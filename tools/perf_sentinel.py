@@ -5,8 +5,8 @@ regresses.
 
 Every measured claim this repo makes lives in a disconnected JSON file
 — ``SERVE_BENCH.json``, ``PSERVER_BENCH.json``, ``SCALE_BENCH.json``,
-the driver-wrapped ``BENCH_r*.json`` training runs — and nothing
-compares across them.  This tool:
+``LONGCTX_BENCH.json``, the driver-wrapped ``BENCH_r*.json`` training
+runs — and nothing compares across them.  This tool:
 
 1. **ingests** every known artifact under ``--repo`` (plus optional
    Watchtower tsdb stores via ``--tsdb``) through per-shape extractors
@@ -102,6 +102,29 @@ def _extract_pserver(obj):
     return {k: v for k, v in out.items() if v is not None}
 
 
+def _extract_longctx(obj):
+    """tools/longctx_bench.py (ISSUE 15): per sequence length the ring
+    tokens/s (higher better) and peak RSS (lower better), plus the
+    64k ring-vs-baseline ratio when the baseline survived to be
+    measured."""
+    out = {}
+    for pt in obj.get("points") or []:
+        seq = pt.get("seq")
+        ring = pt.get("ring") or {}
+        if not seq or ring.get("collapsed"):
+            continue
+        if ring.get("tokens_s"):
+            out["longctx_ring_tokens_s_%dk" % (seq // 1024)] = _m(
+                ring["tokens_s"], True, "tok/s")
+        if ring.get("peak_rss_mb"):
+            out["longctx_ring_peak_rss_mb_%dk" % (seq // 1024)] = _m(
+                ring["peak_rss_mb"], False, "MB")
+        if pt.get("ring_vs_baseline"):
+            out["longctx_ring_vs_baseline_%dk" % (seq // 1024)] = _m(
+                pt["ring_vs_baseline"], True, "x")
+    return {k: v for k, v in out.items() if v is not None}
+
+
 def _extract_scale(obj):
     rows = [r.get("rows_per_sec")
             for r in (obj.get("sweep") or []) + (obj.get("variants")
@@ -165,6 +188,8 @@ def extract_metrics(obj):
         return _extract_pserver(obj), quick
     if kind == "scale_bench":
         return _extract_scale(obj), quick
+    if kind == "longctx_bench":
+        return _extract_longctx(obj), quick
     if isinstance(obj, dict) and kind and "value" in obj:
         # a bare bench.py headline line saved to a file
         return _extract_bench_lines(json.dumps(obj)), quick
@@ -183,7 +208,7 @@ def collect_repo(repo):
     runs = []
     paths = sorted(glob.glob(os.path.join(repo, "BENCH_r*.json")))
     for name in ("PSERVER_BENCH.json", "SERVE_BENCH.json",
-                 "SCALE_BENCH.json"):
+                 "SCALE_BENCH.json", "LONGCTX_BENCH.json"):
         p = os.path.join(repo, name)
         if os.path.exists(p):
             paths.append(p)

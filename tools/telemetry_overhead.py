@@ -507,6 +507,52 @@ def _measure_sanitizer_us(steps=None, repeats=3):
     return probe_ns, best["off"] * 1e6, best["buffers"] * 1e6
 
 
+RING_SITES_PER_STEP = 4
+
+
+def _measure_ring_us(steps=None, repeats=3):
+    """Ring-attention launch-site gate (ISSUE 15 satellite): the
+    ``pallas.ring_attention`` / ``pallas.ring_attention_bwd`` spans
+    fire at TRACE time (compile-cache-miss cadence) and their disabled
+    cost is the same one-attribute-read probe as every other launch
+    site — gated like the executor sites: probe x RING_SITES_PER_STEP
+    (fwd + bwd spans with slack) over the measured ring fwd+bwd step.
+    Returns the per-step wall (us) of a small ring training step on
+    however many host devices exist (the span count per step does not
+    depend on the mesh width)."""
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu.parallel import make_mesh
+    from paddle_tpu.parallel.ring import ring_attention
+
+    steps = steps or int(os.environ.get("RING_OVERHEAD_STEPS", "30"))
+    devs = jax.devices("cpu")
+    p = 4 if len(devs) >= 4 else len(devs)
+    mesh = make_mesh({"sp": p}, devices=devs[:p])
+    rng = np.random.RandomState(0)
+    # big enough that the step is a representative attention launch
+    # (at the tiniest shape the whole fwd+bwd is ~50us of dispatch and
+    # the conservative 4-site probe would read as >2% of nothing)
+    q, k, v = [jnp.asarray(rng.randn(1, 2, 128 * p, 32)
+                           .astype(np.float32)) for _ in range(3)]
+
+    grad = jax.jit(jax.grad(
+        lambda q, k, v: (ring_attention(q, k, v, mesh, causal=True)
+                         .astype(jnp.float32) ** 2).sum(),
+        argnums=(0, 1, 2)))
+    jax.block_until_ready(grad(q, k, v))
+    best = float("inf")
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            r = grad(q, k, v)
+        jax.block_until_ready(r)
+        best = min(best, (time.perf_counter() - t0) / steps)
+    return best * 1e6
+
+
 def record_gate_gauges(out):
     """Mirror every measured gate fraction into the always-on registry
     (gate name -> ``telemetry_gate_<name>`` gauge) and, when a
@@ -568,6 +614,9 @@ def main(argv=None):
     san_frac = (san_probe_ns * SANITIZER_SITES_PER_STEP / 1e3) \
         / san_off_us
     san_limit = float(os.environ.get("SANITIZER_OVERHEAD_MAX", "0.02"))
+    ring_us = _measure_ring_us()
+    ring_frac = (probe_ns * RING_SITES_PER_STEP / 1e3) / ring_us
+    ring_limit = float(os.environ.get("RING_OVERHEAD_MAX", "0.02"))
     out = {
         "step_us": round(step_us, 2),
         "probe_ns_per_site": round(probe_ns, 1),
@@ -627,13 +676,21 @@ def main(argv=None):
             max(0.0, san_buf_us - san_off_us) / san_off_us, 5),
         "sanitizer_overhead_frac": round(san_frac, 6),
         "sanitizer_limit": san_limit,
+        # ISSUE 15: ring-attention launch-site spans (trace-time, like
+        # every Pallas site) — probe x sites over the measured ring
+        # fwd+bwd step
+        "ring_step_us": round(ring_us, 2),
+        "ring_sites_per_step": RING_SITES_PER_STEP,
+        "ring_overhead_frac": round(ring_frac, 6),
+        "ring_limit": ring_limit,
         "ok": (frac < limit and num_frac < num_limit
                and serve_frac < serve_limit
                and gen_frac < gen_limit
                and ledger_frac < ledger_limit
                and tsdb_frac < tsdb_limit
                and slo_frac < slo_limit
-               and san_frac < san_limit),
+               and san_frac < san_limit
+               and ring_frac < ring_limit),
     }
     # gate name -> gauge (+ one tsdb sample when FLAGS_tsdb_dir is
     # set): the measured overheads become durable history, not just

@@ -82,6 +82,12 @@ ROLLUPS = (
      "rates, error budget remaining, alert counters per process — "
      "ISSUE 13); flight dumps written by a firing alert carry the "
      "offending series too"),
+    ("moe", "moe_rows", "format_moe_table",
+     "moe rollup (router steps/tokens / per-expert load / dropped "
+     "fraction / entropy per process):",
+     "print the MoE routing rollup (capacity-factor stats from "
+     "parallel/moe.py: per-expert load distribution, dropped-token "
+     "fraction, router entropy per process — ISSUE 15 rider)"),
 )
 
 
