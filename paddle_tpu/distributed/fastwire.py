@@ -97,7 +97,19 @@ METHODS = {"SendVariable": 1, "GetVariable": 2,
            # sharded-table row prefetch (distributed_lookup): tens of
            # MB of embedding rows per CTR step — bulk data, so it
            # belongs on the data plane with the scatters/gathers
-           "PrefetchVariable": 9}
+           "PrefetchVariable": 9,
+           # disaggregated serving fleet (paddle_tpu/serving/fleet.py):
+           # MigrateKV ships a finished prompt's KV pages from a
+           # prefill worker straight into a decode worker's BlockPool
+           # (block-table header + raw page payloads — bulk data, the
+           # serving tier's SendVariables); FleetCall is the fleet's
+           # control method (prefill/generate/wait/ping/drain/status
+           # as a json head).  Frame format: MIGRATION.md "MigrateKV
+           # wire contract".  An old peer that predates these methods
+           # closes the connection on the unknown kind byte (the
+           # raw-v1 behavior) — the sender falls back to carrying the
+           # request whole and re-prefilling at the destination.
+           "MigrateKV": 10, "FleetCall": 11}
 
 _lib = None
 _lib_tried = False

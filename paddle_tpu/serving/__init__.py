@@ -23,15 +23,20 @@ from __future__ import annotations
 
 from .batcher import set_metrics_enabled
 from .engine import ModelEngine, bucket_ladder
+from .fleet import (FleetEndpoint, FleetWorker, LocalTransport,
+                    SocketTransport)
 from .generative import GenerativeEngine, LMConfig, tiny_lm
 from .kv_cache import BlockPool
+from .router import FleetRouter, default_fleet_slos
 from .server import InferenceServer
 from .wire import PredictClient, RemoteError
 
-__all__ = ["BlockPool", "GenerativeEngine", "InferenceServer",
-           "LMConfig", "ModelEngine", "PredictClient", "RemoteError",
-           "bucket_ladder", "create_c_server", "set_metrics_enabled",
-           "tiny_lm"]
+__all__ = ["BlockPool", "FleetEndpoint", "FleetRouter", "FleetWorker",
+           "GenerativeEngine", "InferenceServer", "LMConfig",
+           "LocalTransport", "ModelEngine", "PredictClient",
+           "RemoteError", "SocketTransport", "bucket_ladder",
+           "create_c_server", "default_fleet_slos",
+           "set_metrics_enabled", "tiny_lm"]
 
 
 class _CServerHandle:

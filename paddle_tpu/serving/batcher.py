@@ -367,6 +367,13 @@ class TokenScheduler:
             req = queue.get(timeout=0)
             if req is None:
                 break
+            if req.blocks:
+                # migrated-in (serving/fleet.py MigrateKV): the pages
+                # already landed in blocks allocated by the receive
+                # path — admission is just batch membership, a second
+                # alloc here would leak the originals
+                admitted.append(req)
+                continue
             blocks = self.pool.alloc(self.pool.blocks_for(
                 len(req.prompt)))
             if blocks is None:

@@ -21,19 +21,29 @@ carries no lengths.
 from __future__ import annotations
 
 import json
+import random
 import socket
 import struct
 import threading
+import time
 
 import numpy as np
 
 from paddle_tpu.distributed.fastwire import MAGIC, METHODS
+from paddle_tpu.observability import metrics as _metrics
 
 __all__ = ["PredictEndpoint", "PredictClient", "RemoteError",
            "encode_request", "decode_request", "encode_reply",
            "decode_reply"]
 
 _PREDICT = METHODS["Predict"]
+
+# always-on (not gated by the serving _METRICS_ON switch): a client
+# quietly riding reconnects is exactly the failure telemetry must not
+# lose when someone turns request metrics off for overhead
+_M_CONN_FAIL = _metrics.counter(
+    "serve_conn_failures_total",
+    "PredictClient connection failures absorbed by reconnect+resend")
 
 
 class RemoteError(RuntimeError):
@@ -183,27 +193,73 @@ class PredictEndpoint:
 class PredictClient:
     """One connection, sequential predict() calls (not thread-safe —
     one client per thread, like a connection checked out of
-    FastConnPool)."""
+    FastConnPool).
 
-    def __init__(self, host, port, timeout=60.0):
-        self._sock = socket.create_connection((host, int(port)),
-                                              timeout=timeout)
-        self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-        self._sock.sendall(MAGIC)
-        if bytes(_recv_exact(self._sock, len(MAGIC))) != MAGIC:
-            self._sock.close()
-            raise ConnectionError("not a fastwire predict endpoint")
+    A connection death mid-request (ECONNRESET, broken pipe, a server
+    restart between calls) is absorbed, not surfaced: the client
+    reconnects with capped jittered exponential backoff and RESENDS the
+    whole request on the fresh connection.  Predict is read-only
+    against the model, so a resend after a torn reply at worst computes
+    the same answer twice — never a duplicated side effect.  Failures
+    count in ``serve_conn_failures_total`` (always-on registry);
+    ``max_attempts`` exhausted re-raises the last socket error."""
+
+    def __init__(self, host, port, timeout=60.0, max_attempts=4,
+                 base_backoff=0.05, max_backoff=2.0):
+        self._host, self._port = host, int(port)
+        self._timeout = timeout
+        self._max_attempts = max(1, int(max_attempts))
+        self._base_backoff = float(base_backoff)
+        self._max_backoff = float(max_backoff)
+        self._rng = random.Random()
+        self._sock = None
+        self._connect()
+
+    def _connect(self):
+        sock = socket.create_connection((self._host, self._port),
+                                        timeout=self._timeout)
+        try:
+            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            sock.sendall(MAGIC)
+            if bytes(_recv_exact(sock, len(MAGIC))) != MAGIC:
+                raise ConnectionError("not a fastwire predict endpoint")
+        except BaseException:
+            sock.close()
+            raise
+        self._sock = sock
 
     def predict(self, model, feed):
         payload = encode_request(model, feed)
-        self._sock.sendall(struct.pack("<BQ", _PREDICT, len(payload)))
-        self._sock.sendall(payload)
-        (ln,) = struct.unpack("<Q", _recv_exact(self._sock, 8))
-        outs = decode_reply(_recv_exact(self._sock, ln))
-        # own the buffers (the recv view wraps a reusable array)
-        return {k: np.array(v) for k, v in outs.items()}
+        attempt = 0
+        while True:
+            try:
+                if self._sock is None:
+                    self._connect()
+                self._sock.sendall(struct.pack("<BQ", _PREDICT,
+                                               len(payload)))
+                self._sock.sendall(payload)
+                (ln,) = struct.unpack("<Q", _recv_exact(self._sock, 8))
+                outs = decode_reply(_recv_exact(self._sock, ln))
+                # own the buffers (the recv view wraps a reusable array)
+                return {k: np.array(v) for k, v in outs.items()}
+            except RemoteError:
+                raise                   # the server ANSWERED; no resend
+            except OSError:
+                # covers ConnectionError/BrokenPipeError/timeouts; the
+                # connection is in an unknown framing state either way
+                _M_CONN_FAIL.inc()
+                self.close()
+                self._sock = None
+                attempt += 1
+                if attempt >= self._max_attempts:
+                    raise
+                span = min(self._max_backoff,
+                           self._base_backoff * (2 ** (attempt - 1)))
+                time.sleep(span * self._rng.uniform(0.5, 1.0))
 
     def close(self):
+        if self._sock is None:
+            return
         try:
             self._sock.close()
         except OSError:

@@ -1,0 +1,204 @@
+"""MigrateKV edge cases (ISSUE 16 satellite): BlockPool double-free of
+a migrated-away block, a migration racing an in-flight decode dispatch,
+partial-migration rollback (the destination frees its half-received
+pages and names the failure), migrate dedup, and end-to-end token
+parity between a migrated-in decode and a local generate."""
+import json
+import struct
+import time
+
+import pytest
+
+from paddle_tpu.core import sanitizer
+from paddle_tpu.core.flags import FLAGS
+from paddle_tpu.observability import metrics
+from paddle_tpu.serving.fleet import (FleetWorker, LocalTransport,
+                                      M_MIGRATE, decode_call,
+                                      encode_migrate)
+from paddle_tpu.serving.generative import tiny_lm
+
+CFG_KW = dict(vocab=64, d_model=32, n_heads=2, n_layers=2, d_ff=64,
+              block_size=8, max_blocks=8, max_batch=4)
+
+
+@pytest.fixture
+def buffers_on():
+    old = FLAGS.sanitizer
+    FLAGS.sanitizer = "buffers"
+    try:
+        yield
+    finally:
+        FLAGS.sanitizer = old
+
+
+def _pair(kv_blocks=24):
+    """One prefill + one decode worker over LocalTransport."""
+    cfg, params = tiny_lm(3, **CFG_KW)
+    tr = LocalTransport()
+    pw = FleetWorker("mp0", "prefill", cfg, params, kv_blocks=kv_blocks,
+                     warm=False, transport=tr)
+    dw = FleetWorker("md0", "decode", cfg, params, kv_blocks=kv_blocks,
+                     warm=False, transport=tr)
+    tr.register(pw)
+    tr.register(dw)
+    return tr, pw, dw
+
+
+def _migrate_frame(pw, rid, prompt, max_new=4, tear=False):
+    """Run a real prefill+export on ``pw`` and capture the MigrateKV
+    frame a prefill worker would send (optionally torn mid-payload).
+    The capture SWALLOWS the delivery — the destination never sees the
+    original frame, so the test controls first delivery itself."""
+    rep = None
+
+    calls = []
+    orig_call = pw.transport.call
+
+    def capture(addr, method, payload, timeout=None):
+        if method != M_MIGRATE:
+            return orig_call(addr, method, payload, timeout=timeout)
+        calls.append((method, b"".join(payload)
+                      if isinstance(payload, (list, tuple))
+                      else bytes(payload)))
+        from paddle_tpu.serving.fleet import encode_call
+        return encode_call({"ok": True, "dup": False, "blocks": [],
+                            "epoch": 1})
+
+    pw.transport.call = capture
+    try:
+        rep = pw._op_prefill({"op": "prefill", "dest": "local:md0",
+                              "req": {"id": rid, "prompt": prompt,
+                                      "max_new": max_new, "eos": None}})
+    finally:
+        pw.transport.call = orig_call
+    assert rep["ok"]
+    (method, frame), = calls
+    assert method == M_MIGRATE
+    if tear:
+        frame = frame[:len(frame) - len(frame) // 4]
+    return frame, rep
+
+
+def test_double_free_of_migrated_block(buffers_on):
+    """After a block set is migrated away and freed at the source, a
+    second free of the same ids (two owners both believing they
+    returned the pages) must raise the NAMED error and leave the free
+    list uncorrupted — the next alloc must not hand out duplicates."""
+    _, pw, _ = _pair()
+    pool = pw.engine.pool
+    blocks = pool.alloc(3)
+    pool.free(blocks)          # the migrated-away free (legitimate)
+    free0 = pool.free_blocks
+    with pytest.raises(sanitizer.BufferLifetimeError,
+                       match="kv_block"):
+        pool.free(blocks)      # the double free
+    assert pool.free_blocks == free0, "free list grew on a double free"
+    seen = pool.alloc(free0)
+    assert len(set(seen)) == free0, "duplicate ids after double free"
+    pool.free(seen)
+
+
+def test_migration_racing_inflight_dispatch(buffers_on):
+    """export_blocks while a decode dispatch holds the KV pool (donated
+    buffers in flight) must trip the epoch guard, not copy pages that
+    are being rewritten under it."""
+    _, pw, _ = _pair()
+    eng = pw.engine
+    blocks = eng.pool.alloc(2)
+    eng._kv_guard.begin("decode", step=7)     # a dispatch owns the pool
+    try:
+        with pytest.raises(sanitizer.BufferLifetimeError,
+                           match="dispatch in flight"):
+            eng.export_blocks(blocks)
+    finally:
+        eng._kv_guard.rebind()
+        eng.pool.free(blocks)
+    # quiesced: the same export now succeeds
+    blocks = eng.pool.alloc(2)
+    kp, vp, epoch = eng.export_blocks(blocks)
+    assert kp.shape[1] == 2 and vp.shape[1] == 2
+    eng.pool.free(blocks)
+
+
+def test_partial_migration_rollback():
+    """A MigrateKV frame torn mid-payload must (a) come back as a named
+    ok=false reply — BufferLifetimeError carrying kv_migration:<rid> —
+    and (b) free the destination's half-received blocks (rollback), so
+    a torn wire never strands pool capacity or serves garbage pages.
+    Named regardless of FLAGS_sanitizer: a torn frame is data loss."""
+    _, pw, dw = _pair()
+    trips0 = metrics.counter("sanitizer_trips_total").value
+    frame, _ = _migrate_frame(pw, "tear1", list(range(5, 17)),
+                              tear=True)
+    free0 = dw.engine.pool.free_blocks
+    rep = decode_call(dw.handle(M_MIGRATE, memoryview(frame)))
+    assert rep["ok"] is False
+    assert rep["kind"] == "BufferLifetimeError"
+    assert "kv_migration:tear1" in rep["error"]
+    assert "rolled back" in rep["error"]
+    assert dw.engine.pool.free_blocks == free0, \
+        "torn migration stranded destination blocks"
+    assert metrics.counter("sanitizer_trips_total").value == trips0 + 1
+    with dw._flock:
+        assert "tear1" not in dw._futures, \
+            "torn migration admitted a request"
+
+
+def test_migrate_dedup_and_parity():
+    """The same migration delivered twice (hedge/retry replay) installs
+    once — the second reply is dup=true and allocates nothing — and the
+    migrated-in decode finishes with tokens bit-identical to a local
+    generate of the same request."""
+    _, pw, dw = _pair()
+    prompt = [3, 9, 27, 17, 50, 8, 8, 1, 40]
+    frame, prep = _migrate_frame(pw, "dup1", prompt, max_new=6)
+    rep1 = decode_call(dw.handle(M_MIGRATE, memoryview(frame)))
+    assert rep1["ok"] and not rep1["dup"]
+    # the epoch handshake: the reply carries the destination guard's
+    # post-install epoch (0 while the sanitizer is off — rebind only
+    # advances the counter when FLAGS_sanitizer=buffers)
+    assert rep1["epoch"] == dw.engine._kv_guard.epoch
+    dups0 = metrics.counter("fleet_migration_dups_total").value
+    rep2 = decode_call(dw.handle(M_MIGRATE, memoryview(frame)))
+    assert rep2["ok"] and rep2["dup"]
+    assert metrics.counter("fleet_migration_dups_total").value \
+        == dups0 + 1
+    got = dw._op_wait({"id": "dup1", "timeout": 120.0})
+    assert got["done"]
+    migrated_tokens = got["result"]["tokens"]
+    assert migrated_tokens[0] == prep["first"]
+    # reference: the same request decoded wholly on the decode worker
+    dw._op_generate({"op": "generate",
+                     "req": {"id": "ref1", "prompt": prompt,
+                             "max_new": 6, "eos": None}})
+    ref = dw._op_wait({"id": "ref1", "timeout": 120.0})
+    assert ref["done"]
+    assert migrated_tokens == ref["result"]["tokens"], \
+        "migrated-in decode diverged from local generate"
+    # both requests done: every migrated/generated block went home
+    for _ in range(200):
+        if dw.engine.pool.used_blocks == 0:
+            break
+        time.sleep(0.01)
+    assert dw.engine.pool.used_blocks == 0
+    dw.shutdown()
+    pw.shutdown()
+
+
+def test_migrate_geometry_mismatch_rejected():
+    """A frame whose kv header disagrees with the destination engine's
+    geometry is refused before any allocation (same-checkpoint fleets
+    are an operator invariant; silent reshape would be garbage)."""
+    _, pw, dw = _pair()
+    frame, _ = _migrate_frame(pw, "geo1", list(range(9)))
+    view = memoryview(bytes(frame))
+    (hlen,) = struct.unpack("<I", view[:4])
+    head = json.loads(bytes(view[4:4 + hlen]).decode())
+    head["kv"]["n_heads"] = 5
+    free0 = dw.engine.pool.free_blocks
+    bad = encode_migrate(head, b"", b"")
+    rep = decode_call(dw.handle(
+        M_MIGRATE, memoryview(b"".join(bad) + bytes(view[4 + hlen:]))))
+    assert rep["ok"] is False and rep["kind"] == "ValueError"
+    assert "geometry" in rep["error"]
+    assert dw.engine.pool.free_blocks == free0

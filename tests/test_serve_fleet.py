@@ -1,0 +1,272 @@
+"""Disaggregated serving fleet (ISSUE 16): router placement logic,
+request-id dedup, graceful drain, PredictClient reconnect-and-resend,
+and the serve_fleet_bench --quick smoke — the tier-1 end-to-end drill
+(Poisson load, a simulated mid-run worker kill with zero lost requests
+and token parity, a torn migration named and rolled back)."""
+import json
+import os
+import socket
+import struct
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from paddle_tpu.distributed.fastwire import MAGIC
+from paddle_tpu.observability import metrics
+from paddle_tpu.serving.fleet import FleetWorker, LocalTransport
+from paddle_tpu.serving.generative import tiny_lm
+from paddle_tpu.serving.router import FleetRouter, _Member, \
+    default_fleet_slos
+from paddle_tpu.serving.wire import PredictClient, encode_reply
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+CFG_KW = dict(vocab=64, d_model=32, n_heads=2, n_layers=2, d_ff=64,
+              block_size=8, max_blocks=8, max_batch=4)
+
+
+def _fleet(specs, kv_blocks=24):
+    cfg, params = tiny_lm(3, **CFG_KW)
+    tr = LocalTransport()
+    workers = [FleetWorker(n, r, cfg, params, kv_blocks=kv_blocks,
+                           warm=False, transport=tr) for n, r in specs]
+    for w in workers:
+        tr.register(w)
+    return tr, workers
+
+
+# ------------------------------------------------------- placement
+
+def test_prefix_affinity_minimal_remap():
+    """Rendezvous hashing over the token-id prefix: the same prefix
+    always lands on the same prefill worker, and removing one member
+    only remaps THAT member's share — every other key keeps its
+    placement (no full-keyspace reshuffle on an eviction)."""
+    members = [_Member("p%d" % i, "addr%d" % i, "prefill")
+               for i in range(4)]
+    keys = [",".join(str((7 * i + j) % 64) for j in range(8))
+            for i in range(200)]
+    place = {k: FleetRouter._rendezvous(k, members).name for k in keys}
+    # deterministic
+    assert place == {k: FleetRouter._rendezvous(k, members).name
+                     for k in keys}
+    survivors = members[:2] + members[3:]           # p2 evicted
+    moved = 0
+    for k in keys:
+        now = FleetRouter._rendezvous(k, survivors).name
+        if place[k] == "p2":
+            assert now != "p2"
+            moved += 1
+        else:
+            assert now == place[k], \
+                "key not owned by the dead worker was remapped"
+    assert moved > 0
+
+
+def test_default_fleet_slos_spec():
+    spec = default_fleet_slos(["d0", "d1"], ttft_p99_ms=1500.0)
+    assert "serve_fleet_availability >= 1" in spec
+    assert "fleet_ttft_ms_d0.p99 <= 1500" in spec
+    assert "fleet_ttft_ms_d1.p99 <= 1500" in spec
+
+
+# ------------------------------------------------- router behavior
+
+def test_request_id_dedup_and_exactly_once():
+    """The same req_id submitted twice returns the SAME future (one
+    generation), and a fleet round-trip resolves it exactly once."""
+    tr, workers = _fleet([("p0", "prefill"), ("d0", "decode")])
+    router = FleetRouter(tr, [(w.name, "local:%s" % w.name, w.role)
+                              for w in workers],
+                         lease_s=5.0, lease_interval_s=1.0,
+                         deadline_s=60.0)
+    try:
+        f1 = router.generate([5, 6, 7], 4, req_id="same")
+        f2 = router.generate([5, 6, 7], 4, req_id="same")
+        assert f1 is f2
+        res = f1.result(timeout=120)
+        assert len(res["tokens"]) == 4
+        assert res["req_id"] == "same"
+        assert metrics.counter("fleet_migrations_total").value >= 1
+    finally:
+        router.close()
+        for w in workers:
+            w.shutdown()
+
+
+def test_validation_error_not_retried():
+    """A non-retryable remote error (prompt token outside the vocab)
+    surfaces immediately as FleetRemoteError — no burn of the attempt
+    budget re-trying a request that can never succeed."""
+    from paddle_tpu.serving.fleet import FleetRemoteError
+
+    tr, workers = _fleet([("p0", "prefill"), ("d0", "decode")])
+    router = FleetRouter(tr, [(w.name, "local:%s" % w.name, w.role)
+                              for w in workers],
+                         lease_s=5.0, lease_interval_s=1.0,
+                         deadline_s=60.0)
+    try:
+        fut = router.generate([2, 999], 4, req_id="bad")
+        with pytest.raises(FleetRemoteError, match="vocab"):
+            fut.result(timeout=60)
+        rec = router._recs["bad"]
+        assert rec.attempts == 1, "validation error was retried"
+    finally:
+        router.close()
+        for w in workers:
+            w.shutdown()
+
+
+def test_graceful_drain_stops_admission():
+    """drain() removes the worker from routing and the worker refuses
+    new admissions while reporting drained once quiet; requests after
+    the drain run entirely on the survivor."""
+    tr, workers = _fleet([("p0", "prefill"), ("d0", "decode"),
+                          ("d1", "decode")])
+    router = FleetRouter(tr, [(w.name, "local:%s" % w.name, w.role)
+                              for w in workers],
+                         lease_s=5.0, lease_interval_s=1.0,
+                         deadline_s=60.0)
+    try:
+        ack = router.drain("d1", timeout=10.0)
+        assert ack["drained"] is True
+        # the drained worker refuses new admissions by name
+        from paddle_tpu.serving.fleet import (M_CALL, decode_call,
+                                              encode_call)
+        rep = decode_call(workers[2].handle(M_CALL, memoryview(
+            encode_call({"op": "generate",
+                         "req": {"id": "x", "prompt": [1, 2],
+                                 "max_new": 2, "eos": None}}))))
+        assert rep["ok"] is False and rep["kind"] == "Draining"
+        res = router.generate([4, 4, 4], 3, req_id="after").result(120)
+        assert res["worker"] == "d0"
+        assert len(res["tokens"]) == 3
+    finally:
+        router.close()
+        for w in workers:
+            w.shutdown()
+
+
+# -------------------------------------------- wire reconnect rider
+
+class _FlakyPredictServer:
+    """Minimal fastwire Predict peer that DROPS the first connection
+    right after reading a full request (torn reply), then serves
+    subsequent connections properly — the reconnect-and-resend
+    scenario a rolling server restart produces."""
+
+    def __init__(self, drop_first=1):
+        self._drop = drop_first
+        self.requests = 0
+        self._sock = socket.socket()
+        self._sock.bind(("127.0.0.1", 0))
+        self._sock.listen(8)
+        self.port = self._sock.getsockname()[1]
+        threading.Thread(target=self._serve, daemon=True).start()
+
+    def _recv(self, conn, n):
+        buf = b""
+        while len(buf) < n:
+            chunk = conn.recv(n - len(buf))
+            if not chunk:
+                raise ConnectionError("closed")
+            buf += chunk
+        return buf
+
+    def _serve(self):
+        while True:
+            try:
+                conn, _ = self._sock.accept()
+            except OSError:
+                return
+            try:
+                assert self._recv(conn, len(MAGIC)) == MAGIC
+                conn.sendall(MAGIC)
+                while True:
+                    _, ln = struct.unpack(
+                        "<BQ", self._recv(conn, 9))
+                    self._recv(conn, ln)
+                    self.requests += 1
+                    if self._drop > 0:
+                        self._drop -= 1
+                        break            # close with no reply: torn
+                    reply = encode_reply(
+                        outputs={"y": np.arange(3, dtype=np.float32)})
+                    conn.sendall(struct.pack("<Q", len(reply)) + reply)
+            except (ConnectionError, OSError, AssertionError):
+                pass
+            finally:
+                conn.close()
+
+    def close(self):
+        self._sock.close()
+
+
+def test_predict_client_reconnects_and_resends():
+    """A connection death mid-request is absorbed: the client backs
+    off, reconnects, RESENDS, and the failure lands in the always-on
+    serve_conn_failures_total counter."""
+    srv = _FlakyPredictServer(drop_first=1)
+    fails0 = metrics.counter("serve_conn_failures_total").value
+    client = PredictClient("127.0.0.1", srv.port, timeout=10.0,
+                           base_backoff=0.01, max_backoff=0.05)
+    try:
+        out = client.predict("m", {"x": np.zeros(2, np.float32)})
+        assert list(out["y"]) == [0.0, 1.0, 2.0]
+        assert srv.requests == 2, "request was not resent"
+        assert metrics.counter(
+            "serve_conn_failures_total").value == fails0 + 1
+    finally:
+        client.close()
+        srv.close()
+
+
+def test_predict_client_exhausts_attempts():
+    """Every attempt torn -> the last socket error surfaces after
+    max_attempts, with each failure counted."""
+    srv = _FlakyPredictServer(drop_first=99)
+    fails0 = metrics.counter("serve_conn_failures_total").value
+    client = PredictClient("127.0.0.1", srv.port, timeout=10.0,
+                           max_attempts=3, base_backoff=0.01,
+                           max_backoff=0.02)
+    try:
+        with pytest.raises(OSError):
+            client.predict("m", {"x": np.zeros(2, np.float32)})
+        assert metrics.counter(
+            "serve_conn_failures_total").value == fails0 + 3
+    finally:
+        client.close()
+        srv.close()
+
+
+# ------------------------------------------------------------ bench
+
+def test_serve_fleet_bench_quick_smoke():
+    """tools/serve_fleet_bench.py --quick must PASS outright (rc 0):
+    in-process fleet, Poisson load with zero lost requests, a mid-run
+    simulated kill survived with token parity + an eviction artifact +
+    the availability burn alert, and a torn migration named and rolled
+    back (ISSUE 16 tier-1 gate)."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.run(
+        [sys.executable,
+         os.path.join(REPO, "tools", "serve_fleet_bench.py"),
+         "--quick"],
+        capture_output=True, text=True, timeout=560, env=env, cwd=REPO)
+    assert proc.returncode == 0, (proc.stdout[-2000:],
+                                  proc.stderr[-2000:])
+    rec = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert rec["metric"] == "serve_fleet_bench"
+    assert rec["ok"] is True
+    assert rec["kill"]["lost"] == 0
+    assert rec["kill"]["parity"] is True
+    assert rec["kill"]["evictions"] >= 1
+    assert rec["kill"]["artifacts"], "eviction left no flight artifact"
+    assert rec["slo"]["availability_alert"] is True
+    assert rec["torn"]["ok"] is True
+    assert rec["migrations"] > 0

@@ -67,6 +67,12 @@ PRESETS = {
     # alert fires and its flight dump names the violated SLO and
     # embeds the offending series — run_slo_preset()
     "slo": "serve_dispatch:delay:0.02",
+    # Disaggregated serving fleet (ISSUE 16): SIGKILL one decode AND
+    # one prefill worker in the middle of the fleet bench's kill drill
+    # and FAIL unless ZERO requests were lost (tokens bit-identical to
+    # the unkilled baseline) and EACH eviction left a flight artifact
+    # naming the dead worker — run_serve_fleet_preset()
+    "serve_fleet": "",
     # Sanitizer suite (ISSUE 14): plant a use-after-donate (direct
     # host read of a donated param mid-prepared-loop) and a lock-order
     # inversion under FLAGS_sanitizer=all, and FAIL unless both leave
@@ -229,6 +235,61 @@ def run_slo_preset(spec, pytest_args):
     return rc, time.time() - t0, dump_dir, matched
 
 
+def run_serve_fleet_preset():
+    """The 'serve_fleet' preset is a kill-survival drill, not a fault
+    sweep: tools/serve_fleet_bench.py spawns real prefill/decode worker
+    processes, replays one Poisson schedule twice, and SIGKILLs one
+    decode AND one prefill worker mid-run (--kill both).  This runner
+    FAILs (rc 3) unless the killed run lost ZERO requests, its greedy
+    tokens are bit-identical to the unkilled baseline, and EVERY
+    eviction left a flight_*.json naming the dead worker — a fleet
+    that survives a kill but can't say who died is a FAIL."""
+    import json
+
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    dump_dir = tempfile.mkdtemp(prefix="fault_fleet_dump_")
+    env["FLAGS_telemetry_dump_dir"] = dump_dir
+    out_json = os.path.join(dump_dir, "fleet.json")
+    cmd = [sys.executable, "tools/serve_fleet_bench.py",
+           "--kill", "both", "--replicas", "3", "--prefill-workers",
+           "2", "--seconds", "8", "--floor-seconds", "3",
+           "--burst-seconds", "6", "--kill-seconds", "10",
+           "--out", out_json]
+    t0 = time.time()
+    proc = subprocess.run(cmd, cwd=REPO, env=env,
+                          stdout=subprocess.DEVNULL)
+    rc, matched = 0, 0
+    try:
+        with open(out_json) as f:
+            out = json.load(f)
+        kill = out.get("kill") or {}
+        victims = kill.get("victims") or []
+        artifacts = kill.get("artifacts") or {}
+        matched = sum(1 for v in victims if artifacts.get(v))
+        survived = (kill.get("lost") == 0 and kill.get("parity")
+                    and len(victims) >= 2
+                    and matched == len(victims))
+        if not survived:
+            print("preset 'serve_fleet': kill drill not survived "
+                  "cleanly (lost=%r parity=%r victims=%r artifacts "
+                  "naming the dead: %d/%d) under %s"
+                  % (kill.get("lost"), kill.get("parity"), victims,
+                     matched, len(victims), dump_dir), file=sys.stderr)
+            rc = 3
+    except Exception as e:
+        print("preset 'serve_fleet': bench produced no parseable "
+              "result (%s; bench rc=%d); artifacts kept at %s"
+              % (e, proc.returncode, dump_dir), file=sys.stderr)
+        rc = 3
+    if rc == 0:
+        shutil.rmtree(dump_dir, ignore_errors=True)
+    else:
+        print("preset 'serve_fleet' FAILED (rc=%d); artifacts kept "
+              "at %s" % (rc, dump_dir), file=sys.stderr)
+    return rc, time.time() - t0, dump_dir, matched
+
+
 def run_sanitizer_preset(pytest_args):
     """The 'sanitizer' preset is a named-artifact drill, not a fault
     sweep: tests/test_sanitizer.py's fault plants run with
@@ -377,6 +438,10 @@ def main(argv=None):
         if name == "sanitizer":
             rc, secs, dump_dir, n_dumps = run_sanitizer_preset(
                 pytest_args)
+            rows.append((name, rc, secs, n_dumps))
+            continue
+        if name == "serve_fleet":
+            rc, secs, dump_dir, n_dumps = run_serve_fleet_preset()
             rows.append((name, rc, secs, n_dumps))
             continue
         rc, secs, dump_dir, n_dumps = run_preset(name, spec, args.seed,

@@ -125,6 +125,25 @@ def _extract_longctx(obj):
     return {k: v for k, v in out.items() if v is not None}
 
 
+def _extract_fleet(obj):
+    """tools/serve_fleet_bench.py (ISSUE 16): solo-process floor,
+    aggregate fleet tok/s under open-loop Poisson, the scaling
+    multiple itself, and the kill drill's TTFT recovery (lower
+    better — how fast the router heals after a SIGKILL)."""
+    out = {
+        "fleet_gen_floor_tokens_s": _m(
+            _get(obj, "floor", "tokens_s"), True, "tok/s"),
+        "fleet_poisson_tokens_s": _m(
+            _get(obj, "scale", "tokens_s") if not obj.get("quick")
+            else _get(obj, "poisson", "tokens_s"), True, "tok/s"),
+        "fleet_scaling_x": _m(_get(obj, "scale", "scaling_x"), True,
+                              "x"),
+        "fleet_kill_ttft_recovery_s": _m(
+            _get(obj, "kill", "ttft_recovery_s"), False, "s"),
+    }
+    return {k: v for k, v in out.items() if v is not None}
+
+
 def _extract_scale(obj):
     rows = [r.get("rows_per_sec")
             for r in (obj.get("sweep") or []) + (obj.get("variants")
@@ -190,6 +209,8 @@ def extract_metrics(obj):
         return _extract_scale(obj), quick
     if kind == "longctx_bench":
         return _extract_longctx(obj), quick
+    if kind == "serve_fleet_bench":
+        return _extract_fleet(obj), quick
     if isinstance(obj, dict) and kind and "value" in obj:
         # a bare bench.py headline line saved to a file
         return _extract_bench_lines(json.dumps(obj)), quick
@@ -208,7 +229,8 @@ def collect_repo(repo):
     runs = []
     paths = sorted(glob.glob(os.path.join(repo, "BENCH_r*.json")))
     for name in ("PSERVER_BENCH.json", "SERVE_BENCH.json",
-                 "SCALE_BENCH.json", "LONGCTX_BENCH.json"):
+                 "SCALE_BENCH.json", "LONGCTX_BENCH.json",
+                 "SERVE_FLEET_BENCH.json"):
         p = os.path.join(repo, name)
         if os.path.exists(p):
             paths.append(p)

