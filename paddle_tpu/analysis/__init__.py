@@ -19,16 +19,18 @@ from __future__ import annotations
 
 import warnings
 
-from .checkers import (CHECKERS, register_checker, run_checkers,
-                       verify_transpiled_pair)
+from .checkers import (CHECKERS, SOURCE_CHECKERS, register_checker,
+                       register_source_checker, run_checkers,
+                       run_source_checkers, verify_transpiled_pair)
 from .defuse import DefUse, sub_block_indices
 from .diagnostics import (Diagnostic, ProgramVerificationError, Severity,
                           format_diagnostics, max_severity)
 
 __all__ = [
     "CHECKERS", "DefUse", "Diagnostic", "ProgramLintWarning",
-    "ProgramVerificationError", "Severity", "enforce",
+    "ProgramVerificationError", "SOURCE_CHECKERS", "Severity", "enforce",
     "format_diagnostics", "max_severity", "register_checker",
+    "register_source_checker", "run_source_checkers",
     "sub_block_indices", "verify_and_enforce", "verify_program",
     "verify_transpiled_pair",
 ]

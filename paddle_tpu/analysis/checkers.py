@@ -19,8 +19,9 @@ from .diagnostics import Diagnostic, Severity
 from .lifetime import check_block_lifetime
 from .shapes import check_block_shapes
 
-__all__ = ["CHECKERS", "register_checker", "run_checkers",
-           "verify_transpiled_pair"]
+__all__ = ["CHECKERS", "SOURCE_CHECKERS", "register_checker",
+           "register_source_checker", "run_checkers",
+           "run_source_checkers", "verify_transpiled_pair"]
 
 CHECKERS = collections.OrderedDict()
 
@@ -616,4 +617,166 @@ def check_lifetime(du):
     diags = []
     for bi in range(len(du.program.blocks)):
         diags.extend(check_block_lifetime(du, bi))
+    return diags
+
+
+# ---------------------------------------------------------------------------
+# Source checkers: AST lints over the repo's OWN Python (not a
+# ProgramDesc).  Registered separately because their input is a file
+# path, not a DefUse; tools/lint_program.py --scan-sources runs them.
+# ---------------------------------------------------------------------------
+
+SOURCE_CHECKERS = collections.OrderedDict()
+
+
+def register_source_checker(name):
+    """Register ``fn(relpath, tree, source) -> iterable[Diagnostic]``
+    under ``name``; ``relpath`` is repo-relative, ``tree`` the parsed
+    ast.Module, ``source`` the raw text (for pragma scans)."""
+
+    def deco(fn):
+        if name in SOURCE_CHECKERS:
+            raise ValueError("source checker %r already registered"
+                             % name)
+        SOURCE_CHECKERS[name] = fn
+        return fn
+
+    return deco
+
+
+def run_source_checkers(paths, root=None, checkers=None):
+    """Run source checkers over ``paths`` (files or directories —
+    directories are walked for ``.py``).  Returns diagnostics; files
+    that fail to parse produce one ERROR diagnostic each."""
+    import ast
+    import os
+
+    names = list(checkers) if checkers is not None \
+        else list(SOURCE_CHECKERS)
+    files = []
+    for p in paths:
+        if os.path.isdir(p):
+            for dirpath, _dirnames, filenames in os.walk(p):
+                files.extend(os.path.join(dirpath, f)
+                             for f in sorted(filenames)
+                             if f.endswith(".py"))
+        else:
+            files.append(p)
+    diags = []
+    for path in files:
+        rel = os.path.relpath(path, root) if root else path
+        rel = rel.replace(os.sep, "/")
+        try:
+            with open(path) as f:
+                source = f.read()
+            tree = ast.parse(source, filename=path)
+        except (OSError, SyntaxError) as e:
+            diags.append(Diagnostic(
+                "source", Severity.ERROR,
+                "cannot parse %s: %s" % (rel, e), var=rel))
+            continue
+        for name in names:
+            try:
+                fn = SOURCE_CHECKERS[name]
+            except KeyError:
+                raise KeyError(
+                    "unknown source checker %r (registered: %s)"
+                    % (name, ", ".join(SOURCE_CHECKERS)))
+            diags.extend(fn(rel, tree, source))
+    return diags
+
+
+# raw threading primitives allowed in the interception-mandatory
+# planes: registry/bookkeeping locks deliberately OUTSIDE the sanitizer
+# (the sanitizer must not sanitize itself; process-lifetime registries
+# self-heal and are never part of a modeled protocol).  Entries are
+# "path-suffix::variable" as assigned.  An inline ``# rawlock: ok``
+# comment on the construction line is the per-site escape hatch.
+RAWLOCK_ALLOWLIST = frozenset({
+    "serving/kv_cache.py::_LIVE_LOCK",      # module gauge registry
+})
+
+_RAWLOCK_SCOPES = ("paddle_tpu/distributed/", "paddle_tpu/serving/")
+_RAWLOCK_CTORS = {"Lock": "make_lock", "RLock": "make_lock",
+                  "Condition": "make_condition", "Event": "make_event"}
+
+
+@register_source_checker("rawlock")
+def check_rawlock(relpath, tree, source):
+    """Flag raw ``threading.Lock()/RLock()/Condition()/Event()``
+    construction in ``distributed/`` and ``serving/`` modules: those
+    planes must build sync primitives through core.sanitizer
+    (make_lock/make_event/make_condition) so the lock-discipline
+    sanitizer and the Weaver schedule explorer keep their interception
+    points.  Allowlisted names (RAWLOCK_ALLOWLIST) and lines carrying
+    ``# rawlock: ok`` are exempt."""
+    import ast
+
+    if not any(s in relpath for s in _RAWLOCK_SCOPES):
+        return []
+    lines = source.splitlines()
+    # names bound by `from threading import Lock, ...`
+    imported = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom) and node.module == "threading":
+            for alias in node.names:
+                if alias.name in _RAWLOCK_CTORS:
+                    imported.add(alias.asname or alias.name)
+
+    def ctor_of(call):
+        f = call.func
+        if isinstance(f, ast.Attribute) and \
+                isinstance(f.value, ast.Name) and \
+                f.value.id == "threading" and f.attr in _RAWLOCK_CTORS:
+            return f.attr
+        if isinstance(f, ast.Name) and f.id in imported:
+            return f.id
+        return None
+
+    def target_name(parents, call):
+        # nearest enclosing assignment target, for the allowlist key
+        assign = parents.get(id(call))
+        while assign is not None and not isinstance(assign, ast.Assign):
+            assign = parents.get(id(assign))
+        if assign is not None and assign.targets:
+            t = assign.targets[0]
+            if isinstance(t, ast.Name):
+                return t.id
+            if isinstance(t, ast.Attribute):
+                return t.attr
+        return None
+
+    parents = {}
+    for node in ast.walk(tree):
+        for child in ast.iter_child_nodes(node):
+            parents[id(child)] = node
+
+    diags = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        ctor = ctor_of(node)
+        if ctor is None:
+            continue
+        line = lines[node.lineno - 1] if node.lineno <= len(lines) else ""
+        if "rawlock: ok" in line:
+            continue
+        name = target_name(parents, node)
+        key = "%s::%s" % ("/".join(relpath.split("/")[-2:]), name)
+        if any(key.endswith(a.split("::")[0] + "::" + a.split("::")[1])
+               or (a.split("::")[0] in relpath
+                   and a.split("::")[1] == name)
+               for a in RAWLOCK_ALLOWLIST):
+            continue
+        diags.append(Diagnostic(
+            "rawlock", Severity.ERROR,
+            "%s:%d constructs threading.%s() directly — the "
+            "distributed/serving planes must use core.sanitizer.%s so "
+            "the lock sanitizer and the Weaver explorer keep their "
+            "interception points" % (relpath, node.lineno, ctor,
+                                     _RAWLOCK_CTORS[ctor]),
+            var="%s:%d" % (relpath, node.lineno),
+            suggestion="use _san.%s(name) (or add '# rawlock: ok' / "
+                       "an RAWLOCK_ALLOWLIST entry for a registry "
+                       "lock)" % _RAWLOCK_CTORS[ctor]))
     return diags

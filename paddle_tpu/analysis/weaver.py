@@ -1,0 +1,1083 @@
+"""Weaver: a deterministic-schedule concurrency explorer (ISSUE 18
+tentpole) — CHESS-style systematic testing (Musuvathi et al., OSDI '08)
+with sleep-set pruning in the DPOR lineage (Flanagan & Godefroid,
+POPL '05) over the repo's four load-bearing protocols.
+
+PR 14's runtime sanitizers catch the recurring race classes only when
+the wild scheduler happens to produce the bad interleaving; Weaver
+*owns* the scheduler instead.  A scenario's threads are real Python
+threads, but a cooperative control loop serializes them: at every
+synchronization operation (``make_lock`` acquire/release,
+``make_event`` wait/set, ``make_condition`` wait/notify, and explicit
+``sanitizer.weaver_yield`` points on queue/wire boundaries) the running
+task parks and the scheduler picks the next runnable task.  Because the
+scheduler makes every interleaving decision, a schedule IS its decision
+trace — a list of indices into the enabled set — and can be
+
+- **enumerated**: DFS over the schedule tree at small scope (2-3
+  tasks, 1-2 rounds), with sleep-set-style sibling pruning: an
+  unexplored sibling whose pending transition commutes with every
+  previously explored sibling at that node (different task, different
+  sync object) reaches only states the explored branches already
+  cover, and is skipped;
+- **sampled**: a seeded random walk for scopes too large to exhaust;
+- **replayed**: the same trace re-executes bit-deterministically
+  (timeouts are virtual — a timed wait is just one more scheduling
+  decision, never a wall-clock sleep);
+- **minimized**: delta-debugging over the trace (shortest failing
+  prefix, then non-default decisions reverted to the default choice)
+  yields the smallest schedule that still fails.
+
+A failing schedule is written as a ``weaver_<scenario>_<n>.json``
+artifact naming the racing sites; ``tools/weaver.py --replay`` re-runs
+it.  Each historical race class (PR 10 k-stale read, PR 14 BlockPool
+double-free, PR 16 dup-migration, the router exactly-once contract) is
+re-introduced behind ``plant=`` and must be found by exploration while
+HEAD explores clean — the regression tests pin the minimized traces.
+
+Interception contract: under ``FLAGS_sanitizer=weaver`` the sanitizer
+constructors return Weaver primitives *when a run is active*; a thread
+that is not a registered Weaver task (the control thread in scenario
+setup/teardown, background pytest machinery) degrades to a plain
+fallback primitive, so the mode can never capture foreign threads.
+Off-path cost of the hook is one module-attribute read, gated by
+tools/telemetry_overhead.py like every sanitizer.
+"""
+from __future__ import annotations
+
+import collections
+import json
+import os
+import sys
+import threading
+
+from paddle_tpu.core import sanitizer as _san
+from paddle_tpu.core.flags import FLAGS
+
+__all__ = [
+    "DeadlockError", "ExploreStats", "RunRecord", "SCENARIOS",
+    "WeaverCondition", "WeaverEvent", "WeaverLock", "current_task",
+    "explore", "list_scenarios", "maybe_yield", "minimize",
+    "next_artifact_path", "replay_artifact", "run_schedule",
+    "weaver_condition", "weaver_event", "weaver_lock", "write_artifact",
+]
+
+# Deep scenario state (every sync op is ~1 decision) is a bug, not a
+# workload: runs past this many decisions are truncated and flagged.
+DEFAULT_MAX_DECISIONS = 400
+
+# CHESS's result: almost every concurrency bug manifests within a small
+# number of PREEMPTIONS (switching away from a still-runnable task);
+# bounding them makes exhaustive enumeration polynomial while keeping
+# the bug-finding power.  Switching off a blocked/finished task is a
+# forced switch and never counts.
+DEFAULT_PREEMPTION_BOUND = 3
+
+
+def _metrics():
+    from paddle_tpu.observability import metrics
+    return metrics
+
+
+def _m_explored():
+    return _metrics().counter(
+        "weaver_schedules_explored_total",
+        "schedules executed by the weaver explorer (dfs + random)")
+
+
+def _m_pruned():
+    return _metrics().counter(
+        "weaver_schedules_pruned_total",
+        "sibling branches skipped by sleep-set pruning (commuting "
+        "transitions already covered by an explored branch)")
+
+
+def _m_failures():
+    return _metrics().counter(
+        "weaver_failures_total",
+        "failing schedules found by the weaver explorer")
+
+
+def _m_minlen():
+    return _metrics().gauge(
+        "weaver_minimized_trace_len",
+        "decision-trace length of the most recently minimized failing "
+        "schedule")
+
+
+class DeadlockError(RuntimeError):
+    """Every live task is blocked on a sync object no runnable task can
+    release — a real deadlock, found deterministically."""
+
+
+class _Killed(BaseException):
+    # run teardown: unwinds a parked task without touching its state;
+    # BaseException so scenario try/except Exception can't swallow it
+    pass
+
+
+_TLS = threading.local()
+_ACTIVE = None          # the Weaver owning the current run (control thread)
+
+
+def current_task():
+    """The Weaver task the calling thread is registered as, or None."""
+    t = getattr(_TLS, "task", None)
+    if t is not None and t.done:
+        return None
+    return t
+
+
+def _site(depth=2):
+    try:
+        f = sys._getframe(depth)
+        # the racing site is the protocol code, not a weaver internal
+        # (e.g. WeaverLock.__exit__ calling release)
+        while f is not None and f.f_code.co_filename == __file__:
+            f = f.f_back
+        if f is None:
+            return "?"
+        return "%s:%d" % (os.path.basename(f.f_code.co_filename),
+                          f.f_lineno)
+    except Exception:
+        return "?"
+
+
+class _Task:
+    __slots__ = ("weaver", "idx", "name", "fn", "gate", "thread", "done",
+                 "kill", "failure", "pred", "pending")
+
+    def __init__(self, weaver, idx, name, fn):
+        self.weaver = weaver
+        self.idx = idx
+        self.name = name
+        self.fn = fn
+        self.gate = threading.Event()
+        self.thread = None
+        self.done = False
+        self.kill = False
+        self.failure = None
+        self.pred = None                      # enabled-iff predicate
+        self.pending = ("start", None, name)  # (op, obj, site)
+
+    def enabled(self):
+        if self.done:
+            return False
+        if self.pred is None:
+            return True
+        try:
+            return bool(self.pred())
+        except Exception:
+            return True
+
+
+class Weaver:
+    """One schedule execution: spawns the scenario tasks, serializes
+    them through per-task gates, and records every decision."""
+
+    def __init__(self, chooser, max_decisions=DEFAULT_MAX_DECISIONS,
+                 preemption_bound=None):
+        self.tasks = []
+        self.chooser = chooser        # fn(decision_i, n_enabled) -> idx
+        self.max_decisions = int(max_decisions)
+        self.pbound = preemption_bound
+        self.preemptions = 0
+        self.ctrl = threading.Event()
+        self.trace = []               # indices actually taken
+        self.points = []              # [(name, op, obj, site), ...] per decision
+        self.oplog = []               # chosen transition per decision
+        self.failure = None
+        self.truncated = False
+
+    def spawn(self, name, fn):
+        t = _Task(self, len(self.tasks), name, fn)
+        self.tasks.append(t)
+        return t
+
+    # -- task side ---------------------------------------------------
+
+    def _task_main(self, task):
+        _TLS.task = task
+        try:
+            task.gate.wait()
+            task.gate.clear()
+            if task.kill:
+                raise _Killed()
+            task.fn()
+        except _Killed:
+            pass
+        except BaseException as e:   # noqa: BLE001 — the finding itself
+            task.failure = e
+        finally:
+            task.done = True
+            _TLS.task = None
+            self.ctrl.set()
+
+    def _yield(self, task, op, obj, site, pred=None):
+        """Park ``task`` at a decision point; returns once the control
+        loop schedules it again (with ``pred``, if given, now true)."""
+        task.pending = (op, obj, site)
+        task.pred = pred
+        self.ctrl.set()
+        task.gate.wait()
+        task.gate.clear()
+        task.pred = None
+        if task.kill:
+            raise _Killed()
+
+    # -- control side ------------------------------------------------
+
+    def run(self):
+        for t in self.tasks:
+            t.thread = threading.Thread(
+                target=self._task_main, args=(t,),
+                name="weaver:%s" % t.name, daemon=True)
+            t.thread.start()
+        try:
+            self._control_loop()
+        finally:
+            for t in self.tasks:
+                if not t.done:
+                    t.kill = True
+                    t.gate.set()
+            for t in self.tasks:
+                t.thread.join(timeout=10)
+            if self.failure is None:
+                for t in self.tasks:
+                    if t.failure is not None:
+                        self.failure = t.failure
+                        break
+        return self
+
+    def _control_loop(self):
+        last = None
+        while True:
+            if any(t.failure is not None for t in self.tasks):
+                return
+            live = [t for t in self.tasks if not t.done]
+            if not live:
+                return
+            enabled = [t for t in live if t.enabled()]
+            if not enabled:
+                self.failure = DeadlockError(
+                    "deadlock: all live tasks blocked — "
+                    + "; ".join("%s at %s on %r" % (t.name, t.pending[2],
+                                                    t.pending[1])
+                                for t in live))
+                return
+            if len(self.trace) >= self.max_decisions:
+                self.truncated = True
+                return
+            last_runnable = last is not None and last in enabled
+            if self.pbound is not None and last_runnable \
+                    and self.preemptions >= self.pbound:
+                # preemption budget spent: the running task keeps the
+                # processor until it blocks or finishes
+                enabled = [last]
+            idx = self.chooser(len(self.trace), len(enabled))
+            idx = max(0, min(int(idx), len(enabled) - 1))
+            chosen = enabled[idx]
+            if last_runnable and chosen is not last:
+                self.preemptions += 1
+            last = chosen
+            self.trace.append(idx)
+            self.points.append([(t.name,) + t.pending for t in enabled])
+            self.oplog.append((chosen.name,) + chosen.pending)
+            self.ctrl.clear()
+            chosen.gate.set()
+            self.ctrl.wait()
+
+    def failure_sites(self, last=8):
+        """The most recent transition per task touching the run's tail
+        — the 'racing sites' an artifact names."""
+        out, seen = [], set()
+        for name, op, obj, site in reversed(self.oplog[-max(last, 1):]):
+            if name in seen:
+                continue
+            seen.add(name)
+            out.append("%s %s(%s) @ %s" % (name, op, obj or "-", site))
+        out.reverse()
+        return out
+
+
+# ---------------------------------------------------------------------------
+# Weaver sync primitives (what sanitizer.make_lock/_event/_condition
+# return under FLAGS_sanitizer=weaver while a run is active)
+# ---------------------------------------------------------------------------
+
+class WeaverLock:
+    """A modeled lock: acquisition order is a scheduling decision.
+    From a non-task thread it degrades to a private real lock (scenario
+    setup/teardown and foreign threads are never captured).  Execution
+    is serialized, so the modeled state needs no memory barriers."""
+
+    def __init__(self, name, reentrant=False):
+        self.name = name
+        self.reentrant = bool(reentrant)
+        self.owner = None
+        self.depth = 0
+        self._fallback = (threading.RLock() if reentrant
+                          else threading.Lock())
+
+    def _task(self):
+        return current_task()
+
+    def acquire(self, blocking=True, timeout=-1):
+        t = self._task()
+        if t is None:
+            if blocking:
+                return self._fallback.acquire(True)
+            return self._fallback.acquire(False)
+        if self.owner is t:
+            if self.reentrant:
+                self.depth += 1
+                return True
+            raise _san.LockDisciplineError(
+                "weaver: task %r re-acquired non-reentrant lock %r it "
+                "already holds — a certain deadlock" % (t.name, self.name))
+        timed = blocking and timeout is not None and timeout > 0
+        if not blocking or timed:
+            # the timeout is virtual: whether it fires is exactly the
+            # scheduling decision of running this task while the lock
+            # is still held
+            t.weaver._yield(t, "acquire", self.name, _site())
+            if self.owner is None:
+                self.owner = t
+                self.depth = 1
+                return True
+            return False
+        t.weaver._yield(t, "acquire", self.name, _site(),
+                        pred=lambda: self.owner is None)
+        self.owner = t
+        self.depth = 1
+        return True
+
+    def release(self, _quiet=False):
+        t = self._task()
+        if t is None:
+            return self._fallback.release()
+        if self.owner is not t:
+            raise RuntimeError(
+                "weaver: task %r released lock %r it does not hold"
+                % (t.name, self.name))
+        if not _quiet:
+            t.weaver._yield(t, "release", self.name, _site())
+        if self.depth > 1:
+            self.depth -= 1
+        else:
+            self.owner = None
+            self.depth = 0
+
+    def locked(self):
+        if self._task() is None:
+            got = self._fallback.acquire(False)
+            if got:
+                self._fallback.release()
+            return not got
+        return self.owner is not None
+
+    def _is_owned(self):
+        return self.owner is current_task() is not None
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        # unwind quietly while an exception propagates: the failure is
+        # the interesting transition, not the cleanup releases
+        self.release(_quiet=exc_type is not None)
+        return False
+
+    def __repr__(self):
+        return "<WeaverLock %r owner=%s>" % (
+            self.name, self.owner.name if self.owner else None)
+
+
+class WeaverEvent:
+    """A modeled event; the flag lives in a real Event so non-task
+    threads interoperate.  A timed wait never sleeps: the timeout
+    firing is the decision of scheduling the waiter while unset."""
+
+    def __init__(self, name):
+        self.name = name
+        self._flag = threading.Event()
+
+    def is_set(self):
+        return self._flag.is_set()
+
+    def set(self):
+        t = current_task()
+        if t is not None:
+            t.weaver._yield(t, "set", self.name, _site())
+        self._flag.set()
+
+    def clear(self):
+        t = current_task()
+        if t is not None:
+            t.weaver._yield(t, "clear", self.name, _site())
+        self._flag.clear()
+
+    def wait(self, timeout=None):
+        t = current_task()
+        if t is None:
+            return self._flag.wait(timeout)
+        if timeout is None:
+            t.weaver._yield(t, "wait", self.name, _site(),
+                            pred=self._flag.is_set)
+            return True
+        t.weaver._yield(t, "wait", self.name, _site())
+        return self._flag.is_set()
+
+    def __repr__(self):
+        return "<WeaverEvent %r set=%s>" % (self.name, self.is_set())
+
+
+class WeaverCondition:
+    """A modeled condition variable over a :class:`WeaverLock`.
+    wait() releases the lock and parks as ONE decision, wakes on a
+    decision where it was notified (or, for timed waits, whenever the
+    lock is re-acquirable — the virtual timeout), and re-acquires
+    before returning, exactly the threading.Condition contract."""
+
+    def __init__(self, name, lock=None):
+        self.name = name
+        self._lock = lock if lock is not None else WeaverLock(
+            name + ".lock", reentrant=True)
+        self._waiters = []
+        self._signals = {}
+
+    def acquire(self, *a, **kw):
+        return self._lock.acquire(*a, **kw)
+
+    def release(self):
+        return self._lock.release()
+
+    def __enter__(self):
+        self._lock.acquire()
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        return self._lock.__exit__(exc_type, exc, tb)
+
+    def wait(self, timeout=None):
+        t = current_task()
+        if t is None:
+            # foreign threads cannot park the scheduler; degrade to a
+            # bounded poll so setup/teardown code never hangs
+            return False
+        lk = self._lock
+        if lk.owner is not t:
+            raise RuntimeError("weaver: wait() on %r without holding its "
+                               "lock" % self.name)
+        depth, site = lk.depth, _site()
+        t.weaver._yield(t, "wait", self.name, site)
+        self._waiters.append(t)
+        lk.owner = None
+        lk.depth = 0
+        if timeout is None:
+            t.weaver._yield(
+                t, "wakeup", self.name, site,
+                pred=lambda: self._signals.get(t, False)
+                and lk.owner is None)
+        else:
+            t.weaver._yield(t, "wakeup", self.name, site,
+                            pred=lambda: lk.owner is None)
+        signaled = self._signals.pop(t, False)
+        if t in self._waiters:
+            self._waiters.remove(t)
+        lk.owner = t
+        lk.depth = depth
+        return signaled
+
+    def wait_for(self, predicate, timeout=None):
+        result = predicate()
+        while not result:
+            if not self.wait(timeout) and timeout is not None:
+                return predicate()
+            result = predicate()
+        return result
+
+    def notify(self, n=1):
+        t = current_task()
+        if t is not None:
+            t.weaver._yield(t, "notify", self.name, _site())
+        pending = [w for w in self._waiters
+                   if not self._signals.get(w, False)]
+        for w in pending[:max(int(n), 0)]:
+            self._signals[w] = True
+
+    def notify_all(self):
+        self.notify(len(self._waiters))
+
+    def __repr__(self):
+        return "<WeaverCondition %r waiters=%d>" % (
+            self.name, len(self._waiters))
+
+
+# -- sanitizer-facing constructors ------------------------------------------
+
+# observability-plane locks (metric registries, flight buffers) are
+# infrastructure under the protocol, not part of it: modeling them
+# explodes the schedule tree with commuting bookkeeping transitions
+# and buries the real racing sites.  They stay plain.
+_MODEL_EXCLUDE_PREFIXES = ("metrics.", "flight.", "tsdb.", "slo.",
+                           "ledger.", "numerics.")
+
+
+def _modeled(name):
+    return not str(name).startswith(_MODEL_EXCLUDE_PREFIXES)
+
+
+def weaver_lock(name, reentrant=False):
+    """A WeaverLock when a run is active, else None (the sanitizer
+    falls back to a plain lock — weaver mode outside a run is inert)."""
+    if _ACTIVE is None or not _modeled(name):
+        return None
+    return WeaverLock(name, reentrant=reentrant)
+
+
+def weaver_event(name):
+    if _ACTIVE is None or not _modeled(name):
+        return None
+    return WeaverEvent(name)
+
+
+def weaver_condition(name, lock=None):
+    if _ACTIVE is None or not _modeled(name):
+        return None
+    if lock is not None and not isinstance(lock, WeaverLock):
+        lock = None   # a foreign lock cannot be modeled; give the
+        # condition its own
+    return WeaverCondition(name, lock)
+
+
+def maybe_yield(site):
+    """The sanitizer.weaver_yield landing point: a pure scheduling
+    decision at a queue/wire boundary.  No-op off a task thread."""
+    t = current_task()
+    if t is None:
+        return
+    t.weaver._yield(t, "yield", None, site)
+
+
+# ---------------------------------------------------------------------------
+# One-schedule harness
+# ---------------------------------------------------------------------------
+
+class RunRecord:
+    """Everything one schedule execution produced."""
+
+    __slots__ = ("trace", "points", "oplog", "failure", "truncated",
+                 "sites", "decisions")
+
+    def __init__(self, wv):
+        self.trace = list(wv.trace)
+        self.points = wv.points
+        self.oplog = wv.oplog
+        self.failure = wv.failure
+        self.truncated = wv.truncated
+        self.sites = wv.failure_sites() if wv.failure is not None else []
+        self.decisions = len(wv.trace)
+
+    @property
+    def failure_type(self):
+        return type(self.failure).__name__ if self.failure else None
+
+
+class _WeaverFlags:
+    """Force FLAGS_sanitizer=weaver around one run, restoring after."""
+
+    def __enter__(self):
+        self._old = FLAGS.sanitizer
+        FLAGS.sanitizer = "weaver"
+        return self
+
+    def __exit__(self, *exc):
+        FLAGS.sanitizer = self._old
+        return False
+
+
+def run_schedule(scenario, trace=None, plant=None, chooser=None,
+                 max_decisions=DEFAULT_MAX_DECISIONS,
+                 preemption_bound=DEFAULT_PREEMPTION_BOUND):
+    """Execute one schedule of ``scenario`` (a name in SCENARIOS or a
+    builder callable).  ``trace`` forces decisions by index; beyond the
+    trace the first enabled task is chosen — so replaying a recorded
+    trace is bit-deterministic (the trace indexes the enabled set, so
+    replay must use the same ``preemption_bound`` it was recorded
+    under; artifacts carry it).  ``chooser`` overrides trace-based
+    choice entirely (the random-walk mode)."""
+    global _ACTIVE
+    builder = SCENARIOS[scenario] if isinstance(scenario, str) else scenario
+    trace = list(trace or [])
+    if chooser is None:
+        def chooser(i, n):
+            return trace[i] if i < len(trace) else 0
+    with _WeaverFlags():
+        wv = Weaver(chooser, max_decisions=max_decisions,
+                    preemption_bound=preemption_bound)
+        _ACTIVE = wv
+        try:
+            spec = builder(plant)
+            for name, fn in spec["tasks"]:
+                wv.spawn(name, fn)
+            wv.run()
+        finally:
+            _ACTIVE = None
+        try:
+            if wv.failure is None and not wv.truncated \
+                    and spec.get("check") is not None:
+                try:
+                    spec["check"]()
+                except AssertionError as e:
+                    wv.failure = e
+        finally:
+            td = spec.get("teardown")
+            if td is not None:
+                try:
+                    td()
+                except Exception:
+                    pass
+    return RunRecord(wv)
+
+
+# ---------------------------------------------------------------------------
+# Exploration
+# ---------------------------------------------------------------------------
+
+class ExploreStats:
+    __slots__ = ("explored", "pruned", "failures", "exhausted",
+                 "truncated")
+
+    def __init__(self):
+        self.explored = 0
+        self.pruned = 0
+        self.failures = 0
+        self.exhausted = False
+        self.truncated = 0
+
+    def as_dict(self):
+        return {k: getattr(self, k) for k in self.__slots__}
+
+
+def _independent(pa, pb):
+    """May transitions pa/pb (as (task, op, obj, site)) commute?  Only
+    claimed for sync ops by different tasks on different named objects
+    — plain yields guard data races and are never pruned."""
+    ta, _, oa, _ = pa
+    tb, _, ob, _ = pb
+    return ta != tb and oa is not None and ob is not None and oa != ob
+
+
+def explore(scenario, plant=None, mode="dfs", max_schedules=400,
+            max_decisions=DEFAULT_MAX_DECISIONS, seed=0,
+            stop_on_failure=True,
+            preemption_bound=DEFAULT_PREEMPTION_BOUND):
+    """Enumerate (dfs) or sample (random) schedules of ``scenario``.
+    Returns ``(stats, first_failing_RunRecord_or_None)``.  DFS is
+    exhaustive when the tree empties before ``max_schedules`` — then
+    ``stats.exhausted`` is True and a clean result is a proof at this
+    scope and preemption bound (CHESS's soundness claim).  Pass
+    ``preemption_bound=None`` for the unbounded tree."""
+    stats = ExploreStats()
+    failing = None
+    if mode == "random":
+        import random
+        for i in range(max_schedules):
+            rng = random.Random((seed << 16) ^ i)
+            taken = []
+
+            def chooser(di, n, _rng=rng, _taken=taken):
+                c = _rng.randrange(n)
+                _taken.append(c)
+                return c
+
+            rec = run_schedule(scenario, chooser=chooser, plant=plant,
+                               max_decisions=max_decisions,
+                               preemption_bound=preemption_bound)
+            rec.trace[:] = taken[:rec.decisions]
+            stats.explored += 1
+            stats.truncated += 1 if rec.truncated else 0
+            if rec.failure is not None:
+                stats.failures += 1
+                if failing is None:
+                    failing = rec
+                if stop_on_failure:
+                    break
+    else:
+        stack = [[]]
+        while stack and stats.explored < max_schedules:
+            prefix = stack.pop()
+            rec = run_schedule(scenario, trace=prefix, plant=plant,
+                               max_decisions=max_decisions,
+                               preemption_bound=preemption_bound)
+            stats.explored += 1
+            stats.truncated += 1 if rec.truncated else 0
+            if rec.failure is not None:
+                stats.failures += 1
+                if failing is None:
+                    failing = rec
+                if stop_on_failure:
+                    break
+                continue
+            children = []
+            for d in range(len(prefix), len(rec.points)):
+                pts = rec.points[d]
+                for alt in range(1, len(pts)):
+                    if all(_independent(pts[alt], pts[j])
+                           for j in range(alt)):
+                        stats.pruned += 1
+                        continue
+                    children.append(rec.trace[:d] + [alt])
+            stack.extend(reversed(children))
+        stats.exhausted = not stack and stats.explored <= max_schedules
+    try:
+        _m_explored().inc(stats.explored)
+        _m_pruned().inc(stats.pruned)
+        if stats.failures:
+            _m_failures().inc(stats.failures)
+    except Exception:
+        pass
+    return stats, failing
+
+
+# ---------------------------------------------------------------------------
+# Minimization (delta-debug the decision trace)
+# ---------------------------------------------------------------------------
+
+def minimize(scenario, trace, failure_type, plant=None,
+             max_decisions=DEFAULT_MAX_DECISIONS,
+             preemption_bound=DEFAULT_PREEMPTION_BOUND):
+    """Smallest trace still producing ``failure_type``: (1) shortest
+    failing prefix (the suffix re-derives under default scheduling),
+    (2) each non-default decision reverted to the default if the
+    failure survives, (3) trailing defaults stripped.  Returns
+    ``(minimized_trace, runs_used)``."""
+    runs = [0]
+
+    def fails(tr):
+        runs[0] += 1
+        rec = run_schedule(scenario, trace=tr, plant=plant,
+                           max_decisions=max_decisions,
+                           preemption_bound=preemption_bound)
+        return rec.failure is not None \
+            and rec.failure_type == failure_type
+
+    best = None
+    for cut in range(len(trace) + 1):
+        if fails(trace[:cut]):
+            best = list(trace[:cut])
+            break
+    if best is None:        # flaky input trace: nothing to minimize
+        return list(trace), runs[0]
+    for i in range(len(best)):
+        if best[i] != 0:
+            cand = best[:i] + [0] + best[i + 1:]
+            if fails(cand):
+                best = cand
+    while best and best[-1] == 0:
+        best.pop()
+    try:
+        _m_minlen().set(len(best))
+    except Exception:
+        pass
+    return best, runs[0]
+
+
+# ---------------------------------------------------------------------------
+# Artifacts
+# ---------------------------------------------------------------------------
+
+def next_artifact_path(directory, scenario):
+    os.makedirs(directory, exist_ok=True)
+    n = 0
+    while True:
+        p = os.path.join(directory, "weaver_%s_%d.json" % (scenario, n))
+        if not os.path.exists(p):
+            return p
+        n += 1
+
+
+def write_artifact(directory, scenario, plant, trace, rec, stats=None,
+                   minimized_from=None,
+                   preemption_bound=DEFAULT_PREEMPTION_BOUND):
+    """One replayable ``weaver_<scenario>_<n>.json``: the decision
+    trace, the failure, and the racing sites.  Returns the path."""
+    path = next_artifact_path(directory, scenario)
+    payload = {
+        "kind": "weaver",
+        "scenario": scenario,
+        "plant": plant,
+        "trace": list(trace),
+        "preemption_bound": preemption_bound,
+        "failure": {
+            "type": rec.failure_type,
+            "message": str(rec.failure)[:800] if rec.failure else None,
+            "sites": rec.sites,
+        },
+        "minimized_len": len(trace),
+        "minimized_from": minimized_from,
+        "explored": stats.explored if stats else None,
+        "pruned": stats.pruned if stats else None,
+    }
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(payload, f, indent=1, sort_keys=True)
+    os.replace(tmp, path)
+    return path
+
+
+def replay_artifact(path, max_decisions=DEFAULT_MAX_DECISIONS):
+    """Re-execute an artifact's trace; returns ``(reproduced, rec,
+    payload)`` where reproduced means the same failure type fired."""
+    with open(path) as f:
+        payload = json.load(f)
+    rec = run_schedule(payload["scenario"], trace=payload["trace"],
+                       plant=payload.get("plant"),
+                       max_decisions=max_decisions,
+                       preemption_bound=payload.get(
+                           "preemption_bound", DEFAULT_PREEMPTION_BOUND))
+    want = (payload.get("failure") or {}).get("type")
+    reproduced = rec.failure_type == want
+    return reproduced, rec, payload
+
+
+# ---------------------------------------------------------------------------
+# Scenario drivers — the four load-bearing protocols, each a small
+# in-process model over the real sanitizer primitives (and, where
+# practical, the real object: BlockPool).  Each builder takes ``plant``
+# (None = HEAD) and returns {"tasks": [(name, fn)...], "check": fn,
+# "teardown": fn}.  The planted variants re-introduce the historical
+# race exactly as shipped.
+# ---------------------------------------------------------------------------
+
+SCENARIOS = collections.OrderedDict()
+PLANTS = {
+    "pserver": ("kstale",),
+    "kv_pool": ("double_free",),
+    "migrate_kv": ("dup_migration",),
+    "router_evict": ("double_complete",),
+}
+
+
+def scenario(name):
+    def deco(fn):
+        SCENARIOS[name] = fn
+        return fn
+    return deco
+
+
+def list_scenarios():
+    return [(name, PLANTS.get(name, ())) for name in SCENARIOS]
+
+
+@scenario("pserver")
+def _build_pserver(plant=None):
+    """(a) pserver barrier/apply/staleness loop (rpc.py): the apply
+    worker donates the params to the optimize dispatch with the lock
+    dropped around the device window, while k-stale trainers read
+    them.  plant='kstale' re-introduces the PR 10 bug: the reader
+    skips the shard-applying fence and can observe the donated husk."""
+    mu = _san.make_lock("scen.ps.mu")
+    cv = _san.make_condition("scen.ps.cv", mu)
+    state = {"param": 0, "donated": False, "round": 0, "acks": 0}
+
+    def apply_worker():
+        with mu:
+            while state["acks"] < 2:
+                cv.wait()
+            state["acks"] = 0
+            state["donated"] = True     # optimize dispatch consumes params
+        _san.weaver_yield("scen.ps.apply_window")   # device window,
+        # lock dropped exactly like VariableServer._maybe_apply_locked
+        with mu:
+            state["param"] += 1
+            state["donated"] = False    # re-bind
+            state["round"] += 1
+            cv.notify_all()
+
+    def trainer(tag):
+        def run():
+            with mu:
+                state["acks"] += 1
+                cv.notify_all()
+            if plant == "kstale":
+                # PR 10: the k-stale read path consulted no fence — it
+                # fetched the device param across a dispatch boundary
+                # (a yield point in the real code) and could land
+                # inside the optimize window, reading the donated
+                # buffer
+                _san.weaver_yield("scen.ps.kstale_read")
+                donated = state["donated"]
+                assert not donated, (
+                    "k-stale read raced the optimize dispatch: param "
+                    "observed while donated (round %d)" % state["round"])
+            else:
+                with mu:
+                    while state["donated"]:
+                        cv.wait()
+                    assert not state["donated"]
+        return run
+
+    def check():
+        assert state["round"] == 1 and state["param"] == 1, \
+            "apply round did not commit exactly once: %r" % (state,)
+
+    return {"tasks": [("apply", apply_worker),
+                      ("trainer0", trainer("trainer0")),
+                      ("trainer1", trainer("trainer1"))],
+            "check": check, "teardown": None}
+
+
+@scenario("kv_pool")
+def _build_kv_pool(plant=None):
+    """(b) BlockPool alloc/free over the real serving pool: two owners
+    (decode-finish and preemption) hand off who returns a sequence's
+    blocks, while a third task churns its own allocation.
+    plant='double_free' re-introduces the PR 14 bug shape: the
+    ownership check-then-act runs outside the lock, so both owners can
+    free — the pool's own sanitizer check is what must trip."""
+    from paddle_tpu.serving import kv_cache
+    pool = kv_cache.BlockPool(8, 16)
+    blocks = pool.alloc(2)
+    mu = _san.make_lock("scen.kv.owner")
+    state = {"freed": False}
+
+    def free_once(tag):
+        def run():
+            if plant == "double_free":
+                if not state["freed"]:
+                    _san.weaver_yield("scen.kv.%s.gap" % tag)
+                    state["freed"] = True
+                    pool.free(list(blocks))
+            else:
+                with mu:
+                    mine = not state["freed"]
+                    state["freed"] = True
+                if mine:
+                    pool.free(list(blocks))
+        return run
+
+    def churner():
+        b = pool.alloc(1)
+        _san.weaver_yield("scen.kv.churn")
+        if b is not None:
+            pool.free(b)
+
+    def check():
+        assert pool.used_blocks == 0, (
+            "pool leaked %d blocks after handoff" % pool.used_blocks)
+
+    return {"tasks": [("finisher", free_once("finisher")),
+                      ("preemptor", free_once("preemptor")),
+                      ("churner", churner)],
+            "check": check, "teardown": pool.close}
+
+
+@scenario("migrate_kv")
+def _build_migrate_kv(plant=None):
+    """(c) the PR 16 MigrateKV handshake on the decode side: duplicate
+    frames of the same rid (fastwire retries) race through
+    alloc/import/register against the real BlockPool.
+    plant='dup_migration' removes the early reserve-under-lock dup
+    check, leaving only a post-import rollback — correct for a dup
+    frame arriving after the install, but two frames overlapping in
+    the import window both see no prior install and both register:
+    double-admit + leak, exactly the window the PR 16 review found."""
+    from paddle_tpu.serving import kv_cache
+    pool = kv_cache.BlockPool(8, 16)
+    flock = _san.make_lock("scen.mig.flock")
+    futures = {}
+    stats = {"installed": 0, "dup": 0}
+
+    def handler(tag):
+        def run():
+            rid = "req-1"
+            if plant != "dup_migration":
+                with flock:
+                    if rid in futures:        # early dup check (PR 16 fix)
+                        stats["dup"] += 1
+                        return
+                    futures[rid] = None       # reserve before alloc
+            blocks = pool.alloc(2)
+            assert blocks is not None, "migrate alloc starved"
+            _san.weaver_yield("scen.mig.import")   # engine.import_blocks
+            if plant == "dup_migration":
+                # the late dup check is correct for a frame arriving
+                # AFTER the install (rollback), but check and register
+                # sit in separate critical sections: two frames
+                # overlapping in the import window both see no prior
+                # install and both register
+                with flock:
+                    prev = futures.get(rid)
+                if prev is not None:
+                    stats["dup"] += 1
+                    pool.free(blocks)          # serial dup: rolled back
+                    return
+                _san.weaver_yield("scen.mig.register")
+                with flock:
+                    futures[rid] = blocks      # clobbers a racing install
+                    stats["installed"] += 1
+            else:
+                with flock:
+                    futures[rid] = blocks
+                    stats["installed"] += 1
+        return run
+
+    def check():
+        assert stats["installed"] == 1, (
+            "rid installed %d times — dup frames double-admitted"
+            % stats["installed"])
+        assert pool.used_blocks == 2, (
+            "dup migration: %d installs, %d blocks live (want 2) — "
+            "leaked or double-admitted"
+            % (stats["installed"], pool.used_blocks))
+
+    return {"tasks": [("frame_a", handler("frame_a")),
+                      ("frame_b", handler("frame_b"))],
+            "check": check, "teardown": pool.close}
+
+
+@scenario("router_evict")
+def _build_router_evict(plant=None):
+    """(d) router lease-eviction vs the in-flight attempt: when a
+    worker is evicted mid-prefill, both the original attempt's
+    failover and the evictor's re-dispatch race to complete the
+    request, and the set-once record must keep it exactly-once.
+    plant='double_complete' opens the check-then-act gap in the
+    completion record, so the request can complete twice."""
+    mu = _san.make_lock("scen.route.rec")
+    rec = {"completed": False, "done": 0, "live": True}
+
+    def complete(tag):
+        if plant == "double_complete":
+            if not rec["completed"]:
+                _san.weaver_yield("scen.route.complete_gap")
+                rec["completed"] = True
+                rec["done"] += 1
+        else:
+            with mu:
+                if rec["completed"]:
+                    return
+                rec["completed"] = True
+            rec["done"] += 1
+
+    def original():
+        _san.weaver_yield("scen.route.prefill")   # in flight on the
+        # worker the evictor is about to kill
+        complete("orig")
+
+    def evictor():
+        with mu:
+            rec["live"] = False
+        _san.weaver_yield("scen.route.requeue")
+        complete("evict_redispatch")
+
+    def check():
+        assert rec["done"] == 1, (
+            "request completed %d times — exactly-once violated"
+            % rec["done"])
+
+    return {"tasks": [("original", original), ("evictor", evictor)],
+            "check": check, "teardown": None}

@@ -133,7 +133,13 @@ define_flag("sanitizer", "off",
             "checking: instrumented locks record per-thread "
             "acquisition order, detect order-inversion cycles and "
             "non-reentrant acquisition on signal-handler-reachable "
-            "paths, reported as lockgraph_<pid>.json), or 'all'.  "
+            "paths, reported as lockgraph_<pid>.json), 'all', or "
+            "'weaver' (deterministic-schedule exploration: make_lock/"
+            "make_event/make_condition hand out analysis/weaver.py "
+            "primitives whose every acquire/release/wait/notify is a "
+            "scheduling decision under the active Weaver's virtual "
+            "clock; implies buffer checking so scenario invariants "
+            "can trip; see tools/weaver.py).  "
             "Lock instrumentation is chosen at lock CREATION time — "
             "set the flag (or FLAGS_sanitizer env) before the "
             "subsystems under test construct their locks.  Every trip "

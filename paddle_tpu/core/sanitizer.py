@@ -52,22 +52,28 @@ from .flags import FLAGS
 __all__ = [
     "BufferEpochGuard", "BufferLifetimeError", "InstrumentedLock",
     "LockDisciplineError", "PoisonedHusk", "buffer_epoch", "buffers_on",
-    "disabled_probe", "is_husk", "locks_on", "make_lock",
-    "poison_donated", "probe_signal_reentrancy", "reset_lock_graph",
-    "trip", "write_lockgraph",
+    "disabled_probe", "is_husk", "locks_on", "make_condition",
+    "make_event", "make_lock", "poison_donated",
+    "probe_signal_reentrancy", "reset_lock_graph", "trip",
+    "weaver_on", "weaver_probe", "weaver_yield", "write_lockgraph",
 ]
 
 # hot-path mirrors of FLAGS_sanitizer — the disabled path reads exactly
 # one of these per guarded site (the telemetry_overhead.py contract)
 _BUFFERS_ON = False
 _LOCKS_ON = False
+_WEAVER_ON = False
 
 
 def _sync_mode(value):
-    global _BUFFERS_ON, _LOCKS_ON
+    global _BUFFERS_ON, _LOCKS_ON, _WEAVER_ON
     mode = str(value or "off")
-    _BUFFERS_ON = mode in ("buffers", "all")
+    # weaver implies the buffer checks: the schedule explorer's
+    # scenarios rely on use-after-donate / double-free trips being the
+    # observable failure
+    _BUFFERS_ON = mode in ("buffers", "all", "weaver")
     _LOCKS_ON = mode in ("locks", "all")
+    _WEAVER_ON = mode == "weaver"
 
 
 FLAGS.watch("sanitizer", _sync_mode)
@@ -81,6 +87,10 @@ def locks_on():
     return _LOCKS_ON
 
 
+def weaver_on():
+    return _WEAVER_ON
+
+
 def disabled_probe(iters):
     """Execute exactly the per-site disabled-path work ``iters`` times
     (one module-attribute read + branch) — micro-timed by the
@@ -88,6 +98,18 @@ def disabled_probe(iters):
     n = 0
     for _ in range(iters):
         if _BUFFERS_ON:
+            n += 1
+    return n
+
+
+def weaver_probe(iters):
+    """The weaver hook's disabled-path work ``iters`` times (one
+    module-attribute read + branch, identical to :func:`weaver_yield`
+    with the mode off) — micro-timed by the telemetry_overhead.py
+    weaver gate."""
+    n = 0
+    for _ in range(iters):
+        if _WEAVER_ON:
             n += 1
     return n
 
@@ -462,6 +484,10 @@ class InstrumentedLock:
             _note_trip("sanitizer:lock:%s" % name,
                        {"lock": name, "kind": "signal-unsafe-lock"})
 
+    def _is_owned(self):
+        # threading.Condition probes this when handed a foreign lock
+        return any(h is self for h in _held_stack())
+
     def acquire(self, blocking=True, timeout=-1):
         st = _held_stack()
         held_here = any(h is self for h in st)
@@ -481,7 +507,10 @@ class InstrumentedLock:
         if not held_here:
             for h in st:
                 GRAPH.note_edge(h.name, self.name)
-        ok = self._inner.acquire(blocking, timeout)
+        if blocking:
+            ok = self._inner.acquire(True, timeout)
+        else:   # threading forbids a timeout on a non-blocking acquire
+            ok = self._inner.acquire(False)
         if ok:
             st.append(self)
         return ok
@@ -516,11 +545,56 @@ def make_lock(name, reentrant=False, signal_safe=False):
     :class:`InstrumentedLock` feeding the process lock graph.
     ``signal_safe`` documents (and, instrumented, enforces) the
     flight.dump invariant: the lock is taken inside signal handlers
-    and must be reentrant."""
+    and must be reentrant.  Under ``FLAGS_sanitizer=weaver`` with a
+    schedule-exploration run active (analysis/weaver.py), the lock is
+    a WeaverLock: every acquire/release is a scheduling decision."""
+    if _WEAVER_ON:
+        lk = _weaver().weaver_lock(name, reentrant=reentrant)
+        if lk is not None:
+            return lk
     if not _LOCKS_ON:
         return threading.RLock() if reentrant else threading.Lock()
     return InstrumentedLock(name, reentrant=reentrant,
                             signal_safe=signal_safe)
+
+
+def _weaver():
+    from paddle_tpu.analysis import weaver
+    return weaver
+
+
+def make_event(name):
+    """The event analog of :func:`make_lock`: a plain
+    ``threading.Event`` unless a weaver run is active, in which case a
+    WeaverEvent whose wait/set are scheduling decisions (a timed wait
+    never sleeps — the timeout is virtual)."""
+    if _WEAVER_ON:
+        ev = _weaver().weaver_event(name)
+        if ev is not None:
+            return ev
+    return threading.Event()
+
+
+def make_condition(name, lock=None):
+    """The condition analog of :func:`make_lock`.  ``lock`` may be a
+    lock previously returned by :func:`make_lock` (the
+    Condition-over-my-mutex idiom); instrumentation rides whatever
+    that lock already is.  Under an active weaver run this returns a
+    WeaverCondition whose wait/notify are scheduling decisions."""
+    if _WEAVER_ON:
+        cv = _weaver().weaver_condition(name, lock)
+        if cv is not None:
+            return cv
+    return threading.Condition(lock)
+
+
+def weaver_yield(site):
+    """A pure scheduling decision at a queue/wire boundary (fastwire
+    frame hand-off, request-queue put/get, the pserver apply window).
+    Off path: ONE module-attribute read — gated like every sanitizer
+    hook by tools/telemetry_overhead.py."""
+    if _WEAVER_ON:
+        _weaver().maybe_yield(site)
 
 
 def probe_signal_reentrancy():

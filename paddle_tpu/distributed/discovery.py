@@ -24,6 +24,8 @@ import threading
 import time
 import uuid
 
+from paddle_tpu.core import sanitizer as _san
+
 __all__ = ["EndpointRegistry", "FileLock", "MasterHA"]
 
 DEFAULT_TTL = 10.0
@@ -54,7 +56,7 @@ class EndpointRegistry:
             json.dump(payload, f)
         os.replace(tmp, path)
         if heartbeat:
-            stop = threading.Event()
+            stop = _san.make_event("discovery.beat.stop")
             self._beats[(kind, endpoint)] = stop
 
             def beat():
@@ -193,7 +195,7 @@ class FileLock:
         return self
 
     def _heartbeat(self):
-        stop = threading.Event()
+        stop = _san.make_event("discovery.watch.stop")
         self._stop = stop
         self.lost = False
 

@@ -37,6 +37,8 @@ import struct
 import subprocess
 import threading
 
+from paddle_tpu.core import sanitizer as _san
+
 __all__ = ["native_available", "FastServer", "FastConnPool"]
 
 from paddle_tpu.observability import metrics as _obs_metrics
@@ -64,7 +66,7 @@ _M_CONNS = _obs_metrics.gauge(
 _M_INFLIGHT = _obs_metrics.gauge(
     "fastwire_inflight_requests",
     "fastwire frames currently inside a server handler")
-_live_lock = threading.Lock()
+_live_lock = threading.Lock()  # rawlock: ok - process-wide metrics registry, pre-import of sanitizer modes
 _live = {"conns": 0, "inflight": 0}
 
 
@@ -113,7 +115,7 @@ METHODS = {"SendVariable": 1, "GetVariable": 2,
 
 _lib = None
 _lib_tried = False
-_lib_lock = threading.Lock()
+_lib_lock = threading.Lock()  # rawlock: ok - guards ctypes lib load, must exist before flags parse
 
 
 def _load():
@@ -308,7 +310,7 @@ class FastServer:
             raise OSError("fastwire listen failed on %s:%d (%d)"
                           % (addr, port, self._lfd))
         self.port = int(port)
-        self._stop = threading.Event()
+        self._stop = _san.make_event("fastwire.server.stop")
         self._accept_thread = threading.Thread(target=self._accept_loop,
                                                daemon=True)
         self._accept_thread.start()
@@ -433,7 +435,7 @@ class FastConnPool:
         self.port_offset = int(port_offset)
         self._idle = {}
         self._dead = set()
-        self._lock = threading.Lock()
+        self._lock = _san.make_lock("fastwire.pool")
 
     def _connect(self, ep):
         """Returns a _Conn, None (transient: connect refused — retry

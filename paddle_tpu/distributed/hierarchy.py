@@ -35,6 +35,8 @@ from __future__ import annotations
 
 import os
 import threading
+
+from paddle_tpu.core import sanitizer as _san
 import time
 
 import numpy as np
@@ -119,7 +121,7 @@ class HostAggregator:
         # uploads overlap the rest of the round instead of bunching at
         # the barrier.  flush() then only settles the stragglers.
         self._upload = upload
-        self._cv = threading.Condition()
+        self._cv = _san.make_condition("hier.agg.cv")
         self._grads = {}      # round -> {(ep, name): {sender: arr}}
         self._order = {}      # round -> [(ep, name)] first-seen order
         self._shipped = {}    # round -> {(ep, name)} already uploaded
@@ -366,7 +368,7 @@ class _FollowerLink:
         self._fw = fastwire
         self._ep = "127.0.0.1:%d" % int(port)
         self._pool = fastwire.FastConnPool(0)
-        self._lock = threading.Lock()
+        self._lock = _san.make_lock("hier.link")
 
     def call(self, method, payload, deadline=300.0):
         end = time.monotonic() + deadline
@@ -396,7 +398,7 @@ class _FollowerLink:
 # process-wide wiring (used by rpc.RPCClient)
 # ---------------------------------------------------------------------------
 
-_state_lock = threading.Lock()
+_state_lock = threading.Lock()  # rawlock: ok - module singleton wiring, set up before any mode flip
 _agg = None
 _link = None
 

@@ -21,6 +21,8 @@ from __future__ import annotations
 import json
 import os
 import threading
+
+from paddle_tpu.core import sanitizer as _san
 import time
 from concurrent import futures
 
@@ -55,7 +57,7 @@ class Master:
     def __init__(self, lease_timeout=DEFAULT_LEASE,
                  max_retry=DEFAULT_MAX_RETRY, snapshot_path=None,
                  num_epochs=1):
-        self._lock = threading.Lock()
+        self._lock = _san.make_lock("master.state")
         self._todo = []          # [Task]
         self._pending = {}       # id -> (Task, deadline)
         self._done = []          # [Task]

@@ -31,6 +31,8 @@ from __future__ import annotations
 
 import random
 import threading
+
+from paddle_tpu.core import sanitizer as _san
 import time
 
 from paddle_tpu.core.flags import FLAGS, define_flag
@@ -244,7 +246,7 @@ class FaultInjector:
     def __init__(self, spec="", seed=None):
         self.rules = self._parse(spec)
         self._rng = random.Random(seed or None)
-        self._lock = threading.Lock()
+        self._lock = _san.make_lock("resilience.injector")
         self.stats = {}
 
     @classmethod
@@ -350,7 +352,7 @@ def maybe_corrupt(point, round_, arr):
 
 
 _injector = None
-_injector_lock = threading.Lock()
+_injector_lock = threading.Lock()  # rawlock: ok - module singleton wiring, set up before any mode flip
 
 
 def get_injector():

@@ -460,7 +460,7 @@ class VariableServer:
         self.trainer_lease = float(
             FLAGS.trainer_lease if trainer_lease is None else trainer_lease)
 
-        self._cv = threading.Condition()
+        self._cv = _san.make_condition("rpc.server.cv")
         # grad name -> {sender key: array}; sender-keyed so a replayed
         # round overwrites instead of double-counting in the sync mean
         self._pending = {g: {} for g in self.grad_to_block}
@@ -515,7 +515,7 @@ class VariableServer:
         self._completed = set()         # senders that sent SendComplete
         self._async_applied = {}        # (sender, name) -> last applied seq
         self._alive = self.fanin_total
-        self._shutdown = threading.Event()
+        self._shutdown = _san.make_event("rpc.server.shutdown")
         # one save at a time (sanitizer-adopted: FLAGS_sanitizer=locks
         # instruments acquisition order, core/sanitizer.py)
         self._ckpt_lock = _san.make_lock("rpc.server.ckpt")
@@ -1527,6 +1527,10 @@ class VariableServer:
                         self._cv.wait(timeout=0.05)
                     self._shard_applying.update(outs)
                     self._cv.release()
+                    # the PR 10 window: the shard's params are donated
+                    # to the optimize dispatch with the lock dropped —
+                    # under the weaver this is a scheduling decision
+                    _san.weaver_yield("rpc.apply_window")
                     try:
                         if _TRC.on:
                             with _TRC.span("pserver.apply_shard", cid,

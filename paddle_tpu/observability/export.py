@@ -24,7 +24,8 @@ __all__ = ["load_dump", "chrome_trace", "merge_files", "phase_rows",
            "format_phase_table", "kernel_rows", "format_kernel_table",
            "numerics_rows", "format_numerics_table", "serve_rows",
            "format_serve_table", "scale_rows", "format_scale_table",
-           "slo_rows", "format_slo_table"]
+           "slo_rows", "format_slo_table", "weaver_rows",
+           "format_weaver_table"]
 
 
 def load_dump(path):
@@ -525,6 +526,49 @@ def format_moe_table(rows):
                       r["dropped_tokens"], r["dropped_frac"],
                       r["router_entropy"], r["expert_load_p50"],
                       r["expert_load_p99"], r["expert_load_mean"]))
+    return "\n".join(out)
+
+
+def weaver_rows(dumps):
+    """Weaver schedule-exploration rollup (ISSUE 18 satellite): per
+    process dump, how much of the interleaving space the explorer
+    covered — schedules executed, sibling branches the sleep-set
+    pruning skipped, failing schedules found, and the decision length
+    of the last minimized repro.  tools/weaver.py leaves a dump when
+    FLAGS_telemetry_dump_dir is set, so CI runs roll up here."""
+    rows = []
+    for d in dumps:
+        m = d.get("metrics", {})
+
+        def val(name, default=0):
+            return (m.get(name) or {}).get("value", default)
+
+        explored = val("weaver_schedules_explored_total")
+        pruned = val("weaver_schedules_pruned_total")
+        if not explored and not pruned:
+            continue
+        rows.append({
+            "label": d.get("label", "?"),
+            "explored": explored,
+            "pruned": pruned,
+            "pruned_pct": round(
+                100.0 * pruned / (explored + pruned), 1)
+            if (explored + pruned) else 0.0,
+            "failures": val("weaver_failures_total"),
+            "minimized_len": val("weaver_minimized_trace_len"),
+        })
+    rows.sort(key=lambda r: r["label"])
+    return rows
+
+
+def format_weaver_table(rows):
+    out = ["%-24s %9s %9s %8s %9s %8s" % (
+        "process", "explored", "pruned", "pruned%", "failures",
+        "min_len")]
+    for r in rows:
+        out.append("%-24s %9d %9d %8.1f %9d %8d" % (
+            r["label"][:24], r["explored"], r["pruned"],
+            r["pruned_pct"], r["failures"], r["minimized_len"]))
     return "\n".join(out)
 
 

@@ -30,6 +30,7 @@ from collections import deque
 
 import numpy as np
 
+from paddle_tpu.core import sanitizer as _san
 from paddle_tpu.distributed.resilience import fault_point
 from paddle_tpu.observability import metrics as _metrics
 from paddle_tpu.observability.trace import TRACER
@@ -107,10 +108,11 @@ class RequestQueue:
 
     def __init__(self):
         self._q = deque()
-        self._cv = threading.Condition()
+        self._cv = _san.make_condition("batcher.queue")
         self._closed = False
 
     def put(self, item):
+        _san.weaver_yield("batcher.queue.put")
         with self._cv:
             if self._closed:
                 raise RuntimeError("queue closed")
@@ -125,6 +127,7 @@ class RequestQueue:
 
     def get(self, timeout=None):
         """Next request, or None on timeout / close-with-empty-queue."""
+        _san.weaver_yield("batcher.queue.get")
         with self._cv:
             if not self._q:
                 self._cv.wait_for(lambda: self._q or self._closed,
@@ -158,7 +161,7 @@ class Dispatcher:
         self.engine_ref = engine_ref
         self.max_wait_us = max_wait_us
         self.label = label
-        self._stop = threading.Event()
+        self._stop = _san.make_event("batcher.dispatch.stop")
         self._thread = threading.Thread(
             target=self._loop, daemon=True,
             name="serve-dispatch-%s" % (label or id(self)))

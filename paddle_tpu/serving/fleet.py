@@ -185,7 +185,7 @@ class SocketTransport:
     def __init__(self, timeout=60.0):
         self._timeout = float(timeout)
         self._idle = {}
-        self._lock = threading.Lock()
+        self._lock = _san.make_lock("fleet.socket_transport")
 
     def _checkout(self, addr):
         with self._lock:
@@ -246,7 +246,7 @@ class LocalTransport:
 
     def __init__(self):
         self._workers = {}
-        self._lock = threading.Lock()
+        self._lock = _san.make_lock("fleet.local_transport")
 
     def register(self, worker):
         addr = "local:%s" % worker.name
@@ -262,6 +262,9 @@ class LocalTransport:
             worker.kill()
 
     def call(self, addr, method, payload, timeout=None):
+        # the wire boundary: under the weaver this is where a frame
+        # hand-off can interleave with the peer's other work
+        _san.weaver_yield("fleet.wire.call")
         with self._lock:
             worker = self._workers.get(addr)
         if worker is None or worker.killed:
@@ -300,9 +303,9 @@ class FleetWorker:
         if warm:
             self.engine.warm_role(role)
         self._draining = False
-        self._killed = threading.Event()
+        self._killed = _san.make_event("fleet.worker.killed")
         self._futures = {}
-        self._flock = threading.Lock()
+        self._flock = _san.make_lock("fleet.worker.futures")
         # prefill admission bound: every conn thread past this count
         # queues on the semaphore, so concurrent prompts can never
         # race the block pool into exhaustion
@@ -529,7 +532,7 @@ class FleetWorker:
             raise KeyError("unknown request id %r" % (rid,))
         # event-based wait: hundreds of outstanding waits must not
         # spin-poll a saturated core out from under the decode loop
-        done = threading.Event()
+        done = _san.make_event("fleet.worker.wait")
         fut.add_done_callback(lambda _f: done.set())
         while True:
             if fut.done():
@@ -644,7 +647,7 @@ class FleetEndpoint:
         self._sock.bind((host, int(port)))
         self._sock.listen(256)
         self.host, self.port = self._sock.getsockname()[:2]
-        self._stop = threading.Event()
+        self._stop = _san.make_event("fleet.server.stop")
         self._thread = threading.Thread(
             target=self._accept_loop, daemon=True,
             name="fleet-endpoint-%s" % worker.name)

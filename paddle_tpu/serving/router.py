@@ -43,6 +43,7 @@ import time
 import zlib
 from concurrent.futures import Future
 
+from paddle_tpu.core import sanitizer as _san
 from paddle_tpu.core.flags import FLAGS
 from paddle_tpu.distributed.resilience import (DeadlineExceeded,
                                                RetryPolicy)
@@ -117,8 +118,8 @@ class _Rec:
         self.max_new = int(max_new)
         self.eos = eos
         self.future = Future()
-        self.done_evt = threading.Event()
-        self.lock = threading.Lock()
+        self.done_evt = _san.make_event("router.rec.done")
+        self.lock = _san.make_lock("router.rec")
         self.t_arrival = time.perf_counter()
         self.t_first = None
         self.owner = None
@@ -157,16 +158,16 @@ class FleetRouter:
         for name, addr, role in workers:
             self._members[name] = _Member(name, addr, role)
         self._expected = max(1, len(self._members))
-        self._mlock = threading.Lock()
+        self._mlock = _san.make_lock("router.members")
         self._recs = {}
-        self._rlock = threading.Lock()
+        self._rlock = _san.make_lock("router.recs")
         self._rid_seq = 0
         self._inflight = {}          # decode name -> outstanding count
         self.credits = int(decode_credits if decode_credits is not None
                            else FLAGS.fleet_decode_credits)
-        self._ccond = threading.Condition(self._rlock)
+        self._ccond = _san.make_condition("router.capacity", self._rlock)
         self._retry = RetryPolicy(base_backoff=0.02, max_backoff=0.5)
-        self._stop = threading.Event()
+        self._stop = _san.make_event("router.stop")
         self._refresh_gauges()
         self._lease_thread = threading.Thread(
             target=self._lease_loop, daemon=True, name="fleet-lease")

@@ -25,6 +25,7 @@ from concurrent.futures import Future
 
 import numpy as np
 
+from paddle_tpu.core import sanitizer as _san
 from paddle_tpu.core.flags import FLAGS
 from paddle_tpu.observability import metrics as _metrics
 
@@ -98,7 +99,7 @@ class InferenceServer:
         self.max_batch = max_batch
         self.max_wait_us = max_wait_us
         self._tenants = {}
-        self._lock = threading.Lock()
+        self._lock = _san.make_lock("serve.server.tenants")
         self._endpoint = None
         self._closed = False
         # Watchtower (ISSUE 13): a serving process with FLAGS_tsdb_dir

@@ -29,6 +29,7 @@ import time
 
 import numpy as np
 
+from paddle_tpu.core import sanitizer as _san
 from paddle_tpu.distributed.fastwire import MAGIC, METHODS
 from paddle_tpu.observability import metrics as _metrics
 
@@ -136,7 +137,7 @@ class PredictEndpoint:
         self._sock.bind((host, int(port)))
         self._sock.listen(256)
         self.host, self.port = self._sock.getsockname()[:2]
-        self._stop = threading.Event()
+        self._stop = _san.make_event("serve.wire.stop")
         self._thread = threading.Thread(target=self._accept_loop,
                                         daemon=True,
                                         name="serve-endpoint")

@@ -432,7 +432,7 @@ def test_trace_report_all_implies_every_rollup(tmp_path, capsys):
     tr = _tool("trace_report")
     # registry covers exactly the known rollups
     assert [r[0] for r in tr.ROLLUPS] == [
-        "numerics", "wire", "serve", "scale", "slo", "moe"]
+        "numerics", "wire", "serve", "scale", "slo", "moe", "weaver"]
     from paddle_tpu.observability.trace import Tracer
     obs_metrics.counter("slo_alerts_total").inc()
     t = Tracer(enabled=True)
@@ -452,7 +452,7 @@ def test_trace_report_all_implies_every_rollup(tmp_path, capsys):
     assert rc == 0
     obj = json.loads(capsys.readouterr().out)
     assert set(obj) == {"phases", "kernels", "numerics", "wire",
-                        "serve", "scale", "slo", "moe"}
+                        "serve", "scale", "slo", "moe", "weaver"}
 
 
 def test_trace_report_slo_rollup_reads_gauges(tmp_path, capsys):

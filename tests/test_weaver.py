@@ -1,0 +1,287 @@
+"""Weaver deterministic-schedule explorer: exhaustive clean proofs on
+HEAD, planted historical races found + minimized + replayed, and the
+rawlock source checker that keeps the interception layer from eroding.
+
+Each planted race is a real bug this repo shipped and fixed:
+
+  pserver/kstale        — PR 10 donated-params window: a trainer read
+                          the param snapshot outside the apply fence.
+  kv_pool/double_free   — PR 12 preemption/finish tie both freeing the
+                          same KV blocks.
+  migrate_kv/dup_migration — PR 16 MigrateKV retry double-admitting a
+                          request id (check/register TOCTOU).
+  router_evict/double_complete — PR 16 lease eviction completing a
+                          request the original worker also completed.
+"""
+import json
+import os
+import subprocess
+import sys
+import threading
+
+import pytest
+
+from paddle_tpu.analysis import checkers, weaver
+from paddle_tpu.core import sanitizer as san
+from paddle_tpu.core.flags import FLAGS
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# bound 2 keeps every scenario tree in the low hundreds of schedules —
+# exhaustive in a couple of seconds, comfortably inside tier-1 budget.
+QUICK = dict(preemption_bound=2, max_schedules=1600)
+
+PLANTED = [
+    ("pserver", "kstale"),
+    ("kv_pool", "double_free"),
+    ("migrate_kv", "dup_migration"),
+    ("router_evict", "double_complete"),
+]
+
+
+@pytest.fixture(autouse=True)
+def _restore_sanitizer():
+    old = FLAGS.sanitizer
+    yield
+    FLAGS.sanitizer = old
+
+
+# ---------------------------------------------------------------------------
+# registry + exhaustive clean HEAD
+# ---------------------------------------------------------------------------
+
+def test_scenario_registry():
+    names = dict(weaver.list_scenarios())
+    for s, p in PLANTED:
+        assert s in names
+        assert p in names[s]
+        assert p in weaver.PLANTS[s]
+
+
+@pytest.mark.parametrize("scenario", [s for s, _ in PLANTED])
+def test_head_explores_clean_exhaustively(scenario):
+    stats, rec = weaver.explore(scenario, plant=None, **QUICK)
+    assert rec is None, (
+        "HEAD %s has a schedule failure: %r sites=%s"
+        % (scenario, rec and rec.failure, rec and rec.sites))
+    assert stats.exhausted, (
+        "%s did not exhaust within %d schedules (explored=%d)"
+        % (scenario, QUICK["max_schedules"], stats.explored))
+    assert stats.failures == 0
+    assert stats.explored > 1           # the tree is non-trivial
+    assert stats.pruned >= 0
+
+
+# ---------------------------------------------------------------------------
+# planted historical races: found, minimized, deterministic, clean@HEAD
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("scenario,plant", PLANTED)
+def test_planted_race_found_minimized_and_replayed(scenario, plant):
+    stats, rec = weaver.explore(scenario, plant=plant, **QUICK)
+    assert rec is not None, "planted %s/%s not found" % (scenario, plant)
+    assert rec.failure is not None
+
+    best, runs = weaver.minimize(
+        scenario, rec.trace, rec.failure_type, plant=plant,
+        preemption_bound=QUICK["preemption_bound"])
+    assert len(best) <= len(rec.trace)
+    assert runs > 0
+
+    # minimized trace still reproduces the same failure type...
+    r1 = weaver.run_schedule(scenario, trace=best, plant=plant,
+                             preemption_bound=QUICK["preemption_bound"])
+    assert r1.failure_type == rec.failure_type
+    assert r1.sites, "failure must name racing sites"
+    # ...deterministically (bit-identical schedule + oplog)...
+    r2 = weaver.run_schedule(scenario, trace=best, plant=plant,
+                             preemption_bound=QUICK["preemption_bound"])
+    assert r2.failure_type == r1.failure_type
+    assert r2.trace == r1.trace
+    assert r2.oplog == r1.oplog
+    # ...while the SAME schedule on HEAD is clean (the fix holds).
+    head = weaver.run_schedule(scenario, trace=best, plant=None,
+                               preemption_bound=QUICK["preemption_bound"])
+    assert head.failure is None, (
+        "HEAD fails under the minimized %s schedule: %r"
+        % (scenario, head.failure))
+
+
+def test_minimized_double_free_is_one_decision():
+    """Pin the canonical minimized schedule: the KV double-free needs
+    exactly one non-default decision (schedule the preemptor into the
+    finisher's check/free gap)."""
+    stats, rec = weaver.explore("kv_pool", plant="double_free", **QUICK)
+    best, _ = weaver.minimize(
+        "kv_pool", rec.trace, rec.failure_type, plant="double_free",
+        preemption_bound=QUICK["preemption_bound"])
+    assert best == [1]
+    assert rec.failure_type == "BufferLifetimeError"
+
+
+def test_planted_sites_name_real_code():
+    """Racing sites must point at scenario/production lines, never
+    weaver internals."""
+    _, rec = weaver.explore("kv_pool", plant="double_free", **QUICK)
+    joined = " ".join(rec.sites)
+    assert "weaver.py" not in joined
+    assert "kv_cache.py" in joined or "scen.kv" in joined
+
+
+def test_artifact_roundtrip(tmp_path):
+    stats, rec = weaver.explore("migrate_kv", plant="dup_migration",
+                                **QUICK)
+    best, _ = weaver.minimize(
+        "migrate_kv", rec.trace, rec.failure_type, plant="dup_migration",
+        preemption_bound=QUICK["preemption_bound"])
+    mrec = weaver.run_schedule("migrate_kv", trace=best,
+                               plant="dup_migration",
+                               preemption_bound=QUICK["preemption_bound"])
+    path = weaver.write_artifact(
+        str(tmp_path), "migrate_kv", "dup_migration", best, mrec,
+        stats=stats, minimized_from=len(rec.trace),
+        preemption_bound=QUICK["preemption_bound"])
+    assert os.path.basename(path).startswith("weaver_migrate_kv_")
+
+    with open(path) as f:
+        payload = json.load(f)
+    assert payload["kind"] == "weaver"
+    assert payload["failure"]["sites"]
+    assert payload["preemption_bound"] == QUICK["preemption_bound"]
+
+    reproduced, rrec, rpayload = weaver.replay_artifact(path)
+    assert reproduced
+    assert rrec.failure_type == payload["failure"]["type"]
+
+
+# ---------------------------------------------------------------------------
+# sanitizer wrapper contract (make_event / make_condition / weaver mode)
+# ---------------------------------------------------------------------------
+
+def test_make_event_condition_plain_when_off():
+    FLAGS.sanitizer = "off"
+    ev = san.make_event("t.ev")
+    assert isinstance(ev, threading.Event)
+    cv = san.make_condition("t.cv")
+    assert isinstance(cv, threading.Condition)
+
+
+def test_weaver_mode_without_active_weaver_degrades_to_plain():
+    FLAGS.sanitizer = "weaver"
+    ev = san.make_event("t.ev2")
+    assert isinstance(ev, threading.Event)
+    lk = san.make_lock("t.lk2")
+    with lk:
+        pass
+    cv = san.make_condition("t.cv2")
+    with cv:
+        cv.notify_all()
+
+
+def test_instrumented_lock_backs_a_condition():
+    """threading.Condition probes _is_owned()/acquire(0) on its lock —
+    the locks-mode InstrumentedLock must satisfy that contract."""
+    FLAGS.sanitizer = "locks"
+    lk = san.make_lock("t.locks.cv")
+    cv = threading.Condition(lk)
+    with cv:
+        assert not cv.wait(timeout=0.01)
+    ev = san.make_event("t.locks.ev")     # locks mode: plain event
+    assert isinstance(ev, threading.Event)
+
+
+def test_adopted_modules_use_wrappers():
+    """The fleet/router/batcher planes must construct through the
+    sanitizer so weaver mode can intercept them."""
+    FLAGS.sanitizer = "locks"
+    from paddle_tpu.serving import batcher, router
+    q = batcher.RequestQueue()
+    assert isinstance(q._cv, threading.Condition)
+    rec = router._Rec("r0", [1, 2], 4, 0)
+    assert isinstance(rec.lock, san.InstrumentedLock)
+    assert isinstance(rec.done_evt, threading.Event)
+
+
+# ---------------------------------------------------------------------------
+# rawlock source checker
+# ---------------------------------------------------------------------------
+
+def _scan_tree(tmp_path, rel, source):
+    p = tmp_path / rel
+    p.parent.mkdir(parents=True, exist_ok=True)
+    p.write_text(source)
+    return checkers.run_source_checkers(
+        [str(tmp_path)], root=str(tmp_path), checkers=["rawlock"])
+
+
+def test_rawlock_flags_raw_constructs(tmp_path):
+    diags = _scan_tree(
+        tmp_path, "paddle_tpu/serving/foo.py",
+        "import threading\n"
+        "L = threading.Lock()\n"
+        "E = threading.Event()\n")
+    assert len(diags) == 2
+    assert all(d.checker == "rawlock" for d in diags)
+    assert "make_lock" in diags[0].suggestion
+    assert "make_event" in diags[1].suggestion
+
+
+def test_rawlock_respects_pragma_and_scope(tmp_path):
+    diags = _scan_tree(
+        tmp_path, "paddle_tpu/serving/bar.py",
+        "import threading\n"
+        "L = threading.Lock()  # rawlock: ok - bootstrap\n")
+    assert diags == []
+    diags = _scan_tree(
+        tmp_path, "paddle_tpu/core/baz.py",
+        "import threading\nL = threading.Lock()\n")
+    assert diags == []                    # out of scope
+
+
+def test_rawlock_allowlist(tmp_path):
+    diags = _scan_tree(
+        tmp_path, "paddle_tpu/serving/kv_cache.py",
+        "import threading\n_LIVE_LOCK = threading.Lock()\n")
+    assert diags == []                    # serving/kv_cache.py::_LIVE_LOCK
+
+
+def test_repo_distributed_and_serving_are_rawlock_clean():
+    diags = checkers.run_source_checkers(
+        [os.path.join(REPO, "paddle_tpu", "serving"),
+         os.path.join(REPO, "paddle_tpu", "distributed")],
+        root=REPO, checkers=["rawlock"])
+    assert diags == [], "\n".join(d.format() for d in diags)
+
+
+def test_rawlock_registered_in_source_registry():
+    assert "rawlock" in checkers.SOURCE_CHECKERS
+    assert "rawlock" not in checkers.CHECKERS   # IR registry untouched
+
+
+# ---------------------------------------------------------------------------
+# CLI smoke (tier-1 budget)
+# ---------------------------------------------------------------------------
+
+def _run_cli(*argv):
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    return subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "weaver.py")]
+        + list(argv),
+        capture_output=True, text=True, timeout=300, env=env, cwd=REPO)
+
+
+def test_cli_quick_smoke():
+    r = _run_cli("--quick")
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "exhausted" in r.stdout
+
+
+def test_cli_plant_writes_artifact_and_replays(tmp_path):
+    r = _run_cli("--scenario", "kv_pool", "--plant", "double_free",
+                 "--preemption-bound", "2", "--out-dir", str(tmp_path))
+    assert r.returncode == 1, r.stdout + r.stderr
+    arts = sorted(tmp_path.glob("weaver_kv_pool_*.json"))
+    assert arts, r.stdout + r.stderr
+    r2 = _run_cli("--replay", str(arts[0]))
+    assert r2.returncode == 0, r2.stdout + r2.stderr
+    assert "REPRODUCED" in r2.stdout

@@ -80,6 +80,11 @@ PRESETS = {
     # planted var name, and a lockgraph_<pid>.json whose cycle lists
     # both planted locks — run_sanitizer_preset()
     "sanitizer": "",
+    # Weaver schedule explorer (ISSUE 18): re-introduce the historical
+    # KV double-free behind --plant and FAIL (rc 3) unless the explorer
+    # finds it, minimizes it, and leaves a weaver_*.json whose failure
+    # names the racing sites — run_weaver_preset()
+    "weaver": "",
 }
 
 # the names the sanitizer preset's plants use (tests/test_sanitizer.py
@@ -379,6 +384,53 @@ def run_preset(name, spec, seed, pytest_args):
     return proc.returncode, time.time() - t0, dump_dir, n_dumps
 
 
+def run_weaver_preset():
+    """The 'weaver' preset is a find-the-planted-race drill: run the
+    schedule explorer (tools/weaver.py) over the kv_pool scenario with
+    the historical double-free re-introduced (--plant double_free) and
+    FAIL (rc 3) unless the run (a) finds a failing schedule (explorer
+    rc 1), and (b) leaves a minimized weaver_kv_pool_*.json artifact
+    whose failure block NAMES the racing sites.  An anonymous failure
+    — found but unattributed — is a FAIL, same contract as the
+    sanitizer preset."""
+    import json
+
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    dump_dir = tempfile.mkdtemp(prefix="fault_weaver_")
+    cmd = [sys.executable, os.path.join(REPO, "tools", "weaver.py"),
+           "--scenario", "kv_pool", "--plant", "double_free",
+           "--preemption-bound", "2", "--out-dir", dump_dir]
+    t0 = time.time()
+    proc = subprocess.run(cmd, cwd=REPO, env=env)
+    rc = proc.returncode
+    named = 0
+    for path in glob.glob(os.path.join(dump_dir, "weaver_kv_pool_*.json")):
+        try:
+            with open(path) as f:
+                rec = json.load(f)
+        except Exception:
+            continue
+        failure = rec.get("failure") or {}
+        sites = failure.get("sites") or []
+        if failure.get("type") and sites \
+                and rec.get("trace") is not None:
+            named += 1
+    if rc == 1 and named > 0:
+        rc = 0                      # found + minimized + attributed
+    elif rc in (0, 1):
+        print("preset 'weaver': planted double_free not attributed "
+              "under %s (explorer rc=%d, named artifacts=%d)"
+              % (dump_dir, rc, named), file=sys.stderr)
+        rc = 3
+    if rc == 0:
+        shutil.rmtree(dump_dir, ignore_errors=True)
+    else:
+        print("preset 'weaver' FAILED (rc=%d); artifacts kept at %s"
+              % (rc, dump_dir), file=sys.stderr)
+    return rc, time.time() - t0, dump_dir, named
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(
         description="fault-injection suite matrix runner")
@@ -442,6 +494,10 @@ def main(argv=None):
             continue
         if name == "serve_fleet":
             rc, secs, dump_dir, n_dumps = run_serve_fleet_preset()
+            rows.append((name, rc, secs, n_dumps))
+            continue
+        if name == "weaver":
+            rc, secs, dump_dir, n_dumps = run_weaver_preset()
             rows.append((name, rc, secs, n_dumps))
             continue
         rc, secs, dump_dir, n_dumps = run_preset(name, spec, args.seed,

@@ -12,6 +12,9 @@ Usage:
     python tools/lint_program.py MODEL --checkers def-use,lifetime
     python tools/lint_program.py MODEL --max-level warning
     python tools/lint_program.py --list-checkers  # registered names
+    python tools/lint_program.py --scan-sources paddle_tpu/serving \\
+        paddle_tpu/distributed               # AST source checkers
+                                             # (e.g. 'rawlock')
 
 MODEL is either a file holding a serialized framework ProgramDesc proto
 (e.g. the ``__model__`` written by fluid.io.save_inference_model) or a
@@ -55,6 +58,12 @@ def main(argv=None):
                     help="print every registered checker (incl. "
                          "'lifetime', the ISSUE 14 donation checker) "
                          "with its one-line description and exit")
+    ap.add_argument("--scan-sources", nargs="+", default=None,
+                    metavar="PATH",
+                    help="run the AST source checkers (e.g. 'rawlock') "
+                         "over .py files/trees instead of linting a "
+                         "ProgramDesc; honors --checkers/--json/"
+                         "--max-level")
     ap.add_argument("--max-level", default="error",
                     choices=["error", "warning", "note"],
                     help="exit non-zero when findings at or above this "
@@ -74,9 +83,31 @@ def main(argv=None):
         for name, fn in analysis.CHECKERS.items():
             doc = (fn.__doc__ or "").strip().splitlines()
             print("%-18s %s" % (name, doc[0] if doc else ""))
+        for name, fn in analysis.SOURCE_CHECKERS.items():
+            doc = (fn.__doc__ or "").strip().splitlines()
+            print("%-18s %s" % (name + " (src)", doc[0] if doc else ""))
         return 0
+
+    checkers = ([c.strip() for c in args.checkers.split(",") if c.strip()]
+                if args.checkers else None)
+
+    if args.scan_sources is not None:
+        diags = analysis.run_source_checkers(
+            args.scan_sources, root=REPO, checkers=checkers)
+        if args.json:
+            print(json.dumps([d.to_dict() for d in diags], indent=2))
+        else:
+            for d in diags:
+                print(d.format())
+            print("scan-sources: %d finding(s) over %s"
+                  % (len(diags), ", ".join(args.scan_sources)))
+        threshold = Severity.rank(args.max_level)
+        return 1 if any(Severity.rank(d.severity) >= threshold
+                        for d in diags) else 0
+
     if args.model is None:
-        ap.error("MODEL is required unless --list-checkers is given")
+        ap.error("MODEL is required unless --list-checkers or "
+                 "--scan-sources is given")
 
     try:
         program, path = load_program(args.model, args.model_filename)
@@ -85,8 +116,6 @@ def main(argv=None):
               file=sys.stderr)
         return 2
 
-    checkers = ([c.strip() for c in args.checkers.split(",") if c.strip()]
-                if args.checkers else None)
     diags = analysis.verify_program(program, checkers)
 
     if args.json:

@@ -437,6 +437,28 @@ def _measure_slo_us(repeats=3, iters=200, samples=600):
 
 SANITIZER_SITES_PER_STEP = 4
 
+# weaver_yield hooks + the make_lock/make_event mode reads a prepared
+# step's worth of serving/pserver traffic can cross (queue put/get,
+# wire call, apply window) — deliberately generous, like SITES_PER_STEP
+WEAVER_SITES_PER_STEP = 6
+
+
+def _measure_weaver_probe_ns(repeats=3, iters=200000):
+    """ISSUE 18: the FLAGS_sanitizer!=weaver cost of a weaver_yield
+    site is ONE module-attribute read + branch
+    (``core/sanitizer.weaver_probe``, decomposed exactly like
+    disabled_probe) — micro-timed, then gated as
+    probe x WEAVER_SITES_PER_STEP over the measured prepared step."""
+    from paddle_tpu.core import sanitizer as san
+
+    san.weaver_probe(1000)                # warm
+    best = float("inf")
+    for _ in range(repeats):
+        t0 = time.perf_counter_ns()
+        san.weaver_probe(iters)
+        best = min(best, (time.perf_counter_ns() - t0) / iters)
+    return best
+
 
 def _measure_sanitizer_us(steps=None, repeats=3):
     """Sanitizer gate (ISSUE 14 satellite), decomposed like the
@@ -630,6 +652,10 @@ def main(argv=None):
     san_frac = (san_probe_ns * SANITIZER_SITES_PER_STEP / 1e3) \
         / san_off_us
     san_limit = float(os.environ.get("SANITIZER_OVERHEAD_MAX", dflt))
+    weaver_probe_ns = _measure_weaver_probe_ns()
+    weaver_frac = (weaver_probe_ns * WEAVER_SITES_PER_STEP / 1e3) \
+        / san_off_us
+    weaver_limit = float(os.environ.get("WEAVER_OVERHEAD_MAX", dflt))
     ring_us = _measure_ring_us()
     ring_frac = (probe_ns * RING_SITES_PER_STEP / 1e3) / ring_us
     ring_limit = float(os.environ.get("RING_OVERHEAD_MAX", dflt))
@@ -692,6 +718,15 @@ def main(argv=None):
             max(0.0, san_buf_us - san_off_us) / san_off_us, 5),
         "sanitizer_overhead_frac": round(san_frac, 6),
         "sanitizer_limit": san_limit,
+        # ISSUE 18: weaver scheduling hooks (weaver_yield + the
+        # make_lock/make_event mode branch) — off-path is one module-
+        # attribute read per site, gated like every sanitizer hook
+        "weaver_probe_ns_per_site": round(weaver_probe_ns, 1),
+        "weaver_sites_per_step": WEAVER_SITES_PER_STEP,
+        "weaver_overhead_frac": round(
+            (weaver_probe_ns * WEAVER_SITES_PER_STEP / 1e3)
+            / san_off_us, 6),
+        "weaver_limit": weaver_limit,
         # ISSUE 15: ring-attention launch-site spans (trace-time, like
         # every Pallas site) — probe x sites over the measured ring
         # fwd+bwd step
@@ -706,6 +741,7 @@ def main(argv=None):
                and tsdb_frac < tsdb_limit
                and slo_frac < slo_limit
                and san_frac < san_limit
+               and weaver_frac < weaver_limit
                and ring_frac < ring_limit),
     }
     # gate name -> gauge (+ one tsdb sample when FLAGS_tsdb_dir is

@@ -88,6 +88,14 @@ ROLLUPS = (
      "print the MoE routing rollup (capacity-factor stats from "
      "parallel/moe.py: per-expert load distribution, dropped-token "
      "fraction, router entropy per process — ISSUE 15 rider)"),
+    ("weaver", "weaver_rows", "format_weaver_table",
+     "weaver rollup (schedules explored/pruned / failing schedules / "
+     "minimized repro length per process):",
+     "print the schedule-exploration rollup (weaver explorer "
+     "coverage: schedules executed, sleep-set-pruned branches, "
+     "failing schedules found, minimized decision-trace length per "
+     "process — ISSUE 18); tools/weaver.py leaves a dump when "
+     "FLAGS_telemetry_dump_dir is set"),
 )
 
 

@@ -1,0 +1,220 @@
+#!/usr/bin/env python
+"""Drive the Weaver schedule explorer (paddle_tpu/analysis/weaver.py)
+over the pserver / KV-pool / MigrateKV / router protocol scenarios.
+
+Usage:
+    python tools/weaver.py --list                     # scenario table
+    python tools/weaver.py                            # explore all, HEAD
+    python tools/weaver.py --scenario kv_pool --plant double_free
+    python tools/weaver.py --replay weaver_kv_pool_0.json
+    python tools/weaver.py --quick                    # tier-1 smoke
+    python tools/weaver.py --mode random --max-schedules 2000 --seed 7
+
+Exploration enumerates every schedule up to --preemption-bound
+preemptions (DFS with sleep-set pruning; 'none' lifts the bound), or
+samples seeded random walks with --mode random.  A failing schedule is
+delta-debug minimized and written as a replayable
+``weaver_<scenario>_<n>.json`` artifact naming the racing sites;
+--replay re-executes an artifact bit-deterministically and reports
+whether the pinned failure reproduced.
+
+Exit status: 0 every explored scenario is clean (or --replay
+reproduced its failure), 1 a failure was found (or --replay did not
+reproduce), 2 usage error.
+"""
+import argparse
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def _out_dir(args):
+    if args.out_dir:
+        return args.out_dir
+    from paddle_tpu.core.flags import FLAGS
+    return FLAGS.telemetry_dump_dir or "."
+
+
+def _dump_metrics():
+    # leave one flight snapshot so trace_report.py --weaver has a
+    # rollup source (best-effort, dump-dir gated like every artifact)
+    try:
+        from paddle_tpu.core.flags import FLAGS
+        if FLAGS.telemetry_dump_dir:
+            from paddle_tpu.observability import flight
+            flight.dump("weaver")
+    except Exception:
+        pass
+
+
+def cmd_list(W):
+    print("%-14s %s" % ("scenario", "plants"))
+    for name, plants in W.list_scenarios():
+        print("%-14s %s" % (name, ", ".join(plants) or "-"))
+    return 0
+
+
+def cmd_replay(W, args):
+    reproduced, rec, payload = W.replay_artifact(args.replay)
+    out = {
+        "artifact": args.replay,
+        "scenario": payload.get("scenario"),
+        "plant": payload.get("plant"),
+        "want_failure": (payload.get("failure") or {}).get("type"),
+        "got_failure": rec.failure_type,
+        "reproduced": reproduced,
+        "decisions": rec.decisions,
+    }
+    if args.json:
+        print(json.dumps(out, indent=1, sort_keys=True))
+    else:
+        print("replay %s: %s (want %s, got %s, %d decisions)"
+              % (args.replay,
+                 "REPRODUCED" if reproduced else "NOT reproduced",
+                 out["want_failure"], out["got_failure"],
+                 rec.decisions))
+        if rec.failure is not None:
+            for s in rec.sites:
+                print("  site: %s" % s)
+    return 0 if reproduced else 1
+
+
+def explore_one(W, name, args, results):
+    pb = args.preemption_bound
+    t0 = time.time()
+    stats, failing = W.explore(
+        name, plant=args.plant, mode=args.mode,
+        max_schedules=args.max_schedules,
+        max_decisions=args.max_decisions, seed=args.seed,
+        preemption_bound=pb)
+    row = {
+        "scenario": name,
+        "plant": args.plant,
+        "mode": args.mode,
+        "explored": stats.explored,
+        "pruned": stats.pruned,
+        "exhausted": stats.exhausted,
+        "truncated": stats.truncated,
+        "seconds": round(time.time() - t0, 3),
+        "failure": failing.failure_type if failing else None,
+        "artifact": None,
+        "minimized_len": None,
+    }
+    if failing is not None:
+        trace = failing.trace
+        if not args.no_minimize:
+            trace, _ = W.minimize(name, failing.trace,
+                                  failing.failure_type,
+                                  plant=args.plant, preemption_bound=pb)
+        rec = W.run_schedule(name, trace=trace, plant=args.plant,
+                             preemption_bound=pb)
+        path = W.write_artifact(_out_dir(args), name, args.plant, trace,
+                                rec, stats=stats,
+                                minimized_from=len(failing.trace),
+                                preemption_bound=pb)
+        row["artifact"] = path
+        row["minimized_len"] = len(trace)
+        row["sites"] = rec.sites
+    results.append(row)
+    if not args.json:
+        status = row["failure"] or (
+            "clean (exhausted)" if row["exhausted"] else "clean")
+        print("%-14s %-16s %6d explored %6d pruned %6.1fs  %s"
+              % (name, args.plant or "-", row["explored"], row["pruned"],
+                 row["seconds"], status))
+        if row["artifact"]:
+            print("  minimized to %d decisions -> %s"
+                  % (row["minimized_len"], row["artifact"]))
+            for s in row.get("sites", ()):
+                print("  site: %s" % s)
+    return 1 if row["failure"] else 0
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="systematic concurrency exploration of the "
+                    "pserver/fleet/KV-pool protocol scenarios")
+    ap.add_argument("--scenario", default="all",
+                    help="scenario name or 'all' (see --list)")
+    ap.add_argument("--plant", default=None,
+                    help="re-introduce a historical race in the "
+                         "scenario (see --list for names)")
+    ap.add_argument("--mode", choices=("dfs", "random"), default="dfs")
+    ap.add_argument("--max-schedules", type=int, default=4000)
+    ap.add_argument("--max-decisions", type=int, default=None)
+    ap.add_argument("--preemption-bound", default=None,
+                    help="max preemptions per schedule (int or 'none'; "
+                         "default %d)" % 3)
+    ap.add_argument("--seed", type=int, default=0,
+                    help="random-walk seed (--mode random)")
+    ap.add_argument("--out-dir", default=None,
+                    help="artifact directory (default "
+                         "FLAGS_telemetry_dump_dir or .)")
+    ap.add_argument("--replay", default=None, metavar="ARTIFACT",
+                    help="re-execute a weaver_*.json artifact")
+    ap.add_argument("--quick", action="store_true",
+                    help="budgeted tier-1 smoke: every scenario on "
+                         "HEAD, preemption bound 2")
+    ap.add_argument("--no-minimize", action="store_true")
+    ap.add_argument("--list", action="store_true")
+    ap.add_argument("--json", action="store_true")
+    args = ap.parse_args(argv)
+
+    from paddle_tpu.analysis import weaver as W
+
+    if args.list:
+        return cmd_list(W)
+    if args.replay:
+        return cmd_replay(W, args)
+
+    if args.preemption_bound is None:
+        args.preemption_bound = W.DEFAULT_PREEMPTION_BOUND
+    elif str(args.preemption_bound).lower() == "none":
+        args.preemption_bound = None
+    else:
+        args.preemption_bound = int(args.preemption_bound)
+    if args.max_decisions is None:
+        args.max_decisions = W.DEFAULT_MAX_DECISIONS
+    if args.quick:
+        # the tier-1 smoke: small bound, capped tree, HEAD only —
+        # seconds, not minutes
+        args.preemption_bound = min(args.preemption_bound or 2, 2)
+        args.max_schedules = min(args.max_schedules, 1200)
+        args.plant = None
+
+    if args.scenario == "all":
+        names = [n for n, _ in W.list_scenarios()]
+        if args.plant:
+            names = [n for n in names
+                     if args.plant in dict(W.list_scenarios())[n]]
+            if not names:
+                print("no scenario has plant %r" % args.plant,
+                      file=sys.stderr)
+                return 2
+    else:
+        if args.scenario not in W.SCENARIOS:
+            print("unknown scenario %r (have: %s)"
+                  % (args.scenario, ", ".join(W.SCENARIOS)),
+                  file=sys.stderr)
+            return 2
+        names = [args.scenario]
+
+    results = []
+    rc = 0
+    for name in names:
+        rc |= explore_one(W, name, args, results)
+    _dump_metrics()
+    if args.json:
+        print(json.dumps({"results": results, "rc": rc}, indent=1,
+                         sort_keys=True))
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())
