@@ -202,13 +202,20 @@ def check_block_lifetime(du, bi, extra_donated=()):
     return diags
 
 
-def check_serving_fetches(fetch_names, donated_state, site="serving"):
+def check_serving_fetches(fetch_names, donated_state, site="serving",
+                          shared_state=()):
     """Program-free form of the fetch rule for serving state that never
     lives in a ProgramDesc: a tenant's fetch list must not name the
     donated KV pool (or any other donated device state) — the returned
     handle would alias a buffer the next decode step consumes (the
-    PR 11 rebind contract).  Returns diagnostics."""
+    PR 11 rebind contract).  ``shared_state`` extends the rule to the
+    prefix cache (ISSUE 19): state whose blocks are refcount-shared
+    across tenants must not be fetched either — the handle aliases
+    OTHER tenants' prefix, and the pool's copy-on-write covers only
+    engine writes through ``append_kv``, never a caller-held handle.
+    Returns diagnostics."""
     donated = set(donated_state)
+    shared = set(shared_state) - donated
     diags = []
     for n in fetch_names:
         if n in donated:
@@ -221,4 +228,17 @@ def check_serving_fetches(fetch_names, donated_state, site="serving"):
                 suggestion="fetch through a copying debug entry (the "
                            "separately-compiled logits path), never "
                            "the live pool"))
+        elif n in shared:
+            diags.append(Diagnostic(
+                "lifetime", Severity.ERROR,
+                "serving fetch aliases refcount-shared state %r of %s: "
+                "the prefix blocks behind the handle belong to every "
+                "tenant sharing the prefix, and copy-on-write guards "
+                "only the engine's own writes — a caller mutating the "
+                "fetched handle corrupts the other tenants' cache"
+                % (n, site),
+                var=n, op_type="fetch",
+                suggestion="fetch a per-tenant copy, or drop to a "
+                           "private (refcount-1) block via the pool's "
+                           "COW path before handing out the buffer"))
     return diags

@@ -856,6 +856,7 @@ SCENARIOS = collections.OrderedDict()
 PLANTS = {
     "pserver": ("kstale",),
     "kv_pool": ("double_free",),
+    "kv_refcount": ("dropped_decref",),
     "migrate_kv": ("dup_migration",),
     "router_evict": ("double_complete",),
 }
@@ -972,6 +973,49 @@ def _build_kv_pool(plant=None):
     return {"tasks": [("finisher", free_once("finisher")),
                       ("preemptor", free_once("preemptor")),
                       ("churner", churner)],
+            "check": check, "teardown": pool.close}
+
+
+@scenario("kv_refcount")
+def _build_kv_refcount(plant=None):
+    """(b') prefix-sharing refcount release (ISSUE 19): two sequences
+    hold references to one shared prefix block and release them
+    concurrently.  On HEAD the pool OWNS the count — every holder
+    just calls ``free`` (a decref) and the terminal decref returns the
+    block, on any schedule.  plant='dropped_decref' re-introduces the
+    pre-refcount design: an external holder count whose
+    read-modify-write is split across a dispatch boundary, so two
+    releases can both read 2 and both write 1 — the decref is LOST,
+    the terminal free never runs, and the prefix block leaks.  The
+    leak only manifests when a preemption lands inside the gap, which
+    is exactly what the explorer is for."""
+    from paddle_tpu.serving import kv_cache
+    pool = kv_cache.BlockPool(8, 16)
+    shared = pool.alloc(1)
+    state = {"holders": 2}
+    if plant != "dropped_decref":
+        pool.share(shared)      # real refcount: one ref per holder
+
+    def holder(tag):
+        def run():
+            _san.weaver_yield("scen.kvref.%s.decode" % tag)
+            if plant == "dropped_decref":
+                v = state["holders"]
+                _san.weaver_yield("scen.kvref.%s.gap" % tag)
+                state["holders"] = v - 1
+                if v - 1 == 0:
+                    pool.free(list(shared))
+            else:
+                pool.free(list(shared))   # decref; the pool keeps count
+        return run
+
+    def check():
+        assert pool.used_blocks == 0, (
+            "refcount leak: %d blocks still referenced after both "
+            "holders released" % pool.used_blocks)
+
+    return {"tasks": [("holder_a", holder("a")),
+                      ("holder_b", holder("b"))],
             "check": check, "teardown": pool.close}
 
 

@@ -275,6 +275,30 @@ define_flag("serve_kv_blocks", 512,
             "counts serve_kv_alloc_failures_total and preempts the "
             "youngest sequence (serve_kv_preemptions_total) — "
             "recompute-style eviction, requeued at the queue front")
+define_flag("serve_prefix_cache", False,
+            "generative serving (ISSUE 19): copy-on-write prefix KV "
+            "reuse.  On, a tenant keeps a radix index over prompt "
+            "token ids at block granularity: admission shares the "
+            "cached prefix blocks by refcount (serve_kv_blocks_shared "
+            "gauge), prefill computes and stores ONLY the un-cached "
+            "suffix (serve_kv_prefix_hits gauge / "
+            "serve_prefix_tokens_* counters), a shared block written "
+            "mid-block is copied first (COW, "
+            "serve_kv_cow_copies_total), and finished prompts' blocks "
+            "park in a refcount-zero LRU instead of the free list — "
+            "evicted only under allocation pressure.  Per-tenant "
+            "override: load_generative(prefix_cache=...)")
+define_flag("serve_spec_k", 0,
+            "generative serving (ISSUE 19): speculative decoding "
+            "draft depth.  k > 0 makes the decode loop propose k "
+            "tokens per iteration from the tenant's draft LM (a "
+            "load_generative(draft=...) requirement) and verify all "
+            "k in ONE batched target dispatch — greedy acceptance "
+            "keeps the longest matching prefix plus the target's "
+            "correction token, so output stays bit-identical to "
+            "non-speculative greedy decode (the certificate in "
+            "tools/serve_bench.py).  0 (default) is plain one-token "
+            "decode.  Per-tenant override: load_generative(spec_k=...)")
 define_flag("dist_compress", "",
             "gradient compression codec for the pserver wire "
             "(distributed/compress.py): '' (raw frames, the default), "

@@ -324,6 +324,11 @@ def serve_rows(dumps):
             return (m.get(name) or {}).get(field, default)
 
         slots = val("serve_decode_slots_total")
+        pfx_tok = val("serve_prefix_tokens_total")
+        pfx_cached = val("serve_prefix_tokens_cached_total")
+        proposed = val("serve_spec_proposed_total")
+        draft_us = val("serve_spec_draft_us_total")
+        verify_us = val("serve_spec_verify_us_total")
         rows.append({
             "label": d.get("label", "?"),
             "requests": val("serve_requests_total"),
@@ -343,26 +348,42 @@ def serve_rows(dumps):
             "kv_blocks_total": val("serve_kv_blocks_total"),
             "kv_alloc_failures": val("serve_kv_alloc_failures_total"),
             "preemptions": val("serve_kv_preemptions_total"),
+            # prefix cache + speculative decode (ISSUE 19)
+            "prefix_hit_rate_pct": round(100.0 * pfx_cached / pfx_tok,
+                                         1) if pfx_tok else 0.0,
+            "blocks_shared": val("serve_kv_blocks_shared"),
+            "cow_copies": val("serve_kv_cow_copies_total"),
+            "spec_accept_rate": round(
+                val("serve_spec_accepted_total") / proposed, 3)
+            if proposed else 0.0,
+            "draft_overhead_pct": round(
+                100.0 * draft_us / (draft_us + verify_us), 1)
+            if draft_us + verify_us else 0.0,
         })
     rows.sort(key=lambda r: r["label"])
     return rows
 
 
 def format_serve_table(rows):
-    out = ["%-20s %7s %8s %8s %6s %9s %9s %8s %8s %9s %7s %8s" % (
-        "process", "reqs", "tokens", "steps", "occ%", "ttft_p50",
-        "ttft_p99", "itl_p50", "itl_p99", "kv_used", "allocF",
-        "preempt")]
+    out = ["%-20s %7s %8s %8s %6s %9s %9s %8s %8s %9s %7s %8s "
+           "%7s %6s %6s %7s" % (
+               "process", "reqs", "tokens", "steps", "occ%", "ttft_p50",
+               "ttft_p99", "itl_p50", "itl_p99", "kv_used", "allocF",
+               "preempt", "pfxHit%", "shared", "accept", "draft%")]
     for r in rows:
         out.append("%-20s %7d %8d %8d %6.1f %9.3f %9.3f %8.3f %8.3f "
-                   "%5d/%-3d %7d %8d" % (
+                   "%5d/%-3d %7d %8d %7.1f %6d %6.3f %7.1f" % (
                        r["label"][:20],
                        r["requests"] + r["gen_requests"], r["tokens"],
                        r["decode_steps"], r["decode_occupancy_pct"],
                        r["ttft_p50_ms"], r["ttft_p99_ms"],
                        r["itl_p50_ms"], r["itl_p99_ms"],
                        r["kv_blocks_used"], r["kv_blocks_total"],
-                       r["kv_alloc_failures"], r["preemptions"]))
+                       r["kv_alloc_failures"], r["preemptions"],
+                       r.get("prefix_hit_rate_pct", 0.0),
+                       r.get("blocks_shared", 0),
+                       r.get("spec_accept_rate", 0.0),
+                       r.get("draft_overhead_pct", 0.0)))
     return "\n".join(out)
 
 

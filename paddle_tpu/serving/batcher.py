@@ -355,12 +355,21 @@ class TokenScheduler:
     - the victim is never an older sequence (oldest-first completion
       keeps head-of-line latency bounded), and a lone sequence that
       cannot grow out of an EMPTY pool is a configuration error
-      surfaced to the caller, not an infinite preempt-readmit loop.
+      surfaced to the caller, not an infinite preempt-readmit loop;
+    - with a prefix index attached (ISSUE 19,
+      generative.PrefixCache), admission takes the PARTIALLY-CACHED
+      branch: the index shares the prompt's already-resident prefix
+      blocks by refcount and allocates only the suffix, so the pool
+      bar for a mostly-cached prompt is its few fresh blocks — a
+      cache-hit prompt admits under pressure that would requeue a cold
+      one.  The suffix-only prefill that completes the contract is the
+      engine's (``seq.cached_len`` carries the boundary).
     """
 
-    def __init__(self, pool, max_batch):
+    def __init__(self, pool, max_batch, prefix_cache=None):
         self.pool = pool
         self.max_batch = int(max_batch)
+        self.prefix_cache = prefix_cache
 
     def try_admit(self, queue, n_running):
         """Pop and return the requests admissible RIGHT NOW (their
@@ -375,6 +384,12 @@ class TokenScheduler:
                 # already landed in blocks allocated by the receive
                 # path — admission is just batch membership, a second
                 # alloc here would leak the originals
+                admitted.append(req)
+                continue
+            if self.prefix_cache is not None:
+                if not self.prefix_cache.acquire(req):
+                    queue.put_front([req])  # keeps its arrival stamp
+                    break
                 admitted.append(req)
                 continue
             blocks = self.pool.alloc(self.pool.blocks_for(

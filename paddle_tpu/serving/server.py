@@ -163,19 +163,30 @@ class InferenceServer:
         return shadow
 
     def load_generative(self, name, config, params, quant="",
-                        kv_blocks=None, warm=True):
+                        kv_blocks=None, warm=True, prefix_cache=None,
+                        spec_k=None, draft=None):
         """Load a generative (autoregressive decode) tenant: a
         GenerativeEngine built from ``(config, params)`` — e.g.
         ``generative.tiny_lm`` output — with int8 weight quantization
         gated per tenant via ``quant='int8'``.  Requests go through
         ``generate()``; the tenant runs token-level continuous batching
-        (serving/generative.py), not the predict dispatcher."""
+        (serving/generative.py), not the predict dispatcher.
+
+        ISSUE 19 knobs (default to FLAGS_serve_prefix_cache /
+        FLAGS_serve_spec_k): ``prefix_cache=True`` turns on
+        copy-on-write prefix KV reuse for this tenant; ``spec_k > 0``
+        turns on speculative decoding, which REQUIRES
+        ``draft=(config, params)`` — a small LM with the same vocab
+        and paging geometry, load-time state like the target's own
+        weights (there is no hot-swap path for the draft)."""
         from .generative import GenerativeEngine
 
         self._check_loadable(name)
         engine = GenerativeEngine(config, params, quant=quant,
                                   kv_blocks=kv_blocks, name=name,
-                                  place=self.place, warm=warm)
+                                  place=self.place, warm=warm,
+                                  prefix_cache=prefix_cache,
+                                  spec_k=spec_k, draft=draft)
         try:
             with self._lock:
                 self._check_loadable(name, locked=True)

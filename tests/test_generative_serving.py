@@ -412,6 +412,115 @@ def test_prefill_failure_fails_only_that_request():
         assert len(res["tokens"]) == 4
 
 
+# ------------------------------------------------ speculative decoding
+
+def _spec_pair(seed=13):
+    """Target + 1-layer draft sharing vocab/paging geometry (the
+    draft-contract _init_draft enforces)."""
+    cfg, params = tiny_lm(seed, **CFG_KW)
+    dcfg, dparams = tiny_lm(seed + 1, **dict(CFG_KW, n_layers=1))
+    return cfg, params, dcfg, dparams
+
+
+def test_spec_accept_rate_accounting():
+    """The serve_spec_* counters must add up against the emission
+    contract: per (round, sequence) the engine proposes k, accepts
+    m <= k, emits m+1 — so proposed == k * verify-rows, accepted stays
+    within proposed, and delivered tokens land between the exact
+    emission sum and that sum minus the worst-case final-round
+    overshoot trim (k per request)."""
+    k = 3
+    cfg, params, dcfg, dparams = _spec_pair()
+    prompts = _prompts(21, 3, lo=4, hi=10)
+    metrics.zero_all()
+    with InferenceServer() as srv:
+        srv.load_generative("g", cfg, params, kv_blocks=64, warm=False,
+                            spec_k=k, draft=(dcfg, dparams))
+        res = [srv.generate("g", p, max_new_tokens=12).result(300)
+               for p in prompts]
+    rounds = metrics.counter("serve_spec_rounds_total").value
+    proposed = metrics.counter("serve_spec_proposed_total").value
+    accepted = metrics.counter("serve_spec_accepted_total").value
+    rows = metrics.counter("serve_decode_rows_total").value
+    prefills = metrics.counter("serve_prefills_total").value
+    assert rounds > 0, "spec engine never ran a speculative round"
+    assert proposed == k * rows
+    assert 0 <= accepted <= proposed
+    delivered = sum(len(r["tokens"]) for r in res)
+    emitted = prefills + accepted + rows      # 1 + sum(m_i + 1)
+    assert delivered <= emitted <= delivered + k * len(prompts)
+    # draft/verify wall-time observability rides the same gate
+    assert metrics.counter("serve_spec_verify_us_total").value > 0
+
+
+def test_spec_k0_degenerate_equals_plain():
+    """spec_k=0 IS plain decode: identical tokens, no draft engine,
+    and the serve_spec_* counters never move."""
+    cfg, params, _, _ = _spec_pair()
+    prompts = _prompts(23, 2, lo=4, hi=9)
+    with InferenceServer() as srv:
+        srv.load_generative("g", cfg, params, kv_blocks=64, warm=False)
+        base = [srv.generate("g", p, max_new_tokens=10).result(300)
+                ["tokens"] for p in prompts]
+    metrics.zero_all()
+    with InferenceServer() as srv:
+        eng = srv.load_generative("g", cfg, params, kv_blocks=64,
+                                  warm=False, spec_k=0)
+        assert eng.draft is None
+        k0 = [srv.generate("g", p, max_new_tokens=10).result(300)
+              ["tokens"] for p in prompts]
+    assert k0 == base
+    assert metrics.counter("serve_spec_rounds_total").value == 0
+    assert metrics.counter("serve_spec_proposed_total").value == 0
+
+
+def test_spec_certified_greedy_parity():
+    """THE spec-decode correctness contract on the bench LM: the
+    speculative token stream is bit-identical to plain greedy decode
+    and the per-round acceptance accounting closes exactly —
+    serve_bench documents the same certificate in SERVE_BENCH.json."""
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    try:
+        import serve_bench
+    finally:
+        sys.path.pop(0)
+    rec = serve_bench._gen_spec_parity(steps=24, k=3, fat=512)
+    assert rec["identical"], rec
+    assert rec["accounting_ok"], rec
+    assert rec["certified"], rec
+    assert rec["rounds"] > 0
+    assert 0.0 <= rec["accept_rate"] <= 1.0
+
+
+def test_spec_draft_target_bucket_ladder_coexistence():
+    """Draft and target run separate StepCache ladders (propose/verify
+    vs decode) inside one engine: staggered admissions through the
+    spec engine must stay bit-identical to plain solo decode, with
+    both ladders demonstrably compiled-through."""
+    cfg, params, dcfg, dparams = _spec_pair()
+    prompts = _prompts(29, 3, lo=4, hi=10)
+    with InferenceServer() as srv:
+        srv.load_generative("g", cfg, params, kv_blocks=64, warm=False)
+        solo = [srv.generate("g", p, max_new_tokens=14).result(300)
+                ["tokens"] for p in prompts]
+    metrics.zero_all()
+    with InferenceServer() as srv:
+        eng = srv.load_generative("g", cfg, params, kv_blocks=64,
+                                  warm=False, spec_k=3,
+                                  draft=(dcfg, dparams))
+        futs = []
+        for p in prompts:
+            futs.append(srv.generate("g", p, max_new_tokens=14))
+            time.sleep(0.02)   # stagger: admissions land mid-round
+        batched = [f.result(300)["tokens"] for f in futs]
+        assert eng._verify.warm_keys, "target verify ladder never used"
+        assert eng.draft._propose.warm_keys, \
+            "draft propose ladder never used"
+    for i, (s, b) in enumerate(zip(solo, batched)):
+        assert s == b, "request %d diverged under spec decode: " \
+            "solo %r vs spec %r" % (i, s, b)
+
+
 # ------------------------------------------------ int8 serving parity
 
 def test_int8_decode_greedy_parity():
@@ -462,4 +571,40 @@ def test_serve_bench_quick_generate_smoke():
     # the hard guarantee holds even in the smoke: int8 decode is
     # token-exact with fp32 over the smoke's parity horizon
     assert gen["int8"]["parity_ok"] is True
+    assert gen["kv"]["blocks_used_after_drain"] == 0
+
+
+@pytest.mark.parametrize("feature_env,check", [
+    ({"SVB_GEN_PREFIX_CACHE": "1"}, "prefix"),
+    ({"SVB_GEN_SPEC_K": "2"}, "spec"),
+])
+def test_serve_bench_quick_generate_feature_smoke(feature_env, check):
+    """The generate smoke parametrized over the ISSUE 19 features: the
+    SAME Poisson trace with the prefix cache on / a draft speculating
+    must complete with zero drops, a drained pool, AND the feature
+    demonstrably engaged (hits > 0 / rounds > 0 in the artifact's
+    features block) — not just schema presence."""
+    env = dict(os.environ)
+    env.update(JAX_PLATFORMS="cpu", SVB_MAX_BATCH="4",
+               SVB_GEN_KV_BLOCKS="64", SVB_GEN_MAX_NEW="8",
+               SVB_GEN_PARITY_STEPS="16")
+    env.update(feature_env)
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "serve_bench.py"),
+         "--quick", "--mode", "generate", "--seconds", "0.8"],
+        capture_output=True, text=True, timeout=560, env=env, cwd=REPO)
+    assert proc.returncode in (0, 1), proc.stderr[-2000:]
+    rec = json.loads(proc.stdout.strip().splitlines()[-1])
+    gen = rec["generate"]
+    feats = gen["features"]
+    if check == "prefix":
+        assert feats["prefix_cache"] is True
+        assert feats["prefix_hits"] > 0, feats
+        assert feats["prefix_tokens_cached"] > 0, feats
+    else:
+        assert feats["spec_k"] == 2
+        assert feats["spec_rounds"] > 0, feats
+        assert 0.0 <= feats["spec_accept_rate"] <= 1.0
+    assert gen["poisson"]["completed"] == gen["poisson"]["n_requests"]
+    assert gen["drop"]["zero_dropped"] is True
     assert gen["kv"]["blocks_used_after_drain"] == 0

@@ -8,6 +8,10 @@ Each planted race is a real bug this repo shipped and fixed:
                           the param snapshot outside the apply fence.
   kv_pool/double_free   — PR 12 preemption/finish tie both freeing the
                           same KV blocks.
+  kv_refcount/dropped_decref — ISSUE 19 pre-refcount prefix release:
+                          two holders' read-modify-write of an external
+                          holder count loses a decref and leaks the
+                          shared block.
   migrate_kv/dup_migration — PR 16 MigrateKV retry double-admitting a
                           request id (check/register TOCTOU).
   router_evict/double_complete — PR 16 lease eviction completing a
@@ -34,6 +38,7 @@ QUICK = dict(preemption_bound=2, max_schedules=1600)
 PLANTED = [
     ("pserver", "kstale"),
     ("kv_pool", "double_free"),
+    ("kv_refcount", "dropped_decref"),
     ("migrate_kv", "dup_migration"),
     ("router_evict", "double_complete"),
 ]

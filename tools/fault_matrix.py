@@ -85,6 +85,11 @@ PRESETS = {
     # finds it, minimizes it, and leaves a weaver_*.json whose failure
     # names the racing sites — run_weaver_preset()
     "weaver": "",
+    # Prefix-cache refcounts (ISSUE 19): same drill over the
+    # kv_refcount scenario with the pre-refcount lost-decref release
+    # re-introduced (--plant dropped_decref) — the shared prefix block
+    # leaks unless the terminal decref runs exactly once
+    "kv_refcount": "",
 }
 
 # the names the sanitizer preset's plants use (tests/test_sanitizer.py
@@ -384,28 +389,31 @@ def run_preset(name, spec, seed, pytest_args):
     return proc.returncode, time.time() - t0, dump_dir, n_dumps
 
 
-def run_weaver_preset():
+def run_weaver_preset(scenario="kv_pool", plant="double_free"):
     """The 'weaver' preset is a find-the-planted-race drill: run the
-    schedule explorer (tools/weaver.py) over the kv_pool scenario with
-    the historical double-free re-introduced (--plant double_free) and
-    FAIL (rc 3) unless the run (a) finds a failing schedule (explorer
-    rc 1), and (b) leaves a minimized weaver_kv_pool_*.json artifact
-    whose failure block NAMES the racing sites.  An anonymous failure
-    — found but unattributed — is a FAIL, same contract as the
-    sanitizer preset."""
+    schedule explorer (tools/weaver.py) over ``scenario`` with a
+    historical race re-introduced (``--plant``) and FAIL (rc 3) unless
+    the run (a) finds a failing schedule (explorer rc 1), and (b)
+    leaves a minimized weaver_<scenario>_*.json artifact whose failure
+    block NAMES the racing sites.  An anonymous failure — found but
+    unattributed — is a FAIL, same contract as the sanitizer preset.
+    The 'kv_refcount' preset routes here with plant=dropped_decref:
+    the pre-refcount shared-prefix release whose lost decref leaks the
+    block."""
     import json
 
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
     dump_dir = tempfile.mkdtemp(prefix="fault_weaver_")
     cmd = [sys.executable, os.path.join(REPO, "tools", "weaver.py"),
-           "--scenario", "kv_pool", "--plant", "double_free",
+           "--scenario", scenario, "--plant", plant,
            "--preemption-bound", "2", "--out-dir", dump_dir]
     t0 = time.time()
     proc = subprocess.run(cmd, cwd=REPO, env=env)
     rc = proc.returncode
     named = 0
-    for path in glob.glob(os.path.join(dump_dir, "weaver_kv_pool_*.json")):
+    for path in glob.glob(
+            os.path.join(dump_dir, "weaver_%s_*.json" % scenario)):
         try:
             with open(path) as f:
                 rec = json.load(f)
@@ -419,15 +427,15 @@ def run_weaver_preset():
     if rc == 1 and named > 0:
         rc = 0                      # found + minimized + attributed
     elif rc in (0, 1):
-        print("preset 'weaver': planted double_free not attributed "
+        print("weaver preset: planted %s/%s not attributed "
               "under %s (explorer rc=%d, named artifacts=%d)"
-              % (dump_dir, rc, named), file=sys.stderr)
+              % (scenario, plant, dump_dir, rc, named), file=sys.stderr)
         rc = 3
     if rc == 0:
         shutil.rmtree(dump_dir, ignore_errors=True)
     else:
-        print("preset 'weaver' FAILED (rc=%d); artifacts kept at %s"
-              % (rc, dump_dir), file=sys.stderr)
+        print("weaver preset %s/%s FAILED (rc=%d); artifacts kept at "
+              "%s" % (scenario, plant, rc, dump_dir), file=sys.stderr)
     return rc, time.time() - t0, dump_dir, named
 
 
@@ -498,6 +506,11 @@ def main(argv=None):
             continue
         if name == "weaver":
             rc, secs, dump_dir, n_dumps = run_weaver_preset()
+            rows.append((name, rc, secs, n_dumps))
+            continue
+        if name == "kv_refcount":
+            rc, secs, dump_dir, n_dumps = run_weaver_preset(
+                scenario="kv_refcount", plant="dropped_decref")
             rows.append((name, rc, secs, n_dumps))
             continue
         rc, secs, dump_dir, n_dumps = run_preset(name, spec, args.seed,

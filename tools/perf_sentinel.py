@@ -82,7 +82,25 @@ def _extract_serve(obj):
         "serve_gen_itl_p99_ms": _m(
             _get(obj, "generate", "poisson", "itl_p99_ms"), False,
             "ms"),
+        # ISSUE 19: speculative decoding — solo tok/s, acceptance, and
+        # the speedup over the same engine decoding plainly
+        "serve_spec_tokens_s": _m(
+            _get(obj, "spec", "spec", "tokens_s"), True, "tok/s"),
+        "serve_spec_accept_rate": _m(
+            _get(obj, "spec", "spec", "accept_rate"), True, "frac"),
+        "serve_spec_speedup_x": _m(
+            _get(obj, "spec", "speedup_vs_plain"), True, "x"),
     }
+    # ISSUE 19: shared-prefix phase — gate the HARDEST mix (the last,
+    # 95% shared): cached-prefill TTFT and the FLOPs the radix index
+    # avoided
+    mixes = _get(obj, "prefix", "mixes") or []
+    if mixes:
+        last = mixes[-1]
+        out["serve_prefix_ttft_p50_ms"] = _m(
+            _get(last, "ttft_p50_ms", "on"), False, "ms")
+        out["serve_prefix_flops_avoided_pct"] = _m(
+            last.get("prefill_flops_avoided_pct"), True, "%")
     return {k: v for k, v in out.items() if v is not None}
 
 

@@ -439,6 +439,392 @@ def _gen_int8_parity(max_batch, kv_blocks, steps):
     }
 
 
+# ---------------------------------------------------------------------------
+# Prefix-cache + speculative phases (ISSUE 19)
+# ---------------------------------------------------------------------------
+
+# speculative bench LM (env-overridable): 3 layers whose layer 0 is
+# dimension-shared with the 1-layer draft; the two DEEP layers carry a
+# fat (SVB_SPEC_FAT-wide) MLP whose outputs are damped by
+# SVB_SPEC_DAMP, so the draft predicts the target's greedy argmax at
+# ~0.95+ acceptance while the target pays ~6x the draft's FLOPs — the
+# regime speculative decoding exists for (cheap proposer, expensive
+# verifier), scaled to a CI-sized model.  SVB_SPEC_DAMP=0.002 is the
+# certified draw: smaller perturbations leave the argmax unmoved on
+# most steps without making the deep layers a no-op
+SPEC_VOCAB = int(os.environ.get("SVB_SPEC_VOCAB", "128"))
+SPEC_DMODEL = int(os.environ.get("SVB_SPEC_DMODEL", "256"))
+SPEC_HEADS = int(os.environ.get("SVB_SPEC_HEADS", "4"))
+SPEC_FAT = int(os.environ.get("SVB_SPEC_FAT", "8192"))
+SPEC_DAMP = float(os.environ.get("SVB_SPEC_DAMP", "0.002"))
+SPEC_K = int(os.environ.get("SVB_SPEC_K", "8"))
+SPEC_SEED = int(os.environ.get("SVB_SPEC_SEED", "3"))
+SPEC_MAX_NEW = int(os.environ.get("SVB_SPEC_MAX_NEW", "60"))
+
+
+def _spec_lm(max_batch=4, fat=None):
+    """(cfg, params, draft_cfg, draft_params) for the speculative
+    bench: target = 3 layers (layer 0 thin, deep layers fat and
+    damped); draft = layer 0 plus the shared embedding/head — a strict
+    parameter subset, so draft quality comes from the damping, not
+    from any training step the bench would have to carry."""
+    import re as _re
+
+    from paddle_tpu.serving import tiny_lm
+    from paddle_tpu.serving.generative import LMConfig
+
+    kw = dict(vocab=SPEC_VOCAB, d_model=SPEC_DMODEL,
+              n_heads=SPEC_HEADS, n_layers=3, d_ff=256,
+              block_size=GEN_BLOCK, max_blocks=GEN_MAX_BLOCKS,
+              max_batch=max_batch)
+    cfg, params = tiny_lm(SPEC_SEED, **kw)
+    fat = SPEC_FAT if fat is None else fat
+    rng = np.random.RandomState(99)
+    for layer in (1, 2):
+        params["l%d.w1" % layer] = (
+            rng.randn(SPEC_DMODEL, fat) * 0.1).astype(np.float32)
+        params["l%d.w2" % layer] = (
+            rng.randn(fat, SPEC_DMODEL) * 0.1 * SPEC_DAMP
+        ).astype(np.float32)
+        params["l%d.wo" % layer] = params["l%d.wo" % layer] * SPEC_DAMP
+    dcfg = LMConfig(**dict(kw, n_layers=1))
+    dparams = {k: v for k, v in params.items()
+               if not _re.match(r"l[0-9]+\.", k)
+               or k.startswith("l0.")}
+    return cfg, params, dcfg, dparams
+
+
+def _solo_loop(eng, cfg, prompt, max_new, spec=False):
+    """Closed-loop single-sequence generation at the engine level (no
+    server thread in the measured path): the solo decode floor both
+    spec numbers quote.  Returns (tokens, rounds) where ``rounds``
+    carries the per-round accepted-draft counts when ``spec``."""
+    from concurrent.futures import Future
+
+    from paddle_tpu.serving.batcher import TokenScheduler
+    from paddle_tpu.serving.generative import GenRequest
+
+    k = eng.spec_k
+    req = GenRequest(prompt, max_new, None, Future())
+    req.blocks = eng.pool.alloc(eng.pool.blocks_for(len(prompt)))
+    req.out.append(eng.prefill(req))
+    sched = TokenScheduler(eng.pool, cfg.max_batch)
+    rounds = []
+    need = (k + 1) if spec else 1
+    while len(req.out) < max_new \
+            and req.context_len + need <= cfg.max_seq:
+        cap = len(req.blocks) * cfg.block_size
+        while req.context_len + need > cap:
+            if not sched.grow(req):
+                raise RuntimeError("kv pool exhausted")
+            cap += cfg.block_size
+        if spec:
+            toks = eng.spec_decode([req])[0]
+            rounds.append(len(toks) - 1)
+            for t in toks:
+                if len(req.out) < max_new:
+                    req.out.append(int(t))
+        else:
+            req.out.append(int(eng.decode([req])[0]))
+    toks = list(req.out)
+    eng.free_sequence(req)
+    return toks, rounds
+
+
+def _gen_spec_parity(steps, k=None, fat=None):
+    """Greedy-parity certificate for speculative decoding (the ISSUE
+    19 extension of the int8 certificate): the spec engine's token
+    stream must be BIT-IDENTICAL to plain greedy decode on the same
+    LM, and the per-round acceptance accounting must add up exactly —
+    every emitted token is either a verified draft token or the verify
+    pass's own correction/bonus token, so the emitted count equals
+    1 (prefill) + sum(m_i + 1) over rounds, modulo the final-round
+    max_new cap.  The measured accept-rate rides the record as an
+    efficiency number; it is never a correctness input."""
+    from paddle_tpu.serving.generative import GenerativeEngine
+
+    k = SPEC_K if k is None else k
+    cfg, params, dcfg, dparams = _spec_lm(fat=fat)
+    prompt = np.random.RandomState(1000 + SPEC_SEED) \
+        .randint(0, SPEC_VOCAB, size=8).tolist()
+    eng = GenerativeEngine(cfg, params, kv_blocks=64, warm=False,
+                           name="specparity-plain", prefix_cache=False,
+                           spec_k=0)
+    try:
+        plain, _ = _solo_loop(eng, cfg, prompt, steps)
+    finally:
+        eng.close()
+    eng = GenerativeEngine(cfg, params, kv_blocks=64, warm=False,
+                           name="specparity", prefix_cache=False,
+                           spec_k=k, draft=(dcfg, dparams))
+    try:
+        spec, rounds = _solo_loop(eng, cfg, prompt, steps, spec=True)
+    finally:
+        eng.close()
+    n = min(len(plain), len(spec))
+    identical = bool(plain[:n] == spec[:n] and n == steps)
+    accepted = sum(rounds)
+    proposed = k * len(rounds)
+    emitted = 1 + accepted + len(rounds)
+    accounting_ok = len(spec) <= emitted <= len(spec) + k
+    return {
+        "steps": steps, "k": k,
+        "token_parity": "%d/%d" % (
+            sum(a == b for a, b in zip(plain, spec)), n),
+        "identical": identical,
+        "rounds": len(rounds), "accepted": accepted,
+        "proposed": proposed,
+        "accept_rate": round(accepted / proposed, 4) if proposed
+        else 0.0,
+        "accounting_ok": bool(accounting_ok),
+        "certified": bool(identical and accounting_ok),
+        "acceptance": "greedy longest-matching-prefix + correction "
+                      "token (lossless for greedy decode by "
+                      "construction; this record MEASURES it)",
+    }
+
+
+def _run_spec(quick):
+    """Solo-floor speculative phase: plain greedy tokens/s vs
+    spec-decode tokens/s on the same LM and prompt, best-of-N closed
+    loops after an unmeasured warm-up (engine compiles land there).
+    Accept-rate and draft-overhead come from the serve_spec_* metric
+    counters, so the observable numbers are also smoke-tested."""
+    from paddle_tpu.observability import metrics
+    from paddle_tpu.serving.generative import GenerativeEngine
+
+    fat = int(os.environ.get("SVB_SPEC_FAT_QUICK", "512")) if quick \
+        else SPEC_FAT
+    k = min(SPEC_K, 4) if quick else SPEC_K
+    max_new = 24 if quick else SPEC_MAX_NEW
+    trials = 2 if quick else 3
+    cfg, params, dcfg, dparams = _spec_lm(fat=fat)
+    prompt = np.random.RandomState(1000 + SPEC_SEED) \
+        .randint(0, SPEC_VOCAB, size=8).tolist()
+
+    def best_of(fn):
+        fn()
+        # the warm pass above absorbed the engine compiles — rebase
+        # the spec timing counters so draft-overhead reflects steady
+        # state, not jit time
+        metrics.zero_all()
+        best, out = None, None
+        for _ in range(trials):
+            t0 = time.perf_counter()
+            out = fn()
+            dt = time.perf_counter() - t0
+            best = dt if best is None or dt < best else best
+        return out, best
+
+    eng = GenerativeEngine(cfg, params, kv_blocks=64, warm=False,
+                           name="specbench-plain", prefix_cache=False,
+                           spec_k=0)
+    try:
+        plain_toks, dt_p = best_of(
+            lambda: _solo_loop(eng, cfg, prompt, max_new)[0])
+    finally:
+        eng.close()
+    plain_tps = len(plain_toks) / dt_p
+
+    eng = GenerativeEngine(cfg, params, kv_blocks=64, warm=False,
+                           name="specbench", prefix_cache=False,
+                           spec_k=k, draft=(dcfg, dparams))
+    try:
+        spec_toks, dt_s = best_of(
+            lambda: _solo_loop(eng, cfg, prompt, max_new,
+                               spec=True)[0])
+    finally:
+        eng.close()
+    spec_tps = len(spec_toks) / dt_s
+    snap = metrics.snapshot()
+
+    def _c(name):
+        ent = snap.get(name)
+        return ent["value"] if ent else 0
+
+    proposed = _c("serve_spec_proposed_total")
+    accepted = _c("serve_spec_accepted_total")
+    draft_us = _c("serve_spec_draft_us_total")
+    verify_us = _c("serve_spec_verify_us_total")
+    accept = accepted / proposed if proposed else 0.0
+    overhead = draft_us / (draft_us + verify_us) \
+        if draft_us + verify_us else 0.0
+    cert = _gen_spec_parity(
+        int(os.environ.get("SVB_SPEC_PARITY_STEPS",
+                           "24" if quick else "48")), k=k, fat=fat)
+    speedup = round(spec_tps / max(plain_tps, 1e-9), 2)
+    # quick runs keep the parity guarantee but only a collapse floor
+    # on speed — a seconds-long smoke is not a perf measurement
+    floor_x = float(os.environ.get("SVB_SPEC_FLOOR_X",
+                                   "0.5" if quick else "2.0"))
+    return {
+        "model": {"vocab": SPEC_VOCAB, "d_model": SPEC_DMODEL,
+                  "n_heads": SPEC_HEADS, "n_layers": 3,
+                  "d_ff_thin": 256, "d_ff_fat": fat,
+                  "deep_damp": SPEC_DAMP, "seed": SPEC_SEED,
+                  "draft": "layer 0 + embed/head (1 layer)"},
+        "k": k, "max_new_tokens": max_new, "trials": trials,
+        "plain": {"tokens": len(plain_toks),
+                  "tokens_s": round(plain_tps, 1)},
+        "spec": {"tokens": len(spec_toks),
+                 "tokens_s": round(spec_tps, 1),
+                 "rounds": _c("serve_spec_rounds_total"),
+                 "accept_rate": round(accept, 4),
+                 "draft_overhead_pct": round(100.0 * overhead, 1),
+                 "draft_us": draft_us, "verify_us": verify_us},
+        "speedup_vs_plain": speedup,
+        "floor_x": floor_x,
+        "parity": cert,
+        "ok": bool(cert["certified"] and speedup >= floor_x),
+    }
+
+
+PFX_USERS = int(os.environ.get("SVB_PFX_USERS", "12"))
+PFX_SHARED = int(os.environ.get("SVB_PFX_SHARED", "120"))
+# wider MLP than the generate-phase LM: prefill must be COMPUTE-bound
+# for the suffix-only dispatch to show its win — on the CPU fallback
+# the paged K/V gather costs rows x max_blocks regardless of how many
+# tokens were cached, so a skinny model measures the gather, not the
+# avoided FLOPs
+PFX_DFF = int(os.environ.get("SVB_PFX_DFF", "2048"))
+
+
+def _run_prefix(quick):
+    """Multi-tenant shared-prefix trace: ``users`` tenants whose
+    prompts share a long system prefix, swept over 80/90/95% shared
+    mixes, prefix cache OFF vs ON.  Reports the prefill FLOPs avoided
+    (from the serve_prefix_tokens_* counters — prefill compute is
+    linear in tokens actually computed), TTFT p50 both ways, and the
+    peak KV bytes per user (shared blocks count ONCE under refcount
+    semantics).  Each mode runs one unmeasured warm trace first so
+    bucket compiles never land inside a measured TTFT; the shared
+    prefix is deliberately block-unaligned so the partial-tail
+    copy-on-write path is on the measured path, not just in tests."""
+    from concurrent.futures import Future
+
+    from paddle_tpu.observability import metrics
+    from paddle_tpu.serving import tiny_lm
+    from paddle_tpu.serving.generative import (GenRequest,
+                                               GenerativeEngine)
+
+    users = 6 if quick else PFX_USERS
+    shared_len = PFX_SHARED
+    # prompts run ~150 tokens (shared prefix + per-tenant suffix), so
+    # the prefix phase carries its own max_blocks rather than the
+    # generate phase's 8-block sequences
+    max_blocks = 16
+    kv = int(os.environ.get(
+        "SVB_PFX_KV_BLOCKS", "72" if quick else "160"))
+    rng = np.random.RandomState(21)
+    shared = rng.randint(0, GEN_VOCAB, size=shared_len).tolist()
+    cfg, params = tiny_lm(GEN_SEED, vocab=GEN_VOCAB,
+                          d_model=GEN_DMODEL, n_heads=GEN_HEADS,
+                          n_layers=GEN_LAYERS, d_ff=PFX_DFF,
+                          block_size=GEN_BLOCK, max_blocks=max_blocks,
+                          max_batch=4)
+    block_bytes = cfg.n_layers * 2 * cfg.block_size * cfg.d_model * 4
+
+    def run_mode(prompts, on):
+        eng = GenerativeEngine(cfg, params, kv_blocks=kv, warm=False,
+                               name="pfx-%s" % ("on" if on else "off"),
+                               prefix_cache=on, spec_k=0)
+        try:
+            def trace():
+                reqs, ttfts, firsts = [], [], []
+                for p in prompts:
+                    req = GenRequest(p, 4, None, Future())
+                    t0 = time.perf_counter()
+                    if eng.prefix_cache is not None:
+                        if not eng.prefix_cache.acquire(req):
+                            raise RuntimeError("prefix admission "
+                                               "failed")
+                    else:
+                        req.blocks = eng.pool.alloc(
+                            eng.pool.blocks_for(len(p)))
+                        if req.blocks is None:
+                            raise RuntimeError("kv pool exhausted")
+                    tok = eng.prefill(req)
+                    if eng.prefix_cache is not None:
+                        eng.prefix_cache.insert(req)
+                    ttfts.append((time.perf_counter() - t0) * 1e3)
+                    firsts.append(int(tok))
+                    reqs.append(req)
+                # snapshot while every tenant is LIVE: the shared
+                # gauge reads sharing as it exists under load, not
+                # after the drain parks everything at refcount zero
+                peak = eng.pool.used_blocks
+                snap = metrics.snapshot()
+                for req in reqs:
+                    eng.free_sequence(req)
+                return ttfts, firsts, peak, snap
+
+            # warm TWICE: the first trace fills the trie (and, cache
+            # on, runs the cold COW path), the second hits the exact
+            # steady-state suffix buckets the measured trace will use
+            # — a bucket first compiled inside a measured TTFT, or a
+            # background compile still churning on a small box, would
+            # be harness noise dressed up as cache overhead
+            metrics.zero_all()
+            trace()
+            cold = metrics.snapshot()
+            trace()
+            time.sleep(1.5)
+            metrics.zero_all()
+            ttfts, firsts, peak, snap = trace()
+            # COW fires on the COLD trace (divergent suffixes sharing
+            # a partial block); the measured steady-state trace is an
+            # exact repeat, so its counter would hide it
+            snap = dict(snap, _cow_cold=cold[
+                "serve_kv_cow_copies_total"]["value"])
+        finally:
+            eng.close()
+        return ttfts, firsts, peak, snap
+
+    out_mixes = []
+    for mix in (80, 90, 95):
+        suffix_len = max(1, int(round(
+            shared_len * (100.0 / mix - 1.0))))
+        prompts = [shared + rng.randint(
+            0, GEN_VOCAB, size=suffix_len).tolist()
+            for _ in range(users)]
+        ttf_off, first_off, peak_off, _ = run_mode(prompts, on=False)
+        ttf_on, first_on, peak_on, snap = run_mode(prompts, on=True)
+        tok_total = snap["serve_prefix_tokens_total"]["value"]
+        tok_cached = snap["serve_prefix_tokens_cached_total"]["value"]
+        avoided = 100.0 * tok_cached / tok_total if tok_total else 0.0
+        p50_off = _pctl(sorted(ttf_off), 50)
+        p50_on = _pctl(sorted(ttf_on), 50)
+        out_mixes.append({
+            "mix_pct": mix, "users": users,
+            "prompt_tokens": len(prompts[0]),
+            "shared_tokens": shared_len,
+            "prefix_hits": snap["serve_kv_prefix_hits"]["value"],
+            "prefill_tokens": tok_total,
+            "prefill_tokens_cached": tok_cached,
+            "prefill_flops_avoided_pct": round(avoided, 1),
+            "ttft_p50_ms": {"off": round(p50_off, 3),
+                            "on": round(p50_on, 3)},
+            "ttft_speedup": round(p50_off / max(p50_on, 1e-9), 2),
+            "kv_blocks_peak": {"off": peak_off, "on": peak_on},
+            "kv_bytes_per_user": {
+                "off": int(peak_off * block_bytes / users),
+                "on": int(peak_on * block_bytes / users)},
+            "blocks_shared": snap["serve_kv_blocks_shared"]["value"],
+            "cow_copies_cold_trace": snap["_cow_cold"],
+            "cow_copies": snap["serve_kv_cow_copies_total"]["value"],
+            "tokens_identical": bool(first_off == first_on),
+        })
+    ok = all(m["tokens_identical"]
+             and m["kv_blocks_peak"]["on"] < m["kv_blocks_peak"]["off"]
+             and m["prefill_flops_avoided_pct"]
+             >= 0.75 * m["mix_pct"]
+             and m["ttft_p50_ms"]["on"] <= m["ttft_p50_ms"]["off"]
+             for m in out_mixes)
+    return {"users": users, "shared_tokens": shared_len,
+            "kv_block_bytes": block_bytes, "mixes": out_mixes,
+            "ok": bool(ok)}
+
+
 def _run_generate(quick, seconds, max_batch):
     from paddle_tpu.observability import metrics
     from paddle_tpu.serving import InferenceServer
@@ -450,9 +836,35 @@ def _run_generate(quick, seconds, max_batch):
     cfg, params, kv = _gen_cfg(max_batch, kv_blocks)
     rng = np.random.RandomState(5)
     prompts = _gen_prompts(rng, 64)
+    # feature knobs (the tier-1 smoke parametrizes over these): run
+    # the SAME Poisson trace with the prefix cache on and/or a draft
+    # LM speculating — correctness under load, not a perf claim
+    prefix_on = os.environ.get("SVB_GEN_PREFIX_CACHE", "") == "1"
+    spec_k = int(os.environ.get("SVB_GEN_SPEC_K", "0"))
+    draft = None
+    if spec_k:
+        import re as _re
+
+        from paddle_tpu.serving.generative import LMConfig
+
+        dcfg = LMConfig(vocab=GEN_VOCAB, d_model=GEN_DMODEL,
+                        n_heads=GEN_HEADS, n_layers=1, d_ff=GEN_DFF,
+                        block_size=GEN_BLOCK,
+                        max_blocks=GEN_MAX_BLOCKS,
+                        max_batch=max_batch)
+        draft = (dcfg, {k: v for k, v in params.items()
+                        if not _re.match(r"l[0-9]+\.", k)
+                        or k.startswith("l0.")})
+    if prefix_on:
+        # give the trace something to share: one block-sized system
+        # prefix on every prompt, so admission-time lookups hit
+        common = rng.randint(0, GEN_VOCAB, size=GEN_BLOCK).tolist()
+        prompts = [common + p for p in prompts]
     srv = InferenceServer()
     t_load = time.perf_counter()
-    eng = srv.load_generative("g", cfg, params, kv_blocks=kv)
+    eng = srv.load_generative("g", cfg, params, kv_blocks=kv,
+                              prefix_cache=True if prefix_on else None,
+                              spec_k=spec_k or None, draft=draft)
     load_s = time.perf_counter() - t_load
     try:
         floor = _gen_floor(srv, prompts[0], max(max_new, 32))
@@ -494,10 +906,24 @@ def _run_generate(quick, seconds, max_batch):
             # rebased the gauges to measure the phase, not the load
             "blocks_total": eng.pool.capacity,
             "blocks_used_after_drain": eng.pool.used_blocks,
+            "blocks_cached_after_drain": eng.pool.cached_blocks,
             "alloc_failures":
                 snap["serve_kv_alloc_failures_total"]["value"],
             "preemptions": snap["serve_kv_preemptions_total"]["value"],
         }
+        features = {"prefix_cache": prefix_on, "spec_k": spec_k}
+        if prefix_on:
+            features["prefix_hits"] = \
+                snap["serve_kv_prefix_hits"]["value"]
+            features["prefix_tokens_cached"] = \
+                snap["serve_prefix_tokens_cached_total"]["value"]
+        if spec_k:
+            prop = snap["serve_spec_proposed_total"]["value"]
+            acc = snap["serve_spec_accepted_total"]["value"]
+            features["spec_rounds"] = \
+                snap["serve_spec_rounds_total"]["value"]
+            features["spec_accept_rate"] = \
+                round(acc / prop, 4) if prop else 0.0
     finally:
         srv.close()
     int8 = _gen_int8_parity(max_batch, kv_blocks,
@@ -514,6 +940,7 @@ def _run_generate(quick, seconds, max_batch):
                   "kv_blocks": kv_blocks},
         "max_batch": max_batch,
         "max_new_tokens": max_new,
+        "features": features,
         "load_warm_s": round(load_s, 2),
         "floor": floor,
         "capacity_tokens_s": round(cap_tokens_s, 1),
@@ -556,11 +983,15 @@ def main(argv=None):
                          "measured floor QPS")
     ap.add_argument("--seconds", type=float, default=0.0,
                     help="override per-phase duration")
-    ap.add_argument("--mode", choices=("predict", "generate", "all"),
+    ap.add_argument("--mode",
+                    choices=("predict", "generate", "prefix", "spec",
+                             "all"),
                     default="all",
                     help="which serving planes to bench: the PR 9 "
                          "predict phases, the ISSUE 11 token-level "
-                         "generate phases, or both (default)")
+                         "generate phases, the ISSUE 19 shared-prefix "
+                         "trace or speculative solo-floor phases, or "
+                         "all of them (default)")
     ap.add_argument("--sentinel", action="store_true",
                     help="gate this run against PERF_TRAJECTORY.json "
                          "via tools/perf_sentinel.py (rc 3 on a >15%% "
@@ -582,12 +1013,7 @@ def main(argv=None):
                                    "8" if args.quick else "16"))
     max_wait_us = int(os.environ.get("SVB_MAX_WAIT_US", "2000"))
 
-    if args.mode == "generate":
-        gen = _run_generate(args.quick, seconds, max_batch)
-        out = {"metric": "serve_bench", "quick": bool(args.quick),
-               "mode": "generate",
-               "platform": os.environ.get("JAX_PLATFORMS", ""),
-               "generate": gen, "ok": gen["ok"]}
+    def _finish(out):
         line = json.dumps(out)
         print(line)
         if args.out:
@@ -595,6 +1021,17 @@ def main(argv=None):
                 f.write(line + "\n")
         rc = 0 if out["ok"] else 1
         return rc or (_sentinel_check(out) if args.sentinel else 0)
+
+    if args.mode in ("generate", "prefix", "spec"):
+        rec = {"generate": lambda: _run_generate(args.quick, seconds,
+                                                 max_batch),
+               "prefix": lambda: _run_prefix(args.quick),
+               "spec": lambda: _run_spec(args.quick)}[args.mode]()
+        return _finish({
+            "metric": "serve_bench", "quick": bool(args.quick),
+            "mode": args.mode,
+            "platform": os.environ.get("JAX_PLATFORMS", ""),
+            args.mode: rec, "ok": rec["ok"]})
 
     tmp = tempfile.mkdtemp(prefix="serve_bench_")
     d1, d2 = os.path.join(tmp, "v1"), os.path.join(tmp, "v2")
@@ -676,14 +1113,13 @@ def main(argv=None):
     if args.mode == "all":
         gen = _run_generate(args.quick, seconds, max_batch)
         out["generate"] = gen
-        out["ok"] = bool(out["ok"] and gen["ok"])
-    line = json.dumps(out)
-    print(line)
-    if args.out:
-        with open(args.out, "w") as f:
-            f.write(line + "\n")
-    rc = 0 if out["ok"] else 1
-    return rc or (_sentinel_check(out) if args.sentinel else 0)
+        pfx = _run_prefix(args.quick)
+        out["prefix"] = pfx
+        spec = _run_spec(args.quick)
+        out["spec"] = spec
+        out["ok"] = bool(out["ok"] and gen["ok"] and pfx["ok"]
+                         and spec["ok"])
+    return _finish(out)
 
 
 def _sentinel_check(out):

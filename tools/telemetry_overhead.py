@@ -309,6 +309,24 @@ def _measure_generate_us(tokens=None, repeats=3):
     return on_us, on_us - probe_us
 
 
+def _measure_spec_probe_us(repeats=3, iters=20000):
+    """Speculative-decode metrics gate (ISSUE 19 satellite): one spec
+    round adds ``generative.spec_metrics_probe``'s op set (round/
+    proposed/accepted counters + the draft/verify µs meters) on top of
+    the per-token ops, and every round emits >= 1 token — so the
+    per-round probe cost is gated against the measured inter-token
+    latency, exactly like token_metrics_probe above."""
+    from paddle_tpu.serving import generative as gen_mod
+
+    gen_mod.spec_metrics_probe(1000)    # warm
+    best = float("inf")
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        gen_mod.spec_metrics_probe(iters)
+        best = min(best, (time.perf_counter() - t0) / iters * 1e6)
+    return best
+
+
 def _measure_ledger_us(repeats=3, iters=2000):
     """Resource-ledger collector gate (ISSUE 12 satellite): the
     collector wakes every FLAGS_ledger_sample_ms and reads every
@@ -639,6 +657,9 @@ def main(argv=None):
     gen_on_us, gen_off_us = _measure_generate_us()
     gen_frac = max(0.0, gen_on_us - gen_off_us) / gen_off_us
     gen_limit = float(os.environ.get("GENERATE_OVERHEAD_MAX", dflt))
+    spec_probe_us = _measure_spec_probe_us()
+    spec_frac = spec_probe_us / gen_off_us
+    spec_limit = float(os.environ.get("SPEC_OVERHEAD_MAX", dflt))
     ledger_us, ledger_ms = _measure_ledger_us()
     ledger_frac = ledger_us / (ledger_ms * 1e3)
     ledger_limit = float(os.environ.get("LEDGER_OVERHEAD_MAX", dflt))
@@ -687,6 +708,13 @@ def main(argv=None):
         "generate_itl_off_us": round(gen_off_us, 2),
         "generate_overhead_frac": round(gen_frac, 5),
         "generate_limit": gen_limit,
+        # ISSUE 19: speculative decoding — per-round draft/verify
+        # metric op set (spec_metrics_probe) vs the measured inter-
+        # token latency; every round emits >= 1 token so per-round is
+        # the worst per-token charge
+        "spec_probe_us_per_round": round(spec_probe_us, 3),
+        "spec_overhead_frac": round(spec_frac, 5),
+        "spec_limit": spec_limit,
         # ISSUE 12: resource-ledger collector — one full sampling
         # iteration vs the sampling interval (the collector's
         # steady-state core-steal bound)
@@ -737,6 +765,7 @@ def main(argv=None):
         "ok": (frac < limit and num_frac < num_limit
                and serve_frac < serve_limit
                and gen_frac < gen_limit
+               and spec_frac < spec_limit
                and ledger_frac < ledger_limit
                and tsdb_frac < tsdb_limit
                and slo_frac < slo_limit

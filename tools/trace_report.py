@@ -62,11 +62,13 @@ ROLLUPS = (
      "per process — ISSUE 10)"),
     ("serve", "serve_rows", "format_serve_table",
      "serve rollup (requests/tokens / decode occupancy / TTFT+ITL / "
-     "paged KV pressure per process):",
+     "paged KV pressure / prefix-cache + speculative columns per "
+     "process):",
      "print the serving-tier rollup (requests/tokens, decode-batch "
      "occupancy, TTFT and inter-token latency, paged KV cache "
      "pressure: blocks used/total, allocation failures, preemptions "
-     "— ISSUE 11)"),
+     "— ISSUE 11; plus the ISSUE 19 columns: prefix-hit-rate, "
+     "blocks shared, speculative accept-rate, draft overhead)"),
     ("scale", "scale_rows", "format_scale_table",
      "scale rollup (resource ledgers per process: pending grads / "
      "caches+evictions / barrier quorum / apply backlog):",
