@@ -393,6 +393,82 @@ def verify_transpiled_pair(trainer_desc, pserver_descs):
 
 
 # ---------------------------------------------------------------------------
+# sharding: the annotation carrier the elastic SPMD runtime lowers
+# (ISSUE 20) — desc.var_shardings + the mesh stash apply_placement left
+# ---------------------------------------------------------------------------
+
+
+@register_checker("sharding")
+def check_sharding(du):
+    """Validate per-VarDesc sharding annotations: spec arity must match
+    the var's rank, one mesh axis may shard at most one dim of a var,
+    annotated names must resolve to a VarDesc, and — when the desc
+    carries a mesh stash — every named axis must exist on the mesh and
+    every sharded static dim must divide its extent.  These are the
+    invariants the executor's GSPMD lowering and reshard()'s
+    redistribution assume; violating them fails at compile (best case)
+    or silently misplaces data (worst case)."""
+    desc = du.program
+    shardings = getattr(desc, "var_shardings", None) or {}
+    if not shardings:
+        return []
+    diags = []
+    mesh_axes = getattr(desc, "mesh_axes", None) or {}
+    block = desc.blocks[0]
+    for name, spec in sorted(shardings.items()):
+        vd = block.find_var_recursive(name)
+        if vd is None:
+            diags.append(Diagnostic(
+                "sharding", Severity.WARNING,
+                "sharding annotation names a var with no VarDesc in "
+                "block 0's scope chain", var=name,
+                suggestion="drop the stale annotation or declare the "
+                           "var"))
+            continue
+        if vd.shape and len(spec) != len(vd.shape):
+            diags.append(Diagnostic(
+                "sharding", Severity.ERROR,
+                "spec %r has %d entries but the var has rank %d"
+                % (tuple(spec), len(spec), len(vd.shape)), var=name,
+                suggestion="one spec entry per dim (None = "
+                           "replicated)"))
+            continue
+        seen = {}
+        for dim, axis in enumerate(spec):
+            if not axis:
+                continue
+            if axis in seen:
+                diags.append(Diagnostic(
+                    "sharding", Severity.ERROR,
+                    "axis %r shards both dim %d and dim %d — a mesh "
+                    "axis can shard at most one dim of a var"
+                    % (axis, seen[axis], dim), var=name,
+                    suggestion="replicate one of the dims"))
+                continue
+            seen[axis] = dim
+            if mesh_axes:
+                ext = mesh_axes.get(axis)
+                if ext is None:
+                    diags.append(Diagnostic(
+                        "sharding", Severity.ERROR,
+                        "spec names axis %r but the placement mesh %r "
+                        "has no such axis" % (axis, dict(mesh_axes)),
+                        var=name,
+                        suggestion="add the axis to the mesh or drop "
+                                   "the annotation"))
+                elif (dim < len(vd.shape) and vd.shape[dim] > 0
+                      and vd.shape[dim] % int(ext)):
+                    diags.append(Diagnostic(
+                        "sharding", Severity.ERROR,
+                        "dim %d (size %d) does not divide by %s=%d"
+                        % (dim, vd.shape[dim], axis, int(ext)),
+                        var=name,
+                        suggestion="pick a dividing extent or leave "
+                                   "the dim replicated"))
+    return diags
+
+
+# ---------------------------------------------------------------------------
 # numerics: known-risk ops consuming low-precision inputs (ISSUE 8)
 # ---------------------------------------------------------------------------
 

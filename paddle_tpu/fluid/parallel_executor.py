@@ -109,6 +109,19 @@ class ParallelExecutor:
         if num_devices:
             devices = devices[:num_devices]
         self._devices = devices
+        if mesh_axes is None:
+            # spmd route (ISSUE 20): a program that went through
+            # spmd.apply_placement carries its own mesh (the stash the
+            # placement left on the desc) — the annotations lower
+            # through the executor's GSPMD in_shardings without a
+            # hand-wired mesh_axes kwarg.  Bare ParamAttr annotations
+            # without a placement keep the legacy flat-dp default.
+            stashed = getattr(self._program.desc, "mesh_axes", None)
+            if stashed and getattr(self._program.desc,
+                                   "var_shardings", None):
+                from paddle_tpu.parallel import spmd
+                mesh_axes = spmd.infer_mesh_axes(self._program.desc,
+                                                 len(devices))
         if mesh_axes:
             # multi-axis mesh, e.g. {"dp": 2, "tp": 4}: parameters carry
             # per-dim axis annotations (ParamAttr(sharding=...)), feeds

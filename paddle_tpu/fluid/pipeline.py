@@ -45,6 +45,46 @@ class PipelineProgram:
     back.
     """
 
+    @classmethod
+    def from_annotations(cls, program, loss, devices, scope, feed_names):
+        """The spmd route (ISSUE 20): lower a program whose ops carry
+        ``__pp_stage__`` tags (written by
+        ``paddle_tpu.parallel.spmd.assign_pipeline_stages`` / a
+        pp-bearing placement) instead of hand-picked cut vars — the
+        stage boundaries and cut activations are recovered from the
+        annotations, so the pipeline carrier consumes the same
+        annotated-program contract as the GSPMD executor path."""
+        from paddle_tpu.parallel.spmd import PP_STAGE_ATTR
+        from .framework import OpRole
+
+        block = program.global_block()
+        ops = [op for op in block.desc.ops
+               if op.type not in ("feed", "fetch")
+               and not (op.role & (OpRole.Backward | OpRole.Optimize))]
+        tagged = [(op, op.attr(PP_STAGE_ATTR)) for op in ops]
+        if any(s is None for _, s in tagged):
+            raise ValueError(
+                "program has untagged ops; run "
+                "spmd.assign_pipeline_stages(program, n_stages) first")
+        n_stages = max(s for _, s in tagged) + 1
+        if n_stages != len(devices):
+            raise ValueError("%d annotated stages but %d devices"
+                             % (n_stages, len(devices)))
+        cut_vars = []
+        for s in range(n_stages - 1):
+            here = [op for op, st in tagged if st == s]
+            later_reads = {n for op, st in tagged if st > s
+                           for n in op.input_arg_names()}
+            crossing = [n for op in here
+                        for n in op.output_arg_names()
+                        if n in later_reads and not scope.has_var(n)]
+            if not crossing:
+                raise ValueError(
+                    "no activation crosses the stage %d/%d boundary"
+                    % (s, s + 1))
+            cut_vars.append(crossing[-1])
+        return cls(program, loss, cut_vars, devices, scope, feed_names)
+
     def __init__(self, program, loss, cut_vars, devices, scope,
                  feed_names):
         import jax

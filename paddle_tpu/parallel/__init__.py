@@ -13,6 +13,10 @@ Three levels:
 - ``ring`` / ``pipeline`` / ``moe``: explicit shard_map strategies for the
   parts GSPMD cannot express alone — ring attention (sequence/context
   parallelism), GPipe-style pipeline parallelism, expert parallelism.
+- ``spmd``  : the elastic SPMD runtime (ISSUE 20) that unifies the
+  above: ShardingPass assigns/propagates per-VarDesc annotations, a
+  measured-cost search (auto_shard) picks the placement, and reshard()
+  re-lowers the same program for a grown/shrunk mesh mid-job.
 """
 from .mesh import make_mesh, auto_mesh_axes  # noqa: F401
 from .api import shard_var, sharding_constraint  # noqa: F401
@@ -20,9 +24,19 @@ from .ring import (ring_attention, ring_attention_fwd_lse,  # noqa: F401
                    ring_attention_bwd, causal_step_counts)
 from .pipeline import pipeline_apply  # noqa: F401
 from .moe import moe_ffn, emit_router_stats  # noqa: F401
+from .spmd import (ShardingPass, CostModel, Placement,  # noqa: F401
+                   auto_shard, apply_placement, annotate_program,
+                   placement_for, enumerate_strategies, strategy_name,
+                   infer_mesh_axes, assign_pipeline_stages,
+                   check_reshard_pair, reshard)
 
 __all__ = ["make_mesh", "auto_mesh_axes", "shard_var",
            "sharding_constraint", "ring_attention",
            "ring_attention_fwd_lse", "ring_attention_bwd",
            "causal_step_counts", "pipeline_apply", "moe_ffn",
-           "emit_router_stats"]
+           "emit_router_stats", "ShardingPass", "CostModel",
+           "Placement", "auto_shard", "apply_placement",
+           "annotate_program", "placement_for",
+           "enumerate_strategies", "strategy_name",
+           "infer_mesh_axes", "assign_pipeline_stages",
+           "check_reshard_pair", "reshard"]

@@ -90,6 +90,12 @@ PRESETS = {
     # re-introduced (--plant dropped_decref) — the shared prefix block
     # leaks unless the terminal decref runs exactly once
     "kv_refcount": "",
+    # Elastic mesh reshard (ISSUE 20): SIGKILL the trainer in the
+    # window between the quiesce checkpoint and the 8->4 re-lowering,
+    # relaunch in recovery mode, and FAIL (rc 3) unless the recovered
+    # shrunken mesh reproduces the expected loss trajectory (PARITY)
+    # and the reshard left a flight artifact — run_reshard_preset()
+    "reshard": "",
 }
 
 # the names the sanitizer preset's plants use (tests/test_sanitizer.py
@@ -298,6 +304,87 @@ def run_serve_fleet_preset():
         print("preset 'serve_fleet' FAILED (rc=%d); artifacts kept "
               "at %s" % (rc, dump_dir), file=sys.stderr)
     return rc, time.time() - t0, dump_dir, matched
+
+
+def run_reshard_preset():
+    """The 'reshard' preset is the elastic-mesh kill drill (ISSUE 20):
+    tools/autoshard_bench.py --shrink-drill trains the auto-sharded
+    transformer at p=8, quiesces, writes the PR 1 shard checkpoint plus
+    the expected post-quiesce loss trajectory, raises a marker file,
+    and pauses — this runner SIGKILLs it inside that window (mid-shrink,
+    after state is durable, before the 4-device re-lowering exists).
+    The relaunch with --recover must rebuild the program, restore the
+    checkpoint through spmd.reshard(checkpoint_dir=...), and reproduce
+    the expected trajectory on the SHRUNKEN mesh.  rc 3 unless the
+    recovery's drill_result.json reports parity_ok AND the reshard left
+    a flight artifact under the dump dir — a shrink that loses the loss
+    trajectory, or one that leaves no breadcrumb of the mesh change,
+    is a FAIL."""
+    import json
+    import signal  # noqa: F401  (SIGKILL via Popen.kill)
+
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["AUTOSHARD_DRILL_PAUSE_S"] = "30"
+    dump_dir = tempfile.mkdtemp(prefix="fault_reshard_dump_")
+    env["FLAGS_telemetry_dump_dir"] = dump_dir
+    marker = os.path.join(dump_dir, "pre_shrink_ready")
+    t0 = time.time()
+    proc = subprocess.Popen(
+        [sys.executable, "tools/autoshard_bench.py", "--shrink-drill",
+         "--dump-dir", dump_dir],
+        cwd=REPO, env=env, stdout=subprocess.DEVNULL)
+    killed = False
+    deadline = time.time() + 300
+    while time.time() < deadline and proc.poll() is None:
+        if os.path.exists(marker):
+            time.sleep(0.5)  # let the marker write land
+            proc.kill()      # SIGKILL: no atexit, no flush, no mercy
+            proc.wait()
+            killed = True
+            break
+        time.sleep(0.5)
+    if not killed:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait()
+        print("preset 'reshard': drill never reached the kill window "
+              "(marker %s missing; rc=%r); artifacts kept at %s"
+              % (marker, proc.returncode, dump_dir), file=sys.stderr)
+        return 3, time.time() - t0, dump_dir, 0
+
+    rec_proc = subprocess.run(
+        [sys.executable, "tools/autoshard_bench.py", "--shrink-drill",
+         "--recover", "--dump-dir", dump_dir],
+        cwd=REPO, env=env, stdout=subprocess.DEVNULL, timeout=300)
+    rc, n_dumps = 0, 0
+    try:
+        with open(os.path.join(dump_dir, "drill_result.json")) as f:
+            rec = json.load(f)
+        flight = rec.get("flight_artifact")
+        n_dumps = 1 if flight and os.path.exists(flight) else 0
+        survived = (rec_proc.returncode == 0 and rec.get("recovered")
+                    and rec.get("parity_ok") and n_dumps == 1)
+        if not survived:
+            print("preset 'reshard': kill drill not survived cleanly "
+                  "(recover rc=%d recovered=%r parity_ok=%r "
+                  "parity_max_rel=%r flight=%r) under %s"
+                  % (rec_proc.returncode, rec.get("recovered"),
+                     rec.get("parity_ok"), rec.get("parity_max_rel"),
+                     flight, dump_dir), file=sys.stderr)
+            rc = 3
+    except Exception as e:
+        print("preset 'reshard': recovery produced no parseable "
+              "drill_result.json (%s; recover rc=%d); artifacts kept "
+              "at %s" % (e, rec_proc.returncode, dump_dir),
+              file=sys.stderr)
+        rc = 3
+    if rc == 0:
+        shutil.rmtree(dump_dir, ignore_errors=True)
+    else:
+        print("preset 'reshard' FAILED (rc=%d); artifacts kept at %s"
+              % (rc, dump_dir), file=sys.stderr)
+    return rc, time.time() - t0, dump_dir, n_dumps
 
 
 def run_sanitizer_preset(pytest_args):
@@ -511,6 +598,10 @@ def main(argv=None):
         if name == "kv_refcount":
             rc, secs, dump_dir, n_dumps = run_weaver_preset(
                 scenario="kv_refcount", plant="dropped_decref")
+            rows.append((name, rc, secs, n_dumps))
+            continue
+        if name == "reshard":
+            rc, secs, dump_dir, n_dumps = run_reshard_preset()
             rows.append((name, rc, secs, n_dumps))
             continue
         rc, secs, dump_dir, n_dumps = run_preset(name, spec, args.seed,

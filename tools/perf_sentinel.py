@@ -176,6 +176,27 @@ def _extract_scale(obj):
     return out
 
 
+def _extract_autoshard(obj):
+    """tools/autoshard_bench.py (ISSUE 20): ratio metrics only — raw
+    CPU step ms flakes across runs, but auto-vs-best-hand gap
+    fractions (clamped at 1.0), the reshard parity boolean, and the
+    measured-strategy count are machine-stable."""
+    out = {}
+    for p, rec in sorted((obj.get("per_p") or {}).items()):
+        gap = rec.get("auto_gap_frac")
+        if gap is not None:
+            out["autoshard_gap_p%s" % p] = _m(gap, False, "frac")
+    n = sum(len(rec.get("strategies") or [])
+            for rec in (obj.get("per_p") or {}).values())
+    if n:
+        out["autoshard_strategies_measured"] = _m(n, True, "legs")
+    reshard = obj.get("reshard") or {}
+    if "parity_ok" in reshard:
+        out["autoshard_parity_ok"] = _m(
+            1.0 if reshard["parity_ok"] else 0.0, True, "bool")
+    return out
+
+
 def _extract_bench_lines(text):
     """The driver-wrapped training bench (BENCH_r*.json 'tail'): each
     measured claim is one ``{"metric": ..., "value": ..., "unit"}``
@@ -229,6 +250,8 @@ def extract_metrics(obj):
         return _extract_longctx(obj), quick
     if kind == "serve_fleet_bench":
         return _extract_fleet(obj), quick
+    if kind == "autoshard_bench":
+        return _extract_autoshard(obj), quick
     if isinstance(obj, dict) and kind and "value" in obj:
         # a bare bench.py headline line saved to a file
         return _extract_bench_lines(json.dumps(obj)), quick
@@ -248,7 +271,7 @@ def collect_repo(repo):
     paths = sorted(glob.glob(os.path.join(repo, "BENCH_r*.json")))
     for name in ("PSERVER_BENCH.json", "SERVE_BENCH.json",
                  "SCALE_BENCH.json", "LONGCTX_BENCH.json",
-                 "SERVE_FLEET_BENCH.json"):
+                 "SERVE_FLEET_BENCH.json", "AUTOSHARD_BENCH.json"):
         p = os.path.join(repo, name)
         if os.path.exists(p):
             paths.append(p)

@@ -593,6 +593,56 @@ def _measure_ring_us(steps=None, repeats=3):
     return best * 1e6
 
 
+def _measure_autoshard_us(repeats=3):
+    """Elastic SPMD lowering gate (ISSUE 20): auto_shard's strategy
+    search + the ShardingPass annotation walk run at compile-cache-miss
+    cadence — apply_placement bumps the program version, so every run
+    of the pair rides on (and triggers) an XLA recompile of the
+    annotated program.  Gated as search+pass wall over the measured
+    compile it amortizes against: the ParallelExecutor's first
+    prepared run of the same annotated program on however many host
+    devices exist.  Returns (autoshard_us, compile_us)."""
+    import numpy as np
+    import jax
+
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.core.scope import Scope
+    from paddle_tpu.models.transformer import get_model
+    from paddle_tpu.parallel import spmd
+
+    devs = jax.devices("cpu")
+    p = 4 if len(devs) >= 4 else len(devs)
+    main, startup = fluid.Program(), fluid.Program()
+    scope = Scope()
+    with fluid.scope_guard(scope):
+        with fluid.program_guard(main, startup):
+            with fluid.unique_name.guard():
+                loss, feeds, _ = get_model(
+                    vocab_size=32, seq_len=16, d_model=32, n_head=2,
+                    n_layers=2, d_ff=64)
+        fluid.Executor(fluid.CPUPlace()).run(startup)
+    best = float("inf")
+    pl = None
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        pl = spmd.auto_shard(main, p, cost_model=spmd.CostModel(),
+                             batch_size=4)
+        spmd.apply_placement(main, pl)
+        best = min(best, time.perf_counter() - t0)
+    with fluid.scope_guard(scope):
+        pe = fluid.ParallelExecutor(
+            use_tpu=False, loss_name=loss.name, main_program=main,
+            scope=scope, num_devices=p)
+        rng = np.random.RandomState(0)
+        xs = rng.randint(0, 32, (4, 16)).astype(np.int64)
+        ys = np.roll(xs, -1, 1)[:, :, None].astype(np.int64)
+        t0 = time.perf_counter()
+        pe.run(feed={feeds[0].name: xs, feeds[1].name: ys},
+               fetch_list=[loss])
+        compile_s = time.perf_counter() - t0
+    return best * 1e6, compile_s * 1e6
+
+
 def record_gate_gauges(out):
     """Mirror every measured gate fraction into the always-on registry
     (gate name -> ``telemetry_gate_<name>`` gauge) and, when a
@@ -680,6 +730,10 @@ def main(argv=None):
     ring_us = _measure_ring_us()
     ring_frac = (probe_ns * RING_SITES_PER_STEP / 1e3) / ring_us
     ring_limit = float(os.environ.get("RING_OVERHEAD_MAX", dflt))
+    autoshard_us, autoshard_compile_us = _measure_autoshard_us()
+    autoshard_frac = autoshard_us / autoshard_compile_us
+    autoshard_limit = float(os.environ.get("AUTOSHARD_OVERHEAD_MAX",
+                                           dflt))
     out = {
         "step_us": round(step_us, 2),
         "probe_ns_per_site": round(probe_ns, 1),
@@ -762,6 +816,14 @@ def main(argv=None):
         "ring_sites_per_step": RING_SITES_PER_STEP,
         "ring_overhead_frac": round(ring_frac, 6),
         "ring_limit": ring_limit,
+        # ISSUE 20: auto-sharding search + ShardingPass — runs once
+        # per program version (compile-cache-miss cadence, the version
+        # bump forces the recompile it rides on), gated against the
+        # measured compile wall of the annotated program
+        "autoshard_pass_us": round(autoshard_us, 1),
+        "autoshard_compile_us": round(autoshard_compile_us, 1),
+        "autoshard_overhead_frac": round(autoshard_frac, 6),
+        "autoshard_limit": autoshard_limit,
         "ok": (frac < limit and num_frac < num_limit
                and serve_frac < serve_limit
                and gen_frac < gen_limit
@@ -771,7 +833,8 @@ def main(argv=None):
                and slo_frac < slo_limit
                and san_frac < san_limit
                and weaver_frac < weaver_limit
-               and ring_frac < ring_limit),
+               and ring_frac < ring_limit
+               and autoshard_frac < autoshard_limit),
     }
     # gate name -> gauge (+ one tsdb sample when FLAGS_tsdb_dir is
     # set): the measured overheads become durable history, not just

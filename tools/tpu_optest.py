@@ -633,6 +633,106 @@ SKIPS = {
 
 
 # ---------------------------------------------------------------------------
+# Justified-refusal ledger — the op-parity TAIL, closed explicitly.
+#
+# Every v2 surface that deliberately raises NotImplementedError is
+# enumerated here with its justification and the supported route.  The
+# artifact carries the ledger verbatim, and tests/test_refusal_ledger.py
+# asserts the set of in-tree NotImplementedError guards equals this set,
+# so the tail cannot grow (or rot) silently: adding a new refusal without
+# a ledger entry — or listing one that no longer exists — fails the suite.
+#
+# kind="refusal": the whole v2 symbol is refused (callable exists for
+# source compatibility; every call raises).  kind="partial": the layer IS
+# ported and a specific argument/mode raises; ``param`` names it.
+# ---------------------------------------------------------------------------
+
+REFUSALS = {
+    # -- whole-symbol refusals (3) --
+    "get_output": dict(
+        kind="refusal",
+        reason="layers here have exactly one output value; auxiliary "
+               "outputs ride as attributes (e.g. lstm_step(...).state)",
+        use=".state attribute / fluid.layers"),
+    "cross_entropy_over_beam": dict(
+        kind="refusal",
+        reason="beam-training (CRF-over-beam) requires the gserver beam "
+               "expansion records, which the XLA lowering never builds",
+        use="layer.beam_search for generation + per-step "
+            "cross_entropy_cost for training"),
+    "SubsequenceInput": dict(
+        kind="refusal",
+        reason="nested-sequence (level-2) recurrent_group: level-k LoD "
+               "data is ported but the scan-over-subsequences control "
+               "form is not",
+        use="fluid.layers.sequence_* on the inner level, or seq_reshape"),
+    # -- partial guards: the layer works, one argument/mode refuses --
+    "context_projection": dict(
+        kind="partial", param="padding_attr",
+        reason="trainable context padding is a gserver parameter; zero "
+               "padding (padding_attr=False) is the ported semantics",
+        use="padding_attr=False"),
+    "conv_operator": dict(
+        kind="partial", param="trans / per-sample kernels",
+        reason="transposed variant and reference ConvOperator's "
+               "per-sample kernel stream have no grouped-conv lowering",
+        use="conv_projection(trans=True) / img_conv_layer"),
+    "seq_reshape": dict(
+        kind="partial", param="bias_attr",
+        reason="reshape is data movement; the reference bias add after "
+               "it is not ported",
+        use="seq_reshape(...) + layer.addto with a bias layer"),
+    "selective_fc": dict(
+        kind="partial", param="select",
+        reason="column selection is a gserver execution optimization; "
+               "the full fc computes identical selected values",
+        use="select=None (full fc)"),
+    "upsample": dict(
+        kind="partial", param="mask-free / upsample_size / pad_out_*",
+        reason="needs the paired max-pool mask; explicit output sizing "
+               "is not ported (output is scale * input)",
+        use="bilinear_interp for mask-free interpolation"),
+    "img_conv3d": dict(
+        kind="partial", param="trans",
+        reason="transposed 3-D convolution has no lowering",
+        use="img_conv3d(trans=False)"),
+    "prelu": dict(
+        kind="partial", param="partial_sum>1",
+        reason="per-group alpha sharing is not ported",
+        use="partial_sum=1 (per-element) or channel_shared=True"),
+    "sub_seq": dict(
+        kind="partial", param="bias_attr",
+        reason="subsequence extraction is data movement; the post-slice "
+               "bias is not ported",
+        use="sub_seq(...) + layer.addto"),
+    "lstm_step": dict(
+        kind="partial", param="gate/state activations",
+        reason="the lstm_unit op fixes the standard tanh/sigmoid gate "
+               "math; non-default step activations are not ported",
+        use="default activations"),
+    "multibox_loss": dict(
+        kind="partial", param="label / neg_overlap",
+        reason="the v1 packed-label stream and the mining op's "
+               "negative-overlap threshold are not ported",
+        use="(gt_box, gt_label) layers; tune neg_pos_ratio"),
+    "nce": dict(
+        kind="partial", param="neg_distribution / weight / multi-input",
+        reason="only the uniform sampler is ported; per-example "
+               "weighting and implicit multi-input concat are not",
+        use="uniform sampler; layer.scaling; concat inputs first"),
+    "hsigmoid": dict(
+        kind="partial", param="multi-input",
+        reason="implicit multi-input concat is not ported",
+        use="concat inputs first"),
+    "lambda_cost": dict(
+        kind="partial", param="max_sort_size",
+        reason="partial-sort truncation is a CPU-side optimization; the "
+               "whole candidate list is ranked",
+        use="default (full ranking)"),
+}
+
+
+# ---------------------------------------------------------------------------
 # Composite programs: build with the fluid front-end, run on both places,
 # compare every fetch; credit every op type in the program (fwd + emitted
 # grad ops) to the composite.
@@ -987,6 +1087,19 @@ def main():
             results[op] = dict(status="fail", mode="unspecced",
                                note="no spec, no composite credit")
 
+    # registered <op>_grad entries are exercised by the forward spec's
+    # grad check (run_exact compares analytic gradients), so they carry
+    # the forward op's verdict instead of counting as unspecced
+    for op, r in results.items():
+        if r["mode"] != "unspecced" or not op.endswith("_grad"):
+            continue
+        fwd = results.get(op[:-5])
+        if fwd is not None and fwd.get("grad_checked"):
+            results[op] = dict(
+                status=fwd["status"], mode="grad-of-spec",
+                via=op[:-5],
+                note="checked by %s's grad comparison" % op[:-5])
+
     if not only:
         npass = sum(1 for r in results.values() if r["status"] == "pass")
         nskip = sum(1 for r in results.values() if r["status"] == "skip")
@@ -1009,7 +1122,12 @@ def main():
                 date=time.strftime("%Y-%m-%d %H:%M:%S"),
                 total_ops=len(results), passed=npass, failed=nfail,
                 skipped=nskip, grad_checked=ngrad,
+                refused=sum(1 for r in REFUSALS.values()
+                            if r["kind"] == "refusal"),
+                partial_guards=sum(1 for r in REFUSALS.values()
+                                   if r["kind"] == "partial"),
                 wall_seconds=round(time.time() - t_start, 1)),
+            refusal_ledger=REFUSALS,
             results=results)
         out = os.path.join(REPO, "TPU_OPTEST_r05.json")
         with open(out, "w") as f:
